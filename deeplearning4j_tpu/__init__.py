@@ -1,0 +1,17 @@
+"""deeplearning4j_tpu — a TPU-native deep-learning framework with the
+capabilities of Eclipse Deeplearning4j (reference fork:
+midnightradio/deeplearning4j), built on JAX/XLA/Pallas/pjit.
+
+Not a port: the libnd4j C++/CUDA engine is replaced by XLA:TPU via PJRT, the
+SameDiff interpreter by traced jaxprs compiled once per shape, the
+Aeron/parameter-server distributed stack by XLA collectives over ICI/DCN, and
+the JVM layer API by config-driven pure-functional layers. See SURVEY.md for
+the reference blueprint this implements and the recorded divergences.
+"""
+
+__version__ = "0.1.0"
+
+from . import dtypes  # noqa: F401
+from . import rng  # noqa: F401
+from . import tensor  # noqa: F401
+from .tensor import Tensor  # noqa: F401

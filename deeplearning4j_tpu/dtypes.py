@@ -1,0 +1,89 @@
+"""Data type system.
+
+TPU-native equivalent of the nd4j/libnd4j dtype system
+(reference: ``libnd4j/include/array/ArrayOptions.h``†,
+``nd4j-api .../linalg/api/buffer/DataType.java``† — paths per SURVEY.md §2.1/2.2;
+reference mount was empty, citations are upstream-relative, unverified).
+
+Divergences (deliberate, TPU-first):
+- ``bfloat16`` is a first-class citizen (native on the MXU); DL4J treats it as
+  exotic.
+- ``float64`` is supported but discouraged on TPU (software emulation); it is
+  kept for grad-check oracles on CPU.
+- UTF8/compressed buffer types are out of scope (no string tensors in XLA).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+# DL4J-style names -> jnp dtypes. Keys mirror org.nd4j.linalg.api.buffer.DataType.
+_NAME_TO_DTYPE = {
+    "BOOL": jnp.bool_,
+    "INT8": jnp.int8,
+    "INT16": jnp.int16,
+    "INT32": jnp.int32,
+    "INT64": jnp.int64,
+    "UINT8": jnp.uint8,
+    "UINT16": jnp.uint16,
+    "UINT32": jnp.uint32,
+    "UINT64": jnp.uint64,
+    "FLOAT16": jnp.float16,
+    "BFLOAT16": jnp.bfloat16,
+    "FLOAT": jnp.float32,
+    "DOUBLE": jnp.float64,
+    # Aliases (numpy-style, accepted everywhere a dtype name is accepted)
+    "bool": jnp.bool_,
+    "int8": jnp.int8,
+    "int16": jnp.int16,
+    "int32": jnp.int32,
+    "int64": jnp.int64,
+    "uint8": jnp.uint8,
+    "uint16": jnp.uint16,
+    "uint32": jnp.uint32,
+    "uint64": jnp.uint64,
+    "float16": jnp.float16,
+    "bfloat16": jnp.bfloat16,
+    "float32": jnp.float32,
+    "float64": jnp.float64,
+}
+
+bool_ = jnp.bool_
+int8 = jnp.int8
+int16 = jnp.int16
+int32 = jnp.int32
+int64 = jnp.int64
+uint8 = jnp.uint8
+uint16 = jnp.uint16
+uint32 = jnp.uint32
+uint64 = jnp.uint64
+float16 = jnp.float16
+bfloat16 = jnp.bfloat16
+float32 = jnp.float32
+float64 = jnp.float64
+
+FLOATING = (jnp.float16, jnp.bfloat16, jnp.float32, jnp.float64)
+
+
+def resolve(dtype) -> np.dtype:
+    """Resolve a dtype-ish value (DL4J name, numpy name, np/jnp dtype) to numpy dtype."""
+    if isinstance(dtype, str):
+        try:
+            return np.dtype(_NAME_TO_DTYPE[dtype])
+        except KeyError:
+            raise ValueError(f"Unknown dtype name: {dtype!r}") from None
+    return np.dtype(dtype)
+
+
+def name_of(dtype) -> str:
+    """DL4J-style canonical name for a dtype (used in config JSON round-trips)."""
+    d = np.dtype(dtype)
+    for name, cand in _NAME_TO_DTYPE.items():
+        if name.isupper() and np.dtype(cand) == d:
+            return name
+    raise ValueError(f"No DL4J name for dtype {d}")
+
+
+def is_floating(dtype) -> bool:
+    return np.dtype(dtype) in {np.dtype(d) for d in FLOATING}

@@ -1,0 +1,75 @@
+"""Runtime environment knobs.
+
+TPU-native equivalent of libnd4j's ``Environment`` singleton + nd4j's
+``ND4JSystemProperties``/``Nd4jEnvironmentVars`` (reference:
+``libnd4j/include/system/Environment.h``†, ``nd4j-common``† per SURVEY.md §5
+"Config / flag system"; reference mount was empty, citations
+upstream-relative, unverified).
+
+Env-var overrides use the ``DL4J_TPU_`` prefix (mirror of the reference's
+``ND4J_``/``org.nd4j.*`` convention).
+
+The load-bearing knob is **matmul precision policy**: DL4J is strict-fp32;
+XLA's *default* matmul/conv precision on TPU (and this CPU stack) decomposes
+f32 into bf16 passes (~1e-2 error). Policy: float32 inputs compute at
+``Precision.HIGHEST`` (DL4J numeric parity, grad-checkable); bfloat16 inputs
+use native MXU passes (the perf path — mixed-precision models opt in by
+dtype, per SURVEY.md §7.3 item 8).
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+from jax import lax
+
+
+class Environment:
+    _instance = None
+
+    def __init__(self):
+        self.debug = os.environ.get("DL4J_TPU_DEBUG", "0") == "1"
+        self.verbose = os.environ.get("DL4J_TPU_VERBOSE", "0") == "1"
+        # "highest" => f32 math is true f32 (DL4J parity); "default" => let
+        # XLA use fast bf16 passes even for f32 inputs.
+        self.f32_matmul_precision = os.environ.get(
+            "DL4J_TPU_F32_MATMUL_PRECISION", "highest")
+        # NaN/Inf panic mode (ProfilerConfig.checkForNAN/INF equivalent):
+        # routes to jax debug_nans/debug_infs.
+        if os.environ.get("DL4J_TPU_CHECK_NAN", "0") == "1":
+            jax.config.update("jax_debug_nans", True)
+        if os.environ.get("DL4J_TPU_CHECK_INF", "0") == "1":
+            jax.config.update("jax_debug_infs", True)
+        # Default CNN data format for layers ("NCHW" = DL4J default; "NHWC"
+        # is the TPU-preferred layout zoo/bench configs use).
+        self.default_data_format = os.environ.get("DL4J_TPU_DATA_FORMAT", "NCHW")
+
+    @classmethod
+    def instance(cls) -> "Environment":
+        if cls._instance is None:
+            cls._instance = cls()
+        return cls._instance
+
+    def set_check_nan(self, enabled: bool) -> None:
+        jax.config.update("jax_debug_nans", enabled)
+
+    def set_check_inf(self, enabled: bool) -> None:
+        jax.config.update("jax_debug_infs", enabled)
+
+
+def precision_for(*arrays):
+    """lax.Precision for a matmul/conv over these operands.
+
+    float32 anywhere -> HIGHEST (unless policy overridden); pure
+    bf16/f16/int -> None (XLA default, native MXU passes).
+    """
+    env = Environment.instance()
+    if env.f32_matmul_precision != "highest":
+        return None
+    import jax.numpy as jnp
+    for a in arrays:
+        dt = getattr(a, "dtype", None)
+        if dt == jnp.float32 or dt == jnp.float64:
+            return lax.Precision.HIGHEST
+    return None

@@ -1,0 +1,90 @@
+"""Op catalog and coverage ledger.
+
+TPU-native equivalent of the libnd4j declarable-op registry + nd4j
+``OpValidation`` coverage accounting (reference:
+``libnd4j/include/ops/declarable/OpRegistrator.h``†,
+``nd4j-api .../autodiff/validation/OpValidation.java``† per SURVEY.md
+§2.1/§2.2; reference mount was empty, citations upstream-relative,
+unverified).
+
+Every public op in this package is a pure function over ``jax.Array``s,
+registered here with a name and flags for whether a forward test and a
+gradient test exist. ``coverage_report()`` mirrors OpValidation's accounting:
+CI asserts that coverage never regresses (see ``tests/test_op_coverage.py``).
+
+There is no dispatch machinery — XLA is the executor; the registry exists for
+(a) test-coverage accounting, (b) the graph layer's name->callable lookup used
+by serialization and import frontends.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, Optional
+
+
+@dataclasses.dataclass
+class OpDef:
+    name: str
+    fn: Callable
+    category: str = "misc"
+    differentiable: bool = True
+
+
+_REGISTRY: Dict[str, OpDef] = {}
+_FWD_TESTED: set = set()
+_GRAD_TESTED: set = set()
+
+
+def register(name: str, category: str = "misc", differentiable: bool = True):
+    """Decorator: register an op in the catalog."""
+
+    def deco(fn):
+        _REGISTRY[name] = OpDef(name=name, fn=fn, category=category,
+                                differentiable=differentiable)
+        return fn
+
+    return deco
+
+
+def get(name: str) -> OpDef:
+    return _REGISTRY[name]
+
+
+def lookup(name: str) -> Optional[Callable]:
+    od = _REGISTRY.get(name)
+    return od.fn if od else None
+
+
+def all_ops() -> Dict[str, OpDef]:
+    return dict(_REGISTRY)
+
+
+def mark_fwd_tested(name: str) -> None:
+    _FWD_TESTED.add(name)
+
+
+def mark_grad_tested(name: str) -> None:
+    _GRAD_TESTED.add(name)
+
+
+def coverage_report() -> dict:
+    """OpValidation-style accounting of which ops have fwd/grad tests."""
+    total = len(_REGISTRY)
+    diff = [n for n, d in _REGISTRY.items() if d.differentiable]
+    return {
+        "total_ops": total,
+        "fwd_tested": sorted(_FWD_TESTED & set(_REGISTRY)),
+        "grad_tested": sorted(_GRAD_TESTED & set(diff)),
+        "fwd_untested": sorted(set(_REGISTRY) - _FWD_TESTED),
+        "grad_untested": sorted(set(diff) - _GRAD_TESTED),
+        "fwd_coverage": (len(_FWD_TESTED & set(_REGISTRY)) / total) if total else 1.0,
+        "grad_coverage": (len(_GRAD_TESTED & set(diff)) / len(diff)) if diff else 1.0,
+    }
+
+
+# Import op modules so registration runs at package import.
+from . import activations  # noqa: E402,F401
+from . import losses  # noqa: E402,F401
+from . import nnops  # noqa: E402,F401
+from . import reduce  # noqa: E402,F401

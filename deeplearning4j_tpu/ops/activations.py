@@ -1,0 +1,160 @@
+"""Activation functions.
+
+TPU-native equivalent of nd4j's ``IActivation`` implementations (reference:
+``nd4j-api .../linalg/activations/impl/``† — ~25 classes, per SURVEY.md §2.2;
+reference mount was empty, citation upstream-relative, unverified).
+
+Each is a pure elementwise function; XLA fuses them into the surrounding
+matmul/conv epilogue, so there is no per-activation kernel (the whole reason
+DL4J needed IActivation.backprop methods disappears under autodiff).
+Names mirror the DL4J activation enum (``Activation.RELU`` etc.) and are the
+strings used in config JSON round-trips.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from . import register
+
+# name -> callable; populated by _act
+ACTIVATIONS = {}
+
+
+def _act(name):
+    def deco(fn):
+        ACTIVATIONS[name] = fn
+        register(f"act.{name}", category="activation")(fn)
+        return fn
+    return deco
+
+
+@_act("identity")
+def identity(x):
+    return x
+
+
+@_act("relu")
+def relu(x):
+    return jax.nn.relu(x)
+
+
+@_act("relu6")
+def relu6(x):
+    return jnp.minimum(jax.nn.relu(x), 6.0)
+
+
+@_act("leakyrelu")
+def leakyrelu(x, alpha=0.01):
+    # DL4J LeakyReLU default alpha = 0.01
+    return jnp.where(x >= 0, x, alpha * x)
+
+
+@_act("thresholdedrelu")
+def thresholdedrelu(x, theta=1.0):
+    return jnp.where(x > theta, x, 0.0)
+
+
+@_act("elu")
+def elu(x, alpha=1.0):
+    return jax.nn.elu(x, alpha)
+
+
+@_act("selu")
+def selu(x):
+    return jax.nn.selu(x)
+
+
+@_act("gelu")
+def gelu(x):
+    # DL4J GELU is the tanh approximation (matches original paper impl).
+    return jax.nn.gelu(x, approximate=True)
+
+
+@_act("swish")
+def swish(x):
+    return jax.nn.silu(x)
+
+
+@_act("mish")
+def mish(x):
+    return x * jnp.tanh(jax.nn.softplus(x))
+
+
+@_act("sigmoid")
+def sigmoid(x):
+    return jax.nn.sigmoid(x)
+
+
+@_act("hardsigmoid")
+def hardsigmoid(x):
+    # DL4J HardSigmoid: clamp(0.2*x + 0.5, 0, 1)
+    return jnp.clip(0.2 * x + 0.5, 0.0, 1.0)
+
+
+@_act("tanh")
+def tanh(x):
+    return jnp.tanh(x)
+
+
+@_act("hardtanh")
+def hardtanh(x):
+    return jnp.clip(x, -1.0, 1.0)
+
+
+@_act("rationaltanh")
+def rationaltanh(x):
+    # DL4J RationalTanh: 1.7159 * tanh_approx(2x/3) with rational approx
+    # f(x) = 1.7159 * sgn(x) * (1 - 1/(1 + |c*x| + (c*x)^2 + 1.41645*(c*x)^4))
+    cx = jnp.abs(2.0 * x / 3.0)
+    a = 1.0 + cx + cx * cx + 1.41645 * cx ** 4
+    return 1.7159 * jnp.sign(x) * (1.0 - 1.0 / a)
+
+
+@_act("recttanh")
+def recttanh(x):
+    # Rectified tanh: max(0, tanh(x))
+    return jnp.maximum(0.0, jnp.tanh(x))
+
+
+@_act("softmax")
+def softmax(x):
+    return jax.nn.softmax(x, axis=-1)
+
+
+@_act("logsoftmax")
+def logsoftmax(x):
+    return jax.nn.log_softmax(x, axis=-1)
+
+
+@_act("softplus")
+def softplus(x):
+    return jax.nn.softplus(x)
+
+
+@_act("softsign")
+def softsign(x):
+    return jax.nn.soft_sign(x)
+
+
+@_act("cube")
+def cube(x):
+    return x ** 3
+
+
+def get(name_or_fn):
+    """Resolve an activation by DL4J-style name (case-insensitive) or passthrough."""
+    if callable(name_or_fn):
+        return name_or_fn
+    key = str(name_or_fn).lower().replace("_", "")
+    if key not in ACTIVATIONS:
+        raise ValueError(f"Unknown activation {name_or_fn!r}; known: {sorted(ACTIVATIONS)}")
+    return ACTIVATIONS[key]
+
+
+def name_of(fn) -> str:
+    for k, v in ACTIVATIONS.items():
+        if v is fn:
+            return k
+    raise ValueError(f"Unregistered activation {fn}")

@@ -1,0 +1,375 @@
+"""Neural-network ops: convolution, pooling, normalization, recurrence, attention.
+
+TPU-native replacement for the libnd4j declarable-op nn families and their
+cuDNN/oneDNN platform helpers (reference:
+``libnd4j/include/ops/declarable/generic/nn/``†,
+``libnd4j/include/ops/declarable/platform/{cudnn,mkldnn}/``† per SURVEY.md
+§2.1; reference mount was empty, citations upstream-relative, unverified).
+
+Everything lowers to ``lax`` primitives that XLA maps onto the MXU
+(conv/matmul) or fuses into epilogues (bias, activation, bn). The cuDNN
+"helper seam" from SURVEY.md §3.1 does not exist here — XLA owns kernel
+choice.
+
+Layout policy (SURVEY.md §7.3 item 1): ops take ``data_format`` ("NCHW" |
+"NHWC"). DL4J's default is NCHW; TPU prefers NHWC. Layers default to NCHW for
+config/import parity and XLA:TPU transposes internally; perf-critical zoo
+configs set NHWC end-to-end.
+
+Padding parity: DL4J ConvolutionMode.Truncate == explicit pad (default 0) with
+floor division; Same == TF-style SAME; Causal == left-pad for conv1d.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from . import register
+from ..environment import precision_for
+
+
+def _pair(v) -> Tuple[int, int]:
+    if isinstance(v, (tuple, list)):
+        return (int(v[0]), int(v[1]))
+    return (int(v), int(v))
+
+
+def _conv_dnums(data_format: str):
+    if data_format == "NCHW":
+        return ("NCHW", "OIHW", "NCHW")
+    if data_format == "NHWC":
+        return ("NHWC", "HWIO", "NHWC")
+    raise ValueError(f"Unknown data_format {data_format}")
+
+
+def _conv_padding(mode: str, padding, kernel, stride, dilation):
+    """Resolve DL4J ConvolutionMode + explicit padding to lax padding config."""
+    if mode == "same":
+        return "SAME"
+    if mode == "causal":
+        # left-pad only (1d conv): (k-1)*d on the left
+        return [((k - 1) * d, 0) for k, d in zip(kernel, dilation)]
+    # truncate/strict: explicit symmetric padding
+    pad = padding if isinstance(padding, (tuple, list)) else (padding,) * len(kernel)
+    return [(int(p), int(p)) for p in pad]
+
+
+@register("conv2d", category="cnn")
+def conv2d(x, w, b=None, stride=(1, 1), padding=0, dilation=(1, 1),
+           mode="truncate", data_format="NCHW", groups=1):
+    """2D convolution (libnd4j ``conv2d`` declarable op; cuDNN helper path).
+
+    x: [N,C,H,W] or [N,H,W,C]; w: [O,I/g,kH,kW] (OIHW, DL4J weight layout)
+    regardless of data_format — importers hand us OIHW and we let XLA
+    transpose. b: [O] or None.
+    """
+    stride, dilation = _pair(stride), _pair(dilation)
+    kh, kw = w.shape[2], w.shape[3]
+    io_layout, _, out_layout = _conv_dnums(data_format)
+    dn = lax.conv_dimension_numbers(x.shape, w.shape, (io_layout, "OIHW", out_layout))
+    pad = _conv_padding(mode, padding, (kh, kw), stride, dilation)
+    y = lax.conv_general_dilated(
+        x, w, window_strides=stride, padding=pad,
+        rhs_dilation=dilation, dimension_numbers=dn,
+        feature_group_count=groups, precision=precision_for(x, w),
+        preferred_element_type=jnp.float32 if x.dtype == jnp.bfloat16 else None)
+    if y.dtype != x.dtype:
+        y = y.astype(x.dtype)
+    if b is not None:
+        y = y + (b.reshape(1, -1, 1, 1) if data_format == "NCHW" else b.reshape(1, 1, 1, -1))
+    return y
+
+
+@register("deconv2d", category="cnn")
+def deconv2d(x, w, b=None, stride=(1, 1), padding=0, dilation=(1, 1),
+             mode="truncate", data_format="NCHW"):
+    """Transposed 2D convolution (libnd4j ``deconv2d``). w: [O,I,kH,kW] with
+    O = output channels (DL4J deconv weight layout)."""
+    stride, dilation = _pair(stride), _pair(dilation)
+    kh, kw = w.shape[2], w.shape[3]
+    dn = lax.conv_dimension_numbers(x.shape, (w.shape[1], w.shape[0], kh, kw),
+                                    (_conv_dnums(data_format)[0], "OIHW", _conv_dnums(data_format)[2]))
+    if mode == "same":
+        pad = "SAME"
+    else:
+        p = padding if isinstance(padding, (tuple, list)) else (padding, padding)
+        pad = [(int(pi), int(pi)) for pi in p]
+    # lax.conv_transpose wants rhs as [spatial..., I, O] per dn; use OIHW with
+    # transpose_kernel semantics: swap I/O of the stored weight.
+    y = lax.conv_transpose(
+        x, jnp.swapaxes(w, 0, 1), strides=stride, padding=pad,
+        rhs_dilation=dilation, dimension_numbers=dn, transpose_kernel=True,
+        precision=precision_for(x, w))
+    if b is not None:
+        y = y + (b.reshape(1, -1, 1, 1) if data_format == "NCHW" else b.reshape(1, 1, 1, -1))
+    return y
+
+
+@register("depthwise_conv2d", category="cnn")
+def depthwise_conv2d(x, w, b=None, stride=(1, 1), padding=0, dilation=(1, 1),
+                     mode="truncate", data_format="NCHW"):
+    """Depthwise conv (libnd4j ``depthwise_conv2d``). w: [C*mult, 1, kH, kW]."""
+    c = x.shape[1] if data_format == "NCHW" else x.shape[3]
+    return conv2d(x, w, b, stride, padding, dilation, mode, data_format, groups=c)
+
+
+@register("separable_conv2d", category="cnn")
+def separable_conv2d(x, w_depth, w_point, b=None, stride=(1, 1), padding=0,
+                     dilation=(1, 1), mode="truncate", data_format="NCHW"):
+    """Separable conv = depthwise then 1x1 pointwise (libnd4j ``sconv2d``)."""
+    y = depthwise_conv2d(x, w_depth, None, stride, padding, dilation, mode, data_format)
+    return conv2d(y, w_point, b, (1, 1), 0, (1, 1), "truncate", data_format)
+
+
+def _pool(x, kind, kernel, stride, padding, mode, data_format, pnorm_p=2.0):
+    kh, kw = _pair(kernel)
+    sh, sw = _pair(stride)
+    if data_format == "NCHW":
+        window = (1, 1, kh, kw)
+        strides = (1, 1, sh, sw)
+    else:
+        window = (1, kh, kw, 1)
+        strides = (1, sh, sw, 1)
+    if mode == "same":
+        pad = "SAME"
+    else:
+        ph, pw = _pair(padding)
+        pad = [(0, 0), (0, 0), (ph, ph), (pw, pw)] if data_format == "NCHW" else \
+              [(0, 0), (ph, ph), (pw, pw), (0, 0)]
+    if kind == "max":
+        init = -jnp.inf
+        y = lax.reduce_window(x, init, lax.max, window, strides, pad)
+    elif kind == "avg":
+        s = lax.reduce_window(x, 0.0, lax.add, window, strides, pad)
+        # DL4J avg pool divides by the full kernel size (incl. padding cells)
+        # in Truncate mode; with SAME it divides by the actual window count.
+        if mode == "same":
+            ones = jnp.ones_like(x)
+            cnt = lax.reduce_window(ones, 0.0, lax.add, window, strides, pad)
+            y = s / cnt
+        else:
+            y = s / (kh * kw)
+    elif kind == "pnorm":
+        s = lax.reduce_window(jnp.abs(x) ** pnorm_p, 0.0, lax.add, window, strides, pad)
+        y = s ** (1.0 / pnorm_p)
+    else:
+        raise ValueError(kind)
+    return y
+
+
+@register("maxpool2d", category="cnn")
+def max_pool2d(x, kernel, stride=None, padding=0, mode="truncate", data_format="NCHW"):
+    """Max pooling (SubsamplingLayer PoolingType.MAX; libnd4j ``maxpool2d``)."""
+    return _pool(x, "max", kernel, stride or kernel, padding, mode, data_format)
+
+
+@register("avgpool2d", category="cnn")
+def avg_pool2d(x, kernel, stride=None, padding=0, mode="truncate", data_format="NCHW"):
+    return _pool(x, "avg", kernel, stride or kernel, padding, mode, data_format)
+
+
+@register("pnormpool2d", category="cnn")
+def pnorm_pool2d(x, kernel, stride=None, padding=0, mode="truncate",
+                 data_format="NCHW", p=2.0):
+    return _pool(x, "pnorm", kernel, stride or kernel, padding, mode, data_format, p)
+
+
+@register("global_pool", category="cnn")
+def global_pool(x, pool_type="max", data_format="NCHW", keepdims=False):
+    """GlobalPoolingLayer: pool over all spatial (or time) dims."""
+    axes = (2, 3) if (data_format == "NCHW" and x.ndim == 4) else \
+           (1, 2) if x.ndim == 4 else (2,) if data_format == "NCHW" else (1,)
+    if pool_type == "max":
+        return jnp.max(x, axis=axes, keepdims=keepdims)
+    if pool_type == "avg":
+        return jnp.mean(x, axis=axes, keepdims=keepdims)
+    if pool_type == "sum":
+        return jnp.sum(x, axis=axes, keepdims=keepdims)
+    if pool_type == "pnorm":
+        return jnp.sum(jnp.abs(x) ** 2.0, axis=axes, keepdims=keepdims) ** 0.5
+    raise ValueError(pool_type)
+
+
+@register("batch_norm", category="normalization")
+def batch_norm(x, gamma, beta, mean, var, eps=1e-5, axis=1):
+    """Batch norm inference/normalize step (libnd4j ``batchnorm``; cuDNN
+    helper path). ``axis`` = channel axis (1 for NCHW, -1 for NHWC).
+
+    Training-mode statistics are computed by the BatchNormalization layer
+    (which passes batch statistics here and maintains running averages); XLA
+    fuses the whole thing.
+    """
+    shape = [1] * x.ndim
+    shape[axis] = x.shape[axis]
+    mean = mean.reshape(shape)
+    var = var.reshape(shape)
+    g = gamma.reshape(shape) if gamma is not None else 1.0
+    b = beta.reshape(shape) if beta is not None else 0.0
+    inv = lax.rsqrt(var + eps)
+    return (x - mean) * inv * g + b
+
+
+@register("layer_norm", category="normalization")
+def layer_norm(x, gamma, beta, eps=1e-5, axis=-1):
+    mean = jnp.mean(x, axis=axis, keepdims=True)
+    var = jnp.var(x, axis=axis, keepdims=True)
+    y = (x - mean) * lax.rsqrt(var + eps)
+    return y * gamma + beta
+
+
+@register("lrn", category="normalization")
+def local_response_normalization(x, k=2.0, n=5, alpha=1e-4, beta=0.75,
+                                 data_format="NCHW"):
+    """LocalResponseNormalization (libnd4j ``lrn``), cross-channel."""
+    caxis = 1 if data_format == "NCHW" else 3
+    sq = jnp.square(x)
+    half = n // 2
+    window = [1] * x.ndim
+    window[caxis] = n
+    pad = [(0, 0)] * x.ndim
+    pad[caxis] = (half, half)
+    s = lax.reduce_window(sq, 0.0, lax.add, tuple(window), (1,) * x.ndim, pad)
+    return x / jnp.power(k + alpha * s, beta)
+
+
+@register("dropout", category="regularization")
+def dropout(x, rate, key, deterministic=False):
+    """Inverted dropout (DL4J Dropout with p = *retain* probability is the
+    config-level concern; this op takes the DROP rate)."""
+    if deterministic or rate == 0.0:
+        return x
+    keep = 1.0 - rate
+    mask = jax.random.bernoulli(key, keep, x.shape)
+    return jnp.where(mask, x / keep, 0.0)
+
+
+@register("embedding_lookup", category="embedding")
+def embedding_lookup(table, ids):
+    """EmbeddingLayer/EmbeddingSequenceLayer lookup (gather rides HBM)."""
+    return jnp.take(table, jnp.asarray(ids, dtype=jnp.int32), axis=0)
+
+
+# -- recurrence -------------------------------------------------------------
+
+@register("lstm_cell", category="rnn")
+def lstm_cell(x, h, c, w_ih, w_hh, b, forget_bias=0.0):
+    """Standard LSTM cell, gate order [i, f, o, g] (DL4J LSTMBlockCell order).
+
+    One fused [in+hidden, 4*units] matmul per step — the shape the MXU wants.
+    Peephole (GravesLSTM) variant is :func:`graves_lstm_cell`.
+    """
+    prec = precision_for(x, w_ih)
+    z = jnp.dot(x, w_ih, precision=prec) + jnp.dot(h, w_hh, precision=prec) + b
+    i, f, o, g = jnp.split(z, 4, axis=-1)
+    f = jax.nn.sigmoid(f + forget_bias)
+    i = jax.nn.sigmoid(i)
+    o = jax.nn.sigmoid(o)
+    g = jnp.tanh(g)
+    c_new = f * c + i * g
+    h_new = o * jnp.tanh(c_new)
+    return h_new, c_new
+
+
+@register("graves_lstm_cell", category="rnn")
+def graves_lstm_cell(x, h, c, w_ih, w_hh, b, w_peep):
+    """Graves (peephole) LSTM cell — DL4J GravesLSTM parity
+    (peepholes on i, f from c_{t-1}; on o from c_t). w_peep: [3, units]."""
+    prec = precision_for(x, w_ih)
+    z = jnp.dot(x, w_ih, precision=prec) + jnp.dot(h, w_hh, precision=prec) + b
+    i, f, o, g = jnp.split(z, 4, axis=-1)
+    i = jax.nn.sigmoid(i + w_peep[0] * c)
+    f = jax.nn.sigmoid(f + w_peep[1] * c)
+    g = jnp.tanh(g)
+    c_new = f * c + i * g
+    o = jax.nn.sigmoid(o + w_peep[2] * c_new)
+    h_new = o * jnp.tanh(c_new)
+    return h_new, c_new
+
+
+@register("simple_rnn_cell", category="rnn")
+def simple_rnn_cell(x, h, w_ih, w_hh, b, activation=jnp.tanh):
+    prec = precision_for(x, w_ih)
+    return activation(jnp.dot(x, w_ih, precision=prec) + jnp.dot(h, w_hh, precision=prec) + b)
+
+
+@register("dot_product_attention", category="attention")
+def dot_product_attention(q, k, v, mask=None, scaled=True):
+    """Scaled dot-product attention (DL4J ``dot_product_attention`` op /
+    attention vertices). q,k,v: [..., T, d]. mask: broadcastable to
+    [..., Tq, Tk], 1 = attend."""
+    d = q.shape[-1]
+    scores = jnp.einsum("...qd,...kd->...qk", q, k, precision=precision_for(q, k))
+    if scaled:
+        scores = scores / jnp.sqrt(jnp.asarray(d, dtype=scores.dtype))
+    if mask is not None:
+        scores = jnp.where(mask.astype(bool), scores, jnp.finfo(scores.dtype).min)
+    w = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("...qk,...kd->...qd", w, v, precision=precision_for(w, v))
+
+
+# -- resampling / structural -----------------------------------------------
+
+@register("upsampling2d", category="cnn")
+def upsampling2d(x, size, data_format="NCHW"):
+    sh, sw = _pair(size)
+    if data_format == "NCHW":
+        return jnp.repeat(jnp.repeat(x, sh, axis=2), sw, axis=3)
+    return jnp.repeat(jnp.repeat(x, sh, axis=1), sw, axis=2)
+
+
+@register("zero_padding2d", category="cnn")
+def zero_padding2d(x, padding, data_format="NCHW"):
+    """padding: (pad_h, pad_w) symmetric, or ((top, bottom), (left, right))."""
+    if isinstance(padding[0], (tuple, list)):
+        (pt, pb), (pl, pr) = padding
+    else:
+        pt = pb = int(padding[0])
+        pl = pr = int(padding[1])
+    cfg = [(0, 0), (0, 0), (pt, pb), (pl, pr)] if data_format == "NCHW" else \
+          [(0, 0), (pt, pb), (pl, pr), (0, 0)]
+    return jnp.pad(x, cfg)
+
+
+@register("cropping2d", category="cnn")
+def cropping2d(x, cropping, data_format="NCHW"):
+    if not isinstance(cropping[0], (tuple, list)):
+        (ct, cb), (cl, cr) = (cropping[0], cropping[0]), (cropping[1], cropping[1])
+    else:
+        (ct, cb), (cl, cr) = cropping
+    if data_format == "NCHW":
+        h, w = x.shape[2], x.shape[3]
+        return x[:, :, ct:h - cb, cl:w - cr]
+    h, w = x.shape[1], x.shape[2]
+    return x[:, ct:h - cb, cl:w - cr, :]
+
+
+@register("space_to_depth", category="cnn")
+def space_to_depth(x, block_size, data_format="NCHW"):
+    b = block_size
+    if data_format == "NCHW":
+        n, c, h, w = x.shape
+        x = x.reshape(n, c, h // b, b, w // b, b)
+        x = x.transpose(0, 3, 5, 1, 2, 4)
+        return x.reshape(n, c * b * b, h // b, w // b)
+    n, h, w, c = x.shape
+    x = x.reshape(n, h // b, b, w // b, b, c)
+    x = x.transpose(0, 1, 3, 2, 4, 5)
+    return x.reshape(n, h // b, w // b, c * b * b)
+
+
+@register("depth_to_space", category="cnn")
+def depth_to_space(x, block_size, data_format="NCHW"):
+    b = block_size
+    if data_format == "NCHW":
+        n, c, h, w = x.shape
+        x = x.reshape(n, b, b, c // (b * b), h, w)
+        x = x.transpose(0, 3, 4, 1, 5, 2)
+        return x.reshape(n, c // (b * b), h * b, w * b)
+    n, h, w, c = x.shape
+    x = x.reshape(n, h, w, b, b, c // (b * b))
+    x = x.transpose(0, 1, 3, 2, 4, 5)
+    return x.reshape(n, h * b, w * b, c // (b * b))

@@ -1,0 +1,47 @@
+"""Reduction / index / statistics ops registered in the catalog.
+
+TPU-native equivalent of libnd4j's legacy reduce / indexreduce /
+summarystats loop families (reference: ``libnd4j/include/loops/``† per
+SURVEY.md §2.1; reference mount was empty, citation upstream-relative,
+unverified). These exist as named catalog entries for the graph layer and
+coverage ledger; the Tensor facade calls jnp directly.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from . import register
+
+register("reduce.sum", category="reduce")(jnp.sum)
+register("reduce.mean", category="reduce")(jnp.mean)
+register("reduce.max", category="reduce")(jnp.max)
+register("reduce.min", category="reduce")(jnp.min)
+register("reduce.prod", category="reduce")(jnp.prod)
+register("reduce.std", category="reduce")(jnp.std)
+register("reduce.var", category="reduce")(jnp.var)
+register("reduce.argmax", category="indexreduce", differentiable=False)(jnp.argmax)
+register("reduce.argmin", category="indexreduce", differentiable=False)(jnp.argmin)
+register("reduce.cumsum", category="reduce")(jnp.cumsum)
+
+
+@register("reduce.norm1", category="reduce")
+def norm1(a, axis=None, keepdims=False):
+    return jnp.sum(jnp.abs(a), axis=axis, keepdims=keepdims)
+
+
+@register("reduce.norm2", category="reduce")
+def norm2(a, axis=None, keepdims=False):
+    return jnp.sqrt(jnp.sum(jnp.square(a), axis=axis, keepdims=keepdims))
+
+
+@register("reduce.normmax", category="reduce")
+def normmax(a, axis=None, keepdims=False):
+    return jnp.max(jnp.abs(a), axis=axis, keepdims=keepdims)
+
+
+@register("reduce.logsumexp", category="reduce")
+def logsumexp(a, axis=None, keepdims=False):
+    m = jnp.max(a, axis=axis, keepdims=True)
+    out = jnp.log(jnp.sum(jnp.exp(a - m), axis=axis, keepdims=True)) + m
+    return out if keepdims else jnp.squeeze(out, axis=axis)

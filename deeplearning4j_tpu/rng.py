@@ -1,0 +1,85 @@
+"""Random number generation.
+
+TPU-native equivalent of nd4j's RNG subsystem
+(reference: ``nd4j-api .../linalg/api/rng/**``† per SURVEY.md §2.2; reference
+mount was empty, citation upstream-relative, unverified).
+
+Design: JAX threefry counter-based keys instead of stateful mersenne/philox
+generators. A module-level :class:`Random` holds a key and splits on each
+draw, giving DL4J-style "global seeded RNG" ergonomics
+(``Nd4j.getRandom().setSeed(…)``) while every draw remains a pure function of
+(seed, draw_index) — reproducible across hosts and restarts, which the
+reference's stateful native generators were not.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import jax
+import jax.numpy as jnp
+
+
+class Random:
+    """Stateful wrapper over JAX functional PRNG keys.
+
+    Thread-safe: each ``next_key`` under a lock. For jit-compiled training
+    loops, callers should draw keys *outside* jit and thread them in (the
+    framework's Model/Trainer does this); this class is the eager-mode
+    convenience surface.
+    """
+
+    def __init__(self, seed: int = 0):
+        self._lock = threading.Lock()
+        self._seed = seed
+        self._key = jax.random.PRNGKey(seed)
+
+    def set_seed(self, seed: int) -> None:
+        with self._lock:
+            self._seed = seed
+            self._key = jax.random.PRNGKey(seed)
+
+    @property
+    def seed(self) -> int:
+        return self._seed
+
+    def next_key(self):
+        with self._lock:
+            self._key, sub = jax.random.split(self._key)
+            return sub
+
+    def split(self, n: int):
+        with self._lock:
+            self._key, *subs = jax.random.split(self._key, n + 1)
+            return subs
+
+    # -- eager draw helpers (nd4j Nd4j.rand/randn parity) --------------------
+    def uniform(self, shape, minval=0.0, maxval=1.0, dtype=jnp.float32):
+        return jax.random.uniform(
+            self.next_key(), shape, dtype=dtype, minval=minval, maxval=maxval
+        )
+
+    def normal(self, shape, mean=0.0, std=1.0, dtype=jnp.float32):
+        return mean + std * jax.random.normal(self.next_key(), shape, dtype=dtype)
+
+    def bernoulli(self, p, shape):
+        return jax.random.bernoulli(self.next_key(), p, shape)
+
+    def randint(self, shape, minval, maxval, dtype=jnp.int32):
+        return jax.random.randint(self.next_key(), shape, minval, maxval, dtype=dtype)
+
+    def permutation(self, n: int):
+        return jax.random.permutation(self.next_key(), n)
+
+
+_default = Random(seed=1234)
+
+
+def get_default_rng() -> Random:
+    """The process-wide default RNG (``Nd4j.getRandom()`` equivalent)."""
+    return _default
+
+
+def set_seed(seed: int) -> None:
+    """``Nd4j.getRandom().setSeed`` equivalent."""
+    _default.set_seed(seed)

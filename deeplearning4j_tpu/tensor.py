@@ -1,0 +1,544 @@
+"""Tensor: an eager ndarray facade over ``jax.Array``.
+
+TPU-native equivalent of nd4j's ``INDArray``/``BaseNDArray`` and the ``Nd4j``
+factory (reference: ``nd4j-api .../linalg/api/ndarray/INDArray.java``†,
+``.../factory/Nd4j.java``† per SURVEY.md §2.2; reference mount was empty,
+citations upstream-relative, unverified).
+
+Architecture (TPU-first, per SURVEY.md §7.1 "nd4j INDArray + backends" row):
+
+- The buffer IS a ``jax.Array`` resident on device (TPU HBM via PJRT). There
+  is no separate host/device DataBuffer pair, no JITA allocator, no
+  workspaces: XLA + PJRT own memory. Arena-style reuse is obtained for free
+  from jit + buffer donation in the compiled training paths.
+- Eager ops are dispatched through **one jitted callable per op** (module
+  cache below). ``jax.jit``'s internal cache then specializes per
+  (shape, dtype) — this is the "shape-specialized jit cache" SURVEY.md §7.3
+  item 2 calls for, and is what makes op-at-a-time user math viable on TPU.
+- DL4J's mutating in-place ops (``addi``/``subi``/…) have no XLA equivalent
+  (arrays are immutable values). The ``*_i`` methods REBIND this Tensor's
+  buffer and return ``self``. Semantics match for the dominant usage pattern
+  (accumulate-into-var); true aliasing through views is deliberately not
+  reproduced. Views produced by indexing are copies-on-write at the XLA
+  level. This is a recorded divergence, not an accident.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import dtypes as _dt
+from . import rng as _rng
+
+# --------------------------------------------------------------------------
+# Op dispatch cache: one jitted callable per op name; jax.jit specializes on
+# (shape, dtype) internally. Static kwargs are closed over via cache key.
+# --------------------------------------------------------------------------
+_JIT_CACHE: Dict[Any, Callable] = {}
+
+
+def _jitted(key: Any, fn: Callable, **jit_kwargs) -> Callable:
+    cached = _JIT_CACHE.get(key)
+    if cached is None:
+        cached = jax.jit(fn, **jit_kwargs)
+        _JIT_CACHE[key] = cached
+    return cached
+
+
+def _unwrap(x):
+    if isinstance(x, Tensor):
+        return x._a
+    return x
+
+
+def _wrap(x) -> "Tensor":
+    return Tensor(x)
+
+
+class Tensor:
+    """Dense device tensor. See module docstring for the design contract."""
+
+    __slots__ = ("_a",)
+
+    def __init__(self, data, dtype=None):
+        if isinstance(data, Tensor):
+            data = data._a
+        if isinstance(data, jax.Array) and dtype is None:
+            self._a = data
+        else:
+            d = _dt.resolve(dtype) if dtype is not None else None
+            self._a = jnp.asarray(data, dtype=d)
+
+    # -- introspection ------------------------------------------------------
+    @property
+    def jax(self) -> jax.Array:
+        """The underlying jax.Array (escape hatch to raw JAX)."""
+        return self._a
+
+    @property
+    def shape(self) -> Tuple[int, ...]:
+        return tuple(self._a.shape)
+
+    @property
+    def ndim(self) -> int:
+        return self._a.ndim
+
+    @property
+    def size(self) -> int:
+        return int(self._a.size)
+
+    # DL4J name: length()
+    def length(self) -> int:
+        return self.size
+
+    @property
+    def dtype(self):
+        return self._a.dtype
+
+    def data_type(self) -> str:
+        """DL4J-style dtype name (``INDArray.dataType()``)."""
+        return _dt.name_of(self._a.dtype)
+
+    def numpy(self) -> np.ndarray:
+        return np.asarray(self._a)
+
+    def item(self):
+        return self._a.item()
+
+    def __repr__(self):
+        return f"Tensor(shape={self.shape}, dtype={self._a.dtype},\n{np.asarray(self._a)!r})"
+
+    def __len__(self):
+        if self.ndim == 0:
+            raise TypeError("len() of a 0-d Tensor")
+        return self.shape[0]
+
+    def __bool__(self):
+        # scalar -> its truth value; multi-element raises (numpy/jax semantics)
+        return bool(self._a)
+
+    # -- casting / copies ---------------------------------------------------
+    def astype(self, dtype) -> "Tensor":
+        return _wrap(self._a.astype(_dt.resolve(dtype)))
+
+    # DL4J name: castTo
+    cast_to = astype
+
+    def dup(self) -> "Tensor":
+        """Copy (``INDArray.dup()``). Values are immutable so this is cheap."""
+        return _wrap(self._a)
+
+    # -- elementwise binary -------------------------------------------------
+    def _binop(self, other, name: str, fn) -> "Tensor":
+        f = _jitted(("bin", name), fn)
+        return _wrap(f(self._a, _unwrap(other)))
+
+    def add(self, other):
+        return self._binop(other, "add", jnp.add)
+
+    def sub(self, other):
+        return self._binop(other, "sub", jnp.subtract)
+
+    def mul(self, other):
+        return self._binop(other, "mul", jnp.multiply)
+
+    def div(self, other):
+        return self._binop(other, "div", jnp.divide)
+
+    def rsub(self, other):
+        return self._binop(other, "rsub", lambda a, b: jnp.subtract(b, a))
+
+    def rdiv(self, other):
+        return self._binop(other, "rdiv", lambda a, b: jnp.divide(b, a))
+
+    def pow(self, other):
+        return self._binop(other, "pow", jnp.power)
+
+    def maximum(self, other):
+        return self._binop(other, "maximum", jnp.maximum)
+
+    def minimum(self, other):
+        return self._binop(other, "minimum", jnp.minimum)
+
+    def fmod(self, other):
+        return self._binop(other, "fmod", jnp.fmod)
+
+    # in-place spellings: rebind + return self (see module docstring)
+    def addi(self, other):
+        self._a = self.add(other)._a
+        return self
+
+    def subi(self, other):
+        self._a = self.sub(other)._a
+        return self
+
+    def muli(self, other):
+        self._a = self.mul(other)._a
+        return self
+
+    def divi(self, other):
+        self._a = self.div(other)._a
+        return self
+
+    def assign(self, other):
+        """``INDArray.assign``: overwrite contents (broadcasting allowed)."""
+        src = _unwrap(other)
+        self._a = jnp.broadcast_to(jnp.asarray(src, dtype=self._a.dtype), self.shape)
+        return self
+
+    # python operators
+    __add__ = add
+    __radd__ = add
+    __sub__ = sub
+    __rsub__ = rsub
+    __mul__ = mul
+    __rmul__ = mul
+    __truediv__ = div
+    __rtruediv__ = rdiv
+    __pow__ = pow
+
+    def __neg__(self):
+        return _wrap(_jitted(("un", "neg"), jnp.negative)(self._a))
+
+    # -- comparisons --------------------------------------------------------
+    def gt(self, other):
+        return self._binop(other, "gt", jnp.greater)
+
+    def gte(self, other):
+        return self._binop(other, "gte", jnp.greater_equal)
+
+    def lt(self, other):
+        return self._binop(other, "lt", jnp.less)
+
+    def lte(self, other):
+        return self._binop(other, "lte", jnp.less_equal)
+
+    def eq(self, other):
+        return self._binop(other, "eq", jnp.equal)
+
+    def neq(self, other):
+        return self._binop(other, "neq", jnp.not_equal)
+
+    __gt__ = gt
+    __ge__ = gte
+    __lt__ = lt
+    __le__ = lte
+    # elementwise == / != (numpy semantics); hash stays identity-based
+    __eq__ = eq
+    __ne__ = neq
+    __hash__ = object.__hash__
+
+    # -- elementwise unary --------------------------------------------------
+    def _unop(self, name: str, fn) -> "Tensor":
+        return _wrap(_jitted(("un", name), fn)(self._a))
+
+    def abs(self):
+        return self._unop("abs", jnp.abs)
+
+    def exp(self):
+        return self._unop("exp", jnp.exp)
+
+    def log(self):
+        return self._unop("log", jnp.log)
+
+    def sqrt(self):
+        return self._unop("sqrt", jnp.sqrt)
+
+    def square(self):
+        return self._unop("square", jnp.square)
+
+    def sign(self):
+        return self._unop("sign", jnp.sign)
+
+    def floor(self):
+        return self._unop("floor", jnp.floor)
+
+    def ceil(self):
+        return self._unop("ceil", jnp.ceil)
+
+    def round(self):
+        return self._unop("round", jnp.round)
+
+    def sin(self):
+        return self._unop("sin", jnp.sin)
+
+    def cos(self):
+        return self._unop("cos", jnp.cos)
+
+    def tanh(self):
+        return self._unop("tanh", jnp.tanh)
+
+    def sigmoid(self):
+        return self._unop("sigmoid", jax.nn.sigmoid)
+
+    def relu(self):
+        return self._unop("relu", jax.nn.relu)
+
+    def neg(self):
+        return -self
+
+    def reciprocal(self):
+        return self._unop("reciprocal", jnp.reciprocal)
+
+    def isnan(self):
+        return self._unop("isnan", jnp.isnan)
+
+    def isinf(self):
+        return self._unop("isinf", jnp.isinf)
+
+    # -- reductions ---------------------------------------------------------
+    def _reduce(self, name, fn, dims, keepdims=False):
+        axis = _normalize_dims(dims)
+        f = _jitted(("red", name, axis, keepdims), lambda a: fn(a, axis=axis, keepdims=keepdims))
+        return _wrap(f(self._a))
+
+    def sum(self, *dims, keepdims=False):
+        return self._reduce("sum", jnp.sum, dims or None, keepdims)
+
+    def mean(self, *dims, keepdims=False):
+        return self._reduce("mean", jnp.mean, dims or None, keepdims)
+
+    def max(self, *dims, keepdims=False):
+        return self._reduce("max", jnp.max, dims or None, keepdims)
+
+    def min(self, *dims, keepdims=False):
+        return self._reduce("min", jnp.min, dims or None, keepdims)
+
+    def prod(self, *dims, keepdims=False):
+        return self._reduce("prod", jnp.prod, dims or None, keepdims)
+
+    def std(self, *dims, keepdims=False, ddof=1):
+        # DL4J std is the sample (Bessel-corrected) std by default.
+        axis = _normalize_dims(dims or None)
+        f = _jitted(("red", "std", axis, keepdims, ddof),
+                    lambda a: jnp.std(a, axis=axis, keepdims=keepdims, ddof=ddof))
+        return _wrap(f(self._a))
+
+    def var(self, *dims, keepdims=False, ddof=1):
+        axis = _normalize_dims(dims or None)
+        f = _jitted(("red", "var", axis, keepdims, ddof),
+                    lambda a: jnp.var(a, axis=axis, keepdims=keepdims, ddof=ddof))
+        return _wrap(f(self._a))
+
+    def norm1(self, *dims, keepdims=False):
+        return self._reduce("norm1", lambda a, axis, keepdims: jnp.sum(jnp.abs(a), axis=axis, keepdims=keepdims), dims or None, keepdims)
+
+    def norm2(self, *dims, keepdims=False):
+        return self._reduce(
+            "norm2",
+            lambda a, axis, keepdims: jnp.sqrt(jnp.sum(jnp.square(a), axis=axis, keepdims=keepdims)),
+            dims or None, keepdims)
+
+    def normmax(self, *dims, keepdims=False):
+        return self._reduce("normmax", lambda a, axis, keepdims: jnp.max(jnp.abs(a), axis=axis, keepdims=keepdims), dims or None, keepdims)
+
+    def argmax(self, dim=None):
+        f = _jitted(("red", "argmax", dim), lambda a: jnp.argmax(a, axis=dim))
+        return _wrap(f(self._a))
+
+    def argmin(self, dim=None):
+        f = _jitted(("red", "argmin", dim), lambda a: jnp.argmin(a, axis=dim))
+        return _wrap(f(self._a))
+
+    def cumsum(self, dim=0):
+        f = _jitted(("un", "cumsum", dim), lambda a: jnp.cumsum(a, axis=dim))
+        return _wrap(f(self._a))
+
+    # -- linalg -------------------------------------------------------------
+    def mmul(self, other) -> "Tensor":
+        """Matrix multiply (``INDArray.mmul``). Rides the MXU.
+
+        bfloat16/float32 inputs use highest-available matmul precision for
+        fp32, default (bf16 passes on MXU) otherwise — policy lives here so
+        eager math matches the compiled-model numerics.
+        """
+        from .environment import precision_for
+        prec = precision_for(self._a, _unwrap(other))
+        f = _jitted(("bin", "mmul", prec), lambda a, b: jnp.matmul(a, b, precision=prec))
+        return _wrap(f(self._a, _unwrap(other)))
+
+    __matmul__ = mmul
+
+    def dot(self, other):
+        f = _jitted(("bin", "dot"), lambda a, b: jnp.sum(a * b))  # elementwise: no precision concern
+        return _wrap(f(self._a, _unwrap(other)))
+
+    def tensordot(self, other, axes):
+        key = ("bin", "tensordot", _freeze(axes))
+        f = _jitted(key, lambda a, b: jnp.tensordot(a, b, axes=axes))
+        return _wrap(f(self._a, _unwrap(other)))
+
+    # -- shape manipulation -------------------------------------------------
+    def reshape(self, *shape) -> "Tensor":
+        if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
+            shape = tuple(shape[0])
+        return _wrap(jnp.reshape(self._a, shape))
+
+    def ravel(self) -> "Tensor":
+        return _wrap(jnp.ravel(self._a))
+
+    flatten = ravel
+
+    def transpose(self, *axes) -> "Tensor":
+        if not axes:
+            return _wrap(jnp.transpose(self._a))
+        if len(axes) == 1 and isinstance(axes[0], (tuple, list)):
+            axes = tuple(axes[0])
+        return _wrap(jnp.transpose(self._a, axes))
+
+    # DL4J name: permute
+    permute = transpose
+
+    def swapaxes(self, a, b) -> "Tensor":
+        return _wrap(jnp.swapaxes(self._a, a, b))
+
+    def expand_dims(self, axis) -> "Tensor":
+        return _wrap(jnp.expand_dims(self._a, axis))
+
+    def squeeze(self, axis=None) -> "Tensor":
+        return _wrap(jnp.squeeze(self._a, axis=axis))
+
+    def broadcast_to(self, shape) -> "Tensor":
+        return _wrap(jnp.broadcast_to(self._a, tuple(shape)))
+
+    def repeat(self, repeats, axis) -> "Tensor":
+        return _wrap(jnp.repeat(self._a, repeats, axis=axis))
+
+    def tile(self, reps) -> "Tensor":
+        return _wrap(jnp.tile(self._a, reps))
+
+    # -- indexing -----------------------------------------------------------
+    def __getitem__(self, idx) -> "Tensor":
+        if isinstance(idx, Tensor):
+            idx = idx._a
+        elif isinstance(idx, tuple):
+            idx = tuple(i._a if isinstance(i, Tensor) else i for i in idx)
+        return _wrap(self._a[idx])
+
+    def put(self, idx, value) -> "Tensor":
+        """Functional scatter-assign: returns a NEW tensor (XLA semantics).
+
+        DL4J's putScalar/put mutate; here mutation happens only through the
+        in-place spellings which rebind. ``t.puti(idx, v)`` rebinds.
+        """
+        if isinstance(idx, Tensor):
+            idx = idx._a
+        elif isinstance(idx, tuple):
+            idx = tuple(i._a if isinstance(i, Tensor) else i for i in idx)
+        return _wrap(self._a.at[idx].set(_unwrap(value)))
+
+    def puti(self, idx, value) -> "Tensor":
+        self._a = self.put(idx, value)._a
+        return self
+
+    def get_scalar(self, *idx):
+        return self._a[tuple(idx)].item()
+
+    # -- conversion helpers used across the framework -----------------------
+    def __array__(self, dtype=None):
+        a = np.asarray(self._a)
+        return a.astype(dtype) if dtype is not None else a
+
+    def __jax_array__(self):
+        return self._a
+
+    def block_until_ready(self) -> "Tensor":
+        self._a.block_until_ready()
+        return self
+
+
+def _freeze(x):
+    if isinstance(x, (list, tuple)):
+        return tuple(_freeze(i) for i in x)
+    return x
+
+
+def _normalize_dims(dims):
+    """Accept dims as None/(), varargs of ints, or a single list/tuple."""
+    if dims is None or dims == ():
+        return None
+    if len(dims) == 1 and isinstance(dims[0], (list, tuple)):
+        dims = dims[0]
+    return tuple(int(d) for d in dims)
+
+
+# --------------------------------------------------------------------------
+# Factory functions (the Nd4j.* surface)
+# --------------------------------------------------------------------------
+
+def create(data, dtype=None) -> Tensor:
+    """``Nd4j.create`` / ``Nd4j.createFromArray`` equivalent."""
+    return Tensor(data, dtype=dtype)
+
+
+def from_numpy(a: np.ndarray) -> Tensor:
+    return Tensor(jnp.asarray(a))
+
+
+def zeros(*shape, dtype=_dt.float32) -> Tensor:
+    if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
+        shape = tuple(shape[0])
+    return Tensor(jnp.zeros(shape, dtype=_dt.resolve(dtype)))
+
+
+def ones(*shape, dtype=_dt.float32) -> Tensor:
+    if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
+        shape = tuple(shape[0])
+    return Tensor(jnp.ones(shape, dtype=_dt.resolve(dtype)))
+
+
+def full(shape, value, dtype=_dt.float32) -> Tensor:
+    return Tensor(jnp.full(tuple(shape), value, dtype=_dt.resolve(dtype)))
+
+
+def zeros_like(t: Tensor) -> Tensor:
+    return Tensor(jnp.zeros_like(_unwrap(t)))
+
+
+def ones_like(t: Tensor) -> Tensor:
+    return Tensor(jnp.ones_like(_unwrap(t)))
+
+
+def arange(*args, dtype=None) -> Tensor:
+    return Tensor(jnp.arange(*args, dtype=_dt.resolve(dtype) if dtype else None))
+
+
+def linspace(start, stop, num, dtype=_dt.float32) -> Tensor:
+    return Tensor(jnp.linspace(start, stop, num, dtype=_dt.resolve(dtype)))
+
+
+def eye(n, m=None, dtype=_dt.float32) -> Tensor:
+    return Tensor(jnp.eye(n, m, dtype=_dt.resolve(dtype)))
+
+
+def rand(*shape, dtype=_dt.float32, rng: _rng.Random | None = None) -> Tensor:
+    """``Nd4j.rand``: U[0,1) from the default (or given) RNG."""
+    if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
+        shape = tuple(shape[0])
+    r = rng or _rng.get_default_rng()
+    return Tensor(r.uniform(shape, dtype=_dt.resolve(dtype)))
+
+
+def randn(*shape, dtype=_dt.float32, rng: _rng.Random | None = None) -> Tensor:
+    """``Nd4j.randn``: standard normal from the default (or given) RNG."""
+    if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
+        shape = tuple(shape[0])
+    r = rng or _rng.get_default_rng()
+    return Tensor(r.normal(shape, dtype=_dt.resolve(dtype)))
+
+
+def stack(tensors: Sequence[Tensor], axis=0) -> Tensor:
+    return Tensor(jnp.stack([_unwrap(t) for t in tensors], axis=axis))
+
+
+def concat(tensors: Sequence[Tensor], axis=0) -> Tensor:
+    """``Nd4j.concat`` equivalent."""
+    return Tensor(jnp.concatenate([_unwrap(t) for t in tensors], axis=axis))
+
+
+def where(cond, x, y) -> Tensor:
+    return Tensor(jnp.where(_unwrap(cond), _unwrap(x), _unwrap(y)))

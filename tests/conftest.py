@@ -1,0 +1,24 @@
+"""Test configuration: run everything on a virtual 8-device CPU mesh.
+
+Mirrors the reference's "fake cluster" test strategy (SURVEY.md §4: Spark
+local[*] + threads-as-GPUs) — multi-chip sharding logic is validated on N
+virtual CPU devices via ``xla_force_host_platform_device_count``; the driver
+separately dry-runs the multi-chip path, and bench.py runs on the real chip.
+
+Must set env vars BEFORE jax is imported anywhere.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(1234)

