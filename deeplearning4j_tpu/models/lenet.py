@@ -1,0 +1,49 @@
+"""LeNet zoo model.
+
+TPU-native equivalent of deeplearning4j-zoo's ``LeNet`` (reference:
+``deeplearning4j-zoo .../zoo/model/LeNet.java``† per SURVEY.md §2.5;
+reference mount was empty, citation upstream-relative, unverified).
+
+Same topology as the zoo model: conv5x5(20) -> maxpool2 -> conv5x5(50) ->
+maxpool2 -> dense(500, relu) -> softmax output. ``data_format`` defaults to
+NCHW (DL4J parity); pass "NHWC" for the TPU-preferred layout.
+"""
+
+from __future__ import annotations
+
+from ..nn.config import InputType, NeuralNetConfiguration
+from ..nn.layers.conv import ConvolutionLayer, SubsamplingLayer
+from ..nn.layers.core import DenseLayer, OutputLayer
+from ..nn.model import MultiLayerNetwork
+from ..nn.updaters import Adam
+
+
+def lenet_config(num_classes: int = 10, in_channels: int = 1, height: int = 28,
+                 width: int = 28, seed: int = 123, updater=None,
+                 data_format: str = "NCHW"):
+    return (NeuralNetConfiguration.builder()
+            .seed(seed)
+            .updater(updater or Adam(learning_rate=1e-3))
+            .l2(5e-5)
+            .input_type(InputType.convolutional(in_channels, height, width,
+                                                data_format))
+            .list(
+                ConvolutionLayer(n_out=20, kernel=(5, 5), stride=(1, 1),
+                                 padding=(2, 2), activation="relu",
+                                 weight_init="relu", data_format=data_format),
+                SubsamplingLayer(kernel=(2, 2), stride=(2, 2),
+                                 pool_type="max", data_format=data_format),
+                ConvolutionLayer(n_out=50, kernel=(5, 5), stride=(1, 1),
+                                 padding=(2, 2), activation="relu",
+                                 weight_init="relu", data_format=data_format),
+                SubsamplingLayer(kernel=(2, 2), stride=(2, 2),
+                                 pool_type="max", data_format=data_format),
+                DenseLayer(n_out=500, activation="relu", weight_init="relu"),
+                OutputLayer(n_out=num_classes, loss="mcxent",
+                            activation="softmax", weight_init="xavier"),
+            )
+            .build())
+
+
+def lenet(num_classes: int = 10, **kwargs) -> MultiLayerNetwork:
+    return MultiLayerNetwork(lenet_config(num_classes, **kwargs)).init()

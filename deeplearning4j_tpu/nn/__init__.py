@@ -1,0 +1,3 @@
+from . import config, model, schedules, updaters, weights  # noqa: F401
+from .config import InputType, MultiLayerConfiguration, NeuralNetConfiguration  # noqa: F401
+from .model import MultiLayerNetwork  # noqa: F401

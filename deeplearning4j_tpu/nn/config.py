@@ -1,0 +1,194 @@
+"""Network configuration DSL with JSON round-trip.
+
+TPU-native equivalent of DL4J's ``NeuralNetConfiguration.Builder`` →
+``MultiLayerConfiguration`` (reference: ``deeplearning4j-nn .../nn/conf/
+{NeuralNetConfiguration,MultiLayerConfiguration}.java``† per SURVEY.md §2.4;
+reference mount was empty, citations upstream-relative, unverified).
+
+JSON is the persistence contract (ModelSerializer stores it, like DL4J's
+Jackson beans). ``InputType`` mirrors DL4J's
+``InputType.convolutional/feedForward/recurrent`` and drives automatic
+Flatten insertion at conv→dense seams (DL4J's InputPreProcessor machinery).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Any, Dict, List, Optional, Tuple
+
+from . import updaters as _upd
+from .layers.base import Layer
+from .layers.core import DenseLayer, FlattenLayer, LossLayer, OutputLayer
+
+
+class InputType:
+    """DL4J InputType equivalent: shape WITHOUT batch dim."""
+
+    @staticmethod
+    def feed_forward(n: int) -> Tuple[int, ...]:
+        return (n,)
+
+    @staticmethod
+    def convolutional(channels: int, height: int, width: int,
+                      data_format: str = "NCHW") -> Tuple[int, ...]:
+        return (channels, height, width) if data_format == "NCHW" else \
+               (height, width, channels)
+
+    @staticmethod
+    def recurrent(n_features: int, timesteps: Optional[int] = None) -> Tuple[int, ...]:
+        # timesteps None -> dynamic; shape convention [T, F]
+        return (timesteps or -1, n_features)
+
+
+@dataclasses.dataclass
+class MultiLayerConfiguration:
+    """Immutable network description (the thing that serializes)."""
+    layers: List[Layer]
+    input_shape: Optional[Tuple[int, ...]] = None
+    seed: int = 1234
+    dtype: str = "FLOAT"
+    updater: Any = None                     # Updater instance
+    l1: float = 0.0                         # net-level defaults
+    l2: float = 0.0
+    gradient_clip_value: Optional[float] = None      # clip by value
+    gradient_clip_l2: Optional[float] = None         # clip by global L2 norm
+    tbptt_length: Optional[int] = None               # truncated BPTT window
+
+    def to_json(self) -> str:
+        d = {
+            "format_version": 1,
+            "seed": self.seed,
+            "dtype": self.dtype,
+            "input_shape": list(self.input_shape) if self.input_shape else None,
+            "updater": self.updater.to_dict() if self.updater else None,
+            "l1": self.l1,
+            "l2": self.l2,
+            "gradient_clip_value": self.gradient_clip_value,
+            "gradient_clip_l2": self.gradient_clip_l2,
+            "tbptt_length": self.tbptt_length,
+            "layers": [l.to_dict() for l in self.layers],
+        }
+        return json.dumps(d, indent=2)
+
+    @staticmethod
+    def from_json(s: str) -> "MultiLayerConfiguration":
+        d = json.loads(s)
+        return MultiLayerConfiguration(
+            layers=[Layer.from_dict(ld) for ld in d["layers"]],
+            input_shape=tuple(d["input_shape"]) if d.get("input_shape") else None,
+            seed=d.get("seed", 1234),
+            dtype=d.get("dtype", "FLOAT"),
+            updater=_upd.Updater.from_dict(d["updater"]) if d.get("updater") else None,
+            l1=d.get("l1", 0.0),
+            l2=d.get("l2", 0.0),
+            gradient_clip_value=d.get("gradient_clip_value"),
+            gradient_clip_l2=d.get("gradient_clip_l2"),
+            tbptt_length=d.get("tbptt_length"),
+        )
+
+
+class NeuralNetConfiguration:
+    """Builder (DL4J ``new NeuralNetConfiguration.Builder()...list()...build()``)."""
+
+    def __init__(self):
+        self._layers: List[Layer] = []
+        self._seed = 1234
+        self._dtype = "FLOAT"
+        self._updater = _upd.Sgd(learning_rate=0.1)
+        self._l1 = 0.0
+        self._l2 = 0.0
+        self._clip_value = None
+        self._clip_l2 = None
+        self._input_shape = None
+        self._tbptt = None
+
+    @staticmethod
+    def builder() -> "NeuralNetConfiguration":
+        return NeuralNetConfiguration()
+
+    def seed(self, s: int):
+        self._seed = int(s)
+        return self
+
+    def data_type(self, dtype: str):
+        self._dtype = dtype
+        return self
+
+    def updater(self, u):
+        self._updater = _upd.get(u) if isinstance(u, str) else u
+        return self
+
+    def l1(self, v: float):
+        self._l1 = v
+        return self
+
+    def l2(self, v: float):
+        self._l2 = v
+        return self
+
+    def gradient_clip_value(self, v: float):
+        self._clip_value = v
+        return self
+
+    def gradient_clip_l2(self, v: float):
+        self._clip_l2 = v
+        return self
+
+    def tbptt_length(self, n: int):
+        self._tbptt = n
+        return self
+
+    def input_type(self, shape: Tuple[int, ...]):
+        self._input_shape = tuple(shape)
+        return self
+
+    def layer(self, l: Layer):
+        self._layers.append(l)
+        return self
+
+    def layers(self, ls: List[Layer]):
+        self._layers.extend(ls)
+        return self
+
+    # DL4J spelling
+    def list(self, *ls: Layer):
+        self._layers.extend(ls)
+        return self
+
+    def build(self) -> MultiLayerConfiguration:
+        layers = _auto_flatten(self._layers, self._input_shape)
+        return MultiLayerConfiguration(
+            layers=layers, input_shape=self._input_shape, seed=self._seed,
+            dtype=self._dtype, updater=self._updater, l1=self._l1, l2=self._l2,
+            gradient_clip_value=self._clip_value, gradient_clip_l2=self._clip_l2,
+            tbptt_length=self._tbptt)
+
+
+def _auto_flatten(layers: List[Layer], input_shape) -> List[Layer]:
+    """Insert FlattenLayer at conv->dense seams (DL4J preprocessor auto-add).
+
+    Only runs when input_shape is known; relies on each layer's initialize()
+    shape propagation being cheap (no arrays are built here — we call
+    initialize with a dummy key only for shape inference on param-free
+    paths... instead we track rank heuristically: conv-family layers keep
+    rank 3, dense/output need rank 1).
+    """
+    if input_shape is None:
+        return list(layers)
+    out: List[Layer] = []
+    rank = len(input_shape)
+    for l in layers:
+        needs_flat = isinstance(l, (DenseLayer, OutputLayer)) and rank > 1
+        if needs_flat:
+            out.append(FlattenLayer())
+            rank = 1
+        out.append(l)
+        # rank transitions
+        kind = getattr(l, "kind", "")
+        if kind in ("flatten", "global_pool"):
+            rank = 1
+        elif kind in ("dense", "output", "loss", "elementwise_mult"):
+            rank = 1
+        # conv/pool/norm keep rank
+    return out

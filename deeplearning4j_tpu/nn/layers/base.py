@@ -1,0 +1,85 @@
+"""Layer base protocol and registry.
+
+TPU-native equivalent of DL4J's layer configuration + implementation split
+(reference: ``deeplearning4j-nn .../nn/conf/layers/**`` and
+``.../nn/layers/**``† per SURVEY.md §2.4; reference mount was empty,
+citations upstream-relative, unverified).
+
+Divergence from the reference (deliberate, TPU-first): DL4J separates config
+beans from stateful impl objects holding INDArray params. Here a layer IS its
+config (a frozen-ish dataclass); parameters/state live in pytrees owned by
+the Model, and ``apply`` is a pure function — so the whole network traces
+into one XLA program (SURVEY.md §3.1 "TPU translation").
+
+Protocol:
+- ``initialize(key, input_shape, dtype) -> (params, state, output_shape)``
+  input_shape EXCLUDES the batch dim (DL4J InputType convention).
+- ``apply(params, x, state, train, rng, mask) -> (y, new_state, new_mask)``
+  pure; ``state`` carries e.g. BN running stats; ``mask`` flows through like
+  DL4J's per-timestep feature masks.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Tuple
+
+LAYERS: Dict[str, type] = {}
+
+
+def layer(kind: str):
+    """Class decorator: make a dataclass layer and register for serde."""
+    def deco(cls):
+        cls = dataclasses.dataclass(cls)
+        cls.kind = kind
+        LAYERS[kind] = cls
+        return cls
+    return deco
+
+
+class Layer:
+    kind = "base"
+    name: Optional[str] = None
+
+    # -- to be implemented by subclasses ------------------------------------
+    def initialize(self, key, input_shape, dtype):
+        """-> (params: dict, state: dict, output_shape: tuple)"""
+        return {}, {}, tuple(input_shape)
+
+    def apply(self, params, x, state, *, train=False, rng=None, mask=None):
+        """-> (y, new_state, out_mask)"""
+        raise NotImplementedError
+
+    # -- shared helpers ------------------------------------------------------
+    def has_params(self) -> bool:
+        return True
+
+    def to_dict(self) -> Dict[str, Any]:
+        d = {"kind": self.kind}
+        for f in dataclasses.fields(self):
+            v = getattr(self, f.name)
+            d[f.name] = _encode(v)
+        return d
+
+    @staticmethod
+    def from_dict(d: Dict[str, Any]) -> "Layer":
+        d = dict(d)
+        kind = d.pop("kind")
+        if kind not in LAYERS:
+            raise ValueError(f"Unknown layer kind {kind!r}; known: {sorted(LAYERS)}")
+        cls = LAYERS[kind]
+        field_names = {f.name for f in dataclasses.fields(cls)}
+        kwargs = {k: _decode(v) for k, v in d.items() if k in field_names}
+        return cls(**kwargs)
+
+
+def _encode(v):
+    if isinstance(v, tuple):
+        return list(v)
+    return v
+
+
+def _decode(v):
+    if isinstance(v, list):
+        return tuple(v)
+    return v

@@ -1,0 +1,207 @@
+"""Core feed-forward layers: Dense, Activation, Dropout, Output/Loss,
+Embedding, ElementWiseMultiplication, Flatten.
+
+TPU-native equivalents of DL4J layer configs/impls (reference:
+``deeplearning4j-nn .../nn/conf/layers/{DenseLayer,OutputLayer,...}.java``†,
+impls under ``.../nn/layers/feedforward/``† per SURVEY.md §2.4; reference
+mount was empty, citations upstream-relative, unverified).
+
+Param names follow DL4J's DefaultParamInitializer: "W" (weights [in, out]),
+"b" (bias [out]) — kept verbatim so checkpoint/import name-mapping is 1:1.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ...environment import precision_for
+from ...ops import activations as _act
+from ...ops import losses as _loss
+from ...ops import nnops
+from .. import weights as _winit
+from .base import Layer, layer
+
+
+def _split(rng):
+    return jax.random.split(rng) if rng is not None else (None, None)
+
+
+@layer("dense")
+class DenseLayer(Layer):
+    """Fully connected layer (DL4J DenseLayer). W:[nIn,nOut] b:[nOut]."""
+    n_out: int = 0
+    n_in: Optional[int] = None  # inferred from input_shape when None
+    activation: str = "identity"
+    weight_init: str = "xavier"
+    bias_init: float = 0.0
+    l1: float = 0.0
+    l2: float = 0.0
+    name: Optional[str] = None
+
+    def initialize(self, key, input_shape, dtype):
+        n_in = self.n_in or int(input_shape[-1])
+        w = _winit.init(self.weight_init, key, (n_in, self.n_out), n_in,
+                        self.n_out, dtype)
+        b = jnp.full((self.n_out,), self.bias_init, dtype)
+        return {"W": w, "b": b}, {}, input_shape[:-1] + (self.n_out,)
+
+    def apply(self, params, x, state, *, train=False, rng=None, mask=None):
+        y = jnp.dot(x, params["W"], precision=precision_for(x, params["W"])) + params["b"]
+        return _act.get(self.activation)(y), state, mask
+
+
+@layer("activation")
+class ActivationLayer(Layer):
+    activation: str = "relu"
+    name: Optional[str] = None
+
+    def has_params(self):
+        return False
+
+    def apply(self, params, x, state, *, train=False, rng=None, mask=None):
+        return _act.get(self.activation)(x), state, mask
+
+
+@layer("dropout")
+class DropoutLayer(Layer):
+    """DL4J DropoutLayer. NOTE: DL4J's config value is the RETAIN probability
+    p; ours is the DROP rate (documented divergence — clearer and matches
+    every modern framework). Import frontends convert."""
+    rate: float = 0.5
+    name: Optional[str] = None
+
+    def has_params(self):
+        return False
+
+    def apply(self, params, x, state, *, train=False, rng=None, mask=None):
+        if not train or rng is None:
+            return x, state, mask
+        return nnops.dropout(x, self.rate, rng), state, mask
+
+
+@layer("flatten")
+class FlattenLayer(Layer):
+    """CnnToFeedForwardPreProcessor equivalent, exposed as an explicit layer
+    (our config builder also auto-inserts it at conv->dense seams)."""
+    name: Optional[str] = None
+
+    def has_params(self):
+        return False
+
+    def initialize(self, key, input_shape, dtype):
+        import math
+        flat = 1
+        for s in input_shape:
+            flat *= int(s)
+        return {}, {}, (flat,)
+
+    def apply(self, params, x, state, *, train=False, rng=None, mask=None):
+        return x.reshape(x.shape[0], -1), state, mask
+
+
+@layer("embedding")
+class EmbeddingLayer(Layer):
+    """DL4J EmbeddingLayer/EmbeddingSequenceLayer: int ids -> vectors."""
+    n_in: int = 0        # vocab size
+    n_out: int = 0       # embedding dim
+    weight_init: str = "xavier"
+    name: Optional[str] = None
+
+    def initialize(self, key, input_shape, dtype):
+        w = _winit.init(self.weight_init, key, (self.n_in, self.n_out),
+                        self.n_in, self.n_out, dtype)
+        return {"W": w}, {}, input_shape + (self.n_out,)
+
+    def apply(self, params, x, state, *, train=False, rng=None, mask=None):
+        y = nnops.embedding_lookup(params["W"], x)
+        if y.ndim >= 3 and y.shape[-2] == 1:
+            y = y.squeeze(-2)  # [B,1,D] column-vector ids -> [B,D]
+        return y, state, mask
+
+
+@layer("elementwise_mult")
+class ElementWiseMultiplicationLayer(Layer):
+    """DL4J ElementWiseMultiplicationLayer: y = act(x * w + b), w,b:[nIn]."""
+    activation: str = "identity"
+    weight_init: str = "ones"
+    name: Optional[str] = None
+
+    def initialize(self, key, input_shape, dtype):
+        n = int(input_shape[-1])
+        w = _winit.init(self.weight_init, key, (n,), n, n, dtype)
+        return {"W": w, "b": jnp.zeros((n,), dtype)}, {}, input_shape
+
+    def apply(self, params, x, state, *, train=False, rng=None, mask=None):
+        return _act.get(self.activation)(x * params["W"] + params["b"]), state, mask
+
+
+class _BaseOutput:
+    """Shared loss plumbing for output layers.
+
+    Fusion policy: softmax+mcxent and sigmoid+binary_xent compute the loss on
+    LOGITS via the numerically-stable fused path (what DL4J special-cases in
+    LossMCXENT's gradient); everything else applies the activation then the
+    loss on activations.
+    """
+
+    def loss_value(self, logits, labels, mask=None, weights=None):
+        act, lname = self.activation, self.loss
+        if act == "softmax" and lname in ("mcxent", "sparse_mcxent"):
+            if lname == "sparse_mcxent":
+                labels1h = jax.nn.one_hot(jnp.asarray(labels, jnp.int32),
+                                          logits.shape[-1], dtype=logits.dtype)
+            else:
+                labels1h = labels
+            return _loss.softmax_cross_entropy_with_logits(labels1h, logits, mask, weights)
+        if act == "sigmoid" and lname == "binary_xent":
+            return _loss.sigmoid_binary_xent_with_logits(labels, logits, mask, weights)
+        preds = _act.get(act)(logits)
+        return _loss.get(lname)(labels, preds, mask, weights)
+
+
+@layer("output")
+class OutputLayer(Layer, _BaseOutput):
+    """DenseLayer + loss head (DL4J OutputLayer)."""
+    n_out: int = 0
+    n_in: Optional[int] = None
+    loss: str = "mcxent"
+    activation: str = "softmax"
+    weight_init: str = "xavier"
+    bias_init: float = 0.0
+    loss_weights: Optional[Tuple[float, ...]] = None
+    l1: float = 0.0
+    l2: float = 0.0
+    name: Optional[str] = None
+
+    def initialize(self, key, input_shape, dtype):
+        n_in = self.n_in or int(input_shape[-1])
+        w = _winit.init(self.weight_init, key, (n_in, self.n_out), n_in,
+                        self.n_out, dtype)
+        return ({"W": w, "b": jnp.full((self.n_out,), self.bias_init, dtype)},
+                {}, input_shape[:-1] + (self.n_out,))
+
+    def apply(self, params, x, state, *, train=False, rng=None, mask=None):
+        logits = jnp.dot(x, params["W"], precision=precision_for(x, params["W"])) + params["b"]
+        if train:
+            return logits, state, mask  # loss consumes logits (fused path)
+        return _act.get(self.activation)(logits), state, mask
+
+
+@layer("loss")
+class LossLayer(Layer, _BaseOutput):
+    """Loss head with no params (DL4J LossLayer)."""
+    loss: str = "mse"
+    activation: str = "identity"
+    loss_weights: Optional[Tuple[float, ...]] = None
+    name: Optional[str] = None
+
+    def has_params(self):
+        return False
+
+    def apply(self, params, x, state, *, train=False, rng=None, mask=None):
+        if train:
+            return x, state, mask
+        return _act.get(self.activation)(x), state, mask

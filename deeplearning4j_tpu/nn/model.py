@@ -1,0 +1,294 @@
+"""Sequential network engine.
+
+TPU-native equivalent of DL4J's ``MultiLayerNetwork`` (reference:
+``deeplearning4j-nn .../nn/multilayer/MultiLayerNetwork.java``† per SURVEY.md
+§2.4/§3.1; reference mount was empty, citation upstream-relative, unverified).
+
+Architecture (the §3.1 "TPU translation"): DL4J's per-op
+Java→JNI→kernel round trip per layer per iteration becomes ONE jitted XLA
+program per (topology, shapes): forward + backward + updater fused, buffers
+donated. The "helper seam" (cuDNN/oneDNN) does not exist — XLA owns kernels.
+
+Param/state layout: pytree ``{"0": {"W": ..., "b": ...}, "1": {...}}`` keyed
+by layer index (stringified, stable across JSON). DL4J's flattened contiguous
+param buffer is NOT the storage format (pytree-native is the right call on
+TPU — SURVEY.md §7.3 item 5); ``params_flat()``/``set_params_flat()`` provide
+the flat VIEW for import/serialization parity, ordered layer-by-layer with
+DL4J's param-name order (W, b, gamma, beta).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import dtypes as _dt
+from ..data.dataset import DataSet, DataSetIterator, NumpyDataSetIterator
+from .config import MultiLayerConfiguration
+from .layers.core import LossLayer, OutputLayer
+
+# DL4J param-name ordering inside a layer, for the flat view
+_PARAM_ORDER = {"W": 0, "b": 1, "gamma": 2, "beta": 3}
+
+
+class MultiLayerNetwork:
+    def __init__(self, conf: MultiLayerConfiguration):
+        self.conf = conf
+        self.layers = conf.layers
+        self.params: Dict[str, Dict[str, jax.Array]] = {}
+        self.state: Dict[str, Dict[str, jax.Array]] = {}
+        self.updater_state: Any = None
+        self.iteration = 0
+        self.epoch = 0
+        self._score = float("nan")
+        self._listeners: List[Any] = []
+        self._train_step = None
+        self._output_fn = None
+        self._key = jax.random.PRNGKey(conf.seed)
+        self._out_layer = self.layers[-1] if self.layers else None
+        if not isinstance(self._out_layer, (OutputLayer, LossLayer)) and self.layers:
+            # permissive: a net without a loss head can still do output()
+            self._out_layer = None
+
+    # ------------------------------------------------------------------ init
+    def init(self) -> "MultiLayerNetwork":
+        if self.conf.input_shape is None:
+            raise ValueError("config needs input_type(...) to initialize")
+        dtype = _dt.resolve(self.conf.dtype)
+        shape = tuple(self.conf.input_shape)
+        key = jax.random.PRNGKey(self.conf.seed)
+        params, state = {}, {}
+        for i, layer in enumerate(self.layers):
+            key, sub = jax.random.split(key)
+            p, s, shape = layer.initialize(sub, shape, dtype)
+            if p:
+                params[str(i)] = p
+            if s:
+                state[str(i)] = s
+        self.params = params
+        self.state = state
+        self.updater_state = self.conf.updater.init_state(params) \
+            if self.conf.updater else {}
+        self._train_step = None
+        self._output_fn = None
+        return self
+
+    def num_params(self) -> int:
+        return sum(int(np.prod(l.shape)) for l in jax.tree.leaves(self.params))
+
+    # --------------------------------------------------------------- forward
+    def _forward(self, params, x, state, *, train, rng, mask=None):
+        """Pure layer stack walk. Returns (out, new_state)."""
+        new_state = dict(state)
+        for i, layer in enumerate(self.layers):
+            si = str(i)
+            p = params.get(si, {})
+            s = state.get(si, {})
+            if rng is not None:
+                rng, sub = jax.random.split(rng)
+            else:
+                sub = None
+            x, s_new, mask = layer.apply(p, x, s, train=train, rng=sub, mask=mask)
+            if s_new:
+                new_state[si] = s_new
+        return x, new_state, mask
+
+    def _regularization(self, params):
+        """Per-layer l1/l2 on weights (DL4J regularizes W, not b, by default)."""
+        total = 0.0
+        for i, layer in enumerate(self.layers):
+            l1 = getattr(layer, "l1", 0.0) or self.conf.l1
+            l2 = getattr(layer, "l2", 0.0) or self.conf.l2
+            if not (l1 or l2):
+                continue
+            p = params.get(str(i), {})
+            w = p.get("W")
+            if w is None:
+                continue
+            if l1:
+                total = total + l1 * jnp.sum(jnp.abs(w))
+            if l2:
+                total = total + 0.5 * l2 * jnp.sum(jnp.square(w))
+        return total
+
+    def _clip(self, grads):
+        cv, cl2 = self.conf.gradient_clip_value, self.conf.gradient_clip_l2
+        if cv:
+            grads = jax.tree.map(lambda g: jnp.clip(g, -cv, cv), grads)
+        if cl2:
+            norm = jnp.sqrt(sum(jnp.sum(jnp.square(g))
+                                for g in jax.tree.leaves(grads)))
+            scale = jnp.minimum(1.0, cl2 / (norm + 1e-12))
+            grads = jax.tree.map(lambda g: g * scale, grads)
+        return grads
+
+    # ------------------------------------------------------------- train step
+    def _build_train_step(self):
+        updater = self.conf.updater
+        out_layer = self._out_layer
+
+        def step_fn(params, opt_state, bn_state, step, key, x, y, fmask, lmask):
+            def loss_fn(p):
+                out, new_bn, out_mask = self._forward(
+                    p, x, bn_state, train=True, rng=key, mask=fmask)
+                lm = lmask if lmask is not None else out_mask
+                data_loss = out_layer.loss_value(
+                    out, y, mask=lm, weights=getattr(out_layer, "loss_weights", None))
+                return data_loss + self._regularization(p), new_bn
+
+            (loss, new_bn), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+            grads = self._clip(grads)
+            delta, new_opt = updater.apply(grads, opt_state, params, step)
+            new_params = jax.tree.map(lambda p, d: p - d, params, delta)
+            return new_params, new_opt, new_bn, loss
+
+        # donate params/opt/bn buffers: in-place update on device (workspace
+        # arenas' moral equivalent, handled by XLA)
+        return jax.jit(step_fn, donate_argnums=(0, 1, 2))
+
+    def fit(self, data, labels=None, epochs: int = 1) -> "MultiLayerNetwork":
+        """DL4J fit(): accepts DataSetIterator, DataSet, or (features, labels)."""
+        if not self.params and not self.state:
+            self.init()
+        it = _as_iterator(data, labels)
+        if self._out_layer is None:
+            raise ValueError("last layer must be an OutputLayer/LossLayer to fit()")
+        if self._train_step is None:
+            self._train_step = self._build_train_step()
+
+        for _ in range(epochs):
+            for ds in it:
+                self._key, sub = jax.random.split(self._key)
+                x = jnp.asarray(ds.features)
+                y = jnp.asarray(ds.labels)
+                fm = None if ds.features_mask is None else jnp.asarray(ds.features_mask)
+                lm = None if ds.labels_mask is None else jnp.asarray(ds.labels_mask)
+                step = jnp.asarray(self.iteration, dtype=jnp.int32)  # traced, no retrace per step
+                self.params, self.updater_state, self.state, loss = \
+                    self._train_step(self.params, self.updater_state, self.state,
+                                     step, sub, x, y, fm, lm)
+                # keep the loss on device: score() syncs lazily, so the train
+                # loop never blocks on the host (async dispatch back-to-back)
+                self._score = loss
+                self.iteration += 1
+                for cb in self._listeners:
+                    cb.iteration_done(self, self.iteration, self.epoch)
+            self.epoch += 1
+            for cb in self._listeners:
+                cb.on_epoch_end(self)
+            it = _as_iterator(data, labels)  # fresh pass
+        return self
+
+    # ------------------------------------------------------------- inference
+    def output(self, x, train: bool = False):
+        """Forward pass to output activations (DL4J ``output()``)."""
+        if self._output_fn is None:
+            self._output_fn = jax.jit(
+                lambda params, state, x: self._forward(
+                    params, x, state, train=False, rng=None)[0])
+        return np.asarray(self._output_fn(self.params, self.state, jnp.asarray(x)))
+
+    def predict(self, x) -> np.ndarray:
+        """Class indices (DL4J ``predict()``)."""
+        return np.argmax(self.output(x), axis=-1)
+
+    def score(self, ds: Optional[DataSet] = None) -> float:
+        """Loss value; with no argument, the score of the last fit batch."""
+        if ds is None:
+            if self._score is not None and not isinstance(self._score, float):
+                self._score = float(self._score)  # sync point, only on demand
+            return self._score
+        out, _, _ = self._forward(self.params, jnp.asarray(ds.features),
+                                  self.state, train=True, rng=None,
+                                  mask=None if ds.features_mask is None
+                                  else jnp.asarray(ds.features_mask))
+        loss = self._out_layer.loss_value(
+            out, jnp.asarray(ds.labels),
+            mask=None if ds.labels_mask is None else jnp.asarray(ds.labels_mask))
+        return float(loss)
+
+    def evaluate(self, data, labels=None):
+        """Classification evaluation over an iterator (DL4J ``evaluate()``)."""
+        from ..eval.evaluation import Evaluation
+        ev = Evaluation()
+        for ds in _as_iterator(data, labels):
+            out = self.output(ds.features)
+            ev.eval(ds.labels, out, mask=ds.labels_mask)
+        return ev
+
+    # -------------------------------------------------------------- listeners
+    def set_listeners(self, *listeners):
+        self._listeners = list(listeners)
+        return self
+
+    def add_listener(self, l):
+        self._listeners.append(l)
+        return self
+
+    # ---------------------------------------------------- flat-param adapter
+    def _flat_entries(self) -> List[Tuple[str, str]]:
+        out = []
+        for i in range(len(self.layers)):
+            si = str(i)
+            if si in self.params:
+                names = sorted(self.params[si],
+                               key=lambda n: _PARAM_ORDER.get(n, 99))
+                out.extend((si, n) for n in names)
+        return out
+
+    def params_flat(self) -> np.ndarray:
+        """One contiguous fp vector, DL4J layer/param ordering."""
+        parts = [np.asarray(self.params[si][n]).ravel()
+                 for si, n in self._flat_entries()]
+        return np.concatenate(parts) if parts else np.zeros((0,), np.float32)
+
+    def set_params_flat(self, vec) -> "MultiLayerNetwork":
+        vec = np.asarray(vec)
+        total = self.num_params()
+        if vec.size != total:
+            raise ValueError(f"param vector length {vec.size} != model {total}")
+        off = 0
+        new = {k: dict(v) for k, v in self.params.items()}
+        for si, n in self._flat_entries():
+            a = self.params[si][n]
+            size = int(np.prod(a.shape))
+            new[si][n] = jnp.asarray(
+                vec[off:off + size].reshape(a.shape), dtype=a.dtype)
+            off += size
+        self.params = new
+        return self
+
+    # ------------------------------------------------------------------ serde
+    def save(self, path, save_updater: bool = True, normalizer=None):
+        from ..utils.serializer import save_model
+        save_model(self, path, save_updater=save_updater, normalizer=normalizer)
+
+    @staticmethod
+    def load(path, load_updater: bool = True):
+        from ..utils.serializer import load_model
+        return load_model(path, load_updater=load_updater)
+
+
+def _as_iterator(data, labels=None) -> DataSetIterator:
+    if isinstance(data, DataSetIterator):
+        return data
+    if isinstance(data, DataSet):
+        return _SingleIterator(data)
+    if labels is not None:
+        return NumpyDataSetIterator(data, labels, batch_size=len(np.asarray(data)))
+    raise TypeError(f"cannot make a DataSetIterator from {type(data)}")
+
+
+class _SingleIterator(DataSetIterator):
+    def __init__(self, ds: DataSet):
+        self._ds = ds
+
+    def batch_size(self):
+        return self._ds.num_examples()
+
+    def __iter__(self):
+        yield self._ds

@@ -1,0 +1,165 @@
+"""Learning-rate (and value) schedules.
+
+TPU-native equivalent of nd4j's ``ISchedule`` implementations (reference:
+``nd4j-api .../linalg/schedule/``† — MapSchedule, ExponentialSchedule,
+InverseSchedule, PolySchedule, SigmoidSchedule, StepSchedule, CycleSchedule;
+per SURVEY.md §2.2 updater rows; reference mount was empty, citations
+upstream-relative, unverified).
+
+Schedules are pure functions of (iteration, epoch) returning a value, so they
+trace cleanly inside jit (iteration arrives as a traced scalar). JSON
+round-trip via to_dict/from_dict mirrors the Jackson config contract.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict
+
+import jax.numpy as jnp
+
+_SCHEDULES = {}
+
+
+def _sched(name):
+    def deco(cls):
+        cls = dataclasses.dataclass(cls)
+        cls.kind = name
+        _SCHEDULES[name] = cls
+        return cls
+    return deco
+
+
+class Schedule:
+    kind = "base"
+
+    def value_at(self, iteration, epoch=0):
+        raise NotImplementedError
+
+    def to_dict(self) -> Dict:
+        d = {"kind": self.kind}
+        d.update(dataclasses.asdict(self))
+        return d
+
+    @staticmethod
+    def from_dict(d):
+        if d is None:
+            return None
+        d = dict(d)
+        cls = _SCHEDULES[d.pop("kind")]
+        return cls(**d)
+
+
+def resolve(value):
+    """Accept a float (fixed) or a Schedule; return a Schedule."""
+    if isinstance(value, Schedule):
+        return value
+    return Fixed(float(value))
+
+
+@_sched("fixed")
+class Fixed(Schedule):
+    value: float = 1e-3
+
+    def value_at(self, iteration, epoch=0):
+        return self.value
+
+
+@_sched("exponential")
+class ExponentialSchedule(Schedule):
+    """value * gamma^iter (DL4J ExponentialSchedule)."""
+    initial_value: float = 1e-3
+    gamma: float = 0.99
+
+    def value_at(self, iteration, epoch=0):
+        return self.initial_value * self.gamma ** iteration
+
+
+@_sched("inverse")
+class InverseSchedule(Schedule):
+    """value / (1 + gamma*iter)^power (DL4J InverseSchedule)."""
+    initial_value: float = 1e-3
+    gamma: float = 0.99
+    power: float = 1.0
+
+    def value_at(self, iteration, epoch=0):
+        return self.initial_value / (1.0 + self.gamma * iteration) ** self.power
+
+
+@_sched("poly")
+class PolySchedule(Schedule):
+    """value * (1 - iter/maxIter)^power (DL4J PolySchedule)."""
+    initial_value: float = 1e-3
+    power: float = 1.0
+    max_iter: int = 10000
+
+    def value_at(self, iteration, epoch=0):
+        frac = jnp.minimum(iteration / self.max_iter, 1.0)
+        return self.initial_value * (1.0 - frac) ** self.power
+
+
+@_sched("sigmoid")
+class SigmoidSchedule(Schedule):
+    """value / (1 + exp(-gamma*(iter-stepSize))) (DL4J SigmoidSchedule)."""
+    initial_value: float = 1e-3
+    gamma: float = 0.99
+    step_size: int = 1000
+
+    def value_at(self, iteration, epoch=0):
+        return self.initial_value / (1.0 + jnp.exp(-self.gamma * (iteration - self.step_size)))
+
+
+@_sched("step")
+class StepSchedule(Schedule):
+    """value * decayRate^floor(iter/step) (DL4J StepSchedule)."""
+    initial_value: float = 1e-3
+    decay_rate: float = 0.1
+    step: float = 1000.0
+
+    def value_at(self, iteration, epoch=0):
+        return self.initial_value * self.decay_rate ** jnp.floor(iteration / self.step)
+
+
+@_sched("cosine")
+class CosineSchedule(Schedule):
+    """Cosine annealing to min_value over max_iter (TPU-era addition; DL4J's
+    CycleSchedule covers the warm-restart use case)."""
+    initial_value: float = 1e-3
+    min_value: float = 0.0
+    max_iter: int = 10000
+
+    def value_at(self, iteration, epoch=0):
+        frac = jnp.minimum(iteration / self.max_iter, 1.0)
+        return self.min_value + 0.5 * (self.initial_value - self.min_value) * (
+            1.0 + jnp.cos(jnp.pi * frac))
+
+
+@_sched("warmup_linear")
+class WarmupLinearSchedule(Schedule):
+    """Linear warmup then linear decay (the BERT fine-tune shape)."""
+    peak_value: float = 1e-4
+    warmup_iters: int = 100
+    max_iter: int = 10000
+
+    def value_at(self, iteration, epoch=0):
+        warm = self.peak_value * iteration / max(self.warmup_iters, 1)
+        decay = self.peak_value * jnp.maximum(
+            0.0, (self.max_iter - iteration) / max(self.max_iter - self.warmup_iters, 1))
+        return jnp.where(iteration < self.warmup_iters, warm, decay)
+
+
+@_sched("map")
+class MapSchedule(Schedule):
+    """Piecewise-constant by iteration breakpoints (DL4J MapSchedule).
+
+    values: {iteration: value} — value holds from that iteration onward.
+    Traced-friendly via sorted breakpoint scan.
+    """
+    values: Dict[int, float] = dataclasses.field(default_factory=dict)
+
+    def value_at(self, iteration, epoch=0):
+        items = sorted((int(k), float(v)) for k, v in self.values.items())
+        out = items[0][1]
+        for it, v in items:
+            out = jnp.where(iteration >= it, v, out)
+        return out

@@ -1,0 +1,124 @@
+"""Weight initialization.
+
+TPU-native equivalent of DL4J's ``IWeightInit``/``WeightInit`` enum family
+(reference: ``deeplearning4j-nn .../nn/weights/**``† per SURVEY.md §2.4;
+reference mount was empty, citations upstream-relative, unverified).
+
+Names mirror the DL4J ``WeightInit`` enum values used in config JSON.
+``fan_in``/``fan_out`` follow DL4J conventions: for dense [in, out] kernels
+fan_in = in; for conv OIHW kernels fan_in = I*kH*kW, fan_out = O*kH*kW.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+WEIGHT_INITS = {}
+
+
+def _wi(name):
+    def deco(fn):
+        WEIGHT_INITS[name] = fn
+        return fn
+    return deco
+
+
+@_wi("zero")
+def zero(key, shape, fan_in, fan_out, dtype=jnp.float32):
+    return jnp.zeros(shape, dtype)
+
+
+@_wi("ones")
+def ones(key, shape, fan_in, fan_out, dtype=jnp.float32):
+    return jnp.ones(shape, dtype)
+
+
+@_wi("normal")
+def normal(key, shape, fan_in, fan_out, dtype=jnp.float32):
+    # DL4J NORMAL: N(0, 1/sqrt(fanIn))
+    return jax.random.normal(key, shape, dtype) / math.sqrt(fan_in)
+
+
+@_wi("uniform")
+def uniform(key, shape, fan_in, fan_out, dtype=jnp.float32):
+    a = math.sqrt(3.0 / fan_in)
+    return jax.random.uniform(key, shape, dtype, -a, a)
+
+
+@_wi("xavier")
+def xavier(key, shape, fan_in, fan_out, dtype=jnp.float32):
+    # DL4J XAVIER: N(0, 2/(fanIn+fanOut))
+    std = math.sqrt(2.0 / (fan_in + fan_out))
+    return std * jax.random.normal(key, shape, dtype)
+
+
+@_wi("xavier_uniform")
+def xavier_uniform(key, shape, fan_in, fan_out, dtype=jnp.float32):
+    a = math.sqrt(6.0 / (fan_in + fan_out))
+    return jax.random.uniform(key, shape, dtype, -a, a)
+
+
+@_wi("xavier_fan_in")
+def xavier_fan_in(key, shape, fan_in, fan_out, dtype=jnp.float32):
+    std = math.sqrt(1.0 / fan_in)
+    return std * jax.random.normal(key, shape, dtype)
+
+
+@_wi("relu")
+def relu_init(key, shape, fan_in, fan_out, dtype=jnp.float32):
+    # DL4J RELU (He): N(0, 2/fanIn)
+    std = math.sqrt(2.0 / fan_in)
+    return std * jax.random.normal(key, shape, dtype)
+
+
+@_wi("relu_uniform")
+def relu_uniform(key, shape, fan_in, fan_out, dtype=jnp.float32):
+    a = math.sqrt(6.0 / fan_in)
+    return jax.random.uniform(key, shape, dtype, -a, a)
+
+
+@_wi("lecun_normal")
+def lecun_normal(key, shape, fan_in, fan_out, dtype=jnp.float32):
+    std = math.sqrt(1.0 / fan_in)
+    return std * jax.random.normal(key, shape, dtype)
+
+
+@_wi("lecun_uniform")
+def lecun_uniform(key, shape, fan_in, fan_out, dtype=jnp.float32):
+    a = math.sqrt(3.0 / fan_in)
+    return jax.random.uniform(key, shape, dtype, -a, a)
+
+
+@_wi("sigmoid_uniform")
+def sigmoid_uniform(key, shape, fan_in, fan_out, dtype=jnp.float32):
+    a = 4.0 * math.sqrt(6.0 / (fan_in + fan_out))
+    return jax.random.uniform(key, shape, dtype, -a, a)
+
+
+@_wi("identity")
+def identity_init(key, shape, fan_in, fan_out, dtype=jnp.float32):
+    if len(shape) == 2 and shape[0] == shape[1]:
+        return jnp.eye(shape[0], dtype=dtype)
+    raise ValueError("IDENTITY weight init requires a square 2d shape")
+
+
+@_wi("var_scaling_normal_fan_avg")
+def vs_normal_fan_avg(key, shape, fan_in, fan_out, dtype=jnp.float32):
+    std = math.sqrt(2.0 / (fan_in + fan_out))
+    return std * jax.random.normal(key, shape, dtype)
+
+
+def get(name_or_fn):
+    if callable(name_or_fn):
+        return name_or_fn
+    key = str(name_or_fn).lower()
+    if key not in WEIGHT_INITS:
+        raise ValueError(f"Unknown weight init {name_or_fn!r}; known: {sorted(WEIGHT_INITS)}")
+    return WEIGHT_INITS[key]
+
+
+def init(name, key, shape, fan_in, fan_out, dtype=jnp.float32):
+    return get(name)(key, shape, fan_in, fan_out, dtype)

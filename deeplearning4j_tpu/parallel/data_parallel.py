@@ -1,0 +1,111 @@
+"""Data-parallel training over a device mesh.
+
+TPU-native equivalent of DL4J's ``ParallelWrapper`` + Spark
+``SharedTrainingMaster`` + ``VoidParameterServer`` stack (reference:
+``deeplearning4j-parallel-wrapper .../parallelism/ParallelWrapper.java``†,
+``dl4j-spark-parameterserver``†, ``nd4j .../parameterserver/distributed/v2``†
+per SURVEY.md §2.6/§2.8/§3.4; reference mount was empty, citations
+upstream-relative, unverified).
+
+The entire reference stack (trainer threads, threshold-encoded gradient
+gossip over Aeron UDP, mesh organizer) collapses into GSPMD: the batch is
+sharded over the mesh's ``data`` axis, parameters are replicated, and XLA
+inserts the gradient AllReduce over ICI inside the ONE compiled step
+(SURVEY.md §3.4 "TPU translation"). The *contract* kept from the reference:
+same-step synchronized replicas, deterministic update application,
+listener-visible aggregated stats.
+
+Multi-host: the same compiled program runs on every host via
+``jax.distributed.initialize`` (see ``parallel/launcher.py``); this module is
+oblivious to host count — the mesh spans whatever ``jax.devices()`` reports.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..data.dataset import DataSetIterator
+from ..nn.model import MultiLayerNetwork, _as_iterator
+
+
+def make_mesh(devices: Optional[Sequence] = None, axis: str = "data") -> Mesh:
+    devs = list(devices) if devices is not None else jax.devices()
+    return Mesh(np.array(devs), (axis,))
+
+
+class ParallelWrapper:
+    """Data-parallel fit() over a mesh (name kept for reference parity).
+
+    Usage mirrors DL4J::
+
+        pw = ParallelWrapper(net)            # mesh over all devices
+        pw.fit(iterator, epochs=2)
+
+    Batches are split evenly across the mesh's data axis; the global batch
+    size must be divisible by the mesh size (DL4J's prefetch splitter had the
+    same constraint per-workersize).
+    """
+
+    def __init__(self, model: MultiLayerNetwork, mesh: Optional[Mesh] = None):
+        self.model = model
+        self.mesh = mesh or make_mesh()
+        self._step = None
+
+    def _build(self):
+        base = self.model._build_train_step()  # already jit; re-wrap with shardings
+        mesh = self.mesh
+        repl = NamedSharding(mesh, P())
+        data = NamedSharding(mesh, P("data"))
+
+        # Same pure step; GSPMD partitions the batch dim and inserts the
+        # gradient AllReduce. Donation mirrors the single-chip path.
+        def step_fn(params, opt_state, bn_state, step, key, x, y, fm, lm):
+            return base(params, opt_state, bn_state, step, key, x, y, fm, lm)
+
+        def shard_args(params, opt_state, bn_state, step, key, x, y, fm, lm):
+            put = lambda t, s: jax.device_put(t, s)
+            params = jax.tree.map(lambda a: put(a, repl), params)
+            opt_state = jax.tree.map(lambda a: put(a, repl), opt_state)
+            bn_state = jax.tree.map(lambda a: put(a, repl), bn_state)
+            x = put(x, data)
+            y = put(y, data)
+            fm = None if fm is None else put(fm, data)
+            lm = None if lm is None else put(lm, data)
+            return params, opt_state, bn_state, step, key, x, y, fm, lm
+
+        return step_fn, shard_args
+
+    def fit(self, data, epochs: int = 1) -> MultiLayerNetwork:
+        m = self.model
+        if not m.params:
+            m.init()
+        if self._step is None:
+            self._step = self._build()
+        step_fn, shard_args = self._step
+        n = self.mesh.devices.size
+        it: DataSetIterator = _as_iterator(data)
+        for _ in range(epochs):
+            for ds in it:
+                if ds.num_examples() % n:
+                    continue  # drop ragged tail (keeps shapes static)
+                m._key, sub = jax.random.split(m._key)
+                args = shard_args(
+                    m.params, m.updater_state, m.state,
+                    jnp.asarray(m.iteration, jnp.int32), sub,
+                    jnp.asarray(ds.features), jnp.asarray(ds.labels),
+                    None if ds.features_mask is None else jnp.asarray(ds.features_mask),
+                    None if ds.labels_mask is None else jnp.asarray(ds.labels_mask))
+                m.params, m.updater_state, m.state, loss = step_fn(*args)
+                m._score = loss
+                m.iteration += 1
+                for cb in m._listeners:
+                    cb.iteration_done(m, m.iteration, m.epoch)
+            m.epoch += 1
+            for cb in m._listeners:
+                cb.on_epoch_end(m)
+        return m

@@ -1,0 +1,104 @@
+"""Model save/load.
+
+TPU-native equivalent of DL4J's ``ModelSerializer`` (reference:
+``deeplearning4j .../util/ModelSerializer.java``† per SURVEY.md §2.4/§5
+"Checkpoint / resume"; reference mount was empty, citation
+upstream-relative, unverified).
+
+Format mirrors the reference's ZIP contract:
+  ``configuration.json``   — network config (our JSON round-trip)
+  ``coefficients.npz``     — params, keys "layer/name" (npz in place of the
+                             flat coefficients.bin; per-array keys make the
+                             format self-describing and partially loadable)
+  ``state.npz``            — layer state (BN running stats)
+  ``updaterState.npz``     — updater state (Adam m/v etc.) when saved
+  ``normalizer.json``      — fitted normalizer statistics when provided
+  ``meta.json``            — iteration/epoch counters (DL4J loses the
+                             iterator position — recorded gap we fix at the
+                             trainer level)
+
+Large-scale sharded checkpoints (multi-host) use the orbax-backed
+checkpointer in ``parallel/checkpoint.py``; this ZIP format is the
+single-host interchange format matching the reference's semantics.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import zipfile
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def _tree_to_npz_bytes(tree: dict) -> bytes:
+    flat = {}
+
+    def walk(prefix, node):
+        if isinstance(node, dict):
+            for k, v in node.items():
+                walk(f"{prefix}/{k}" if prefix else k, v)
+        else:
+            flat[prefix] = np.asarray(node)
+
+    walk("", tree)
+    buf = io.BytesIO()
+    np.savez(buf, **flat)
+    return buf.getvalue()
+
+
+def _npz_bytes_to_tree(data: bytes) -> dict:
+    tree: dict = {}
+    with np.load(io.BytesIO(data)) as z:
+        for key in z.files:
+            parts = key.split("/")
+            node = tree
+            for p in parts[:-1]:
+                node = node.setdefault(p, {})
+            node[parts[-1]] = jnp.asarray(z[key])
+    return tree
+
+
+def save_model(model, path: str, save_updater: bool = True, normalizer=None):
+    with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as zf:
+        zf.writestr("configuration.json", model.conf.to_json())
+        zf.writestr("coefficients.npz", _tree_to_npz_bytes(model.params))
+        zf.writestr("state.npz", _tree_to_npz_bytes(model.state))
+        if save_updater and model.updater_state:
+            zf.writestr("updaterState.npz", _tree_to_npz_bytes(model.updater_state))
+        if normalizer is not None:
+            zf.writestr("normalizer.json", json.dumps(normalizer.to_state()))
+        zf.writestr("meta.json", json.dumps(
+            {"iteration": model.iteration, "epoch": model.epoch,
+             "format": "deeplearning4j_tpu", "version": 1}))
+
+
+def load_model(path: str, load_updater: bool = True):
+    from ..nn.config import MultiLayerConfiguration
+    from ..nn.model import MultiLayerNetwork
+
+    with zipfile.ZipFile(path, "r") as zf:
+        conf = MultiLayerConfiguration.from_json(
+            zf.read("configuration.json").decode())
+        model = MultiLayerNetwork(conf)
+        model.init()  # builds structure; then overwrite arrays
+        model.params = _npz_bytes_to_tree(zf.read("coefficients.npz"))
+        model.state = _npz_bytes_to_tree(zf.read("state.npz"))
+        names = zf.namelist()
+        if load_updater and "updaterState.npz" in names:
+            model.updater_state = _npz_bytes_to_tree(zf.read("updaterState.npz"))
+        if "meta.json" in names:
+            meta = json.loads(zf.read("meta.json"))
+            model.iteration = meta.get("iteration", 0)
+            model.epoch = meta.get("epoch", 0)
+    return model
+
+
+def load_normalizer(path: str):
+    from ..data.normalizers import Normalizer
+    with zipfile.ZipFile(path, "r") as zf:
+        if "normalizer.json" not in zf.namelist():
+            return None
+        return Normalizer.from_state(json.loads(zf.read("normalizer.json")))

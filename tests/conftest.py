@@ -10,10 +10,19 @@ Must set env vars BEFORE jax is imported anywhere.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# This machine's sitecustomize.py imports jax and registers the TPU (axon)
+# plugin BEFORE conftest runs, so mutating JAX_PLATFORMS here is too late —
+# jax captured its config at import. XLA_FLAGS is still read lazily at
+# backend-client creation, so the device-count flag works; the platform
+# switch must go through jax.config.update.
+os.environ["JAX_PLATFORMS"] = "cpu"  # for any subprocesses tests spawn
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

@@ -1,0 +1,167 @@
+"""Data pipeline + evaluation + normalizer tests (DataVec/nd4j-dataset/
+evaluation equivalents, SURVEY.md §2.2/§2.3)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.data.dataset import (AsyncDataSetIterator, DataSet,
+                                             ListDataSetIterator,
+                                             NumpyDataSetIterator)
+from deeplearning4j_tpu.data.mnist import MnistDataSetIterator
+from deeplearning4j_tpu.data.normalizers import (ImagePreProcessingScaler,
+                                                 Normalizer,
+                                                 NormalizerMinMaxScaler,
+                                                 NormalizerStandardize)
+from deeplearning4j_tpu.eval.evaluation import Evaluation, RegressionEvaluation
+
+
+def test_numpy_iterator_batching(rng):
+    x = rng.normal(size=(100, 4)).astype(np.float32)
+    y = rng.normal(size=(100, 2)).astype(np.float32)
+    it = NumpyDataSetIterator(x, y, batch_size=32)
+    batches = list(it)
+    assert [b.num_examples() for b in batches] == [32, 32, 32, 4]
+    np.testing.assert_array_equal(batches[0].features, x[:32])
+    # drop_last
+    it2 = NumpyDataSetIterator(x, y, batch_size=32, drop_last=True)
+    assert [b.num_examples() for b in it2] == [32, 32, 32]
+    # reiterable
+    assert len(list(it)) == 4
+
+
+def test_shuffled_iterator_consistent_pairs(rng):
+    x = np.arange(20, dtype=np.float32).reshape(20, 1)
+    y = x * 10
+    it = NumpyDataSetIterator(x, y, batch_size=5, shuffle=True, seed=3)
+    for b in it:
+        np.testing.assert_array_equal(b.labels, b.features * 10)
+
+
+def test_async_iterator_matches_sync(rng):
+    x = rng.normal(size=(50, 3)).astype(np.float32)
+    y = rng.normal(size=(50, 1)).astype(np.float32)
+    base = NumpyDataSetIterator(x, y, batch_size=16)
+    sync = [b.features for b in base]
+    async_it = AsyncDataSetIterator(base)
+    got = [b.features for b in async_it]
+    assert len(got) == len(sync)
+    for a, b in zip(got, sync):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_async_iterator_propagates_errors():
+    class Bad(ListDataSetIterator):
+        def __iter__(self):
+            yield DataSet(np.zeros((2, 2)), np.zeros((2, 1)))
+            raise RuntimeError("ETL exploded")
+
+    with pytest.raises(RuntimeError, match="ETL exploded"):
+        list(AsyncDataSetIterator(Bad([])))
+
+
+def test_dataset_split_and_shuffle(rng):
+    ds = DataSet(rng.normal(size=(10, 3)), rng.normal(size=(10, 2)))
+    a, b = ds.split_test_and_train(7)
+    assert a.num_examples() == 7 and b.num_examples() == 3
+
+
+def test_standardize_normalizer(rng):
+    x = rng.normal(size=(200, 5)).astype(np.float32) * 4 + 7
+    n = NormalizerStandardize().fit(DataSet(x, None))
+    ds = DataSet(x.copy(), None)
+    n.transform(ds)
+    np.testing.assert_allclose(ds.features.mean(0), 0, atol=1e-3)
+    np.testing.assert_allclose(ds.features.std(0), 1, atol=1e-2)
+    back = n.revert_features(ds.features)
+    np.testing.assert_allclose(back, x, rtol=1e-3, atol=1e-3)
+    # serde
+    n2 = Normalizer.from_state(n.to_state())
+    ds2 = DataSet(x.copy(), None)
+    n2.transform(ds2)
+    np.testing.assert_allclose(ds2.features, ds.features, rtol=1e-6)
+
+
+def test_standardize_per_channel_images(rng):
+    x = rng.normal(size=(50, 3, 8, 8)).astype(np.float32)
+    x[:, 1] += 5
+    n = NormalizerStandardize().fit(DataSet(x, None))
+    assert n.mean.shape == (3,)
+    assert abs(n.mean[1] - 5) < 0.3
+
+
+def test_minmax_normalizer(rng):
+    x = rng.uniform(5, 9, size=(100, 4)).astype(np.float32)
+    n = NormalizerMinMaxScaler().fit(DataSet(x, None))
+    ds = DataSet(x.copy(), None)
+    n.transform(ds)
+    assert ds.features.min() >= 0 and ds.features.max() <= 1
+    np.testing.assert_allclose(n.revert_features(ds.features), x, rtol=1e-4)
+
+
+def test_image_scaler():
+    x = np.array([[0.0, 127.5, 255.0]], dtype=np.float32)
+    s = ImagePreProcessingScaler()
+    ds = DataSet(x.copy(), None)
+    s.fit(ds)
+    s.transform(ds)
+    np.testing.assert_allclose(ds.features, [[0, 0.5, 1.0]], rtol=1e-6)
+
+
+def test_mnist_synthetic(rng):
+    it = MnistDataSetIterator(32, train=True, num_examples=64)
+    assert it.source in ("idx", "synthetic")
+    b = next(iter(it))
+    assert b.features.shape == (32, 1, 28, 28)
+    assert b.labels.shape == (32, 10)
+    assert 0 <= b.features.min() and b.features.max() <= 1.0
+    assert (b.labels.sum(axis=1) == 1).all()
+    flat = MnistDataSetIterator(16, train=False, num_examples=16, flatten=True)
+    assert next(iter(flat)).features.shape == (16, 784)
+
+
+# -- evaluation -------------------------------------------------------------
+
+def test_evaluation_metrics():
+    ev = Evaluation()
+    labels = np.eye(3)[[0, 0, 1, 1, 2, 2]]
+    preds = np.eye(3)[[0, 1, 1, 1, 2, 0]]
+    ev.eval(labels, preds)
+    assert ev.accuracy() == pytest.approx(4 / 6)
+    assert ev.confusion[0, 1] == 1 and ev.confusion[2, 0] == 1
+    # sklearn-checked macro values for this confusion matrix
+    assert ev.recall() == pytest.approx((0.5 + 1.0 + 0.5) / 3)
+    assert ev.precision() == pytest.approx((0.5 + 2 / 3 + 1.0) / 3, rel=1e-6)
+    s = ev.stats()
+    assert "Accuracy" in s and "Confusion" in s
+
+
+def test_evaluation_incremental_batches():
+    ev = Evaluation()
+    for i in range(4):
+        labels = np.eye(2)[[0, 1]]
+        preds = np.eye(2)[[0, 1]]
+        ev.eval(labels, preds)
+    assert ev.accuracy() == 1.0
+    assert ev.confusion.sum() == 8
+
+
+def test_evaluation_with_mask():
+    ev = Evaluation()
+    labels = np.eye(2)[[0, 1, 1]]
+    preds = np.eye(2)[[0, 0, 0]]
+    ev.eval(labels, preds, mask=np.array([1, 1, 0]))
+    assert ev.confusion.sum() == 2
+    assert ev.accuracy() == 0.5
+
+
+def test_regression_evaluation(rng):
+    labels = rng.normal(size=(50, 2))
+    preds = labels + rng.normal(size=(50, 2)) * 0.1
+    re = RegressionEvaluation()
+    re.eval(labels[:25], preds[:25])
+    re.eval(labels[25:], preds[25:])
+    assert re.mse() < 0.05
+    assert re.r2() > 0.9
+    assert re.pearson() > 0.95
+    full = RegressionEvaluation().eval(labels, preds)
+    assert re.mse() == pytest.approx(full.mse(), rel=1e-9)

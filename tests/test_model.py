@@ -1,0 +1,194 @@
+"""MultiLayerNetwork end-to-end tests: config round-trip, training
+convergence, model-level gradient check, save/load, evaluation.
+
+Equivalent of DL4J's MultiLayerTest + gradient-check suites + integration
+snapshots (SURVEY.md §4). Runs on the CPU mesh (conftest) with tiny models.
+"""
+
+import os
+import tempfile
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.data.dataset import DataSet, NumpyDataSetIterator
+from deeplearning4j_tpu.nn.config import (InputType, MultiLayerConfiguration,
+                                          NeuralNetConfiguration)
+from deeplearning4j_tpu.nn.layers.conv import (BatchNormalization,
+                                               ConvolutionLayer,
+                                               SubsamplingLayer)
+from deeplearning4j_tpu.nn.layers.core import (DenseLayer, DropoutLayer,
+                                               OutputLayer)
+from deeplearning4j_tpu.nn.model import MultiLayerNetwork
+from deeplearning4j_tpu.nn.updaters import Adam, Sgd
+from deeplearning4j_tpu.optimize.listeners import (CollectScoresListener,
+                                                   ScoreIterationListener)
+from deeplearning4j_tpu.utils.gradcheck import check_gradients
+
+
+def _xor_data(n=128, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.uniform(-1, 1, size=(n, 2)).astype(np.float32)
+    y = ((x[:, 0] > 0) ^ (x[:, 1] > 0)).astype(np.int64)
+    onehot = np.eye(2, dtype=np.float32)[y]
+    return x, onehot
+
+
+def _mlp_conf(updater=None, **kw):
+    return (NeuralNetConfiguration.builder()
+            .seed(42)
+            .updater(updater or Adam(learning_rate=0.01))
+            .input_type(InputType.feed_forward(2))
+            .list(DenseLayer(n_out=16, activation="tanh"),
+                  DenseLayer(n_out=16, activation="relu"),
+                  OutputLayer(n_out=2, loss="mcxent", activation="softmax"))
+            .build())
+
+
+def test_config_json_roundtrip():
+    conf = _mlp_conf()
+    js = conf.to_json()
+    conf2 = MultiLayerConfiguration.from_json(js)
+    assert conf2.to_json() == js
+    assert len(conf2.layers) == len(conf.layers)
+    assert conf2.updater.kind == "adam"
+
+
+def test_model_init_shapes():
+    net = MultiLayerNetwork(_mlp_conf()).init()
+    assert net.params["0"]["W"].shape == (2, 16)
+    assert net.params["1"]["W"].shape == (16, 16)
+    assert net.params["2"]["W"].shape == (16, 2)
+    assert net.num_params() == 2 * 16 + 16 + 16 * 16 + 16 + 16 * 2 + 2
+
+
+def test_xor_convergence():
+    x, y = _xor_data(256)
+    net = MultiLayerNetwork(_mlp_conf()).init()
+    scores = CollectScoresListener()
+    net.set_listeners(scores)
+    it = NumpyDataSetIterator(x, y, batch_size=32, shuffle=True)
+    net.fit(it, epochs=60)
+    first_score = scores.scores[0][1]
+    # listeners got called and scores fell
+    assert len(scores.scores) == 60 * 8
+    assert net.score() < 0.2 < first_score
+    acc = net.evaluate(NumpyDataSetIterator(x, y, batch_size=64)).accuracy()
+    assert acc > 0.95, f"XOR accuracy {acc}"
+    # predict returns class ids
+    pred = net.predict(x[:10])
+    assert pred.shape == (10,) and set(pred) <= {0, 1}
+
+
+def test_model_gradients_match_fd():
+    """Whole-model gradient check (the DL4J GradientCheckUtil pattern)."""
+    x, y = _xor_data(8, seed=3)
+    net = MultiLayerNetwork(_mlp_conf()).init()
+
+    def loss_fn(params):
+        out, _, _ = net._forward(params, jnp.asarray(x), net.state,
+                                 train=True, rng=None)
+        return net._out_layer.loss_value(out, jnp.asarray(y))
+
+    ok, worst, fails = check_gradients(loss_fn, net.params, max_rel_error=1e-4)
+    assert ok, f"model grad check failed: worst={worst} {fails[:3]}"
+
+
+def test_l2_regularization_changes_loss():
+    x, y = _xor_data(16)
+    c1 = (NeuralNetConfiguration.builder().seed(1).updater(Sgd(learning_rate=0.1))
+          .l2(0.0).input_type(InputType.feed_forward(2))
+          .list(DenseLayer(n_out=4, activation="tanh"),
+                OutputLayer(n_out=2)).build())
+    c2 = (NeuralNetConfiguration.builder().seed(1).updater(Sgd(learning_rate=0.1))
+          .l2(0.1).input_type(InputType.feed_forward(2))
+          .list(DenseLayer(n_out=4, activation="tanh"),
+                OutputLayer(n_out=2)).build())
+    ds = DataSet(x, y)
+    n1 = MultiLayerNetwork(c1).init()
+    n2 = MultiLayerNetwork(c2).init()
+    n1.fit(ds, epochs=1)
+    n2.fit(ds, epochs=1)
+    # same seed, same data: scores differ only because of the l2 penalty
+    assert n2.score() > n1.score()
+
+
+def test_gradient_clipping_runs():
+    x, y = _xor_data(16)
+    conf = (NeuralNetConfiguration.builder().seed(1)
+            .updater(Sgd(learning_rate=0.5)).gradient_clip_l2(0.5)
+            .input_type(InputType.feed_forward(2))
+            .list(DenseLayer(n_out=8, activation="relu"),
+                  OutputLayer(n_out=2)).build())
+    net = MultiLayerNetwork(conf).init()
+    net.fit(DataSet(x, y), epochs=3)
+    assert np.isfinite(net.score())
+
+
+def test_small_cnn_trains():
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(64, 1, 8, 8)).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[(x.mean(axis=(1, 2, 3)) > 0).astype(int)]
+    conf = (NeuralNetConfiguration.builder().seed(7)
+            .updater(Adam(learning_rate=0.01))
+            .input_type(InputType.convolutional(1, 8, 8))
+            .list(ConvolutionLayer(n_out=4, kernel=(3, 3), padding=(1, 1),
+                                   activation="relu"),
+                  SubsamplingLayer(kernel=(2, 2)),
+                  BatchNormalization(),
+                  DenseLayer(n_out=16, activation="relu"),
+                  OutputLayer(n_out=2)).build())
+    # auto-flatten inserted before dense
+    kinds = [l.kind for l in conf.layers]
+    assert "flatten" in kinds and kinds.index("flatten") == 3
+    net = MultiLayerNetwork(conf).init()
+    net.fit(NumpyDataSetIterator(x, y, 16, shuffle=True), epochs=8)
+    acc = net.evaluate(NumpyDataSetIterator(x, y, 32)).accuracy()
+    assert acc > 0.9, f"cnn acc {acc}"
+    # BN running stats were updated
+    assert not np.allclose(np.asarray(net.state["2"]["mean"]), 0)
+
+
+def test_save_load_roundtrip(tmp_path):
+    x, y = _xor_data(64)
+    net = MultiLayerNetwork(_mlp_conf()).init()
+    net.fit(DataSet(x, y), epochs=5)
+    path = os.path.join(tmp_path, "model.zip")
+    net.save(path)
+    net2 = MultiLayerNetwork.load(path)
+    np.testing.assert_array_equal(net.output(x[:5]), net2.output(x[:5]))
+    assert net2.iteration == net.iteration
+    # updater state round-trips: continued training matches
+    np.testing.assert_allclose(
+        np.asarray(net.updater_state["m"]["0"]["W"]),
+        np.asarray(net2.updater_state["m"]["0"]["W"]), rtol=1e-6)
+    # continue training works
+    net2.fit(DataSet(x, y), epochs=1)
+
+
+def test_params_flat_roundtrip():
+    net = MultiLayerNetwork(_mlp_conf()).init()
+    flat = net.params_flat()
+    assert flat.shape == (net.num_params(),)
+    flat2 = flat * 2.0
+    net.set_params_flat(flat2)
+    np.testing.assert_allclose(net.params_flat(), flat2, rtol=1e-6)
+    with pytest.raises(ValueError, match="length"):
+        net.set_params_flat(flat[:-1])
+
+
+def test_dropout_model_deterministic_eval():
+    x, y = _xor_data(32)
+    conf = (NeuralNetConfiguration.builder().seed(5)
+            .updater(Adam(learning_rate=0.01))
+            .input_type(InputType.feed_forward(2))
+            .list(DenseLayer(n_out=32, activation="relu"),
+                  DropoutLayer(rate=0.5),
+                  OutputLayer(n_out=2)).build())
+    net = MultiLayerNetwork(conf).init()
+    net.fit(DataSet(x, y), epochs=2)
+    o1 = net.output(x)
+    o2 = net.output(x)
+    np.testing.assert_array_equal(o1, o2)  # inference has no dropout noise

