@@ -166,29 +166,53 @@ class NeuralNetConfiguration:
 
 
 def _auto_flatten(layers: List[Layer], input_shape) -> List[Layer]:
-    """Insert FlattenLayer at conv->dense seams (DL4J preprocessor auto-add).
+    """Insert FlattenLayer at CNN->dense seams (DL4J's
+    CnnToFeedForwardPreProcessor auto-add).
 
-    Only runs when input_shape is known; relies on each layer's initialize()
-    shape propagation being cheap (no arrays are built here — we call
-    initialize with a dummy key only for shape inference on param-free
-    paths... instead we track rank heuristically: conv-family layers keep
-    rank 3, dense/output need rank 1).
+    Shape is propagated with each layer's real initialize() under eval_shape
+    (not a rank heuristic). Flatten is inserted ONLY for rank-3 (CNN
+    [C,H,W]/[H,W,C]) inputs into Dense/Output; recurrent [T,F] inputs get
+    per-timestep dense application (DL4J's RnnToFeedForwardPreProcessor
+    semantics fall out of last-axis matmul).
     """
     if input_shape is None:
         return list(layers)
     out: List[Layer] = []
-    rank = len(input_shape)
+    shape: Optional[Tuple[int, ...]] = tuple(input_shape)
     for l in layers:
-        needs_flat = isinstance(l, (DenseLayer, OutputLayer)) and rank > 1
-        if needs_flat:
-            out.append(FlattenLayer())
-            rank = 1
+        if (isinstance(l, (DenseLayer, OutputLayer)) and shape is not None
+                and len(shape) == 3):
+            fl = FlattenLayer()
+            out.append(fl)
+            shape = _infer_shape(fl, shape)
         out.append(l)
-        # rank transitions
-        kind = getattr(l, "kind", "")
-        if kind in ("flatten", "global_pool"):
-            rank = 1
-        elif kind in ("dense", "output", "loss", "elementwise_mult"):
-            rank = 1
-        # conv/pool/norm keep rank
+        shape = _infer_shape(l, shape) if shape is not None else None
     return out
+
+
+def _infer_shape(layer: Layer, input_shape, dtype="FLOAT"):
+    """Output shape of `layer` on `input_shape`, via the layer's own
+    initialize() run under jax.eval_shape (no arrays are allocated — the
+    RNG/weight-init calls trace abstractly; the output shape is plain Python
+    ints computed from the static input shape, captured by closure).
+
+    Returns None when inference is impossible (dynamic -1 dims, e.g.
+    recurrent inputs with unknown timesteps).
+    """
+    if input_shape is None or any(int(s) < 0 for s in input_shape):
+        return None
+    import jax
+
+    from .. import dtypes as _dt
+    captured = {}
+
+    def run(key):
+        p, s, o = layer.initialize(key, tuple(input_shape), _dt.resolve(dtype))
+        captured["out"] = o
+        return p, s
+
+    # failures propagate: a layer whose initialize() breaks on a known-static
+    # shape is a config error that must surface at build(), not silently
+    # disable downstream Flatten insertion
+    jax.eval_shape(run, jax.random.PRNGKey(0))
+    return tuple(captured["out"])

@@ -28,6 +28,13 @@ def _pair(v):
     return (v, v) if isinstance(v, int) else tuple(v)
 
 
+def _safe_root(s, p):
+    """s ** (1/p) with a finite gradient at s == 0 (d s^(1/p)/ds -> inf there;
+    0-cotangent * inf = NaN would poison shared weight grads — double-where)."""
+    pos = s > 0
+    return jnp.where(pos, jnp.where(pos, s, 1.0) ** (1.0 / p), 0.0)
+
+
 def _conv_out(size, k, s, p, mode):
     if mode == "same":
         return -(-size // s)  # ceil
@@ -192,9 +199,11 @@ class LocalResponseNormalization(Layer):
 @layer("global_pool")
 class GlobalPoolingLayer(Layer):
     """DL4J GlobalPoolingLayer: collapse spatial/time dims; mask-aware for
-    time series (masked timesteps excluded, as in DL4J)."""
+    time series (masked timesteps excluded, as in DL4J). ``pnorm`` is the
+    p exponent for pool_type="pnorm"."""
     pool_type: str = "max"
     data_format: str = "NCHW"
+    pnorm: float = 2.0
     name: Optional[str] = None
 
     def has_params(self):
@@ -216,19 +225,24 @@ class GlobalPoolingLayer(Layer):
             elif self.pool_type == "max":
                 neg = jnp.finfo(x.dtype).min
                 y = jnp.max(jnp.where(m > 0, x, neg), axis=1)
+            elif self.pool_type == "pnorm":
+                y = _safe_root(jnp.sum((jnp.abs(x) * m) ** self.pnorm, axis=1),
+                               self.pnorm)
             else:
                 y = jnp.sum(x * m, axis=1)
             return y, state, None
         if x.ndim == 3:
-            axes = (1,)
             if self.pool_type == "avg":
-                y = jnp.mean(x, axis=axes)
+                y = jnp.mean(x, axis=1)
             elif self.pool_type == "max":
-                y = jnp.max(x, axis=axes)
+                y = jnp.max(x, axis=1)
+            elif self.pool_type == "pnorm":
+                y = _safe_root(jnp.sum(jnp.abs(x) ** self.pnorm, axis=1),
+                               self.pnorm)
             else:
-                y = jnp.sum(x, axis=axes)
+                y = jnp.sum(x, axis=1)
             return y, state, None
-        y = nnops.global_pool(x, self.pool_type, self.data_format)
+        y = nnops.global_pool(x, self.pool_type, self.data_format, p=self.pnorm)
         return y, state, None
 
 

@@ -197,7 +197,9 @@ class MultiLayerNetwork:
         return np.argmax(self.output(x), axis=-1)
 
     def score(self, ds: Optional[DataSet] = None) -> float:
-        """Loss value; with no argument, the score of the last fit batch."""
+        """Loss value; with no argument, the score of the last fit batch.
+        Includes the l1/l2 regularization penalty, matching the fit-loop
+        score (DL4J computeScore includes regularization on both paths)."""
         if ds is None:
             if self._score is not None and not isinstance(self._score, float):
                 self._score = float(self._score)  # sync point, only on demand
@@ -209,7 +211,7 @@ class MultiLayerNetwork:
         loss = self._out_layer.loss_value(
             out, jnp.asarray(ds.labels),
             mask=None if ds.labels_mask is None else jnp.asarray(ds.labels_mask))
-        return float(loss)
+        return float(loss + self._regularization(self.params))
 
     def evaluate(self, data, labels=None):
         """Classification evaluation over an iterator (DL4J ``evaluate()``)."""

@@ -46,7 +46,11 @@ def _per_example(value, mask):
     """
     if mask is not None:
         mask = jnp.broadcast_to(jnp.asarray(mask, dtype=value.dtype), value.shape)
-        return jnp.sum(value * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+        # where, not multiply: a non-finite loss on a fully-masked example
+        # (e.g. a zero-padded DP tail row overflowing an activation) must not
+        # leak NaN into the sum (NaN * 0 = NaN) or the gradient
+        masked = jnp.where(mask > 0, value * mask, 0.0)
+        return jnp.sum(masked) / jnp.maximum(jnp.sum(mask), 1.0)
     return jnp.mean(value)
 
 
@@ -117,25 +121,28 @@ LOSSES["sigmoid_bce_logits"] = sigmoid_binary_xent_with_logits
 
 @_loss("mse")
 def mse(labels, predictions, mask=None, weights=None):
+    """DL4J LossMSE = LossL2 / nOut (mean, not sum, over the output dim)."""
     elem = jnp.square(predictions - labels)
-    return _per_example(_sum_outputs(elem, weights), mask)
+    return _per_example(_sum_outputs(elem, weights) / elem.shape[-1], mask)
 
 
 @_loss("l2")
 def l2(labels, predictions, mask=None, weights=None):
-    # DL4J LossL2 = sum of squared errors (MSE without the 1/n over outputs).
+    # DL4J LossL2 = SUM of squared errors over the output dim (no 1/n).
     elem = jnp.square(predictions - labels)
     return _per_example(_sum_outputs(elem, weights), mask)
 
 
 @_loss("mae")
 def mae(labels, predictions, mask=None, weights=None):
+    """DL4J LossMAE = LossL1 / nOut (mean, not sum, over the output dim)."""
     elem = jnp.abs(predictions - labels)
-    return _per_example(_sum_outputs(elem, weights), mask)
+    return _per_example(_sum_outputs(elem, weights) / elem.shape[-1], mask)
 
 
 @_loss("l1")
 def l1(labels, predictions, mask=None, weights=None):
+    # DL4J LossL1 = SUM of absolute errors over the output dim (no 1/n).
     elem = jnp.abs(predictions - labels)
     return _per_example(_sum_outputs(elem, weights), mask)
 

@@ -32,6 +32,13 @@ from . import register
 from ..environment import precision_for
 
 
+def _safe_root(s, p):
+    """s ** (1/p) with a finite gradient at s == 0 (the derivative is inf
+    there; 0-cotangent * inf = NaN would poison shared grads — double-where)."""
+    pos = s > 0
+    return jnp.where(pos, jnp.where(pos, s, 1.0) ** (1.0 / p), 0.0)
+
+
 def _pair(v) -> Tuple[int, int]:
     if isinstance(v, (tuple, list)):
         return (int(v[0]), int(v[1]))
@@ -155,7 +162,7 @@ def _pool(x, kind, kernel, stride, padding, mode, data_format, pnorm_p=2.0):
             y = s / (kh * kw)
     elif kind == "pnorm":
         s = lax.reduce_window(jnp.abs(x) ** pnorm_p, 0.0, lax.add, window, strides, pad)
-        y = s ** (1.0 / pnorm_p)
+        y = _safe_root(s, pnorm_p)
     else:
         raise ValueError(kind)
     return y
@@ -179,8 +186,9 @@ def pnorm_pool2d(x, kernel, stride=None, padding=0, mode="truncate",
 
 
 @register("global_pool", category="cnn")
-def global_pool(x, pool_type="max", data_format="NCHW", keepdims=False):
-    """GlobalPoolingLayer: pool over all spatial (or time) dims."""
+def global_pool(x, pool_type="max", data_format="NCHW", keepdims=False, p=2.0):
+    """GlobalPoolingLayer: pool over all spatial (or time) dims.
+    ``p`` is the pnorm exponent (DL4J GlobalPoolingLayer.pnorm)."""
     axes = (2, 3) if (data_format == "NCHW" and x.ndim == 4) else \
            (1, 2) if x.ndim == 4 else (2,) if data_format == "NCHW" else (1,)
     if pool_type == "max":
@@ -190,7 +198,7 @@ def global_pool(x, pool_type="max", data_format="NCHW", keepdims=False):
     if pool_type == "sum":
         return jnp.sum(x, axis=axes, keepdims=keepdims)
     if pool_type == "pnorm":
-        return jnp.sum(jnp.abs(x) ** 2.0, axis=axes, keepdims=keepdims) ** 0.5
+        return _safe_root(jnp.sum(jnp.abs(x) ** p, axis=axes, keepdims=keepdims), p)
     raise ValueError(pool_type)
 
 

@@ -46,9 +46,12 @@ class ParallelWrapper:
         pw = ParallelWrapper(net)            # mesh over all devices
         pw.fit(iterator, epochs=2)
 
-    Batches are split evenly across the mesh's data axis; the global batch
-    size must be divisible by the mesh size (DL4J's prefetch splitter had the
-    same constraint per-workersize).
+    Batches whose size is not divisible by the mesh size are padded to the
+    next multiple and the padded examples are masked out of the loss (DL4J's
+    prefetch splitter silently constrained batch%workers; pad-and-mask keeps
+    every example contributing exactly once). Caveat recorded: in train mode
+    BatchNorm batch statistics see the zero-padded rows of the tail batch —
+    a bounded, tail-only artifact; the loss and gradients exclude them.
     """
 
     def __init__(self, model: MultiLayerNetwork, mesh: Optional[Mesh] = None):
@@ -91,15 +94,20 @@ class ParallelWrapper:
         it: DataSetIterator = _as_iterator(data)
         for _ in range(epochs):
             for ds in it:
-                if ds.num_examples() % n:
-                    continue  # drop ragged tail (keeps shapes static)
+                x = np.asarray(ds.features)
+                y = np.asarray(ds.labels)
+                fm = None if ds.features_mask is None else np.asarray(ds.features_mask)
+                lm = None if ds.labels_mask is None else np.asarray(ds.labels_mask)
+                rem = x.shape[0] % n
+                if rem:
+                    x, y, fm, lm = _pad_and_mask(x, y, fm, lm, n - rem)
                 m._key, sub = jax.random.split(m._key)
                 args = shard_args(
                     m.params, m.updater_state, m.state,
                     jnp.asarray(m.iteration, jnp.int32), sub,
-                    jnp.asarray(ds.features), jnp.asarray(ds.labels),
-                    None if ds.features_mask is None else jnp.asarray(ds.features_mask),
-                    None if ds.labels_mask is None else jnp.asarray(ds.labels_mask))
+                    jnp.asarray(x), jnp.asarray(y),
+                    None if fm is None else jnp.asarray(fm),
+                    None if lm is None else jnp.asarray(lm))
                 m.params, m.updater_state, m.state, loss = step_fn(*args)
                 m._score = loss
                 m.iteration += 1
@@ -109,3 +117,30 @@ class ParallelWrapper:
             for cb in m._listeners:
                 cb.on_epoch_end(m)
         return m
+
+
+def _pad_and_mask(x, y, fm, lm, pad):
+    """Zero-pad `pad` examples onto the batch and mask them out of the loss.
+
+    The label mask is the loss-weighting channel (losses average over the
+    unmasked count, see ops/losses._per_example), so padded rows contribute
+    zero loss and zero gradient.
+    """
+    def zpad(a):
+        widths = [(0, pad)] + [(0, 0)] * (a.ndim - 1)
+        return np.pad(a, widths)
+
+    x, y = zpad(x), zpad(y)
+    if fm is not None:
+        fm = zpad(fm)  # padded rows have all-zero feature mask
+    if lm is not None:
+        lm = zpad(lm)  # padded rows masked (zeros)
+    elif fm is None:
+        # no masks anywhere: synthesize one matching the per-example loss
+        # shape (labels' leading dims — [B] dense, [B,T] per-timestep)
+        lm = np.ones(y.shape[:-1] or (y.shape[0],), dtype=np.float32)
+        lm[-pad:] = 0.0
+    # else (fm set, lm absent): the network-propagated out_mask derived from
+    # the zero-padded feature mask already excludes padded rows AND masked
+    # timesteps of real sequences — synthesizing lm here would override it
+    return x, y, fm, lm

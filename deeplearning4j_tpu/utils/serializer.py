@@ -33,31 +33,56 @@ import jax.numpy as jnp
 import numpy as np
 
 
+# ml_dtypes extension dtypes are stored as same-width unsigned-int views
+# (np.savez writes them as raw void dtypes that cannot be loaded back);
+# the true dtype rides in a '__dtypes__' JSON entry inside the npz.
+_CARRIER = {"bfloat16": np.uint16,
+            "float8_e4m3fn": np.uint8, "float8_e5m2": np.uint8,
+            "float8_e4m3b11fnuz": np.uint8}
+
+
 def _tree_to_npz_bytes(tree: dict) -> bytes:
-    flat = {}
+    flat, true_dtypes = {}, {}
 
     def walk(prefix, node):
         if isinstance(node, dict):
             for k, v in node.items():
                 walk(f"{prefix}/{k}" if prefix else k, v)
         else:
-            flat[prefix] = np.asarray(node)
+            a = np.asarray(node)
+            if a.dtype.name in _CARRIER:
+                true_dtypes[prefix] = a.dtype.name
+                a = a.view(_CARRIER[a.dtype.name])
+            flat[prefix] = a
 
     walk("", tree)
+    if true_dtypes:
+        flat["__dtypes__"] = np.frombuffer(
+            json.dumps(true_dtypes).encode(), dtype=np.uint8)
     buf = io.BytesIO()
     np.savez(buf, **flat)
     return buf.getvalue()
 
 
 def _npz_bytes_to_tree(data: bytes) -> dict:
+    import ml_dtypes
+
     tree: dict = {}
     with np.load(io.BytesIO(data)) as z:
+        true_dtypes = {}
+        if "__dtypes__" in z.files:
+            true_dtypes = json.loads(z["__dtypes__"].tobytes().decode())
         for key in z.files:
+            if key == "__dtypes__":
+                continue
+            a = z[key]
+            if key in true_dtypes:
+                a = a.view(np.dtype(getattr(ml_dtypes, true_dtypes[key])))
             parts = key.split("/")
             node = tree
             for p in parts[:-1]:
                 node = node.setdefault(p, {})
-            node[parts[-1]] = jnp.asarray(z[key])
+            node[parts[-1]] = jnp.asarray(a)
     return tree
 
 

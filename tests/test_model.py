@@ -168,6 +168,25 @@ def test_save_load_roundtrip(tmp_path):
     net2.fit(DataSet(x, y), epochs=1)
 
 
+def test_bfloat16_save_load_roundtrip(tmp_path):
+    """ADVICE r1: bf16 params must survive the ZIP (np.savez can't store
+    ml_dtypes natively — serializer views them as uint16 + dtype sidecar)."""
+    conf = (NeuralNetConfiguration.builder().seed(7)
+            .data_type("BFLOAT16")
+            .updater(Adam(learning_rate=0.01))
+            .input_type(InputType.feed_forward(2))
+            .list(DenseLayer(n_out=8, activation="relu"),
+                  OutputLayer(n_out=2)).build())
+    net = MultiLayerNetwork(conf).init()
+    assert str(net.params["0"]["W"].dtype) == "bfloat16"
+    path = os.path.join(tmp_path, "bf16.zip")
+    net.save(path)
+    net2 = MultiLayerNetwork.load(path)
+    assert str(net2.params["0"]["W"].dtype) == "bfloat16"
+    x = np.random.default_rng(0).normal(size=(4, 2)).astype(np.float32)
+    np.testing.assert_array_equal(net.output(x), net2.output(x))
+
+
 def test_params_flat_roundtrip():
     net = MultiLayerNetwork(_mlp_conf()).init()
     flat = net.params_flat()

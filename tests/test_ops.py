@@ -128,11 +128,18 @@ def test_binary_xent_and_logits_fused(rng):
 
 
 def test_mse_mae_oracle(rng):
+    """DL4J LossMSE/LossMAE = LossL2/LossL1 divided by nOut (mean over the
+    output dim); for MSE this coincides with torch F.mse_loss's all-element
+    mean."""
     a = rng.normal(size=(4, 3))
     b = rng.normal(size=(4, 3))
     np.testing.assert_allclose(float(losses.mse(jnp.asarray(a), jnp.asarray(b))),
-                               (np.square(a - b)).sum(-1).mean(), rtol=1e-4, atol=1e-6)
+                               (np.square(a - b)).mean(-1).mean(), rtol=1e-4, atol=1e-6)
     np.testing.assert_allclose(float(losses.mae(jnp.asarray(a), jnp.asarray(b))),
+                               (np.abs(a - b)).mean(-1).mean(), rtol=1e-4, atol=1e-6)
+    np.testing.assert_allclose(float(losses.l2(jnp.asarray(a), jnp.asarray(b))),
+                               (np.square(a - b)).sum(-1).mean(), rtol=1e-4, atol=1e-6)
+    np.testing.assert_allclose(float(losses.l1(jnp.asarray(a), jnp.asarray(b))),
                                (np.abs(a - b)).sum(-1).mean(), rtol=1e-4, atol=1e-6)
     _mark("loss.mse", "loss.mae", "loss.l1", "loss.l2")
 
@@ -142,7 +149,7 @@ def test_loss_masking(rng):
     pred = _probs(rng, (4, 3))
     mask = np.array([1.0, 1.0, 0.0, 0.0])
     got = float(losses.mse(jnp.asarray(lab), jnp.asarray(pred), mask=jnp.asarray(mask)))
-    want = np.square(lab[:2] - pred[:2]).sum(-1).mean()
+    want = np.square(lab[:2] - pred[:2]).mean(-1).mean()
     np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-6)
 
 

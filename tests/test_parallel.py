@@ -59,12 +59,21 @@ def test_dp_convergence():
     assert acc > 0.9
 
 
-def test_dp_drops_ragged_tail():
+def test_dp_pads_ragged_tail():
+    """A batch not divisible by the mesh size is padded and masked — it must
+    train (no silent skip) and produce the SAME update as the single-device
+    fit on the same 37 real examples (padded rows carry zero loss weight)."""
     x, y = _data(37)  # 37 not divisible by 8
-    net = MultiLayerNetwork(_conf()).init()
-    pw = ParallelWrapper(net)
-    pw.fit(NumpyDataSetIterator(x, y, batch_size=37), epochs=1)
-    assert net.iteration == 0  # batch skipped, no crash
+
+    net1 = MultiLayerNetwork(_conf()).init()
+    net1.fit(DataSet(x, y), epochs=1)
+
+    net2 = MultiLayerNetwork(_conf()).init()
+    ParallelWrapper(net2).fit(NumpyDataSetIterator(x, y, batch_size=37), epochs=1)
+
+    assert net2.iteration == 1  # trained, not skipped
+    np.testing.assert_allclose(net1.params_flat(), net2.params_flat(),
+                               rtol=1e-4, atol=1e-5)
 
 
 def test_dp_params_replicated_after_step():
