@@ -1,11 +1,14 @@
 """Benchmark: prints ONE JSON line for the driver.
 
-Round-1 metric: LeNet-MNIST training throughput (examples/sec) on the real
-chip — the M1 milestone model. Later rounds switch to the ResNet-50 MFU
-headline once M2 lands. ``vs_baseline`` is vs the reference's published
-number; none exists (BASELINE.md: "unavailable"), so 1.0 is reported when the
-run succeeds (parity-by-default against an absent number, recorded honestly
-in the metric name).
+Headline (round 2+): ResNet-50 ComputationGraph training on the real chip,
+reported as **MFU** (the BASELINE.md north-star metric: ≥35% on v5e) plus
+examples/sec and step time. Data is synthetic (zero-egress environment), so
+no accuracy is claimable here — ``accuracy`` is null with a reason;
+LeNet-MNIST convergence is asserted in tests/ (test_model.py, test_mnist_e2e).
+
+``vs_baseline`` is null: the reference publishes no number to compare against
+(BASELINE.md §"reference value: unavailable"); reporting 1.0 against an
+absent number would be dishonest (VERDICT r1 weak #2).
 """
 
 import json
@@ -17,33 +20,62 @@ import numpy as np
 def main():
     import jax
 
-    from deeplearning4j_tpu.data.mnist import MnistDataSetIterator
-    from deeplearning4j_tpu.models.lenet import lenet
+    from deeplearning4j_tpu.data.dataset import DataSet
+    from deeplearning4j_tpu.models.resnet import (estimate_flops_per_example,
+                                                  resnet50)
+    from deeplearning4j_tpu.nn.updaters import Sgd
+    from deeplearning4j_tpu.optimize.listeners import _detect_peak_flops
 
-    batch = 512
-    net = lenet()
-    it = MnistDataSetIterator(batch, train=True, num_examples=8192)
+    rng = np.random.default_rng(0)
+    y_all = np.eye(1000, dtype=np.float32)
 
-    # warmup: compile + first steps
-    net.fit(it, epochs=1)
-    jax.block_until_ready(net.params)
+    def run(batch):
+        net = resnet50(updater=Sgd(learning_rate=0.1)).init()
+        x = rng.normal(size=(batch, 224, 224, 3)).astype(np.float32)
+        y = y_all[rng.integers(0, 1000, batch)]
+        ds = DataSet(x, y)
+        net.fit(ds, epochs=1)  # compile + first step
+        jax.block_until_ready(net.params)
+        steps = 20
+        t0 = time.perf_counter()
+        net.fit(ds, epochs=steps)
+        jax.block_until_ready(net.params)
+        dt = time.perf_counter() - t0
+        return net, dt / steps
 
-    # timed epochs
-    t0 = time.perf_counter()
-    epochs = 3
-    net.fit(it, epochs=epochs)
-    jax.block_until_ready(net.params)
-    dt = time.perf_counter() - t0
+    batch = 128
+    while True:
+        try:
+            net, step_time = run(batch)
+            break
+        except Exception as e:  # OOM on small chips: halve and retry
+            if batch <= 16 or "RESOURCE_EXHAUSTED" not in str(e).upper():
+                raise
+            batch //= 2
 
-    steps_per_epoch = 8192 // batch
-    examples = epochs * steps_per_epoch * batch
-    eps = examples / dt
+    eps = batch / step_time
+    fwd_flops = estimate_flops_per_example(net)
+    peak = _detect_peak_flops()
+    # 3x fwd approximates fwd+bwd (PerformanceListener convention)
+    mfu = (3 * fwd_flops * eps / peak) if peak else None
 
     print(json.dumps({
-        "metric": "lenet_mnist_train_examples_per_sec",
-        "value": round(eps, 1),
-        "unit": "examples/sec",
-        "vs_baseline": 1.0,
+        "metric": "resnet50_train_mfu_pct",
+        "value": round(mfu * 100, 2) if mfu is not None else None,
+        "unit": "%",
+        "vs_baseline": None,
+        "vs_baseline_reason": "reference publishes no benchmark numbers "
+                              "(BASELINE.md: unavailable)",
+        "model": "ResNet-50 ComputationGraph, NHWC, 224x224, synthetic data",
+        "batch": batch,
+        "examples_per_sec": round(eps, 1),
+        "step_time_ms": round(step_time * 1e3, 2),
+        "fwd_gflops_per_example": round(fwd_flops / 1e9, 2),
+        "peak_tflops_bf16": round(peak / 1e12, 1) if peak else None,
+        "params": net.num_params(),
+        "accuracy": None,
+        "accuracy_reason": "synthetic data (zero-egress); LeNet-MNIST "
+                           "accuracy asserted in tests/test_model.py",
     }))
 
 

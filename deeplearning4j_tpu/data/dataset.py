@@ -54,6 +54,81 @@ class DataSet:
         return self
 
 
+class MultiDataSet:
+    """Multi-input/multi-output minibatch (nd4j ``MultiDataSet``†) — the
+    ComputationGraph feeding format. Every field is a LIST of arrays (or
+    None-per-slot for masks), one per network input/output."""
+
+    def __init__(self, features, labels, features_masks=None, labels_masks=None):
+        self.features = [np.asarray(f) for f in _as_list(features)]
+        self.labels = ([np.asarray(l) for l in _as_list(labels)]
+                       if labels is not None else [])
+        self.features_masks = _mask_list(features_masks, len(self.features))
+        self.labels_masks = _mask_list(labels_masks, len(self.labels))
+
+    def num_examples(self) -> int:
+        return int(self.features[0].shape[0])
+
+    @staticmethod
+    def from_dataset(ds: "DataSet") -> "MultiDataSet":
+        has_labels = ds.labels is not None
+        return MultiDataSet([ds.features],
+                            [ds.labels] if has_labels else None,
+                            [ds.features_mask],
+                            [ds.labels_mask] if has_labels else None)
+
+
+def _as_list(x):
+    if isinstance(x, (list, tuple)):
+        return list(x)
+    return [x]
+
+
+def _mask_list(masks, n):
+    if masks is None:
+        return [None] * n
+    out = [None if m is None else np.asarray(m) for m in _as_list(masks)]
+    if len(out) != n:
+        raise ValueError(f"expected {n} masks, got {len(out)}")
+    return out
+
+
+class MultiDataSetIterator:
+    """Iterator protocol over MultiDataSet minibatches (DL4J
+    ``MultiDataSetIterator``†)."""
+
+    def __iter__(self) -> Iterator[MultiDataSet]:
+        raise NotImplementedError
+
+    def reset(self):
+        pass
+
+    def batch_size(self) -> int:
+        raise NotImplementedError
+
+
+class NumpyMultiDataSetIterator(MultiDataSetIterator):
+    """Mini-batches over in-memory multi-input/-output arrays."""
+
+    def __init__(self, features, labels, batch_size: int, shuffle: bool = False,
+                 seed: int = 123):
+        self._f = [np.asarray(a) for a in _as_list(features)]
+        self._l = [np.asarray(a) for a in _as_list(labels)]
+        self._bs = batch_size
+        self._shuffle = shuffle
+        self._rng = np.random.default_rng(seed)
+
+    def batch_size(self) -> int:
+        return self._bs
+
+    def __iter__(self):
+        n = self._f[0].shape[0]
+        idx = self._rng.permutation(n) if self._shuffle else np.arange(n)
+        for i in range(0, n, self._bs):
+            j = idx[i:i + self._bs]
+            yield MultiDataSet([a[j] for a in self._f], [a[j] for a in self._l])
+
+
 class DataSetIterator:
     """Iterator protocol (DL4J DataSetIterator): iterable of DataSet
     minibatches with reset semantics."""

@@ -1,0 +1,168 @@
+"""ResNet family as ComputationGraph configs.
+
+TPU-native equivalent of DL4J's zoo ResNet50 (reference:
+``deeplearning4j-zoo .../zoo/model/ResNet50.java``† per SURVEY.md §2.5;
+reference mount was empty, citation upstream-relative, unverified).
+
+Divergences (deliberate, TPU-first):
+- NHWC data format (MXU-friendly layout; DL4J zoo is NCHW). Weights stay
+  OIHW on disk (import parity — see layers/conv.py).
+- Besides the zoo's ResNet50, the standard depths (18/34/101/152) are
+  exposed through the same block builder since they are config-only.
+- He/ReLU weight init, BN decay 0.9 — matching the zoo hyperparameters.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+from ..nn.config import InputType, NeuralNetConfiguration
+from ..nn.graph import ComputationGraph, GraphBuilder
+from ..nn.layers.conv import (BatchNormalization, ConvolutionLayer,
+                              GlobalPoolingLayer, SubsamplingLayer,
+                              ZeroPadding2D)
+from ..nn.layers.core import ActivationLayer, OutputLayer
+from ..nn.updaters import Adam
+from ..nn.vertices import ElementWiseVertex
+
+# (block counts, bottleneck?) per standard depth
+_SPECS = {
+    18: ((2, 2, 2, 2), False),
+    34: ((3, 4, 6, 3), False),
+    50: ((3, 4, 6, 3), True),
+    101: ((3, 4, 23, 3), True),
+    152: ((3, 8, 36, 3), True),
+}
+_STAGE_CHANNELS = (64, 128, 256, 512)
+
+
+def _conv_bn(g: GraphBuilder, name: str, inp: str, n_out: int, kernel, stride,
+             padding=(0, 0), act: str = "identity") -> str:
+    g.add_layer(f"{name}_conv",
+                ConvolutionLayer(n_out=n_out, kernel=kernel, stride=stride,
+                                 padding=padding, activation="identity",
+                                 weight_init="relu", has_bias=False,
+                                 data_format="NHWC"), inp)
+    g.add_layer(f"{name}_bn", BatchNormalization(data_format="NHWC"),
+                f"{name}_conv")
+    if act != "identity":
+        g.add_layer(f"{name}_act", ActivationLayer(activation=act), f"{name}_bn")
+        return f"{name}_act"
+    return f"{name}_bn"
+
+
+def _bottleneck(g: GraphBuilder, name: str, inp: str, channels: int,
+                stride: int, project: bool) -> str:
+    """1x1 -> 3x3 -> 1x1(x4) bottleneck with identity/projection shortcut."""
+    out_ch = channels * 4
+    a = _conv_bn(g, f"{name}_a", inp, channels, (1, 1), (stride, stride),
+                 act="relu")
+    b = _conv_bn(g, f"{name}_b", a, channels, (3, 3), (1, 1), (1, 1),
+                 act="relu")
+    c = _conv_bn(g, f"{name}_c", b, out_ch, (1, 1), (1, 1))
+    if project:
+        sc = _conv_bn(g, f"{name}_proj", inp, out_ch, (1, 1), (stride, stride))
+    else:
+        sc = inp
+    g.add_vertex(f"{name}_add", ElementWiseVertex(op="add"), c, sc)
+    g.add_layer(f"{name}_out", ActivationLayer(activation="relu"), f"{name}_add")
+    return f"{name}_out"
+
+
+def _basic(g: GraphBuilder, name: str, inp: str, channels: int,
+           stride: int, project: bool) -> str:
+    """3x3 -> 3x3 basic block (ResNet-18/34)."""
+    a = _conv_bn(g, f"{name}_a", inp, channels, (3, 3), (stride, stride),
+                 (1, 1), act="relu")
+    b = _conv_bn(g, f"{name}_b", a, channels, (3, 3), (1, 1), (1, 1))
+    if project:
+        sc = _conv_bn(g, f"{name}_proj", inp, channels, (1, 1),
+                      (stride, stride))
+    else:
+        sc = inp
+    g.add_vertex(f"{name}_add", ElementWiseVertex(op="add"), b, sc)
+    g.add_layer(f"{name}_out", ActivationLayer(activation="relu"), f"{name}_add")
+    return f"{name}_out"
+
+
+def resnet(depth: int = 50, *, num_classes: int = 1000,
+           input_shape: Tuple[int, int, int] = (224, 224, 3),
+           updater=None, seed: int = 1234,
+           dtype: str = "FLOAT") -> ComputationGraph:
+    """Build a ResNet ComputationGraph. input_shape is NHWC-style (H, W, C)."""
+    if depth not in _SPECS:
+        raise ValueError(f"depth must be one of {sorted(_SPECS)}")
+    blocks, bottleneck = _SPECS[depth]
+    h, w, c = input_shape
+
+    base = (NeuralNetConfiguration.builder().seed(seed).data_type(dtype)
+            .updater(updater or Adam(learning_rate=1e-3)))
+    g = (base.graph_builder()
+         .add_inputs("in")
+         .set_input_types(InputType.convolutional(c, h, w, data_format="NHWC")))
+
+    # stem: 7x7/2 conv + BN + relu + 3x3/2 maxpool (zero-pad to keep parity
+    # with the canonical 'same'-style stem sizes)
+    g.add_layer("stem_pad", ZeroPadding2D(padding=(3, 3), data_format="NHWC"),
+                "in")
+    top = _conv_bn(g, "stem", "stem_pad", 64, (7, 7), (2, 2), act="relu")
+    g.add_layer("stem_poolpad", ZeroPadding2D(padding=(1, 1),
+                                              data_format="NHWC"), top)
+    g.add_layer("stem_pool", SubsamplingLayer(kernel=(3, 3), stride=(2, 2),
+                                              pool_type="max",
+                                              data_format="NHWC"),
+                "stem_poolpad")
+    top = "stem_pool"
+
+    block_fn = _bottleneck if bottleneck else _basic
+    for stage, (n_blocks, ch) in enumerate(zip(blocks, _STAGE_CHANNELS)):
+        for i in range(n_blocks):
+            stride = 2 if (stage > 0 and i == 0) else 1
+            project = (i == 0)
+            top = block_fn(g, f"s{stage}_b{i}", top, ch, stride, project)
+
+    g.add_layer("avgpool", GlobalPoolingLayer(pool_type="avg",
+                                              data_format="NHWC"), top)
+    g.add_layer("fc", OutputLayer(n_out=num_classes, weight_init="xavier"),
+                "avgpool")
+    g.set_outputs("fc")
+    return ComputationGraph(g.build())
+
+
+def resnet50(**kw) -> ComputationGraph:
+    """The DL4J zoo model (ResNet50.java†), NHWC, ImageNet head by default."""
+    return resnet(50, **kw)
+
+
+def estimate_flops_per_example(net: ComputationGraph) -> float:
+    """Forward-pass MAC-derived FLOPs (2*MACs) per example from the graph's
+    propagated shapes — feeds PerformanceListener's MFU (bwd ~ 2x fwd, the
+    listener applies the 3x convention)."""
+    from ..nn.vertices import LayerVertex
+    if not getattr(net, "_shapes", None):
+        net.init()
+    flops = 0.0
+    for name in net._topo:
+        v, ins = net._vertex_map[name]
+        if not isinstance(v, LayerVertex):
+            continue
+        lyr = v.layer
+        out_shape = net._shapes[name]
+        if isinstance(lyr, ConvolutionLayer):
+            kh, kw = (lyr.kernel if isinstance(lyr.kernel, tuple)
+                      else (lyr.kernel, lyr.kernel))
+            if lyr.data_format == "NHWC":
+                oh, ow, co = out_shape
+            else:
+                co, oh, ow = out_shape
+            in_shape = net._shapes.get(ins[0]) or net.conf.input_shapes[ins[0]]
+            ci = in_shape[-1] if lyr.data_format == "NHWC" else in_shape[0]
+            flops += 2.0 * kh * kw * ci * co * oh * ow
+        elif isinstance(lyr, OutputLayer) or lyr.kind == "dense":
+            n_out = int(out_shape[-1])
+            in_shape = net._shapes.get(ins[0]) or net.conf.input_shapes[ins[0]]
+            n_in = 1
+            for s in in_shape:
+                n_in *= int(s)
+            flops += 2.0 * n_in * n_out
+    return flops

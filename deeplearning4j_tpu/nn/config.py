@@ -58,6 +58,7 @@ class MultiLayerConfiguration:
     def to_json(self) -> str:
         d = {
             "format_version": 1,
+            "model_class": "MultiLayerNetwork",
             "seed": self.seed,
             "dtype": self.dtype,
             "input_shape": list(self.input_shape) if self.input_shape else None,
@@ -155,6 +156,12 @@ class NeuralNetConfiguration:
     def list(self, *ls: Layer):
         self._layers.extend(ls)
         return self
+
+    def graph_builder(self):
+        """DAG config builder carrying this builder's seed/updater/etc.
+        (DL4J ``.graphBuilder()``)."""
+        from .graph import GraphBuilder
+        return GraphBuilder(self)
 
     def build(self) -> MultiLayerConfiguration:
         layers = _auto_flatten(self._layers, self._input_shape)

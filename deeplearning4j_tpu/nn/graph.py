@@ -1,0 +1,555 @@
+"""ComputationGraph: the DAG network engine.
+
+TPU-native equivalent of DL4J's ``ComputationGraph`` +
+``ComputationGraphConfiguration.GraphBuilder`` (reference:
+``deeplearning4j-nn .../nn/graph/ComputationGraph.java`` and
+``.../nn/conf/ComputationGraphConfiguration.java``† per SURVEY.md §2.4/§3.2;
+reference mount was empty, citations upstream-relative, unverified).
+
+Architecture (the §3.2 "TPU translation"): DL4J walks ``GraphVertex[]`` in
+topological order calling doForward per vertex per iteration, then reverse
+topo with hand-written epsilon accumulation. Here the SAME topo walk is a
+pure function traced ONCE into a single fused XLA program
+(forward + backward + updater, buffers donated); fan-out gradient
+accumulation is the chain rule under ``jax.grad``, multi-output losses sum.
+
+Usage mirrors DL4J::
+
+    conf = (NeuralNetConfiguration.builder()
+            .updater(Adam(1e-3))
+            .graph_builder()
+            .add_inputs("in")
+            .set_input_types(InputType.convolutional(3, 32, 32))
+            .add_layer("conv1", ConvolutionLayer(...), "in")
+            .add_vertex("res", ElementWiseVertex(op="add"), "conv1", "in")
+            .add_layer("out", OutputLayer(...), "res")
+            .set_outputs("out")
+            .build())
+    net = ComputationGraph(conf).init()
+    net.fit(multi_dataset_iterator, epochs=2)
+
+Param/state layout: pytree keyed by VERTEX NAME (stable across JSON);
+flat-param adapter orders by topological order then DL4J param-name order —
+same contract as MultiLayerNetwork.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import dtypes as _dt
+from ..data.dataset import (DataSet, DataSetIterator, MultiDataSet,
+                            MultiDataSetIterator, NumpyMultiDataSetIterator)
+from ..ops import losses as _loss
+from . import updaters as _upd
+from .layers.base import Layer
+from .layers.core import LossLayer, OutputLayer
+from .model import _PARAM_ORDER
+from .vertices import GraphVertex, LayerVertex
+
+
+class ComputationGraphConfiguration:
+    """Immutable DAG description (the thing that serializes)."""
+
+    def __init__(self, *, inputs: List[str], outputs: List[str],
+                 vertices: List[Tuple[str, GraphVertex, List[str]]],
+                 input_shapes: Optional[Dict[str, Tuple[int, ...]]] = None,
+                 seed: int = 1234, dtype: str = "FLOAT", updater: Any = None,
+                 l1: float = 0.0, l2: float = 0.0,
+                 gradient_clip_value: Optional[float] = None,
+                 gradient_clip_l2: Optional[float] = None,
+                 tbptt_length: Optional[int] = None):
+        self.inputs = list(inputs)
+        self.outputs = list(outputs)
+        self.vertices = list(vertices)  # [(name, vertex, [input names])]
+        self.input_shapes = dict(input_shapes or {})
+        self.seed = seed
+        self.dtype = dtype
+        self.updater = updater
+        self.l1 = l1
+        self.l2 = l2
+        self.gradient_clip_value = gradient_clip_value
+        self.gradient_clip_l2 = gradient_clip_l2
+        self.tbptt_length = tbptt_length
+        self._validate()
+
+    def _validate(self):
+        names = set(self.inputs)
+        for name, v, ins in self.vertices:
+            if name in names:
+                raise ValueError(f"duplicate vertex name {name!r}")
+            for i in ins:
+                if i not in names and i not in {n for n, _, _ in self.vertices}:
+                    raise ValueError(
+                        f"vertex {name!r} input {i!r} is not a network input "
+                        "or a declared vertex")
+            names.add(name)
+        for o in self.outputs:
+            if o not in names:
+                raise ValueError(f"output {o!r} is not a declared vertex")
+
+    def topo_order(self) -> List[str]:
+        """Kahn topological order over vertex names (inputs excluded)."""
+        ins = {name: set(i for i in inp if i not in self.inputs)
+               for name, _, inp in self.vertices}
+        dependents: Dict[str, List[str]] = {}
+        for name, _, inp in self.vertices:
+            for i in set(inp):  # dedupe: a vertex may consume an input twice
+                dependents.setdefault(i, []).append(name)
+        ready = [n for n, deps in ins.items() if not deps]
+        order: List[str] = []
+        while ready:
+            n = ready.pop(0)
+            order.append(n)
+            for d in dependents.get(n, []):
+                ins[d].discard(n)
+                if not ins[d]:
+                    ready.append(d)
+        if len(order) != len(self.vertices):
+            cyc = sorted(set(ins) - set(order))
+            raise ValueError(f"graph has a cycle involving {cyc}")
+        return order
+
+    # ------------------------------------------------------------------ serde
+    def to_json(self) -> str:
+        return json.dumps({
+            "format_version": 1,
+            "model_class": "ComputationGraph",
+            "seed": self.seed,
+            "dtype": self.dtype,
+            "updater": self.updater.to_dict() if self.updater else None,
+            "l1": self.l1, "l2": self.l2,
+            "gradient_clip_value": self.gradient_clip_value,
+            "gradient_clip_l2": self.gradient_clip_l2,
+            "tbptt_length": self.tbptt_length,
+            "network_inputs": self.inputs,
+            "network_outputs": self.outputs,
+            "input_shapes": {k: list(v) for k, v in self.input_shapes.items()},
+            "vertices": [{"name": n, "inputs": list(i), "vertex": v.to_dict()}
+                         for n, v, i in self.vertices],
+        }, indent=2)
+
+    @staticmethod
+    def from_json(s: str) -> "ComputationGraphConfiguration":
+        d = json.loads(s)
+        return ComputationGraphConfiguration(
+            inputs=d["network_inputs"],
+            outputs=d["network_outputs"],
+            vertices=[(vd["name"], GraphVertex.from_dict(vd["vertex"]),
+                       list(vd["inputs"])) for vd in d["vertices"]],
+            input_shapes={k: tuple(v) for k, v in d.get("input_shapes", {}).items()},
+            seed=d.get("seed", 1234), dtype=d.get("dtype", "FLOAT"),
+            updater=_upd.Updater.from_dict(d["updater"]) if d.get("updater") else None,
+            l1=d.get("l1", 0.0), l2=d.get("l2", 0.0),
+            gradient_clip_value=d.get("gradient_clip_value"),
+            gradient_clip_l2=d.get("gradient_clip_l2"),
+            tbptt_length=d.get("tbptt_length"))
+
+
+class GraphBuilder:
+    """DL4J ``NeuralNetConfiguration.Builder().graphBuilder()`` equivalent."""
+
+    def __init__(self, base=None):
+        # base: a NeuralNetConfiguration builder carrying seed/updater/etc.
+        self._base = base
+        self._inputs: List[str] = []
+        self._outputs: List[str] = []
+        self._vertices: List[Tuple[str, GraphVertex, List[str]]] = []
+        self._input_shapes: Dict[str, Tuple[int, ...]] = {}
+
+    def add_inputs(self, *names: str) -> "GraphBuilder":
+        self._inputs.extend(names)
+        return self
+
+    def set_input_types(self, *shapes) -> "GraphBuilder":
+        """Shapes (batch-free, InputType.* values) aligned with add_inputs order."""
+        if len(shapes) != len(self._inputs):
+            raise ValueError(f"{len(self._inputs)} inputs declared, "
+                             f"{len(shapes)} input types given")
+        for name, s in zip(self._inputs, shapes):
+            self._input_shapes[name] = tuple(s)
+        return self
+
+    def add_layer(self, name: str, layer: Layer, *inputs: str) -> "GraphBuilder":
+        self._vertices.append((name, LayerVertex(layer=layer), list(inputs)))
+        return self
+
+    # DL4J spelling
+    def layer(self, name: str, layer: Layer, *inputs: str) -> "GraphBuilder":
+        return self.add_layer(name, layer, *inputs)
+
+    def add_vertex(self, name: str, vertex: GraphVertex, *inputs: str) -> "GraphBuilder":
+        self._vertices.append((name, vertex, list(inputs)))
+        return self
+
+    def set_outputs(self, *names: str) -> "GraphBuilder":
+        self._outputs = list(names)
+        return self
+
+    def build(self) -> ComputationGraphConfiguration:
+        b = self._base
+        return ComputationGraphConfiguration(
+            inputs=self._inputs, outputs=self._outputs,
+            vertices=self._vertices, input_shapes=self._input_shapes,
+            seed=b._seed if b else 1234,
+            dtype=b._dtype if b else "FLOAT",
+            updater=b._updater if b else None,
+            l1=b._l1 if b else 0.0, l2=b._l2 if b else 0.0,
+            gradient_clip_value=b._clip_value if b else None,
+            gradient_clip_l2=b._clip_l2 if b else None,
+            tbptt_length=b._tbptt if b else None)
+
+
+class ComputationGraph:
+    """DAG network engine (DL4J ``ComputationGraph``)."""
+
+    def __init__(self, conf: ComputationGraphConfiguration):
+        self.conf = conf
+        self._vertex_map: Dict[str, Tuple[GraphVertex, List[str]]] = {
+            n: (v, ins) for n, v, ins in conf.vertices}
+        self._topo = conf.topo_order()
+        self.params: Dict[str, Dict[str, jax.Array]] = {}
+        self.state: Dict[str, Dict[str, jax.Array]] = {}
+        self.updater_state: Any = None
+        self.iteration = 0
+        self.epoch = 0
+        self._score = float("nan")
+        self._listeners: List[Any] = []
+        self._train_step = None
+        self._output_fn = None
+        self._key = jax.random.PRNGKey(conf.seed)
+        self._out_layers: Dict[str, Any] = {}
+        for o in conf.outputs:
+            v = self._vertex_map[o][0]
+            lyr = v.layer if isinstance(v, LayerVertex) else None
+            if isinstance(lyr, (OutputLayer, LossLayer)):
+                self._out_layers[o] = lyr
+
+    # ------------------------------------------------------------------ init
+    def init(self) -> "ComputationGraph":
+        if set(self.conf.input_shapes) != set(self.conf.inputs):
+            missing = set(self.conf.inputs) - set(self.conf.input_shapes)
+            raise ValueError(f"set_input_types missing for inputs {sorted(missing)}")
+        dtype = _dt.resolve(self.conf.dtype)
+        shapes: Dict[str, Tuple[int, ...]] = {
+            k: tuple(v) for k, v in self.conf.input_shapes.items()}
+        key = jax.random.PRNGKey(self.conf.seed)
+        params, state = {}, {}
+        for name in self._topo:
+            v, ins = self._vertex_map[name]
+            key, sub = jax.random.split(key)
+            p, s, out_shape = v.initialize(sub, [shapes[i] for i in ins], dtype)
+            if p:
+                params[name] = p
+            if s:
+                state[name] = s
+            shapes[name] = tuple(out_shape)
+        self.params = params
+        self.state = state
+        self._shapes = shapes
+        self.updater_state = self.conf.updater.init_state(params) \
+            if self.conf.updater else {}
+        self._train_step = None
+        self._output_fn = None
+        return self
+
+    def num_params(self) -> int:
+        return sum(int(np.prod(l.shape)) for l in jax.tree.leaves(self.params))
+
+    def summary(self) -> str:
+        lines = [f"{'vertex':<24}{'type':<22}{'inputs':<30}{'out shape':<18}params"]
+        for name in self._topo:
+            v, ins = self._vertex_map[name]
+            kind = (f"layer[{v.layer.kind}]" if isinstance(v, LayerVertex)
+                    else v.kind)
+            n = sum(int(np.prod(a.shape))
+                    for a in jax.tree.leaves(self.params.get(name, {})))
+            shape = getattr(self, "_shapes", {}).get(name, "?")
+            lines.append(f"{name:<24}{kind:<22}{','.join(ins):<30}"
+                         f"{str(shape):<18}{n}")
+        lines.append(f"total params: {self.num_params()}")
+        return "\n".join(lines)
+
+    # --------------------------------------------------------------- forward
+    def _forward(self, params, inputs: Dict[str, jax.Array], state, *,
+                 train, rng, masks: Optional[Dict[str, Any]] = None):
+        """Pure topo walk. Returns ({vertex: activation}, new_state,
+        {vertex: mask}) for output vertices."""
+        acts: Dict[str, jax.Array] = dict(inputs)
+        mks: Dict[str, Any] = dict(masks or {})
+        new_state = dict(state)
+        for name in self._topo:
+            v, ins = self._vertex_map[name]
+            if rng is not None:
+                rng, sub = jax.random.split(rng)
+            else:
+                sub = None
+            y, s_new, m = v.apply(
+                params.get(name, {}), [acts[i] for i in ins],
+                state.get(name, {}), train=train, rng=sub,
+                masks=[mks.get(i) for i in ins])
+            acts[name] = y
+            mks[name] = m
+            if s_new:
+                new_state[name] = s_new
+        return acts, new_state, mks
+
+    def _regularization(self, params):
+        total = 0.0
+        for name in self._topo:
+            v, _ = self._vertex_map[name]
+            lyr = v.layer if isinstance(v, LayerVertex) else None
+            l1 = (getattr(lyr, "l1", 0.0) or self.conf.l1) if lyr else self.conf.l1
+            l2 = (getattr(lyr, "l2", 0.0) or self.conf.l2) if lyr else self.conf.l2
+            if not (l1 or l2):
+                continue
+            w = params.get(name, {}).get("W")
+            if w is None:
+                continue
+            if l1:
+                total = total + l1 * jnp.sum(jnp.abs(w))
+            if l2:
+                total = total + 0.5 * l2 * jnp.sum(jnp.square(w))
+        return total
+
+    def _clip(self, grads):
+        cv, cl2 = self.conf.gradient_clip_value, self.conf.gradient_clip_l2
+        if cv:
+            grads = jax.tree.map(lambda g: jnp.clip(g, -cv, cv), grads)
+        if cl2:
+            norm = jnp.sqrt(sum(jnp.sum(jnp.square(g))
+                                for g in jax.tree.leaves(grads)))
+            scale = jnp.minimum(1.0, cl2 / (norm + 1e-12))
+            grads = jax.tree.map(lambda g: g * scale, grads)
+        return grads
+
+    # ------------------------------------------------------------ train step
+    def _build_train_step(self):
+        updater = self.conf.updater
+        outputs = self.conf.outputs
+        out_layers = self._out_layers
+        if set(out_layers) != set(outputs):
+            bad = sorted(set(outputs) - set(out_layers))
+            raise ValueError(
+                f"output vertices {bad} are not Output/Loss layers; fit() "
+                "needs a loss head on every network output")
+
+        def step_fn(params, opt_state, bn_state, step, key, xs, ys, fms, lms):
+            def loss_fn(p):
+                inputs = dict(zip(self.conf.inputs, xs))
+                masks = {n: m for n, m in zip(self.conf.inputs, fms)
+                         if m is not None}
+                acts, new_bn, mks = self._forward(
+                    p, inputs, bn_state, train=True, rng=key, masks=masks)
+                total = 0.0
+                for o, y, lm in zip(outputs, ys, lms):
+                    layer = out_layers[o]
+                    # intersect explicit label mask with the propagated mask
+                    m = _loss.combine_masks(lm, mks.get(o))
+                    total = total + layer.loss_value(
+                        acts[o], y, mask=m,
+                        weights=getattr(layer, "loss_weights", None))
+                return total + self._regularization(p), new_bn
+
+            (loss, new_bn), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+            grads = self._clip(grads)
+            delta, new_opt = updater.apply(grads, opt_state, params, step)
+            new_params = jax.tree.map(lambda p, d: p - d, params, delta)
+            return new_params, new_opt, new_bn, loss
+
+        return jax.jit(step_fn, donate_argnums=(0, 1, 2))
+
+    def fit(self, data, labels=None, epochs: int = 1) -> "ComputationGraph":
+        """Accepts MultiDataSetIterator, MultiDataSet, DataSetIterator,
+        DataSet, or (features, labels) arrays."""
+        if not self.params and not self.state:
+            self.init()
+        if self._train_step is None:
+            self._train_step = self._build_train_step()
+        it = _as_multi_iterator(data, labels)
+
+        for _ in range(epochs):
+            for mds in it:
+                self._key, sub = jax.random.split(self._key)
+                xs = tuple(jnp.asarray(f) for f in mds.features)
+                ys = tuple(jnp.asarray(l) for l in mds.labels)
+                fms = tuple(None if m is None else jnp.asarray(m)
+                            for m in mds.features_masks)
+                lms = tuple(None if m is None else jnp.asarray(m)
+                            for m in mds.labels_masks)
+                step = jnp.asarray(self.iteration, dtype=jnp.int32)
+                self.params, self.updater_state, self.state, loss = \
+                    self._train_step(self.params, self.updater_state,
+                                     self.state, step, sub, xs, ys, fms, lms)
+                self._score = loss
+                self.iteration += 1
+                for cb in self._listeners:
+                    cb.iteration_done(self, self.iteration, self.epoch)
+            self.epoch += 1
+            for cb in self._listeners:
+                cb.on_epoch_end(self)
+            it = _as_multi_iterator(data, labels)
+        return self
+
+    # ------------------------------------------------------------- inference
+    def output(self, *inputs, train: bool = False):
+        """Output activations for the network outputs. Returns a single array
+        when the graph has one output, else a list (DL4J ``output()``)."""
+        if self._output_fn is None:
+            outputs = self.conf.outputs
+
+            def fwd(params, state, xs):
+                acts, _, _ = self._forward(
+                    params, dict(zip(self.conf.inputs, xs)), state,
+                    train=False, rng=None)
+                return tuple(acts[o] for o in outputs)
+
+            self._output_fn = jax.jit(fwd)
+        xs = tuple(jnp.asarray(x) for x in inputs)
+        outs = [np.asarray(o) for o in
+                self._output_fn(self.params, self.state, xs)]
+        return outs[0] if len(outs) == 1 else outs
+
+    def predict(self, *inputs) -> np.ndarray:
+        out = self.output(*inputs)
+        if isinstance(out, list):
+            return [np.argmax(o, axis=-1) for o in out]
+        return np.argmax(out, axis=-1)
+
+    def score(self, data=None) -> float:
+        """Loss of the last fit batch, or of the given (Multi)DataSet;
+        includes the regularization term on both paths."""
+        if data is None:
+            if self._score is not None and not isinstance(self._score, float):
+                self._score = float(self._score)
+            return self._score
+        mds = data if isinstance(data, MultiDataSet) else \
+            MultiDataSet.from_dataset(data)
+        acts, _, mks = self._forward(
+            self.params,
+            {n: jnp.asarray(f) for n, f in zip(self.conf.inputs, mds.features)},
+            self.state, train=True, rng=None,
+            masks={n: jnp.asarray(m)
+                   for n, m in zip(self.conf.inputs, mds.features_masks)
+                   if m is not None})
+        total = 0.0
+        for o, y, lm in zip(self.conf.outputs, mds.labels, mds.labels_masks):
+            layer = self._out_layers[o]
+            m = _loss.combine_masks(
+                None if lm is None else jnp.asarray(lm), mks.get(o))
+            total = total + layer.loss_value(acts[o], jnp.asarray(y), mask=m)
+        return float(total + self._regularization(self.params))
+
+    def evaluate(self, data, labels=None, output: int = 0):
+        """Classification evaluation on one network output."""
+        from ..eval.evaluation import Evaluation
+        ev = Evaluation()
+        for mds in _as_multi_iterator(data, labels):
+            out = self.output(*mds.features)
+            if isinstance(out, list):
+                out = out[output]
+            ev.eval(mds.labels[output], out, mask=mds.labels_masks[output])
+        return ev
+
+    # -------------------------------------------------------------- listeners
+    def set_listeners(self, *listeners):
+        self._listeners = list(listeners)
+        return self
+
+    def add_listener(self, l):
+        self._listeners.append(l)
+        return self
+
+    # ---------------------------------------------------- flat-param adapter
+    def _flat_entries(self) -> List[Tuple[str, str]]:
+        out = []
+        for name in self._topo:
+            if name in self.params:
+                pnames = sorted(self.params[name],
+                                key=lambda n: _PARAM_ORDER.get(n, 99))
+                out.extend((name, n) for n in pnames)
+        return out
+
+    def params_flat(self) -> np.ndarray:
+        parts = [np.asarray(self.params[vn][pn]).ravel()
+                 for vn, pn in self._flat_entries()]
+        return np.concatenate(parts) if parts else np.zeros((0,), np.float32)
+
+    def set_params_flat(self, vec) -> "ComputationGraph":
+        vec = np.asarray(vec)
+        total = self.num_params()
+        if vec.size != total:
+            raise ValueError(f"param vector length {vec.size} != model {total}")
+        off = 0
+        new = {k: dict(v) for k, v in self.params.items()}
+        for vn, pn in self._flat_entries():
+            a = self.params[vn][pn]
+            size = int(np.prod(a.shape))
+            new[vn][pn] = jnp.asarray(
+                vec[off:off + size].reshape(a.shape), dtype=a.dtype)
+            off += size
+        self.params = new
+        return self
+
+    # ------------------------------------------------------------------ serde
+    def save(self, path, save_updater: bool = True, normalizer=None):
+        from ..utils.serializer import save_model
+        save_model(self, path, save_updater=save_updater, normalizer=normalizer)
+
+    @staticmethod
+    def load(path, load_updater: bool = True):
+        from ..utils.serializer import load_model
+        model = load_model(path, load_updater=load_updater)
+        if not isinstance(model, ComputationGraph):
+            raise TypeError(f"{path} holds a {type(model).__name__}, "
+                            "not a ComputationGraph")
+        return model
+
+
+def _as_multi_iterator(data, labels=None) -> MultiDataSetIterator:
+    if isinstance(data, MultiDataSetIterator):
+        return data
+    if isinstance(data, MultiDataSet):
+        return _SingleMultiIterator(data)
+    if isinstance(data, DataSet):
+        return _SingleMultiIterator(MultiDataSet.from_dataset(data))
+    if isinstance(data, DataSetIterator):
+        return _DataSetIteratorAdapter(data)
+    if labels is not None:
+        f = [np.asarray(a) for a in (data if isinstance(data, (list, tuple)) else [data])]
+        l = [np.asarray(a) for a in (labels if isinstance(labels, (list, tuple)) else [labels])]
+        return NumpyMultiDataSetIterator(f, l, batch_size=f[0].shape[0])
+    raise TypeError(f"cannot make a MultiDataSetIterator from {type(data)}")
+
+
+class _SingleMultiIterator(MultiDataSetIterator):
+    def __init__(self, mds: MultiDataSet):
+        self._mds = mds
+
+    def batch_size(self):
+        return self._mds.num_examples()
+
+    def __iter__(self):
+        yield self._mds
+
+
+class _DataSetIteratorAdapter(MultiDataSetIterator):
+    """DL4J MultiDataSetIteratorAdapter: DataSetIterator -> MultiDataSet."""
+
+    def __init__(self, it: DataSetIterator):
+        self._it = it
+
+    def batch_size(self):
+        return self._it.batch_size()
+
+    def reset(self):
+        self._it.reset()
+
+    def __iter__(self):
+        for ds in self._it:
+            yield MultiDataSet.from_dataset(ds)

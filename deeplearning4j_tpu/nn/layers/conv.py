@@ -28,11 +28,7 @@ def _pair(v):
     return (v, v) if isinstance(v, int) else tuple(v)
 
 
-def _safe_root(s, p):
-    """s ** (1/p) with a finite gradient at s == 0 (d s^(1/p)/ds -> inf there;
-    0-cotangent * inf = NaN would poison shared weight grads — double-where)."""
-    pos = s > 0
-    return jnp.where(pos, jnp.where(pos, s, 1.0) ** (1.0 / p), 0.0)
+from ...ops.nnops import _safe_root
 
 
 def _conv_out(size, k, s, p, mode):

@@ -28,6 +28,7 @@ import numpy as np
 
 from .. import dtypes as _dt
 from ..data.dataset import DataSet, DataSetIterator, NumpyDataSetIterator
+from ..ops import losses as _loss
 from .config import MultiLayerConfiguration
 from .layers.core import LossLayer, OutputLayer
 
@@ -135,7 +136,9 @@ class MultiLayerNetwork:
             def loss_fn(p):
                 out, new_bn, out_mask = self._forward(
                     p, x, bn_state, train=True, rng=key, mask=fmask)
-                lm = lmask if lmask is not None else out_mask
+                # intersect, don't override: an explicit label mask (e.g. the
+                # DP pad mask) and the propagated feature mask must BOTH hold
+                lm = _loss.combine_masks(lmask, out_mask)
                 data_loss = out_layer.loss_value(
                     out, y, mask=lm, weights=getattr(out_layer, "loss_weights", None))
                 return data_loss + self._regularization(p), new_bn
@@ -272,7 +275,11 @@ class MultiLayerNetwork:
     @staticmethod
     def load(path, load_updater: bool = True):
         from ..utils.serializer import load_model
-        return load_model(path, load_updater=load_updater)
+        model = load_model(path, load_updater=load_updater)
+        if not isinstance(model, MultiLayerNetwork):
+            raise TypeError(f"{path} holds a {type(model).__name__}, "
+                            "not a MultiLayerNetwork")
+        return model
 
 
 def _as_iterator(data, labels=None) -> DataSetIterator:

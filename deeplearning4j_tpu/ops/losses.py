@@ -54,6 +54,22 @@ def _per_example(value, mask):
     return jnp.mean(value)
 
 
+def combine_masks(a, b):
+    """Intersect two 0/1 loss masks of possibly different ranks (e.g. a
+    per-example [B] pad mask with a per-timestep [B,T] sequence mask):
+    leading dims are aligned, trailing dims broadcast. None is identity."""
+    if a is None:
+        return b
+    if b is None:
+        return a
+    a = jnp.asarray(a)
+    b = jnp.asarray(b)
+    nd = max(a.ndim, b.ndim)
+    a = a.reshape(a.shape + (1,) * (nd - a.ndim))
+    b = b.reshape(b.shape + (1,) * (nd - b.ndim))
+    return a * b
+
+
 def _sum_outputs(elem, weights):
     """Sum per-element loss over the trailing (output) axis with optional weights."""
     if weights is not None:

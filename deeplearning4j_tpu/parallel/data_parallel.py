@@ -29,7 +29,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..data.dataset import DataSetIterator
+from ..data.dataset import DataSetIterator, MultiDataSet
 from ..nn.model import MultiLayerNetwork, _as_iterator
 
 
@@ -54,10 +54,15 @@ class ParallelWrapper:
     a bounded, tail-only artifact; the loss and gradients exclude them.
     """
 
-    def __init__(self, model: MultiLayerNetwork, mesh: Optional[Mesh] = None):
+    def __init__(self, model, mesh: Optional[Mesh] = None):
+        # model: MultiLayerNetwork or ComputationGraph (duck-typed: both
+        # expose params/updater_state/state/_build_train_step with the same
+        # pytree layout; only the batch-argument arity differs)
         self.model = model
         self.mesh = mesh or make_mesh()
         self._step = None
+        from ..nn.graph import ComputationGraph
+        self._is_graph = isinstance(model, ComputationGraph)
 
     def _build(self):
         base = self.model._build_train_step()  # already jit; re-wrap with shardings
@@ -70,44 +75,41 @@ class ParallelWrapper:
         def step_fn(params, opt_state, bn_state, step, key, x, y, fm, lm):
             return base(params, opt_state, bn_state, step, key, x, y, fm, lm)
 
+        put = jax.device_put
+
+        def shard_batch(t):
+            """Batch-sharded placement for one array, a tuple of arrays
+            (multi-input/-output graphs), or None (absent mask)."""
+            if t is None:
+                return None
+            if isinstance(t, tuple):
+                return tuple(shard_batch(a) for a in t)
+            return put(t, data)
+
         def shard_args(params, opt_state, bn_state, step, key, x, y, fm, lm):
-            put = lambda t, s: jax.device_put(t, s)
             params = jax.tree.map(lambda a: put(a, repl), params)
             opt_state = jax.tree.map(lambda a: put(a, repl), opt_state)
             bn_state = jax.tree.map(lambda a: put(a, repl), bn_state)
-            x = put(x, data)
-            y = put(y, data)
-            fm = None if fm is None else put(fm, data)
-            lm = None if lm is None else put(lm, data)
-            return params, opt_state, bn_state, step, key, x, y, fm, lm
+            return (params, opt_state, bn_state, step, key,
+                    shard_batch(x), shard_batch(y),
+                    shard_batch(fm), shard_batch(lm))
 
         return step_fn, shard_args
 
-    def fit(self, data, epochs: int = 1) -> MultiLayerNetwork:
+    def fit(self, data, epochs: int = 1):
         m = self.model
         if not m.params:
             m.init()
         if self._step is None:
             self._step = self._build()
         step_fn, shard_args = self._step
-        n = self.mesh.devices.size
-        it: DataSetIterator = _as_iterator(data)
         for _ in range(epochs):
-            for ds in it:
-                x = np.asarray(ds.features)
-                y = np.asarray(ds.labels)
-                fm = None if ds.features_mask is None else np.asarray(ds.features_mask)
-                lm = None if ds.labels_mask is None else np.asarray(ds.labels_mask)
-                rem = x.shape[0] % n
-                if rem:
-                    x, y, fm, lm = _pad_and_mask(x, y, fm, lm, n - rem)
+            for batch in self._batches(data):
+                x, y, fm, lm = batch
                 m._key, sub = jax.random.split(m._key)
                 args = shard_args(
                     m.params, m.updater_state, m.state,
-                    jnp.asarray(m.iteration, jnp.int32), sub,
-                    jnp.asarray(x), jnp.asarray(y),
-                    None if fm is None else jnp.asarray(fm),
-                    None if lm is None else jnp.asarray(lm))
+                    jnp.asarray(m.iteration, jnp.int32), sub, x, y, fm, lm)
                 m.params, m.updater_state, m.state, loss = step_fn(*args)
                 m._score = loss
                 m.iteration += 1
@@ -117,6 +119,37 @@ class ParallelWrapper:
             for cb in m._listeners:
                 cb.on_epoch_end(m)
         return m
+
+    def _batches(self, data):
+        """Yield (x, y, fm, lm) step arguments — arrays for the sequential
+        engine, tuples-of-arrays for the graph engine — ragged tails padded
+        to the mesh size and masked."""
+        n = self.mesh.devices.size
+        if self._is_graph:
+            from ..nn.graph import _as_multi_iterator
+            for mds in _as_multi_iterator(data):
+                fs = [np.asarray(a) for a in mds.features]
+                ls = [np.asarray(a) for a in mds.labels]
+                fms = [None if a is None else np.asarray(a)
+                       for a in mds.features_masks]
+                lms = [None if a is None else np.asarray(a)
+                       for a in mds.labels_masks]
+                rem = fs[0].shape[0] % n
+                if rem:
+                    fs, ls, fms, lms = _pad_and_mask_multi(
+                        fs, ls, fms, lms, n - rem)
+                yield (tuple(fs), tuple(ls), tuple(fms), tuple(lms))
+        else:
+            it: DataSetIterator = _as_iterator(data)
+            for ds in it:
+                x = np.asarray(ds.features)
+                y = np.asarray(ds.labels)
+                fm = None if ds.features_mask is None else np.asarray(ds.features_mask)
+                lm = None if ds.labels_mask is None else np.asarray(ds.labels_mask)
+                rem = x.shape[0] % n
+                if rem:
+                    x, y, fm, lm = _pad_and_mask(x, y, fm, lm, n - rem)
+                yield (x, y, fm, lm)
 
 
 def _pad_and_mask(x, y, fm, lm, pad):
@@ -135,12 +168,33 @@ def _pad_and_mask(x, y, fm, lm, pad):
         fm = zpad(fm)  # padded rows have all-zero feature mask
     if lm is not None:
         lm = zpad(lm)  # padded rows masked (zeros)
-    elif fm is None:
-        # no masks anywhere: synthesize one matching the per-example loss
-        # shape (labels' leading dims — [B] dense, [B,T] per-timestep)
-        lm = np.ones(y.shape[:-1] or (y.shape[0],), dtype=np.float32)
+    else:
+        # synthesize a per-example pad mask; the loss INTERSECTS it with any
+        # network-propagated mask (ops/losses.combine_masks), so real
+        # sequences' masked timesteps stay excluded too
+        lm = np.ones((y.shape[0],), dtype=np.float32)
         lm[-pad:] = 0.0
-    # else (fm set, lm absent): the network-propagated out_mask derived from
-    # the zero-padded feature mask already excludes padded rows AND masked
-    # timesteps of real sequences — synthesizing lm here would override it
     return x, y, fm, lm
+
+
+def _pad_and_mask_multi(fs, ls, fms, lms, pad):
+    """Multi-input/-output variant of :func:`_pad_and_mask` for the graph
+    engine: every feature/label array is zero-padded; label masks are padded
+    or (when no mask exists anywhere) synthesized per output slot."""
+    def zpad(a):
+        return np.pad(a, [(0, pad)] + [(0, 0)] * (a.ndim - 1))
+
+    fs = [zpad(a) for a in fs]
+    ls = [zpad(a) for a in ls]
+    fms = [None if m is None else zpad(m) for m in fms]
+    out_lms = []
+    for y, m in zip(ls, lms):
+        if m is not None:
+            out_lms.append(zpad(m))
+        else:
+            # per-example pad mask; intersected with any propagated mask by
+            # the loss (ops/losses.combine_masks)
+            lm = np.ones((y.shape[0],), dtype=np.float32)
+            lm[-pad:] = 0.0
+            out_lms.append(lm)
+    return fs, ls, fms, out_lms

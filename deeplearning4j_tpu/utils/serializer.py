@@ -102,12 +102,19 @@ def save_model(model, path: str, save_updater: bool = True, normalizer=None):
 
 def load_model(path: str, load_updater: bool = True):
     from ..nn.config import MultiLayerConfiguration
+    from ..nn.graph import ComputationGraph, ComputationGraphConfiguration
     from ..nn.model import MultiLayerNetwork
 
     with zipfile.ZipFile(path, "r") as zf:
-        conf = MultiLayerConfiguration.from_json(
-            zf.read("configuration.json").decode())
-        model = MultiLayerNetwork(conf)
+        conf_json = zf.read("configuration.json").decode()
+        model_class = json.loads(conf_json).get("model_class",
+                                                "MultiLayerNetwork")
+        if model_class == "ComputationGraph":
+            model = ComputationGraph(
+                ComputationGraphConfiguration.from_json(conf_json))
+        else:
+            model = MultiLayerNetwork(
+                MultiLayerConfiguration.from_json(conf_json))
         model.init()  # builds structure; then overwrite arrays
         model.params = _npz_bytes_to_tree(zf.read("coefficients.npz"))
         model.state = _npz_bytes_to_tree(zf.read("state.npz"))

@@ -76,6 +76,71 @@ def test_dp_pads_ragged_tail():
                                rtol=1e-4, atol=1e-5)
 
 
+def test_dp_computation_graph():
+    """ComputationGraph DP over the 8-device mesh: residual graph trains and
+    matches the single-device graph fit (sync-replica contract), including a
+    ragged batch."""
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+    from deeplearning4j_tpu.nn.vertices import ElementWiseVertex
+
+    def _graph_conf():
+        return (NeuralNetConfiguration.builder().seed(9)
+                .updater(Sgd(learning_rate=0.05))
+                .graph_builder()
+                .add_inputs("in")
+                .set_input_types(InputType.feed_forward(4))
+                .add_layer("d1", DenseLayer(n_out=8, activation="tanh"), "in")
+                .add_layer("d2", DenseLayer(n_out=8, activation="tanh"), "d1")
+                .add_vertex("res", ElementWiseVertex(op="add"), "d1", "d2")
+                .add_layer("out", OutputLayer(n_out=2), "res")
+                .set_outputs("out")
+                .build())
+
+    x, y = _data(37)  # ragged on an 8-mesh
+    ds = DataSet(x, y)
+
+    g1 = ComputationGraph(_graph_conf()).init()
+    g1.fit(ds, epochs=3)
+
+    g2 = ComputationGraph(_graph_conf()).init()
+    ParallelWrapper(g2).fit(ds, epochs=3)
+
+    assert g2.iteration == 3
+    np.testing.assert_allclose(g1.params_flat(), g2.params_flat(),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_dp_pads_ragged_tail_with_feature_mask():
+    """Masked time-series + ragged tail: the synthesized pad mask must
+    INTERSECT the propagated sequence mask (not override it, and mask-
+    consuming layers returning out_mask=None must not unmask pad rows)."""
+    from deeplearning4j_tpu.nn.layers.conv import GlobalPoolingLayer
+
+    def conf():
+        return (NeuralNetConfiguration.builder().seed(4)
+                .updater(Sgd(learning_rate=0.05))
+                .input_type(InputType.recurrent(3, 5))
+                .list(DenseLayer(n_out=6, activation="tanh"),  # per-timestep
+                      GlobalPoolingLayer(pool_type="avg"),     # consumes mask
+                      OutputLayer(n_out=2)).build())
+
+    rng = np.random.default_rng(8)
+    x = rng.normal(size=(37, 5, 3)).astype(np.float32)
+    fm = (rng.random((37, 5)) > 0.3).astype(np.float32)
+    fm[:, 0] = 1.0  # at least one valid step per sequence
+    y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 37)]
+    ds = DataSet(x, y, features_mask=fm)
+
+    net1 = MultiLayerNetwork(conf()).init()
+    net1.fit(ds, epochs=1)
+
+    net2 = MultiLayerNetwork(conf()).init()
+    ParallelWrapper(net2).fit(ds, epochs=1)
+
+    np.testing.assert_allclose(net1.params_flat(), net2.params_flat(),
+                               rtol=1e-4, atol=1e-5)
+
+
 def test_dp_params_replicated_after_step():
     x, y = _data(32)
     net = MultiLayerNetwork(_conf()).init()
