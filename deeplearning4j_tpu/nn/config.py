@@ -165,11 +165,30 @@ class NeuralNetConfiguration:
 
     def build(self) -> MultiLayerConfiguration:
         layers = _auto_flatten(self._layers, self._input_shape)
+        if self._tbptt:
+            layers = [stamp_tbptt(l, self._tbptt) for l in layers]
         return MultiLayerConfiguration(
             layers=layers, input_shape=self._input_shape, seed=self._seed,
             dtype=self._dtype, updater=self._updater, l1=self._l1, l2=self._l2,
             gradient_clip_value=self._clip_value, gradient_clip_l2=self._clip_l2,
             tbptt_length=self._tbptt)
+
+
+def stamp_tbptt(layer: Layer, tbptt: int) -> Layer:
+    """Copy-on-write stamp of the net-level truncated-BPTT window onto
+    recurrent layers that didn't set their own (DL4J:
+    backpropType(TruncatedBPTT) + tBPTTLength is a net-level knob the RNN
+    layers consume). Recurses into wrappers holding a nested `layer`
+    (Bidirectional); never mutates caller-owned configs."""
+    import dataclasses as _dc
+    inner = getattr(layer, "layer", None)
+    if isinstance(inner, Layer):
+        stamped = stamp_tbptt(inner, tbptt)
+        if stamped is not inner:
+            layer = _dc.replace(layer, layer=stamped)
+    if getattr(layer, "tbptt_length", False) is None:
+        layer = _dc.replace(layer, tbptt_length=tbptt)
+    return layer
 
 
 def _auto_flatten(layers: List[Layer], input_shape) -> List[Layer]:

@@ -49,7 +49,7 @@ from ..ops import losses as _loss
 from . import updaters as _upd
 from .layers.base import Layer
 from .layers.core import LossLayer, OutputLayer
-from .model import _PARAM_ORDER
+from .model import _get_path, _param_paths, _set_path
 from .vertices import GraphVertex, LayerVertex
 
 
@@ -193,9 +193,16 @@ class GraphBuilder:
 
     def build(self) -> ComputationGraphConfiguration:
         b = self._base
+        vertices = self._vertices
+        if b and b._tbptt:
+            from .config import stamp_tbptt
+            vertices = [
+                (n, LayerVertex(layer=stamp_tbptt(v.layer, b._tbptt))
+                 if isinstance(v, LayerVertex) else v, ins)
+                for n, v, ins in vertices]
         return ComputationGraphConfiguration(
             inputs=self._inputs, outputs=self._outputs,
-            vertices=self._vertices, input_shapes=self._input_shapes,
+            vertices=vertices, input_shapes=self._input_shapes,
             seed=b._seed if b else 1234,
             dtype=b._dtype if b else "FLOAT",
             updater=b._updater if b else None,
@@ -466,18 +473,17 @@ class ComputationGraph:
         return self
 
     # ---------------------------------------------------- flat-param adapter
-    def _flat_entries(self) -> List[Tuple[str, str]]:
+    def _flat_entries(self) -> List[Tuple[str, Tuple[str, ...]]]:
         out = []
         for name in self._topo:
             if name in self.params:
-                pnames = sorted(self.params[name],
-                                key=lambda n: _PARAM_ORDER.get(n, 99))
-                out.extend((name, n) for n in pnames)
+                out.extend((name, path)
+                           for path in _param_paths(self.params[name]))
         return out
 
     def params_flat(self) -> np.ndarray:
-        parts = [np.asarray(self.params[vn][pn]).ravel()
-                 for vn, pn in self._flat_entries()]
+        parts = [np.asarray(_get_path(self.params[vn], path)).ravel()
+                 for vn, path in self._flat_entries()]
         return np.concatenate(parts) if parts else np.zeros((0,), np.float32)
 
     def set_params_flat(self, vec) -> "ComputationGraph":
@@ -486,12 +492,12 @@ class ComputationGraph:
         if vec.size != total:
             raise ValueError(f"param vector length {vec.size} != model {total}")
         off = 0
-        new = {k: dict(v) for k, v in self.params.items()}
-        for vn, pn in self._flat_entries():
-            a = self.params[vn][pn]
+        new = dict(self.params)
+        for vn, path in self._flat_entries():
+            a = _get_path(self.params[vn], path)
             size = int(np.prod(a.shape))
-            new[vn][pn] = jnp.asarray(
-                vec[off:off + size].reshape(a.shape), dtype=a.dtype)
+            new[vn] = _set_path(new[vn], path, jnp.asarray(
+                vec[off:off + size].reshape(a.shape), dtype=a.dtype))
             off += size
         self.params = new
         return self

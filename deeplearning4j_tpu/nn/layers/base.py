@@ -68,6 +68,8 @@ class Layer:
         if kind not in LAYERS:
             raise ValueError(f"Unknown layer kind {kind!r}; known: {sorted(LAYERS)}")
         cls = LAYERS[kind]
+        if hasattr(cls, "_from_dict_fields"):  # wrappers with nested layers
+            return cls(**cls._from_dict_fields(d))
         field_names = {f.name for f in dataclasses.fields(cls)}
         kwargs = {k: _decode(v) for k, v in d.items() if k in field_names}
         return cls(**kwargs)

@@ -1,0 +1,325 @@
+"""Recurrent layers: LSTM, GravesLSTM, SimpleRnn, Bidirectional wrapper,
+RnnOutputLayer/RnnLossLayer, LastTimeStep wrapper.
+
+TPU-native equivalents of DL4J's recurrent stack (reference:
+``deeplearning4j-nn .../nn/conf/layers/{LSTM,GravesLSTM,SimpleRnn}.java``,
+``.../nn/conf/layers/recurrent/{Bidirectional,LastTimeStep}.java``,
+``.../nn/layers/recurrent/``† per SURVEY.md §2.7; reference mount was empty,
+citations upstream-relative, unverified).
+
+TPU-first design (SURVEY.md §2.7 "TPU build"): the whole sequence runs as ONE
+``lax.scan`` whose per-step body is a fused [B, in+hidden]x[.,4u] matmul (the
+MXU shape) — not DL4J's per-timestep Java loop over native calls. Masking is
+carry-gating (``h_t = m_t*h_new + (1-m_t)*h_prev``), which also makes naive
+buffer-flip bidirectionalism correct for end-padded sequences. Truncated BPTT
+is a per-step ``stop_gradient`` on the carry at window boundaries — the same
+gradient truncation DL4J gets from chunked fitting, without leaving the
+compiled step.
+
+Layout conventions (recorded divergences from DL4J):
+- activations are [B, T, F] (time-second); DL4J is [B, F, T].
+- param names follow LSTMParamInitializer: "W" [nIn,4u] input weights,
+  "RW" [u,4u] recurrent weights, "b" [4u]; gate order [i,f,o,g]
+  (DL4J LSTMBlockCell order). GravesLSTM keeps peepholes in a separate
+  "PW" [3,u] tensor instead of DL4J's RW-appended columns.
+- streaming state (``rnnTimeStep``) lives OUTSIDE params/state, managed by
+  the model (`MultiLayerNetwork.rnn_time_step`), so fit() stays stateless
+  across batches exactly like DL4J's feed-forward fit path.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ...ops import activations as _act
+from ...ops import nnops
+from .. import weights as _winit
+from .base import Layer, layer
+from .core import OutputLayer, LossLayer
+
+
+def _scan_time(step, carry0, x, mask, tbptt):
+    """Scan `step` over the time axis of x [B,T,F].
+
+    step: (carry, (x_t, m_t, t)) -> (carry, y_t); mask gating happens inside
+    `step`. tbptt: stop the gradient flowing through the carry every
+    `tbptt` steps (window boundary), or None for full BPTT.
+    """
+    T = x.shape[1]
+    xs = jnp.moveaxis(x, 1, 0)  # [T,B,F] scan layout
+    ms = None if mask is None else jnp.moveaxis(mask, 1, 0)  # [T,B]
+    ts = jnp.arange(T, dtype=jnp.int32)
+
+    def body(carry, inp):
+        t = inp[-1]
+        if tbptt:
+            carry = jax.lax.cond(t % tbptt == 0,
+                                 lambda c: jax.tree.map(jax.lax.stop_gradient, c),
+                                 lambda c: c, carry)
+        return step(carry, inp)
+
+    if ms is None:
+        carry, ys = jax.lax.scan(body, carry0, (xs, jnp.zeros((T, 0)), ts))
+    else:
+        carry, ys = jax.lax.scan(body, carry0, (xs, ms, ts))
+    return carry, jnp.moveaxis(ys, 0, 1)  # back to [B,T,u]
+
+
+def _gate(m_t, new, prev):
+    """Carry gating: masked steps keep the previous state (callers only gate
+    when a real [B] mask slice is present)."""
+    m = m_t[:, None].astype(new.dtype)
+    return m * new + (1.0 - m) * prev
+
+
+class _RecurrentLayer(Layer):
+    """Shared streaming/scan plumbing for recurrent layers."""
+
+    supports_streaming = True
+
+    def is_recurrent(self) -> bool:
+        return True
+
+    def init_stream_state(self, params, batch: int):
+        raise NotImplementedError
+
+    def scan_with_state(self, params, x, carry, mask=None):
+        """(y [B,T,u], final_carry) — used by apply() (zero carry) and by the
+        model's rnnTimeStep streaming (persisted carry)."""
+        raise NotImplementedError
+
+    def apply(self, params, x, state, *, train=False, rng=None, mask=None):
+        carry = self.init_stream_state(params, x.shape[0])
+        y, _ = self.scan_with_state(params, x, carry, mask)
+        return y, state, mask
+
+
+@layer("lstm")
+class LSTM(_RecurrentLayer):
+    """Standard (non-peephole) LSTM (DL4J LSTM / LSTMBlock helper path)."""
+    n_out: int = 0
+    n_in: Optional[int] = None
+    activation: str = "tanh"            # DL4J exposes it; cell uses tanh
+    forget_bias: float = 1.0            # DL4J LSTM forgetGateBiasInit default
+    weight_init: str = "xavier"
+    tbptt_length: Optional[int] = None  # stamped from conf by the builder
+    l1: float = 0.0
+    l2: float = 0.0
+    name: Optional[str] = None
+
+    def initialize(self, key, input_shape, dtype):
+        n_in = self.n_in or int(input_shape[-1])
+        u = self.n_out
+        k1, k2 = jax.random.split(key)
+        w = _winit.init(self.weight_init, k1, (n_in, 4 * u), n_in, u, dtype)
+        rw = _winit.init(self.weight_init, k2, (u, 4 * u), u, u, dtype)
+        b = jnp.zeros((4 * u,), dtype)
+        return ({"W": w, "RW": rw, "b": b}, {},
+                input_shape[:-1] + (u,))
+
+    def init_stream_state(self, params, batch):
+        u = params["RW"].shape[0]
+        dt = params["W"].dtype
+        return (jnp.zeros((batch, u), dt), jnp.zeros((batch, u), dt))
+
+    def scan_with_state(self, params, x, carry, mask=None):
+        w, rw, b = params["W"], params["RW"], params["b"]
+        fb = self.forget_bias
+
+        def step(carry, inp):
+            x_t, m_t, _ = inp
+            h, c = carry
+            h_new, c_new = nnops.lstm_cell(x_t, h, c, w, rw, b, forget_bias=fb)
+            if m_t.shape[-1]:
+                h_new = _gate(m_t, h_new, h)
+                c_new = _gate(m_t, c_new, c)
+            return (h_new, c_new), h_new
+
+        return _scan_ret(step, carry, x, mask, self.tbptt_length)
+
+
+@layer("graves_lstm")
+class GravesLSTM(_RecurrentLayer):
+    """Peephole LSTM (DL4J GravesLSTM; Graves 2013). Peepholes i,f from
+    c_{t-1}, o from c_t; stored as "PW" [3,u] (recorded divergence — DL4J
+    appends them to RW)."""
+    n_out: int = 0
+    n_in: Optional[int] = None
+    activation: str = "tanh"
+    weight_init: str = "xavier"
+    tbptt_length: Optional[int] = None
+    l1: float = 0.0
+    l2: float = 0.0
+    name: Optional[str] = None
+
+    def initialize(self, key, input_shape, dtype):
+        n_in = self.n_in or int(input_shape[-1])
+        u = self.n_out
+        k1, k2, k3 = jax.random.split(key, 3)
+        w = _winit.init(self.weight_init, k1, (n_in, 4 * u), n_in, u, dtype)
+        rw = _winit.init(self.weight_init, k2, (u, 4 * u), u, u, dtype)
+        pw = _winit.init(self.weight_init, k3, (3, u), u, u, dtype)
+        return ({"W": w, "RW": rw, "PW": pw, "b": jnp.zeros((4 * u,), dtype)},
+                {}, input_shape[:-1] + (u,))
+
+    def init_stream_state(self, params, batch):
+        u = params["RW"].shape[0]
+        dt = params["W"].dtype
+        return (jnp.zeros((batch, u), dt), jnp.zeros((batch, u), dt))
+
+    def scan_with_state(self, params, x, carry, mask=None):
+        w, rw, pw, b = params["W"], params["RW"], params["PW"], params["b"]
+
+        def step(carry, inp):
+            x_t, m_t, _ = inp
+            h, c = carry
+            h_new, c_new = nnops.graves_lstm_cell(x_t, h, c, w, rw, b, pw)
+            if m_t.shape[-1]:
+                h_new = _gate(m_t, h_new, h)
+                c_new = _gate(m_t, c_new, c)
+            return (h_new, c_new), h_new
+
+        return _scan_ret(step, carry, x, mask, self.tbptt_length)
+
+
+@layer("simple_rnn")
+class SimpleRnn(_RecurrentLayer):
+    """Elman RNN: h_t = act(x W + h_{t-1} RW + b) (DL4J SimpleRnn)."""
+    n_out: int = 0
+    n_in: Optional[int] = None
+    activation: str = "tanh"
+    weight_init: str = "xavier"
+    tbptt_length: Optional[int] = None
+    l1: float = 0.0
+    l2: float = 0.0
+    name: Optional[str] = None
+
+    def initialize(self, key, input_shape, dtype):
+        n_in = self.n_in or int(input_shape[-1])
+        u = self.n_out
+        k1, k2 = jax.random.split(key)
+        w = _winit.init(self.weight_init, k1, (n_in, u), n_in, u, dtype)
+        rw = _winit.init(self.weight_init, k2, (u, u), u, u, dtype)
+        return ({"W": w, "RW": rw, "b": jnp.zeros((u,), dtype)}, {},
+                input_shape[:-1] + (u,))
+
+    def init_stream_state(self, params, batch):
+        return (jnp.zeros((batch, params["RW"].shape[0]), params["W"].dtype),)
+
+    def scan_with_state(self, params, x, carry, mask=None):
+        w, rw, b = params["W"], params["RW"], params["b"]
+        act = _act.get(self.activation)
+
+        def step(carry, inp):
+            x_t, m_t, _ = inp
+            (h,) = carry
+            h_new = nnops.simple_rnn_cell(x_t, h, w, rw, b, activation=act)
+            if m_t.shape[-1]:
+                h_new = _gate(m_t, h_new, h)
+            return (h_new,), h_new
+
+        return _scan_ret(step, carry, x, mask, self.tbptt_length)
+
+
+def _scan_ret(step, carry, x, mask, tbptt):
+    """(final_carry, ys) -> (ys, final_carry) in layer return order."""
+    final, ys = _scan_time(step, carry, x, mask, tbptt)
+    return ys, final
+
+
+@layer("bidirectional")
+class Bidirectional(_RecurrentLayer):
+    """Bidirectional wrapper around a recurrent layer config (DL4J
+    ``Bidirectional(Mode, layer)``). Modes: concat|add|mul|average.
+
+    The backward pass flips the time buffer; carry gating keeps end-padded
+    (masked) steps from perturbing state, so the flip is mask-correct.
+    GravesBidirectionalLSTM ≡ Bidirectional(GravesLSTM) here (recorded:
+    DL4J has it as a distinct legacy class with shared-gate math).
+    """
+    layer: Any = None           # the wrapped recurrent Layer config
+    mode: str = "concat"
+    name: Optional[str] = None
+
+    # rnnTimeStep is ill-defined for bidirectional nets (the backward pass
+    # needs the full future); DL4J throws the same way
+    supports_streaming = False
+
+    def initialize(self, key, input_shape, dtype):
+        k1, k2 = jax.random.split(key)
+        p_fw, _, out = self.layer.initialize(k1, input_shape, dtype)
+        p_bw, _, _ = self.layer.initialize(k2, input_shape, dtype)
+        if self.mode == "concat":
+            out = out[:-1] + (out[-1] * 2,)
+        return {"fw": p_fw, "bw": p_bw}, {}, out
+
+    def init_stream_state(self, params, batch):
+        return (self.layer.init_stream_state(params["fw"], batch),
+                self.layer.init_stream_state(params["bw"], batch))
+
+    def scan_with_state(self, params, x, carry, mask=None):
+        y_fw, c_fw = self.layer.scan_with_state(params["fw"], x, carry[0], mask)
+        x_rev = jnp.flip(x, axis=1)
+        m_rev = None if mask is None else jnp.flip(mask, axis=1)
+        y_bw, c_bw = self.layer.scan_with_state(params["bw"], x_rev,
+                                                carry[1], m_rev)
+        y_bw = jnp.flip(y_bw, axis=1)
+        if self.mode == "concat":
+            y = jnp.concatenate([y_fw, y_bw], axis=-1)
+        elif self.mode == "add":
+            y = y_fw + y_bw
+        elif self.mode == "mul":
+            y = y_fw * y_bw
+        elif self.mode == "average":
+            y = (y_fw + y_bw) / 2
+        else:
+            raise ValueError(f"unknown Bidirectional mode {self.mode!r}")
+        return y, (c_fw, c_bw)
+
+    def to_dict(self):
+        return {"kind": "bidirectional", "mode": self.mode,
+                "layer": self.layer.to_dict(), "name": self.name}
+
+    @staticmethod
+    def _from_dict_fields(d):
+        return {"mode": d.get("mode", "concat"),
+                "layer": Layer.from_dict(d["layer"]), "name": d.get("name")}
+
+
+@layer("last_timestep")
+class LastTimeStep(Layer):
+    """[B,T,F] -> [B,F]: last unmasked timestep (DL4J ``LastTimeStep``
+    wrapper — exposed as a standalone layer; the graph engine has the vertex
+    equivalent)."""
+    name: Optional[str] = None
+
+    def has_params(self):
+        return False
+
+    def initialize(self, key, input_shape, dtype):
+        return {}, {}, (int(input_shape[-1]),)
+
+    def apply(self, params, x, state, *, train=False, rng=None, mask=None):
+        if mask is None:
+            return x[:, -1, :], state, None
+        idx = (x.shape[1] - 1
+               - jnp.argmax(jnp.flip(mask, axis=1) > 0, axis=1)).astype(jnp.int32)
+        y = jnp.take_along_axis(
+            x, idx[:, None, None].repeat(x.shape[2], axis=2), axis=1)[:, 0, :]
+        return y, state, None
+
+
+@layer("rnn_output")
+class RnnOutputLayer(OutputLayer):
+    """Per-timestep dense + loss head on [B,T,F] (DL4J RnnOutputLayer).
+    Inherits OutputLayer — last-axis matmul is already time-distributed; the
+    loss averages over unmasked (example, timestep) pairs via the [B,T] mask
+    (ops/losses._per_example)."""
+
+
+@layer("rnn_loss")
+class RnnLossLayer(LossLayer):
+    """Param-free per-timestep loss head (DL4J RnnLossLayer)."""

@@ -33,7 +33,39 @@ from .config import MultiLayerConfiguration
 from .layers.core import LossLayer, OutputLayer
 
 # DL4J param-name ordering inside a layer, for the flat view
-_PARAM_ORDER = {"W": 0, "b": 1, "gamma": 2, "beta": 3}
+# (LSTMParamInitializer order W, RW, b; PW is our peephole tensor;
+# fw/bw are Bidirectional sub-trees)
+_PARAM_ORDER = {"W": 0, "RW": 1, "PW": 2, "b": 3, "gamma": 4, "beta": 5,
+                "fw": 6, "bw": 7}
+
+
+def _param_paths(node, prefix=()):
+    """Depth-first (name, ...) paths to array leaves inside one layer/vertex
+    param dict, DL4J name order at each level (handles nested sub-trees like
+    Bidirectional's fw/bw)."""
+    if not isinstance(node, dict):
+        return [prefix]
+    out = []
+    for k in sorted(node, key=lambda n: (_PARAM_ORDER.get(n, 99), n)):
+        out.extend(_param_paths(node[k], prefix + (k,)))
+    return out
+
+
+def _get_path(tree, path):
+    for p in path:
+        tree = tree[p]
+    return tree
+
+
+def _set_path(tree, path, value):
+    """Set a leaf in a nested dict, copying the dicts along the path."""
+    if len(path) == 1:
+        new = dict(tree)
+        new[path[0]] = value
+        return new
+    new = dict(tree)
+    new[path[0]] = _set_path(tree[path[0]], path[1:], value)
+    return new
 
 
 class MultiLayerNetwork:
@@ -49,6 +81,8 @@ class MultiLayerNetwork:
         self._listeners: List[Any] = []
         self._train_step = None
         self._output_fn = None
+        self._rnn_step_fn = None
+        self._rnn_stream = None
         self._key = jax.random.PRNGKey(conf.seed)
         self._out_layer = self.layers[-1] if self.layers else None
         if not isinstance(self._out_layer, (OutputLayer, LossLayer)) and self.layers:
@@ -76,6 +110,8 @@ class MultiLayerNetwork:
             if self.conf.updater else {}
         self._train_step = None
         self._output_fn = None
+        self._rnn_step_fn = None
+        self._rnn_stream = None
         return self
 
     def num_params(self) -> int:
@@ -199,6 +235,58 @@ class MultiLayerNetwork:
         """Class indices (DL4J ``predict()``)."""
         return np.argmax(self.output(x), axis=-1)
 
+    # ----------------------------------------------------- rnnTimeStep state
+    def rnn_time_step(self, x):
+        """Stateful streaming inference (DL4J ``rnnTimeStep()``): feed
+        [B,T,F] (or [B,F] for a single step) chunks; recurrent hidden state
+        persists across calls until :meth:`rnn_clear_previous_state`."""
+        x = jnp.asarray(x)
+        single = x.ndim == 2
+        if single:
+            x = x[:, None, :]  # [B,1,F]
+        if self._rnn_stream is None:
+            self._rnn_stream = {}
+        if self._rnn_step_fn is None:
+            self._rnn_step_fn = self._build_rnn_step()
+        out, self._rnn_stream = self._rnn_step_fn(
+            self.params, self.state, x, self._rnn_stream)
+        out = np.asarray(out)
+        return out[:, -1, :] if (single and out.ndim == 3) else out
+
+    def rnn_clear_previous_state(self):
+        self._rnn_stream = None
+
+    def _build_rnn_step(self):
+        recurrent = {str(i): l for i, l in enumerate(self.layers)
+                     if getattr(l, "is_recurrent", lambda: False)()}
+        for si, l in recurrent.items():
+            if not getattr(l, "supports_streaming", True):
+                raise ValueError(
+                    f"rnnTimeStep() is not supported with layer {si} "
+                    f"({l.kind}): bidirectional layers need the full future "
+                    "sequence (DL4J throws here too); use output() instead")
+
+        def step(params, state, x, stream):
+            new_stream = dict(stream)
+            for i, layer in enumerate(self.layers):
+                si = str(i)
+                p = params.get(si, {})
+                s = state.get(si, {})
+                if si in recurrent:
+                    carry = stream.get(si)
+                    if carry is None:
+                        carry = layer.init_stream_state(p, x.shape[0])
+                    x, carry = layer.scan_with_state(p, x, carry)
+                    new_stream[si] = carry
+                else:
+                    x, _, _ = layer.apply(p, x, s, train=False, rng=None)
+            return x, new_stream
+
+        # not jitted with a fixed signature: stream dict shape varies on the
+        # first call; jit would retrace once per (carry presence) pattern —
+        # fine, there are at most two patterns
+        return jax.jit(step)
+
     def score(self, ds: Optional[DataSet] = None) -> float:
         """Loss value; with no argument, the score of the last fit batch.
         Includes the l1/l2 regularization penalty, matching the fit-loop
@@ -235,20 +323,18 @@ class MultiLayerNetwork:
         return self
 
     # ---------------------------------------------------- flat-param adapter
-    def _flat_entries(self) -> List[Tuple[str, str]]:
+    def _flat_entries(self) -> List[Tuple[str, Tuple[str, ...]]]:
         out = []
         for i in range(len(self.layers)):
             si = str(i)
             if si in self.params:
-                names = sorted(self.params[si],
-                               key=lambda n: _PARAM_ORDER.get(n, 99))
-                out.extend((si, n) for n in names)
+                out.extend((si, path) for path in _param_paths(self.params[si]))
         return out
 
     def params_flat(self) -> np.ndarray:
         """One contiguous fp vector, DL4J layer/param ordering."""
-        parts = [np.asarray(self.params[si][n]).ravel()
-                 for si, n in self._flat_entries()]
+        parts = [np.asarray(_get_path(self.params[si], path)).ravel()
+                 for si, path in self._flat_entries()]
         return np.concatenate(parts) if parts else np.zeros((0,), np.float32)
 
     def set_params_flat(self, vec) -> "MultiLayerNetwork":
@@ -257,12 +343,12 @@ class MultiLayerNetwork:
         if vec.size != total:
             raise ValueError(f"param vector length {vec.size} != model {total}")
         off = 0
-        new = {k: dict(v) for k, v in self.params.items()}
-        for si, n in self._flat_entries():
-            a = self.params[si][n]
+        new = dict(self.params)
+        for si, path in self._flat_entries():
+            a = _get_path(self.params[si], path)
             size = int(np.prod(a.shape))
-            new[si][n] = jnp.asarray(
-                vec[off:off + size].reshape(a.shape), dtype=a.dtype)
+            new[si] = _set_path(new[si], path, jnp.asarray(
+                vec[off:off + size].reshape(a.shape), dtype=a.dtype))
             off += size
         self.params = new
         return self

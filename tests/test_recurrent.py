@@ -1,0 +1,336 @@
+"""Recurrent stack tests (SURVEY.md §2.7): LSTM/GravesLSTM/SimpleRnn layers
+over lax.scan, masking-through-time, tbptt, Bidirectional, rnnTimeStep
+streaming, Bi-LSTM seq2seq convergence (BASELINE.md row 5)."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.data.dataset import DataSet
+from deeplearning4j_tpu.nn.config import (InputType, MultiLayerConfiguration,
+                                          NeuralNetConfiguration)
+from deeplearning4j_tpu.nn.layers.core import DenseLayer, EmbeddingLayer
+from deeplearning4j_tpu.nn.layers.recurrent import (LSTM, Bidirectional,
+                                                    GravesLSTM, LastTimeStep,
+                                                    RnnOutputLayer, SimpleRnn)
+from deeplearning4j_tpu.nn.model import MultiLayerNetwork
+from deeplearning4j_tpu.nn.updaters import Adam
+from deeplearning4j_tpu.utils.gradcheck import check_gradients
+
+
+def _init_layer(layer, shape=(5, 3), seed=0):
+    p, s, out = layer.initialize(jax.random.PRNGKey(seed), shape, np.float32)
+    return p, s, out
+
+
+# ----------------------------------------------------------- torch oracle
+
+def test_lstm_forward_matches_torch():
+    """Our scan-LSTM (gate order i,f,o,g) must match torch.nn.LSTM
+    (gate order i,f,g,o) with permuted weights."""
+    import torch
+
+    B, T, F, U = 2, 6, 3, 4
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(B, T, F)).astype(np.float32)
+
+    layer = LSTM(n_out=U, forget_bias=0.0)
+    params, _, _ = _init_layer(layer, (T, F))
+
+    tl = torch.nn.LSTM(F, U, batch_first=True)
+    w = np.asarray(params["W"])    # [F, 4U] (i,f,o,g)
+    rw = np.asarray(params["RW"])  # [U, 4U]
+    b = np.asarray(params["b"])    # [4U]
+
+    def perm(a):  # ours (i,f,o,g) -> torch (i,f,g,o); acts on last axis
+        i, f, o, g = np.split(a, 4, axis=-1)
+        return np.concatenate([i, f, g, o], axis=-1)
+
+    with torch.no_grad():
+        tl.weight_ih_l0.copy_(torch.tensor(perm(w).T))
+        tl.weight_hh_l0.copy_(torch.tensor(perm(rw).T))
+        tl.bias_ih_l0.copy_(torch.tensor(perm(b)))
+        tl.bias_hh_l0.zero_()
+        want, _ = tl(torch.tensor(x))
+
+    got, _, _ = layer.apply(params, jnp.asarray(x), {})
+    np.testing.assert_allclose(np.asarray(got), want.numpy(),
+                               rtol=1e-4, atol=1e-5)
+
+
+# ------------------------------------------------------------ grad checks
+
+@pytest.mark.parametrize("layer_fn", [
+    lambda: LSTM(n_out=3),
+    lambda: GravesLSTM(n_out=3),
+    lambda: SimpleRnn(n_out=3),
+    lambda: Bidirectional(layer=LSTM(n_out=3), mode="concat"),
+])
+def test_rnn_layer_gradients_match_fd(layer_fn):
+    layer = layer_fn()
+    params, _, _ = _init_layer(layer, (4, 2))
+    rng = np.random.default_rng(1)
+    x = rng.normal(size=(2, 4, 2))
+
+    def loss(p):
+        y, _, _ = layer.apply(p, jnp.asarray(x), {})
+        return jnp.sum(jnp.square(y))
+
+    ok, worst, failures = check_gradients(loss, params, max_rel_error=1e-5)
+    assert ok, f"worst rel err {worst}; {failures[:3]}"
+
+
+# ---------------------------------------------------------------- masking
+
+def test_masked_steps_do_not_affect_output_or_grads():
+    """End-padding with mask must give the SAME per-sequence outputs and
+    parameter gradients as the truncated sequences themselves."""
+    U = 4
+    layer = LSTM(n_out=U)
+    params, _, _ = _init_layer(layer, (6, 3))
+    rng = np.random.default_rng(2)
+    x_short = rng.normal(size=(2, 4, 3)).astype(np.float32)   # true length 4
+    pad = rng.normal(size=(2, 2, 3)).astype(np.float32)       # garbage pad
+    x_full = np.concatenate([x_short, pad], axis=1)           # [2,6,3]
+    mask = np.concatenate([np.ones((2, 4)), np.zeros((2, 2))],
+                          axis=1).astype(np.float32)
+
+    y_short, _, _ = layer.apply(params, jnp.asarray(x_short), {})
+    y_full, _, _ = layer.apply(params, jnp.asarray(x_full), {},
+                               mask=jnp.asarray(mask))
+    np.testing.assert_allclose(np.asarray(y_full)[:, :4], np.asarray(y_short),
+                               rtol=1e-5, atol=1e-6)
+
+    def loss_masked(p):
+        y, _, _ = layer.apply(p, jnp.asarray(x_full), {},
+                              mask=jnp.asarray(mask))
+        return jnp.sum(jnp.square(y[:, :4]))
+
+    def loss_short(p):
+        y, _, _ = layer.apply(p, jnp.asarray(x_short), {})
+        return jnp.sum(jnp.square(y))
+
+    g1 = jax.grad(loss_masked)(params)
+    g2 = jax.grad(loss_short)(params)
+    for k in g1:
+        np.testing.assert_allclose(np.asarray(g1[k]), np.asarray(g2[k]),
+                                   rtol=1e-4, atol=1e-6)
+
+
+def test_e2e_masked_training_loss_excludes_padding():
+    """Full fit path: per-timestep loss with labels_mask — padded steps
+    contribute nothing (same loss as the truncated batch)."""
+    conf = (NeuralNetConfiguration.builder().seed(3)
+            .updater(Adam(learning_rate=1e-2))
+            .input_type(InputType.recurrent(3))
+            .list(LSTM(n_out=5),
+                  RnnOutputLayer(n_out=2)).build())
+    rng = np.random.default_rng(3)
+    x = rng.normal(size=(4, 6, 3)).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, (4, 6))]
+    fm = np.ones((4, 6), dtype=np.float32)
+    fm[:, 4:] = 0.0
+
+    net = MultiLayerNetwork(conf).init()
+    s_masked = net.score(DataSet(x, y, features_mask=fm, labels_mask=fm))
+    s_trunc = net.score(DataSet(x[:, :4], y[:, :4]))
+    assert s_masked == pytest.approx(s_trunc, rel=1e-5)
+
+
+# ------------------------------------------------------------------- tbptt
+
+def test_tbptt_truncates_gradients():
+    layer_full = LSTM(n_out=3)
+    layer_tr = LSTM(n_out=3, tbptt_length=2)
+    params, _, _ = _init_layer(layer_full, (8, 2))
+    rng = np.random.default_rng(4)
+    x = rng.normal(size=(2, 8, 2)).astype(np.float32)
+
+    def loss(layer):
+        def f(p):
+            y, _, _ = layer.apply(p, jnp.asarray(x), {})
+            return jnp.sum(jnp.square(y))
+        return f
+
+    # forward identical
+    y1, _, _ = layer_full.apply(params, jnp.asarray(x), {})
+    y2, _, _ = layer_tr.apply(params, jnp.asarray(x), {})
+    np.testing.assert_allclose(np.asarray(y1), np.asarray(y2), rtol=1e-6)
+
+    # recurrent-weight gradients differ (long-range chains cut)
+    g_full = jax.grad(loss(layer_full))(params)
+    g_tr = jax.grad(loss(layer_tr))(params)
+    assert not np.allclose(np.asarray(g_full["RW"]), np.asarray(g_tr["RW"]),
+                           rtol=1e-3)
+
+
+def test_tbptt_config_stamped_onto_layers():
+    lstm = LSTM(n_out=4)
+    bi = Bidirectional(layer=LSTM(n_out=4), mode="concat")
+    conf = (NeuralNetConfiguration.builder()
+            .tbptt_length(5)
+            .input_type(InputType.recurrent(3))
+            .list(lstm, bi, RnnOutputLayer(n_out=2)).build())
+    assert conf.layers[0].tbptt_length == 5
+    assert conf.layers[1].layer.tbptt_length == 5  # reaches wrapped layer
+    assert conf.tbptt_length == 5
+    # caller-owned configs are never mutated (copy-on-stamp)
+    assert lstm.tbptt_length is None
+    assert bi.layer.tbptt_length is None
+
+
+def test_tbptt_stamped_in_graph_builder():
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+    conf = (NeuralNetConfiguration.builder().tbptt_length(7)
+            .updater(Adam(learning_rate=1e-3))
+            .graph_builder()
+            .add_inputs("in")
+            .set_input_types(InputType.recurrent(3))
+            .add_layer("rnn", LSTM(n_out=4), "in")
+            .add_layer("out", RnnOutputLayer(n_out=2), "rnn")
+            .set_outputs("out")
+            .build())
+    rnn_vertex = dict((n, v) for n, v, _ in conf.vertices)["rnn"]
+    assert rnn_vertex.layer.tbptt_length == 7
+
+
+# --------------------------------------------------------------- streaming
+
+def test_rnn_time_step_streaming_matches_full_forward():
+    conf = (NeuralNetConfiguration.builder().seed(5)
+            .updater(Adam(learning_rate=1e-2))
+            .input_type(InputType.recurrent(3))
+            .list(LSTM(n_out=4),
+                  RnnOutputLayer(n_out=2)).build())
+    net = MultiLayerNetwork(conf).init()
+    rng = np.random.default_rng(5)
+    x = rng.normal(size=(2, 8, 3)).astype(np.float32)
+
+    full = net.output(x)
+    net.rnn_clear_previous_state()
+    part1 = net.rnn_time_step(x[:, :3])
+    part2 = net.rnn_time_step(x[:, 3:6])
+    part3 = net.rnn_time_step(x[:, 6:])
+    streamed = np.concatenate([part1, part2, part3], axis=1)
+    np.testing.assert_allclose(streamed, full, rtol=1e-4, atol=1e-5)
+
+    # clearing state restarts the stream
+    net.rnn_clear_previous_state()
+    again = net.rnn_time_step(x[:, :3])
+    np.testing.assert_allclose(again, part1, rtol=1e-6)
+
+    # single-step [B,F] form
+    net.rnn_clear_previous_state()
+    step0 = net.rnn_time_step(x[:, 0])
+    np.testing.assert_allclose(step0, full[:, 0], rtol=1e-4, atol=1e-5)
+
+
+def test_rnn_time_step_rejects_bidirectional():
+    """Chunked streaming through a Bi-RNN is non-causal — must raise
+    (DL4J throws the same way), never silently return wrong values."""
+    conf = (NeuralNetConfiguration.builder().seed(5)
+            .updater(Adam(learning_rate=1e-2))
+            .input_type(InputType.recurrent(3))
+            .list(Bidirectional(layer=LSTM(n_out=4), mode="concat"),
+                  RnnOutputLayer(n_out=2)).build())
+    net = MultiLayerNetwork(conf).init()
+    with pytest.raises(ValueError, match="bidirectional"):
+        net.rnn_time_step(np.zeros((2, 3, 3), np.float32))
+
+
+# ------------------------------------------------------------- convergence
+
+def test_bilstm_seq2seq_trains():
+    """BASELINE.md row 5: Bi-LSTM seq2seq (sequence tagging: was the token
+    above the running mean?) trains to high accuracy."""
+    rng = np.random.default_rng(6)
+    B, T = 64, 10
+    x = rng.normal(size=(B, T, 1)).astype(np.float32)
+    labels = (x[..., 0] > 0).astype(int)
+    y = np.eye(2, dtype=np.float32)[labels]
+
+    conf = (NeuralNetConfiguration.builder().seed(6)
+            .updater(Adam(learning_rate=5e-3))
+            .input_type(InputType.recurrent(1))
+            .list(Bidirectional(layer=LSTM(n_out=8), mode="concat"),
+                  RnnOutputLayer(n_out=2)).build())
+    net = MultiLayerNetwork(conf).init()
+    ds = DataSet(x, y)
+    net.fit(ds, epochs=1)
+    s0 = net.score()
+    net.fit(ds, epochs=150)
+    assert net.score() < s0
+    pred = np.argmax(net.output(x), axis=-1)
+    acc = (pred == labels).mean()
+    assert acc > 0.95, f"accuracy {acc}"
+
+
+def test_graves_bidirectional_and_last_timestep():
+    """GravesLSTM in a Bidirectional wrapper + LastTimeStep classifier head
+    (the GravesBidirectionalLSTM-style topology)."""
+    rng = np.random.default_rng(7)
+    x = rng.normal(size=(32, 6, 3)).astype(np.float32)
+    labels = (x.sum((1, 2)) > 0).astype(int)
+    y = np.eye(2, dtype=np.float32)[labels]
+
+    conf = (NeuralNetConfiguration.builder().seed(7)
+            .updater(Adam(learning_rate=1e-2))
+            .input_type(InputType.recurrent(3))
+            .list(Bidirectional(layer=GravesLSTM(n_out=6), mode="add"),
+                  LastTimeStep(),
+                  DenseLayer(n_out=8, activation="relu"),
+                  # rank-2 recurrent path: no auto-flatten expected
+                  __import__("deeplearning4j_tpu.nn.layers.core",
+                             fromlist=["OutputLayer"]).OutputLayer(n_out=2))
+            .build())
+    assert all(l.kind != "flatten" for l in conf.layers)
+    net = MultiLayerNetwork(conf).init()
+    ds = DataSet(x, y)
+    net.fit(ds, epochs=1)
+    s0 = net.score()
+    net.fit(ds, epochs=60)
+    assert net.score() < s0
+    acc = (net.predict(x) == labels).mean()
+    assert acc > 0.9, f"accuracy {acc}"
+
+
+# ------------------------------------------------------------------- serde
+
+def test_rnn_config_json_and_model_roundtrip(tmp_path):
+    conf = (NeuralNetConfiguration.builder().seed(8)
+            .updater(Adam(learning_rate=1e-2))
+            .tbptt_length(4)
+            .input_type(InputType.recurrent(3))
+            .list(EmbeddingLayer(n_in=10, n_out=3),
+                  Bidirectional(layer=LSTM(n_out=4), mode="concat"),
+                  RnnOutputLayer(n_out=2)).build())
+    js = conf.to_json()
+    conf2 = MultiLayerConfiguration.from_json(js)
+    assert conf2.to_json() == js
+    assert conf2.layers[1].layer.n_out == 4
+
+    # trained-model zip round-trip with nested (fw/bw) params
+    rng = np.random.default_rng(8)
+    x = rng.normal(size=(4, 6, 3)).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, (4, 6))]
+    conf3 = (NeuralNetConfiguration.builder().seed(8)
+             .updater(Adam(learning_rate=1e-2))
+             .input_type(InputType.recurrent(3))
+             .list(Bidirectional(layer=LSTM(n_out=4), mode="concat"),
+                   RnnOutputLayer(n_out=2)).build())
+    net = MultiLayerNetwork(conf3).init()
+    net.fit(DataSet(x, y), epochs=2)
+    path = os.path.join(tmp_path, "rnn.zip")
+    net.save(path)
+    net2 = MultiLayerNetwork.load(path)
+    np.testing.assert_array_equal(net.output(x), net2.output(x))
+
+    # flat adapter round-trips nested fw/bw params
+    flat = net.params_flat()
+    assert flat.size == net.num_params()
+    net.set_params_flat(flat * 1.0)
+    np.testing.assert_allclose(net.params_flat(), flat, rtol=1e-7)
