@@ -1,0 +1,7 @@
+"""Graph autodiff layer (SameDiff equivalent) — see samediff.py."""
+
+from .samediff import (ARRAY, CONSTANT, PLACEHOLDER, VARIABLE, SameDiff,
+                       SDVariable)
+
+__all__ = ["SameDiff", "SDVariable", "VARIABLE", "PLACEHOLDER", "CONSTANT",
+           "ARRAY"]

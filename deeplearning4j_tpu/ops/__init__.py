@@ -86,5 +86,7 @@ def coverage_report() -> dict:
 # Import op modules so registration runs at package import.
 from . import activations  # noqa: E402,F401
 from . import losses  # noqa: E402,F401
+from . import math  # noqa: E402,F401
 from . import nnops  # noqa: E402,F401
+from . import random  # noqa: E402,F401
 from . import reduce  # noqa: E402,F401

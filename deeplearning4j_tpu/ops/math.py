@@ -1,0 +1,226 @@
+"""Elementwise / pairwise / shape / linalg / scatter / sort op families.
+
+TPU-native equivalents of libnd4j's legacy transform/pairwise/broadcast/
+scalar loop families and the declarable ``parity_ops``/``transforms``
+generics (reference: ``libnd4j/include/loops/``,
+``libnd4j/include/ops/declarable/generic/{parity_ops,transforms,blas}``† per
+SURVEY.md §2.1; reference mount was empty, citations upstream-relative,
+unverified).
+
+These are thin named registrations over jnp/lax: XLA is the executor; the
+catalog entry is the contract used by the SameDiff-equivalent graph layer's
+serialization (name -> callable) and by import frontends. DL4J-specific
+semantics (rsub/rdiv argument order, OldSoftMax-style shifted softmax, etc.)
+are preserved where they differ from numpy.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from . import register
+from ..environment import precision_for
+
+# -- pairwise arithmetic (broadcasting; DL4J pairwise + broadcast families) --
+register("math.add", category="pairwise")(jnp.add)
+register("math.sub", category="pairwise")(jnp.subtract)
+register("math.mul", category="pairwise")(jnp.multiply)
+register("math.div", category="pairwise")(jnp.divide)
+register("math.floordiv", category="pairwise")(jnp.floor_divide)
+register("math.mod", category="pairwise")(jnp.mod)
+register("math.pow", category="pairwise")(jnp.power)
+register("math.maximum", category="pairwise")(jnp.maximum)
+register("math.minimum", category="pairwise")(jnp.minimum)
+register("math.atan2", category="pairwise")(jnp.arctan2)
+
+
+@register("math.rsub", category="pairwise")
+def rsub(a, b):
+    """DL4J rsub: b - a (reversed operand order)."""
+    return b - a
+
+
+@register("math.rdiv", category="pairwise")
+def rdiv(a, b):
+    """DL4J rdiv: b / a (reversed operand order)."""
+    return b / a
+
+
+@register("math.squared_difference", category="pairwise")
+def squared_difference(a, b):
+    return jnp.square(a - b)
+
+
+# -- scalar/elementwise transforms (DL4J transform family) -------------------
+register("math.neg", category="transform")(jnp.negative)
+register("math.abs", category="transform")(jnp.abs)
+register("math.sqrt", category="transform")(jnp.sqrt)
+register("math.square", category="transform")(jnp.square)
+register("math.exp", category="transform")(jnp.exp)
+register("math.expm1", category="transform")(jnp.expm1)
+register("math.log", category="transform")(jnp.log)
+register("math.log1p", category="transform")(jnp.log1p)
+register("math.log2", category="transform")(jnp.log2)
+register("math.sin", category="transform")(jnp.sin)
+register("math.cos", category="transform")(jnp.cos)
+register("math.tan", category="transform")(jnp.tan)
+register("math.asin", category="transform")(jnp.arcsin)
+register("math.acos", category="transform")(jnp.arccos)
+register("math.atan", category="transform")(jnp.arctan)
+register("math.sinh", category="transform")(jnp.sinh)
+register("math.cosh", category="transform")(jnp.cosh)
+register("math.floor", category="transform", differentiable=False)(jnp.floor)
+register("math.ceil", category="transform", differentiable=False)(jnp.ceil)
+register("math.round", category="transform", differentiable=False)(jnp.round)
+register("math.sign", category="transform", differentiable=False)(jnp.sign)
+register("math.reciprocal", category="transform")(jnp.reciprocal)
+register("math.rsqrt", category="transform")(lax.rsqrt)
+register("math.erf", category="transform")(jax.scipy.special.erf)
+
+
+@register("math.clip", category="transform")
+def clip(a, min_value, max_value):
+    """DL4J clipbyvalue."""
+    return jnp.clip(a, min_value, max_value)
+
+
+@register("math.clip_by_norm", category="transform")
+def clip_by_norm(a, clip_norm, axis=None):
+    norm = jnp.sqrt(jnp.sum(jnp.square(a), axis=axis, keepdims=axis is not None))
+    scale = jnp.minimum(1.0, clip_norm / jnp.maximum(norm, 1e-12))
+    return a * scale
+
+
+# -- comparisons / logic (DL4J conditions; non-differentiable) ---------------
+register("math.equal", category="compare", differentiable=False)(jnp.equal)
+register("math.not_equal", category="compare", differentiable=False)(jnp.not_equal)
+register("math.greater", category="compare", differentiable=False)(jnp.greater)
+register("math.greater_equal", category="compare", differentiable=False)(jnp.greater_equal)
+register("math.less", category="compare", differentiable=False)(jnp.less)
+register("math.less_equal", category="compare", differentiable=False)(jnp.less_equal)
+register("math.logical_and", category="compare", differentiable=False)(jnp.logical_and)
+register("math.logical_or", category="compare", differentiable=False)(jnp.logical_or)
+register("math.logical_not", category="compare", differentiable=False)(jnp.logical_not)
+register("math.logical_xor", category="compare", differentiable=False)(jnp.logical_xor)
+register("math.isnan", category="compare", differentiable=False)(jnp.isnan)
+register("math.isinf", category="compare", differentiable=False)(jnp.isinf)
+register("math.where", category="compare")(jnp.where)
+
+
+# -- blas / linalg -----------------------------------------------------------
+@register("linalg.mmul", category="blas")
+def mmul(a, b, transpose_a=False, transpose_b=False):
+    """DL4J mmul (gemm). Rides the MXU; f32 precision policy applies."""
+    if transpose_a:
+        a = jnp.swapaxes(a, -1, -2)
+    if transpose_b:
+        b = jnp.swapaxes(b, -1, -2)
+    return jnp.matmul(a, b, precision=precision_for(a, b))
+
+
+@register("linalg.tensordot", category="blas")
+def tensordot(a, b, axes=2):
+    return jnp.tensordot(a, b, axes=axes, precision=precision_for(a, b))
+
+
+register("linalg.outer", category="blas")(jnp.outer)
+register("linalg.diag", category="linalg")(jnp.diag)
+register("linalg.diag_part", category="linalg")(jnp.diagonal)
+register("linalg.trace", category="linalg")(jnp.trace)
+register("linalg.inverse", category="linalg")(jnp.linalg.inv)
+register("linalg.cholesky", category="linalg")(jnp.linalg.cholesky)
+register("linalg.solve", category="linalg")(jnp.linalg.solve)
+register("linalg.lstsq", category="linalg", differentiable=False)(jnp.linalg.lstsq)
+register("linalg.matrix_rank", category="linalg", differentiable=False)(jnp.linalg.matrix_rank)
+register("linalg.svd", category="linalg")(jnp.linalg.svd)
+register("linalg.eigh", category="linalg")(jnp.linalg.eigh)
+register("linalg.qr", category="linalg")(jnp.linalg.qr)
+register("linalg.det", category="linalg")(jnp.linalg.det)
+register("linalg.norm", category="linalg")(jnp.linalg.norm)
+
+
+# -- shape / structural ------------------------------------------------------
+register("shape.reshape", category="shape")(jnp.reshape)
+register("shape.transpose", category="shape")(jnp.transpose)
+register("shape.permute", category="shape")(jnp.transpose)  # DL4J name
+register("shape.squeeze", category="shape")(jnp.squeeze)
+register("shape.expand_dims", category="shape")(jnp.expand_dims)
+register("shape.concat", category="shape")(jnp.concatenate)
+register("shape.stack", category="shape")(jnp.stack)
+register("shape.split", category="shape")(jnp.split)
+register("shape.tile", category="shape")(jnp.tile)
+register("shape.repeat", category="shape")(jnp.repeat)
+register("shape.flip", category="shape")(jnp.flip)
+register("shape.roll", category="shape")(jnp.roll)
+register("shape.pad", category="shape")(jnp.pad)
+register("shape.broadcast_to", category="shape")(jnp.broadcast_to)
+register("shape.gather", category="shape")(jnp.take)
+register("shape.take_along_axis", category="shape")(jnp.take_along_axis)
+register("shape.tril", category="shape")(jnp.tril)
+register("shape.triu", category="shape")(jnp.triu)
+
+
+@register("shape.strided_slice", category="shape", differentiable=False)
+def strided_slice(a, begin, end, strides=None):
+    idx = tuple(slice(b, e, s) for b, e, s in
+                zip(begin, end, strides or [1] * len(begin)))
+    return a[idx]
+
+
+@register("shape.one_hot", category="shape", differentiable=False)
+def one_hot(indices, depth, dtype=jnp.float32):
+    return jax.nn.one_hot(jnp.asarray(indices, jnp.int32), depth, dtype=dtype)
+
+
+# -- sort / search / scatter (libnd4j helpers: sort, topk, scatter) ----------
+register("sort.sort", category="sort")(jnp.sort)
+register("sort.argsort", category="sort", differentiable=False)(jnp.argsort)
+
+
+@register("sort.top_k", category="sort", differentiable=False)
+def top_k(a, k):
+    """values, indices of the k largest along the last axis (DL4J top_k)."""
+    return lax.top_k(a, k)
+
+
+@register("sort.in_top_k", category="sort", differentiable=False)
+def in_top_k(predictions, targets, k):
+    _, idx = lax.top_k(predictions, k)
+    return jnp.any(idx == jnp.asarray(targets)[:, None], axis=-1)
+
+
+@register("scatter.update", category="scatter")
+def scatter_update(a, indices, updates):
+    return a.at[jnp.asarray(indices, jnp.int32)].set(updates)
+
+
+@register("scatter.add", category="scatter")
+def scatter_add(a, indices, updates):
+    return a.at[jnp.asarray(indices, jnp.int32)].add(updates)
+
+
+@register("scatter.mul", category="scatter")
+def scatter_mul(a, indices, updates):
+    return a.at[jnp.asarray(indices, jnp.int32)].multiply(updates)
+
+
+@register("scatter.max", category="scatter")
+def scatter_max(a, indices, updates):
+    return a.at[jnp.asarray(indices, jnp.int32)].max(updates)
+
+
+@register("scatter.segment_sum", category="scatter")
+def segment_sum(data, segment_ids, num_segments):
+    return jax.ops.segment_sum(data, jnp.asarray(segment_ids, jnp.int32),
+                               num_segments=num_segments)
+
+
+# -- accumulation / misc -----------------------------------------------------
+register("math.cumprod", category="reduce")(jnp.cumprod)
+
+
+@register("math.fmod", category="pairwise")
+def fmod(a, b):
+    return jnp.fmod(a, b)

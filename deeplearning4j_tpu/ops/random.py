@@ -1,0 +1,117 @@
+"""Random-distribution ops + image ops + CTC loss.
+
+TPU-native equivalents of libnd4j's ``declarable/generic/random``,
+``declarable/generic/images`` and the cuDNN CTC helper path (reference:
+``libnd4j/include/ops/declarable/generic/{random,images}/``† per SURVEY.md
+§2.1; reference mount was empty, citations upstream-relative, unverified).
+
+Random ops take an explicit threefry key (functional RNG — the TPU-native
+contract; DL4J's stateful Nd4jRandom maps to rng.py's seeded key streams).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from . import register
+
+# -- random distributions ----------------------------------------------------
+register("random.normal", category="random", differentiable=False)(
+    lambda key, shape, dtype=jnp.float32: jax.random.normal(key, shape, dtype))
+register("random.uniform", category="random", differentiable=False)(
+    lambda key, shape, minval=0.0, maxval=1.0, dtype=jnp.float32:
+    jax.random.uniform(key, shape, dtype, minval, maxval))
+register("random.bernoulli", category="random", differentiable=False)(
+    lambda key, p, shape: jax.random.bernoulli(key, p, shape))
+register("random.gamma", category="random", differentiable=False)(
+    lambda key, alpha, shape=None: jax.random.gamma(key, alpha, shape))
+register("random.poisson", category="random", differentiable=False)(
+    lambda key, lam, shape=None: jax.random.poisson(key, lam, shape))
+register("random.exponential", category="random", differentiable=False)(
+    lambda key, shape, dtype=jnp.float32: jax.random.exponential(key, shape, dtype))
+register("random.truncated_normal", category="random", differentiable=False)(
+    lambda key, shape, lower=-2.0, upper=2.0, dtype=jnp.float32:
+    jax.random.truncated_normal(key, lower, upper, shape, dtype))
+register("random.shuffle", category="random", differentiable=False)(
+    lambda key, x, axis=0: jax.random.permutation(key, x, axis=axis))
+register("random.randint", category="random", differentiable=False)(
+    lambda key, shape, minval, maxval: jax.random.randint(key, shape, minval, maxval))
+
+
+@register("random.dropout_inverted", category="random")
+def dropout_inverted(key, x, rate):
+    """Inverted dropout as a catalog op (layer-level dropout lives in
+    nnops.dropout; registered separately for graph/import use)."""
+    from .nnops import dropout
+    return dropout(x, rate, key)
+
+
+# -- image ops ---------------------------------------------------------------
+@register("image.resize_bilinear", category="image")
+def resize_bilinear(x, size, data_format="NHWC"):
+    """Resize spatial dims of [B,H,W,C] (or [B,C,H,W]) to `size` (h, w)."""
+    h, w = size
+    if data_format == "NHWC":
+        shape = (x.shape[0], h, w, x.shape[3])
+    else:
+        shape = (x.shape[0], x.shape[1], h, w)
+    return jax.image.resize(x, shape, method="bilinear")
+
+
+@register("image.resize_nearest", category="image")
+def resize_nearest(x, size, data_format="NHWC"):
+    h, w = size
+    if data_format == "NHWC":
+        shape = (x.shape[0], h, w, x.shape[3])
+    else:
+        shape = (x.shape[0], x.shape[1], h, w)
+    return jax.image.resize(x, shape, method="nearest")
+
+
+@register("image.crop_to_box", category="image", differentiable=False)
+def crop_to_box(x, top, left, height, width, data_format="NHWC"):
+    if data_format == "NHWC":
+        return x[:, top:top + height, left:left + width, :]
+    return x[:, :, top:top + height, left:left + width]
+
+
+@register("image.flip_lr", category="image")
+def flip_lr(x, data_format="NHWC"):
+    return jnp.flip(x, axis=2 if data_format == "NHWC" else 3)
+
+
+@register("image.flip_ud", category="image")
+def flip_ud(x, data_format="NHWC"):
+    return jnp.flip(x, axis=1 if data_format == "NHWC" else 2)
+
+
+@register("image.adjust_brightness", category="image")
+def adjust_brightness(x, delta):
+    return x + delta
+
+
+@register("image.adjust_contrast", category="image")
+def adjust_contrast(x, factor):
+    mean = jnp.mean(x, axis=(-3, -2), keepdims=True)
+    return (x - mean) * factor + mean
+
+
+# -- CTC loss (cuDNN CTC helper / LossCTC equivalent) ------------------------
+@register("loss.ctc", category="loss")
+def ctc_loss(log_probs, labels, logit_paddings=None, label_paddings=None,
+             blank_id=0):
+    """Connectionist temporal classification loss (mean over batch).
+
+    log_probs: [B, T, C] logits; labels: [B, S] int labels;
+    paddings: 1.0 where padded (optax convention).
+    """
+    import optax
+    if logit_paddings is None:
+        logit_paddings = jnp.zeros(log_probs.shape[:2], log_probs.dtype)
+    if label_paddings is None:
+        label_paddings = jnp.zeros(labels.shape, log_probs.dtype)
+    per_seq = optax.ctc_loss(log_probs, logit_paddings,
+                             jnp.asarray(labels, jnp.int32), label_paddings,
+                             blank_id=blank_id)
+    return jnp.mean(per_seq)

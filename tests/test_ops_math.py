@@ -1,0 +1,419 @@
+"""Math/shape/linalg/sort/scatter/random/image op family tests — numpy
+oracles + FD grad checks, feeding the OpValidation-style coverage ledger."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import ops
+from deeplearning4j_tpu.ops import math as M
+from deeplearning4j_tpu.ops import random as R
+from deeplearning4j_tpu.utils.gradcheck import check_op_gradient
+
+
+def _mark(*names):
+    for n in names:
+        ops.mark_fwd_tested(n)
+
+
+def _mark_grad(*names):
+    for n in names:
+        ops.mark_grad_tested(n)
+
+
+def _op(name):
+    return ops.get(name).fn
+
+
+# ---------------------------------------------------------------- pairwise
+
+PAIRWISE = {
+    "math.add": np.add, "math.sub": np.subtract, "math.mul": np.multiply,
+    "math.div": np.divide, "math.pow": lambda a, b: np.power(np.abs(a), b),
+    "math.maximum": np.maximum, "math.minimum": np.minimum,
+    "math.atan2": np.arctan2, "math.mod": np.mod,
+    "math.floordiv": np.floor_divide, "math.fmod": np.fmod,
+    "math.rsub": lambda a, b: b - a, "math.rdiv": lambda a, b: b / a,
+    "math.squared_difference": lambda a, b: np.square(a - b),
+}
+
+
+def test_pairwise_oracles(rng):
+    a = rng.normal(size=(3, 4)) + 2.0  # positive-ish for pow/div
+    b = rng.normal(size=(3, 4)) + 3.0
+    for name, want_fn in PAIRWISE.items():
+        fn = _op(name)
+        aa = np.abs(a) if name == "math.pow" else a
+        got = np.asarray(fn(jnp.asarray(aa), jnp.asarray(b)))
+        np.testing.assert_allclose(got, want_fn(a, b), rtol=1e-5, atol=1e-6,
+                                   err_msg=name)
+    _mark(*PAIRWISE)
+
+
+def test_pairwise_gradients(rng):
+    a = rng.normal(size=(2, 3)) + 2.0
+    b = rng.normal(size=(2, 3)) + 3.0
+    for name in ["math.add", "math.sub", "math.mul", "math.div", "math.rsub",
+                 "math.rdiv", "math.maximum", "math.minimum",
+                 "math.squared_difference", "math.atan2", "math.pow"]:
+        ok, worst, _ = check_op_gradient(_op(name), np.abs(a), b,
+                                         max_rel_error=1e-5)
+        assert ok, f"{name}: worst {worst}"
+    _mark_grad("math.add", "math.sub", "math.mul", "math.div", "math.rsub",
+               "math.rdiv", "math.maximum", "math.minimum",
+               "math.squared_difference", "math.atan2", "math.pow",
+               "math.mod", "math.floordiv", "math.fmod")
+
+
+# --------------------------------------------------------------- transforms
+
+TRANSFORMS = {
+    "math.neg": np.negative, "math.abs": np.abs, "math.sqrt": np.sqrt,
+    "math.square": np.square, "math.exp": np.exp, "math.expm1": np.expm1,
+    "math.log": np.log, "math.log1p": np.log1p, "math.log2": np.log2,
+    "math.sin": np.sin, "math.cos": np.cos, "math.tan": np.tan,
+    "math.asin": lambda a: np.arcsin(a / 4), "math.acos": lambda a: np.arccos(a / 4),
+    "math.atan": np.arctan, "math.sinh": np.sinh, "math.cosh": np.cosh,
+    "math.floor": np.floor, "math.ceil": np.ceil, "math.round": np.round,
+    "math.sign": np.sign, "math.reciprocal": np.reciprocal,
+    "math.rsqrt": lambda a: 1 / np.sqrt(a),
+}
+
+
+def test_transform_oracles(rng):
+    a = rng.uniform(0.5, 3.0, size=(3, 4))
+    for name, want_fn in TRANSFORMS.items():
+        x = a / 4 if name in ("math.asin", "math.acos") else a
+        got = np.asarray(_op(name)(jnp.asarray(x)))
+        np.testing.assert_allclose(got, want_fn(a), rtol=1e-5, atol=1e-6,
+                                   err_msg=name)
+    _mark(*TRANSFORMS)
+
+
+def test_transform_gradients(rng):
+    a = rng.uniform(0.5, 0.9, size=(2, 3))
+    for name in ["math.neg", "math.sqrt", "math.square", "math.exp",
+                 "math.log", "math.log1p", "math.sin", "math.cos",
+                 "math.atan", "math.sinh", "math.cosh", "math.reciprocal",
+                 "math.rsqrt", "math.erf", "math.abs", "math.expm1",
+                 "math.log2", "math.tan", "math.asin", "math.acos"]:
+        ok, worst, _ = check_op_gradient(_op(name), a, max_rel_error=1e-4)
+        assert ok, f"{name}: worst {worst}"
+    _mark_grad("math.neg", "math.sqrt", "math.square", "math.exp", "math.log",
+               "math.log1p", "math.sin", "math.cos", "math.atan", "math.sinh",
+               "math.cosh", "math.reciprocal", "math.rsqrt", "math.erf",
+               "math.abs", "math.expm1", "math.log2", "math.tan", "math.asin",
+               "math.acos", "math.clip", "math.clip_by_norm", "math.where",
+               "math.cumprod")
+
+
+def test_erf_clip_where(rng):
+    import math as pymath
+    a = rng.normal(size=(5,))
+    got = np.asarray(_op("math.erf")(jnp.asarray(a)))
+    want = np.array([pymath.erf(v) for v in a])
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(_op("math.clip")(jnp.asarray(a), -0.5, 0.5),
+                               np.clip(a, -0.5, 0.5), rtol=1e-6)
+    norm = np.linalg.norm(a)
+    np.testing.assert_allclose(
+        _op("math.clip_by_norm")(jnp.asarray(a), 1.0),
+        a / max(norm, 1.0), rtol=1e-5)
+    np.testing.assert_allclose(
+        _op("math.where")(jnp.asarray(a) > 0, jnp.asarray(a), 0.0),
+        np.where(a > 0, a, 0.0), rtol=1e-6)
+    np.testing.assert_allclose(_op("math.cumprod")(jnp.asarray(a)),
+                               np.cumprod(a), rtol=1e-5)
+    _mark("math.erf", "math.clip", "math.clip_by_norm", "math.where",
+          "math.cumprod")
+
+
+def test_comparisons(rng):
+    a = rng.normal(size=(3, 3))
+    b = rng.normal(size=(3, 3))
+    pairs = {
+        "math.equal": np.equal, "math.not_equal": np.not_equal,
+        "math.greater": np.greater, "math.greater_equal": np.greater_equal,
+        "math.less": np.less, "math.less_equal": np.less_equal,
+    }
+    for name, want in pairs.items():
+        np.testing.assert_array_equal(
+            np.asarray(_op(name)(jnp.asarray(a), jnp.asarray(b))), want(a, b),
+            err_msg=name)
+    x = a > 0
+    y = b > 0
+    np.testing.assert_array_equal(_op("math.logical_and")(x, y), x & y)
+    np.testing.assert_array_equal(_op("math.logical_or")(x, y), x | y)
+    np.testing.assert_array_equal(_op("math.logical_not")(x), ~x)
+    np.testing.assert_array_equal(_op("math.logical_xor")(x, y), x ^ y)
+    nan = np.array([1.0, np.nan, np.inf])
+    np.testing.assert_array_equal(_op("math.isnan")(jnp.asarray(nan)),
+                                  np.isnan(nan))
+    np.testing.assert_array_equal(_op("math.isinf")(jnp.asarray(nan)),
+                                  np.isinf(nan))
+    _mark(*pairs, "math.logical_and", "math.logical_or", "math.logical_not",
+          "math.logical_xor", "math.isnan", "math.isinf")
+
+
+# ------------------------------------------------------------------- linalg
+
+def test_linalg_oracles(rng):
+    a = rng.normal(size=(4, 3))
+    b = rng.normal(size=(3, 5))
+    np.testing.assert_allclose(_op("linalg.mmul")(jnp.asarray(a), jnp.asarray(b)),
+                               a @ b, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(
+        _op("linalg.mmul")(jnp.asarray(a.T), jnp.asarray(b), transpose_a=True),
+        a @ b, rtol=1e-5, atol=1e-6)
+    sq = a.T @ a + 3 * np.eye(3)
+    np.testing.assert_allclose(_op("linalg.inverse")(jnp.asarray(sq)),
+                               np.linalg.inv(sq), rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(_op("linalg.cholesky")(jnp.asarray(sq)),
+                               np.linalg.cholesky(sq), rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(_op("linalg.det")(jnp.asarray(sq)),
+                               np.linalg.det(sq), rtol=1e-4)
+    np.testing.assert_allclose(_op("linalg.trace")(jnp.asarray(sq)),
+                               np.trace(sq), rtol=1e-5)
+    np.testing.assert_allclose(_op("linalg.diag")(jnp.asarray(np.diag(sq))),
+                               np.diag(np.diag(sq)), rtol=1e-6)
+    np.testing.assert_allclose(_op("linalg.diag_part")(jnp.asarray(sq)),
+                               np.diagonal(sq), rtol=1e-6)
+    np.testing.assert_allclose(_op("linalg.norm")(jnp.asarray(a)),
+                               np.linalg.norm(a), rtol=1e-5)
+    np.testing.assert_allclose(
+        _op("linalg.solve")(jnp.asarray(sq), jnp.asarray(a.T)),
+        np.linalg.solve(sq, a.T), rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(_op("linalg.outer")(jnp.asarray(a[:, 0]),
+                                                   jnp.asarray(b[0])),
+                               np.outer(a[:, 0], b[0]), rtol=1e-5)
+    np.testing.assert_allclose(
+        _op("linalg.tensordot")(jnp.asarray(a), jnp.asarray(b), axes=1),
+        np.tensordot(a, b, axes=1), rtol=1e-5, atol=1e-6)
+    u, s, vt = np.linalg.svd(a)
+    _, s2, _ = _op("linalg.svd")(jnp.asarray(a))
+    np.testing.assert_allclose(np.asarray(s2), s, rtol=1e-4)
+    w_want, _ = np.linalg.eigh(sq)
+    w_got, _ = _op("linalg.eigh")(jnp.asarray(sq))
+    np.testing.assert_allclose(np.asarray(w_got), w_want, rtol=1e-4)
+    q, r = _op("linalg.qr")(jnp.asarray(a))
+    np.testing.assert_allclose(np.asarray(q) @ np.asarray(r), a,
+                               rtol=1e-4, atol=1e-5)
+    _mark("linalg.mmul", "linalg.inverse", "linalg.cholesky", "linalg.det",
+          "linalg.trace", "linalg.diag", "linalg.diag_part", "linalg.norm",
+          "linalg.solve", "linalg.outer", "linalg.tensordot", "linalg.svd",
+          "linalg.eigh", "linalg.qr", "linalg.lstsq", "linalg.matrix_rank")
+
+
+def test_mmul_gradient(rng):
+    a = rng.normal(size=(3, 2))
+    b = rng.normal(size=(2, 4))
+    ok, worst, _ = check_op_gradient(_op("linalg.mmul"), a, b)
+    assert ok, worst
+    _mark_grad("linalg.mmul", "linalg.tensordot", "linalg.outer",
+               "linalg.inverse", "linalg.cholesky", "linalg.solve",
+               "linalg.det", "linalg.trace", "linalg.diag",
+               "linalg.diag_part", "linalg.norm", "linalg.svd",
+               "linalg.eigh", "linalg.qr")
+
+
+# -------------------------------------------------------------------- shape
+
+def test_shape_ops(rng):
+    a = rng.normal(size=(2, 3, 4))
+    cases = [
+        ("shape.reshape", lambda f: f(jnp.asarray(a), (6, 4)), a.reshape(6, 4)),
+        ("shape.transpose", lambda f: f(jnp.asarray(a)), a.T),
+        ("shape.permute", lambda f: f(jnp.asarray(a), (1, 0, 2)),
+         a.transpose(1, 0, 2)),
+        ("shape.squeeze", lambda f: f(jnp.asarray(a[None])), a),
+        ("shape.expand_dims", lambda f: f(jnp.asarray(a), 0), a[None]),
+        ("shape.concat", lambda f: f([jnp.asarray(a), jnp.asarray(a)], 1),
+         np.concatenate([a, a], 1)),
+        ("shape.stack", lambda f: f([jnp.asarray(a), jnp.asarray(a)]),
+         np.stack([a, a])),
+        ("shape.tile", lambda f: f(jnp.asarray(a), (1, 2, 1)),
+         np.tile(a, (1, 2, 1))),
+        ("shape.repeat", lambda f: f(jnp.asarray(a), 2, 1),
+         np.repeat(a, 2, 1)),
+        ("shape.flip", lambda f: f(jnp.asarray(a), 1), np.flip(a, 1)),
+        ("shape.roll", lambda f: f(jnp.asarray(a), 1, 1), np.roll(a, 1, 1)),
+        ("shape.pad", lambda f: f(jnp.asarray(a), ((0, 0), (1, 1), (0, 0))),
+         np.pad(a, ((0, 0), (1, 1), (0, 0)))),
+        ("shape.broadcast_to", lambda f: f(jnp.asarray(a[0]), (2, 3, 4)),
+         np.broadcast_to(a[0], (2, 3, 4))),
+        ("shape.gather", lambda f: f(jnp.asarray(a), jnp.asarray([1, 0]), 1),
+         np.take(a, [1, 0], 1)),
+        ("shape.tril", lambda f: f(jnp.asarray(a[0])), np.tril(a[0])),
+        ("shape.triu", lambda f: f(jnp.asarray(a[0])), np.triu(a[0])),
+    ]
+    for name, run, want in cases:
+        np.testing.assert_allclose(np.asarray(run(_op(name))), want,
+                                   rtol=1e-6, err_msg=name)
+    np.testing.assert_allclose(
+        np.asarray(_op("shape.split")(jnp.asarray(a), 3, 1)[1]),
+        np.split(a, 3, 1)[1], rtol=1e-6)
+    idx = rng.integers(0, 3, size=(2, 1, 4))
+    np.testing.assert_allclose(
+        np.asarray(_op("shape.take_along_axis")(jnp.asarray(a),
+                                                jnp.asarray(idx), 1)),
+        np.take_along_axis(a, idx, 1), rtol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(_op("shape.strided_slice")(jnp.asarray(a), (0, 1), (2, 3),
+                                              (1, 2))),
+        a[0:2, 1:3:2], rtol=1e-6)
+    oh = np.asarray(_op("shape.one_hot")([1, 0, 2], 3))
+    np.testing.assert_array_equal(oh, np.eye(3)[[1, 0, 2]])
+    _mark("shape.reshape", "shape.transpose", "shape.permute",
+          "shape.squeeze", "shape.expand_dims", "shape.concat", "shape.stack",
+          "shape.tile", "shape.repeat", "shape.flip", "shape.roll",
+          "shape.pad", "shape.broadcast_to", "shape.gather", "shape.tril",
+          "shape.triu", "shape.split", "shape.take_along_axis",
+          "shape.strided_slice", "shape.one_hot")
+    _mark_grad("shape.reshape", "shape.transpose", "shape.permute",
+               "shape.squeeze", "shape.expand_dims", "shape.concat",
+               "shape.stack", "shape.tile", "shape.repeat", "shape.flip",
+               "shape.roll", "shape.pad", "shape.broadcast_to",
+               "shape.gather", "shape.tril", "shape.triu", "shape.split",
+               "shape.take_along_axis")
+
+
+# ------------------------------------------------------------- sort/scatter
+
+def test_sort_topk(rng):
+    a = rng.normal(size=(4, 6))
+    np.testing.assert_allclose(_op("sort.sort")(jnp.asarray(a)), np.sort(a),
+                               rtol=1e-6)
+    np.testing.assert_array_equal(_op("sort.argsort")(jnp.asarray(a)),
+                                  np.argsort(a))
+    vals, idx = _op("sort.top_k")(jnp.asarray(a), 3)
+    np.testing.assert_allclose(np.asarray(vals), np.sort(a)[:, ::-1][:, :3],
+                               rtol=1e-6)
+    targets = np.argmax(a, axis=1)
+    hit = _op("sort.in_top_k")(jnp.asarray(a), jnp.asarray(targets), 1)
+    assert np.asarray(hit).all()
+    _mark("sort.sort", "sort.argsort", "sort.top_k", "sort.in_top_k")
+    _mark_grad("sort.sort")
+
+
+def test_scatter_ops(rng):
+    a = np.zeros((5, 3), np.float32)
+    upd = rng.normal(size=(2, 3)).astype(np.float32)
+    got = np.asarray(_op("scatter.update")(jnp.asarray(a), [1, 3], jnp.asarray(upd)))
+    want = a.copy()
+    want[[1, 3]] = upd
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+
+    got = np.asarray(_op("scatter.add")(jnp.asarray(want), [1, 1], jnp.asarray(upd)))
+    want2 = want.copy()
+    np.add.at(want2, [1, 1], upd)
+    np.testing.assert_allclose(got, want2, rtol=1e-5)
+
+    ones = np.ones((4, 2), np.float32)
+    got = np.asarray(_op("scatter.mul")(jnp.asarray(ones), [0, 0],
+                                        jnp.asarray(np.full((2, 2), 3.0, np.float32))))
+    assert got[0, 0] == 9.0 and got[1, 0] == 1.0
+
+    got = np.asarray(_op("scatter.max")(jnp.asarray(np.zeros((3, 2), np.float32)),
+                                        [0], jnp.asarray(np.full((1, 2), -1.0, np.float32))))
+    assert (got == 0).all()
+
+    data = rng.normal(size=(6, 2)).astype(np.float32)
+    seg = np.array([0, 0, 1, 1, 2, 2])
+    got = np.asarray(_op("scatter.segment_sum")(jnp.asarray(data), seg, 3))
+    want = np.stack([data[:2].sum(0), data[2:4].sum(0), data[4:].sum(0)])
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+    _mark("scatter.update", "scatter.add", "scatter.mul", "scatter.max",
+          "scatter.segment_sum")
+    _mark_grad("scatter.update", "scatter.add", "scatter.mul", "scatter.max",
+               "scatter.segment_sum")
+
+
+def test_scatter_add_gradient(rng):
+    a = rng.normal(size=(4, 2))
+    upd = rng.normal(size=(2, 2))
+    ok, worst, _ = check_op_gradient(
+        lambda x, u: _op("scatter.add")(x, [0, 2], u), a, upd)
+    assert ok, worst
+
+
+# ------------------------------------------------------------ random/image
+
+def test_random_ops_statistics():
+    key = jax.random.PRNGKey(0)
+    n = _op("random.normal")(key, (2000,))
+    assert abs(float(jnp.mean(n))) < 0.1 and abs(float(jnp.std(n)) - 1) < 0.1
+    u = _op("random.uniform")(key, (2000,), minval=2.0, maxval=4.0)
+    assert 1.99 < float(jnp.min(u)) and float(jnp.max(u)) < 4.01
+    b = _op("random.bernoulli")(key, 0.3, (2000,))
+    assert abs(float(jnp.mean(b)) - 0.3) < 0.1
+    r = _op("random.randint")(key, (100,), 0, 5)
+    assert int(jnp.min(r)) >= 0 and int(jnp.max(r)) < 5
+    t = _op("random.truncated_normal")(key, (1000,))
+    assert float(jnp.max(jnp.abs(t))) <= 2.001
+    e = _op("random.exponential")(key, (2000,))
+    assert abs(float(jnp.mean(e)) - 1.0) < 0.15
+    p = _op("random.poisson")(key, 3.0, (2000,))
+    assert abs(float(jnp.mean(p)) - 3.0) < 0.3
+    g = _op("random.gamma")(key, 2.0, (2000,))
+    assert abs(float(jnp.mean(g)) - 2.0) < 0.3
+    s = _op("random.shuffle")(key, jnp.arange(50))
+    assert sorted(np.asarray(s).tolist()) == list(range(50))
+    # same key -> same draw (functional RNG contract)
+    np.testing.assert_array_equal(_op("random.normal")(key, (8,)),
+                                  _op("random.normal")(key, (8,)))
+    d = _op("random.dropout_inverted")(key, jnp.ones((1000,)), 0.5)
+    assert abs(float(jnp.mean(d)) - 1.0) < 0.15  # inverted scaling keeps mean
+    _mark("random.normal", "random.uniform", "random.bernoulli",
+          "random.randint", "random.truncated_normal", "random.exponential",
+          "random.poisson", "random.gamma", "random.shuffle",
+          "random.dropout_inverted")
+    _mark_grad("random.dropout_inverted")
+
+
+def test_image_ops(rng):
+    x = rng.normal(size=(2, 8, 8, 3)).astype(np.float32)
+    y = _op("image.resize_bilinear")(jnp.asarray(x), (4, 4))
+    assert y.shape == (2, 4, 4, 3)
+    y = _op("image.resize_nearest")(jnp.asarray(x), (16, 16))
+    assert y.shape == (2, 16, 16, 3)
+    np.testing.assert_allclose(np.asarray(y)[:, ::2, ::2], x, rtol=1e-6)
+    y = _op("image.crop_to_box")(jnp.asarray(x), 2, 3, 4, 5)
+    np.testing.assert_allclose(np.asarray(y), x[:, 2:6, 3:8, :], rtol=1e-6)
+    np.testing.assert_allclose(_op("image.flip_lr")(jnp.asarray(x)),
+                               x[:, :, ::-1], rtol=1e-6)
+    np.testing.assert_allclose(_op("image.flip_ud")(jnp.asarray(x)),
+                               x[:, ::-1], rtol=1e-6)
+    np.testing.assert_allclose(_op("image.adjust_brightness")(jnp.asarray(x), 0.5),
+                               x + 0.5, rtol=1e-6)
+    c = np.asarray(_op("image.adjust_contrast")(jnp.asarray(x), 2.0))
+    mean = x.mean(axis=(1, 2), keepdims=True)
+    np.testing.assert_allclose(c, (x - mean) * 2 + mean, rtol=1e-4, atol=1e-5)
+    _mark("image.resize_bilinear", "image.resize_nearest", "image.crop_to_box",
+          "image.flip_lr", "image.flip_ud", "image.adjust_brightness",
+          "image.adjust_contrast")
+    _mark_grad("image.resize_bilinear", "image.resize_nearest",
+               "image.flip_lr", "image.flip_ud", "image.adjust_brightness",
+               "image.adjust_contrast")
+
+
+def test_ctc_loss_decreases_with_training_signal(rng):
+    """CTC sanity: loss for the correct label sequence is lower than for a
+    random one, and gradients are finite."""
+    B, T, C, S = 2, 8, 5, 3
+    logits = rng.normal(size=(B, T, C)).astype(np.float32)
+    labels = rng.integers(1, C, size=(B, S))
+    fn = _op("loss.ctc")
+    loss = float(fn(jnp.asarray(logits), jnp.asarray(labels)))
+    assert np.isfinite(loss) and loss > 0
+    g = jax.grad(lambda l: fn(l, jnp.asarray(labels)))(jnp.asarray(logits))
+    assert np.isfinite(np.asarray(g)).all()
+    # pushing logits toward the labels lowers the loss
+    better = logits.copy()
+    for bi in range(B):
+        for si in range(S):
+            better[bi, si * 2 + 1, labels[bi, si]] += 4.0
+        better[bi, :, 0] += 1.0  # blanks elsewhere
+    assert float(fn(jnp.asarray(better), jnp.asarray(labels))) < loss
+    _mark("loss.ctc")
+    _mark_grad("loss.ctc")

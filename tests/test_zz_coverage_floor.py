@@ -10,8 +10,10 @@ import pytest
 
 import deeplearning4j_tpu.ops as ops
 
-FWD_FLOOR = 0.50
-GRAD_FLOOR = 0.35
+# Ratcheted each round (r1: 0.50/0.35; r2: 0.80/0.60 after the math/shape/
+# linalg/sort/scatter/random/image families landed with oracle tests).
+FWD_FLOOR = 0.80
+GRAD_FLOOR = 0.60
 
 
 def test_coverage_floor():
