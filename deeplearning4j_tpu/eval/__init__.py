@@ -1,0 +1,3 @@
+from .binary import EvaluationBinary, EvaluationCalibration  # noqa: F401
+from .evaluation import Evaluation, RegressionEvaluation  # noqa: F401
+from .roc import ROC, ROCBinary, ROCMultiClass  # noqa: F401

@@ -1,0 +1,209 @@
+"""Multi-label binary evaluation + probability calibration.
+
+TPU-native equivalent of nd4j's ``EvaluationBinary`` and
+``EvaluationCalibration`` (reference: ``nd4j-api .../evaluation/
+classification/{EvaluationBinary,EvaluationCalibration}.java``† per
+SURVEY.md §2.2; reference mount was empty, citations upstream-relative,
+unverified).
+
+Both accumulate O(columns) / O(bins) counts host-side — constant memory for
+streaming over arbitrarily large eval sets; the device work is the forward
+pass that produced the probabilities.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+
+class EvaluationBinary:
+    """Per-output-column binary classification stats at a decision
+    threshold (default 0.5), for multi-label sigmoid heads. Matches DL4J:
+    each column is an independent binary problem with its own
+    TP/FP/TN/FN counts."""
+
+    def __init__(self, n_columns: Optional[int] = None,
+                 decision_threshold: float = 0.5):
+        self.threshold = float(decision_threshold)
+        self._tp = self._fp = self._tn = self._fn = None
+        if n_columns:
+            self._alloc(n_columns)
+
+    def _alloc(self, k: int):
+        z = np.zeros(k, dtype=np.int64)
+        self._tp, self._fp, self._tn, self._fn = (z.copy(), z.copy(),
+                                                  z.copy(), z.copy())
+
+    def eval(self, labels, predictions, mask=None):
+        l = np.asarray(labels, dtype=np.float32)
+        p = np.asarray(predictions, dtype=np.float32)
+        l = l.reshape(-1, l.shape[-1])
+        p = p.reshape(-1, p.shape[-1])
+        if mask is not None:
+            m = np.asarray(mask)
+            if m.ndim == l.ndim and m.shape == l.shape:
+                # per-output mask: zero-out excluded entries from all counts
+                mm = m.reshape(l.shape).astype(bool)
+            else:
+                mm = np.broadcast_to(
+                    m.ravel().astype(bool)[:, None], l.shape)
+            keep = mm
+        else:
+            keep = np.ones(l.shape, dtype=bool)
+        if self._tp is None:
+            self._alloc(l.shape[-1])
+        pred = p >= self.threshold
+        true = l > 0.5
+        self._tp += ((pred & true) & keep).sum(0)
+        self._fp += ((pred & ~true) & keep).sum(0)
+        self._fn += ((~pred & true) & keep).sum(0)
+        self._tn += ((~pred & ~true) & keep).sum(0)
+        return self
+
+    def num_labels(self) -> int:
+        return 0 if self._tp is None else self._tp.size
+
+    def _per(self, num, den):
+        with np.errstate(divide="ignore", invalid="ignore"):
+            return np.where(den > 0, num / np.maximum(den, 1), np.nan)
+
+    def accuracy(self, col: Optional[int] = None) -> float:
+        tot = self._tp + self._fp + self._tn + self._fn
+        per = self._per(self._tp + self._tn, tot)
+        return float(np.nanmean(per) if col is None else per[col])
+
+    def precision(self, col: Optional[int] = None) -> float:
+        per = self._per(self._tp, self._tp + self._fp)
+        return float(np.nanmean(per) if col is None else per[col])
+
+    def recall(self, col: Optional[int] = None) -> float:
+        per = self._per(self._tp, self._tp + self._fn)
+        return float(np.nanmean(per) if col is None else per[col])
+
+    def f1(self, col: Optional[int] = None) -> float:
+        p2 = self._per(self._tp, self._tp + self._fp)
+        r2 = self._per(self._tp, self._tp + self._fn)
+        with np.errstate(divide="ignore", invalid="ignore"):
+            f = np.where((p2 + r2) > 0, 2 * p2 * r2 / (p2 + r2), 0.0)
+        return float(np.nanmean(f) if col is None else f[col])
+
+    def true_positives(self, col: int) -> int:
+        return int(self._tp[col])
+
+    def false_positives(self, col: int) -> int:
+        return int(self._fp[col])
+
+    def true_negatives(self, col: int) -> int:
+        return int(self._tn[col])
+
+    def false_negatives(self, col: int) -> int:
+        return int(self._fn[col])
+
+    def stats(self) -> str:
+        k = self.num_labels()
+        lines = [f"EvaluationBinary: {k} labels @ threshold "
+                 f"{self.threshold}",
+                 f"{'label':>6} {'acc':>8} {'prec':>8} {'rec':>8} {'f1':>8}"]
+        for i in range(k):
+            lines.append(f"{i:>6} {self.accuracy(i):>8.4f} "
+                         f"{self.precision(i):>8.4f} {self.recall(i):>8.4f} "
+                         f"{self.f1(i):>8.4f}")
+        lines.append(f"{'macro':>6} {self.accuracy():>8.4f} "
+                     f"{self.precision():>8.4f} {self.recall():>8.4f} "
+                     f"{self.f1():>8.4f}")
+        return "\n".join(lines)
+
+
+class EvaluationCalibration:
+    """Probability-calibration evaluation: reliability diagram bins,
+    per-class prediction-probability histograms, residual histograms, and
+    expected calibration error. DL4J ``EvaluationCalibration`` with the same
+    three artifacts (reliability / residual / probability histogram)."""
+
+    def __init__(self, reliability_bins: int = 10, histogram_bins: int = 50):
+        self.n_bins = int(reliability_bins)
+        self.hist_bins = int(histogram_bins)
+        self._bin_count = None      # [classes, bins]
+        self._bin_pos = None        # label==class count per bin
+        self._bin_prob_sum = None   # sum of predicted prob per bin
+        self._residual_hist = None  # [hist_bins] of |label - prob|
+        self._prob_hist = None      # [classes, hist_bins]
+
+    def _alloc(self, k: int):
+        self._bin_count = np.zeros((k, self.n_bins), dtype=np.int64)
+        self._bin_pos = np.zeros((k, self.n_bins), dtype=np.int64)
+        self._bin_prob_sum = np.zeros((k, self.n_bins), dtype=np.float64)
+        self._residual_hist = np.zeros(self.hist_bins, dtype=np.int64)
+        self._prob_hist = np.zeros((k, self.hist_bins), dtype=np.int64)
+
+    def eval(self, labels, predictions, mask=None):
+        l = np.asarray(labels, dtype=np.float32)
+        p = np.asarray(predictions, dtype=np.float32)
+        p = p.reshape(-1, p.shape[-1])
+        l = l.reshape(-1, l.shape[-1]) if l.ndim > 1 else \
+            np.eye(p.shape[-1], dtype=np.float32)[l.astype(np.int64).ravel()]
+        if mask is not None:
+            m = np.asarray(mask).ravel().astype(bool)
+            l, p = l[m], p[m]
+        k = p.shape[-1]
+        if self._bin_count is None:
+            self._alloc(k)
+        bins = np.clip((p * self.n_bins).astype(np.int64), 0, self.n_bins - 1)
+        hbins = np.clip((p * self.hist_bins).astype(np.int64), 0,
+                        self.hist_bins - 1)
+        pos = l > 0.5
+        for c in range(k):
+            np.add.at(self._bin_count[c], bins[:, c], 1)
+            np.add.at(self._bin_pos[c], bins[:, c], pos[:, c])
+            np.add.at(self._bin_prob_sum[c], bins[:, c], p[:, c])
+            np.add.at(self._prob_hist[c], hbins[:, c], 1)
+        res = np.abs(l - p).ravel()
+        rbins = np.clip((res * self.hist_bins).astype(np.int64), 0,
+                        self.hist_bins - 1)
+        np.add.at(self._residual_hist, rbins, 1)
+        return self
+
+    def reliability_diagram(self, cls: int):
+        """-> (mean_predicted_prob[bins], observed_frequency[bins]);
+        NaN where a bin is empty."""
+        cnt = self._bin_count[cls]
+        with np.errstate(divide="ignore", invalid="ignore"):
+            mean_p = np.where(cnt > 0,
+                              self._bin_prob_sum[cls] / np.maximum(cnt, 1),
+                              np.nan)
+            freq = np.where(cnt > 0,
+                            self._bin_pos[cls] / np.maximum(cnt, 1), np.nan)
+        return mean_p, freq
+
+    def expected_calibration_error(self, cls: Optional[int] = None) -> float:
+        """Weighted |confidence - accuracy| over bins (standard ECE)."""
+        if cls is not None:
+            classes = [cls]
+        else:
+            classes = range(self._bin_count.shape[0])
+        total_err, total_n = 0.0, 0
+        for c in classes:
+            cnt = self._bin_count[c]
+            n = cnt.sum()
+            if n == 0:
+                continue
+            mean_p, freq = self.reliability_diagram(c)
+            valid = cnt > 0
+            total_err += float(np.sum(
+                cnt[valid] * np.abs(mean_p[valid] - freq[valid])))
+            total_n += int(n)
+        return total_err / max(total_n, 1)
+
+    def residual_plot(self):
+        """-> histogram counts of |label - prob| over [0,1]."""
+        return self._residual_hist.copy()
+
+    def probability_histogram(self, cls: int):
+        return self._prob_hist[cls].copy()
+
+    def stats(self) -> str:
+        return (f"EvaluationCalibration: {self._bin_count.shape[0]} classes, "
+                f"{self.n_bins} reliability bins, "
+                f"ECE={self.expected_calibration_error():.4f}")

@@ -311,6 +311,8 @@ class ComputationGraph:
         for name in self._topo:
             v, _ = self._vertex_map[name]
             lyr = v.layer if isinstance(v, LayerVertex) else None
+            if getattr(lyr, "frozen", False):
+                continue  # FrozenLayer: no updates of any kind (DL4J)
             l1 = (getattr(lyr, "l1", 0.0) or self.conf.l1) if lyr else self.conf.l1
             l2 = (getattr(lyr, "l2", 0.0) or self.conf.l2) if lyr else self.conf.l2
             if not (l1 or l2):

@@ -1,2 +1,2 @@
 from .base import LAYERS, Layer  # noqa: F401
-from . import conv, core  # noqa: F401
+from . import conv, core, wrappers  # noqa: F401

@@ -1,0 +1,148 @@
+"""Wrapper layers: Frozen (transfer learning), TimeDistributed, RepeatVector.
+
+TPU-native equivalents of DL4J's wrapper/misc layer configs (reference:
+``deeplearning4j-nn .../nn/conf/layers/misc/FrozenLayer.java``,
+``.../recurrent/TimeDistributed.java``, ``.../misc/RepeatVector.java``† per
+SURVEY.md §2.4; reference mount was empty, citations upstream-relative,
+unverified).
+
+Freezing is functional here: ``FrozenLayer.apply`` routes the wrapped
+layer's parameters through ``lax.stop_gradient``, so the single fused train
+step computes exactly-zero gradients for them — XLA dead-code-eliminates
+the frozen backward graph, which is *cheaper* than DL4J's approach of
+running the backward pass and discarding the update. The engines also skip
+frozen layers in the regularization penalty (DL4J FrozenLayer semantics:
+no updates of any kind).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from .base import Layer, layer
+
+
+@layer("frozen")
+class FrozenLayer(Layer):
+    """Wraps any layer; parameters are excluded from training."""
+    layer: Any = None
+    name: Optional[str] = None
+
+    frozen = True
+
+    def has_params(self):
+        return self.layer.has_params()
+
+    def initialize(self, key, input_shape, dtype):
+        return self.layer.initialize(key, input_shape, dtype)
+
+    def apply(self, params, x, state, *, train=False, rng=None, mask=None):
+        p = jax.tree.map(jax.lax.stop_gradient, params)
+        # train=False inside the frozen stack: BN uses running stats and
+        # dropout is disabled, matching DL4J (a frozen layer behaves as at
+        # inference even during fit)
+        return self.layer.apply(p, x, state, train=False, rng=rng, mask=mask)
+
+    # recurrent protocol delegation (freezing an LSTM keeps streaming usable)
+    def is_recurrent(self):
+        return getattr(self.layer, "is_recurrent", lambda: False)()
+
+    @property
+    def supports_streaming(self):
+        return getattr(self.layer, "supports_streaming", True)
+
+    def init_stream_state(self, params, batch):
+        return self.layer.init_stream_state(params, batch)
+
+    def scan_with_state(self, params, x, carry, mask=None):
+        p = jax.tree.map(jax.lax.stop_gradient, params)
+        return self.layer.scan_with_state(p, x, carry, mask)
+
+    def loss_value(self, out, y, mask=None, weights=None):
+        return self.layer.loss_value(out, y, mask=mask, weights=weights)
+
+    def to_dict(self):
+        return {"kind": "frozen", "layer": self.layer.to_dict(),
+                "name": self.name}
+
+    @staticmethod
+    def _from_dict_fields(d):
+        return {"layer": Layer.from_dict(d["layer"]), "name": d.get("name")}
+
+
+@layer("repeat_vector")
+class RepeatVector(Layer):
+    """[B,F] -> [B,n,F] (DL4J ``RepeatVector``): bridge feed-forward
+    encodings into recurrent decoders."""
+    n: int = 1
+    name: Optional[str] = None
+
+    def has_params(self):
+        return False
+
+    def initialize(self, key, input_shape, dtype):
+        if len(input_shape) != 1:
+            raise ValueError(f"RepeatVector expects [F], got {input_shape}")
+        return {}, {}, (self.n, input_shape[0])
+
+    def apply(self, params, x, state, *, train=False, rng=None, mask=None):
+        y = jnp.repeat(x[:, None, :], self.n, axis=1)
+        return y, {}, None  # fresh time axis: no inherited feature mask
+
+
+@layer("time_distributed")
+class TimeDistributed(Layer):
+    """Apply a feed-forward layer independently at every timestep of
+    [B,T,F] input (DL4J ``TimeDistributed``). Implemented by folding time
+    into the batch — one big matmul instead of T small ones (MXU-friendly;
+    DL4J's RnnToFeedForwardPreProcessor does the same reshape)."""
+    layer: Any = None
+    name: Optional[str] = None
+
+    def has_params(self):
+        return self.layer.has_params()
+
+    def initialize(self, key, input_shape, dtype):
+        if len(input_shape) != 2:
+            raise ValueError(f"TimeDistributed expects [T,F], got {input_shape}")
+        t, f = input_shape
+        p, s, out = self.layer.initialize(key, (f,), dtype)
+        return p, s, (t,) + tuple(out)
+
+    def apply(self, params, x, state, *, train=False, rng=None, mask=None):
+        b, t = x.shape[0], x.shape[1]
+        y, s_new, _ = self.layer.apply(
+            params, x.reshape((b * t,) + x.shape[2:]), state,
+            train=train, rng=rng, mask=None)
+        y = y.reshape((b, t) + y.shape[1:])
+        return y, s_new, mask  # per-timestep mask flows through unchanged
+
+    def to_dict(self):
+        return {"kind": "time_distributed", "layer": self.layer.to_dict(),
+                "name": self.name}
+
+    @staticmethod
+    def _from_dict_fields(d):
+        return {"layer": Layer.from_dict(d["layer"]), "name": d.get("name")}
+
+
+@layer("mask_layer")
+class MaskLayer(Layer):
+    """Zero out activations at masked timesteps (DL4J ``MaskLayer``):
+    makes the mask explicit in the activations so downstream global pooling
+    or loss layers see hard zeros."""
+    name: Optional[str] = None
+
+    def has_params(self):
+        return False
+
+    def apply(self, params, x, state, *, train=False, rng=None, mask=None):
+        if mask is None:
+            return x, {}, None
+        m = mask
+        while m.ndim < x.ndim:
+            m = m[..., None]
+        return x * m.astype(x.dtype), {}, mask

@@ -138,6 +138,8 @@ class MultiLayerNetwork:
         """Per-layer l1/l2 on weights (DL4J regularizes W, not b, by default)."""
         total = 0.0
         for i, layer in enumerate(self.layers):
+            if getattr(layer, "frozen", False):
+                continue  # FrozenLayer: no updates of any kind (DL4J)
             l1 = getattr(layer, "l1", 0.0) or self.conf.l1
             l2 = getattr(layer, "l2", 0.0) or self.conf.l2
             if not (l1 or l2):

@@ -1,0 +1,11 @@
+from .earlystopping import (  # noqa: F401
+    BestScoreEpochTerminationCondition, ClassificationScoreCalculator,
+    DataSetLossCalculator, EarlyStoppingConfiguration, EarlyStoppingResult,
+    EarlyStoppingTrainer, InMemoryModelSaver,
+    InvalidScoreIterationTerminationCondition, LocalFileModelSaver,
+    MaxEpochsTerminationCondition, MaxScoreIterationTerminationCondition,
+    MaxTimeIterationTerminationCondition,
+    ScoreImprovementEpochTerminationCondition)
+from .listeners import (  # noqa: F401
+    CheckpointListener, CollectScoresListener, EvaluativeListener,
+    PerformanceListener, ScoreIterationListener, TrainingListener)

@@ -1,0 +1,358 @@
+"""Early stopping.
+
+TPU-native equivalent of DL4J's early-stopping package (reference:
+``deeplearning4j-nn .../earlystopping/{EarlyStoppingConfiguration,
+trainer/EarlyStoppingTrainer,EarlyStoppingResult}.java``† per SURVEY.md
+§2.5; reference mount was empty, citations upstream-relative, unverified).
+
+Same contract as the reference: fit epoch-by-epoch, score on a held-out set
+with a ScoreCalculator every ``evaluate_every_n_epochs``, keep the best model
+via a ModelSaver, and stop on the first satisfied termination condition
+(epoch-level checked after each epoch's score; iteration-level checked
+inside the fit loop through a listener). The result always carries the
+best model restored from the saver.
+
+Works with both engines (MultiLayerNetwork and ComputationGraph) — both
+expose ``fit/score/save`` and the in-memory snapshot round-trips through the
+same ZIP serializer bytes.
+"""
+
+from __future__ import annotations
+
+import io
+import time
+import zipfile
+from typing import Any, Callable, List, Optional
+
+import numpy as np
+
+
+# ---------------------------------------------------------------- snapshots
+def _model_to_bytes(model) -> bytes:
+    from ..utils.serializer import save_model
+    buf = io.BytesIO()
+    save_model(model, buf)
+    return buf.getvalue()
+
+
+def _model_from_bytes(data: bytes):
+    from ..utils.serializer import load_model
+    return load_model(io.BytesIO(data))
+
+
+class InMemoryModelSaver:
+    """Keeps the best/latest model as serialized bytes (DL4J
+    ``InMemoryModelSaver`` keeps a clone; bytes give the same isolation
+    without aliasing device buffers)."""
+
+    def __init__(self):
+        self._best: Optional[bytes] = None
+        self._latest: Optional[bytes] = None
+
+    def save_best_model(self, model, score: float):
+        self._best = _model_to_bytes(model)
+
+    def save_latest_model(self, model, score: float):
+        self._latest = _model_to_bytes(model)
+
+    def get_best_model(self):
+        return None if self._best is None else _model_from_bytes(self._best)
+
+    def get_latest_model(self):
+        return None if self._latest is None else _model_from_bytes(self._latest)
+
+
+class LocalFileModelSaver:
+    """Saves best/latest model zips under a directory (DL4J
+    ``LocalFileModelSaver``)."""
+
+    def __init__(self, directory: str):
+        import os
+        self.directory = directory
+        os.makedirs(directory, exist_ok=True)
+
+    def _path(self, name: str) -> str:
+        import os
+        return os.path.join(self.directory, name)
+
+    def save_best_model(self, model, score: float):
+        model.save(self._path("bestModel.zip"))
+
+    def save_latest_model(self, model, score: float):
+        model.save(self._path("latestModel.zip"))
+
+    def get_best_model(self):
+        import os
+        from ..utils.serializer import load_model
+        p = self._path("bestModel.zip")
+        return load_model(p) if os.path.exists(p) else None
+
+    def get_latest_model(self):
+        import os
+        from ..utils.serializer import load_model
+        p = self._path("latestModel.zip")
+        return load_model(p) if os.path.exists(p) else None
+
+
+# ---------------------------------------------------------- score calculators
+class DataSetLossCalculator:
+    """Average loss over a DataSetIterator (DL4J ``DataSetLossCalculator``).
+    ``minimize_score()`` is True: lower is better."""
+
+    def __init__(self, iterator, average: bool = True):
+        self.iterator = iterator
+        self.average = average
+
+    def minimize_score(self) -> bool:
+        return True
+
+    def calculate_score(self, model) -> float:
+        total, n = 0.0, 0
+        for ds in self.iterator:
+            b = ds.num_examples()
+            total += model.score(ds) * (b if self.average else 1.0)
+            n += b
+        return total / max(n, 1) if self.average else total
+
+
+class ClassificationScoreCalculator:
+    """Accuracy/F1 on a held-out iterator; higher is better (DL4J
+    ``ClassificationScoreCalculator``)."""
+
+    def __init__(self, iterator, metric: str = "accuracy"):
+        self.iterator = iterator
+        self.metric = metric
+
+    def minimize_score(self) -> bool:
+        return False
+
+    def calculate_score(self, model) -> float:
+        ev = model.evaluate(self.iterator)
+        return getattr(ev, self.metric)()
+
+
+# ------------------------------------------------------ termination conditions
+class MaxEpochsTerminationCondition:
+    def __init__(self, max_epochs: int):
+        self.max_epochs = int(max_epochs)
+
+    def terminate(self, epoch: int, score: float, best_score: float) -> bool:
+        return epoch + 1 >= self.max_epochs
+
+    def __str__(self):
+        return f"MaxEpochs({self.max_epochs})"
+
+
+class ScoreImprovementEpochTerminationCondition:
+    """Stop after N epochs with no (sufficient) improvement. Tracks its own
+    best (scores arrive in minimize orientation), so it is independent of
+    when the trainer updates its best-model snapshot."""
+
+    def __init__(self, max_epochs_without_improvement: int,
+                 min_improvement: float = 0.0):
+        self.patience = int(max_epochs_without_improvement)
+        self.min_improvement = float(min_improvement)
+        self._best: Optional[float] = None
+        self._since_best = 0
+
+    def terminate(self, epoch: int, score: float, best_score: float) -> bool:
+        if np.isnan(score):
+            self._since_best += 1
+        elif self._best is None or \
+                (self._best - score) > self.min_improvement:
+            self._best = score
+            self._since_best = 0
+        else:
+            self._since_best += 1
+        return self._since_best >= self.patience
+
+    def __str__(self):
+        return (f"ScoreImprovement(patience={self.patience}, "
+                f"min={self.min_improvement})")
+
+
+class BestScoreEpochTerminationCondition:
+    """Stop as soon as the score is at least this good."""
+
+    def __init__(self, value: float):
+        self.value = float(value)
+
+    def terminate(self, epoch: int, score: float, best_score: float) -> bool:
+        return score <= self.value  # minimize orientation
+
+    def __str__(self):
+        return f"BestScore({self.value})"
+
+
+class MaxTimeIterationTerminationCondition:
+    def __init__(self, max_minutes: float):
+        self.max_seconds = float(max_minutes) * 60.0
+        self._start: Optional[float] = None
+
+    def initialize(self):
+        self._start = time.monotonic()
+
+    def terminate(self, last_score: float) -> bool:
+        return (time.monotonic() - self._start) > self.max_seconds
+
+    def __str__(self):
+        return f"MaxTime({self.max_seconds / 60:.1f}min)"
+
+
+class MaxScoreIterationTerminationCondition:
+    """Stop if the training score exceeds a bound (diverging)."""
+
+    def __init__(self, max_score: float):
+        self.max_score = float(max_score)
+
+    def initialize(self):
+        pass
+
+    def terminate(self, last_score: float) -> bool:
+        return last_score > self.max_score
+
+    def __str__(self):
+        return f"MaxScore({self.max_score})"
+
+
+class InvalidScoreIterationTerminationCondition:
+    def initialize(self):
+        pass
+
+    def terminate(self, last_score: float) -> bool:
+        return bool(np.isnan(last_score) or np.isinf(last_score))
+
+    def __str__(self):
+        return "InvalidScore"
+
+
+# ----------------------------------------------------------------- trainer
+class EarlyStoppingConfiguration:
+    """Builder-style config (DL4J ``EarlyStoppingConfiguration.Builder``)."""
+
+    def __init__(self, *,
+                 epoch_termination_conditions: Optional[List[Any]] = None,
+                 iteration_termination_conditions: Optional[List[Any]] = None,
+                 score_calculator: Any = None,
+                 model_saver: Any = None,
+                 evaluate_every_n_epochs: int = 1,
+                 save_last_model: bool = False):
+        self.epoch_conditions = epoch_termination_conditions or []
+        self.iteration_conditions = iteration_termination_conditions or []
+        self.score_calculator = score_calculator
+        self.saver = model_saver or InMemoryModelSaver()
+        self.every_n = int(evaluate_every_n_epochs)
+        self.save_last = save_last_model
+
+
+class EarlyStoppingResult:
+    def __init__(self, termination_reason: str, termination_details: str,
+                 best_model_epoch: int, best_model_score: float,
+                 total_epochs: int, best_model):
+        self.termination_reason = termination_reason
+        self.termination_details = termination_details
+        self.best_model_epoch = best_model_epoch
+        self.best_model_score = best_model_score
+        self.total_epochs = total_epochs
+        self.best_model = best_model
+
+    def __repr__(self):
+        return (f"EarlyStoppingResult(reason={self.termination_reason}, "
+                f"details={self.termination_details}, "
+                f"best_epoch={self.best_model_epoch}, "
+                f"best_score={self.best_model_score:.6f}, "
+                f"epochs={self.total_epochs})")
+
+
+class _IterationStop(Exception):
+    def __init__(self, condition):
+        self.condition = condition
+
+
+class _IterationConditionListener:
+    """Fit-loop listener that checks iteration termination conditions on the
+    live training score and aborts the epoch via exception (the functional
+    equivalent of DL4J's in-loop check)."""
+
+    def __init__(self, conditions):
+        self.conditions = conditions
+
+    def iteration_done(self, model, iteration, epoch):
+        score = model.score()
+        for c in self.conditions:
+            if c.terminate(score):
+                raise _IterationStop(c)
+
+    def on_epoch_end(self, model):
+        pass
+
+
+class EarlyStoppingTrainer:
+    """DL4J ``EarlyStoppingTrainer`` / ``EarlyStoppingGraphTrainer`` (one
+    class — both engines share the fit/score surface here)."""
+
+    def __init__(self, config: EarlyStoppingConfiguration, model, train_data):
+        self.config = config
+        self.model = model
+        self.train_data = train_data
+
+    def fit(self) -> EarlyStoppingResult:
+        cfg = self.config
+        calc = cfg.score_calculator
+        if calc is None:
+            raise ValueError("EarlyStoppingConfiguration needs a "
+                             "score_calculator")
+        sign = 1.0 if calc.minimize_score() else -1.0
+        best_score = float("nan")
+        best_epoch = -1
+        epoch = 0
+        reason, details = "Unknown", ""
+        for c in cfg.iteration_conditions:
+            c.initialize()
+        listener = _IterationConditionListener(cfg.iteration_conditions)
+        self.model.add_listener(listener)
+        try:
+            while True:
+                try:
+                    self.model.fit(self.train_data, epochs=1)
+                except _IterationStop as stop:
+                    reason = "IterationTerminationCondition"
+                    details = str(stop.condition)
+                    break
+                terminated = False
+                if (epoch + 1) % cfg.every_n == 0:
+                    score = sign * calc.calculate_score(self.model)
+                    if np.isnan(best_score) or score < best_score:
+                        best_score = score
+                        best_epoch = epoch
+                        cfg.saver.save_best_model(self.model, sign * score)
+                    if cfg.save_last:
+                        cfg.saver.save_latest_model(self.model, sign * score)
+                    for c in cfg.epoch_conditions:
+                        if c.terminate(epoch, score, best_score):
+                            reason = "EpochTerminationCondition"
+                            details = str(c)
+                            terminated = True
+                            break
+                else:
+                    # still enforce MaxEpochs-style conditions on off-epochs
+                    for c in cfg.epoch_conditions:
+                        if isinstance(c, MaxEpochsTerminationCondition) and \
+                                c.terminate(epoch, float("nan"), best_score):
+                            reason = "EpochTerminationCondition"
+                            details = str(c)
+                            terminated = True
+                            break
+                epoch += 1
+                if terminated:
+                    break
+        finally:
+            if listener in self.model._listeners:
+                self.model._listeners.remove(listener)
+        best = cfg.saver.get_best_model() or self.model
+        return EarlyStoppingResult(
+            termination_reason=reason, termination_details=details,
+            best_model_epoch=best_epoch,
+            best_model_score=sign * best_score if not np.isnan(best_score)
+            else float("nan"),
+            total_epochs=epoch, best_model=best)

@@ -1,0 +1,141 @@
+"""Early stopping: termination conditions, best-model restore, savers.
+
+Equivalent of DL4J's TestEarlyStopping suite (SURVEY.md §4)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.data.dataset import DataSet, NumpyDataSetIterator
+from deeplearning4j_tpu.nn.config import InputType, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.layers.core import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.model import MultiLayerNetwork
+from deeplearning4j_tpu.nn.updaters import Adam, Sgd
+from deeplearning4j_tpu.optimize import (
+    DataSetLossCalculator, EarlyStoppingConfiguration, EarlyStoppingTrainer,
+    InMemoryModelSaver, InvalidScoreIterationTerminationCondition,
+    LocalFileModelSaver, MaxEpochsTerminationCondition,
+    MaxScoreIterationTerminationCondition,
+    ScoreImprovementEpochTerminationCondition)
+
+
+def _xor(n=64, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.uniform(-1, 1, size=(n, 2)).astype(np.float32)
+    y = ((x[:, 0] > 0) ^ (x[:, 1] > 0)).astype(np.int64)
+    return x, np.eye(2, dtype=np.float32)[y]
+
+
+def _net(lr=0.01, seed=42):
+    conf = (NeuralNetConfiguration.builder()
+            .seed(seed)
+            .updater(Adam(learning_rate=lr))
+            .input_type(InputType.feed_forward(2))
+            .list(DenseLayer(n_out=16, activation="tanh"),
+                  OutputLayer(n_out=2, loss="mcxent", activation="softmax"))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def test_max_epochs_terminates():
+    x, y = _xor()
+    train = NumpyDataSetIterator(x, y, batch_size=32)
+    val = NumpyDataSetIterator(*_xor(seed=1), batch_size=32)
+    cfg = EarlyStoppingConfiguration(
+        epoch_termination_conditions=[MaxEpochsTerminationCondition(3)],
+        score_calculator=DataSetLossCalculator(val),
+        model_saver=InMemoryModelSaver())
+    result = EarlyStoppingTrainer(cfg, _net(), train).fit()
+    assert result.total_epochs == 3
+    assert result.termination_reason == "EpochTerminationCondition"
+    assert "MaxEpochs" in result.termination_details
+    assert result.best_model is not None
+    assert result.best_model_epoch >= 0
+
+
+def test_best_model_is_restored_not_last():
+    """Diverging LR: early epochs are best; trainer must return the best
+    snapshot, not the final one."""
+    x, y = _xor()
+    train = NumpyDataSetIterator(x, y, batch_size=64)
+    val = NumpyDataSetIterator(x, y, batch_size=64)
+    net = _net(lr=15.0)  # diverges after a step or two
+    cfg = EarlyStoppingConfiguration(
+        epoch_termination_conditions=[MaxEpochsTerminationCondition(5)],
+        score_calculator=DataSetLossCalculator(val),
+        model_saver=InMemoryModelSaver())
+    result = EarlyStoppingTrainer(cfg, net, train).fit()
+    best = result.best_model
+    calc = DataSetLossCalculator(val)
+    assert calc.calculate_score(best) == pytest.approx(
+        result.best_model_score, rel=1e-5)
+    # the best snapshot beats (or matches) the live diverged model
+    assert calc.calculate_score(best) <= calc.calculate_score(net) + 1e-6
+
+
+def test_score_improvement_patience():
+    x, y = _xor()
+    train = NumpyDataSetIterator(x, y, batch_size=32)
+    val = NumpyDataSetIterator(*_xor(seed=1), batch_size=32)
+    net = _net(lr=0.0)  # lr=0: score never improves after epoch 0
+    cfg = EarlyStoppingConfiguration(
+        epoch_termination_conditions=[
+            ScoreImprovementEpochTerminationCondition(2),
+            MaxEpochsTerminationCondition(50)],
+        score_calculator=DataSetLossCalculator(val),
+        model_saver=InMemoryModelSaver())
+    result = EarlyStoppingTrainer(cfg, net, train).fit()
+    assert result.termination_reason == "EpochTerminationCondition"
+    assert "ScoreImprovement" in result.termination_details
+    assert result.total_epochs <= 5  # stopped long before 50
+
+
+def test_max_score_stops_mid_training():
+    """Iteration-level termination fires inside an epoch, not at its end."""
+    x, y = _xor()
+    train = NumpyDataSetIterator(x, y, batch_size=8)  # 8 iterations/epoch
+    val = NumpyDataSetIterator(x, y, batch_size=32)
+    # SGD with an absurd LR diverges on the first step (tanh saturation
+    # keeps the loss finite, so divergence shows as a huge score, not NaN)
+    conf = (NeuralNetConfiguration.builder()
+            .seed(42)
+            .updater(Sgd(learning_rate=1e18))
+            .input_type(InputType.feed_forward(2))
+            .list(DenseLayer(n_out=16, activation="tanh"),
+                  OutputLayer(n_out=2, loss="mcxent", activation="softmax"))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    cfg = EarlyStoppingConfiguration(
+        epoch_termination_conditions=[MaxEpochsTerminationCondition(100)],
+        iteration_termination_conditions=[
+            MaxScoreIterationTerminationCondition(1e6),
+            InvalidScoreIterationTerminationCondition()],
+        score_calculator=DataSetLossCalculator(val),
+        model_saver=InMemoryModelSaver())
+    result = EarlyStoppingTrainer(cfg, net, train).fit()
+    assert result.termination_reason == "IterationTerminationCondition"
+    assert "MaxScore" in result.termination_details
+    assert result.total_epochs == 0  # stopped inside the first epoch
+
+
+def test_invalid_score_condition():
+    cond = InvalidScoreIterationTerminationCondition()
+    cond.initialize()
+    assert not cond.terminate(5.0)
+    assert cond.terminate(float("nan"))
+    assert cond.terminate(float("inf"))
+
+
+def test_local_file_saver_roundtrip(tmp_path):
+    x, y = _xor()
+    train = NumpyDataSetIterator(x, y, batch_size=32)
+    val = NumpyDataSetIterator(x, y, batch_size=32)
+    saver = LocalFileModelSaver(str(tmp_path))
+    cfg = EarlyStoppingConfiguration(
+        epoch_termination_conditions=[MaxEpochsTerminationCondition(2)],
+        score_calculator=DataSetLossCalculator(val),
+        model_saver=saver, save_last_model=True)
+    EarlyStoppingTrainer(cfg, _net(), train).fit()
+    assert (tmp_path / "bestModel.zip").exists()
+    assert (tmp_path / "latestModel.zip").exists()
+    best = saver.get_best_model()
+    assert best.num_params() == _net().num_params()

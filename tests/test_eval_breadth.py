@@ -1,0 +1,142 @@
+"""ROC/AUC family, EvaluationBinary, EvaluationCalibration — tested against
+sklearn oracles (SURVEY.md §4 oracle strategy: independent reference
+implementations, not self-consistency)."""
+
+import numpy as np
+import pytest
+from sklearn.metrics import (average_precision_score, precision_score,
+                             recall_score, roc_auc_score)
+
+from deeplearning4j_tpu.eval import (ROC, Evaluation, EvaluationBinary,
+                                     EvaluationCalibration, ROCBinary,
+                                     ROCMultiClass)
+
+
+def _binary_data(n=500, seed=0):
+    rng = np.random.default_rng(seed)
+    labels = (rng.uniform(size=n) > 0.5).astype(np.float32)
+    # scores correlated with labels, with ties sprinkled in
+    scores = np.clip(labels * 0.3 + rng.uniform(size=n) * 0.7, 0, 1)
+    scores = np.round(scores.astype(np.float32), 2)  # force ties
+    return labels, scores
+
+
+def test_roc_exact_auc_matches_sklearn():
+    labels, scores = _binary_data()
+    roc = ROC().eval(labels, scores)
+    assert roc.auc() == pytest.approx(roc_auc_score(labels, scores), abs=1e-9)
+
+
+def test_roc_exact_auprc_matches_sklearn():
+    labels, scores = _binary_data(seed=1)
+    roc = ROC().eval(labels, scores)
+    assert roc.auprc() == pytest.approx(
+        average_precision_score(labels, scores), abs=1e-9)
+
+
+def test_roc_streaming_equals_single_shot():
+    labels, scores = _binary_data(seed=2)
+    one = ROC().eval(labels, scores)
+    many = ROC()
+    for i in range(0, 500, 100):
+        many.eval(labels[i:i + 100], scores[i:i + 100])
+    assert many.auc() == pytest.approx(one.auc(), abs=1e-12)
+
+
+def test_roc_two_column_softmax_input():
+    labels, scores = _binary_data(seed=3)
+    onehot = np.stack([1 - labels, labels], -1)
+    probs = np.stack([1 - scores, scores], -1)
+    roc = ROC().eval(onehot, probs)
+    assert roc.auc() == pytest.approx(roc_auc_score(labels, scores), abs=1e-9)
+
+
+def test_roc_thresholded_approximates_exact():
+    labels, scores = _binary_data(seed=4)
+    exact = ROC().eval(labels, scores).auc()
+    binned = ROC(threshold_steps=200).eval(labels, scores).auc()
+    assert binned == pytest.approx(exact, abs=0.02)
+
+
+def test_roc_degenerate_single_class_is_nan():
+    roc = ROC().eval(np.ones(10), np.linspace(0, 1, 10))
+    assert np.isnan(roc.auc())
+
+
+def test_roc_multiclass_one_vs_all():
+    rng = np.random.default_rng(5)
+    y = rng.integers(0, 3, size=400)
+    logits = rng.normal(size=(400, 3)) + 2.0 * np.eye(3)[y]
+    p = np.exp(logits) / np.exp(logits).sum(-1, keepdims=True)
+    rmc = ROCMultiClass().eval(np.eye(3)[y], p)
+    for c in range(3):
+        expect = roc_auc_score((y == c).astype(int), p[:, c])
+        assert rmc.auc(c) == pytest.approx(expect, abs=1e-9)
+    assert 0.5 < rmc.average_auc() <= 1.0
+
+
+def test_roc_binary_per_column():
+    rng = np.random.default_rng(6)
+    labels = (rng.uniform(size=(300, 4)) > 0.5).astype(np.float32)
+    probs = np.clip(labels * 0.4 + rng.uniform(size=(300, 4)) * 0.6, 0, 1)
+    rb = ROCBinary().eval(labels, probs)
+    assert rb.num_labels() == 4
+    for c in range(4):
+        assert rb.auc(c) == pytest.approx(
+            roc_auc_score(labels[:, c], probs[:, c]), abs=1e-9)
+
+
+def test_evaluation_binary_counts_and_metrics():
+    rng = np.random.default_rng(7)
+    labels = (rng.uniform(size=(200, 3)) > 0.5).astype(np.float32)
+    probs = np.clip(labels * 0.5 + rng.uniform(size=(200, 3)) * 0.5, 0, 1)
+    eb = EvaluationBinary().eval(labels, probs)
+    pred = (probs >= 0.5).astype(int)
+    for c in range(3):
+        assert eb.precision(c) == pytest.approx(
+            precision_score(labels[:, c], pred[:, c]), abs=1e-9)
+        assert eb.recall(c) == pytest.approx(
+            recall_score(labels[:, c], pred[:, c]), abs=1e-9)
+        assert eb.true_positives(c) == int(
+            ((pred[:, c] == 1) & (labels[:, c] == 1)).sum())
+    assert "EvaluationBinary" in eb.stats()
+
+
+def test_evaluation_binary_streaming():
+    rng = np.random.default_rng(8)
+    labels = (rng.uniform(size=(100, 2)) > 0.5).astype(np.float32)
+    probs = rng.uniform(size=(100, 2)).astype(np.float32)
+    one = EvaluationBinary().eval(labels, probs)
+    two = EvaluationBinary()
+    two.eval(labels[:50], probs[:50]).eval(labels[50:], probs[50:])
+    assert one.f1() == pytest.approx(two.f1(), abs=1e-12)
+
+
+def test_calibration_perfectly_calibrated_low_ece():
+    rng = np.random.default_rng(9)
+    p = rng.uniform(0.05, 0.95, size=20000)
+    labels = (rng.uniform(size=20000) < p).astype(np.float32)
+    # two-class problem: [1-p, p]
+    cal = EvaluationCalibration(reliability_bins=10)
+    cal.eval(np.stack([1 - labels, labels], -1), np.stack([1 - p, p], -1))
+    assert cal.expected_calibration_error() < 0.02
+    mean_p, freq = cal.reliability_diagram(1)
+    valid = ~np.isnan(mean_p)
+    assert np.allclose(mean_p[valid], freq[valid], atol=0.06)
+
+
+def test_calibration_overconfident_high_ece():
+    rng = np.random.default_rng(10)
+    # predictions always 0.99/0.01 but labels are a coin flip: badly calibrated
+    labels = (rng.uniform(size=2000) > 0.5).astype(np.float32)
+    p = np.full(2000, 0.99, dtype=np.float32)
+    cal = EvaluationCalibration()
+    cal.eval(np.stack([1 - labels, labels], -1), np.stack([1 - p, p], -1))
+    assert cal.expected_calibration_error() > 0.3
+    assert cal.residual_plot().sum() == 4000  # 2000 examples x 2 classes
+
+
+def test_evaluation_still_importable_from_package():
+    ev = Evaluation()
+    ev.eval(np.array([0, 1, 1]), np.array([[0.9, 0.1], [0.2, 0.8], [0.6, 0.4]]))
+    assert ev.accuracy() == pytest.approx(2 / 3)

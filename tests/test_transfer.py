@@ -1,0 +1,188 @@
+"""Transfer learning: freezing, re-heading, fine-tune overrides, helper.
+
+Equivalent of DL4J's TransferLearning*Test suites (SURVEY.md §4)."""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.data.dataset import DataSet, NumpyDataSetIterator
+from deeplearning4j_tpu.nn.config import InputType, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.graph import ComputationGraph
+from deeplearning4j_tpu.nn.layers.core import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.layers.wrappers import FrozenLayer
+from deeplearning4j_tpu.nn.model import MultiLayerNetwork
+from deeplearning4j_tpu.nn.transfer import (FineTuneConfiguration,
+                                            TransferLearning,
+                                            TransferLearningHelper)
+from deeplearning4j_tpu.nn.updaters import Adam, Sgd
+from deeplearning4j_tpu.nn.vertices import LayerVertex
+
+
+def _xor(n=64, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.uniform(-1, 1, size=(n, 2)).astype(np.float32)
+    y = ((x[:, 0] > 0) ^ (x[:, 1] > 0)).astype(np.int64)
+    return x, np.eye(2, dtype=np.float32)[y]
+
+
+def _net(seed=42):
+    conf = (NeuralNetConfiguration.builder()
+            .seed(seed)
+            .updater(Adam(learning_rate=0.05))
+            .input_type(InputType.feed_forward(2))
+            .list(DenseLayer(n_out=16, activation="tanh"),
+                  DenseLayer(n_out=8, activation="relu"),
+                  OutputLayer(n_out=2, loss="mcxent", activation="softmax"))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def test_frozen_layer_params_do_not_move():
+    x, y = _xor()
+    net = _net()
+    new = (TransferLearning.Builder(net)
+           .set_feature_extractor(0)
+           .build())
+    assert isinstance(new.layers[0], FrozenLayer)
+    w0_before = np.asarray(new.params["0"]["W"]).copy()
+    w1_before = np.asarray(new.params["1"]["W"]).copy()
+    new.fit(DataSet(x, y), epochs=3)
+    w0_after = np.asarray(new.params["0"]["W"])
+    w1_after = np.asarray(new.params["1"]["W"])
+    np.testing.assert_array_equal(w0_before, w0_after)  # frozen: bit-exact
+    assert np.abs(w1_after - w1_before).max() > 1e-6    # unfrozen moved
+
+
+def test_transfer_copies_trained_params():
+    x, y = _xor()
+    net = _net()
+    net.fit(DataSet(x, y), epochs=2)
+    trained_w = np.asarray(net.params["0"]["W"]).copy()
+    new = TransferLearning.Builder(net).set_feature_extractor(0).build()
+    np.testing.assert_array_equal(np.asarray(new.params["0"]["W"]), trained_w)
+
+
+def test_nout_replace_reinits_next_layer():
+    net = _net()
+    new = (TransferLearning.Builder(net)
+           .nout_replace(1, 12)
+           .build())
+    assert new.layers[1].n_out == 12
+    assert new.params["1"]["W"].shape == (16, 12)
+    assert new.params["2"]["W"].shape == (12, 2)  # fan-in followed
+    # layer 0 untouched: copied bit-exact
+    np.testing.assert_array_equal(np.asarray(new.params["0"]["W"]),
+                                  np.asarray(net.params["0"]["W"]))
+
+
+def test_remove_and_add_output_layer():
+    net = _net()
+    new = (TransferLearning.Builder(net)
+           .remove_output_layer()
+           .add_layer(OutputLayer(n_out=5, loss="mcxent",
+                                  activation="softmax"))
+           .build())
+    assert new.layers[-1].n_out == 5
+    out = new.output(np.zeros((3, 2), np.float32))
+    assert out.shape == (3, 5)
+
+
+def test_fine_tune_updater_override():
+    net = _net()
+    new = (TransferLearning.Builder(net)
+           .fine_tune_configuration(
+               FineTuneConfiguration(updater=Sgd(learning_rate=0.5)))
+           .build())
+    assert new.conf.updater.kind == "sgd"
+    assert new.conf.updater.learning_rate == 0.5
+
+
+def test_frozen_layer_serde_roundtrip(tmp_path):
+    net = _net()
+    new = TransferLearning.Builder(net).set_feature_extractor(0).build()
+    p = str(tmp_path / "frozen.zip")
+    new.save(p)
+    loaded = MultiLayerNetwork.load(p)
+    assert isinstance(loaded.layers[0], FrozenLayer)
+    x, _ = _xor(8)
+    np.testing.assert_allclose(loaded.output(x), new.output(x), atol=1e-6)
+
+
+def test_graph_transfer_freeze_ancestors():
+    conf = (NeuralNetConfiguration.builder()
+            .seed(7)
+            .updater(Adam(learning_rate=0.05))
+            .graph_builder()
+            .add_inputs("in")
+            .set_input_types(InputType.feed_forward(2))
+            .add_layer("d1", DenseLayer(n_out=8, activation="tanh"), "in")
+            .add_layer("d2", DenseLayer(n_out=8, activation="relu"), "d1")
+            .add_layer("out", OutputLayer(n_out=2), "d2")
+            .set_outputs("out")
+            .build())
+    g = ComputationGraph(conf).init()
+    x, y = _xor()
+    new = (TransferLearning.GraphBuilder(g)
+           .set_feature_extractor("d2")
+           .build())
+    vmap = {n: v for n, v, _ in new.conf.vertices}
+    assert isinstance(vmap["d1"].layer, FrozenLayer)  # ancestor frozen too
+    assert isinstance(vmap["d2"].layer, FrozenLayer)
+    assert not isinstance(vmap["out"].layer, FrozenLayer)
+    w_before = np.asarray(new.params["d1"]["W"]).copy()
+    new.fit(DataSet(x, y), epochs=2)
+    np.testing.assert_array_equal(np.asarray(new.params["d1"]["W"]), w_before)
+
+
+def test_graph_transfer_rehead():
+    conf = (NeuralNetConfiguration.builder()
+            .seed(7)
+            .updater(Adam(learning_rate=0.05))
+            .graph_builder()
+            .add_inputs("in")
+            .set_input_types(InputType.feed_forward(2))
+            .add_layer("d1", DenseLayer(n_out=8, activation="tanh"), "in")
+            .add_layer("out", OutputLayer(n_out=2), "d1")
+            .set_outputs("out")
+            .build())
+    g = ComputationGraph(conf).init()
+    g.fit(DataSet(*_xor()), epochs=1)
+    new = (TransferLearning.GraphBuilder(g)
+           .remove_vertex("out")
+           .add_layer("newout", OutputLayer(n_out=3), "d1")
+           .set_outputs("newout")
+           .build())
+    # trained d1 params carried over
+    np.testing.assert_array_equal(np.asarray(new.params["d1"]["W"]),
+                                  np.asarray(g.params["d1"]["W"]))
+    out = new.output(np.zeros((4, 2), np.float32))
+    assert out.shape == (4, 3)
+
+
+def test_transfer_helper_featurize_matches_full_forward():
+    x, y = _xor(32)
+    net = _net()
+    frozen = TransferLearning.Builder(net).set_feature_extractor(1).build()
+    helper = TransferLearningHelper(frozen)
+    feat = helper.featurize(DataSet(x, y))
+    assert feat.features.shape == (32, 8)
+    # tail-on-features == full net forward
+    tail = helper.unfrozen_graph()
+    np.testing.assert_allclose(tail.output(feat.features),
+                               frozen.output(x), atol=1e-5)
+
+
+def test_transfer_helper_fit_featurized_trains_tail():
+    x, y = _xor()
+    net = _net()
+    frozen = TransferLearning.Builder(net).set_feature_extractor(0).build()
+    helper = TransferLearningHelper(frozen)
+    feat = helper.featurize(DataSet(x, y))
+    w_frozen = np.asarray(frozen.params["0"]["W"]).copy()
+    w_tail = np.asarray(frozen.params["1"]["W"]).copy()
+    helper.fit_featurized(feat, epochs=3)
+    np.testing.assert_array_equal(np.asarray(frozen.params["0"]["W"]),
+                                  w_frozen)
+    assert np.abs(np.asarray(frozen.params["1"]["W"]) - w_tail).max() > 1e-6
