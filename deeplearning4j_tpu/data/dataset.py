@@ -108,7 +108,8 @@ class MultiDataSetIterator:
 
 
 class NumpyMultiDataSetIterator(MultiDataSetIterator):
-    """Mini-batches over in-memory multi-input/-output arrays."""
+    """Mini-batches over in-memory multi-input/-output arrays. Resumable via
+    the same ``(epoch, pos)`` cursor contract as :class:`NumpyDataSetIterator`."""
 
     def __init__(self, features, labels, batch_size: int, shuffle: bool = False,
                  seed: int = 123):
@@ -116,22 +117,43 @@ class NumpyMultiDataSetIterator(MultiDataSetIterator):
         self._l = [np.asarray(a) for a in _as_list(labels)]
         self._bs = batch_size
         self._shuffle = shuffle
-        self._rng = np.random.default_rng(seed)
+        self._seed = seed
+        self._epoch = 0
+        self._pos = 0
 
     def batch_size(self) -> int:
         return self._bs
 
+    def reset(self):
+        self._epoch = 0
+        self._pos = 0
+
+    def state(self) -> dict:
+        return {"epoch": self._epoch, "pos": self._pos, "seed": self._seed}
+
+    def set_state(self, state: dict):
+        self._epoch = int(state.get("epoch", 0))
+        self._pos = int(state.get("pos", 0))
+
     def __iter__(self):
         n = self._f[0].shape[0]
-        idx = self._rng.permutation(n) if self._shuffle else np.arange(n)
-        for i in range(0, n, self._bs):
-            j = idx[i:i + self._bs]
+        idx = (np.random.default_rng((self._seed, self._epoch)).permutation(n)
+               if self._shuffle else np.arange(n))
+        while self._pos < n:
+            j = idx[self._pos:self._pos + self._bs]
+            self._pos += self._bs
             yield MultiDataSet([a[j] for a in self._f], [a[j] for a in self._l])
+        self._epoch += 1
+        self._pos = 0
 
 
 class DataSetIterator:
     """Iterator protocol (DL4J DataSetIterator): iterable of DataSet
-    minibatches with reset semantics."""
+    minibatches with reset semantics, plus a restorable-cursor contract the
+    reference never had (SURVEY.md §5 "Checkpoint / resume": iterator position
+    NOT captured — a gap we fix): ``state()`` returns a small JSON-able dict
+    and ``set_state()`` resumes iteration exactly there, so preemption-safe
+    checkpoints can capture the data cursor (``parallel/checkpoint.py``)."""
 
     def __iter__(self) -> Iterator[DataSet]:
         raise NotImplementedError
@@ -142,9 +164,22 @@ class DataSetIterator:
     def batch_size(self) -> int:
         raise NotImplementedError
 
+    def state(self) -> dict:
+        """Restorable cursor. Default: empty (non-resumable iterators)."""
+        return {}
+
+    def set_state(self, state: dict):
+        pass
+
 
 class NumpyDataSetIterator(DataSetIterator):
-    """Mini-batches over in-memory arrays (ListDataSetIterator equivalent)."""
+    """Mini-batches over in-memory arrays (ListDataSetIterator equivalent).
+
+    Resumable: the epoch-``e`` shuffle permutation is derived from
+    ``(seed, e)`` rather than a progressively-consumed generator, so the
+    cursor is fully described by ``{epoch, pos}`` — two ints — and restoring
+    it reproduces the exact remaining batch sequence.
+    """
 
     def __init__(self, features, labels, batch_size: int, shuffle: bool = False,
                  seed: int = 123, drop_last: bool = False,
@@ -155,8 +190,10 @@ class NumpyDataSetIterator(DataSetIterator):
         self._lm = None if labels_mask is None else np.asarray(labels_mask)
         self._bs = batch_size
         self._shuffle = shuffle
-        self._rng = np.random.default_rng(seed)
+        self._seed = seed
         self._drop_last = drop_last
+        self._epoch = 0
+        self._pos = 0  # example index within the current epoch's permutation
 
     def batch_size(self) -> int:
         return self._bs
@@ -164,16 +201,40 @@ class NumpyDataSetIterator(DataSetIterator):
     def num_examples(self) -> int:
         return int(self._f.shape[0])
 
+    def reset(self):
+        self._epoch = 0
+        self._pos = 0
+
+    def state(self) -> dict:
+        return {"epoch": self._epoch, "pos": self._pos, "seed": self._seed}
+
+    def set_state(self, state: dict):
+        if state.get("seed", self._seed) != self._seed:
+            raise ValueError(
+                f"iterator state was captured with seed {state['seed']}, "
+                f"this iterator has seed {self._seed}")
+        self._epoch = int(state.get("epoch", 0))
+        self._pos = int(state.get("pos", 0))
+
+    def _perm(self, epoch: int):
+        if not self._shuffle:
+            return np.arange(self._f.shape[0])
+        return np.random.default_rng((self._seed, epoch)).permutation(
+            self._f.shape[0])
+
     def __iter__(self):
         n = self._f.shape[0]
-        idx = self._rng.permutation(n) if self._shuffle else np.arange(n)
+        idx = self._perm(self._epoch)
         end = (n // self._bs) * self._bs if self._drop_last else n
-        for i in range(0, end, self._bs):
-            j = idx[i:i + self._bs]
+        while self._pos < end:
+            j = idx[self._pos:self._pos + self._bs]
+            self._pos += self._bs
             yield DataSet(self._f[j],
                           None if self._l is None else self._l[j],
                           None if self._fm is None else self._fm[j],
                           None if self._lm is None else self._lm[j])
+        self._epoch += 1
+        self._pos = 0
 
 
 class ListDataSetIterator(DataSetIterator):
@@ -181,12 +242,26 @@ class ListDataSetIterator(DataSetIterator):
 
     def __init__(self, batches: Sequence[DataSet]):
         self._batches = list(batches)
+        self._pos = 0
 
     def batch_size(self) -> int:
         return self._batches[0].num_examples() if self._batches else 0
 
+    def reset(self):
+        self._pos = 0
+
+    def state(self) -> dict:
+        return {"pos": self._pos}
+
+    def set_state(self, state: dict):
+        self._pos = int(state.get("pos", 0))
+
     def __iter__(self):
-        return iter(self._batches)
+        while self._pos < len(self._batches):
+            b = self._batches[self._pos]
+            self._pos += 1
+            yield b
+        self._pos = 0
 
 
 class AsyncDataSetIterator(DataSetIterator):
@@ -200,33 +275,93 @@ class AsyncDataSetIterator(DataSetIterator):
     def __init__(self, base: DataSetIterator, queue_size: int = 4):
         self._base = base
         self._qsize = queue_size
+        # restorable cursor: the producer thread runs AHEAD of the consumer
+        # (queue depth), so the base iterator's own cursor over-reports what
+        # the trainer has actually consumed. We snapshot the base state at
+        # iteration start and count consumed (yielded) batches; resume
+        # replays the base from the snapshot and skips that many.
+        self._start_state: dict = {}
+        self._consumed = 0
+        self._skip = 0
 
     def batch_size(self) -> int:
         return self._base.batch_size()
 
     def reset(self):
         self._base.reset()
+        self._consumed = 0
+        self._skip = 0
+
+    def state(self) -> dict:
+        return {"base": self._start_state, "consumed": self._consumed}
+
+    def set_state(self, state: dict):
+        self._base.set_state(state.get("base", {}))
+        self._skip = int(state.get("consumed", 0))
+        self._start_state = self._base.state()
+        self._consumed = self._skip
 
     def __iter__(self):
+        self._start_state = self._base.state()
+        self._consumed = 0
         q: "queue.Queue" = queue.Queue(maxsize=self._qsize)
         _END = object()
         err: List[BaseException] = []
+        stop = threading.Event()
+
+        def put(item) -> bool:
+            """Bounded put that aborts when the consumer went away."""
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
 
         def produce():
             try:
                 for ds in self._base:
-                    q.put(ds)
+                    if not put(ds):
+                        return
             except BaseException as e:  # propagate into consumer
                 err.append(e)
             finally:
-                q.put(_END)
+                put(_END)
 
         t = threading.Thread(target=produce, daemon=True)
         t.start()
-        while True:
-            item = q.get()
-            if item is _END:
-                if err:
-                    raise err[0]
-                return
-            yield item
+        clean = False
+        try:
+            while True:
+                item = q.get()
+                if item is _END:
+                    if err:
+                        raise err[0]
+                    # epoch completed cleanly: roll the snapshot forward so
+                    # an epoch-boundary checkpoint resumes at the NEXT epoch
+                    # instead of replaying this one as all-skipped (empty)
+                    self._start_state = self._base.state()
+                    self._consumed = 0
+                    clean = True
+                    return
+                if self._skip > 0:
+                    self._skip -= 1
+                    self._consumed += 1
+                    continue
+                self._consumed += 1
+                yield item
+        finally:
+            if not clean:
+                # consumer abandoned mid-epoch (break / exception / error):
+                # stop the producer, then rewind the base cursor to what was
+                # actually consumed — the producer ran AHEAD, and without the
+                # rewind the prefetched-but-unconsumed batches would be
+                # silently skipped by the next pass
+                stop.set()
+                t.join(timeout=5.0)
+                if self._base.state():  # resumable base only; a base with
+                    # no cursor ({} state) keeps the old restart-from-
+                    # wherever behavior — we cannot rewind it
+                    self._base.set_state(self._start_state)
+                    self._skip = self._consumed

@@ -40,18 +40,16 @@ def _exact_auroc(labels: np.ndarray, scores: np.ndarray) -> float:
     if n_pos == 0 or n_neg == 0:
         return float("nan")
     order = np.argsort(scores, kind="mergesort")
+    # vectorized midranks for ties: group identical sorted scores, midrank
+    # of a group spanning 0-based [i, j] is (i + j + 2) / 2
+    s_sorted = scores[order]
+    new_group = np.r_[True, s_sorted[1:] != s_sorted[:-1]]
+    group_id = np.cumsum(new_group) - 1
+    counts = np.bincount(group_id)
+    starts = np.cumsum(counts) - counts
+    midranks = starts + (counts + 1) / 2.0
     ranks = np.empty(labels.size, dtype=np.float64)
-    ranks[order] = np.arange(1, labels.size + 1)
-    # midranks for ties
-    sorted_scores = scores[order]
-    i = 0
-    while i < labels.size:
-        j = i
-        while j + 1 < labels.size and sorted_scores[j + 1] == sorted_scores[i]:
-            j += 1
-        if j > i:
-            ranks[order[i:j + 1]] = 0.5 * (i + j) + 1.0
-        i = j + 1
+    ranks[order] = midranks[group_id]
     u = ranks[pos].sum() - n_pos * (n_pos + 1) / 2.0
     return float(u / (n_pos * n_neg))
 
@@ -133,8 +131,10 @@ class ROC:
             fp = np.cumsum(self._fp[::-1])[::-1]
             tpr = tp / max(self._pos, 1)
             fpr = fp / max(self._neg, 1)
-            # descending thresholds -> ascending fpr
-            return np.r_[tpr[::-1], 1.0], np.r_[fpr[::-1], 1.0]
+            # descending thresholds -> ascending fpr; anchor the curve at
+            # (0,0) (threshold above every score) and (1,1) so trapezoidal
+            # AUC covers the full [0,1] fpr range
+            return np.r_[0.0, tpr[::-1], 1.0], np.r_[0.0, fpr[::-1], 1.0]
         raise RuntimeError("exact mode computes AUC directly")
 
     def auc(self) -> float:

@@ -505,9 +505,11 @@ class ComputationGraph:
         return self
 
     # ------------------------------------------------------------------ serde
-    def save(self, path, save_updater: bool = True, normalizer=None):
+    def save(self, path, save_updater: bool = True, normalizer=None,
+             iterator=None):
         from ..utils.serializer import save_model
-        save_model(self, path, save_updater=save_updater, normalizer=normalizer)
+        save_model(self, path, save_updater=save_updater,
+                   normalizer=normalizer, iterator=iterator)
 
     @staticmethod
     def load(path, load_updater: bool = True):

@@ -171,7 +171,7 @@ class TransferLearning:
             self._graph = graph
             self._ftc = FineTuneConfiguration()
             self._frozen_roots: List[str] = []
-            self._removed: Set[str] = set()
+            self._removed: Dict[str, bool] = {}  # name -> remove_outputs
             self._added: List[Tuple[str, Any, List[str]]] = []
             self._outputs: Optional[List[str]] = None
 
@@ -187,7 +187,13 @@ class TransferLearning:
             return self
 
         def remove_vertex(self, name: str, remove_outputs: bool = True):
-            self._removed.add(name)
+            """remove_outputs=True drops the vertex AND everything
+            downstream (DL4J ``removeVertexAndConnections``);
+            remove_outputs=False drops only the vertex, keeping its
+            consumers wired to the name (DL4J ``removeVertexKeepConnections``)
+            — re-add a replacement vertex under the SAME name before
+            build(), or build() rejects the dangling reference."""
+            self._removed[name] = bool(remove_outputs)
             return self
 
         def add_layer(self, name: str, l: Layer, *inputs: str):
@@ -221,7 +227,10 @@ class TransferLearning:
                     raise ValueError(f"unknown vertex {r!r}")
                 mark(r)
 
-            # drop removed vertices and every vertex downstream of them
+            # drop cascade-removed vertices and every vertex downstream of
+            # them; keep-connections removals drop only the vertex itself
+            cascade = {n for n, ro in self._removed.items() if ro}
+            keep_conn = {n for n, ro in self._removed.items() if not ro}
             dropped: Set[str] = set()
             changed = True
             names_in_order = [n for n, _, _ in conf.vertices]
@@ -230,10 +239,11 @@ class TransferLearning:
                 for n in names_in_order:
                     if n in dropped:
                         continue
-                    if n in self._removed or any(
+                    if n in cascade or any(
                             i in dropped for i in producers[n]):
                         dropped.add(n)
                         changed = True
+            dropped |= keep_conn
 
             vertices: List[Tuple[str, Any, List[str]]] = []
             copy_names: Set[str] = set()
@@ -246,6 +256,18 @@ class TransferLearning:
                 vertices.append((n, v, list(ins)))
                 copy_names.add(n)
             vertices.extend(self._added)
+
+            # keep-connections removals leave consumers referencing the old
+            # name; a replacement vertex must have been re-added under it
+            avail = set(conf.inputs) | {n for n, _, _ in vertices}
+            for n, _, ins in vertices:
+                for i in ins:
+                    if i not in avail:
+                        raise ValueError(
+                            f"vertex {n!r} consumes {i!r}, which was removed "
+                            "(remove_outputs=False) and not re-added — "
+                            "add_layer/add_vertex a replacement with that "
+                            "name")
 
             outputs = self._outputs if self._outputs is not None else \
                 [o for o in conf.outputs if o not in dropped]

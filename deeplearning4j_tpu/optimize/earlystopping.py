@@ -172,13 +172,19 @@ class ScoreImprovementEpochTerminationCondition:
 
 
 class BestScoreEpochTerminationCondition:
-    """Stop as soon as the score is at least this good."""
+    """Stop as soon as the score is at least this good. ``value`` is in the
+    calculator's RAW orientation (a loss bound for minimizing calculators,
+    an accuracy bound for maximizing ones); the trainer tells us the sign it
+    normalizes scores with."""
 
     def __init__(self, value: float):
         self.value = float(value)
+        self._sign = 1.0  # set by EarlyStoppingTrainer.fit
 
     def terminate(self, epoch: int, score: float, best_score: float) -> bool:
-        return score <= self.value  # minimize orientation
+        # trainer passes score = sign * raw (minimize orientation); compare
+        # against the threshold in the same space
+        return score <= self._sign * self.value
 
     def __str__(self):
         return f"BestScore({self.value})"
@@ -303,6 +309,9 @@ class EarlyStoppingTrainer:
             raise ValueError("EarlyStoppingConfiguration needs a "
                              "score_calculator")
         sign = 1.0 if calc.minimize_score() else -1.0
+        for c in cfg.epoch_conditions:
+            if hasattr(c, "_sign"):
+                c._sign = sign  # conditions holding raw-orientation bounds
         best_score = float("nan")
         best_epoch = -1
         epoch = 0

@@ -1,0 +1,8 @@
+"""Parallelism: data-parallel training, multi-host launcher, sharded
+checkpoints (SURVEY.md §2.6/§2.8)."""
+
+from .data_parallel import ParallelWrapper, make_mesh  # noqa: F401
+from .launcher import (HostShardedIterator, global_mesh, initialize,  # noqa: F401
+                       is_multi_host, make_global_array, process_count,
+                       process_index, shutdown)
+from .checkpoint import TrainingCheckpointer  # noqa: F401

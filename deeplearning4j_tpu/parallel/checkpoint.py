@@ -1,0 +1,155 @@
+"""Preemption-safe sharded training checkpoints (orbax-backed).
+
+TPU-native equivalent of the reference's checkpoint/resume story
+(reference: ``ModelSerializer`` + ``CheckpointListener`` rotation per
+SURVEY.md §5 "Checkpoint / resume"; reference mount was empty, citations
+upstream-relative, unverified) — upgraded where SURVEY.md §5 flags the gap:
+the reference never captures data-iterator position, so resume replays or
+skips data. Here a checkpoint is {params, updater state, layer state, RNG
+key, counters, **iterator cursor**}: restore continues the exact example
+sequence (tested bit-exact in tests/test_checkpoint.py).
+
+Storage is `orbax.checkpoint` — on a pod each host writes only the shards
+it owns (OCDBT), which is the multi-host analog of the reference's
+single-file ZIP; the single-host interchange ZIP (``utils/serializer.py``)
+remains the portable format. Rotation (`max_to_keep`) mirrors
+CheckpointListener's keepLast semantics.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _config_equivalent(stored_json, live_json) -> bool:
+    """Architecture equality modulo the init seed: every checkpointed array
+    overwrites the fresh init, so a restarted job may build with a different
+    seed, but any structural difference means the weights don't belong to
+    this model."""
+    import json
+
+    if stored_json is None:
+        return True  # pre-config-check checkpoint (format v1 early saves)
+    a, b = json.loads(stored_json), json.loads(live_json)
+    a.pop("seed", None)
+    b.pop("seed", None)
+    return a == b
+
+
+class TrainingCheckpointer:
+    """Rotating, resumable training checkpoints for both engines.
+
+    Usage::
+
+        ckpt = TrainingCheckpointer(dir, max_to_keep=3)
+        ...
+        ckpt.save(net, iterator=it)               # inside the train loop
+        ...
+        step = ckpt.restore(net, iterator=it)     # after restart; None if none
+    """
+
+    def __init__(self, directory: str, max_to_keep: int = 3):
+        import orbax.checkpoint as ocp
+
+        self._ocp = ocp
+        self.directory = os.path.abspath(directory)
+        self._mngr = ocp.CheckpointManager(
+            self.directory,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep, create=True))
+
+    # -- save ---------------------------------------------------------------
+    def save(self, model, iterator=None, step: Optional[int] = None,
+             wait: bool = False) -> int:
+        """Checkpoint the full training state at ``step`` (default: the
+        model's iteration counter). Saves are async on the orbax side;
+        ``wait=True`` blocks until durable (use before deliberate exit)."""
+        ocp = self._ocp
+        step = int(model.iteration if step is None else step)
+        tree = {"params": model.params,
+                "rng_key": jax.random.key_data(model._key)
+                if jnp.issubdtype(model._key.dtype, jax.dtypes.prng_key)
+                else model._key}
+        # orbax rejects empty pytree nodes; BN-less models have state == {}
+        # and un-stepped models have updater_state == {} — save only what is
+        if model.state:
+            tree["state"] = model.state
+        if model.updater_state:
+            tree["updater"] = model.updater_state
+        meta = {"iteration": int(model.iteration), "epoch": int(model.epoch),
+                "model_class": type(model).__name__,
+                "configuration": model.conf.to_json(),
+                "iterator": dict(iterator.state()) if iterator is not None
+                else None,
+                "format": "deeplearning4j_tpu.parallel.checkpoint",
+                "version": 1}
+        self._mngr.save(step, args=ocp.args.Composite(
+            tree=ocp.args.PyTreeSave(tree),
+            meta=ocp.args.JsonSave(meta)))
+        if wait:
+            self._mngr.wait_until_finished()
+        return step
+
+    # -- restore ------------------------------------------------------------
+    def latest_step(self) -> Optional[int]:
+        return self._mngr.latest_step()
+
+    def restore(self, model, iterator=None,
+                step: Optional[int] = None) -> Optional[int]:
+        """Restore model (+ iterator cursor) in place from ``step`` (default
+        latest). Returns the restored step, or None when no checkpoint
+        exists (first launch) — callers can use that as the cold-start
+        signal. The model must be built from the same configuration; this is
+        asserted against the stored config JSON."""
+        ocp = self._ocp
+        if step is None:
+            step = self._mngr.latest_step()
+        if step is None:
+            return None
+        restored = self._mngr.restore(step, args=ocp.args.Composite(
+            tree=ocp.args.PyTreeRestore(),
+            meta=ocp.args.JsonRestore()))
+        tree, meta = restored["tree"], restored["meta"]
+        if meta["model_class"] != type(model).__name__:
+            raise ValueError(
+                f"checkpoint holds a {meta['model_class']}, restoring into "
+                f"a {type(model).__name__}")
+        if not _config_equivalent(meta.get("configuration"),
+                                  model.conf.to_json()):
+            raise ValueError(
+                "checkpoint configuration does not match the model being "
+                "restored into — rebuild the model from the same config "
+                "(the stored JSON is in meta['configuration'])")
+        if not model.params:
+            model.init()
+        model.params = jax.tree.map(jnp.asarray, tree["params"])
+        if "state" in tree:
+            model.state = jax.tree.map(jnp.asarray, tree["state"])
+        if "updater" in tree:
+            model.updater_state = jax.tree.map(jnp.asarray, tree["updater"])
+        key = np.asarray(tree["rng_key"])
+        model._key = jax.random.wrap_key_data(key) \
+            if jnp.issubdtype(model._key.dtype, jax.dtypes.prng_key) \
+            else jnp.asarray(key)
+        model.iteration = meta["iteration"]
+        model.epoch = meta["epoch"]
+        if iterator is not None and meta.get("iterator") is not None:
+            iterator.set_state(meta["iterator"])
+        return step
+
+    def wait_until_finished(self):
+        self._mngr.wait_until_finished()
+
+    def close(self):
+        self._mngr.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

@@ -75,7 +75,20 @@ class ParallelWrapper:
         def step_fn(params, opt_state, bn_state, step, key, x, y, fm, lm):
             return base(params, opt_state, bn_state, step, key, x, y, fm, lm)
 
-        put = jax.device_put
+        multi_host = jax.process_count() > 1
+
+        def put(t, sharding):
+            """Place one array with the given sharding. Multi-host: the
+            local numpy value is this host's shard (batch axis) or the
+            replicated value (params/state), assembled into a global array
+            via make_array_from_process_local_data; arrays already carrying
+            the target sharding (step outputs fed back in) pass through."""
+            if isinstance(t, jax.Array) and t.sharding == sharding:
+                return t
+            if multi_host:
+                return jax.make_array_from_process_local_data(
+                    sharding, np.asarray(t))
+            return jax.device_put(t, sharding)
 
         def shard_batch(t):
             """Batch-sharded placement for one array, a tuple of arrays
@@ -90,7 +103,8 @@ class ParallelWrapper:
             params = jax.tree.map(lambda a: put(a, repl), params)
             opt_state = jax.tree.map(lambda a: put(a, repl), opt_state)
             bn_state = jax.tree.map(lambda a: put(a, repl), bn_state)
-            return (params, opt_state, bn_state, step, key,
+            return (params, opt_state, bn_state,
+                    put(step, repl), put(key, repl),
                     shard_batch(x), shard_batch(y),
                     shard_batch(fm), shard_batch(lm))
 
@@ -123,8 +137,10 @@ class ParallelWrapper:
     def _batches(self, data):
         """Yield (x, y, fm, lm) step arguments — arrays for the sequential
         engine, tuples-of-arrays for the graph engine — ragged tails padded
-        to the mesh size and masked."""
-        n = self.mesh.devices.size
+        to the device count and masked. Multi-host: batches are HOST-LOCAL
+        shards (see launcher.HostShardedIterator), so the pad granularity is
+        the per-host device count, keeping every host's shard equal-sized."""
+        n = self.mesh.devices.size // jax.process_count()
         if self._is_graph:
             from ..nn.graph import _as_multi_iterator
             for mds in _as_multi_iterator(data):

@@ -1,0 +1,196 @@
+"""Multi-host control plane: jax.distributed launcher + per-host data sharding.
+
+TPU-native equivalent of the reference's multi-node orchestration layer
+(reference: ``dl4j-spark-parameterserver .../SharedTrainingMaster.java``,
+``nd4j .../parameterserver/distributed/v2/**`` — MeshOrganizer tree, Aeron
+UDP transport, heartbeats — per SURVEY.md §2.8/§3.4; reference mount was
+empty, citations upstream-relative, unverified).
+
+The entire transport/mesh/codec stack collapses into the JAX control plane
+(SURVEY.md §2.8 "TPU-native equivalent"): ``jax.distributed.initialize``
+brings up the coordination service (the MeshOrganizer/heartbeat analog —
+PJRT's distributed runtime does membership, barriers and health checks), and
+the hot gradient path is XLA AllReduce over ICI/DCN emitted by GSPMD — no
+parameter server, no gradient gossip. What this module keeps from the
+reference's contract: every host runs the same program on the same step,
+updates are deterministic, and each host reads its own shard of the data
+(Spark's per-executor RDD partitions → :class:`HostShardedIterator`).
+
+Typical pod usage (same script on every host)::
+
+    from deeplearning4j_tpu.parallel import launcher
+    launcher.initialize()                      # env-driven on TPU pods
+    mesh = launcher.global_mesh()              # all devices, all hosts
+    it = launcher.HostShardedIterator(base_iterator)
+    ParallelWrapper(net, mesh).fit(it, epochs=...)
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Sequence
+
+import numpy as np
+
+from ..data.dataset import DataSet, DataSetIterator
+
+_initialized = False
+
+
+def initialize(coordinator_address: Optional[str] = None,
+               num_processes: Optional[int] = None,
+               process_id: Optional[int] = None,
+               local_device_ids: Optional[Sequence[int]] = None) -> None:
+    """Bring up the multi-host JAX runtime (idempotent).
+
+    On TPU pods all arguments are auto-detected from the metadata/env by
+    ``jax.distributed.initialize``; pass them explicitly for CPU/GPU
+    clusters or simulated multi-host tests. Single-process callers may call
+    this unconditionally: with no coordinator configured anywhere it is a
+    no-op, so the same training script runs 1-host and N-host unchanged.
+    """
+    global _initialized
+    if _initialized:
+        return
+    import jax
+
+    if (coordinator_address is None and num_processes is None
+            and "JAX_COORDINATOR_ADDRESS" not in os.environ
+            and "COORDINATOR_ADDRESS" not in os.environ
+            and not _on_tpu_pod()):
+        return  # single-process: nothing to initialize
+    from jax._src import xla_bridge as _xb
+    if _xb.backends_are_initialized():
+        # a backend client predates us (e.g. an eager sitecustomize import);
+        # distributed init must come first, so tear the client down. Any
+        # jax.Array created before this point is invalidated — call
+        # initialize() at program start, before building models.
+        _xb._clear_backends()
+        jax.clear_caches()
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+        local_device_ids=local_device_ids)
+    _initialized = True
+
+
+def _on_tpu_pod() -> bool:
+    """True when TPU pod env vars indicate a MULTI-host slice (single-host
+    TPU VMs also set TPU_WORKER_HOSTNAMES — with one entry)."""
+    if "MEGASCALE_COORDINATOR_ADDRESS" in os.environ:
+        return True
+    hosts = os.environ.get("TPU_WORKER_HOSTNAMES", "")
+    return len([h for h in hosts.split(",") if h.strip()]) > 1
+
+
+def shutdown() -> None:
+    global _initialized
+    if _initialized:
+        import jax
+        jax.distributed.shutdown()
+        _initialized = False
+
+
+def process_index() -> int:
+    import jax
+    return jax.process_index()
+
+
+def process_count() -> int:
+    import jax
+    return jax.process_count()
+
+
+def is_multi_host() -> bool:
+    return process_count() > 1
+
+
+def global_mesh(axis: str = "data", devices: Optional[Sequence] = None):
+    """Mesh over ALL devices of ALL hosts (the pod-wide data axis)."""
+    from .data_parallel import make_mesh
+
+    return make_mesh(devices, axis)
+
+
+def make_global_array(local_data, mesh, spec):
+    """Assemble a global jax.Array from this host's shard of the data.
+
+    ``spec=P('data')`` treats ``local_data`` as this host's contiguous slice
+    of the global batch (global batch = per-host batch x process_count);
+    ``spec=P()`` treats it as a fully-replicated value (must be identical on
+    every host). Single-host this degrades to a plain device_put.
+    """
+    import jax
+    from jax.sharding import NamedSharding
+
+    sharding = NamedSharding(mesh, spec)
+    arr = np.asarray(local_data)
+    if jax.process_count() == 1:
+        return jax.device_put(arr, sharding)
+    return jax.make_array_from_process_local_data(sharding, arr)
+
+
+class HostShardedIterator(DataSetIterator):
+    """Each host sees its contiguous 1/N slice of every global batch.
+
+    The multi-host analog of Spark's per-executor partitions: the base
+    iterator is assumed identical on every host (same seed → same shuffle
+    permutation, guaranteed by NumpyDataSetIterator's (seed, epoch) perms),
+    and host ``p`` takes rows ``[p*k, (p+1)*k)`` of each batch. Combined with
+    :func:`make_global_array` / ParallelWrapper, the slices re-assemble into
+    the global batch in host order. The restorable cursor delegates to the
+    base, so checkpoint/resume works unchanged.
+    """
+
+    def __init__(self, base: DataSetIterator,
+                 process_id: Optional[int] = None,
+                 num_processes: Optional[int] = None):
+        self._base = base
+        self._pid = process_index() if process_id is None else process_id
+        self._n = process_count() if num_processes is None else num_processes
+
+    def batch_size(self) -> int:
+        return max(1, self._base.batch_size() // self._n)
+
+    def reset(self):
+        self._base.reset()
+
+    def state(self) -> dict:
+        return self._base.state()
+
+    def set_state(self, state: dict):
+        self._base.set_state(state)
+
+    def _slice(self, a, lo, hi):
+        return None if a is None else a[lo:hi]
+
+    def __iter__(self):
+        for ds in self._base:
+            b = ds.num_examples()
+            # pad the global batch to a per-host-equal size; the extra rows
+            # land on the tail hosts and are masked out of the loss
+            k = (b + self._n - 1) // self._n
+            ragged = k * self._n != b
+            lo, hi = min(self._pid * k, b), min((self._pid + 1) * k, b)
+            feats = ds.features[lo:hi]
+            labels = self._slice(ds.labels, lo, hi)
+            fm = self._slice(ds.features_mask, lo, hi)
+            lm = self._slice(ds.labels_mask, lo, hi)
+            short = k - feats.shape[0]
+            if short:
+                def zpad(a):
+                    if a is None:
+                        return None
+                    return np.pad(a, [(0, short)] + [(0, 0)] * (a.ndim - 1))
+                feats, labels, fm, lm = (zpad(feats), zpad(labels),
+                                         zpad(fm), zpad(lm))
+            if ragged and lm is None:
+                # EVERY host must synthesize the mask, not just the short
+                # ones: hosts are SPMD — if some passed lm=None and others an
+                # array, the per-host programs (and their collectives) would
+                # diverge and the step would hang at the first AllReduce
+                lm = np.ones((k,), dtype=np.float32)
+                if short:
+                    lm[-short:] = 0.0
+            yield DataSet(feats, labels, fm, lm)

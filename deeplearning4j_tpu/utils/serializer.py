@@ -13,9 +13,11 @@ Format mirrors the reference's ZIP contract:
   ``state.npz``            — layer state (BN running stats)
   ``updaterState.npz``     — updater state (Adam m/v etc.) when saved
   ``normalizer.json``      — fitted normalizer statistics when provided
-  ``meta.json``            — iteration/epoch counters (DL4J loses the
-                             iterator position — recorded gap we fix at the
-                             trainer level)
+  ``meta.json``            — iteration/epoch counters
+  ``iterator.json``        — data-iterator cursor when provided (DL4J loses
+                             the iterator position — SURVEY.md §5 gap; see
+                             also parallel/checkpoint.py which captures it
+                             in sharded checkpoints)
 
 Large-scale sharded checkpoints (multi-host) use the orbax-backed
 checkpointer in ``parallel/checkpoint.py``; this ZIP format is the
@@ -86,7 +88,8 @@ def _npz_bytes_to_tree(data: bytes) -> dict:
     return tree
 
 
-def save_model(model, path: str, save_updater: bool = True, normalizer=None):
+def save_model(model, path: str, save_updater: bool = True, normalizer=None,
+               iterator=None):
     with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as zf:
         zf.writestr("configuration.json", model.conf.to_json())
         zf.writestr("coefficients.npz", _tree_to_npz_bytes(model.params))
@@ -95,6 +98,8 @@ def save_model(model, path: str, save_updater: bool = True, normalizer=None):
             zf.writestr("updaterState.npz", _tree_to_npz_bytes(model.updater_state))
         if normalizer is not None:
             zf.writestr("normalizer.json", json.dumps(normalizer.to_state()))
+        if iterator is not None:
+            zf.writestr("iterator.json", json.dumps(iterator.state()))
         zf.writestr("meta.json", json.dumps(
             {"iteration": model.iteration, "epoch": model.epoch,
              "format": "deeplearning4j_tpu", "version": 1}))
@@ -126,6 +131,15 @@ def load_model(path: str, load_updater: bool = True):
             model.iteration = meta.get("iteration", 0)
             model.epoch = meta.get("epoch", 0)
     return model
+
+
+def load_iterator_state(path: str) -> Optional[dict]:
+    """Read the data-iterator cursor from a checkpoint zip (pass it to
+    ``iterator.set_state``); None when the save didn't capture one."""
+    with zipfile.ZipFile(path, "r") as zf:
+        if "iterator.json" not in zf.namelist():
+            return None
+        return json.loads(zf.read("iterator.json"))
 
 
 def load_normalizer(path: str):

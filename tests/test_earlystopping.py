@@ -139,3 +139,28 @@ def test_local_file_saver_roundtrip(tmp_path):
     assert (tmp_path / "latestModel.zip").exists()
     best = saver.get_best_model()
     assert best.num_params() == _net().num_params()
+
+
+def test_best_score_condition_maximize_orientation():
+    """BestScoreEpochTerminationCondition(0.9) with a MAXIMIZING calculator
+    must not fire until the metric actually reaches 0.9 (regression: the
+    sign-flipped score was compared against the raw threshold, stopping
+    immediately at any accuracy)."""
+    from deeplearning4j_tpu.optimize.earlystopping import (
+        BestScoreEpochTerminationCondition, ClassificationScoreCalculator)
+
+    x, y = _xor(128, seed=3)
+    test_it = NumpyDataSetIterator(x, y, 32)
+    calc = ClassificationScoreCalculator(test_it)
+    cond = BestScoreEpochTerminationCondition(0.999)  # nearly unreachable
+    cfg = EarlyStoppingConfiguration(
+        score_calculator=calc,
+        epoch_termination_conditions=[cond,
+                                      MaxEpochsTerminationCondition(3)],
+        model_saver=InMemoryModelSaver(), evaluate_every_n_epochs=1)
+    net = _net(lr=0.05)
+    result = EarlyStoppingTrainer(
+        cfg, net, NumpyDataSetIterator(x, y, 32, shuffle=True, seed=1)).fit()
+    # ran all 3 epochs: the 0.999-accuracy bar was never met
+    assert "MaxEpochs" in result.termination_details
+    assert result.total_epochs == 3

@@ -1,0 +1,101 @@
+"""Simulated 2-host data-parallel training (SURVEY.md §4 "Distributed w/o
+cluster": the reference fakes clusters with threads + loopback UDP; our
+analog is two real processes, each with 4 virtual CPU devices, joined by
+``jax.distributed`` — an 8-device global mesh across 2 "hosts").
+
+Asserts: launcher initializes, HostShardedIterator feeds each host its
+slice, ParallelWrapper trains over the global mesh, and the resulting
+(replicated) params are identical across hosts and finite.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+_WORKER = textwrap.dedent("""
+    import os, sys
+    import numpy as np
+
+    port, pid = sys.argv[1], int(sys.argv[2])
+
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    from deeplearning4j_tpu.parallel import launcher
+    launcher.initialize(coordinator_address=f"127.0.0.1:{port}",
+                        num_processes=2, process_id=pid)
+    assert jax.process_count() == 2, jax.process_count()
+    assert len(jax.devices()) == 8, len(jax.devices())
+
+    from deeplearning4j_tpu.data.dataset import NumpyDataSetIterator
+    from deeplearning4j_tpu.nn.config import InputType, NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.layers.core import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.model import MultiLayerNetwork
+    from deeplearning4j_tpu.nn.updaters import Sgd
+    from deeplearning4j_tpu.parallel.data_parallel import ParallelWrapper
+
+    conf = (NeuralNetConfiguration.builder()
+            .seed(0).updater(Sgd(learning_rate=0.1))
+            .input_type(InputType.feed_forward(6))
+            .list(DenseLayer(n_out=16, activation="tanh"),
+                  OutputLayer(n_out=3))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+
+    rng = np.random.default_rng(0)  # same data on every host; iterator shards
+    x = rng.normal(size=(64, 6)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 64)]
+    base = NumpyDataSetIterator(x, y, batch_size=16, shuffle=True, seed=4)
+    it = launcher.HostShardedIterator(base)
+    assert it.batch_size() == 8  # 16-global split over 2 hosts
+
+    mesh = launcher.global_mesh()
+    ParallelWrapper(net, mesh).fit(it, epochs=2)
+
+    loss = float(net.score())
+    assert np.isfinite(loss), loss
+
+    from jax.experimental import multihost_utils
+    flat = np.concatenate([np.asarray(a).ravel()
+                           for _, a in sorted(
+                               jax.tree_util.tree_leaves_with_path(net.params),
+                               key=lambda kv: str(kv[0]))])
+    gathered = multihost_utils.process_allgather(flat)
+    assert gathered.shape[0] == 2
+    np.testing.assert_array_equal(gathered[0], gathered[1])
+    print(f"host {pid}: ok loss={loss:.4f}")
+    launcher.shutdown()
+""")
+
+
+def test_two_process_data_parallel(tmp_path):
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+
+    script = tmp_path / "worker.py"
+    script.write_text(_WORKER)
+    env = dict(os.environ,
+               JAX_PLATFORMS="cpu",
+               XLA_FLAGS="--xla_force_host_platform_device_count=4",
+               PYTHONPATH=os.path.dirname(os.path.dirname(__file__)))
+    procs = [subprocess.Popen([sys.executable, str(script), str(port), str(i)],
+                              env=env, stdout=subprocess.PIPE,
+                              stderr=subprocess.STDOUT, text=True)
+             for i in range(2)]
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=240)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        outs.append(out)
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"host {i} failed:\n{out}"
+        assert f"host {i}: ok" in out

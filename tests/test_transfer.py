@@ -186,3 +186,40 @@ def test_transfer_helper_fit_featurized_trains_tail():
     np.testing.assert_array_equal(np.asarray(frozen.params["0"]["W"]),
                                   w_frozen)
     assert np.abs(np.asarray(frozen.params["1"]["W"]) - w_tail).max() > 1e-6
+
+
+def test_graph_remove_vertex_keep_connections():
+    """remove_vertex(..., remove_outputs=False) keeps downstream vertices;
+    a replacement re-added under the same name satisfies them (regression:
+    the flag was ignored and downstream was always dropped)."""
+    conf = (NeuralNetConfiguration.builder()
+            .seed(7)
+            .updater(Adam(learning_rate=0.05))
+            .graph_builder()
+            .add_inputs("in")
+            .set_input_types(InputType.feed_forward(2))
+            .add_layer("d1", DenseLayer(n_out=8, activation="tanh"), "in")
+            .add_layer("d2", DenseLayer(n_out=8, activation="relu"), "d1")
+            .add_layer("out", OutputLayer(n_out=2), "d2")
+            .set_outputs("out")
+            .build())
+    g = ComputationGraph(conf).init()
+    new = (TransferLearning.GraphBuilder(g)
+           .remove_vertex("d1", remove_outputs=False)
+           .add_layer("d1", DenseLayer(n_out=8, activation="elu"), "in")
+           .build())
+    names = [n for n, _, _ in new.conf.vertices]
+    assert set(names) == {"d1", "d2", "out"}  # downstream survived
+    vmap = {n: v for n, v, _ in new.conf.vertices}
+    assert vmap["d1"].layer.activation == "elu"  # replacement in place
+    # d2/out params carried over; replacement d1 is fresh
+    np.testing.assert_array_equal(np.asarray(new.params["d2"]["W"]),
+                                  np.asarray(g.params["d2"]["W"]))
+    assert new.output(np.zeros((4, 2), np.float32)).shape == (4, 2)
+
+    # dangling reference without a replacement is rejected
+    import pytest
+    with pytest.raises(ValueError, match="not re-added"):
+        (TransferLearning.GraphBuilder(g)
+         .remove_vertex("d1", remove_outputs=False)
+         .build())
