@@ -1,0 +1,100 @@
+"""CIFAR-10 canned dataset.
+
+TPU-native equivalent of DL4J's ``Cifar10DataSetIterator`` (reference:
+``deeplearning4j-datasets .../iterator/impl/Cifar10DataSetIterator.java``
++ fetcher† per SURVEY.md §2.5; reference mount was empty, citations
+upstream-relative, unverified).
+
+Sources, in order:
+1. **Local binary-version files** (``data_batch_*.bin`` / ``test_batch.bin``,
+   the canonical 3073-byte-record format) under ``$DL4J_TPU_DATA/cifar10``
+   or ``~/.deeplearning4j_tpu/cifar10`` — the reference downloads these; this
+   environment has zero egress, so we only read pre-placed files.
+2. **Synthetic fallback**: seeded class-conditional color blobs with the
+   right shapes/dtypes so shape-level pipelines (zoo models, benchmarks)
+   run anywhere. ``.source`` records which path was taken; accuracy claims
+   are only meaningful for "bin".
+
+Layout is NHWC float32 in [0,255] (TPU-first; the bin format is
+channel-planar and is transposed on load) — pair with ImageScaler/
+Standardize normalizers.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from .dataset import NumpyDataSetIterator
+
+LABELS = ["airplane", "automobile", "bird", "cat", "deer",
+          "dog", "frog", "horse", "ship", "truck"]
+
+
+def _data_root() -> str:
+    return os.environ.get(
+        "DL4J_TPU_DATA",
+        os.path.join(os.path.expanduser("~"), ".deeplearning4j_tpu"))
+
+
+def _find_bins(train: bool) -> Optional[List[str]]:
+    root = os.path.join(_data_root(), "cifar10")
+    if not os.path.isdir(root):
+        return None
+    names = ([f"data_batch_{i}.bin" for i in range(1, 6)] if train
+             else ["test_batch.bin"])
+    paths = []
+    for dirpath, _, files in os.walk(root):
+        for n in names:
+            if n in files:
+                paths.append(os.path.join(dirpath, n))
+    return sorted(paths) or None
+
+
+def _read_bin(paths: List[str]) -> Tuple[np.ndarray, np.ndarray]:
+    """3073-byte records: 1 label byte + 3072 channel-planar pixels."""
+    xs, ys = [], []
+    for p in paths:
+        raw = np.fromfile(p, dtype=np.uint8).reshape(-1, 3073)
+        ys.append(raw[:, 0])
+        xs.append(raw[:, 1:].reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1))
+    x = np.concatenate(xs).astype(np.float32)
+    y = np.concatenate(ys).astype(np.int64)
+    return x, y
+
+
+def _synthetic(n: int, seed: int) -> Tuple[np.ndarray, np.ndarray]:
+    """Class-conditional colored blobs on textured backgrounds: linearly
+    separable enough that a convnet's loss visibly decreases, honest enough
+    that nobody mistakes it for CIFAR accuracy."""
+    rng = np.random.default_rng(seed)
+    labels = rng.integers(0, 10, n)
+    x = rng.normal(120.0, 30.0, size=(n, 32, 32, 3)).astype(np.float32)
+    yy, xx = np.mgrid[0:32, 0:32]
+    for i, c in enumerate(labels):
+        cy, cx = 8 + 2 * (c % 4), 8 + 2 * (c // 4)
+        blob = np.exp(-(((yy - cy) ** 2 + (xx - cx) ** 2) / 40.0))
+        color = np.array([(c * 37) % 256, (c * 73) % 256, (c * 151) % 256],
+                         dtype=np.float32)
+        x[i] += blob[:, :, None] * color[None, None, :]
+    return np.clip(x, 0, 255), labels.astype(np.int64)
+
+
+class Cifar10DataSetIterator(NumpyDataSetIterator):
+    def __init__(self, batch_size: int, train: bool = True, seed: int = 12,
+                 num_examples: Optional[int] = None, shuffle: bool = True):
+        paths = _find_bins(train)
+        if paths:
+            x, y = _read_bin(paths)
+            self.source = "bin"
+        else:
+            n = num_examples or (10000 if train else 2000)
+            x, y = _synthetic(n, seed if train else seed + 1)
+            self.source = "synthetic"
+        if num_examples is not None:
+            x, y = x[:num_examples], y[:num_examples]
+        onehot = np.eye(10, dtype=np.float32)[y]
+        super().__init__(x, onehot, batch_size, shuffle=shuffle, seed=seed)
+        self.labels = list(LABELS)

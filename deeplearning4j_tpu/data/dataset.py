@@ -32,6 +32,17 @@ class DataSet:
     def num_examples(self) -> int:
         return int(self.features.shape[0])
 
+    def copy(self) -> "DataSet":
+        """Shallow copy: new DataSet object over the same arrays. Enough to
+        protect a stored batch from normalizers, which REASSIGN fields
+        rather than mutating arrays in place."""
+        out = DataSet.__new__(DataSet)
+        out.features = self.features
+        out.labels = self.labels
+        out.features_mask = self.features_mask
+        out.labels_mask = self.labels_mask
+        return out
+
     def split_test_and_train(self, n_train: int):
         a = DataSet(self.features[:n_train], self.labels[:n_train],
                     None if self.features_mask is None else self.features_mask[:n_train],
@@ -155,6 +166,8 @@ class DataSetIterator:
     and ``set_state()`` resumes iteration exactly there, so preemption-safe
     checkpoints can capture the data cursor (``parallel/checkpoint.py``)."""
 
+    pre_processor = None  # DataSetPreProcessor (a Normalizer), applied per batch
+
     def __iter__(self) -> Iterator[DataSet]:
         raise NotImplementedError
 
@@ -163,6 +176,18 @@ class DataSetIterator:
 
     def batch_size(self) -> int:
         raise NotImplementedError
+
+    def set_pre_processor(self, pp) -> "DataSetIterator":
+        """Attach a per-batch preprocessor (DL4J ``setPreProcessor``):
+        any fitted Normalizer — each yielded DataSet passes through
+        ``pp.transform`` before the consumer sees it."""
+        self.pre_processor = pp
+        return self
+
+    def _pp(self, ds: DataSet) -> DataSet:
+        if self.pre_processor is not None:
+            self.pre_processor.transform(ds)
+        return ds
 
     def state(self) -> dict:
         """Restorable cursor. Default: empty (non-resumable iterators)."""
@@ -229,10 +254,11 @@ class NumpyDataSetIterator(DataSetIterator):
         while self._pos < end:
             j = idx[self._pos:self._pos + self._bs]
             self._pos += self._bs
-            yield DataSet(self._f[j],
-                          None if self._l is None else self._l[j],
-                          None if self._fm is None else self._fm[j],
-                          None if self._lm is None else self._lm[j])
+            yield self._pp(DataSet(
+                self._f[j],
+                None if self._l is None else self._l[j],
+                None if self._fm is None else self._fm[j],
+                None if self._lm is None else self._lm[j]))
         self._epoch += 1
         self._pos = 0
 
@@ -260,7 +286,11 @@ class ListDataSetIterator(DataSetIterator):
         while self._pos < len(self._batches):
             b = self._batches[self._pos]
             self._pos += 1
-            yield b
+            # copy before preprocessing: these batch objects are STORED and
+            # re-yielded every epoch — transforming them in place would
+            # compound the normalizer once per epoch
+            yield self._pp(b.copy()) if self.pre_processor is not None \
+                else b
         self._pos = 0
 
 
@@ -270,6 +300,12 @@ class AsyncDataSetIterator(DataSetIterator):
     Overlaps host-side batch prep with device compute. Queue depth 2-4 is
     plenty — the jitted step is async-dispatched anyway, so this only needs
     to hide ETL latency, not device latency.
+
+    Resume semantics match the sync iterators: abandoning a pass exactly at
+    the epoch's last batch leaves the cursor at "remainder = nothing", so
+    the NEXT pass yields zero batches (the remainder) and the pass after
+    that yields the following epoch — consumers that count epochs should
+    abandon via ``reset()`` when they mean "start over".
     """
 
     def __init__(self, base: DataSetIterator, queue_size: int = 4):
@@ -350,7 +386,10 @@ class AsyncDataSetIterator(DataSetIterator):
                     self._consumed += 1
                     continue
                 self._consumed += 1
-                yield item
+                # copy-then-transform: the base may re-yield stored batch
+                # objects (ListDataSetIterator), which must not be mutated
+                yield self._pp(item.copy()) \
+                    if self.pre_processor is not None else item
         finally:
             if not clean:
                 # consumer abandoned mid-epoch (break / exception / error):

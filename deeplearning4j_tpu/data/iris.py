@@ -1,0 +1,37 @@
+"""Iris canned dataset.
+
+TPU-native equivalent of DL4J's ``IrisDataSetIterator`` (reference:
+``deeplearning4j-datasets .../iterator/impl/IrisDataSetIterator.java``† per
+SURVEY.md §2.5; reference mount was empty, citation upstream-relative,
+unverified).
+
+Data source: scikit-learn's bundled copy of the classic 150-sample Fisher
+dataset (ships with the library — no network access needed, matching the
+reference's bundled-resource approach).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .dataset import NumpyDataSetIterator
+
+
+def load_iris_arrays():
+    """-> (features [150,4] float32, one-hot labels [150,3] float32)."""
+    from sklearn.datasets import load_iris
+
+    d = load_iris()
+    x = d.data.astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[d.target]
+    return x, y
+
+
+class IrisDataSetIterator(NumpyDataSetIterator):
+    """DL4J constructor shape: ``IrisDataSetIterator(batch, num_examples)``."""
+
+    def __init__(self, batch_size: int = 150, num_examples: int = 150,
+                 shuffle: bool = False, seed: int = 123):
+        x, y = load_iris_arrays()
+        x, y = x[:num_examples], y[:num_examples]
+        super().__init__(x, y, batch_size, shuffle=shuffle, seed=seed)

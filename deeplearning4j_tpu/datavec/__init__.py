@@ -1,0 +1,18 @@
+"""datavec-equivalent ETL: record readers, transform DSL, image pipeline
+(SURVEY.md §2.3).
+
+The reference's Writable type system (Java's boxed-value hierarchy) is
+replaced by plain Python/numpy values — a record is a list of values, a
+sequence record a list of lists — which is the idiomatic host-side format
+feeding the numpy→device pipeline.
+"""
+
+from .records import (CSVRecordReader, CSVSequenceRecordReader,  # noqa: F401
+                      CollectionRecordReader, FileSplit, InputSplit,
+                      LineRecordReader, RecordReader)
+from .schema import (DataAnalysis, Schema, TransformProcess)  # noqa: F401
+from .iterator import (RecordReaderDataSetIterator,  # noqa: F401
+                       SequenceRecordReaderDataSetIterator)
+from .image import (CenterCropImageTransform, FlipImageTransform,  # noqa: F401
+                    ImageRecordReader, PipelineImageTransform,
+                    RandomCropImageTransform, ResizeImageTransform)

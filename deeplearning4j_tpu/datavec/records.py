@@ -1,0 +1,230 @@
+"""Record readers + input splits.
+
+TPU-native equivalent of datavec's reader layer (reference:
+``datavec-api .../records/reader/impl/{csv/CSVRecordReader,LineRecordReader,
+collection/CollectionRecordReader,csv/CSVSequenceRecordReader}.java`` and
+``.../split/FileSplit.java``† per SURVEY.md §2.3; reference mount was empty,
+citations upstream-relative, unverified).
+
+A record is a list of values (str until a TransformProcess/iterator types
+them); a sequence record is a list of records. Readers are restartable
+(``reset``) and expose a restorable cursor (``state``/``set_state``) so the
+preemption-safe checkpoint story (parallel/checkpoint.py) extends to
+file-backed pipelines.
+"""
+
+from __future__ import annotations
+
+import csv as _csv
+import io
+import os
+from typing import Iterator, List, Optional, Sequence
+
+
+class InputSplit:
+    """Where the data lives (reference ``InputSplit``†): a list of URIs
+    (here: paths) plus iteration order."""
+
+    def locations(self) -> List[str]:
+        raise NotImplementedError
+
+
+class FileSplit(InputSplit):
+    """Root path → files, optionally filtered by extension and shuffled
+    with a seed (reference ``FileSplit``†)."""
+
+    def __init__(self, root: str, allowed_extensions: Optional[Sequence[str]] = None,
+                 recursive: bool = True, seed: Optional[int] = None):
+        self.root = root
+        self.allowed = (tuple(e.lower().lstrip(".") for e in allowed_extensions)
+                        if allowed_extensions else None)
+        self.recursive = recursive
+        self.seed = seed
+
+    def locations(self) -> List[str]:
+        out: List[str] = []
+        if os.path.isfile(self.root):
+            out = [self.root]
+        else:
+            for dirpath, dirnames, filenames in os.walk(self.root):
+                dirnames.sort()
+                for f in sorted(filenames):
+                    if self.allowed is None or \
+                            f.rsplit(".", 1)[-1].lower() in self.allowed:
+                        out.append(os.path.join(dirpath, f))
+                if not self.recursive:
+                    break
+        if self.seed is not None:
+            import numpy as np
+            rng = np.random.default_rng(self.seed)
+            out = [out[i] for i in rng.permutation(len(out))]
+        return out
+
+
+class RecordReader:
+    """Iterable of records with reset + restorable cursor."""
+
+    def __iter__(self) -> Iterator[list]:
+        raise NotImplementedError
+
+    def reset(self):
+        pass
+
+    def state(self) -> dict:
+        return {}
+
+    def set_state(self, state: dict):
+        pass
+
+
+class _CursorReader(RecordReader):
+    """Base for readers over a materialized list of records."""
+
+    def __init__(self):
+        self._pos = 0
+
+    def _records(self) -> List:
+        raise NotImplementedError
+
+    def __len__(self):
+        return len(self._records())
+
+    def reset(self):
+        self._pos = 0
+
+    def state(self) -> dict:
+        return {"pos": self._pos}
+
+    def set_state(self, state: dict):
+        self._pos = int(state.get("pos", 0))
+
+    def __iter__(self):
+        recs = self._records()
+        while self._pos < len(recs):
+            r = recs[self._pos]
+            self._pos += 1
+            yield r
+        self._pos = 0
+
+
+class CSVRecordReader(_CursorReader):
+    """One record per CSV row (reference ``CSVRecordReader``†:
+    skip-lines + delimiter + quote semantics)."""
+
+    def __init__(self, skip_lines: int = 0, delimiter: str = ",",
+                 quote: str = '"'):
+        super().__init__()
+        self.skip_lines = skip_lines
+        self.delimiter = delimiter
+        self.quote = quote
+        self._rows: Optional[List[list]] = None
+        self._source: Optional[str] = None
+
+    def initialize(self, split) -> "CSVRecordReader":
+        """split: InputSplit, a path, or raw CSV text via ``from_text``.
+        Files are parsed SEPARATELY — skip_lines applies per file (every
+        file's header is skipped, matching the reference), and a missing
+        trailing newline cannot merge the last row of one file with the
+        first row of the next."""
+        if isinstance(split, InputSplit):
+            paths = split.locations()
+        else:
+            paths = [split]
+        rows: List[list] = []
+        for p in paths:
+            rows.extend(self._parse_text(open(p, "r", newline="").read()))
+        self._source = ",".join(paths)
+        self._rows = rows
+        self._pos = 0
+        return self
+
+    def from_text(self, text: str) -> "CSVRecordReader":
+        self._source = "<text>"
+        self._rows = self._parse_text(text)
+        self._pos = 0
+        return self
+
+    def _parse_text(self, text: str) -> List[list]:
+        rows = list(_csv.reader(io.StringIO(text), delimiter=self.delimiter,
+                                quotechar=self.quote))
+        return [r for r in rows[self.skip_lines:] if r]  # drop blank lines
+
+    def _records(self):
+        if self._rows is None:
+            raise RuntimeError("call initialize(split) or from_text(csv) first")
+        return self._rows
+
+
+class LineRecordReader(_CursorReader):
+    """One record per line: ``[line]`` (reference ``LineRecordReader``†)."""
+
+    def __init__(self):
+        super().__init__()
+        self._lines: Optional[List[list]] = None
+
+    def initialize(self, split) -> "LineRecordReader":
+        paths = split.locations() if isinstance(split, InputSplit) else [split]
+        lines: List[list] = []
+        for p in paths:
+            with open(p, "r") as f:
+                lines.extend([ln.rstrip("\n")] for ln in f)
+        self._lines = lines
+        self._pos = 0
+        return self
+
+    def from_text(self, text: str) -> "LineRecordReader":
+        self._lines = [[ln] for ln in text.splitlines()]
+        self._pos = 0
+        return self
+
+    def _records(self):
+        if self._lines is None:
+            raise RuntimeError("call initialize(split) first")
+        return self._lines
+
+
+class CollectionRecordReader(_CursorReader):
+    """Records from an in-memory collection (reference
+    ``CollectionRecordReader``†)."""
+
+    def __init__(self, records: Sequence[Sequence]):
+        super().__init__()
+        self._recs = [list(r) for r in records]
+
+    def _records(self):
+        return self._recs
+
+
+class CSVSequenceRecordReader(_CursorReader):
+    """One SEQUENCE per file: each file's rows form the timesteps
+    (reference ``CSVSequenceRecordReader``†). Yields list-of-records."""
+
+    def __init__(self, skip_lines: int = 0, delimiter: str = ","):
+        super().__init__()
+        self.skip_lines = skip_lines
+        self.delimiter = delimiter
+        self._seqs: Optional[List[List[list]]] = None
+
+    def initialize(self, split) -> "CSVSequenceRecordReader":
+        paths = split.locations() if isinstance(split, InputSplit) else [split]
+        seqs = []
+        for p in paths:
+            rows = list(_csv.reader(open(p, "r", newline=""),
+                                    delimiter=self.delimiter))
+            seqs.append([r for r in rows[self.skip_lines:] if r])
+        self._seqs = seqs
+        self._pos = 0
+        return self
+
+    def from_texts(self, texts: Sequence[str]) -> "CSVSequenceRecordReader":
+        self._seqs = []
+        for t in texts:
+            rows = list(_csv.reader(io.StringIO(t), delimiter=self.delimiter))
+            self._seqs.append([r for r in rows[self.skip_lines:] if r])
+        self._pos = 0
+        return self
+
+    def _records(self):
+        if self._seqs is None:
+            raise RuntimeError("call initialize(split) first")
+        return self._seqs

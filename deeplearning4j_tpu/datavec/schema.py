@@ -1,0 +1,362 @@
+"""Schema + TransformProcess: the column-transform mini-DSL.
+
+TPU-native equivalent of datavec's transform layer (reference:
+``datavec-api .../transform/schema/Schema.java``,
+``.../transform/TransformProcess.java``, column transforms/filters/analysis
+under ``.../transform/**``† per SURVEY.md §2.3; reference mount was empty,
+citations upstream-relative, unverified).
+
+The reference's builder-of-serializable-ops design is kept (a
+TransformProcess is a list of named steps with a JSON round-trip — the
+persistence contract that lets a fitted pipeline ship with a model); the
+execution engine is plain Python over list-records, which is the right
+altitude here: transforms run host-side at ETL time, the device only ever
+sees the resulting numpy batches.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+INTEGER = "integer"
+DOUBLE = "double"
+STRING = "string"
+CATEGORICAL = "categorical"
+
+
+class Schema:
+    """Typed column list (reference ``Schema``† with the same builder
+    spellings)."""
+
+    def __init__(self, columns: Optional[List[dict]] = None):
+        self.columns = columns or []
+
+    # -- builder --
+    @staticmethod
+    def builder() -> "Schema":
+        return Schema()
+
+    def add_column_integer(self, name: str) -> "Schema":
+        self.columns.append({"name": name, "type": INTEGER})
+        return self
+
+    def add_column_double(self, name: str) -> "Schema":
+        self.columns.append({"name": name, "type": DOUBLE})
+        return self
+
+    def add_column_string(self, name: str) -> "Schema":
+        self.columns.append({"name": name, "type": STRING})
+        return self
+
+    def add_column_categorical(self, name: str, *state_names: str) -> "Schema":
+        self.columns.append({"name": name, "type": CATEGORICAL,
+                             "states": list(state_names)})
+        return self
+
+    def build(self) -> "Schema":
+        return self
+
+    # -- introspection --
+    def names(self) -> List[str]:
+        return [c["name"] for c in self.columns]
+
+    def index_of(self, name: str) -> int:
+        for i, c in enumerate(self.columns):
+            if c["name"] == name:
+                return i
+        raise KeyError(f"no column {name!r}; have {self.names()}")
+
+    def column(self, name: str) -> dict:
+        return self.columns[self.index_of(name)]
+
+    def num_columns(self) -> int:
+        return len(self.columns)
+
+    def to_json(self) -> str:
+        return json.dumps({"columns": self.columns})
+
+    @staticmethod
+    def from_json(js: str) -> "Schema":
+        return Schema(json.loads(js)["columns"])
+
+
+def _to_float(v) -> float:
+    return float(v)
+
+
+class TransformProcess:
+    """Ordered steps over (schema, records). Build with the fluent builder,
+    execute with :meth:`execute`; JSON round-trip mirrors the reference's
+    serialized TransformProcess contract."""
+
+    def __init__(self, initial_schema: Schema, steps: Optional[List[dict]] = None):
+        self.initial_schema = initial_schema
+        self.steps = steps or []
+
+    class Builder:
+        def __init__(self, schema: Schema):
+            self._schema = schema
+            self._steps: List[dict] = []
+
+        def remove_columns(self, *names: str):
+            self._steps.append({"op": "remove_columns", "names": list(names)})
+            return self
+
+        def remove_all_columns_except(self, *names: str):
+            self._steps.append({"op": "keep_columns", "names": list(names)})
+            return self
+
+        def rename_column(self, old: str, new: str):
+            self._steps.append({"op": "rename", "old": old, "new": new})
+            return self
+
+        def categorical_to_integer(self, *names: str):
+            self._steps.append({"op": "cat_to_int", "names": list(names)})
+            return self
+
+        def categorical_to_one_hot(self, *names: str):
+            self._steps.append({"op": "cat_to_onehot", "names": list(names)})
+            return self
+
+        def integer_to_categorical(self, name: str, states: Sequence[str]):
+            self._steps.append({"op": "int_to_cat", "name": name,
+                                "states": list(states)})
+            return self
+
+        def string_to_integer(self, *names: str):
+            self._steps.append({"op": "str_to_int", "names": list(names)})
+            return self
+
+        def string_to_double(self, *names: str):
+            self._steps.append({"op": "str_to_double", "names": list(names)})
+            return self
+
+        def double_math_op(self, name: str, op: str, value: float):
+            """op in {add, subtract, multiply, divide} (reference
+            ``DoubleMathOpTransform``†)."""
+            self._steps.append({"op": "double_math", "name": name,
+                                "math": op, "value": value})
+            return self
+
+        def min_max_normalize(self, name: str, minimum: float, maximum: float):
+            self._steps.append({"op": "minmax", "name": name,
+                                "min": minimum, "max": maximum})
+            return self
+
+        def standardize(self, name: str, mean: float, std: float):
+            self._steps.append({"op": "standardize", "name": name,
+                                "mean": mean, "std": std})
+            return self
+
+        def filter_invalid_values(self, *names: str):
+            """Drop rows whose named columns fail to parse as numbers
+            (reference ``FilterInvalidValues``†)."""
+            self._steps.append({"op": "filter_invalid", "names": list(names)})
+            return self
+
+        def filter_rows(self, name: str, condition: str, value):
+            """condition in {eq, neq, lt, lte, gt, gte, in}: drop rows where
+            the condition HOLDS (reference ConditionFilter semantics)."""
+            self._steps.append({"op": "filter", "name": name,
+                                "cond": condition, "value": value})
+            return self
+
+        def replace_invalid_with(self, name: str, value):
+            self._steps.append({"op": "replace_invalid", "name": name,
+                                "value": value})
+            return self
+
+        def build(self) -> "TransformProcess":
+            return TransformProcess(self._schema, self._steps)
+
+    @staticmethod
+    def builder(schema: Schema) -> "TransformProcess.Builder":
+        return TransformProcess.Builder(schema)
+
+    # -- execution --
+    def final_schema(self) -> Schema:
+        schema, _ = self._run(None)
+        return schema
+
+    def execute(self, records: Sequence[Sequence]) -> List[list]:
+        _, out = self._run([list(r) for r in records])
+        return out
+
+    def _run(self, records: Optional[List[list]]):
+        schema = Schema([dict(c) for c in self.initial_schema.columns])
+        for st in self.steps:
+            schema, records = _apply_step(st, schema, records)
+        return schema, records
+
+    # -- serde --
+    def to_json(self) -> str:
+        return json.dumps({"initial_schema": {"columns": self.initial_schema.columns},
+                           "steps": self.steps})
+
+    @staticmethod
+    def from_json(js: str) -> "TransformProcess":
+        d = json.loads(js)
+        return TransformProcess(Schema(d["initial_schema"]["columns"]),
+                                d["steps"])
+
+
+def _apply_step(st: dict, schema: Schema, records: Optional[List[list]]):
+    op = st["op"]
+
+    def col(name):
+        return schema.index_of(name)
+
+    if op == "remove_columns":
+        idxs = sorted((col(n) for n in st["names"]), reverse=True)
+        for i in idxs:
+            del schema.columns[i]
+        if records is not None:
+            for r in records:
+                for i in idxs:
+                    del r[i]
+    elif op == "keep_columns":
+        keep = [col(n) for n in st["names"]]
+        schema.columns = [schema.columns[i] for i in keep]
+        if records is not None:
+            records = [[r[i] for i in keep] for r in records]
+    elif op == "rename":
+        schema.column(st["old"])["name"] = st["new"]
+    elif op == "cat_to_int":
+        for n in st["names"]:
+            c = schema.column(n)
+            states = c.get("states")
+            if not states:
+                raise ValueError(f"{n!r} is not categorical")
+            lut = {s: i for i, s in enumerate(states)}
+            if records is not None:
+                i = col(n)
+                for r in records:
+                    r[i] = lut[str(r[i])]
+            c["type"] = INTEGER
+            c.pop("states", None)
+    elif op == "cat_to_onehot":
+        for n in st["names"]:
+            i = col(n)
+            c = schema.columns[i]
+            states = c.get("states")
+            if not states:
+                raise ValueError(f"{n!r} is not categorical")
+            lut = {s: k for k, s in enumerate(states)}
+            new_cols = [{"name": f"{n}[{s}]", "type": INTEGER} for s in states]
+            schema.columns[i:i + 1] = new_cols
+            if records is not None:
+                for r in records:
+                    onehot = [0] * len(states)
+                    onehot[lut[str(r[i])]] = 1
+                    r[i:i + 1] = onehot
+    elif op == "int_to_cat":
+        c = schema.column(st["name"])
+        states = st["states"]
+        if records is not None:
+            i = col(st["name"])
+            for r in records:
+                r[i] = states[int(r[i])]
+        c["type"] = CATEGORICAL
+        c["states"] = list(states)
+    elif op in ("str_to_int", "str_to_double"):
+        cast = int if op == "str_to_int" else float
+        for n in st["names"]:
+            c = schema.column(n)
+            if records is not None:
+                i = col(n)
+                for r in records:
+                    r[i] = cast(float(r[i]))
+            c["type"] = INTEGER if op == "str_to_int" else DOUBLE
+            c.pop("states", None)
+    elif op == "double_math":
+        i = col(st["name"])
+        f = {"add": lambda v: v + st["value"],
+             "subtract": lambda v: v - st["value"],
+             "multiply": lambda v: v * st["value"],
+             "divide": lambda v: v / st["value"]}[st["math"]]
+        if records is not None:
+            for r in records:
+                r[i] = f(_to_float(r[i]))
+    elif op == "minmax":
+        i = col(st["name"])
+        lo, hi = st["min"], st["max"]
+        rng = (hi - lo) or 1.0
+        if records is not None:
+            for r in records:
+                r[i] = (_to_float(r[i]) - lo) / rng
+    elif op == "standardize":
+        i = col(st["name"])
+        std = st["std"] or 1.0
+        if records is not None:
+            for r in records:
+                r[i] = (_to_float(r[i]) - st["mean"]) / std
+    elif op == "filter_invalid":
+        idxs = [col(n) for n in st["names"]]
+        if records is not None:
+            def ok(r):
+                for i in idxs:
+                    try:
+                        v = float(r[i])
+                    except (TypeError, ValueError):
+                        return False
+                    if math.isnan(v):
+                        return False
+                return True
+            records = [r for r in records if ok(r)]
+    elif op == "filter":
+        i = col(st["name"])
+        v = st["value"]
+        conds: Dict[str, Callable[[Any], bool]] = {
+            "eq": lambda x: x == v, "neq": lambda x: x != v,
+            "lt": lambda x: _to_float(x) < v,
+            "lte": lambda x: _to_float(x) <= v,
+            "gt": lambda x: _to_float(x) > v,
+            "gte": lambda x: _to_float(x) >= v,
+            "in": lambda x: x in v}
+        f = conds[st["cond"]]
+        if records is not None:
+            records = [r for r in records if not f(r[i])]
+    elif op == "replace_invalid":
+        i = col(st["name"])
+        if records is not None:
+            for r in records:
+                try:
+                    if math.isnan(float(r[i])):
+                        r[i] = st["value"]
+                except (TypeError, ValueError):
+                    r[i] = st["value"]
+    else:
+        raise ValueError(f"unknown transform step {op!r}")
+    return schema, records
+
+
+class DataAnalysis:
+    """Per-column statistics over records (reference ``AnalyzeLocal`` /
+    ``DataAnalysis``†): min/max/mean/std for numeric columns, state counts
+    for categorical — the numbers a normalization TransformProcess is built
+    from."""
+
+    def __init__(self, schema: Schema, records: Sequence[Sequence]):
+        self.schema = schema
+        self.columns: Dict[str, dict] = {}
+        for i, c in enumerate(schema.columns):
+            vals = [r[i] for r in records]
+            if c["type"] in (INTEGER, DOUBLE):
+                a = np.asarray([float(v) for v in vals], dtype=np.float64)
+                self.columns[c["name"]] = {
+                    "min": float(a.min()), "max": float(a.max()),
+                    "mean": float(a.mean()), "std": float(a.std()),
+                    "count": int(a.size)}
+            else:
+                counts: Dict[str, int] = {}
+                for v in vals:
+                    counts[str(v)] = counts.get(str(v), 0) + 1
+                self.columns[c["name"]] = {"counts": counts,
+                                           "count": len(vals)}
+
+    def column(self, name: str) -> dict:
+        return self.columns[name]

@@ -269,8 +269,12 @@ class TransferLearning:
                             "add_layer/add_vertex a replacement with that "
                             "name")
 
+            # default outputs: old outputs that still exist AFTER surgery —
+            # a keep-connections removal re-added under the same name keeps
+            # its output slot
+            final_names = {n for n, _, _ in vertices}
             outputs = self._outputs if self._outputs is not None else \
-                [o for o in conf.outputs if o not in dropped]
+                [o for o in conf.outputs if o in final_names]
             if not outputs:
                 raise ValueError("transfer result has no outputs; call "
                                  "set_outputs(...)")

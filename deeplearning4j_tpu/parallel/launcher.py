@@ -193,4 +193,4 @@ class HostShardedIterator(DataSetIterator):
                 lm = np.ones((k,), dtype=np.float32)
                 if short:
                     lm[-short:] = 0.0
-            yield DataSet(feats, labels, fm, lm)
+            yield self._pp(DataSet(feats, labels, fm, lm))

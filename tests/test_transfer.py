@@ -223,3 +223,26 @@ def test_graph_remove_vertex_keep_connections():
         (TransferLearning.GraphBuilder(g)
          .remove_vertex("d1", remove_outputs=False)
          .build())
+
+
+def test_graph_replaced_output_vertex_keeps_output_slot():
+    """Removing an OUTPUT vertex keep-connections style and re-adding a
+    replacement under the same name must keep it in the default outputs
+    (regression: the replacement was filtered out of conf.outputs)."""
+    conf = (NeuralNetConfiguration.builder()
+            .seed(7)
+            .updater(Adam(learning_rate=0.05))
+            .graph_builder()
+            .add_inputs("in")
+            .set_input_types(InputType.feed_forward(2))
+            .add_layer("d1", DenseLayer(n_out=8, activation="tanh"), "in")
+            .add_layer("out", OutputLayer(n_out=2), "d1")
+            .set_outputs("out")
+            .build())
+    g = ComputationGraph(conf).init()
+    new = (TransferLearning.GraphBuilder(g)
+           .remove_vertex("out", remove_outputs=False)
+           .add_layer("out", OutputLayer(n_out=5), "d1")
+           .build())
+    assert new.conf.outputs == ["out"]
+    assert new.output(np.zeros((3, 2), np.float32)).shape == (3, 5)
