@@ -72,15 +72,21 @@ class NormalizerStandardize(Normalizer):
     """Per-feature z-score over the fitted data (DL4J NormalizerStandardize).
 
     For 4-d image tensors, statistics are per-channel (DL4J semantics).
+    DL4J is NCHW-only; our image pipeline (datavec/image.py, data/cifar.py)
+    emits NHWC, so the channel axis is a constructor choice — pass
+    ``data_format="NHWC"`` for those producers or the stats silently come
+    out per-height-row instead of per-channel.
     """
 
-    def __init__(self):
+    def __init__(self, data_format: str = "NCHW"):
         self.mean: Optional[np.ndarray] = None
         self.std: Optional[np.ndarray] = None
+        self.data_format = data_format
 
     def _axes(self, f):
         if f.ndim == 4:
-            return (0, 2, 3)  # NCHW per-channel
+            # reduce everything except the channel axis
+            return (0, 2, 3) if self.data_format == "NCHW" else (0, 1, 2)
         if f.ndim == 3:
             return (0, 1)     # [B,T,F] per-feature
         return (0,)
@@ -107,7 +113,7 @@ class NormalizerStandardize(Normalizer):
 
     def _bshape(self, f):
         shape = [1] * f.ndim
-        if f.ndim == 4:
+        if f.ndim == 4 and self.data_format == "NCHW":
             shape[1] = -1
         else:
             shape[-1] = -1
@@ -125,11 +131,12 @@ class NormalizerStandardize(Normalizer):
 
     def to_state(self):
         return {"kind": self.kind, "mean": self.mean.tolist(),
-                "std": self.std.tolist()}
+                "std": self.std.tolist(), "data_format": self.data_format}
 
     def load_state(self, d):
         self.mean = np.asarray(d["mean"], dtype=np.float32)
         self.std = np.asarray(d["std"], dtype=np.float32)
+        self.data_format = d.get("data_format", "NCHW")
 
 
 @_norm("minmax")

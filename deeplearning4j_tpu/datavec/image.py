@@ -33,15 +33,24 @@ class ImageTransform:
         raise NotImplementedError
 
 
+def _pil_resize(img: np.ndarray, h: int, w: int) -> np.ndarray:
+    """(H,W,C) float -> resized (h,w,C) float32. PIL can't take a trailing
+    singleton channel dim, so grayscale squeezes through a 2-d image."""
+    from PIL import Image
+
+    gray = img.shape[-1] == 1
+    arr = img[:, :, 0] if gray else img
+    out = np.asarray(Image.fromarray(arr.astype(np.uint8)).resize(
+        (w, h), Image.BILINEAR), dtype=np.float32)
+    return out[:, :, None] if gray else out
+
+
 class ResizeImageTransform(ImageTransform):
     def __init__(self, height: int, width: int):
         self.h, self.w = height, width
 
     def __call__(self, img, rng):
-        from PIL import Image
-        pil = Image.fromarray(img.astype(np.uint8))
-        return np.asarray(pil.resize((self.w, self.h), Image.BILINEAR),
-                          dtype=np.float32)
+        return _pil_resize(img, self.h, self.w)
 
 
 class FlipImageTransform(ImageTransform):
@@ -77,6 +86,9 @@ class CenterCropImageTransform(ImageTransform):
 
     def __call__(self, img, rng):
         H, W = img.shape[:2]
+        if H < self.h or W < self.w:
+            raise ValueError(f"crop {self.h}x{self.w} larger than image "
+                             f"{H}x{W}; resize first")
         top, left = (H - self.h) // 2, (W - self.w) // 2
         return img[top:top + self.h, left:left + self.w, :]
 
@@ -160,13 +172,7 @@ class ImageRecordReader(RecordReader):
         if self.transform is not None:
             img = self.transform(img, rng)
         if img.shape[:2] != (self.h, self.w):
-            from PIL import Image as I
-            pil = I.fromarray(img.astype(np.uint8).squeeze(-1)
-                              if self.c == 1 else img.astype(np.uint8))
-            img = np.asarray(pil.resize((self.w, self.h), I.BILINEAR),
-                             dtype=np.float32)
-            if img.ndim == 2:
-                img = img[:, :, None]
+            img = _pil_resize(img, self.h, self.w)
         return img
 
     def __iter__(self):

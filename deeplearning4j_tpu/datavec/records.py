@@ -132,7 +132,8 @@ class CSVRecordReader(_CursorReader):
             paths = [split]
         rows: List[list] = []
         for p in paths:
-            rows.extend(self._parse_text(open(p, "r", newline="").read()))
+            with open(p, "r", newline="") as fh:
+                rows.extend(self._parse_text(fh.read()))
         self._source = ",".join(paths)
         self._rows = rows
         self._pos = 0
@@ -209,8 +210,8 @@ class CSVSequenceRecordReader(_CursorReader):
         paths = split.locations() if isinstance(split, InputSplit) else [split]
         seqs = []
         for p in paths:
-            rows = list(_csv.reader(open(p, "r", newline=""),
-                                    delimiter=self.delimiter))
+            with open(p, "r", newline="") as fh:
+                rows = list(_csv.reader(fh, delimiter=self.delimiter))
             seqs.append([r for r in rows[self.skip_lines:] if r])
         self._seqs = seqs
         self._pos = 0

@@ -1,0 +1,6 @@
+"""Model import frontends (SURVEY.md §3.5): Keras-H5 → layer configs,
+TF-GraphDef / ONNX → SameDiff graphs."""
+
+from .keras import KerasModelImport  # noqa: F401
+from .onnx import OnnxFrameworkImporter  # noqa: F401
+from .tensorflow import TensorflowFrameworkImporter  # noqa: F401

@@ -1,0 +1,315 @@
+"""ONNX model import → SameDiff graph.
+
+TPU-native equivalent of samediff-import-onnx (reference:
+``nd4j/samediff-import/samediff-import-onnx``† per SURVEY.md §2.2/§3.5;
+reference mount was empty, citation upstream-relative, unverified).
+
+The ``onnx`` pip package is not in this environment, so parsing uses a
+vendored minimal transcription of the public ONNX schema
+(``proto/onnx_min.proto``, field numbers are the stable ONNX wire contract)
+compiled with protoc — the import path therefore reads real ``.onnx`` files
+with zero extra dependencies. Mapping mirrors the TF frontend: per-op-type
+registry → catalog ops recorded on a SameDiff; initializers become
+VARIABLEs (fine-tunable), graph inputs become placeholders; unsupported op
+types raise with the name.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List
+
+import numpy as np
+
+from ..autodiff.samediff import SameDiff, SDVariable
+
+_DTYPES = {1: np.float32, 2: np.uint8, 3: np.int8, 5: np.int16, 6: np.int32,
+           7: np.int64, 9: np.bool_, 10: np.float16, 11: np.float64}
+
+
+def _tensor_to_np(t) -> np.ndarray:
+    dims = tuple(t.dims)
+    dt = _DTYPES.get(t.data_type)
+    if dt is None:
+        raise ValueError(f"unsupported ONNX tensor dtype {t.data_type}")
+    if t.raw_data:
+        a = np.frombuffer(t.raw_data, dtype=dt)
+    elif t.float_data:
+        a = np.asarray(list(t.float_data), dtype=dt)
+    elif t.int64_data:
+        a = np.asarray(list(t.int64_data), dtype=dt)
+    elif t.int32_data:
+        a = np.asarray(list(t.int32_data), dtype=dt)
+    elif t.double_data:
+        a = np.asarray(list(t.double_data), dtype=dt)
+    else:
+        a = np.zeros(dims, dtype=dt)
+    return a.reshape(dims)
+
+
+def _attrs(node) -> Dict[str, object]:
+    out = {}
+    for a in node.attribute:
+        if a.type == 1:      # FLOAT
+            out[a.name] = float(a.f)
+        elif a.type == 2:    # INT
+            out[a.name] = int(a.i)
+        elif a.type == 3:    # STRING
+            out[a.name] = a.s.decode()
+        elif a.type == 4:    # TENSOR
+            out[a.name] = _tensor_to_np(a.t)
+        elif a.type == 6:    # FLOATS
+            out[a.name] = [float(v) for v in a.floats]
+        elif a.type == 7:    # INTS
+            out[a.name] = [int(v) for v in a.ints]
+        else:
+            out[a.name] = None
+    return out
+
+
+class _Ctx:
+    def __init__(self, sd: SameDiff):
+        self.sd = sd
+        self.vars: Dict[str, SDVariable] = {}
+        self.consts: Dict[str, np.ndarray] = {}
+
+    def get(self, name: str) -> SDVariable:
+        if name not in self.vars:
+            raise ValueError(f"reference to unknown tensor {name!r}")
+        return self.vars[name]
+
+
+_M: Dict[str, Callable] = {}
+
+
+def onnx_op(*types):
+    def deco(fn):
+        for t in types:
+            _M[t] = fn
+        return fn
+    return deco
+
+
+_UNARY = {"Relu": "act.relu", "Sigmoid": "act.sigmoid", "Tanh": "act.tanh",
+          "Softplus": "act.softplus", "Softsign": "act.softsign",
+          "Elu": "act.elu", "Selu": "act.selu", "Exp": "math.exp",
+          "Log": "math.log", "Sqrt": "math.sqrt", "Abs": "math.abs",
+          "Neg": "math.neg", "Floor": "math.floor", "Ceil": "math.ceil",
+          "Round": "math.round", "Erf": "math.erf", "Sin": "math.sin",
+          "Cos": "math.cos", "Identity": "act.identity",
+          "Reciprocal": "math.reciprocal", "Sign": "math.sign"}
+_BINARY = {"Add": "math.add", "Sub": "math.sub", "Mul": "math.mul",
+           "Div": "math.div", "Pow": "math.pow", "Max": "math.maximum",
+           "Min": "math.minimum", "Greater": "math.greater",
+           "Less": "math.less", "Equal": "math.equal"}
+
+
+@onnx_op("Gemm")
+def _gemm(node, ctx, at):
+    a, b = ctx.get(node.input[0]), ctx.get(node.input[1])
+    alpha, beta = at.get("alpha", 1.0), at.get("beta", 1.0)
+    y = ctx.sd.call("linalg.mmul", a, b,
+                    attrs={"transpose_a": bool(at.get("transA", 0)),
+                           "transpose_b": bool(at.get("transB", 0))})
+    if alpha != 1.0:
+        y = ctx.sd.call("math.mul", y, ctx.sd._lift(np.float32(alpha)))
+    if len(node.input) > 2:
+        c = ctx.get(node.input[2])
+        if beta != 1.0:
+            c = ctx.sd.call("math.mul", c, ctx.sd._lift(np.float32(beta)))
+        y = ctx.sd.call("math.add", y, c, name=node.output[0])
+    else:
+        y = ctx.sd.call("act.identity", y, name=node.output[0])
+    return y
+
+
+@onnx_op("MatMul")
+def _matmul(node, ctx, at):
+    return ctx.sd.call("linalg.mmul", ctx.get(node.input[0]),
+                       ctx.get(node.input[1]), name=node.output[0])
+
+
+@onnx_op("Conv")
+def _conv(node, ctx, at):
+    # ONNX is NCHW with kernel OIHW == our storage layout directly
+    kernel_shape = at.get("kernel_shape")
+    strides = at.get("strides", [1, 1])
+    dil = at.get("dilations", [1, 1])
+    pads = at.get("pads", [0, 0, 0, 0])
+    auto = at.get("auto_pad", "NOTSET")
+    if at.get("group", 1) != 1:
+        raise ValueError("grouped Conv not supported yet")
+    if auto in ("SAME_UPPER", "SAME_LOWER"):
+        mode, pad = "same", (0, 0)
+    else:
+        if len(pads) == 4 and (pads[0] != pads[2] or pads[1] != pads[3]):
+            raise ValueError("asymmetric Conv pads not supported")
+        mode, pad = "truncate", (int(pads[0]), int(pads[1]))
+    args = [ctx.get(node.input[0]), ctx.get(node.input[1])]
+    if len(node.input) > 2:
+        args.append(ctx.get(node.input[2]))
+    return ctx.sd.call("conv2d", *args, name=node.output[0],
+                       attrs={"stride": tuple(int(s) for s in strides),
+                              "padding": pad, "mode": mode,
+                              "dilation": tuple(int(d) for d in dil),
+                              "data_format": "NCHW"})
+
+
+@onnx_op("MaxPool", "AveragePool")
+def _pool(node, ctx, at):
+    op = "maxpool2d" if node.op_type == "MaxPool" else "avgpool2d"
+    pads = at.get("pads", [0, 0, 0, 0])
+    auto = at.get("auto_pad", "NOTSET")
+    if auto in ("SAME_UPPER", "SAME_LOWER"):
+        mode, pad = "same", (0, 0)
+    else:
+        mode, pad = "truncate", (int(pads[0]), int(pads[1]))
+    return ctx.sd.call(op, ctx.get(node.input[0]), name=node.output[0],
+                       attrs={"kernel": tuple(int(k) for k in at["kernel_shape"]),
+                              "stride": tuple(int(s) for s in at.get("strides", at["kernel_shape"])),
+                              "padding": pad, "mode": mode,
+                              "data_format": "NCHW"})
+
+
+@onnx_op("GlobalAveragePool")
+def _gap(node, ctx, at):
+    return ctx.sd.call("reduce.mean", ctx.get(node.input[0]),
+                       name=node.output[0],
+                       attrs={"axis": (2, 3), "keepdims": True})
+
+
+@onnx_op("BatchNormalization")
+def _bn(node, ctx, at):
+    return ctx.sd.call("batch_norm", ctx.get(node.input[0]),
+                       ctx.get(node.input[1]), ctx.get(node.input[2]),
+                       ctx.get(node.input[3]), ctx.get(node.input[4]),
+                       name=node.output[0],
+                       attrs={"eps": float(at.get("epsilon", 1e-5)),
+                              "axis": 1})
+
+
+@onnx_op("Reshape")
+def _reshape(node, ctx, at):
+    shape = ctx.consts.get(node.input[1]) if len(node.input) > 1 else \
+        np.asarray(at.get("shape", []))
+    if shape is None:
+        raise ValueError("Reshape with dynamic shape input not supported")
+    return ctx.sd.call("shape.reshape", ctx.get(node.input[0]),
+                       name=node.output[0],
+                       attrs={"shape": [int(s) for s in np.asarray(shape).tolist()]})
+
+
+@onnx_op("Flatten")
+def _flatten(node, ctx, at):
+    axis = at.get("axis", 1)
+    if axis != 1:
+        raise ValueError("Flatten axis != 1 not supported")
+    return ctx.sd.call("shape.flatten2d", ctx.get(node.input[0]),
+                       name=node.output[0])
+
+
+@onnx_op("Softmax", "LogSoftmax")
+def _softmax(node, ctx, at):
+    op = "act.softmax" if node.op_type == "Softmax" else "act.logsoftmax"
+    return ctx.sd.call(op, ctx.get(node.input[0]), name=node.output[0])
+
+
+@onnx_op("Concat")
+def _concat(node, ctx, at):
+    return ctx.sd.call("shape.concat_v", *[ctx.get(i) for i in node.input],
+                       name=node.output[0], attrs={"axis": int(at["axis"])})
+
+
+@onnx_op("Transpose")
+def _transpose(node, ctx, at):
+    return ctx.sd.call("shape.transpose", ctx.get(node.input[0]),
+                       name=node.output[0],
+                       attrs={"axes": [int(p) for p in at.get("perm", [])]})
+
+
+@onnx_op("Unsqueeze")
+def _unsqueeze(node, ctx, at):
+    axes = at.get("axes")
+    if axes is None and len(node.input) > 1:
+        axes = ctx.consts[node.input[1]].tolist()
+    return ctx.sd.call("shape.expand_dims", ctx.get(node.input[0]),
+                       name=node.output[0],
+                       attrs={"axis": tuple(int(a) for a in axes)})
+
+
+@onnx_op("Squeeze")
+def _squeeze(node, ctx, at):
+    axes = at.get("axes")
+    if axes is None and len(node.input) > 1:
+        axes = ctx.consts[node.input[1]].tolist()
+    attrs = {"axis": tuple(int(a) for a in axes)} if axes else {}
+    return ctx.sd.call("shape.squeeze", ctx.get(node.input[0]),
+                       name=node.output[0], attrs=attrs)
+
+
+@onnx_op("ReduceMean", "ReduceSum", "ReduceMax", "ReduceMin")
+def _reduce(node, ctx, at):
+    op = {"ReduceMean": "reduce.mean", "ReduceSum": "reduce.sum",
+          "ReduceMax": "reduce.max", "ReduceMin": "reduce.min"}[node.op_type]
+    axes = at.get("axes")
+    if axes is None and len(node.input) > 1:
+        axes = ctx.consts[node.input[1]].tolist()
+    return ctx.sd.call(op, ctx.get(node.input[0]), name=node.output[0],
+                       attrs={"axis": tuple(int(a) for a in axes) if axes else None,
+                              "keepdims": bool(at.get("keepdims", 1))})
+
+
+class OnnxFrameworkImporter:
+    """Reference-parity entry point (samediff-import-onnx†)."""
+
+    @staticmethod
+    def import_file(path: str) -> SameDiff:
+        with open(path, "rb") as f:
+            return OnnxFrameworkImporter.import_model_proto(f.read())
+
+    @staticmethod
+    def import_model_proto(data) -> SameDiff:
+        from .proto import onnx_min_pb2 as P
+
+        if isinstance(data, (bytes, bytearray)):
+            model = P.ModelProto()
+            model.ParseFromString(bytes(data))
+        else:
+            model = data
+        g = model.graph
+        sd = SameDiff()
+        ctx = _Ctx(sd)
+        for init in g.initializer:
+            value = _tensor_to_np(init)
+            ctx.consts[init.name] = value
+            ctx.vars[init.name] = sd.var(init.name, value)
+        for vi in g.input:
+            if vi.name in ctx.vars:
+                continue  # initializer doubling as input (pre-IR4 style)
+            shape = None
+            tt = vi.type.tensor_type
+            if tt.shape.dim:
+                shape = tuple(d.dim_value if d.dim_value else None
+                              for d in tt.shape.dim)
+            ctx.vars[vi.name] = sd.placeholder(vi.name, shape)
+        for node in g.node:
+            at = _attrs(node)
+            if node.op_type == "Constant":
+                value = at.get("value")
+                ctx.consts[node.output[0]] = np.asarray(value)
+                ctx.vars[node.output[0]] = sd.constant(node.output[0], value)
+            elif node.op_type in _UNARY:
+                ctx.vars[node.output[0]] = sd.call(
+                    _UNARY[node.op_type], ctx.get(node.input[0]),
+                    name=node.output[0])
+            elif node.op_type in _BINARY:
+                ctx.vars[node.output[0]] = sd.call(
+                    _BINARY[node.op_type], ctx.get(node.input[0]),
+                    ctx.get(node.input[1]), name=node.output[0])
+            elif node.op_type in _M:
+                ctx.vars[node.output[0]] = _M[node.op_type](node, ctx, at)
+            else:
+                raise ValueError(
+                    f"unsupported ONNX op {node.op_type!r} (node "
+                    f"{node.name!r}) — extend modelimport/onnx.py")
+        sd.onnx_outputs = [vi.name for vi in g.output]  # type: ignore
+        return sd

@@ -1,0 +1,376 @@
+"""TF-GraphDef import → SameDiff graph.
+
+TPU-native equivalent of samediff-import-tensorflow (reference:
+``nd4j/samediff-import/samediff-import-tensorflow`` — Kotlin
+``OpMappingRegistry`` + per-op mapping rules + ``ImportGraph`` walk, and the
+older ``TFGraphMapper``† per SURVEY.md §2.2/§3.5; reference mount was empty,
+citations upstream-relative, unverified).
+
+Same architecture as the reference: walk the frozen GraphDef in node order,
+map each TF op through a per-op-type registry into catalog ops recorded on a
+:class:`~deeplearning4j_tpu.autodiff.samediff.SameDiff` instance — which then
+jit-compiles the whole program to XLA (§3.3 "TPU translation"). Frozen
+inference graphs only (variables already folded to Const, the standard
+``convert_variables_to_constants`` output the reference's test corpus uses).
+
+Static-argument convention: TF passes reduction axes / target shapes /
+permutations as Const *tensor inputs*; XLA needs them static, so the mapper
+resolves Const inputs to python values at import time and bakes them into op
+attrs. Unsupported op types raise with the op name (loud coverage gaps, as
+the reference's ImportGraph does).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from ..autodiff.samediff import SameDiff, SDVariable
+
+
+class _Ctx:
+    def __init__(self, sd: SameDiff):
+        self.sd = sd
+        self.vars: Dict[str, SDVariable] = {}     # tf tensor name -> SDVar
+        self.consts: Dict[str, np.ndarray] = {}   # tf node name -> value
+
+    def get(self, ref: str) -> SDVariable:
+        name = _strip(ref)
+        if name not in self.vars:
+            raise ValueError(f"reference to unknown tensor {ref!r}")
+        return self.vars[name]
+
+    def const_value(self, ref: str) -> np.ndarray:
+        name = _strip(ref)
+        if name not in self.consts:
+            raise ValueError(
+                f"op needs a static value but input {ref!r} is not Const")
+        return self.consts[name]
+
+
+def _strip(ref: str) -> str:
+    """'node:0' -> 'node'; control deps '^node' are filtered earlier."""
+    return ref.split(":")[0]
+
+
+def _attr(node, key, default=None):
+    if key not in node.attr:
+        return default
+    a = node.attr[key]
+    field = a.WhichOneof("value")
+    v = getattr(a, field)
+    if field == "list":
+        for f in ("i", "f", "b", "s"):
+            items = list(getattr(v, f))
+            if items:
+                return items
+        return []
+    if field == "s":
+        return v.decode()
+    return v
+
+
+def _pair_from(v, layout="NHWC"):
+    """ksize/strides attr [1,h,w,1] (NHWC) -> (h, w)."""
+    v = list(v)
+    if len(v) == 4:
+        return (int(v[1]), int(v[2])) if layout == "NHWC" else (int(v[2]), int(v[3]))
+    if len(v) == 2:
+        return (int(v[0]), int(v[1]))
+    return (int(v[0]),) * 2
+
+
+_MAPPERS: Dict[str, Callable] = {}
+
+
+def tf_op(*types):
+    def deco(fn):
+        for t in types:
+            _MAPPERS[t] = fn
+        return fn
+    return deco
+
+
+# ---- elementwise / unary ----------------------------------------------------
+_UNARY = {"Relu": "act.relu", "Relu6": "act.relu6", "Elu": "act.elu",
+          "Selu": "act.selu", "Sigmoid": "act.sigmoid", "Tanh": "act.tanh",
+          "Softmax": "act.softmax", "LogSoftmax": "act.logsoftmax",
+          "Softplus": "act.softplus", "Softsign": "act.softsign",
+          "Exp": "math.exp", "Log": "math.log", "Log1p": "math.log1p",
+          "Sqrt": "math.sqrt", "Rsqrt": "math.rsqrt", "Square": "math.square",
+          "Abs": "math.abs", "Neg": "math.neg", "Sign": "math.sign",
+          "Floor": "math.floor", "Ceil": "math.ceil", "Round": "math.round",
+          "Erf": "math.erf", "Sin": "math.sin", "Cos": "math.cos",
+          "Tan": "math.tan", "Sinh": "math.sinh", "Cosh": "math.cosh",
+          "Asin": "math.asin", "Acos": "math.acos", "Atan": "math.atan",
+          "Reciprocal": "math.reciprocal", "Expm1": "math.expm1",
+          "IsNan": "math.isnan", "IsInf": "math.isinf",
+          "LogicalNot": "math.logical_not"}
+
+_BINARY = {"Add": "math.add", "AddV2": "math.add", "BiasAdd": "math.add",
+           "Sub": "math.sub", "Mul": "math.mul", "RealDiv": "math.div",
+           "Div": "math.div", "FloorDiv": "math.floordiv",
+           "Maximum": "math.maximum", "Minimum": "math.minimum",
+           "Pow": "math.pow", "SquaredDifference": "math.squared_difference",
+           "FloorMod": "math.fmod", "Atan2": "math.atan2",
+           "Greater": "math.greater", "GreaterEqual": "math.greater_equal",
+           "Less": "math.less", "LessEqual": "math.less_equal",
+           "Equal": "math.equal", "NotEqual": "math.not_equal",
+           "LogicalAnd": "math.logical_and", "LogicalOr": "math.logical_or"}
+
+
+def _map_unary(node, ctx, ins):
+    return ctx.sd.call(_UNARY[node.op], ctx.get(ins[0]), name=node.name)
+
+
+def _map_binary(node, ctx, ins):
+    return ctx.sd.call(_BINARY[node.op], ctx.get(ins[0]), ctx.get(ins[1]),
+                       name=node.name)
+
+
+@tf_op("MatMul")
+def _matmul(node, ctx, ins):
+    return ctx.sd.call("linalg.mmul", ctx.get(ins[0]), ctx.get(ins[1]),
+                       name=node.name,
+                       attrs={"transpose_a": bool(_attr(node, "transpose_a", False)),
+                              "transpose_b": bool(_attr(node, "transpose_b", False))})
+
+
+@tf_op("Einsum")
+def _einsum(node, ctx, ins):
+    return ctx.sd.call("linalg.einsum", *[ctx.get(i) for i in ins],
+                       name=node.name,
+                       attrs={"equation": _attr(node, "equation")})
+
+
+@tf_op("BatchMatMul", "BatchMatMulV2")
+def _batch_matmul(node, ctx, ins):
+    if _attr(node, "adj_x", False) or _attr(node, "adj_y", False):
+        raise ValueError("BatchMatMul adjoint not supported")
+    return ctx.sd.call("linalg.mmul", ctx.get(ins[0]), ctx.get(ins[1]),
+                       name=node.name)
+
+
+@tf_op("Conv2D")
+def _conv2d(node, ctx, ins):
+    if _attr(node, "data_format", "NHWC") != "NHWC":
+        raise ValueError("Conv2D NCHW graphs not supported (convert to NHWC)")
+    pad = _attr(node, "padding", "VALID")
+    # TF kernel layout HWIO; our conv2d stores OIHW
+    w = ctx.sd.call("shape.transpose", ctx.get(ins[1]),
+                    attrs={"axes": [3, 2, 0, 1]})
+    return ctx.sd.call(
+        "conv2d", ctx.get(ins[0]), w, name=node.name,
+        attrs={"stride": _pair_from(_attr(node, "strides", [1, 1, 1, 1])),
+               "dilation": _pair_from(_attr(node, "dilations", [1, 1, 1, 1])),
+               "mode": "same" if pad == "SAME" else "truncate",
+               "data_format": "NHWC"})
+
+
+@tf_op("MaxPool", "AvgPool")
+def _pool(node, ctx, ins):
+    op = "maxpool2d" if node.op == "MaxPool" else "avgpool2d"
+    pad = _attr(node, "padding", "VALID")
+    return ctx.sd.call(
+        op, ctx.get(ins[0]), name=node.name,
+        attrs={"kernel": _pair_from(_attr(node, "ksize", [1, 2, 2, 1])),
+               "stride": _pair_from(_attr(node, "strides", [1, 2, 2, 1])),
+               "mode": "same" if pad == "SAME" else "truncate",
+               "data_format": "NHWC"})
+
+
+@tf_op("FusedBatchNorm", "FusedBatchNormV2", "FusedBatchNormV3")
+def _fused_bn(node, ctx, ins):
+    if _attr(node, "is_training", False):
+        raise ValueError("FusedBatchNorm training mode not supported "
+                         "(freeze the graph for inference import)")
+    return ctx.sd.call("batch_norm", ctx.get(ins[0]), ctx.get(ins[1]),
+                       ctx.get(ins[2]), ctx.get(ins[3]), ctx.get(ins[4]),
+                       name=node.name,
+                       attrs={"eps": float(_attr(node, "epsilon", 1e-3)),
+                              "axis": -1})
+
+
+@tf_op("Mean", "Sum", "Max", "Min", "Prod")
+def _reduce(node, ctx, ins):
+    op = {"Mean": "reduce.mean", "Sum": "reduce.sum", "Max": "reduce.max",
+          "Min": "reduce.min", "Prod": "reduce.prod"}[node.op]
+    axes = ctx.const_value(ins[1]).tolist()
+    axes = axes if isinstance(axes, list) else [axes]
+    return ctx.sd.call(op, ctx.get(ins[0]), name=node.name,
+                       attrs={"axis": tuple(int(a) for a in axes),
+                              "keepdims": bool(_attr(node, "keep_dims", False))})
+
+
+@tf_op("ArgMax", "ArgMin")
+def _argreduce(node, ctx, ins):
+    op = "reduce.argmax" if node.op == "ArgMax" else "reduce.argmin"
+    axis = int(np.asarray(ctx.const_value(ins[1])))
+    return ctx.sd.call(op, ctx.get(ins[0]), name=node.name,
+                       attrs={"axis": axis})
+
+
+@tf_op("Reshape")
+def _reshape(node, ctx, ins):
+    shape = [int(s) for s in ctx.const_value(ins[1]).tolist()]
+    return ctx.sd.call("shape.reshape", ctx.get(ins[0]), name=node.name,
+                       attrs={"shape": shape})
+
+
+@tf_op("Transpose")
+def _transpose(node, ctx, ins):
+    perm = [int(p) for p in ctx.const_value(ins[1]).tolist()]
+    return ctx.sd.call("shape.transpose", ctx.get(ins[0]), name=node.name,
+                       attrs={"axes": perm})
+
+
+@tf_op("ExpandDims")
+def _expand(node, ctx, ins):
+    axis = int(np.asarray(ctx.const_value(ins[1])))
+    return ctx.sd.call("shape.expand_dims", ctx.get(ins[0]), name=node.name,
+                       attrs={"axis": axis})
+
+
+@tf_op("Squeeze")
+def _squeeze(node, ctx, ins):
+    dims = _attr(node, "squeeze_dims", []) or None
+    attrs = {"axis": tuple(int(d) for d in dims)} if dims else {}
+    return ctx.sd.call("shape.squeeze", ctx.get(ins[0]), name=node.name,
+                       attrs=attrs)
+
+
+@tf_op("ConcatV2")
+def _concat(node, ctx, ins):
+    axis = int(np.asarray(ctx.const_value(ins[-1])))
+    return ctx.sd.call("shape.concat_v",
+                       *[ctx.get(i) for i in ins[:-1]], name=node.name,
+                       attrs={"axis": axis})
+
+
+@tf_op("Pack")
+def _pack(node, ctx, ins):
+    return ctx.sd.call("shape.stack_v", *[ctx.get(i) for i in ins],
+                       name=node.name,
+                       attrs={"axis": int(_attr(node, "axis", 0))})
+
+
+@tf_op("GatherV2", "Gather")
+def _gather(node, ctx, ins):
+    axis = 0
+    if len(ins) > 2:
+        axis = int(np.asarray(ctx.const_value(ins[2])))
+    return ctx.sd.call("shape.gather", ctx.get(ins[0]), ctx.get(ins[1]),
+                       name=node.name, attrs={"axis": axis})
+
+
+@tf_op("Pad", "PadV2")
+def _pad(node, ctx, ins):
+    widths = [(int(a), int(b)) for a, b in ctx.const_value(ins[1]).tolist()]
+    return ctx.sd.call("shape.pad", ctx.get(ins[0]), name=node.name,
+                       attrs={"pad_width": widths})
+
+
+@tf_op("Tile")
+def _tile(node, ctx, ins):
+    reps = [int(r) for r in ctx.const_value(ins[1]).tolist()]
+    return ctx.sd.call("shape.tile", ctx.get(ins[0]), name=node.name,
+                       attrs={"reps": reps})
+
+
+@tf_op("Cast")
+def _cast(node, ctx, ins):
+    # dtype tracking is owned by XLA here; pass-through (recorded divergence:
+    # the reference maps DstT; our catalog ops promote per jnp rules)
+    return ctx.sd.call("act.identity", ctx.get(ins[0]), name=node.name)
+
+
+@tf_op("StopGradient", "Identity", "PreventGradient", "CheckNumerics")
+def _identity(node, ctx, ins):
+    return ctx.sd.call("act.identity", ctx.get(ins[0]), name=node.name)
+
+
+@tf_op("Select", "SelectV2")
+def _select(node, ctx, ins):
+    return ctx.sd.call("math.where", ctx.get(ins[0]), ctx.get(ins[1]),
+                       ctx.get(ins[2]), name=node.name)
+
+
+@tf_op("ClipByValue")
+def _clip(node, ctx, ins):
+    lo = float(np.asarray(ctx.const_value(ins[1])))
+    hi = float(np.asarray(ctx.const_value(ins[2])))
+    return ctx.sd.call("math.clip", ctx.get(ins[0]), name=node.name,
+                       attrs={"min_value": lo, "max_value": hi})
+
+
+@tf_op("LeakyRelu")
+def _leaky(node, ctx, ins):
+    alpha = float(_attr(node, "alpha", 0.2))
+    return ctx.sd.call("act.leakyrelu", ctx.get(ins[0]), name=node.name,
+                       attrs={"alpha": alpha})
+
+
+@tf_op("OneHot")
+def _one_hot(node, ctx, ins):
+    depth = int(np.asarray(ctx.const_value(ins[1])))
+    return ctx.sd.call("shape.one_hot", ctx.get(ins[0]), name=node.name,
+                       attrs={"depth": depth})
+
+
+class TensorflowFrameworkImporter:
+    """Reference-parity entry point (``TensorflowFrameworkImporter`` /
+    ``TFGraphMapper.importGraph``†)."""
+
+    @staticmethod
+    def import_graph_def(graph_def) -> SameDiff:
+        """Frozen GraphDef (proto object or serialized bytes) → SameDiff.
+        Placeholders become SameDiff placeholders; run with
+        ``sd.output({placeholder: value}, [output_names])``."""
+        if isinstance(graph_def, (bytes, bytearray)):
+            from tensorflow.core.framework import graph_pb2  # type: ignore
+            gd = graph_pb2.GraphDef()
+            gd.ParseFromString(bytes(graph_def))
+            graph_def = gd
+
+        sd = SameDiff()
+        ctx = _Ctx(sd)
+        for node in graph_def.node:
+            ins = [i for i in node.input if not i.startswith("^")]
+            if node.op == "Const":
+                value = _tensor_value(node)
+                ctx.consts[node.name] = value
+                ctx.vars[node.name] = sd.constant(node.name, value)
+            elif node.op in ("Placeholder", "PlaceholderV2"):
+                shape = _attr_shape(node)
+                ctx.vars[node.name] = sd.placeholder(node.name, shape)
+            elif node.op == "NoOp":
+                continue
+            elif node.op in _UNARY:
+                ctx.vars[node.name] = _map_unary(node, ctx, ins)
+            elif node.op in _BINARY:
+                ctx.vars[node.name] = _map_binary(node, ctx, ins)
+            elif node.op in _MAPPERS:
+                ctx.vars[node.name] = _MAPPERS[node.op](node, ctx, ins)
+            else:
+                raise ValueError(
+                    f"unsupported TF op type {node.op!r} (node "
+                    f"{node.name!r}) — extend modelimport/tensorflow.py")
+        return sd
+
+    @staticmethod
+    def import_file(path: str) -> SameDiff:
+        with open(path, "rb") as f:
+            return TensorflowFrameworkImporter.import_graph_def(f.read())
+
+
+def _tensor_value(node) -> np.ndarray:
+    from tensorflow.python.framework import tensor_util  # type: ignore
+    return np.asarray(tensor_util.MakeNdarray(node.attr["value"].tensor))
+
+
+def _attr_shape(node):
+    if "shape" not in node.attr:
+        return None
+    dims = [d.size for d in node.attr["shape"].shape.dim]
+    return tuple(None if d == -1 else int(d) for d in dims) or None

@@ -125,6 +125,14 @@ def tensordot(a, b, axes=2):
     return jnp.tensordot(a, b, axes=axes, precision=precision_for(a, b))
 
 
+@register("linalg.einsum", category="blas")
+def einsum(*operands, equation):
+    """Einstein summation (TF-import Einsum nodes land here; contractions
+    ride the MXU with the f32 precision policy)."""
+    return jnp.einsum(equation, *operands,
+                      precision=precision_for(*operands))
+
+
 register("linalg.outer", category="blas")(jnp.outer)
 register("linalg.diag", category="linalg")(jnp.diag)
 register("linalg.diag_part", category="linalg")(jnp.diagonal)
@@ -149,6 +157,27 @@ register("shape.squeeze", category="shape")(jnp.squeeze)
 register("shape.expand_dims", category="shape")(jnp.expand_dims)
 register("shape.concat", category="shape")(jnp.concatenate)
 register("shape.stack", category="shape")(jnp.stack)
+
+
+@register("shape.concat_v", category="shape")
+def _concat_v(*arrays, axis=0):
+    """Variadic concat: inputs as separate positional args, the calling
+    convention graph layers (SameDiff/import frontends) use — jnp's
+    sequence-arg concatenate can't be applied per recorded input."""
+    return jnp.concatenate(arrays, axis=axis)
+
+
+@register("shape.stack_v", category="shape")
+def _stack_v(*arrays, axis=0):
+    """Variadic stack (see shape.concat_v)."""
+    return jnp.stack(arrays, axis=axis)
+
+
+@register("shape.flatten2d", category="shape")
+def _flatten2d(x):
+    """[B, ...] -> [B, prod(...)]: ONNX Flatten(axis=1) / keras Flatten —
+    'keep the batch dim' is not expressible as a static reshape attr."""
+    return jnp.reshape(x, (x.shape[0], -1))
 register("shape.split", category="shape")(jnp.split)
 register("shape.tile", category="shape")(jnp.tile)
 register("shape.repeat", category="shape")(jnp.repeat)

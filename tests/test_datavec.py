@@ -350,3 +350,51 @@ def test_async_iterator_applies_pre_processor():
     it.set_pre_processor(ImagePreProcessingScaler())
     for ds in it:
         np.testing.assert_allclose(ds.features, 1.0)
+
+
+def test_grayscale_image_with_resize_transform(tmp_path):
+    """channels=1 pipelines must survive PIL resize (regression: trailing
+    singleton channel dim crashed Image.fromarray)."""
+    from PIL import Image
+    d = tmp_path / "zero"
+    d.mkdir()
+    rng = np.random.default_rng(0)
+    for i in range(2):
+        Image.fromarray(rng.integers(0, 255, (20, 20), dtype=np.uint8)).save(
+            d / f"{i}.png")
+    rr = ImageRecordReader(16, 16, 1,
+                           transform=ResizeImageTransform(18, 18)).initialize(
+        FileSplit(str(tmp_path), allowed_extensions=["png"]))
+    recs = list(rr)
+    assert recs[0][0].shape == (16, 16, 1)
+
+
+def test_center_crop_too_small_raises():
+    img = np.zeros((10, 10, 3), np.float32)
+    with pytest.raises(ValueError, match="larger than image"):
+        CenterCropImageTransform(16, 16)(img, np.random.default_rng(0))
+
+
+def test_normalizer_standardize_nhwc_per_channel():
+    """data_format='NHWC' computes per-CHANNEL stats (regression: the NCHW
+    assumption silently standardized per height row)."""
+    from deeplearning4j_tpu.data.dataset import DataSet
+    from deeplearning4j_tpu.data.normalizers import NormalizerStandardize
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(16, 8, 9, 3)).astype(np.float32)
+    x[..., 1] = x[..., 1] * 5 + 10  # channel 1 has distinct stats
+    norm = NormalizerStandardize(data_format="NHWC")
+    norm.fit(DataSet(x, None))
+    assert norm.mean.shape == (3,)
+    ds = DataSet(x.copy(), None)
+    norm.transform(ds)
+    np.testing.assert_allclose(ds.features.mean(axis=(0, 1, 2)), 0.0,
+                               atol=1e-4)
+    np.testing.assert_allclose(ds.features.std(axis=(0, 1, 2)), 1.0,
+                               atol=1e-3)
+    # round-trips through serialization with the layout
+    norm2 = NormalizerStandardize()
+    norm2.load_state(norm.to_state())
+    assert norm2.data_format == "NHWC"
+    np.testing.assert_allclose(norm2.revert_features(ds.features), x,
+                               atol=1e-4)

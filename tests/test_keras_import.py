@@ -1,0 +1,142 @@
+"""Keras-H5 import: golden-file tests against live tf.keras outputs.
+
+Equivalent of DL4J's KerasModelEndToEndTest (SURVEY.md §4 "Keras-import
+regression"): real .h5 files are imported and predictions compared
+numerically against Keras's own outputs on the same inputs. tf is baked
+into this environment, so fixtures are generated at test time rather than
+committed (same contract, fresher fixtures).
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+tf = pytest.importorskip("tensorflow")
+
+from deeplearning4j_tpu.modelimport import KerasModelImport  # noqa: E402
+from deeplearning4j_tpu.nn.graph import ComputationGraph  # noqa: E402
+from deeplearning4j_tpu.nn.model import MultiLayerNetwork  # noqa: E402
+
+RTOL, ATOL = 1e-4, 1e-4
+
+
+def _compare(keras_model, ours, x, atol=ATOL):
+    ref = keras_model.predict(x, verbose=0)
+    got = np.asarray(ours.output(x))
+    np.testing.assert_allclose(got, ref, rtol=RTOL, atol=atol)
+
+
+def test_sequential_lenet_like(tmp_path):
+    rng = np.random.default_rng(0)
+    m = tf.keras.Sequential([
+        tf.keras.layers.Input(shape=(12, 12, 1)),
+        tf.keras.layers.Conv2D(4, 3, activation="relu", name="c1"),
+        tf.keras.layers.MaxPooling2D(2, name="p1"),
+        tf.keras.layers.Conv2D(8, 3, padding="same", activation="tanh",
+                               name="c2"),
+        tf.keras.layers.AveragePooling2D(2, name="p2"),
+        tf.keras.layers.Flatten(name="f"),
+        tf.keras.layers.Dense(16, activation="relu", name="d1"),
+        tf.keras.layers.Dropout(0.5, name="do"),
+        tf.keras.layers.Dense(5, activation="softmax", name="out"),
+    ])
+    p = str(tmp_path / "lenet.h5")
+    m.save(p)
+    net = KerasModelImport.import_keras_model_and_weights(p)
+    assert isinstance(net, MultiLayerNetwork)
+    x = rng.normal(size=(4, 12, 12, 1)).astype(np.float32)
+    _compare(m, net, x)
+
+
+def test_sequential_with_batchnorm_nontrivial_stats(tmp_path):
+    rng = np.random.default_rng(1)
+    m = tf.keras.Sequential([
+        tf.keras.layers.Input(shape=(8, 8, 3)),
+        tf.keras.layers.Conv2D(6, 3, padding="same", name="c1"),
+        tf.keras.layers.BatchNormalization(name="bn"),
+        tf.keras.layers.Activation("relu", name="a"),
+        tf.keras.layers.GlobalAveragePooling2D(name="gap"),
+        tf.keras.layers.Dense(4, activation="softmax", name="out"),
+    ])
+    # push real statistics into the BN moving mean/var so the import test
+    # actually exercises the state copy (fresh stats are 0/1 = identity-ish)
+    m.compile(optimizer="sgd", loss="categorical_crossentropy")
+    xs = rng.normal(2.0, 3.0, size=(64, 8, 8, 3)).astype(np.float32)
+    ys = np.eye(4, dtype=np.float32)[rng.integers(0, 4, 64)]
+    m.fit(xs, ys, epochs=2, verbose=0)
+    p = str(tmp_path / "bn.h5")
+    m.save(p)
+    net = KerasModelImport.import_keras_model_and_weights(p)
+    x = rng.normal(2.0, 3.0, size=(4, 8, 8, 3)).astype(np.float32)
+    _compare(m, net, x, atol=5e-4)
+
+
+def test_sequential_embedding_lstm(tmp_path):
+    rng = np.random.default_rng(2)
+    m = tf.keras.Sequential([
+        tf.keras.layers.Input(shape=(7,)),
+        tf.keras.layers.Embedding(20, 8, name="emb"),
+        tf.keras.layers.LSTM(12, return_sequences=False, name="lstm"),
+        tf.keras.layers.Dense(3, activation="softmax", name="out"),
+    ])
+    p = str(tmp_path / "lstm.h5")
+    m.save(p)
+    net = KerasModelImport.import_keras_model_and_weights(p)
+    x = rng.integers(0, 20, size=(5, 7)).astype(np.float32)
+    _compare(m, net, x)
+
+
+def test_functional_residual_graph(tmp_path):
+    rng = np.random.default_rng(3)
+    inp = tf.keras.layers.Input(shape=(8, 8, 4), name="in0")
+    c = tf.keras.layers.Conv2D(4, 3, padding="same", name="c1")(inp)
+    s = tf.keras.layers.Add(name="add")([inp, c])
+    t = tf.keras.layers.Concatenate(name="cat")([s, inp])
+    g = tf.keras.layers.GlobalAveragePooling2D(name="gap")(t)
+    out = tf.keras.layers.Dense(6, activation="softmax", name="out")(g)
+    m = tf.keras.Model(inp, out)
+    p = str(tmp_path / "resid.h5")
+    m.save(p)
+    net = KerasModelImport.import_keras_model_and_weights(p)
+    assert isinstance(net, ComputationGraph)
+    x = rng.normal(size=(3, 8, 8, 4)).astype(np.float32)
+    _compare(m, net, x)
+
+
+def test_unsupported_layer_is_loud(tmp_path):
+    m = tf.keras.Sequential([
+        tf.keras.layers.Input(shape=(4,)),
+        tf.keras.layers.Dense(4, name="d"),
+        tf.keras.layers.GaussianNoise(0.1, name="gn"),
+    ])
+    p = str(tmp_path / "unsup.h5")
+    m.save(p)
+    with pytest.raises(ValueError, match="GaussianNoise"):
+        KerasModelImport.import_keras_model_and_weights(p)
+
+
+def test_imported_model_fine_tunes(tmp_path):
+    """Import → fit continues training (the BERT-style fine-tune contract,
+    at test scale)."""
+    rng = np.random.default_rng(4)
+    m = tf.keras.Sequential([
+        tf.keras.layers.Input(shape=(6,)),
+        tf.keras.layers.Dense(16, activation="tanh", name="d1"),
+        tf.keras.layers.Dense(2, activation="softmax", name="out"),
+    ])
+    p = str(tmp_path / "ft.h5")
+    m.save(p)
+    net = KerasModelImport.import_keras_model_and_weights(p)
+    # imported nets carry no updater/loss (Keras compile state is not
+    # mapped); attach one via transfer-learning-style config overwrite
+    from deeplearning4j_tpu.nn.updaters import Adam
+    net.conf.updater = Adam(learning_rate=0.05)
+    net.updater_state = net.conf.updater.init_state(net.params)
+    x = rng.normal(size=(64, 6)).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[(x.sum(axis=1) > 0).astype(int)]
+    from deeplearning4j_tpu.data.dataset import DataSet
+    before = float(net.score(DataSet(x, y)))
+    net.fit(DataSet(x, y), epochs=30)
+    after = float(net.score(DataSet(x, y)))
+    assert after < before
