@@ -143,7 +143,9 @@ def _map_lstm(cfg) -> _Mapped:
     if cfg.get("return_state"):
         raise ValueError("LSTM return_state not supported in import")
     if _act(cfg.get("activation", "tanh")) != "tanh" or \
-            cfg.get("recurrent_activation", "sigmoid") not in ("sigmoid", "hard_sigmoid"):
+            cfg.get("recurrent_activation", "sigmoid") != "sigmoid":
+        # hard_sigmoid gates would silently change the cell math — our
+        # lstm_cell computes exact sigmoid
         raise ValueError("only tanh/sigmoid LSTM variants import")
     if not cfg.get("return_sequences", False):
         # our LSTM layer always returns sequences; the Sequential importer
@@ -175,6 +177,18 @@ def _map_simple_rnn(cfg) -> _Mapped:
         return {"W": ws[0], "RW": ws[1], "b": b}
     return _Mapped(lyr, w, vertex=("rnn", {
         "return_sequences": bool(cfg.get("return_sequences", False))}))
+
+
+def _map_relu(cfg) -> _Mapped:
+    mv = cfg.get("max_value")
+    if cfg.get("negative_slope") or cfg.get("threshold"):
+        raise ValueError("ReLU with negative_slope/threshold not supported — "
+                         "import as LeakyReLU/ThresholdedReLU instead")
+    if mv in (None, 0):
+        return _Mapped(ActivationLayer(activation="relu"))
+    if float(mv) == 6.0:
+        return _Mapped(ActivationLayer(activation="relu6"))
+    raise ValueError(f"ReLU max_value={mv} not supported (only None/6.0)")
 
 
 def _map_zeropad(cfg) -> _Mapped:
@@ -211,9 +225,10 @@ _MAPPERS: Dict[str, Callable[[dict], _Mapped]] = {
     "Flatten": lambda c: _Mapped(FlattenLayer()),
     "Activation": lambda c: _Mapped(
         ActivationLayer(activation=_act(c["activation"]))),
-    "ReLU": lambda c: _Mapped(ActivationLayer(
-        activation="relu6" if c.get("max_value") == 6.0 else "relu")),
-    "LeakyReLU": lambda c: _Mapped(ActivationLayer(activation="leakyrelu")),
+    "ReLU": lambda c: _map_relu(c),
+    "LeakyReLU": lambda c: _Mapped(ActivationLayer(
+        activation="leakyrelu",
+        alpha=float(c.get("negative_slope", c.get("alpha", 0.3))))),
     "Softmax": lambda c: _Mapped(ActivationLayer(activation="softmax")),
     "ZeroPadding2D": lambda c: _map_zeropad(c),
     "UpSampling2D": lambda c: _Mapped(Upsampling2D(
@@ -246,13 +261,24 @@ def _h5_weights(f, layer_name: str) -> List[np.ndarray]:
     names = [n.decode() if isinstance(n, bytes) else n
              for n in g.attrs.get("weight_names", [])]
     if not names:  # Keras 2 nests one more level without weight_names attr
+        # visititems yields in HDF5 (alphabetical) order — beta < gamma
+        # would silently swap same-shaped BN params; reorder by the
+        # canonical per-layer weight rank instead
+        rank = {"kernel": 0, "embeddings": 0, "gamma": 0, "depthwise": 0,
+                "recurrent_kernel": 1, "pointwise": 1, "beta": 1,
+                "bias": 2, "moving_mean": 2, "moving_variance": 3}
+
+        def key_of(path):
+            leaf = path.split("/")[-1].split(":")[0]
+            return rank.get(leaf, 99)
+
         out = []
-        def visit(_, obj):
+        def visit(path, obj):
             import h5py
             if isinstance(obj, h5py.Dataset):
-                out.append(np.array(obj))
+                out.append((key_of(path), np.array(obj)))
         g.visititems(visit)
-        return out
+        return [a for _, a in sorted(out, key=lambda kv: kv[0])]
     return [np.array(g[n]) for n in names]
 
 

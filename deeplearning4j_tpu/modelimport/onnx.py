@@ -38,7 +38,12 @@ def _tensor_to_np(t) -> np.ndarray:
     elif t.int64_data:
         a = np.asarray(list(t.int64_data), dtype=dt)
     elif t.int32_data:
-        a = np.asarray(list(t.int32_data), dtype=dt)
+        if t.data_type == 10:  # fp16 payloads are uint16 BIT PATTERNS in
+            # int32_data (ONNX spec) — reinterpret, don't value-cast
+            a = np.asarray(list(t.int32_data),
+                           dtype=np.uint16).view(np.float16)
+        else:
+            a = np.asarray(list(t.int32_data), dtype=dt)
     elif t.double_data:
         a = np.asarray(list(t.double_data), dtype=dt)
     else:
@@ -162,6 +167,9 @@ def _pool(node, ctx, at):
     if auto in ("SAME_UPPER", "SAME_LOWER"):
         mode, pad = "same", (0, 0)
     else:
+        if len(pads) == 4 and (pads[0] != pads[2] or pads[1] != pads[3]):
+            raise ValueError(f"asymmetric {node.op_type} pads {pads} not "
+                             "supported (end-side padding would be dropped)")
         mode, pad = "truncate", (int(pads[0]), int(pads[1]))
     return ctx.sd.call(op, ctx.get(node.input[0]), name=node.output[0],
                        attrs={"kernel": tuple(int(k) for k in at["kernel_shape"]),

@@ -157,6 +157,9 @@ def _conv2d(node, ctx, ins):
     if _attr(node, "data_format", "NHWC") != "NHWC":
         raise ValueError("Conv2D NCHW graphs not supported (convert to NHWC)")
     pad = _attr(node, "padding", "VALID")
+    if pad == "EXPLICIT":
+        raise ValueError("Conv2D padding=EXPLICIT not supported "
+                         "(explicit_paddings would be silently dropped)")
     # TF kernel layout HWIO; our conv2d stores OIHW
     w = ctx.sd.call("shape.transpose", ctx.get(ins[1]),
                     attrs={"axes": [3, 2, 0, 1]})
@@ -171,7 +174,12 @@ def _conv2d(node, ctx, ins):
 @tf_op("MaxPool", "AvgPool")
 def _pool(node, ctx, ins):
     op = "maxpool2d" if node.op == "MaxPool" else "avgpool2d"
+    if _attr(node, "data_format", "NHWC") != "NHWC":
+        raise ValueError(f"{node.op} NCHW graphs not supported "
+                         "(convert to NHWC)")
     pad = _attr(node, "padding", "VALID")
+    if pad == "EXPLICIT":
+        raise ValueError(f"{node.op} padding=EXPLICIT not supported")
     return ctx.sd.call(
         op, ctx.get(ins[0]), name=node.name,
         attrs={"kernel": _pair_from(_attr(node, "ksize", [1, 2, 2, 1])),

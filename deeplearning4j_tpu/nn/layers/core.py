@@ -56,13 +56,19 @@ class DenseLayer(Layer):
 @layer("activation")
 class ActivationLayer(Layer):
     activation: str = "relu"
+    # parameter for parameterized activations (leakyrelu slope, elu alpha,
+    # thresholdedrelu theta); None = the activation's own default
+    alpha: Optional[float] = None
     name: Optional[str] = None
 
     def has_params(self):
         return False
 
     def apply(self, params, x, state, *, train=False, rng=None, mask=None):
-        return _act.get(self.activation)(x), state, mask
+        fn = _act.get(self.activation)
+        if self.alpha is not None:
+            return fn(x, self.alpha), state, mask
+        return fn(x), state, mask
 
 
 @layer("dropout")

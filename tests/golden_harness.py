@@ -1,0 +1,79 @@
+"""Golden-snapshot integration harness (SURVEY.md §4 row
+"Integration/regression harness", reference: ``dl4j-integration-tests``
+``IntegrationTestRunner``† — full models trained N steps from a fixed seed,
+params/losses compared against stored snapshots with tolerance bands).
+
+Shared by the regression test (tests/test_integration_golden.py) and the
+fixture generator (``python tests/golden_harness.py`` regenerates
+tests/fixtures/lenet_golden.json — rerun after a DELIBERATE numeric change
+and commit the diff; an undeliberate change fails CI).
+"""
+
+import json
+import os
+
+import numpy as np
+
+FIXTURE = os.path.join(os.path.dirname(__file__), "fixtures",
+                       "lenet_golden.json")
+STEPS = 8
+BATCH = 16
+
+
+def run_reference_training() -> dict:
+    """Train LeNet STEPS fixed steps from fixed seeds; return the snapshot."""
+    import jax
+
+    from deeplearning4j_tpu.data.dataset import DataSet
+    from deeplearning4j_tpu.models.lenet import lenet
+    from deeplearning4j_tpu.nn.updaters import Adam
+
+    rng = np.random.default_rng(20260730)
+    net = lenet(seed=777, updater=Adam(learning_rate=1e-3))
+    losses = []
+    for _ in range(STEPS):
+        x = rng.normal(size=(BATCH, 1, 28, 28)).astype(np.float32)
+        y = np.eye(10, dtype=np.float32)[rng.integers(0, 10, BATCH)]
+        net.fit(DataSet(x, y), epochs=1)
+        losses.append(float(net.score()))
+
+    params = {}
+    for path, leaf in jax.tree_util.tree_leaves_with_path(net.params):
+        key = "/".join(str(p) for p in path)
+        a = np.asarray(leaf, dtype=np.float64).ravel()
+        params[key] = {"mean": float(a.mean()), "std": float(a.std()),
+                       "head": [float(v) for v in a[:5]]}
+    return {"steps": STEPS, "batch": BATCH, "losses": losses,
+            "params": params}
+
+
+def compare(snapshot: dict, golden: dict, rtol: float = 1e-3,
+            atol: float = 1e-5):
+    """Raise AssertionError on any out-of-band drift."""
+    np.testing.assert_allclose(snapshot["losses"], golden["losses"],
+                               rtol=rtol, atol=atol,
+                               err_msg="loss curve drifted")
+    assert snapshot["params"].keys() == golden["params"].keys(), (
+        "param tree structure changed")
+    for key, g in golden["params"].items():
+        s = snapshot["params"][key]
+        np.testing.assert_allclose(
+            [s["mean"], s["std"]] + s["head"],
+            [g["mean"], g["std"]] + g["head"],
+            rtol=rtol, atol=atol, err_msg=f"param {key} drifted")
+
+
+if __name__ == "__main__":
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    os.makedirs(os.path.dirname(FIXTURE), exist_ok=True)
+    snap = run_reference_training()
+    with open(FIXTURE, "w") as f:
+        json.dump(snap, f, indent=1)
+    print(f"wrote {FIXTURE}: final loss {snap['losses'][-1]:.6f}")

@@ -1,0 +1,44 @@
+"""Golden-snapshot regression: LeNet trained N fixed steps from fixed seeds
+must reproduce the committed fixture within tolerance bands (SURVEY.md §4,
+``IntegrationTestRunner``† analog). Regenerate DELIBERATE changes with
+``python tests/golden_harness.py`` and commit the new fixture."""
+
+import copy
+import json
+import os
+
+import pytest
+
+from golden_harness import FIXTURE, compare, run_reference_training
+
+
+@pytest.fixture(scope="module")
+def snapshot():
+    return run_reference_training()
+
+
+def _golden():
+    if not os.path.exists(FIXTURE):
+        pytest.fail(f"golden fixture missing: {FIXTURE} — run "
+                    "`python tests/golden_harness.py` and commit it")
+    with open(FIXTURE) as f:
+        return json.load(f)
+
+
+def test_training_matches_golden_snapshot(snapshot):
+    compare(snapshot, _golden())
+
+
+def test_harness_trips_on_numeric_drift(snapshot):
+    """Sensitivity check: a small deliberate perturbation must fail the
+    comparison — otherwise the tolerance bands are too loose to guard
+    anything."""
+    drifted = copy.deepcopy(snapshot)
+    drifted["losses"][-1] *= 1.01
+    with pytest.raises(AssertionError):
+        compare(drifted, _golden())
+    drifted2 = copy.deepcopy(snapshot)
+    key = next(iter(drifted2["params"]))
+    drifted2["params"][key]["mean"] += 0.01
+    with pytest.raises(AssertionError):
+        compare(drifted2, _golden())

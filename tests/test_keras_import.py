@@ -140,3 +140,29 @@ def test_imported_model_fine_tunes(tmp_path):
     net.fit(DataSet(x, y), epochs=30)
     after = float(net.score(DataSet(x, y)))
     assert after < before
+
+
+def test_leaky_relu_alpha_preserved(tmp_path):
+    """Keras LeakyReLU(0.3 default) must keep its slope (regression: mapped
+    to our leakyrelu default 0.01, 30x off on negatives)."""
+    m = tf.keras.Sequential([
+        tf.keras.layers.Input(shape=(4,)),
+        tf.keras.layers.Dense(4, name="d"),
+        tf.keras.layers.LeakyReLU(name="lr"),
+    ])
+    p = str(tmp_path / "lrelu.h5")
+    m.save(p)
+    net = KerasModelImport.import_keras_model_and_weights(p)
+    x = np.random.default_rng(0).normal(size=(6, 4)).astype(np.float32) * 5
+    _compare(m, net, x)
+
+
+def test_relu_with_cap_or_slope_is_loud(tmp_path):
+    m = tf.keras.Sequential([
+        tf.keras.layers.Input(shape=(4,)),
+        tf.keras.layers.ReLU(max_value=1.0, name="r"),
+    ])
+    p = str(tmp_path / "caprelu.h5")
+    m.save(p)
+    with pytest.raises(ValueError, match="max_value"):
+        KerasModelImport.import_keras_model_and_weights(p)

@@ -209,3 +209,33 @@ def test_onnx_initializers_are_trainable_variables():
     assert sd._vars["w"].kind == VARIABLE  # fine-tunable
     out = sd.output({"x": np.ones((2, 3), np.float32)}, ["y"])["y"]
     np.testing.assert_allclose(np.asarray(out), 3.0)
+
+
+def test_onnx_fp16_int32data_bit_reinterpreted():
+    """fp16 payloads in int32_data are uint16 BIT PATTERNS per the ONNX
+    spec (regression: value-cast turned 1.0 into 15360.0)."""
+    from deeplearning4j_tpu.modelimport.onnx import _tensor_to_np
+    from deeplearning4j_tpu.modelimport.proto import onnx_min_pb2 as P
+    t = P.TensorProto()
+    t.dims.extend([2])
+    t.data_type = 10  # float16
+    vals = np.asarray([1.0, -2.5], np.float16)
+    t.int32_data.extend(int(v) for v in vals.view(np.uint16))
+    out = _tensor_to_np(t)
+    np.testing.assert_array_equal(out, vals)
+
+
+def test_onnx_asymmetric_pool_pads_loud():
+    from deeplearning4j_tpu.modelimport.proto import onnx_min_pb2 as P
+    from deeplearning4j_tpu.modelimport.onnx import OnnxFrameworkImporter
+    m = P.ModelProto(); m.ir_version = 8
+    g = m.graph
+    g.input.append(_onnx_io(P, "x", [1, 2, 8, 8]))
+    g.output.append(_onnx_io(P, "y", [1, 2, 4, 4]))
+    n = g.node.add(); n.op_type = "MaxPool"
+    n.input.append("x"); n.output.append("y")
+    for name, ints in [("kernel_shape", [2, 2]), ("strides", [2, 2]),
+                       ("pads", [0, 0, 1, 1])]:
+        a = n.attribute.add(); a.name = name; a.type = 7; a.ints.extend(ints)
+    with pytest.raises(ValueError, match="asymmetric"):
+        OnnxFrameworkImporter.import_model_proto(m.SerializeToString())
