@@ -236,7 +236,87 @@ _MAPPERS: Dict[str, Callable[[dict], _Mapped]] = {
     "Embedding": _map_embedding,
     "LSTM": _map_lstm,
     "SimpleRNN": _map_simple_rnn,
+    "SeparableConv2D": lambda c: _map_separable(c),
+    "DepthwiseConv2D": lambda c: _map_depthwise(c),
+    "PReLU": lambda c: _map_prelu(c),
+    "SpatialDropout2D": lambda c: _map_special(
+        "SpatialDropout", rate=float(c["rate"]), data_format="NHWC"),
+    "GaussianNoise": lambda c: _map_special(
+        "GaussianNoise", stddev=float(c["stddev"])),
+    "GaussianDropout": lambda c: _map_special(
+        "GaussianDropout", rate=float(c["rate"])),
+    "Cropping2D": lambda c: _map_cropping(c),
 }
+
+
+def _map_special(cls_name: str, **kw) -> _Mapped:
+    from ..nn.layers import special
+    return _Mapped(getattr(special, cls_name)(**kw))
+
+
+def _map_separable(cfg) -> _Mapped:
+    from ..nn.layers.conv_extra import SeparableConvolution2D
+    _check_channels_last(cfg, "SeparableConv2D")
+    same = cfg.get("padding", "valid") == "same"
+    lyr = SeparableConvolution2D(
+        n_out=int(cfg["filters"]), kernel=_pair(cfg["kernel_size"]),
+        stride=_pair(cfg.get("strides", 1)),
+        dilation=_pair(cfg.get("dilation_rate", 1)),
+        depth_multiplier=int(cfg.get("depth_multiplier", 1)),
+        mode="same" if same else "truncate",
+        activation=_act(cfg.get("activation")),
+        has_bias=cfg.get("use_bias", True), data_format="NHWC")
+
+    def w(ws):
+        # keras depthwise kernel [kh,kw,cin,mult] -> ours [cin*mult,1,kh,kw];
+        # pointwise [1,1,cin*mult,out] -> [out,cin*mult,1,1]
+        dk = ws[0]
+        kh, kw, cin, mult = dk.shape
+        dw = dk.transpose(2, 3, 0, 1).reshape(cin * mult, 1, kh, kw)
+        pw = ws[1].transpose(3, 2, 0, 1)
+        out = {"dW": dw, "pW": pw}
+        if cfg.get("use_bias", True):
+            out["b"] = ws[2]
+        return out
+    return _Mapped(lyr, w)
+
+
+def _map_depthwise(cfg) -> _Mapped:
+    from ..nn.layers.conv_extra import DepthwiseConvolution2D
+    _check_channels_last(cfg, "DepthwiseConv2D")
+    same = cfg.get("padding", "valid") == "same"
+    lyr = DepthwiseConvolution2D(
+        kernel=_pair(cfg["kernel_size"]), stride=_pair(cfg.get("strides", 1)),
+        dilation=_pair(cfg.get("dilation_rate", 1)),
+        depth_multiplier=int(cfg.get("depth_multiplier", 1)),
+        mode="same" if same else "truncate",
+        activation=_act(cfg.get("activation")),
+        has_bias=cfg.get("use_bias", True), data_format="NHWC")
+
+    def w(ws):
+        dk = ws[0]
+        kh, kw, cin, mult = dk.shape
+        out = {"W": dk.transpose(2, 3, 0, 1).reshape(cin * mult, 1, kh, kw)}
+        if cfg.get("use_bias", True):
+            out["b"] = ws[1]
+        return out
+    return _Mapped(lyr, w)
+
+
+def _map_prelu(cfg) -> _Mapped:
+    from ..nn.layers.special import PReLULayer
+    return _Mapped(PReLULayer(), lambda ws: {"alpha": ws[0]})
+
+
+def _map_cropping(cfg) -> _Mapped:
+    from ..nn.layers.conv_extra import Cropping2D
+    cr = cfg["cropping"]
+    if isinstance(cr, int):
+        t = b = l = r = cr
+    else:
+        (t, b), (l, r) = cr
+    return _Mapped(Cropping2D(cropping=(int(t), int(b), int(l), int(r)),
+                              data_format="NHWC"))
 
 
 def _input_type_from_batch_shape(shape) -> tuple:
@@ -264,8 +344,9 @@ def _h5_weights(f, layer_name: str) -> List[np.ndarray]:
         # visititems yields in HDF5 (alphabetical) order — beta < gamma
         # would silently swap same-shaped BN params; reorder by the
         # canonical per-layer weight rank instead
-        rank = {"kernel": 0, "embeddings": 0, "gamma": 0, "depthwise": 0,
-                "recurrent_kernel": 1, "pointwise": 1, "beta": 1,
+        rank = {"kernel": 0, "embeddings": 0, "gamma": 0,
+                "depthwise_kernel": 0, "recurrent_kernel": 1,
+                "pointwise_kernel": 1, "beta": 1,
                 "bias": 2, "moving_mean": 2, "moving_variance": 3}
 
         def key_of(path):

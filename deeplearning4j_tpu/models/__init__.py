@@ -1,0 +1,7 @@
+"""Model zoo (SURVEY.md §2.5 deeplearning4j-zoo)."""
+
+from .lenet import lenet, lenet_config  # noqa: F401
+from .resnet import resnet, resnet50  # noqa: F401
+from .zoo import (alexnet, darknet19, simple_cnn, squeezenet,  # noqa: F401
+                  text_generation_lstm, tiny_yolo, unet, vgg16, vgg19,
+                  xception)

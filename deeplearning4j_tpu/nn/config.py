@@ -18,6 +18,7 @@ import json
 from typing import Any, Dict, List, Optional, Tuple
 
 from . import updaters as _upd
+from . import constraints as _constraints
 from .layers.base import Layer
 from .layers.core import DenseLayer, FlattenLayer, LossLayer, OutputLayer
 
@@ -54,6 +55,7 @@ class MultiLayerConfiguration:
     gradient_clip_value: Optional[float] = None      # clip by value
     gradient_clip_l2: Optional[float] = None         # clip by global L2 norm
     tbptt_length: Optional[int] = None               # truncated BPTT window
+    constraints: Any = None                          # [(BaseConstraint, scope)]
 
     def to_json(self) -> str:
         d = {
@@ -68,6 +70,7 @@ class MultiLayerConfiguration:
             "gradient_clip_value": self.gradient_clip_value,
             "gradient_clip_l2": self.gradient_clip_l2,
             "tbptt_length": self.tbptt_length,
+            "constraints": _constraints.encode_constraints(self.constraints),
             "layers": [l.to_dict() for l in self.layers],
         }
         return json.dumps(d, indent=2)
@@ -86,6 +89,7 @@ class MultiLayerConfiguration:
             gradient_clip_value=d.get("gradient_clip_value"),
             gradient_clip_l2=d.get("gradient_clip_l2"),
             tbptt_length=d.get("tbptt_length"),
+            constraints=_constraints.decode_constraints(d.get("constraints")),
         )
 
 
@@ -103,6 +107,7 @@ class NeuralNetConfiguration:
         self._clip_l2 = None
         self._input_shape = None
         self._tbptt = None
+        self._constraints = []
 
     @staticmethod
     def builder() -> "NeuralNetConfiguration":
@@ -140,6 +145,20 @@ class NeuralNetConfiguration:
         self._tbptt = n
         return self
 
+    def constrain_weights(self, *cs):
+        """Apply constraints to weight params after every update (DL4J
+        ``constrainWeights``)."""
+        self._constraints.extend((c, "weights") for c in cs)
+        return self
+
+    def constrain_bias(self, *cs):
+        self._constraints.extend((c, "bias") for c in cs)
+        return self
+
+    def constrain_all_parameters(self, *cs):
+        self._constraints.extend((c, "all") for c in cs)
+        return self
+
     def input_type(self, shape: Tuple[int, ...]):
         self._input_shape = tuple(shape)
         return self
@@ -171,7 +190,7 @@ class NeuralNetConfiguration:
             layers=layers, input_shape=self._input_shape, seed=self._seed,
             dtype=self._dtype, updater=self._updater, l1=self._l1, l2=self._l2,
             gradient_clip_value=self._clip_value, gradient_clip_l2=self._clip_l2,
-            tbptt_length=self._tbptt)
+            tbptt_length=self._tbptt, constraints=self._constraints or None)
 
 
 def stamp_tbptt(layer: Layer, tbptt: int) -> Layer:

@@ -1,0 +1,145 @@
+"""Parameter constraints, applied after each update step.
+
+TPU-native equivalent of DL4J's constraint family (reference:
+``deeplearning4j-nn .../nn/conf/constraint/{MaxNormConstraint,
+MinMaxNormConstraint,UnitNormConstraint,NonNegativeConstraint}.java``† per
+SURVEY.md §2.4; reference mount was empty, citations upstream-relative,
+unverified).
+
+Constraints are pure array->array functions folded into the jitted train
+step right after the updater applies (DL4J applies them in the same place).
+Scope mirrors DL4J's ``constrainWeights``/``constrainBias``/
+``constrainAllParameters``: 'W'-named params, 'b'-named params, or all.
+The norm is taken over every axis except the OUTPUT-unit axis (last axis
+for [in,out] dense weights, axis 0 for OIHW conv kernels), matching the
+reference's per-unit semantics.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax.numpy as jnp
+
+CONSTRAINTS = {}
+
+
+def _constraint(kind):
+    def deco(cls):
+        cls = dataclasses.dataclass(cls)
+        cls.kind = kind
+        CONSTRAINTS[kind] = cls
+        return cls
+    return deco
+
+
+def _unit_axes(a):
+    """Reduce over all axes except the output-unit axis."""
+    if a.ndim <= 1:
+        return None  # whole-vector norm
+    if a.ndim == 2:
+        return (0,)              # [in, out] -> per output column
+    return tuple(range(1, a.ndim))  # OIHW & friends -> per output filter
+
+
+class BaseConstraint:
+    kind = "base"
+
+    def apply(self, a):
+        raise NotImplementedError
+
+    def to_dict(self):
+        d = {"kind": self.kind}
+        for f in dataclasses.fields(self):
+            d[f.name] = getattr(self, f.name)
+        return d
+
+    @staticmethod
+    def from_dict(d):
+        d = dict(d)
+        cls = CONSTRAINTS[d.pop("kind")]
+        return cls(**d)
+
+
+def _norms(a):
+    axes = _unit_axes(a)
+    n = jnp.sqrt(jnp.sum(a * a, axis=axes, keepdims=axes is not None))
+    return jnp.maximum(n, 1e-12)
+
+
+@_constraint("max_norm")
+class MaxNormConstraint(BaseConstraint):
+    max_norm: float = 2.0
+
+    def apply(self, a):
+        n = _norms(a)
+        scale = jnp.minimum(1.0, self.max_norm / n)
+        return a * scale
+
+
+@_constraint("min_max_norm")
+class MinMaxNormConstraint(BaseConstraint):
+    min_norm: float = 0.5
+    max_norm: float = 2.0
+    rate: float = 1.0  # 1.0 = hard projection (DL4J default)
+
+    def apply(self, a):
+        n = _norms(a)
+        clipped = jnp.clip(n, self.min_norm, self.max_norm)
+        target = self.rate * clipped + (1.0 - self.rate) * n
+        return a * (target / n)
+
+
+@_constraint("unit_norm")
+class UnitNormConstraint(BaseConstraint):
+    def apply(self, a):
+        return a / _norms(a)
+
+
+@_constraint("non_negative")
+class NonNegativeConstraint(BaseConstraint):
+    def apply(self, a):
+        return jnp.maximum(a, 0.0)
+
+
+_SCOPE_W = ("W", "RW", "PW", "dW", "pW", "Wq", "Wk", "Wv", "Wo", "Wx", "Wr",
+            "Wc", "Wa")
+
+
+def apply_constraints(constraints, params, skip=()):
+    """Fold every (constraint, scope) pair over the param pytree.
+    ``scope``: "weights" | "bias" | "all". Pure — safe inside jit.
+    ``skip``: top-level keys (layer indices / vertex names) left untouched —
+    the engines pass their FROZEN layers here; a frozen layer receives no
+    updates of any kind, constraint projections included."""
+    if not constraints:
+        return params
+    skip = set(skip)
+
+    def transform(name, leaf):
+        out = leaf
+        for c, scope in constraints:
+            if scope == "all" or \
+                    (scope == "weights" and name in _SCOPE_W) or \
+                    (scope == "bias" and name == "b"):
+                out = c.apply(out)
+        return out
+
+    def walk(node):
+        if isinstance(node, dict):
+            return {k: transform(k, v) if not isinstance(v, dict) else walk(v)
+                    for k, v in node.items()}
+        return node
+
+    return {k: (v if k in skip else
+                (walk(v) if isinstance(v, dict) else transform(k, v)))
+            for k, v in params.items()}
+
+
+def encode_constraints(constraints):
+    return [[c.to_dict(), scope] for c, scope in constraints or []]
+
+
+def decode_constraints(data):
+    return [(BaseConstraint.from_dict(d), scope) for d, scope in data or []]

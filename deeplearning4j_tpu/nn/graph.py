@@ -46,6 +46,7 @@ from .. import dtypes as _dt
 from ..data.dataset import (DataSet, DataSetIterator, MultiDataSet,
                             MultiDataSetIterator, NumpyMultiDataSetIterator)
 from ..ops import losses as _loss
+from . import constraints as _constraints
 from . import updaters as _upd
 from .layers.base import Layer
 from .layers.core import LossLayer, OutputLayer
@@ -63,7 +64,8 @@ class ComputationGraphConfiguration:
                  l1: float = 0.0, l2: float = 0.0,
                  gradient_clip_value: Optional[float] = None,
                  gradient_clip_l2: Optional[float] = None,
-                 tbptt_length: Optional[int] = None):
+                 tbptt_length: Optional[int] = None,
+                 constraints: Any = None):
         self.inputs = list(inputs)
         self.outputs = list(outputs)
         self.vertices = list(vertices)  # [(name, vertex, [input names])]
@@ -76,6 +78,7 @@ class ComputationGraphConfiguration:
         self.gradient_clip_value = gradient_clip_value
         self.gradient_clip_l2 = gradient_clip_l2
         self.tbptt_length = tbptt_length
+        self.constraints = constraints
         self._validate()
 
     def _validate(self):
@@ -127,6 +130,7 @@ class ComputationGraphConfiguration:
             "gradient_clip_value": self.gradient_clip_value,
             "gradient_clip_l2": self.gradient_clip_l2,
             "tbptt_length": self.tbptt_length,
+            "constraints": _constraints.encode_constraints(self.constraints),
             "network_inputs": self.inputs,
             "network_outputs": self.outputs,
             "input_shapes": {k: list(v) for k, v in self.input_shapes.items()},
@@ -148,7 +152,8 @@ class ComputationGraphConfiguration:
             l1=d.get("l1", 0.0), l2=d.get("l2", 0.0),
             gradient_clip_value=d.get("gradient_clip_value"),
             gradient_clip_l2=d.get("gradient_clip_l2"),
-            tbptt_length=d.get("tbptt_length"))
+            tbptt_length=d.get("tbptt_length"),
+            constraints=_constraints.decode_constraints(d.get("constraints")))
 
 
 class GraphBuilder:
@@ -209,7 +214,8 @@ class GraphBuilder:
             l1=b._l1 if b else 0.0, l2=b._l2 if b else 0.0,
             gradient_clip_value=b._clip_value if b else None,
             gradient_clip_l2=b._clip_l2 if b else None,
-            tbptt_length=b._tbptt if b else None)
+            tbptt_length=b._tbptt if b else None,
+            constraints=(b._constraints or None) if b else None)
 
 
 class ComputationGraph:
@@ -341,6 +347,11 @@ class ComputationGraph:
     def _build_train_step(self):
         updater = self.conf.updater
         outputs = self.conf.outputs
+        from .layers.wrappers import FrozenLayer
+        from .vertices import LayerVertex
+        frozen_keys = frozenset(
+            n for n, v, _ in self.conf.vertices
+            if isinstance(v, LayerVertex) and isinstance(v.layer, FrozenLayer))
         out_layers = self._out_layers
         if set(out_layers) != set(outputs):
             bad = sorted(set(outputs) - set(out_layers))
@@ -369,6 +380,8 @@ class ComputationGraph:
             grads = self._clip(grads)
             delta, new_opt = updater.apply(grads, opt_state, params, step)
             new_params = jax.tree.map(lambda p, d: p - d, params, delta)
+            new_params = _constraints.apply_constraints(
+                self.conf.constraints, new_params, skip=frozen_keys)
             return new_params, new_opt, new_bn, loss
 
         return jax.jit(step_fn, donate_argnums=(0, 1, 2))

@@ -1,2 +1,3 @@
 from .base import LAYERS, Layer  # noqa: F401
-from . import conv, core, wrappers  # noqa: F401
+from . import (attention, conv, conv_extra, core, recurrent,  # noqa: F401
+               special, wrappers)

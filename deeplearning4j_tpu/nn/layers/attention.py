@@ -1,0 +1,204 @@
+"""Attention layers.
+
+TPU-native equivalents of DL4J's attention family (reference:
+``deeplearning4j-nn .../nn/conf/layers/{SelfAttentionLayer,
+LearnedSelfAttentionLayer,RecurrentAttentionLayer}.java`` and the
+``AttentionVertex``† per SURVEY.md §2.4/§2.7; reference mount was empty,
+citations upstream-relative, unverified).
+
+All ride ``ops.nnops.dot_product_attention`` (fused scaled-dot-product —
+XLA fuses the softmax chain; the quadratic-attention parity bar of §2.7,
+with ring attention living in parallel/sequence.py as the beyond-parity
+long-context path). Layout [B, T, F]; multi-head reshapes to [B, H, T, hs].
+Per-timestep masks flow as key masks so padded steps get zero weight.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ...ops import nnops
+from ...ops.math import precision_for
+from .. import weights as _winit
+from .base import Layer, layer
+
+
+def _heads_split(x, n_heads):
+    B, T, D = x.shape
+    hs = D // n_heads
+    return x.reshape(B, T, n_heads, hs).transpose(0, 2, 1, 3)  # [B,H,T,hs]
+
+
+def _heads_join(x):
+    B, H, T, hs = x.shape
+    return x.transpose(0, 2, 1, 3).reshape(B, T, H * hs)
+
+
+def _key_mask(mask, like):
+    """[B, T] keep-mask -> additive attention bias broadcastable to
+    [B, H, Tq, Tk]."""
+    if mask is None:
+        return None
+    neg = jnp.asarray(jnp.finfo(like.dtype).min, like.dtype)
+    return jnp.where(mask[:, None, None, :] > 0, 0.0, neg)
+
+
+def _mha(x_q, x_kv, params, n_heads, mask):
+    def proj(x, w):
+        return jnp.dot(x, w, precision=precision_for(x, w))
+
+    q = _heads_split(proj(x_q, params["Wq"]), n_heads)
+    k = _heads_split(proj(x_kv, params["Wk"]), n_heads)
+    v = _heads_split(proj(x_kv, params["Wv"]), n_heads)
+    scale = 1.0 / jnp.sqrt(jnp.asarray(q.shape[-1], q.dtype))
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                        precision=precision_for(q, k)) * scale
+    bias = _key_mask(mask, scores)
+    if bias is not None:
+        scores = scores + bias
+    att = jax.nn.softmax(scores, axis=-1)
+    y = jnp.einsum("bhqk,bhkd->bhqd", att, v,
+                   precision=precision_for(att, v))
+    y = _heads_join(y)
+    return proj(y, params["Wo"])
+
+
+@layer("self_attention")
+class SelfAttentionLayer(Layer):
+    """DL4J SelfAttentionLayer: multi-head scaled-dot self-attention with
+    input projections. Output [B, T, n_out]."""
+    n_out: int = 0
+    n_heads: int = 1
+    head_size: Optional[int] = None
+    weight_init: str = "xavier"
+    l1: float = 0.0
+    l2: float = 0.0
+    name: Optional[str] = None
+
+    def _dims(self, n_in):
+        hs = self.head_size or (self.n_out // self.n_heads)
+        return hs, self.n_heads * hs
+
+    def initialize(self, key, input_shape, dtype):
+        t, f = int(input_shape[0]), int(input_shape[-1])
+        hs, proj = self._dims(f)
+        ks = jax.random.split(key, 4)
+        params = {
+            "Wq": _winit.init(self.weight_init, ks[0], (f, proj), f, proj, dtype),
+            "Wk": _winit.init(self.weight_init, ks[1], (f, proj), f, proj, dtype),
+            "Wv": _winit.init(self.weight_init, ks[2], (f, proj), f, proj, dtype),
+            "Wo": _winit.init(self.weight_init, ks[3], (proj, self.n_out),
+                              proj, self.n_out, dtype),
+        }
+        return params, {}, (t, self.n_out)
+
+    def apply(self, params, x, state, *, train=False, rng=None, mask=None):
+        y = _mha(x, x, params, self.n_heads, mask)
+        if mask is not None:
+            y = y * mask[..., None]  # masked steps emit zeros (DL4J contract)
+        return y, state, mask
+
+
+@layer("learned_self_attention")
+class LearnedSelfAttentionLayer(Layer):
+    """DL4J LearnedSelfAttentionLayer: n_queries LEARNED query vectors
+    attend over the sequence -> fixed-size [B, n_queries, n_out] output
+    (a sequence-summarizer; mask-aware)."""
+    n_out: int = 0
+    n_heads: int = 1
+    n_queries: int = 1
+    head_size: Optional[int] = None
+    weight_init: str = "xavier"
+    l1: float = 0.0
+    l2: float = 0.0
+    name: Optional[str] = None
+
+    def initialize(self, key, input_shape, dtype):
+        f = int(input_shape[-1])
+        hs = self.head_size or (self.n_out // self.n_heads)
+        proj = self.n_heads * hs
+        ks = jax.random.split(key, 5)
+        params = {
+            "Q": _winit.init(self.weight_init, ks[0], (self.n_queries, f),
+                             f, f, dtype),
+            "Wq": _winit.init(self.weight_init, ks[1], (f, proj), f, proj, dtype),
+            "Wk": _winit.init(self.weight_init, ks[2], (f, proj), f, proj, dtype),
+            "Wv": _winit.init(self.weight_init, ks[3], (f, proj), f, proj, dtype),
+            "Wo": _winit.init(self.weight_init, ks[4], (proj, self.n_out),
+                              proj, self.n_out, dtype),
+        }
+        return params, {}, (self.n_queries, self.n_out)
+
+    def apply(self, params, x, state, *, train=False, rng=None, mask=None):
+        B = x.shape[0]
+        q = jnp.broadcast_to(params["Q"][None], (B,) + params["Q"].shape)
+        y = _mha(q, x, params, self.n_heads, mask)
+        return y, state, None  # fixed n_queries steps: no time mask anymore
+
+
+@layer("recurrent_attention")
+class RecurrentAttentionLayer(Layer):
+    """DL4J RecurrentAttentionLayer: an RNN whose step attends over the
+    full input sequence with the previous hidden state as query:
+    h_t = act(Wx x_t + Wr h_{t-1} + attention(h_{t-1}, X) Wc + b)."""
+    n_out: int = 0
+    activation: str = "tanh"
+    weight_init: str = "xavier"
+    l1: float = 0.0
+    l2: float = 0.0
+    name: Optional[str] = None
+
+    def initialize(self, key, input_shape, dtype):
+        t, f = int(input_shape[0]), int(input_shape[-1])
+        u = self.n_out
+        ks = jax.random.split(key, 5)
+        params = {
+            "Wx": _winit.init(self.weight_init, ks[0], (f, u), f, u, dtype),
+            "Wr": _winit.init(self.weight_init, ks[1], (u, u), u, u, dtype),
+            "Wc": _winit.init(self.weight_init, ks[2], (f, u), f, u, dtype),
+            "Wa": _winit.init(self.weight_init, ks[3], (u, f), u, f, dtype),
+            "b": jnp.zeros((u,), dtype),
+        }
+        return params, {}, (t, u)
+
+    def apply(self, params, x, state, *, train=False, rng=None, mask=None):
+        from ...ops import activations as _act
+
+        act = _act.get(self.activation)
+        B, T, F = x.shape
+        u = self.n_out
+        neg = jnp.finfo(x.dtype).min
+
+        def step(h, inp):
+            x_t, m_t = inp
+            # attention over the whole sequence, query = h_{t-1}
+            q = jnp.dot(h, params["Wa"],
+                        precision=precision_for(h, params["Wa"]))  # [B,F]
+            scores = jnp.einsum("bf,btf->bt", q, x,
+                                precision=precision_for(q, x))
+            scores = scores / jnp.sqrt(jnp.asarray(F, x.dtype))
+            if mask is not None:
+                scores = jnp.where(mask > 0, scores, neg)
+            w = jax.nn.softmax(scores, axis=-1)
+            ctx = jnp.einsum("bt,btf->bf", w, x,
+                             precision=precision_for(w, x))
+            h_new = act(jnp.dot(x_t, params["Wx"],
+                                precision=precision_for(x_t, params["Wx"]))
+                        + jnp.dot(h, params["Wr"],
+                                  precision=precision_for(h, params["Wr"]))
+                        + jnp.dot(ctx, params["Wc"],
+                                  precision=precision_for(ctx, params["Wc"]))
+                        + params["b"])
+            if m_t is not None:
+                h_new = jnp.where(m_t[:, None] > 0, h_new, h)
+            return h_new, h_new
+
+        h0 = jnp.zeros((B, u), x.dtype)
+        xs = jnp.swapaxes(x, 0, 1)  # [T,B,F]
+        ms = (jnp.swapaxes(mask, 0, 1) if mask is not None
+              else jnp.ones((T, B), x.dtype))
+        _, ys = jax.lax.scan(lambda h, i: step(h, (i[0], i[1])), h0, (xs, ms))
+        return jnp.swapaxes(ys, 0, 1), state, mask

@@ -1,0 +1,492 @@
+"""Extended convolution family: deconv, separable/depthwise, 1D conv stack,
+locally-connected, crop/space-depth reshapes.
+
+TPU-native equivalents of DL4J layer configs (reference:
+``deeplearning4j-nn .../nn/conf/layers/{Deconvolution2D,SeparableConvolution2D,
+DepthwiseConvolution2D,Convolution1DLayer,Subsampling1DLayer,Upsampling1D,
+Cropping1D,Cropping2D,ZeroPadding1DLayer,SpaceToDepthLayer,
+LocallyConnected1D,LocallyConnected2D}.java``† per SURVEY.md §2.4; reference
+mount was empty, citations upstream-relative, unverified).
+
+1D convention: our recurrent activations are [B, T, F] (time-major features
+last — recorded divergence from DL4J's [B, C, T]); the 1D conv stack rides
+the 2D ops by treating T as a single spatial dim with an NHWC layout of
+[B, 1, T, F].
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ...ops import activations as _act
+from ...ops import nnops
+from ...ops.math import precision_for
+from .. import weights as _winit
+from .base import Layer, layer
+from .conv import _conv_out, _pair
+
+
+@layer("deconv2d")
+class Deconvolution2D(Layer):
+    """DL4J Deconvolution2D (transposed conv). W: [nOut, nIn, kH, kW]."""
+    n_out: int = 0
+    kernel: Tuple[int, int] = (2, 2)
+    stride: Tuple[int, int] = (1, 1)
+    padding: Tuple[int, int] = (0, 0)
+    dilation: Tuple[int, int] = (1, 1)
+    mode: str = "truncate"
+    activation: str = "identity"
+    weight_init: str = "relu"
+    has_bias: bool = True
+    data_format: str = "NCHW"
+    l1: float = 0.0
+    l2: float = 0.0
+    name: Optional[str] = None
+
+    def initialize(self, key, input_shape, dtype):
+        kh, kw = _pair(self.kernel)
+        c_in = int(input_shape[0] if self.data_format == "NCHW"
+                   else input_shape[-1])
+        fan_in = c_in * kh * kw
+        w = _winit.init(self.weight_init, key, (self.n_out, c_in, kh, kw),
+                        fan_in, self.n_out * kh * kw, dtype)
+        params = {"W": w}
+        if self.has_bias:
+            params["b"] = jnp.zeros((self.n_out,), dtype)
+        sh, sw = _pair(self.stride)
+        ph, pw = _pair(self.padding)
+
+        def out_size(size, k, s, p):
+            if self.mode == "same":
+                return size * s
+            return s * (size - 1) + k - 2 * p
+        if self.data_format == "NCHW":
+            h, wd = int(input_shape[1]), int(input_shape[2])
+            out = (self.n_out, out_size(h, kh, sh, ph), out_size(wd, kw, sw, pw))
+        else:
+            h, wd = int(input_shape[0]), int(input_shape[1])
+            out = (out_size(h, kh, sh, ph), out_size(wd, kw, sw, pw), self.n_out)
+        return params, {}, out
+
+    def apply(self, params, x, state, *, train=False, rng=None, mask=None):
+        y = nnops.deconv2d(x, params["W"], params.get("b"), self.stride,
+                           self.padding, self.dilation, self.mode,
+                           self.data_format)
+        return _act.get(self.activation)(y), state, mask
+
+
+@layer("separable_conv2d")
+class SeparableConvolution2D(Layer):
+    """DL4J SeparableConvolution2D: depthwise then 1x1 pointwise.
+    Params: dW [C*mult, 1, kH, kW], pW [nOut, C*mult, 1, 1], b [nOut]."""
+    n_out: int = 0
+    kernel: Tuple[int, int] = (3, 3)
+    stride: Tuple[int, int] = (1, 1)
+    padding: Tuple[int, int] = (0, 0)
+    dilation: Tuple[int, int] = (1, 1)
+    depth_multiplier: int = 1
+    mode: str = "truncate"
+    activation: str = "identity"
+    weight_init: str = "relu"
+    has_bias: bool = True
+    data_format: str = "NCHW"
+    l1: float = 0.0
+    l2: float = 0.0
+    name: Optional[str] = None
+
+    def initialize(self, key, input_shape, dtype):
+        kh, kw = _pair(self.kernel)
+        c_in = int(input_shape[0] if self.data_format == "NCHW"
+                   else input_shape[-1])
+        cm = c_in * self.depth_multiplier
+        k1, k2 = jax.random.split(key)
+        dw = _winit.init(self.weight_init, k1, (cm, 1, kh, kw),
+                         kh * kw, kh * kw * self.depth_multiplier, dtype)
+        pw = _winit.init(self.weight_init, k2, (self.n_out, cm, 1, 1),
+                         cm, self.n_out, dtype)
+        params = {"dW": dw, "pW": pw}
+        if self.has_bias:
+            params["b"] = jnp.zeros((self.n_out,), dtype)
+        sh, sw = _pair(self.stride)
+        ph, pw_ = _pair(self.padding)
+        if self.data_format == "NCHW":
+            h, wd = int(input_shape[1]), int(input_shape[2])
+            out = (self.n_out, _conv_out(h, kh, sh, ph, self.mode),
+                   _conv_out(wd, kw, sw, pw_, self.mode))
+        else:
+            h, wd = int(input_shape[0]), int(input_shape[1])
+            out = (_conv_out(h, kh, sh, ph, self.mode),
+                   _conv_out(wd, kw, sw, pw_, self.mode), self.n_out)
+        return params, {}, out
+
+    def apply(self, params, x, state, *, train=False, rng=None, mask=None):
+        y = nnops.separable_conv2d(x, params["dW"], params["pW"],
+                                   params.get("b"), self.stride, self.padding,
+                                   self.dilation, self.mode, self.data_format)
+        return _act.get(self.activation)(y), state, mask
+
+
+@layer("depthwise_conv2d")
+class DepthwiseConvolution2D(Layer):
+    """DL4J DepthwiseConvolution2D. W: [C*mult, 1, kH, kW]."""
+    kernel: Tuple[int, int] = (3, 3)
+    stride: Tuple[int, int] = (1, 1)
+    padding: Tuple[int, int] = (0, 0)
+    dilation: Tuple[int, int] = (1, 1)
+    depth_multiplier: int = 1
+    mode: str = "truncate"
+    activation: str = "identity"
+    weight_init: str = "relu"
+    has_bias: bool = True
+    data_format: str = "NCHW"
+    l1: float = 0.0
+    l2: float = 0.0
+    name: Optional[str] = None
+
+    def initialize(self, key, input_shape, dtype):
+        kh, kw = _pair(self.kernel)
+        c_in = int(input_shape[0] if self.data_format == "NCHW"
+                   else input_shape[-1])
+        cm = c_in * self.depth_multiplier
+        w = _winit.init(self.weight_init, key, (cm, 1, kh, kw),
+                        kh * kw, kh * kw * self.depth_multiplier, dtype)
+        params = {"W": w}
+        if self.has_bias:
+            params["b"] = jnp.zeros((cm,), dtype)
+        sh, sw = _pair(self.stride)
+        ph, pw = _pair(self.padding)
+        if self.data_format == "NCHW":
+            h, wd = int(input_shape[1]), int(input_shape[2])
+            out = (cm, _conv_out(h, kh, sh, ph, self.mode),
+                   _conv_out(wd, kw, sw, pw, self.mode))
+        else:
+            h, wd = int(input_shape[0]), int(input_shape[1])
+            out = (_conv_out(h, kh, sh, ph, self.mode),
+                   _conv_out(wd, kw, sw, pw, self.mode), cm)
+        return params, {}, out
+
+    def apply(self, params, x, state, *, train=False, rng=None, mask=None):
+        y = nnops.depthwise_conv2d(x, params["W"], params.get("b"),
+                                   self.stride, self.padding, self.dilation,
+                                   self.mode, self.data_format)
+        return _act.get(self.activation)(y), state, mask
+
+
+@layer("cropping2d")
+class Cropping2D(Layer):
+    """DL4J Cropping2D: crop (top, bottom, left, right)."""
+    cropping: Tuple[int, int, int, int] = (0, 0, 0, 0)
+    data_format: str = "NCHW"
+    name: Optional[str] = None
+
+    def has_params(self):
+        return False
+
+    def initialize(self, key, input_shape, dtype):
+        t, b, l, r = self.cropping
+        if self.data_format == "NCHW":
+            c, h, w = (int(s) for s in input_shape)
+            out = (c, h - t - b, w - l - r)
+        else:
+            h, w, c = (int(s) for s in input_shape)
+            out = (h - t - b, w - l - r, c)
+        return {}, {}, out
+
+    def apply(self, params, x, state, *, train=False, rng=None, mask=None):
+        t, b, l, r = self.cropping
+        if self.data_format == "NCHW":
+            y = x[:, :, t:x.shape[2] - b, l:x.shape[3] - r]
+        else:
+            y = x[:, t:x.shape[1] - b, l:x.shape[2] - r, :]
+        return y, state, mask
+
+
+@layer("space_to_depth")
+class SpaceToDepthLayer(Layer):
+    """DL4J SpaceToDepthLayer (block rearrange HxW -> channels)."""
+    block_size: int = 2
+    data_format: str = "NCHW"
+    name: Optional[str] = None
+
+    def has_params(self):
+        return False
+
+    def initialize(self, key, input_shape, dtype):
+        bs = self.block_size
+        if self.data_format == "NCHW":
+            c, h, w = (int(s) for s in input_shape)
+            out = (c * bs * bs, h // bs, w // bs)
+        else:
+            h, w, c = (int(s) for s in input_shape)
+            out = (h // bs, w // bs, c * bs * bs)
+        return {}, {}, out
+
+    def apply(self, params, x, state, *, train=False, rng=None, mask=None):
+        return (nnops.space_to_depth(x, self.block_size, self.data_format),
+                state, mask)
+
+
+@layer("depth_to_space")
+class DepthToSpaceLayer(Layer):
+    block_size: int = 2
+    data_format: str = "NCHW"
+    name: Optional[str] = None
+
+    def has_params(self):
+        return False
+
+    def initialize(self, key, input_shape, dtype):
+        bs = self.block_size
+        if self.data_format == "NCHW":
+            c, h, w = (int(s) for s in input_shape)
+            out = (c // (bs * bs), h * bs, w * bs)
+        else:
+            h, w, c = (int(s) for s in input_shape)
+            out = (h * bs, w * bs, c // (bs * bs))
+        return {}, {}, out
+
+    def apply(self, params, x, state, *, train=False, rng=None, mask=None):
+        return (nnops.depth_to_space(x, self.block_size, self.data_format),
+                state, mask)
+
+
+# ---- 1D stack over [B, T, F] ------------------------------------------------
+
+class _Conv1DBase(Layer):
+    """Shared [B,T,F] <-> [B,1,T,F]-NHWC plumbing."""
+
+    def _to2d(self, x):
+        return x[:, None, :, :]  # [B,1,T,F] NHWC
+
+    def _from2d(self, y):
+        return y[:, 0, :, :]
+
+
+@layer("conv1d")
+class Convolution1D(_Conv1DBase):
+    """DL4J Convolution1DLayer over [B,T,F]. W: [nOut, nIn, 1, k]."""
+    n_out: int = 0
+    kernel: int = 3
+    stride: int = 1
+    padding: int = 0
+    dilation: int = 1
+    mode: str = "truncate"
+    activation: str = "identity"
+    weight_init: str = "relu"
+    has_bias: bool = True
+    l1: float = 0.0
+    l2: float = 0.0
+    name: Optional[str] = None
+
+    def initialize(self, key, input_shape, dtype):
+        t, f = int(input_shape[0]), int(input_shape[1])
+        k = int(self.kernel)
+        w = _winit.init(self.weight_init, key, (self.n_out, f, 1, k),
+                        f * k, self.n_out * k, dtype)
+        params = {"W": w}
+        if self.has_bias:
+            params["b"] = jnp.zeros((self.n_out,), dtype)
+        t_out = _conv_out(t, k, self.stride, self.padding, self.mode) \
+            if t > 0 else t
+        return params, {}, (t_out, self.n_out)
+
+    def apply(self, params, x, state, *, train=False, rng=None, mask=None):
+        y = nnops.conv2d(self._to2d(x), params["W"], params.get("b"),
+                         stride=(1, self.stride), padding=(0, self.padding),
+                         dilation=(1, self.dilation), mode=self.mode,
+                         data_format="NHWC")
+        y = _act.get(self.activation)(self._from2d(y))
+        new_mask = None
+        if mask is not None and self.stride == 1 and self.mode == "same":
+            new_mask = mask
+        return y, state, new_mask
+
+
+@layer("subsampling1d")
+class Subsampling1DLayer(_Conv1DBase):
+    kernel: int = 2
+    stride: Optional[int] = None
+    padding: int = 0
+    pool_type: str = "max"
+    mode: str = "truncate"
+    name: Optional[str] = None
+
+    def has_params(self):
+        return False
+
+    def initialize(self, key, input_shape, dtype):
+        t, f = int(input_shape[0]), int(input_shape[1])
+        s = self.stride or self.kernel
+        t_out = _conv_out(t, self.kernel, s, self.padding, self.mode) \
+            if t > 0 else t
+        return {}, {}, (t_out, f)
+
+    def apply(self, params, x, state, *, train=False, rng=None, mask=None):
+        s = self.stride or self.kernel
+        fn = nnops.max_pool2d if self.pool_type == "max" else nnops.avg_pool2d
+        y = fn(self._to2d(x), (1, self.kernel), (1, s), (0, self.padding),
+               self.mode, "NHWC")
+        return self._from2d(y), state, None if mask is not None else mask
+
+
+@layer("upsampling1d")
+class Upsampling1D(_Conv1DBase):
+    size: int = 2
+    name: Optional[str] = None
+
+    def has_params(self):
+        return False
+
+    def initialize(self, key, input_shape, dtype):
+        t, f = int(input_shape[0]), int(input_shape[1])
+        return {}, {}, (t * self.size if t > 0 else t, f)
+
+    def apply(self, params, x, state, *, train=False, rng=None, mask=None):
+        return jnp.repeat(x, self.size, axis=1), state, None
+
+
+@layer("zeropad1d")
+class ZeroPadding1DLayer(_Conv1DBase):
+    padding: Tuple[int, int] = (1, 1)
+    name: Optional[str] = None
+
+    def has_params(self):
+        return False
+
+    def initialize(self, key, input_shape, dtype):
+        t, f = int(input_shape[0]), int(input_shape[1])
+        lo, hi = _pair(self.padding)
+        return {}, {}, (t + lo + hi if t > 0 else t, f)
+
+    def apply(self, params, x, state, *, train=False, rng=None, mask=None):
+        lo, hi = _pair(self.padding)
+        y = jnp.pad(x, [(0, 0), (lo, hi), (0, 0)])
+        new_mask = None
+        if mask is not None and mask.ndim == 2:
+            new_mask = jnp.pad(mask, [(0, 0), (lo, hi)])
+        return y, state, new_mask
+
+
+@layer("cropping1d")
+class Cropping1D(_Conv1DBase):
+    cropping: Tuple[int, int] = (1, 1)
+    name: Optional[str] = None
+
+    def has_params(self):
+        return False
+
+    def initialize(self, key, input_shape, dtype):
+        t, f = int(input_shape[0]), int(input_shape[1])
+        lo, hi = _pair(self.cropping)
+        return {}, {}, (t - lo - hi if t > 0 else t, f)
+
+    def apply(self, params, x, state, *, train=False, rng=None, mask=None):
+        lo, hi = _pair(self.cropping)
+        y = x[:, lo:x.shape[1] - hi, :]
+        new_mask = None
+        if mask is not None and mask.ndim == 2:
+            new_mask = mask[:, lo:mask.shape[1] - hi]
+        return y, state, new_mask
+
+
+# ---- locally connected ------------------------------------------------------
+
+@layer("locally_connected2d")
+class LocallyConnected2D(Layer):
+    """DL4J LocallyConnected2D: conv with UNSHARED weights per output
+    position. W: [H_out*W_out, nOut, nIn*kH*kW]. NHWC only (TPU layout);
+    implemented as patch extraction + per-position batched matmul (einsum
+    rides the MXU)."""
+    n_out: int = 0
+    kernel: Tuple[int, int] = (3, 3)
+    stride: Tuple[int, int] = (1, 1)
+    activation: str = "identity"
+    weight_init: str = "xavier"
+    has_bias: bool = True
+    l1: float = 0.0
+    l2: float = 0.0
+    name: Optional[str] = None
+
+    def initialize(self, key, input_shape, dtype):
+        h, w, c = (int(s) for s in input_shape)
+        kh, kw = _pair(self.kernel)
+        sh, sw = _pair(self.stride)
+        ho = (h - kh) // sh + 1
+        wo = (w - kw) // sw + 1
+        fan_in = c * kh * kw
+        wgt = _winit.init(self.weight_init, key,
+                          (ho * wo, fan_in, self.n_out),
+                          fan_in, self.n_out, dtype)
+        params = {"W": wgt}
+        if self.has_bias:
+            params["b"] = jnp.zeros((ho * wo, self.n_out), dtype)
+        return params, {}, (ho, wo, self.n_out)
+
+    def _patches(self, x):
+        kh, kw = _pair(self.kernel)
+        sh, sw = _pair(self.stride)
+        B, H, W, C = x.shape
+        ho = (H - kh) // sh + 1
+        wo = (W - kw) // sw + 1
+        idx_h = jnp.arange(ho) * sh
+        idx_w = jnp.arange(wo) * sw
+        # [B, ho, wo, kh, kw, C]
+        patches = x[:, idx_h[:, None, None, None] + jnp.arange(kh)[None, None, :, None],
+                    idx_w[None, :, None, None] + jnp.arange(kw)[None, None, None, :], :]
+        return patches.reshape(B, ho * wo, kh * kw * C)
+
+    def apply(self, params, x, state, *, train=False, rng=None, mask=None):
+        p = self._patches(x)  # [B, P, F]
+        y = jnp.einsum("bpf,pfo->bpo", p, params["W"],
+                       precision=precision_for(p, params["W"]))
+        if "b" in params:
+            y = y + params["b"][None]
+        kh, kw = _pair(self.kernel)
+        sh, sw = _pair(self.stride)
+        B, H, W, C = x.shape
+        ho = (H - kh) // sh + 1
+        wo = (W - kw) // sw + 1
+        y = y.reshape(B, ho, wo, self.n_out)
+        return _act.get(self.activation)(y), state, mask
+
+
+@layer("locally_connected1d")
+class LocallyConnected1D(_Conv1DBase):
+    """DL4J LocallyConnected1D over [B,T,F]: unshared per-timestep filters."""
+    n_out: int = 0
+    kernel: int = 3
+    stride: int = 1
+    activation: str = "identity"
+    weight_init: str = "xavier"
+    has_bias: bool = True
+    l1: float = 0.0
+    l2: float = 0.0
+    name: Optional[str] = None
+
+    def initialize(self, key, input_shape, dtype):
+        t, f = int(input_shape[0]), int(input_shape[1])
+        k, s = int(self.kernel), int(self.stride)
+        to = (t - k) // s + 1
+        fan_in = f * k
+        w = _winit.init(self.weight_init, key, (to, fan_in, self.n_out),
+                        fan_in, self.n_out, dtype)
+        params = {"W": w}
+        if self.has_bias:
+            params["b"] = jnp.zeros((to, self.n_out), dtype)
+        return params, {}, (to, self.n_out)
+
+    def apply(self, params, x, state, *, train=False, rng=None, mask=None):
+        k, s = int(self.kernel), int(self.stride)
+        B, T, F = x.shape
+        to = (T - k) // s + 1
+        idx = jnp.arange(to) * s
+        patches = x[:, idx[:, None] + jnp.arange(k)[None, :], :]  # [B,to,k,F]
+        patches = patches.reshape(B, to, k * F)
+        y = jnp.einsum("btf,tfo->bto", patches, params["W"],
+                       precision=precision_for(patches, params["W"]))
+        if "b" in params:
+            y = y + params["b"][None]
+        return _act.get(self.activation)(y), state, None

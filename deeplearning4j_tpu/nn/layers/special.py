@@ -1,0 +1,367 @@
+"""Specialty layers: dropout family, PReLU, autoencoders, center-loss and
+YOLO output heads, sequence embeddings.
+
+TPU-native equivalents of DL4J configs (reference:
+``deeplearning4j-nn .../nn/conf/dropout/{AlphaDropout,GaussianDropout,
+GaussianNoise,SpatialDropout}.java``, ``.../nn/conf/layers/{PReLULayer,
+AutoEncoder,variational/VariationalAutoencoder,CenterLossOutputLayer,
+EmbeddingSequenceLayer}.java``, ``.../nn/conf/layers/objdetect/
+Yolo2OutputLayer.java``† per SURVEY.md §2.4; reference mount was empty,
+citations upstream-relative, unverified).
+
+Divergence recorded: DL4J models the dropout family as IDropout policies
+attachable to any layer; here each is a standalone layer (composable in both
+engines), which keeps every layer's apply() a pure traced function.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ...ops import activations as _act
+from ...ops import losses as _loss
+from ...ops.math import precision_for
+from .. import weights as _winit
+from .base import Layer, layer
+from .core import _BaseOutput
+
+
+# ---- dropout family ---------------------------------------------------------
+
+@layer("alpha_dropout")
+class AlphaDropout(Layer):
+    """SELU-preserving dropout (DL4J AlphaDropout): dropped units go to
+    alpha' (not zero) and the output is affinely rescaled so self-normalizing
+    nets keep mean 0 / var 1."""
+    rate: float = 0.5
+    name: Optional[str] = None
+
+    _ALPHA = 1.6732632423543772
+    _SCALE = 1.0507009873554805
+
+    def has_params(self):
+        return False
+
+    def apply(self, params, x, state, *, train=False, rng=None, mask=None):
+        if not train or self.rate <= 0.0 or rng is None:
+            return x, state, mask
+        q = 1.0 - self.rate
+        ap = -self._ALPHA * self._SCALE
+        keep = jax.random.bernoulli(rng, q, x.shape)
+        a = (q + ap ** 2 * q * (1 - q)) ** -0.5
+        b = -a * ap * (1 - q)
+        return a * jnp.where(keep, x, ap) + b, state, mask
+
+
+@layer("gaussian_dropout")
+class GaussianDropout(Layer):
+    """Multiplicative N(1, rate/(1-rate)) noise (DL4J GaussianDropout)."""
+    rate: float = 0.5
+    name: Optional[str] = None
+
+    def has_params(self):
+        return False
+
+    def apply(self, params, x, state, *, train=False, rng=None, mask=None):
+        if not train or self.rate <= 0.0 or rng is None:
+            return x, state, mask
+        std = (self.rate / (1.0 - self.rate)) ** 0.5
+        noise = 1.0 + std * jax.random.normal(rng, x.shape, dtype=x.dtype)
+        return x * noise, state, mask
+
+
+@layer("gaussian_noise")
+class GaussianNoise(Layer):
+    """Additive N(0, stddev) noise at train time (DL4J GaussianNoise)."""
+    stddev: float = 0.1
+    name: Optional[str] = None
+
+    def has_params(self):
+        return False
+
+    def apply(self, params, x, state, *, train=False, rng=None, mask=None):
+        if not train or rng is None:
+            return x, state, mask
+        return x + self.stddev * jax.random.normal(rng, x.shape,
+                                                   dtype=x.dtype), state, mask
+
+
+@layer("spatial_dropout")
+class SpatialDropout(Layer):
+    """Whole-channel dropout (DL4J SpatialDropout): one keep/drop draw per
+    channel per example — CNN [B,H,W,C]/[B,C,H,W] or recurrent [B,T,F]."""
+    rate: float = 0.5
+    data_format: str = "NCHW"
+    name: Optional[str] = None
+
+    def has_params(self):
+        return False
+
+    def apply(self, params, x, state, *, train=False, rng=None, mask=None):
+        if not train or self.rate <= 0.0 or rng is None:
+            return x, state, mask
+        keep_p = 1.0 - self.rate
+        if x.ndim == 4:
+            c_axis = 1 if self.data_format == "NCHW" else 3
+        else:
+            c_axis = x.ndim - 1
+        shape = [x.shape[0]] + [1] * (x.ndim - 1)
+        shape[c_axis] = x.shape[c_axis]
+        keep = jax.random.bernoulli(rng, keep_p, tuple(shape))
+        return jnp.where(keep, x / keep_p, 0.0), state, mask
+
+
+# ---- parameterized activations ---------------------------------------------
+
+@layer("prelu")
+class PReLULayer(Layer):
+    """Learned per-feature negative slope (DL4J PReLULayer)."""
+    name: Optional[str] = None
+
+    def initialize(self, key, input_shape, dtype):
+        return ({"alpha": jnp.zeros(tuple(int(s) for s in input_shape),
+                                    dtype)}, {}, tuple(input_shape))
+
+    def apply(self, params, x, state, *, train=False, rng=None, mask=None):
+        a = params["alpha"]
+        return jnp.where(x >= 0, x, a * x), state, mask
+
+
+# ---- autoencoders -----------------------------------------------------------
+
+@layer("autoencoder")
+class AutoEncoder(Layer):
+    """Dense autoencoder layer (DL4J AutoEncoder, non-pretrain path): in a
+    feed-forward stack it behaves as its ENCODER (dense n_in->n_out); the
+    tied decoder params exist for reconstruction training via
+    ``reconstruction`` + the corruption knob."""
+    n_out: int = 0
+    activation: str = "sigmoid"
+    corruption_level: float = 0.0   # input dropout for denoising AE
+    weight_init: str = "xavier"
+    l1: float = 0.0
+    l2: float = 0.0
+    name: Optional[str] = None
+
+    def initialize(self, key, input_shape, dtype):
+        n_in = int(input_shape[-1])
+        w = _winit.init(self.weight_init, key, (n_in, self.n_out), n_in,
+                        self.n_out, dtype)
+        return ({"W": w, "b": jnp.zeros((self.n_out,), dtype),
+                 "vb": jnp.zeros((n_in,), dtype)},
+                {}, input_shape[:-1] + (self.n_out,))
+
+    def encode(self, params, x):
+        h = jnp.dot(x, params["W"],
+                    precision=precision_for(x, params["W"])) + params["b"]
+        return _act.get(self.activation)(h)
+
+    def reconstruction(self, params, x, *, rng=None, train=False):
+        """corrupt -> encode -> decode (tied W^T) — the pretrain objective."""
+        if train and self.corruption_level > 0 and rng is not None:
+            keep = jax.random.bernoulli(rng, 1.0 - self.corruption_level,
+                                        x.shape)
+            x = jnp.where(keep, x, 0.0)
+        h = self.encode(params, x)
+        v = jnp.dot(h, params["W"].T,
+                    precision=precision_for(h, params["W"])) + params["vb"]
+        return _act.get(self.activation)(v)
+
+    def apply(self, params, x, state, *, train=False, rng=None, mask=None):
+        return self.encode(params, x), state, mask
+
+
+@layer("vae")
+class VariationalAutoencoder(Layer):
+    """DL4J VariationalAutoencoder: encoder MLP -> (mu, logvar) -> z;
+    in a supervised stack apply() outputs MU (DL4J's behavior when used as a
+    feed-forward layer). ``elbo_loss`` provides the unsupervised objective
+    (gaussian reconstruction, analytic KL)."""
+    n_out: int = 0                       # latent size
+    encoder_layer_sizes: Tuple[int, ...] = (64,)
+    decoder_layer_sizes: Tuple[int, ...] = (64,)
+    activation: str = "tanh"
+    weight_init: str = "xavier"
+    l1: float = 0.0
+    l2: float = 0.0
+    name: Optional[str] = None
+
+    def initialize(self, key, input_shape, dtype):
+        n_in = int(input_shape[-1])
+        params = {}
+        keys = jax.random.split(key, 2 * (len(self.encoder_layer_sizes) +
+                                          len(self.decoder_layer_sizes)) + 4)
+        ki = iter(keys)
+
+        def dense(tag, a, b):
+            params[f"{tag}_W"] = _winit.init(self.weight_init, next(ki),
+                                             (a, b), a, b, dtype)
+            params[f"{tag}_b"] = jnp.zeros((b,), dtype)
+
+        prev = n_in
+        for i, h in enumerate(self.encoder_layer_sizes):
+            dense(f"enc{i}", prev, h)
+            prev = h
+        dense("mu", prev, self.n_out)
+        dense("logvar", prev, self.n_out)
+        prev = self.n_out
+        for i, h in enumerate(self.decoder_layer_sizes):
+            dense(f"dec{i}", prev, h)
+            prev = h
+        dense("recon", prev, n_in)
+        return params, {}, input_shape[:-1] + (self.n_out,)
+
+    def _mlp(self, params, x, tags):
+        h = x
+        for t in tags:
+            h = jnp.dot(h, params[f"{t}_W"],
+                        precision=precision_for(h, params[f"{t}_W"])) \
+                + params[f"{t}_b"]
+            h = _act.get(self.activation)(h)
+        return h
+
+    def encode(self, params, x):
+        h = self._mlp(params, x,
+                      [f"enc{i}" for i in range(len(self.encoder_layer_sizes))])
+        mu = jnp.dot(h, params["mu_W"],
+                     precision=precision_for(h, params["mu_W"])) + params["mu_b"]
+        logvar = jnp.dot(h, params["logvar_W"],
+                         precision=precision_for(h, params["logvar_W"])) \
+            + params["logvar_b"]
+        return mu, logvar
+
+    def decode(self, params, z):
+        h = self._mlp(params, z,
+                      [f"dec{i}" for i in range(len(self.decoder_layer_sizes))])
+        return jnp.dot(h, params["recon_W"],
+                       precision=precision_for(h, params["recon_W"])) \
+            + params["recon_b"]
+
+    def elbo_loss(self, params, x, rng):
+        mu, logvar = self.encode(params, x)
+        z = mu + jnp.exp(0.5 * logvar) * jax.random.normal(rng, mu.shape,
+                                                           dtype=mu.dtype)
+        recon = self.decode(params, z)
+        rec = jnp.mean(jnp.sum((recon - x) ** 2, axis=-1))
+        kl = -0.5 * jnp.mean(jnp.sum(1 + logvar - mu ** 2 - jnp.exp(logvar),
+                                     axis=-1))
+        return rec + kl
+
+    def apply(self, params, x, state, *, train=False, rng=None, mask=None):
+        mu, _ = self.encode(params, x)
+        return mu, state, mask
+
+
+# ---- output heads -----------------------------------------------------------
+
+@layer("center_loss_output")
+class CenterLossOutputLayer(Layer, _BaseOutput):
+    """DL4J CenterLossOutputLayer: softmax CE + lambda * ||f - c_y||^2 with
+    per-class feature centers updated by EMA alpha. Centers live in STATE
+    (non-gradient), matching DL4J's separate center-update step."""
+    n_out: int = 0
+    alpha: float = 0.05
+    lambda_: float = 2e-4
+    loss: str = "mcxent"
+    activation: str = "softmax"
+    weight_init: str = "xavier"
+    loss_weights: Optional[Tuple[float, ...]] = None
+    l1: float = 0.0
+    l2: float = 0.0
+    name: Optional[str] = None
+
+    def initialize(self, key, input_shape, dtype):
+        n_in = int(input_shape[-1])
+        w = _winit.init(self.weight_init, key, (n_in, self.n_out), n_in,
+                        self.n_out, dtype)
+        params = {"W": w, "b": jnp.zeros((self.n_out,), dtype)}
+        state = {"centers": jnp.zeros((self.n_out, n_in), dtype)}
+        return params, state, input_shape[:-1] + (self.n_out,)
+
+    def apply(self, params, x, state, *, train=False, rng=None, mask=None):
+        logits = jnp.dot(x, params["W"],
+                         precision=precision_for(x, params["W"])) + params["b"]
+        if train:
+            # stash features for the loss/center update (pure: ride state)
+            return logits, {**state, "__features__": x}, mask
+        return _act.get(self.activation)(logits), state, mask
+
+    def loss_value(self, logits, labels, mask=None, weights=None,
+                   features=None, centers=None):
+        ce = _BaseOutput.loss_value(self, logits, labels, mask, weights)
+        if features is None or centers is None:
+            return ce
+        cls_centers = jnp.matmul(labels, centers)  # one-hot pick
+        center_term = jnp.mean(jnp.sum((features - cls_centers) ** 2, axis=-1))
+        return ce + 0.5 * self.lambda_ * center_term
+
+    def update_centers(self, centers, features, labels):
+        """EMA center update (DL4J's alpha rule), called by the train step."""
+        counts = labels.sum(axis=0)[:, None]  # [C,1]
+        sums = jnp.matmul(labels.T, features)
+        means = sums / jnp.maximum(counts, 1.0)
+        upd = jnp.where(counts > 0, (1 - self.alpha) * centers
+                        + self.alpha * means, centers)
+        return upd
+
+
+@layer("yolo2_output")
+class Yolo2OutputLayer(Layer):
+    """DL4J Yolo2OutputLayer: YOLOv2 detection loss over a [B, H, W,
+    A*(5+C)] prediction grid (NHWC; DL4J is NCHW — recorded divergence).
+    ``boxes`` holds the A anchor (w, h) priors in grid units."""
+    boxes: Tuple[Tuple[float, float], ...] = ((1.0, 1.0),)
+    lambda_coord: float = 5.0
+    lambda_noobj: float = 0.5
+    name: Optional[str] = None
+
+    def has_params(self):
+        return False
+
+    def apply(self, params, x, state, *, train=False, rng=None, mask=None):
+        return x, state, mask
+
+    def loss_value(self, pred, label, mask=None, weights=None):
+        """label: [B, H, W, A*(5+C)] with per-anchor
+        [objectness, tx, ty, tw, th, class...] — same layout as pred."""
+        A = len(self.boxes)
+        B, H, W, D = pred.shape
+        C = D // A - 5
+        p = pred.reshape(B, H, W, A, 5 + C)
+        t = label.reshape(B, H, W, A, 5 + C)
+        obj = t[..., 0]
+        pxy = jax.nn.sigmoid(p[..., 1:3])
+        pwh = p[..., 3:5]
+        pobj = jax.nn.sigmoid(p[..., 0])
+        pcls = jax.nn.softmax(p[..., 5:], axis=-1)
+        coord = jnp.sum(obj[..., None] * ((pxy - t[..., 1:3]) ** 2
+                                          + (pwh - t[..., 3:5]) ** 2),
+                        axis=(-1,))
+        conf = obj * (pobj - 1.0) ** 2 + self.lambda_noobj * (1 - obj) * pobj ** 2
+        cls = jnp.sum(obj[..., None] * (pcls - t[..., 5:]) ** 2, axis=-1)
+        per_cell = self.lambda_coord * coord + conf + cls
+        return jnp.mean(jnp.sum(per_cell, axis=(1, 2, 3)))
+
+
+@layer("embedding_sequence")
+class EmbeddingSequenceLayer(Layer):
+    """DL4J EmbeddingSequenceLayer: [B, T] int ids -> [B, T, dim]."""
+    n_in: int = 0
+    n_out: int = 0
+    weight_init: str = "xavier"
+    name: Optional[str] = None
+
+    def initialize(self, key, input_shape, dtype):
+        w = _winit.init(self.weight_init, key, (self.n_in, self.n_out),
+                        self.n_in, self.n_out, dtype)
+        t = int(input_shape[0]) if input_shape else -1
+        return {"W": w}, {}, (t, self.n_out)
+
+    def apply(self, params, x, state, *, train=False, rng=None, mask=None):
+        ids = jnp.asarray(x, jnp.int32)
+        if ids.ndim == 3 and ids.shape[-1] == 1:
+            ids = ids[..., 0]
+        return jnp.take(params["W"], ids, axis=0), state, mask

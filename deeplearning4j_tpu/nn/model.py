@@ -28,6 +28,7 @@ import numpy as np
 
 from .. import dtypes as _dt
 from ..data.dataset import DataSet, DataSetIterator, NumpyDataSetIterator
+from . import constraints as _constraints
 from ..ops import losses as _loss
 from .config import MultiLayerConfiguration
 from .layers.core import LossLayer, OutputLayer
@@ -85,8 +86,10 @@ class MultiLayerNetwork:
         self._rnn_stream = None
         self._key = jax.random.PRNGKey(conf.seed)
         self._out_layer = self.layers[-1] if self.layers else None
-        if not isinstance(self._out_layer, (OutputLayer, LossLayer)) and self.layers:
-            # permissive: a net without a loss head can still do output()
+        if self.layers and not _is_loss_head(self._out_layer):
+            # duck-typed: any layer exposing loss_value is a loss head
+            # (OutputLayer, LossLayer, CenterLossOutputLayer, Yolo2Output…);
+            # a net without one can still do output()
             self._out_layer = None
 
     # ------------------------------------------------------------------ init
@@ -170,6 +173,12 @@ class MultiLayerNetwork:
         updater = self.conf.updater
         out_layer = self._out_layer
 
+        ol_key = str(len(self.layers) - 1)
+        center_loss = hasattr(out_layer, "update_centers")
+        from .layers.wrappers import FrozenLayer
+        frozen_keys = frozenset(str(i) for i, l in enumerate(self.layers)
+                                if isinstance(l, FrozenLayer))
+
         def step_fn(params, opt_state, bn_state, step, key, x, y, fmask, lmask):
             def loss_fn(p):
                 out, new_bn, out_mask = self._forward(
@@ -177,14 +186,35 @@ class MultiLayerNetwork:
                 # intersect, don't override: an explicit label mask (e.g. the
                 # DP pad mask) and the propagated feature mask must BOTH hold
                 lm = _loss.combine_masks(lmask, out_mask)
-                data_loss = out_layer.loss_value(
-                    out, y, mask=lm, weights=getattr(out_layer, "loss_weights", None))
+                if center_loss:
+                    # CenterLossOutputLayer stashes its input features in the
+                    # state aux channel; pull them out (the key must NOT leak
+                    # into the persisted state tree) and EMA-update centers
+                    # outside the gradient
+                    st = dict(new_bn[ol_key])
+                    feats = st.pop("__features__")
+                    centers = bn_state[ol_key]["centers"]
+                    st["centers"] = jax.lax.stop_gradient(
+                        out_layer.update_centers(
+                            centers, jax.lax.stop_gradient(feats), y))
+                    new_bn = {**new_bn, ol_key: st}
+                    data_loss = out_layer.loss_value(
+                        out, y, mask=lm,
+                        weights=getattr(out_layer, "loss_weights", None),
+                        features=feats,
+                        centers=jax.lax.stop_gradient(centers))
+                else:
+                    data_loss = out_layer.loss_value(
+                        out, y, mask=lm,
+                        weights=getattr(out_layer, "loss_weights", None))
                 return data_loss + self._regularization(p), new_bn
 
             (loss, new_bn), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
             grads = self._clip(grads)
             delta, new_opt = updater.apply(grads, opt_state, params, step)
             new_params = jax.tree.map(lambda p, d: p - d, params, delta)
+            new_params = _constraints.apply_constraints(
+                self.conf.constraints, new_params, skip=frozen_keys)
             return new_params, new_opt, new_bn, loss
 
         # donate params/opt/bn buffers: in-place update on device (workspace
@@ -297,13 +327,21 @@ class MultiLayerNetwork:
             if self._score is not None and not isinstance(self._score, float):
                 self._score = float(self._score)  # sync point, only on demand
             return self._score
-        out, _, _ = self._forward(self.params, jnp.asarray(ds.features),
-                                  self.state, train=True, rng=None,
-                                  mask=None if ds.features_mask is None
-                                  else jnp.asarray(ds.features_mask))
-        loss = self._out_layer.loss_value(
-            out, jnp.asarray(ds.labels),
-            mask=None if ds.labels_mask is None else jnp.asarray(ds.labels_mask))
+        out, st, _ = self._forward(self.params, jnp.asarray(ds.features),
+                                   self.state, train=True, rng=None,
+                                   mask=None if ds.features_mask is None
+                                   else jnp.asarray(ds.features_mask))
+        lm = None if ds.labels_mask is None else jnp.asarray(ds.labels_mask)
+        if hasattr(self._out_layer, "update_centers"):
+            # same quantity as the fit loop: CE + center penalty
+            ol_key = str(len(self.layers) - 1)
+            loss = self._out_layer.loss_value(
+                out, jnp.asarray(ds.labels), mask=lm,
+                features=st[ol_key]["__features__"],
+                centers=self.state[ol_key]["centers"])
+        else:
+            loss = self._out_layer.loss_value(
+                out, jnp.asarray(ds.labels), mask=lm)
         return float(loss + self._regularization(self.params))
 
     def evaluate(self, data, labels=None):
@@ -370,6 +408,16 @@ class MultiLayerNetwork:
             raise TypeError(f"{path} holds a {type(model).__name__}, "
                             "not a MultiLayerNetwork")
         return model
+
+
+def _is_loss_head(l) -> bool:
+    """True when the (FrozenLayer-unwrapped) layer really implements
+    loss_value — FrozenLayer delegates it unconditionally, so probe the
+    wrapped layer, not the wrapper."""
+    inner = getattr(l, "layer", None)
+    while inner is not None and hasattr(l, "frozen"):
+        l, inner = inner, getattr(inner, "layer", None)
+    return hasattr(l, "loss_value")
 
 
 def _as_iterator(data, labels=None) -> DataSetIterator:

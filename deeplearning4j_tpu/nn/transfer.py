@@ -147,7 +147,8 @@ class TransferLearning:
                       l1=conf.l1, l2=conf.l2,
                       gradient_clip_value=conf.gradient_clip_value,
                       gradient_clip_l2=conf.gradient_clip_l2,
-                      tbptt_length=conf.tbptt_length)
+                      tbptt_length=conf.tbptt_length,
+                      constraints=conf.constraints)
             new_conf = MultiLayerConfiguration(**self._ftc._apply(kw))
             net = MultiLayerNetwork(new_conf).init()
             params = dict(net.params)
@@ -285,7 +286,8 @@ class TransferLearning:
                       l2=conf.l2,
                       gradient_clip_value=conf.gradient_clip_value,
                       gradient_clip_l2=conf.gradient_clip_l2,
-                      tbptt_length=conf.tbptt_length)
+                      tbptt_length=conf.tbptt_length,
+                      constraints=conf.constraints)
             new_conf = ComputationGraphConfiguration(**self._ftc._apply(kw))
             net = ComputationGraph(new_conf).init()
             params = dict(net.params)

@@ -385,3 +385,37 @@ class PreprocessorVertex(GraphVertex):
         else:
             raise ValueError(self.mode)
         return y, state, _first_mask(masks)
+
+
+@vertex("dot_product_attention")
+class DotProductAttentionVertex(GraphVertex):
+    """Scaled dot-product attention as a graph vertex (DL4J
+    ``DotProductAttentionVertex`` / attention vertices under
+    ``.../nn/graph/vertex/impl``†). Inputs: [queries, keys, values] as
+    [B, T, F] (keys/values share T_k); optional 4th input = key keep-mask
+    [B, T_k]. Parameter-free — projections belong to surrounding layers."""
+    scaled: bool = True
+
+    def initialize(self, key, input_shapes, dtype):
+        tq = int(input_shapes[0][0])
+        fv = int(input_shapes[2][-1])
+        return {}, {}, (tq, fv)
+
+    def apply(self, params, xs, state, *, train=False, rng=None, masks=None):
+        import jax
+        q, k, v = xs[0], xs[1], xs[2]
+        scores = jnp.einsum("bqf,bkf->bqk", q, k)
+        if self.scaled:
+            scores = scores / jnp.sqrt(jnp.asarray(q.shape[-1], q.dtype))
+        key_mask = xs[3] if len(xs) > 3 else (
+            masks[1] if masks and len(masks) > 1 and masks[1] is not None
+            else None)
+        if key_mask is not None:
+            neg = jnp.finfo(scores.dtype).min
+            scores = jnp.where(key_mask[:, None, :] > 0, scores, neg)
+        att = jax.nn.softmax(scores, axis=-1)
+        y = jnp.einsum("bqk,bkf->bqf", att, v)
+        # output timesteps follow the QUERIES; the key mask only weights the
+        # softmax — propagating it downstream would mis-mask a T_q sequence
+        out_mask = masks[0] if masks else None
+        return y, state, out_mask

@@ -103,8 +103,14 @@ def deconv2d(x, w, b=None, stride=(1, 1), padding=0, dilation=(1, 1),
     if mode == "same":
         pad = "SAME"
     else:
+        # DL4J/torch transposed-conv semantics: out = (in-1)*s + k_eff - 2p.
+        # lax.conv_transpose's explicit (lo, hi) padding is ADDITIVE to the
+        # bare transpose (whose pad-free output is (in-1)*s + k_eff - 2*(k_eff-1)),
+        # so forward-padding p maps to lo = hi = (k_eff - 1) - p.
         p = padding if isinstance(padding, (tuple, list)) else (padding, padding)
-        pad = [(int(pi), int(pi)) for pi in p]
+        k_eff = ((kh - 1) * dilation[0] + 1, (kw - 1) * dilation[1] + 1)
+        pad = [(k_eff[i] - 1 - int(pi), k_eff[i] - 1 - int(pi))
+               for i, pi in enumerate(p)]
     # lax.conv_transpose wants rhs as [spatial..., I, O] per dn; use OIHW with
     # transpose_kernel semantics: swap I/O of the stored weight.
     y = lax.conv_transpose(

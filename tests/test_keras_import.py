@@ -108,11 +108,11 @@ def test_unsupported_layer_is_loud(tmp_path):
     m = tf.keras.Sequential([
         tf.keras.layers.Input(shape=(4,)),
         tf.keras.layers.Dense(4, name="d"),
-        tf.keras.layers.GaussianNoise(0.1, name="gn"),
+        tf.keras.layers.GroupNormalization(groups=2, name="gn"),
     ])
     p = str(tmp_path / "unsup.h5")
     m.save(p)
-    with pytest.raises(ValueError, match="GaussianNoise"):
+    with pytest.raises(ValueError, match="GroupNormalization"):
         KerasModelImport.import_keras_model_and_weights(p)
 
 
@@ -166,3 +166,22 @@ def test_relu_with_cap_or_slope_is_loud(tmp_path):
     m.save(p)
     with pytest.raises(ValueError, match="max_value"):
         KerasModelImport.import_keras_model_and_weights(p)
+
+
+def test_separable_depthwise_prelu_import(tmp_path):
+    rng = np.random.default_rng(7)
+    m = tf.keras.Sequential([
+        tf.keras.layers.Input(shape=(10, 10, 3)),
+        tf.keras.layers.SeparableConv2D(6, 3, padding="same",
+                                        activation="relu", name="sep"),
+        tf.keras.layers.DepthwiseConv2D(3, padding="same", name="dw"),
+        tf.keras.layers.PReLU(name="pr"),
+        tf.keras.layers.Cropping2D(((1, 2), (0, 1)), name="cr"),
+        tf.keras.layers.GlobalAveragePooling2D(name="gap"),
+        tf.keras.layers.Dense(4, activation="softmax", name="out"),
+    ])
+    p = str(tmp_path / "sep.h5")
+    m.save(p)
+    net = KerasModelImport.import_keras_model_and_weights(p)
+    x = rng.normal(size=(3, 10, 10, 3)).astype(np.float32)
+    _compare(m, net, x)

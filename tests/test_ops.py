@@ -398,10 +398,21 @@ def test_global_pool(rng):
 
 
 def test_deconv_shape_and_grad(rng):
-    x = rng.normal(size=(1, 3, 4, 4))
-    w = rng.normal(size=(2, 3, 3, 3))  # [O, I, kH, kW]
-    y = nnops.deconv2d(jnp.asarray(x), jnp.asarray(w), stride=(2, 2))
-    assert y.shape[1] == 2 and y.shape[2] > 4
+    """torch conv_transpose2d oracle incl. stride/padding combinations
+    (regression: explicit lax.conv_transpose padding is additive, not
+    forward-conv padding — outputs were (k-1) short per side)."""
+    import torch
+
+    x = rng.normal(size=(1, 3, 4, 4)).astype(np.float32)
+    w = rng.normal(size=(2, 3, 3, 3)).astype(np.float32)  # [O, I, kH, kW]
+    tw = torch.from_numpy(np.transpose(w, (1, 0, 2, 3)).copy())
+    for stride, pad in [((1, 1), 0), ((2, 2), 0), ((2, 2), 1)]:
+        y = nnops.deconv2d(jnp.asarray(x), jnp.asarray(w), stride=stride,
+                           padding=pad)
+        ref = torch.nn.functional.conv_transpose2d(
+            torch.from_numpy(x), tw, stride=stride, padding=pad).numpy()
+        assert y.shape == ref.shape, (stride, pad, y.shape, ref.shape)
+        np.testing.assert_allclose(np.asarray(y), ref, rtol=1e-4, atol=1e-4)
     ok, worst, fails = check_op_gradient(nnops.deconv2d, x, w, argnum=1, stride=(2, 2))
     assert ok, f"deconv2d dW: {worst}"
     _mark("deconv2d", grad=True)

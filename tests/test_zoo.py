@@ -1,0 +1,108 @@
+"""Zoo model smoke tests: every model builds, initializes, forwards at a
+shrunken input shape, and takes a finite training step (SURVEY.md §2.5;
+the reference's zoo tests instantiate each model and run a fit batch)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.data.dataset import DataSet
+from deeplearning4j_tpu.models import (alexnet, darknet19, simple_cnn,
+                                       squeezenet, text_generation_lstm,
+                                       tiny_yolo, unet, vgg16, vgg19,
+                                       xception)
+from deeplearning4j_tpu.nn.updaters import Sgd
+
+RNG = np.random.default_rng(0)
+
+
+def _train_step(net, shape, n_classes, n=2):
+    net.init()
+    x = RNG.normal(size=(n,) + shape).astype(np.float32)
+    y = np.eye(n_classes, dtype=np.float32)[RNG.integers(0, n_classes, n)]
+    net.fit(DataSet(x, y), epochs=1)
+    loss = float(net.score())
+    assert np.isfinite(loss), loss
+    return net
+
+
+def test_alexnet():
+    net = alexnet(num_classes=5, input_shape=(64, 64, 3),
+                  updater=Sgd(learning_rate=1e-3))
+    _train_step(net, (64, 64, 3), 5)
+
+
+def test_vgg16():
+    net = vgg16(num_classes=4, input_shape=(32, 32, 3),
+                updater=Sgd(learning_rate=1e-3))
+    _train_step(net, (32, 32, 3), 4)
+    assert len(net.conf.layers) > 18  # 13 convs + pools + dense head
+
+
+def test_vgg19_builds():
+    net = vgg19(num_classes=4, input_shape=(32, 32, 3))
+    net.init()
+    assert net.num_params() > 0
+
+
+def test_simple_cnn():
+    net = simple_cnn(num_classes=3, input_shape=(16, 16, 3),
+                     updater=Sgd(learning_rate=1e-3))
+    _train_step(net, (16, 16, 3), 3)
+
+
+def test_darknet19():
+    net = darknet19(num_classes=6, input_shape=(64, 64, 3),
+                    updater=Sgd(learning_rate=1e-3))
+    _train_step(net, (64, 64, 3), 6)
+
+
+def test_squeezenet():
+    net = squeezenet(num_classes=7, input_shape=(64, 64, 3),
+                     updater=Sgd(learning_rate=1e-3))
+    _train_step(net, (64, 64, 3), 7)
+
+
+def test_xception():
+    net = xception(num_classes=4, input_shape=(64, 64, 3),
+                   updater=Sgd(learning_rate=1e-4))
+    _train_step(net, (64, 64, 3), 4)
+
+
+def test_unet_segmentation_shapes():
+    net = unet(num_classes=1, input_shape=(32, 32, 3), base=8,
+               updater=Sgd(learning_rate=1e-2))
+    net.init()
+    x = RNG.normal(size=(2, 32, 32, 3)).astype(np.float32)
+    out = net.output(x)
+    assert out.shape == (2, 32, 32, 1)
+    assert (np.asarray(out) >= 0).all() and (np.asarray(out) <= 1).all()
+    y = (RNG.random((2, 32, 32, 1)) > 0.5).astype(np.float32)
+    net.fit(DataSet(x, y), epochs=1)
+    assert np.isfinite(float(net.score()))
+
+
+def test_tiny_yolo():
+    boxes = ((1.0, 1.0), (2.0, 2.0))
+    net = tiny_yolo(num_classes=3, input_shape=(64, 64, 3), boxes=boxes,
+                    updater=Sgd(learning_rate=1e-4))
+    net.init()
+    x = RNG.normal(size=(2, 64, 64, 3)).astype(np.float32)
+    out = net.output(x)
+    grid = out.shape[1]
+    assert out.shape == (2, grid, grid, len(boxes) * (5 + 3))
+    label = np.zeros((2, grid, grid, len(boxes), 8), np.float32)
+    label[0, 0, 0, 0] = [1, 0.5, 0.5, 0.1, 0.1, 1, 0, 0]
+    net.fit(DataSet(x, label.reshape(2, grid, grid, -1)), epochs=1)
+    assert np.isfinite(float(net.score()))
+
+
+def test_text_generation_lstm():
+    net = text_generation_lstm(vocab_size=12, units=16, timesteps=9,
+                               updater=Sgd(learning_rate=0.1))
+    net.init()
+    x = np.eye(12, dtype=np.float32)[RNG.integers(0, 12, (3, 9))]
+    y = np.eye(12, dtype=np.float32)[RNG.integers(0, 12, (3, 9))]
+    net.fit(DataSet(x, y), epochs=2)
+    assert np.isfinite(float(net.score()))
+    out = net.output(x)
+    assert out.shape == (3, 9, 12)
