@@ -1,0 +1,4 @@
+"""NLP: word/sequence embeddings (SURVEY.md §2.5 deeplearning4j-nlp)."""
+
+from .word2vec import (SequenceVectors, TokenizerFactory,  # noqa: F401
+                       Word2Vec, WordVectorSerializer)

@@ -1,0 +1,5 @@
+"""Inference serving (SURVEY.md §2.5/§2.6: ParallelInference +
+JsonModelServer)."""
+
+from .inference import InferenceMode, ParallelInference  # noqa: F401
+from .server import JsonModelServer  # noqa: F401

@@ -1,0 +1,97 @@
+"""JsonModelServer: minimal HTTP JSON inference endpoint.
+
+TPU-native equivalent of the reference's serving module (reference:
+``deeplearning4j-remote .../JsonModelServer.java``† per SURVEY.md §2.5;
+reference mount was empty, citation upstream-relative, unverified).
+
+Same contract: POST JSON → model → JSON. Fronted by ParallelInference so
+concurrent requests batch onto the device. stdlib ``http.server`` only —
+this is the reference's "minimal inference server", not a production
+gateway, and says so.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+import numpy as np
+
+from .inference import InferenceMode, ParallelInference
+
+
+class JsonModelServer:
+    """POST /predict {"data": [...]} -> {"output": [...]};
+    GET /health -> {"status": "ok"}."""
+
+    def __init__(self, model, port: int = 0, host: str = "127.0.0.1",
+                 mode: str = InferenceMode.BATCHED,
+                 pre_processor=None):
+        self.inference = ParallelInference(model, mode=mode)
+        self.pre_processor = pre_processor
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+        self.host = host
+        self.port = port
+
+    def start(self) -> int:
+        """Start serving in a background thread; returns the bound port."""
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # quiet
+                pass
+
+            def _send(self, code, payload):
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == "/health":
+                    self._send(200, {"status": "ok"})
+                else:
+                    self._send(404, {"error": "unknown path"})
+
+            def do_POST(self):
+                if self.path != "/predict":
+                    self._send(404, {"error": "unknown path"})
+                    return
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                    req = json.loads(self.rfile.read(n) or b"{}")
+                    x = np.asarray(req["data"], dtype=np.float32)
+                    if server.pre_processor is not None:
+                        from ..data.dataset import DataSet
+                        ds = DataSet(x, None)
+                        server.pre_processor.transform(ds)
+                        x = ds.features
+                    out = server.inference.output(x)
+                    self._send(200, {"output": np.asarray(out).tolist()})
+                except Exception as e:
+                    self._send(400, {"error": f"{type(e).__name__}: {e}"})
+
+        self._httpd = ThreadingHTTPServer((self.host, self.port), Handler)
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self.port
+
+    def stop(self):
+        if self._httpd:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+        self.inference.shutdown()
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()

@@ -1,0 +1,70 @@
+"""Profiler trace capture.
+
+TPU-native replacement for the reference's three profiling mechanisms
+(reference: nd4j ``OpProfiler``/``ProfilerConfig``, SameDiff
+``ProfilingListener`` (Chrome-trace JSON), ``PerformanceListener``† per
+SURVEY.md §5 "Tracing / profiling"): ``jax.profiler`` captures device-level
+traces (TensorBoard/perfetto xplane format — strictly more detail than the
+reference's op timers, since it sees XLA fusion and HBM transfers).
+PerformanceListener (throughput/MFU) stays in optimize/listeners.py.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from ..optimize.listeners import TrainingListener
+
+
+class ProfilingListener(TrainingListener):
+    """Capture a device trace for iterations [start, start+steps).
+
+    The trace lands in ``logdir/plugins/profile/...`` — open with
+    TensorBoard's profile plugin or ui.perfetto.dev. One capture per
+    training run (the reference's ProfilingListener wrote one Chrome-trace
+    file per session the same way).
+    """
+
+    def __init__(self, logdir: str, start_iteration: int = 3, steps: int = 3):
+        self.logdir = logdir
+        self.start = int(start_iteration)
+        self.steps = int(steps)
+        self._active = False
+        self._done = False
+
+    def iteration_done(self, model, iteration, epoch):
+        import jax
+
+        if self._done:
+            return
+        if not self._active and iteration >= self.start:
+            os.makedirs(self.logdir, exist_ok=True)
+            jax.profiler.start_trace(self.logdir)
+            self._active = True
+            import atexit
+            atexit.register(self.stop)  # never leave a trace open
+            self._stop_at = iteration + self.steps
+            return
+        if self._active and iteration >= self._stop_at:
+            # the global iteration counter runs THROUGH epoch boundaries, so
+            # a capture window may span epochs — only the step count ends it
+            jax.block_until_ready(jax.tree.leaves(model.params))
+            jax.profiler.stop_trace()
+            self._active = False
+            self._done = True
+
+    def stop(self):
+        """Finalize an open capture (training ended mid-window)."""
+        if self._active:
+            import jax
+            jax.profiler.stop_trace()
+            self._active = False
+            self._done = True
+
+
+def annotate(name: str):
+    """Context manager naming a host-side region in the trace
+    (``jax.profiler.TraceAnnotation``)."""
+    import jax
+    return jax.profiler.TraceAnnotation(name)

@@ -1,0 +1,110 @@
+"""StatsListener: per-iteration training statistics → StatsStorage.
+
+TPU-native equivalent of the reference's stats pipeline head (reference:
+``deeplearning4j-ui-model .../stats/StatsListener.java``† per SURVEY.md
+§2.5/§5; reference mount was empty, citation upstream-relative, unverified).
+
+Collects what the reference's dashboard charts: score, per-layer parameter
+and update statistics (mean, std, mean-magnitude), update:parameter
+mean-magnitude ratios (THE learning-rate health signal), activation-free
+histograms (fixed-bin counts over params/updates), throughput, and host
+memory. Collection runs at ``frequency`` to bound host↔device syncs — stats
+pull device arrays to host, so every collected iteration costs a sync;
+leave frequency ≥10 for real training.
+"""
+
+from __future__ import annotations
+
+import time
+import uuid
+from typing import Dict, Optional
+
+import numpy as np
+
+from ..optimize.listeners import TrainingListener
+from .storage import InMemoryStatsStorage, StatsStorage
+
+_HIST_BINS = 20
+
+
+def _leaf_stats(arr: np.ndarray) -> dict:
+    a = np.asarray(arr, dtype=np.float64).ravel()
+    mm = float(np.abs(a).mean()) if a.size else 0.0
+    lo, hi = (float(a.min()), float(a.max())) if a.size else (0.0, 0.0)
+    counts, edges = np.histogram(a, bins=_HIST_BINS) if a.size else \
+        (np.zeros(_HIST_BINS, int), np.zeros(_HIST_BINS + 1))
+    return {"mean": float(a.mean()) if a.size else 0.0,
+            "std": float(a.std()) if a.size else 0.0,
+            "mean_magnitude": mm, "min": lo, "max": hi,
+            "hist_counts": counts.tolist(),
+            "hist_edges": [float(e) for e in edges]}
+
+
+def _walk(tree, prefix=""):
+    for k, v in tree.items():
+        path = f"{prefix}/{k}" if prefix else str(k)
+        if isinstance(v, dict):
+            yield from _walk(v, path)
+        else:
+            yield path, v
+
+
+class StatsListener(TrainingListener):
+    def __init__(self, storage: Optional[StatsStorage] = None,
+                 frequency: int = 10, session_id: Optional[str] = None,
+                 collect_histograms: bool = True):
+        self.storage = storage if storage is not None else InMemoryStatsStorage()
+        self.frequency = max(1, int(frequency))
+        self.session_id = session_id or f"train-{uuid.uuid4().hex[:8]}"
+        self.collect_histograms = collect_histograms
+        self._prev_params: Optional[Dict[str, np.ndarray]] = None
+        self._last_time = None
+        self._meta_written = False
+
+    def _write_meta(self, model):
+        self.storage.put_record({
+            "session": self.session_id, "type": "meta",
+            "model_class": type(model).__name__,
+            "num_params": model.num_params(),
+            "configuration": model.conf.to_json(),
+            "start_time": time.time()})
+        self._meta_written = True
+
+    def iteration_done(self, model, iteration, epoch):
+        if not self._meta_written:
+            self._write_meta(model)
+        if iteration % self.frequency:
+            return
+        now = time.perf_counter()
+        cur = {path: np.asarray(leaf)
+               for path, leaf in _walk(model.params)}
+        record = {"session": self.session_id, "type": "stats",
+                  "iteration": int(iteration), "epoch": int(epoch),
+                  "time": time.time(),
+                  "score": float(model.score()),
+                  "params": {}, "updates": {}, "ratios": {}}
+        for path, arr in cur.items():
+            st = _leaf_stats(arr)
+            if not self.collect_histograms:
+                st.pop("hist_counts"), st.pop("hist_edges")
+            record["params"][path] = st
+            if self._prev_params is not None and path in self._prev_params:
+                upd = arr - self._prev_params[path]
+                ust = _leaf_stats(upd)
+                if not self.collect_histograms:
+                    ust.pop("hist_counts"), ust.pop("hist_edges")
+                record["updates"][path] = ust
+                denom = st["mean_magnitude"] or 1e-12
+                record["ratios"][path] = ust["mean_magnitude"] / denom
+        if self._last_time is not None:
+            dt = now - self._last_time
+            record["iterations_per_sec"] = self.frequency / dt if dt > 0 else None
+        self._last_time = now
+        try:
+            import resource
+            record["max_rss_mb"] = resource.getrusage(
+                resource.RUSAGE_SELF).ru_maxrss / 1024.0
+        except Exception:
+            pass
+        self._prev_params = cur
+        self.storage.put_record(record)

@@ -1,0 +1,122 @@
+"""Stats storage backends.
+
+TPU-native equivalent of the reference's StatsStorage split (reference:
+``deeplearning4j-ui-parent .../storage/{InMemoryStatsStorage,
+FileStatsStorage}.java`` (MapDB-backed) and the remote
+``RemoteUIStatsStorageRouter`` HTTP router† per SURVEY.md §2.5/§5;
+reference mount was empty, citations upstream-relative, unverified).
+
+The storage/router separation is the load-bearing part (it is what made
+remote monitoring work in the reference): producers (StatsListener) write
+records through the same small interface whether the sink is process
+memory, an append-only JSONL file, or an HTTP endpoint. Records are plain
+JSON-able dicts: {"session": str, "type": "meta"|"stats", "iteration": int,
+...payload}.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Callable, Dict, List, Optional
+
+
+class StatsStorage:
+    """Write + read interface (readers power dashboards/tests)."""
+
+    def put_record(self, record: dict):
+        raise NotImplementedError
+
+    def list_sessions(self) -> List[str]:
+        raise NotImplementedError
+
+    def get_records(self, session: str) -> List[dict]:
+        raise NotImplementedError
+
+    def latest(self, session: str) -> Optional[dict]:
+        recs = self.get_records(session)
+        return recs[-1] if recs else None
+
+    def close(self):
+        pass
+
+
+class InMemoryStatsStorage(StatsStorage):
+    def __init__(self):
+        self._by_session: Dict[str, List[dict]] = {}
+        self._lock = threading.Lock()
+
+    def put_record(self, record: dict):
+        with self._lock:
+            self._by_session.setdefault(record["session"], []).append(record)
+
+    def list_sessions(self):
+        return sorted(self._by_session)
+
+    def get_records(self, session):
+        return list(self._by_session.get(session, []))
+
+
+class FileStatsStorage(StatsStorage):
+    """Append-only JSON-lines file (MapDB's role, in a format every tool
+    can read). Reopening the same path resumes the store."""
+
+    def __init__(self, path: str):
+        self.path = path
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        self._lock = threading.Lock()
+        self._fh = open(path, "a")
+
+    def put_record(self, record: dict):
+        with self._lock:
+            self._fh.write(json.dumps(record) + "\n")
+            self._fh.flush()
+
+    def _read_all(self) -> List[dict]:
+        if not os.path.exists(self.path):
+            return []
+        with open(self.path) as f:
+            return [json.loads(ln) for ln in f if ln.strip()]
+
+    def list_sessions(self):
+        return sorted({r["session"] for r in self._read_all()})
+
+    def get_records(self, session):
+        return [r for r in self._read_all() if r["session"] == session]
+
+    def close(self):
+        self._fh.close()
+
+
+class RemoteUIStatsStorage(StatsStorage):
+    """HTTP router: POST each record as JSON to an endpoint (the reference's
+    ``RemoteUIStatsStorageRouter``). Failures are counted, not raised —
+    losing a metrics packet must never kill training. Write-only (reads
+    happen server-side)."""
+
+    def __init__(self, url: str, timeout: float = 2.0,
+                 _post: Optional[Callable] = None):
+        self.url = url
+        self.timeout = timeout
+        self.failures = 0
+        self._post = _post or self._default_post
+
+    def _default_post(self, url, data: bytes):
+        import urllib.request
+        req = urllib.request.Request(
+            url, data=data, headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+            return resp.status
+
+    def put_record(self, record: dict):
+        try:
+            self._post(self.url, json.dumps(record).encode())
+        except Exception:
+            self.failures += 1
+
+    def list_sessions(self):
+        return []
+
+    def get_records(self, session):
+        return []

@@ -1,0 +1,68 @@
+"""TensorBoard summary writer backend.
+
+The reference renders its own Vert.x dashboard (SURVEY.md §2.5
+deeplearning4j-ui); the TPU-native move (§5 "→ TPU" note) is a TB-summary
+metrics writer — the ecosystem-standard dashboard, and the same event files
+`jax.profiler` traces land next to. Backed by ``tensorboardX`` (baked in).
+
+Use standalone as a listener, or as a DRAIN over any StatsStorage
+(``write_storage``) so file/remote-collected runs can be rendered later.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ..optimize.listeners import TrainingListener
+
+
+class TensorBoardStatsWriter(TrainingListener):
+    def __init__(self, logdir: str, frequency: int = 10,
+                 histograms: bool = True):
+        from tensorboardX import SummaryWriter
+
+        self.writer = SummaryWriter(logdir)
+        self.frequency = max(1, int(frequency))
+        self.histograms = histograms
+
+    # ---- listener path -----------------------------------------------------
+    def iteration_done(self, model, iteration, epoch):
+        if iteration % self.frequency:
+            return
+        self.writer.add_scalar("train/score", float(model.score()), iteration)
+        if self.histograms:
+            import jax
+            for path, leaf in jax.tree_util.tree_leaves_with_path(model.params):
+                name = "params/" + "/".join(
+                    str(getattr(p, "key", p)) for p in path)
+                self.writer.add_histogram(name, np.asarray(leaf), iteration)
+
+    def on_epoch_end(self, model):
+        self.writer.add_scalar("train/epoch", model.epoch,
+                               model.iteration)
+        self.writer.flush()
+
+    # ---- storage-drain path ------------------------------------------------
+    def write_storage(self, storage, session: Optional[str] = None):
+        """Render every stats record of a session into TB events."""
+        sessions = [session] if session else storage.list_sessions()
+        for s in sessions:
+            for rec in storage.get_records(s):
+                if rec.get("type") != "stats":
+                    continue
+                it = rec["iteration"]
+                self.writer.add_scalar("train/score", rec["score"], it)
+                if rec.get("iterations_per_sec"):
+                    self.writer.add_scalar("train/iterations_per_sec",
+                                           rec["iterations_per_sec"], it)
+                for path, st in rec.get("params", {}).items():
+                    self.writer.add_scalar(f"param_mean/{path}", st["mean"], it)
+                    self.writer.add_scalar(f"param_std/{path}", st["std"], it)
+                for path, ratio in rec.get("ratios", {}).items():
+                    self.writer.add_scalar(f"update_ratio/{path}", ratio, it)
+        self.writer.flush()
+
+    def close(self):
+        self.writer.close()

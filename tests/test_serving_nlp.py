@@ -1,0 +1,182 @@
+"""Serving (ParallelInference batching, JsonModelServer HTTP) and NLP
+(Word2Vec skip-gram) — SURVEY.md §2.5/§2.6."""
+
+import json
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.data.dataset import DataSet
+from deeplearning4j_tpu.nn.config import InputType, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.layers.core import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.model import MultiLayerNetwork
+from deeplearning4j_tpu.nn.updaters import Adam
+from deeplearning4j_tpu.nlp import (TokenizerFactory, Word2Vec,
+                                    WordVectorSerializer)
+from deeplearning4j_tpu.serving import (InferenceMode, JsonModelServer,
+                                        ParallelInference)
+
+RNG = np.random.default_rng(0)
+
+
+def _net():
+    conf = (NeuralNetConfiguration.builder().seed(0)
+            .updater(Adam(learning_rate=0.01))
+            .input_type(InputType.feed_forward(6))
+            .list(DenseLayer(n_out=12, activation="tanh"),
+                  OutputLayer(n_out=3))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+# ---- ParallelInference ------------------------------------------------------
+
+def test_parallel_inference_matches_direct_output():
+    net = _net()
+    pi = ParallelInference(net, mode=InferenceMode.BATCHED, max_wait_ms=2)
+    try:
+        x = RNG.normal(size=(5, 6)).astype(np.float32)
+        got = pi.output(x)
+        ref = np.asarray(net.output(x))
+        np.testing.assert_allclose(got, ref, atol=1e-6)
+        # single-example convenience
+        one = pi.output(x[0])
+        np.testing.assert_allclose(one[0], ref[0], atol=1e-6)
+    finally:
+        pi.shutdown()
+
+
+def test_parallel_inference_concurrent_batching():
+    net = _net()
+    pi = ParallelInference(net, mode=InferenceMode.BATCHED,
+                           batch_limit=64, max_wait_ms=20)
+    xs = [RNG.normal(size=(3, 6)).astype(np.float32) for _ in range(16)]
+    refs = [np.asarray(net.output(x)) for x in xs]
+    results = [None] * 16
+
+    def call(i):
+        results[i] = pi.output(xs[i])
+
+    threads = [threading.Thread(target=call, args=(i,)) for i in range(16)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    pi.shutdown()
+    for got, ref in zip(results, refs):
+        np.testing.assert_allclose(got, ref, atol=1e-5)
+
+
+def test_parallel_inference_sequential_mode():
+    net = _net()
+    pi = ParallelInference(net, mode=InferenceMode.SEQUENTIAL)
+    x = RNG.normal(size=(4, 6)).astype(np.float32)
+    np.testing.assert_allclose(pi.output(x), np.asarray(net.output(x)),
+                               atol=1e-6)
+    pi.shutdown()
+
+
+# ---- JsonModelServer --------------------------------------------------------
+
+def test_json_model_server_end_to_end():
+    net = _net()
+    x = RNG.normal(size=(2, 6)).astype(np.float32)
+    ref = np.asarray(net.output(x))
+    with JsonModelServer(net, port=0) as srv:
+        url = f"http://127.0.0.1:{srv.port}"
+        with urllib.request.urlopen(url + "/health", timeout=5) as r:
+            assert json.load(r)["status"] == "ok"
+        req = urllib.request.Request(
+            url + "/predict",
+            data=json.dumps({"data": x.tolist()}).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=10) as r:
+            out = np.asarray(json.load(r)["output"], dtype=np.float32)
+        np.testing.assert_allclose(out, ref, atol=1e-5)
+        # malformed request -> 400 with an error body, server stays up
+        bad = urllib.request.Request(url + "/predict", data=b"not json",
+                                     headers={"Content-Type": "application/json"})
+        try:
+            urllib.request.urlopen(bad, timeout=5)
+            assert False, "expected HTTPError"
+        except urllib.error.HTTPError as e:
+            assert e.code == 400
+            assert "error" in json.load(e)
+
+
+# ---- Word2Vec ---------------------------------------------------------------
+
+def _toy_corpus(n=300):
+    """Two topic clusters: (cat, dog, pet) and (car, road, drive)."""
+    rng = np.random.default_rng(4)
+    animals = ["cat", "dog", "pet", "fur", "tail"]
+    vehicles = ["car", "road", "drive", "wheel", "engine"]
+    out = []
+    for _ in range(n):
+        group = animals if rng.random() < 0.5 else vehicles
+        out.append(" ".join(rng.choice(group, size=6)))
+    return out
+
+
+def test_word2vec_learns_topic_clusters():
+    w2v = Word2Vec(layer_size=16, window=3, min_count=1, negative=4,
+                   epochs=3, learning_rate=0.05, seed=7, subsample=0)
+    w2v.fit(_toy_corpus())
+    assert w2v.has_word("cat") and w2v.has_word("car")
+    within = w2v.similarity("cat", "dog")
+    across = w2v.similarity("cat", "road")
+    assert within > across, (within, across)
+    near = [w for w, _ in w2v.words_nearest("cat", 2)]
+    assert set(near) <= {"dog", "pet", "fur", "tail"}, near
+
+
+def test_word2vec_serializer_roundtrip(tmp_path):
+    w2v = Word2Vec(layer_size=8, min_count=1, epochs=1, seed=1)
+    w2v.fit(["alpha beta gamma", "beta gamma delta"])
+    p = str(tmp_path / "vecs.txt")
+    WordVectorSerializer.write_word_vectors(w2v, p)
+    loaded = WordVectorSerializer.read_word_vectors(p)
+    assert set(loaded.vocab.words) == set(w2v.vocab.words)
+    np.testing.assert_allclose(loaded.get_word_vector("beta"),
+                               w2v.get_word_vector("beta"), atol=1e-5)
+
+
+def test_tokenizer():
+    t = TokenizerFactory()
+    assert t.tokenize("Hello, World! it's 2x") == ["hello", "world", "it's",
+                                                   "2x"]
+
+
+def test_word2vec_min_count_prunes():
+    w2v = Word2Vec(layer_size=4, min_count=2, epochs=1, seed=1)
+    w2v.fit(["a a a b", "a b c"])
+    assert w2v.has_word("a") and w2v.has_word("b")
+    assert not w2v.has_word("c")
+
+
+def test_parallel_inference_shutdown_fails_queued_not_hangs():
+    """shutdown() must fail queued requests, not deadlock their callers
+    (regression), and output() after shutdown raises."""
+    net = _net()
+    pi = ParallelInference(net, mode=InferenceMode.BATCHED)
+    pi.shutdown()
+    with pytest.raises(RuntimeError, match="shut down"):
+        pi.output(RNG.normal(size=(2, 6)).astype(np.float32))
+
+
+def test_parallel_inference_rejects_bad_shape_in_caller():
+    """A shape-mismatched request fails ITS caller, not every request in
+    the coalesced batch (regression)."""
+    net = _net()
+    pi = ParallelInference(net, mode=InferenceMode.BATCHED, max_wait_ms=2)
+    try:
+        with pytest.raises(ValueError, match="does not match model input"):
+            pi.output(RNG.normal(size=(2, 5)).astype(np.float32))
+        # good requests still work afterwards
+        x = RNG.normal(size=(2, 6)).astype(np.float32)
+        np.testing.assert_allclose(pi.output(x), np.asarray(net.output(x)),
+                                   atol=1e-6)
+    finally:
+        pi.shutdown()

@@ -1,0 +1,126 @@
+"""Observability: StatsListener → storage backends → TB writer, profiler
+trace capture (SURVEY.md §5, §2.5 deeplearning4j-ui)."""
+
+import glob
+import json
+import os
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.data.dataset import DataSet
+from deeplearning4j_tpu.nn.config import InputType, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.layers.core import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.model import MultiLayerNetwork
+from deeplearning4j_tpu.nn.updaters import Adam
+from deeplearning4j_tpu.ui import (FileStatsStorage, InMemoryStatsStorage,
+                                   ProfilingListener, RemoteUIStatsStorage,
+                                   StatsListener, TensorBoardStatsWriter)
+
+RNG = np.random.default_rng(0)
+
+
+def _net():
+    conf = (NeuralNetConfiguration.builder().seed(0)
+            .updater(Adam(learning_rate=0.01))
+            .input_type(InputType.feed_forward(6))
+            .list(DenseLayer(n_out=12, activation="tanh"),
+                  OutputLayer(n_out=3))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _data(n=64):
+    x = RNG.normal(size=(n, 6)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[RNG.integers(0, 3, n)]
+    return DataSet(x, y)
+
+
+def test_stats_listener_collects_params_updates_ratios():
+    storage = InMemoryStatsStorage()
+    net = _net()
+    net.add_listener(StatsListener(storage, frequency=2, session_id="s1"))
+    net.fit(_data(), epochs=4)  # 4 iterations (full-batch)
+
+    recs = storage.get_records("s1")
+    meta = [r for r in recs if r["type"] == "meta"]
+    stats = [r for r in recs if r["type"] == "stats"]
+    assert len(meta) == 1
+    assert meta[0]["num_params"] == net.num_params()
+    assert len(stats) >= 2
+    last = stats[-1]
+    assert "0/W" in last["params"]
+    st = last["params"]["0/W"]
+    assert set(st) >= {"mean", "std", "mean_magnitude", "hist_counts"}
+    assert sum(st["hist_counts"]) == 6 * 12
+    # update stats + ratios appear from the second collected record on
+    assert last["updates"]["0/W"]["mean_magnitude"] > 0
+    assert 0 < last["ratios"]["0/W"] < 10.0
+    assert np.isfinite(last["score"])
+
+
+def test_file_storage_roundtrip_and_resume(tmp_path):
+    p = str(tmp_path / "stats.jsonl")
+    s1 = FileStatsStorage(p)
+    s1.put_record({"session": "a", "type": "stats", "iteration": 1})
+    s1.close()
+    s2 = FileStatsStorage(p)  # resume same file
+    s2.put_record({"session": "a", "type": "stats", "iteration": 2})
+    assert s2.list_sessions() == ["a"]
+    assert [r["iteration"] for r in s2.get_records("a")] == [1, 2]
+    assert s2.latest("a")["iteration"] == 2
+    s2.close()
+
+
+def test_remote_storage_posts_and_degrades():
+    sent = []
+
+    def fake_post(url, data):
+        sent.append(json.loads(data))
+        return 200
+
+    r = RemoteUIStatsStorage("http://example.invalid/collect", _post=fake_post)
+    r.put_record({"session": "x", "type": "stats", "iteration": 0})
+    assert sent[0]["session"] == "x"
+
+    def failing_post(url, data):
+        raise OSError("connection refused")
+
+    r2 = RemoteUIStatsStorage("http://example.invalid/collect",
+                              _post=failing_post)
+    r2.put_record({"session": "x", "type": "stats", "iteration": 0})
+    assert r2.failures == 1  # never raises into the train loop
+
+
+def test_tensorboard_writer_listener_and_drain(tmp_path):
+    logdir = str(tmp_path / "tb")
+    net = _net()
+    w = TensorBoardStatsWriter(logdir, frequency=1)
+    net.add_listener(w)
+    net.fit(_data(), epochs=3)
+    w.close()
+    events = glob.glob(os.path.join(logdir, "events.out.tfevents.*"))
+    assert events and os.path.getsize(events[0]) > 0
+
+    # drain a storage into a second logdir
+    storage = InMemoryStatsStorage()
+    net2 = _net()
+    net2.add_listener(StatsListener(storage, frequency=1, session_id="s2",
+                                    collect_histograms=False))
+    net2.fit(_data(), epochs=3)
+    logdir2 = str(tmp_path / "tb2")
+    w2 = TensorBoardStatsWriter(logdir2)
+    w2.write_storage(storage, "s2")
+    w2.close()
+    events2 = glob.glob(os.path.join(logdir2, "events.out.tfevents.*"))
+    assert events2 and os.path.getsize(events2[0]) > 0
+
+
+def test_profiling_listener_captures_trace(tmp_path):
+    logdir = str(tmp_path / "prof")
+    net = _net()
+    net.add_listener(ProfilingListener(logdir, start_iteration=1, steps=2))
+    net.fit(_data(), epochs=5)
+    produced = glob.glob(os.path.join(logdir, "**", "*"), recursive=True)
+    assert any("profile" in p or p.endswith((".pb", ".json.gz", ".xplane.pb"))
+               for p in produced), produced
