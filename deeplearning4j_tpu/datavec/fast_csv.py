@@ -1,0 +1,54 @@
+"""Native-accelerated CSV → float32 matrix loader.
+
+The data-loader hot path the reference keeps native (SURVEY.md §2.3:
+datavec's parsing rides JavaCV/native IO): Python's csv module walks rows
+as boxed strings, ~50x slower than the C parser in
+native/dl4j_tpu_native.cpp for large numeric CSVs. Falls back to
+numpy's own loader when no compiler is available — same output either way.
+Use the general CSVRecordReader (records.py) for non-numeric/quoted CSVs;
+this path is for big all-numeric matrices.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import Optional, Tuple
+
+import numpy as np
+
+from .. import native as _native
+
+
+def load_csv_floats(path_or_text, delimiter: str = ",",
+                    skip_rows: int = 0) -> np.ndarray:
+    """-> float32 [rows, cols]. Raises ValueError with the offending line
+    number on malformed numeric data or ragged rows."""
+    if os.path.exists(str(path_or_text)):
+        with open(path_or_text, "rb") as f:
+            buf = f.read()
+    else:
+        buf = str(path_or_text).encode()
+
+    lib = _native.load()
+    if lib is not None:
+        # worst case: every other byte a number
+        cap = max(16, len(buf) // 2 + 64)
+        out = np.empty(cap, dtype=np.float32)
+        cols = ctypes.c_int64(0)
+        rows = lib.csv_parse_floats(
+            buf, len(buf), delimiter.encode()[0], skip_rows,
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), cap,
+            ctypes.byref(cols))
+        if rows < 0:
+            raise ValueError(f"malformed CSV at line {-rows - 1 + skip_rows}")
+        c = cols.value
+        return out[:rows * c].reshape(rows, c).copy()
+
+    import io
+    try:
+        a = np.loadtxt(io.BytesIO(buf), delimiter=delimiter,
+                       skiprows=skip_rows, dtype=np.float32, ndmin=2)
+    except ValueError as e:
+        raise ValueError(f"malformed CSV: {e}") from None
+    return a

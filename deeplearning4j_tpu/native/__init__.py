@@ -1,0 +1,79 @@
+"""Native component loader: lazy g++ build + ctypes bindings with a pure
+Python/numpy fallback for toolchain-free environments.
+
+The reference builds libnd4j ahead of time with CMake (SURVEY.md §2.1
+"Build system" row); here the native surface is one small C ABI library
+(dl4j_tpu_native.cpp) built on demand into the package directory — the
+first import pays ~1s of g++, every later import dlopens the cached .so.
+``load()`` returns None when no compiler is available; callers must keep a
+fallback path (utils/compression.py and datavec/fast_csv.py do).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "dl4j_tpu_native.cpp")
+_LIB = os.path.join(_DIR, "libdl4j_tpu_native.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _build() -> bool:
+    cxx = os.environ.get("CXX", "g++")
+    cmd = [cxx, "-O3", "-shared", "-fPIC", "-std=c++17",
+           _SRC, "-o", _LIB]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        return True
+    except (OSError, subprocess.SubprocessError):
+        return False
+
+
+def load() -> Optional[ctypes.CDLL]:
+    """The bound library, building it on first use; None if unavailable."""
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if not os.path.exists(_LIB) or \
+                os.path.getmtime(_LIB) < os.path.getmtime(_SRC):
+            if not _build():
+                return None
+        try:
+            lib = ctypes.CDLL(_LIB)
+        except OSError:
+            return None
+        i64, u32p, f32p, chp = (ctypes.c_int64,
+                                ctypes.POINTER(ctypes.c_uint32),
+                                ctypes.POINTER(ctypes.c_float),
+                                ctypes.c_char_p)
+        lib.threshold_encode.restype = i64
+        lib.threshold_encode.argtypes = [f32p, i64, ctypes.c_float, u32p, i64]
+        lib.threshold_decode.restype = None
+        lib.threshold_decode.argtypes = [u32p, i64, ctypes.c_float, f32p, i64]
+        lib.threshold_encode_residual.restype = i64
+        lib.threshold_encode_residual.argtypes = [f32p, i64, ctypes.c_float,
+                                                  u32p, i64]
+        lib.bitmap_encode.restype = None
+        lib.bitmap_encode.argtypes = [f32p, i64, ctypes.c_float, u32p, u32p]
+        lib.bitmap_decode.restype = None
+        lib.bitmap_decode.argtypes = [u32p, u32p, ctypes.c_float, f32p, i64]
+        lib.csv_parse_floats.restype = i64
+        lib.csv_parse_floats.argtypes = [chp, i64, ctypes.c_char,
+                                         i64, f32p, i64,
+                                         ctypes.POINTER(ctypes.c_int64)]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return load() is not None

@@ -293,6 +293,13 @@ class ComputationGraph:
                  train, rng, masks: Optional[Dict[str, Any]] = None):
         """Pure topo walk. Returns ({vertex: activation}, new_state,
         {vertex: mask}) for output vertices."""
+        dt = _dt.resolve(self.conf.dtype)
+        if jnp.issubdtype(dt, jnp.floating):
+            inputs = {k: (jnp.asarray(v, dt)
+                          if jnp.issubdtype(jnp.asarray(v).dtype,
+                                            jnp.floating)
+                          and jnp.asarray(v).dtype != dt else v)
+                      for k, v in inputs.items()}  # cast to net dtype (DL4J)
         acts: Dict[str, jax.Array] = dict(inputs)
         mks: Dict[str, Any] = dict(masks or {})
         new_state = dict(state)

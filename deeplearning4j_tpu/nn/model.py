@@ -123,6 +123,11 @@ class MultiLayerNetwork:
     # --------------------------------------------------------------- forward
     def _forward(self, params, x, state, *, train, rng, mask=None):
         """Pure layer stack walk. Returns (out, new_state)."""
+        dt = _dt.resolve(self.conf.dtype)
+        if jnp.issubdtype(dt, jnp.floating) and \
+                jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating) and \
+                jnp.asarray(x).dtype != dt:
+            x = jnp.asarray(x, dt)  # cast inputs to the network dtype (DL4J)
         new_state = dict(state)
         for i, layer in enumerate(self.layers):
             si = str(i)

@@ -79,13 +79,13 @@ def conv2d(x, w, b=None, stride=(1, 1), padding=0, dilation=(1, 1),
     io_layout, _, out_layout = _conv_dnums(data_format)
     dn = lax.conv_dimension_numbers(x.shape, w.shape, (io_layout, "OIHW", out_layout))
     pad = _conv_padding(mode, padding, (kh, kw), stride, dilation)
+    # no preferred_element_type=f32 for bf16: the MXU accumulates bf16 convs
+    # in f32 natively, and forcing the OUTPUT dtype breaks the conv VJP
+    # (transposed conv gets mixed bf16/f32 operands — found benching bf16)
     y = lax.conv_general_dilated(
         x, w, window_strides=stride, padding=pad,
         rhs_dilation=dilation, dimension_numbers=dn,
-        feature_group_count=groups, precision=precision_for(x, w),
-        preferred_element_type=jnp.float32 if x.dtype == jnp.bfloat16 else None)
-    if y.dtype != x.dtype:
-        y = y.astype(x.dtype)
+        feature_group_count=groups, precision=precision_for(x, w))
     if b is not None:
         y = y + (b.reshape(1, -1, 1, 1) if data_format == "NCHW" else b.reshape(1, 1, 1, -1))
     return y

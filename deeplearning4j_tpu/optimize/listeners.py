@@ -98,13 +98,15 @@ class PerformanceListener(TrainingListener):
 
 
 def _detect_peak_flops() -> Optional[float]:
-    """Peak bf16 FLOPs of device 0, for MFU (v5e ~394 TFLOPs bf16)."""
+    """Peak BF16 FLOPs of device 0, for MFU. (v5e's widely-quoted 394
+    TOPS figure is INT8; bf16 peak is 197 TFLOPs — using 394 halves every
+    reported MFU.)"""
     try:
         import jax
         d = jax.devices()[0]
         kind = getattr(d, "device_kind", "").lower()
         if "v5 lite" in kind or "v5e" in kind:
-            return 394e12
+            return 197e12
         if "v4" in kind:
             return 275e12
         if "v5p" in kind or "v5" in kind:

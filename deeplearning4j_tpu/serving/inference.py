@@ -54,6 +54,8 @@ class ParallelInference:
     def __init__(self, model, mode: str = InferenceMode.BATCHED,
                  batch_limit: int = 32, max_wait_ms: float = 5.0,
                  queue_limit: int = 256):
+        if mode not in (InferenceMode.SEQUENTIAL, InferenceMode.BATCHED):
+            raise ValueError(f"unknown inference mode {mode!r}")
         self.model = model
         self.mode = mode
         self.batch_limit = int(batch_limit)
@@ -88,7 +90,14 @@ class ParallelInference:
                 return np.asarray(self.model.output(x))
         req = _Request(x)
         self._q.put(req)
-        req.event.wait()
+        # re-checking wait: shutdown() can win the race between the check
+        # above and the put — the queue drain would then miss this request
+        # and a bare wait() would deadlock its caller
+        while not req.event.wait(timeout=0.2):
+            if self._shutdown.is_set():
+                raise RuntimeError(
+                    "ParallelInference shut down before the request was "
+                    "served")
         if req.error is not None:
             raise req.error
         return req.result

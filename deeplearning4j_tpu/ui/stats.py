@@ -58,6 +58,7 @@ class StatsListener(TrainingListener):
         self.session_id = session_id or f"train-{uuid.uuid4().hex[:8]}"
         self.collect_histograms = collect_histograms
         self._prev_params: Optional[Dict[str, np.ndarray]] = None
+        self._prev_iteration: Optional[int] = None
         self._last_time = None
         self._meta_written = False
 
@@ -89,7 +90,11 @@ class StatsListener(TrainingListener):
                 st.pop("hist_counts"), st.pop("hist_edges")
             record["params"][path] = st
             if self._prev_params is not None and path in self._prev_params:
-                upd = arr - self._prev_params[path]
+                # normalize to PER-ITERATION updates: collections are
+                # `frequency` iterations apart, and the canonical
+                # update:param ratio target (~1e-3) is per optimizer step
+                gap = max(1, iteration - (self._prev_iteration or 0))
+                upd = (arr - self._prev_params[path]) / gap
                 ust = _leaf_stats(upd)
                 if not self.collect_histograms:
                     ust.pop("hist_counts"), ust.pop("hist_edges")
@@ -107,4 +112,5 @@ class StatsListener(TrainingListener):
         except Exception:
             pass
         self._prev_params = cur
+        self._prev_iteration = iteration
         self.storage.put_record(record)

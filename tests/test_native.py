@@ -1,0 +1,151 @@
+"""Native C components: build/load, threshold + bitmap gradient codecs
+(native vs numpy fallback equivalence), fast CSV loader (SURVEY.md §2.1
+codec rows, §2.3 native loaders)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import native
+from deeplearning4j_tpu.datavec.fast_csv import load_csv_floats
+from deeplearning4j_tpu.utils.compression import (BitmapCompression,
+                                                  ThresholdCompression)
+
+RNG = np.random.default_rng(0)
+
+
+def test_native_library_builds_and_loads():
+    # the environment ships g++; the native path must actually engage here
+    assert native.available(), "native library failed to build/load"
+
+
+def test_threshold_codec_roundtrip():
+    tc = ThresholdCompression(threshold=0.1)
+    g = RNG.normal(0, 0.2, size=1000).astype(np.float32)
+    enc = tc.encode(g)
+    # every surviving entry has |g| >= threshold
+    idx = (enc >> 1).astype(int)
+    assert (np.abs(g[idx]) >= 0.1).all()
+    dec = np.zeros_like(g)
+    tc.decode(enc, dec)
+    # decode applies exactly +-threshold at the surviving indices
+    assert set(np.nonzero(dec)[0]) == set(idx.tolist())
+    np.testing.assert_allclose(np.abs(dec[idx]), 0.1, rtol=1e-6)
+    assert np.sign(dec[idx]).tolist() == np.sign(g[idx]).tolist()
+
+
+def test_threshold_residual_accumulates_small_grads():
+    """Strom residual semantics: sub-threshold mass accumulates until it
+    crosses the threshold."""
+    tc = ThresholdCompression(threshold=1.0)
+    g = np.full(4, 0.4, dtype=np.float32)
+    enc1, res1 = tc.encode_residual(g)
+    assert enc1.size == 0
+    np.testing.assert_allclose(res1, 0.4)
+    enc2, res2 = tc.encode_residual(g, res1)      # 0.8 still below
+    assert enc2.size == 0
+    enc3, res3 = tc.encode_residual(g, res2)      # 1.2 crosses
+    assert enc3.size == 4
+    np.testing.assert_allclose(res3, 0.2, atol=1e-6)
+
+
+def test_threshold_native_matches_numpy_fallback(monkeypatch):
+    g = RNG.normal(0, 0.3, size=4096).astype(np.float32)
+    tc = ThresholdCompression(threshold=0.25)
+    enc_native = tc.encode(g)
+    dec_native = np.zeros_like(g)
+    tc.decode(enc_native, dec_native)
+    monkeypatch.setattr(native, "load", lambda: None)
+    enc_py = tc.encode(g)
+    dec_py = np.zeros_like(g)
+    tc.decode(enc_py, dec_py)
+    np.testing.assert_array_equal(enc_native, enc_py)
+    np.testing.assert_array_equal(dec_native, dec_py)
+
+
+def test_bitmap_codec_roundtrip_and_fallback_equivalence(monkeypatch):
+    g = RNG.normal(0, 0.3, size=1000).astype(np.float32)
+    bc = BitmapCompression(threshold=0.2)
+    pres_n, sign_n = bc.encode(g)
+    dec_n = np.zeros_like(g)
+    bc.decode(pres_n, sign_n, dec_n)
+    surviving = np.abs(g) >= 0.2
+    np.testing.assert_array_equal(dec_n != 0, surviving)
+    np.testing.assert_allclose(dec_n[surviving], np.sign(g[surviving]) * 0.2,
+                               rtol=1e-6)
+    monkeypatch.setattr(native, "load", lambda: None)
+    pres_p, sign_p = bc.encode(g)
+    dec_p = np.zeros_like(g)
+    bc.decode(pres_p, sign_p, dec_p)
+    np.testing.assert_array_equal(np.asarray(pres_n), np.asarray(pres_p))
+    np.testing.assert_array_equal(np.asarray(sign_n), np.asarray(sign_p))
+    np.testing.assert_array_equal(dec_n, dec_p)
+
+
+def test_compressed_stream_conserves_gradient_mass():
+    """The Strom-scheme invariant the reference's residual post-processors
+    maintain: everything not transmitted stays in the residual, so
+    decoded_sum + residual == cumulative input EXACTLY (each firing sends
+    one ±threshold quantum; under-transmission of large entries is caught
+    up over subsequent rounds)."""
+    tc = ThresholdCompression(threshold=0.05)
+    N, R = 512, 25
+    g = RNG.normal(0, 0.04, size=N).astype(np.float32)
+    residual = None
+    decoded_total = np.zeros(N, np.float32)
+    for _ in range(R):
+        enc, residual = tc.encode_residual(g, residual)
+        tc.decode(enc, decoded_total)
+    np.testing.assert_allclose(decoded_total + residual, R * g,
+                               rtol=1e-4, atol=1e-4)
+    # elements below threshold per round stay fully transmitted up to one
+    # pending quantum (elements ABOVE threshold under-transmit by design:
+    # one quantum per round, caught up over later rounds)
+    small = np.abs(g) < 0.05
+    assert np.abs(residual[small]).max() <= 0.05 + 1e-5
+
+
+# ---- fast CSV ---------------------------------------------------------------
+
+def test_fast_csv_parses(tmp_path):
+    p = tmp_path / "m.csv"
+    p.write_text("h1,h2,h3\n1,2.5,-3\n4,5e-1,6\n")
+    m = load_csv_floats(str(p), skip_rows=1)
+    np.testing.assert_allclose(m, [[1, 2.5, -3], [4, 0.5, 6]])
+    assert m.dtype == np.float32
+
+
+def test_fast_csv_matches_numpy_fallback(tmp_path, monkeypatch):
+    rows = RNG.normal(size=(200, 7)).astype(np.float32)
+    p = tmp_path / "big.csv"
+    p.write_text("\n".join(",".join(f"{v:.6f}" for v in r) for r in rows))
+    a = load_csv_floats(str(p))
+    monkeypatch.setattr(native, "load", lambda: None)
+    b = load_csv_floats(str(p))
+    np.testing.assert_allclose(a, b, atol=1e-6)
+    np.testing.assert_allclose(a, rows, atol=1e-5)
+
+
+def test_fast_csv_rejects_ragged(tmp_path):
+    p = tmp_path / "bad.csv"
+    p.write_text("1,2,3\n4,5\n")
+    with pytest.raises(ValueError, match="malformed"):
+        load_csv_floats(str(p))
+
+
+def test_encode_residual_does_not_mutate_caller_gradient():
+    """Regression: the native path aliased the caller's array when no
+    residual was passed and subtracted quanta from it in place."""
+    tc = ThresholdCompression(threshold=0.05)
+    g = RNG.normal(0, 0.2, size=64).astype(np.float32)
+    g_copy = g.copy()
+    tc.encode_residual(g)
+    np.testing.assert_array_equal(g, g_copy)
+
+
+def test_fast_csv_trailing_tab_does_not_merge_rows(tmp_path):
+    """Regression: strtof skipped '\\t\\n' as whitespace and merged two rows
+    into one wide row with no error."""
+    p = tmp_path / "tabs.csv"
+    p.write_bytes(b"1,2\t\n3,4\n")
+    m = load_csv_floats(str(p))
+    np.testing.assert_allclose(m, [[1, 2], [3, 4]])

@@ -427,3 +427,33 @@ def test_depthwise_separable(rng):
     z = nnops.separable_conv2d(jnp.asarray(x), jnp.asarray(wd), jnp.asarray(wp), padding=1)
     assert z.shape == (1, 5, 6, 6)
     _mark("depthwise_conv2d", "separable_conv2d")
+
+
+def test_bf16_conv_net_trains(rng):
+    """End-to-end bf16 training step (regression: preferred_element_type on
+    conv2d broke the conv VJP with mixed bf16/f32 operands)."""
+    from deeplearning4j_tpu.data.dataset import DataSet
+    from deeplearning4j_tpu.nn.config import InputType, NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.layers.conv import (BatchNormalization,
+                                                   ConvolutionLayer,
+                                                   SubsamplingLayer)
+    from deeplearning4j_tpu.nn.layers.core import OutputLayer
+    from deeplearning4j_tpu.nn.model import MultiLayerNetwork
+    from deeplearning4j_tpu.nn.updaters import Sgd
+
+    conf = (NeuralNetConfiguration.builder().seed(0)
+            .updater(Sgd(learning_rate=0.05))
+            .data_type("BFLOAT16")
+            .input_type(InputType.convolutional(3, 8, 8, data_format="NHWC"))
+            .list(ConvolutionLayer(n_out=8, kernel=(3, 3), mode="same",
+                                   activation="relu", data_format="NHWC"),
+                  BatchNormalization(data_format="NHWC"),
+                  SubsamplingLayer(kernel=(2, 2), data_format="NHWC"),
+                  OutputLayer(n_out=4))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    assert jnp.asarray(net.params["0"]["W"]).dtype == jnp.bfloat16
+    x = rng.normal(size=(16, 8, 8, 3)).astype(np.float32)
+    y = np.eye(4, dtype=np.float32)[rng.integers(0, 4, 16)]
+    net.fit(DataSet(x, y), epochs=5)
+    assert np.isfinite(float(net.score()))
