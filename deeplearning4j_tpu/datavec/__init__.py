@@ -9,7 +9,8 @@ feeding the numpy→device pipeline.
 
 from .records import (CSVRecordReader, CSVSequenceRecordReader,  # noqa: F401
                       CollectionRecordReader, FileSplit, InputSplit,
-                      LineRecordReader, RecordReader)
+                      JacksonLineRecordReader, LineRecordReader,
+                      RecordReader, SVMLightRecordReader)
 from .schema import (DataAnalysis, Schema, TransformProcess)  # noqa: F401
 from .iterator import (RecordReaderDataSetIterator,  # noqa: F401
                        SequenceRecordReaderDataSetIterator)

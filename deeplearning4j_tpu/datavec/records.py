@@ -229,3 +229,103 @@ class CSVSequenceRecordReader(_CursorReader):
         if self._seqs is None:
             raise RuntimeError("call initialize(split) first")
         return self._seqs
+
+
+class SVMLightRecordReader(_CursorReader):
+    """SVMLight/libsvm sparse format: ``label [qid:n] idx:val ...`` →
+    ``[f0..fN-1, label]`` dense records, label appended last (reference
+    ``SVMLightRecordReader``†). Indices default to the libsvm standard
+    (1-based); pass ``zero_based=True`` for files written with 0-based
+    indices. ``qid`` tokens (ranking datasets) are skipped."""
+
+    def __init__(self, num_features: int, zero_based: bool = False):
+        super().__init__()
+        self.num_features = int(num_features)
+        self.zero_based = zero_based
+        self._recs: Optional[List[list]] = None
+
+    def initialize(self, split) -> "SVMLightRecordReader":
+        paths = split.locations() if isinstance(split, InputSplit) else [split]
+        text = []
+        for p in paths:
+            with open(p) as f:
+                text.append(f.read())
+        return self.from_text("\n".join(text))
+
+    def from_text(self, text: str) -> "SVMLightRecordReader":
+        recs = []
+        for ln in text.splitlines():
+            ln = ln.split("#")[0].strip()
+            if not ln:
+                continue
+            parts = ln.split()
+            label = float(parts[0])
+            feats = [0.0] * self.num_features
+            for tok in parts[1:]:
+                if tok.startswith("qid:"):
+                    continue  # ranking-query id, not a feature
+                i, v = tok.split(":")
+                idx = int(i) - (0 if self.zero_based else 1)
+                if not 0 <= idx < self.num_features:
+                    raise ValueError(f"feature index {i} out of range "
+                                     f"(num_features={self.num_features}, "
+                                     f"zero_based={self.zero_based})")
+                feats[idx] = float(v)
+            recs.append(feats + [label])
+        self._recs = recs
+        self._pos = 0
+        return self
+
+    def _records(self):
+        if self._recs is None:
+            raise RuntimeError("call initialize(split) or from_text() first")
+        return self._recs
+
+
+class JacksonLineRecordReader(_CursorReader):
+    """One JSON object per line; ``field_selection`` orders the extracted
+    values (reference ``JacksonLineRecordReader`` + FieldSelection†).
+    Dotted paths walk nested objects; missing fields raise unless a
+    default is given via ``(path, default)`` tuples."""
+
+    def __init__(self, field_selection: Sequence):
+        super().__init__()
+        self.fields = [(f, None) if isinstance(f, str) else (f[0], f[1])
+                       for f in field_selection]
+        self._recs: Optional[List[list]] = None
+
+    def initialize(self, split) -> "JacksonLineRecordReader":
+        paths = split.locations() if isinstance(split, InputSplit) else [split]
+        lines = []
+        for p in paths:
+            with open(p) as f:
+                lines.extend(f.read().splitlines())
+        return self.from_text("\n".join(lines))
+
+    def from_text(self, text: str) -> "JacksonLineRecordReader":
+        import json as _json
+        recs = []
+        for ln in text.splitlines():
+            if not ln.strip():
+                continue
+            obj = _json.loads(ln)
+            rec = []
+            for path, default in self.fields:
+                node = obj
+                try:
+                    for part in path.split("."):
+                        node = node[part]
+                except (KeyError, TypeError):
+                    if default is None:
+                        raise ValueError(f"field {path!r} missing in {ln!r}")
+                    node = default
+                rec.append(node)
+            recs.append(rec)
+        self._recs = recs
+        self._pos = 0
+        return self
+
+    def _records(self):
+        if self._recs is None:
+            raise RuntimeError("call initialize(split) or from_text() first")
+        return self._recs

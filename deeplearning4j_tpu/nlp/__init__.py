@@ -2,3 +2,4 @@
 
 from .word2vec import (SequenceVectors, TokenizerFactory,  # noqa: F401
                        Word2Vec, WordVectorSerializer)
+from .graph import DeepWalk, Graph  # noqa: F401

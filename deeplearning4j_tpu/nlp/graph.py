@@ -1,0 +1,90 @@
+"""Graph embeddings: DeepWalk.
+
+TPU-native equivalent of deeplearning4j-graph (reference:
+``deeplearning4j-graph .../models/deepwalk/DeepWalk.java``, random-walk
+iterators under ``.../iterator/**``† per SURVEY.md §2.5; reference mount
+was empty, citations upstream-relative, unverified).
+
+Same recipe as the reference: uniform random walks over the graph feed the
+skip-gram machinery — here literally the SequenceVectors trainer from
+word2vec.py (the reference shares its sequencevectors core the same way),
+so the batched jitted update path is reused unchanged.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .word2vec import SequenceVectors
+
+
+class Graph:
+    """Undirected (by default) adjacency-list graph (reference ``Graph``)."""
+
+    def __init__(self, num_vertices: int, directed: bool = False):
+        self.n = int(num_vertices)
+        self.directed = directed
+        self._adj: List[List[int]] = [[] for _ in range(self.n)]
+
+    def add_edge(self, a: int, b: int):
+        self._adj[a].append(b)
+        if not self.directed:
+            self._adj[b].append(a)
+
+    def neighbors(self, v: int) -> List[int]:
+        return self._adj[v]
+
+    def num_vertices(self) -> int:
+        return self.n
+
+
+class DeepWalk:
+    """DeepWalk: ``walks_per_vertex`` uniform random walks of
+    ``walk_length`` from every vertex → skip-gram over vertex-id tokens."""
+
+    def __init__(self, layer_size: int = 64, window: int = 4,
+                 walk_length: int = 16, walks_per_vertex: int = 8,
+                 negative: int = 5, epochs: int = 5,
+                 learning_rate: float = 0.1, batch_size: int = 256,
+                 seed: int = 123):
+        self.walk_length = walk_length
+        self.walks_per_vertex = walks_per_vertex
+        self.seed = seed
+        # small default batch: the update is a batch-MEAN gradient, so the
+        # step count (not the pair count) is what trains small graphs
+        self._sv = SequenceVectors(layer_size=layer_size, window=window,
+                                   min_count=1, negative=negative,
+                                   subsample=0.0, epochs=epochs,
+                                   learning_rate=learning_rate,
+                                   batch_size=batch_size, seed=seed)
+
+    def _walks(self, g: Graph) -> List[List[str]]:
+        rng = np.random.default_rng(self.seed)
+        walks: List[List[str]] = []
+        order = np.arange(g.num_vertices())
+        for _ in range(self.walks_per_vertex):
+            rng.shuffle(order)
+            for start in order:
+                walk = [int(start)]
+                for _ in range(self.walk_length - 1):
+                    nbrs = g.neighbors(walk[-1])
+                    if not nbrs:
+                        break
+                    walk.append(int(nbrs[rng.integers(0, len(nbrs))]))
+                walks.append([str(v) for v in walk])
+        return walks
+
+    def fit(self, graph: Graph) -> "DeepWalk":
+        self._sv.fit_sequences(self._walks(graph))
+        return self
+
+    def get_vertex_vector(self, v: int) -> np.ndarray:
+        return self._sv.get_word_vector(str(v))
+
+    def similarity(self, a: int, b: int) -> float:
+        return self._sv.similarity(str(a), str(b))
+
+    def verts_nearest(self, v: int, n: int = 10) -> List[Tuple[int, float]]:
+        return [(int(w), s) for w, s in self._sv.words_nearest(str(v), n)]

@@ -231,6 +231,54 @@ def wasserstein(labels, predictions, mask=None, weights=None):
     return _per_example(_sum_outputs(elem, weights), mask)
 
 
+@_loss("fmeasure")
+def fmeasure(labels, predictions, mask=None, weights=None, beta=1.0):
+    """LossFMeasure: 1 - soft-F_beta on binary predictions. Batch-level
+    (non-decomposable) like the reference — counts are summed over the
+    whole (unmasked) batch, then one F score is formed; masks weight the
+    counts rather than averaging per example."""
+    if weights is not None:
+        raise ValueError("fmeasure is a single-column batch-level loss; "
+                         "per-output weights do not apply")
+    y = labels[..., -1] if labels.shape[-1] > 1 else labels[..., 0]
+    p = predictions[..., -1] if predictions.shape[-1] > 1 \
+        else predictions[..., 0]
+    if mask is not None:
+        m = jnp.broadcast_to(jnp.asarray(mask, y.dtype), y.shape)
+        y, p = y * m, p * m
+    tp = jnp.sum(y * p)
+    fp = jnp.sum((1.0 - y) * p)
+    fn = jnp.sum(y * (1.0 - p))
+    b2 = beta * beta
+    f = (1.0 + b2) * tp / jnp.maximum((1.0 + b2) * tp + b2 * fn + fp, EPS)
+    return 1.0 - f
+
+
+@_loss("mixture_density")
+def mixture_density(labels, predictions, mask=None, weights=None,
+                    num_mixtures=None):
+    """LossMixtureDensity: negative log-likelihood of an isotropic Gaussian
+    mixture. Network output layout matches the reference:
+    ``[alpha (K) | sigma (K) | mu (K*L)]`` with labels [.., L]; K inferred
+    from the widths when not given (width = K*(2+L))."""
+    L = labels.shape[-1]
+    width = predictions.shape[-1]
+    K = num_mixtures or width // (2 + L)
+    if K * (2 + L) != width:
+        raise ValueError(f"output width {width} != K*(2+L) for labels "
+                         f"width {L}")
+    alpha = predictions[..., :K]
+    sigma = jnp.maximum(jnp.abs(predictions[..., K:2 * K]), EPS)
+    mu = predictions[..., 2 * K:].reshape(predictions.shape[:-1] + (K, L))
+    log_pi = jax.nn.log_softmax(alpha, axis=-1)
+    d2 = jnp.sum((labels[..., None, :] - mu) ** 2, axis=-1)     # [.., K]
+    log_n = (-0.5 * d2 / (sigma ** 2)
+             - L * jnp.log(sigma)
+             - 0.5 * L * jnp.log(2.0 * jnp.pi))
+    nll = -jax.nn.logsumexp(log_pi + log_n, axis=-1)
+    return _per_example(nll, mask)
+
+
 def get(name_or_fn):
     if callable(name_or_fn):
         return name_or_fn

@@ -6,3 +6,4 @@ from .storage import (FileStatsStorage, InMemoryStatsStorage,  # noqa: F401
                       RemoteUIStatsStorage, StatsStorage)
 from .tensorboard import TensorBoardStatsWriter  # noqa: F401
 from .profiler import ProfilingListener  # noqa: F401
+from .server import UIServer  # noqa: F401

@@ -1,0 +1,186 @@
+"""Training dashboard web server.
+
+TPU-native equivalent of the reference's training UI (reference:
+``deeplearning4j-vertx .../VertxUIServer.java`` serving the dashboard on
+port 9000 over any attached StatsStorage† per SURVEY.md §2.5/§5; reference
+mount was empty, citation upstream-relative, unverified).
+
+Deliberately tiny: one self-contained HTML page (inline JS, no deps,
+polls JSON) + a JSON API over stdlib http.server, rendering the same
+first-order charts the reference's dashboard leads with — score curve,
+update:param ratios per layer, throughput. TensorBoard
+(ui/tensorboard.py) remains the heavyweight path; this is the
+"attach to a running job from a browser with zero setup" story.
+
+    storage = InMemoryStatsStorage()
+    net.add_listener(StatsListener(storage))
+    UIServer(storage).start()       # -> http://localhost:9000
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+_PAGE = """<!DOCTYPE html>
+<html><head><title>deeplearning4j_tpu training</title><style>
+ body{font-family:sans-serif;margin:1.5em;background:#fafafa}
+ .card{background:#fff;border:1px solid #ddd;border-radius:6px;
+       padding:1em;margin-bottom:1em;max-width:900px}
+ canvas{width:100%;height:220px}
+ h2{font-size:1em;color:#444;margin:0 0 .5em}
+ #meta{color:#777;font-size:.85em}
+</style></head><body>
+<h1>Training</h1><div id="meta"></div>
+<div class="card"><h2>score</h2><canvas id="score"></canvas></div>
+<div class="card"><h2>update : parameter ratio (log10)</h2>
+<canvas id="ratio"></canvas></div>
+<div class="card"><h2>iterations / sec</h2><canvas id="speed"></canvas></div>
+<script>
+function draw(id, series, logy) {
+  const c = document.getElementById(id), ctx = c.getContext('2d');
+  c.width = c.clientWidth; c.height = c.clientHeight;
+  ctx.clearRect(0,0,c.width,c.height);
+  const names = Object.keys(series); if (!names.length) return;
+  let xs=[], ys=[];
+  names.forEach(n => series[n].forEach(p => {xs.push(p[0]); ys.push(
+      logy ? Math.log10(Math.max(p[1],1e-12)) : p[1]);}));
+  const x0=Math.min(...xs), x1=Math.max(...xs)||1,
+        y0=Math.min(...ys), y1=Math.max(...ys);
+  const sx=v=>(v-x0)/(x1-x0||1)*(c.width-40)+30,
+        sy=v=>c.height-15-((v-y0)/((y1-y0)||1))*(c.height-30);
+  ctx.strokeStyle='#bbb'; ctx.strokeRect(30,5,c.width-40,c.height-20);
+  const colors=['#c33','#36c','#393','#c93','#939','#399'];
+  names.forEach((n,i)=>{ ctx.strokeStyle=colors[i%colors.length];
+    ctx.beginPath();
+    series[n].forEach((p,j)=>{ const y=logy?Math.log10(Math.max(p[1],1e-12)):p[1];
+      j? ctx.lineTo(sx(p[0]),sy(y)) : ctx.moveTo(sx(p[0]),sy(y));});
+    ctx.stroke();});
+  ctx.fillStyle='#333'; ctx.font='11px sans-serif';
+  ctx.fillText(y1.toPrecision(3), 2, 12);
+  ctx.fillText(y0.toPrecision(3), 2, c.height-15);
+}
+async function tick() {
+  const sessions = await (await fetch('/sessions')).json();
+  if (!sessions.length) return;
+  const s = sessions[sessions.length-1];
+  const d = await (await fetch('/data?session='+s)).json();
+  document.getElementById('meta').textContent =
+    'session ' + s + ' — ' + d.num_records + ' records' +
+    (d.model_class ? ' — ' + d.model_class + ' (' + d.num_params +
+     ' params)' : '');
+  draw('score', {score: d.score}, false);
+  draw('ratio', d.ratios, true);
+  draw('speed', {ips: d.speed}, false);
+}
+tick(); setInterval(tick, 2000);
+</script></body></html>"""
+
+
+class UIServer:
+    """Serve a dashboard over any StatsStorage (reference ``UIServer
+    .getInstance().attach(storage)``)."""
+
+    def __init__(self, storage, port: int = 9000, host: str = "127.0.0.1"):
+        self.storage = storage
+        self.port = port
+        self.host = host
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    # -- API payloads ---------------------------------------------------------
+    def _session_data(self, session: str) -> dict:
+        recs = self.storage.get_records(session)
+        meta = next((r for r in recs if r.get("type") == "meta"), {})
+        stats = [r for r in recs if r.get("type") == "stats"]
+        ratios: dict = {}
+        for r in stats:
+            for path, v in r.get("ratios", {}).items():
+                ratios.setdefault(path, []).append([r["iteration"], v])
+        return {
+            "num_records": len(stats),
+            "model_class": meta.get("model_class"),
+            "num_params": meta.get("num_params"),
+            "score": [[r["iteration"], r["score"]] for r in stats],
+            "ratios": ratios,
+            "speed": [[r["iteration"], r["iterations_per_sec"]]
+                      for r in stats if r.get("iterations_per_sec")],
+        }
+
+    # -- server ---------------------------------------------------------------
+    def start(self) -> int:
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def _send(self, code, body: bytes, ctype: str):
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_POST(self):
+                # the receiving end of RemoteUIStatsStorage: remote trainers
+                # POST records here; they land in THIS server's attached
+                # storage and appear on the dashboard (the reference's
+                # remote-router → UIServer leg)
+                if self.path != "/collect":
+                    self._send(404, b'{"error":"not found"}',
+                               "application/json")
+                    return
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                    record = json.loads(self.rfile.read(n))
+                    server.storage.put_record(record)
+                    self._send(200, b'{"status":"ok"}', "application/json")
+                except Exception as e:
+                    self._send(400, json.dumps(
+                        {"error": f"{type(e).__name__}: {e}"}).encode(),
+                        "application/json")
+
+            def do_GET(self):
+                try:
+                    if self.path in ("/", "/train", "/index.html"):
+                        self._send(200, _PAGE.encode(), "text/html")
+                    elif self.path == "/sessions":
+                        self._send(200, json.dumps(
+                            server.storage.list_sessions()).encode(),
+                            "application/json")
+                    elif self.path.startswith("/data"):
+                        from urllib.parse import parse_qs, urlparse
+                        q = parse_qs(urlparse(self.path).query)
+                        session = q.get("session", [""])[0]
+                        self._send(200, json.dumps(
+                            server._session_data(session)).encode(),
+                            "application/json")
+                    else:
+                        self._send(404, b'{"error":"not found"}',
+                                   "application/json")
+                except Exception as e:
+                    self._send(500, json.dumps(
+                        {"error": f"{type(e).__name__}: {e}"}).encode(),
+                        "application/json")
+
+        self._httpd = ThreadingHTTPServer((self.host, self.port), Handler)
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self.port
+
+    def stop(self):
+        if self._httpd:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()

@@ -91,9 +91,11 @@ class FileStatsStorage(StatsStorage):
 
 class RemoteUIStatsStorage(StatsStorage):
     """HTTP router: POST each record as JSON to an endpoint (the reference's
-    ``RemoteUIStatsStorageRouter``). Failures are counted, not raised —
-    losing a metrics packet must never kill training. Write-only (reads
-    happen server-side)."""
+    ``RemoteUIStatsStorageRouter``). The receiving end is a
+    ``ui.server.UIServer`` — point the url at its ``/collect`` path and the
+    records land in that server's storage and dashboard. Failures are
+    counted, not raised — losing a metrics packet must never kill training.
+    Write-only (reads happen server-side)."""
 
     def __init__(self, url: str, timeout: float = 2.0,
                  _post: Optional[Callable] = None):
