@@ -1,3 +1,3 @@
 from .base import LAYERS, Layer  # noqa: F401
-from . import (attention, conv, conv_extra, core, recurrent,  # noqa: F401
-               special, wrappers)
+from . import (attention, conv, conv3d, conv_extra, core,  # noqa: F401
+               recurrent, special, wrappers)

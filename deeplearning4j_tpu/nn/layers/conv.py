@@ -70,14 +70,18 @@ class ConvolutionLayer(Layer):
             params["b"] = jnp.full((self.n_out,), self.bias_init, dtype)
         sh, sw = _pair(self.stride)
         ph, pw = _pair(self.padding)
+        dh, dw = _pair(self.dilation)
+        # effective kernel under dilation: (k-1)*d + 1 (same latent flaw as
+        # the 3D layer had — initialize must agree with the runtime conv)
+        ke_h, ke_w = (kh - 1) * dh + 1, (kw - 1) * dw + 1
         if self.data_format == "NCHW":
             h, wd = int(input_shape[1]), int(input_shape[2])
-            out = (self.n_out, _conv_out(h, kh, sh, ph, self.mode),
-                   _conv_out(wd, kw, sw, pw, self.mode))
+            out = (self.n_out, _conv_out(h, ke_h, sh, ph, self.mode),
+                   _conv_out(wd, ke_w, sw, pw, self.mode))
         else:
             h, wd = int(input_shape[0]), int(input_shape[1])
-            out = (_conv_out(h, kh, sh, ph, self.mode),
-                   _conv_out(wd, kw, sw, pw, self.mode), self.n_out)
+            out = (_conv_out(h, ke_h, sh, ph, self.mode),
+                   _conv_out(wd, ke_w, sw, pw, self.mode), self.n_out)
         return params, {}, out
 
     def apply(self, params, x, state, *, train=False, rng=None, mask=None):

@@ -387,3 +387,83 @@ def depth_to_space(x, block_size, data_format="NCHW"):
     x = x.reshape(n, h, w, b, b, c // (b * b))
     x = x.transpose(0, 1, 3, 2, 4, 5)
     return x.reshape(n, h * b, w * b, c // (b * b))
+
+
+# ---- 3D convolution family --------------------------------------------------
+
+def _triple(v):
+    if isinstance(v, (tuple, list)):
+        return (int(v[0]), int(v[1]), int(v[2]))
+    return (int(v),) * 3
+
+
+@register("conv3d", category="cnn")
+def conv3d(x, w, b=None, stride=(1, 1, 1), padding=0, dilation=(1, 1, 1),
+           mode="truncate", data_format="NCDHW"):
+    """3D convolution (libnd4j ``conv3dnew``). x: [N,C,D,H,W] or
+    [N,D,H,W,C]; w: [O,I,kD,kH,kW] (OIDHW, the DL4J layout) regardless of
+    data_format."""
+    stride, dilation = _triple(stride), _triple(dilation)
+    io = "NCDHW" if data_format == "NCDHW" else "NDHWC"
+    dn = lax.conv_dimension_numbers(x.shape, w.shape, (io, "OIDHW", io))
+    if mode == "same":
+        pad = "SAME"
+    else:
+        p = _triple(padding)
+        pad = [(pi, pi) for pi in p]
+    y = lax.conv_general_dilated(
+        x, w, window_strides=stride, padding=pad, rhs_dilation=dilation,
+        dimension_numbers=dn, precision=precision_for(x, w))
+    if b is not None:
+        shape = (1, -1, 1, 1, 1) if data_format == "NCDHW" else (1, 1, 1, 1, -1)
+        y = y + b.reshape(shape)
+    return y
+
+
+def _pool3d(x, kind, kernel, stride, padding, mode, data_format):
+    kd, kh, kw = _triple(kernel)
+    sd_, sh, sw = _triple(stride)
+    if data_format == "NCDHW":
+        window = (1, 1, kd, kh, kw)
+        strides = (1, 1, sd_, sh, sw)
+    else:
+        window = (1, kd, kh, kw, 1)
+        strides = (1, sd_, sh, sw, 1)
+    if mode == "same":
+        pad = "SAME"
+    else:
+        pd, ph, pw = _triple(padding)
+        spatial = [(pd, pd), (ph, ph), (pw, pw)]
+        pad = ([(0, 0), (0, 0)] + spatial) if data_format == "NCDHW" else \
+            ([(0, 0)] + spatial + [(0, 0)])
+    if kind == "max":
+        return lax.reduce_window(x, -jnp.inf, lax.max, window, strides, pad)
+    s = lax.reduce_window(x, 0.0, lax.add, window, strides, pad)
+    if mode == "same":
+        cnt = lax.reduce_window(jnp.ones_like(x), 0.0, lax.add, window,
+                                strides, pad)
+        return s / cnt
+    return s / (kd * kh * kw)
+
+
+@register("maxpool3d", category="cnn")
+def max_pool3d(x, kernel, stride=None, padding=0, mode="truncate",
+               data_format="NCDHW"):
+    return _pool3d(x, "max", kernel, stride or kernel, padding, mode,
+                   data_format)
+
+
+@register("avgpool3d", category="cnn")
+def avg_pool3d(x, kernel, stride=None, padding=0, mode="truncate",
+               data_format="NCDHW"):
+    return _pool3d(x, "avg", kernel, stride or kernel, padding, mode,
+                   data_format)
+
+
+@register("upsampling3d", category="cnn")
+def upsampling3d(x, size, data_format="NCDHW"):
+    sd_, sh, sw = _triple(size)
+    axes = (2, 3, 4) if data_format == "NCDHW" else (1, 2, 3)
+    y = jnp.repeat(x, sd_, axis=axes[0])
+    y = jnp.repeat(y, sh, axis=axes[1])
+    return jnp.repeat(y, sw, axis=axes[2])

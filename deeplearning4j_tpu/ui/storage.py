@@ -76,8 +76,18 @@ class FileStatsStorage(StatsStorage):
     def _read_all(self) -> List[dict]:
         if not os.path.exists(self.path):
             return []
+        # dashboard polls hit this every couple of seconds; re-parsing the
+        # whole JSONL per poll is O(training history) — cache on (size,
+        # mtime_ns) and parse only when the file grew
+        st = os.stat(self.path)
+        key = (st.st_size, st.st_mtime_ns)
+        cached = getattr(self, "_read_cache", None)
+        if cached is not None and cached[0] == key:
+            return cached[1]
         with open(self.path) as f:
-            return [json.loads(ln) for ln in f if ln.strip()]
+            records = [json.loads(ln) for ln in f if ln.strip()]
+        self._read_cache = (key, records)
+        return records
 
     def list_sessions(self):
         return sorted({r["session"] for r in self._read_all()})

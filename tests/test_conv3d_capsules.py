@@ -1,0 +1,163 @@
+"""3D conv family, CapsNet trio, SameDiff-layer bridge
+(SURVEY.md §2.4 layer catalog rows previously recorded as gaps)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.data.dataset import DataSet
+from deeplearning4j_tpu.nn.config import InputType, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.layers.base import layer
+from deeplearning4j_tpu.nn.layers.conv import GlobalPoolingLayer
+from deeplearning4j_tpu.nn.layers.conv3d import (CapsuleLayer,
+                                                 CapsuleStrengthLayer,
+                                                 Convolution3D,
+                                                 PrimaryCapsules,
+                                                 SameDiffLayer,
+                                                 Subsampling3DLayer,
+                                                 Upsampling3D)
+from deeplearning4j_tpu.nn.layers.core import (DenseLayer, FlattenLayer,
+                                               LossLayer, OutputLayer)
+from deeplearning4j_tpu.nn.model import MultiLayerNetwork
+from deeplearning4j_tpu.nn.updaters import Adam
+
+RNG = np.random.default_rng(0)
+
+
+def test_conv3d_oracle_vs_torch():
+    import torch
+    x = RNG.normal(size=(2, 3, 6, 7, 8)).astype(np.float32)
+    w = RNG.normal(size=(4, 3, 2, 3, 3)).astype(np.float32)
+    b = RNG.normal(size=(4,)).astype(np.float32)
+    from deeplearning4j_tpu.ops.nnops import avg_pool3d, conv3d, max_pool3d
+    ours = np.asarray(conv3d(jnp.asarray(x), jnp.asarray(w), jnp.asarray(b),
+                             stride=(1, 2, 1), padding=(1, 0, 1)))
+    ref = torch.nn.functional.conv3d(
+        torch.from_numpy(x), torch.from_numpy(w), torch.from_numpy(b),
+        stride=(1, 2, 1), padding=(1, 0, 1)).numpy()
+    np.testing.assert_allclose(ours, ref, rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(
+        np.asarray(max_pool3d(jnp.asarray(x), (2, 2, 2))),
+        torch.nn.functional.max_pool3d(torch.from_numpy(x), (2, 2, 2)).numpy(),
+        rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(avg_pool3d(jnp.asarray(x), (2, 2, 2))),
+        torch.nn.functional.avg_pool3d(torch.from_numpy(x), (2, 2, 2)).numpy(),
+        rtol=1e-5, atol=1e-5)
+
+
+def test_conv3d_network_trains():
+    conf = (NeuralNetConfiguration.builder().seed(0)
+            .updater(Adam(learning_rate=1e-3))
+            .input_type((1, 8, 8, 8))        # NCDHW without batch
+            .list(Convolution3D(n_out=4, kernel=(3, 3, 3), mode="same",
+                                activation="relu"),
+                  Subsampling3DLayer(kernel=(2, 2, 2)),
+                  Upsampling3D(size=(2, 2, 2)),
+                  Subsampling3DLayer(kernel=(2, 2, 2), pool_type="avg"),
+                  FlattenLayer(),
+                  OutputLayer(n_out=3))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    x = RNG.normal(size=(4, 1, 8, 8, 8)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[RNG.integers(0, 3, 4)]
+    net.fit(DataSet(x, y), epochs=2)
+    assert np.isfinite(float(net.score()))
+    # serde round-trip for the new kinds
+    from deeplearning4j_tpu.nn.config import MultiLayerConfiguration
+    js = conf.to_json()
+    assert MultiLayerConfiguration.from_json(js).to_json() == js
+
+
+def test_capsnet_trains_and_routing_is_normed():
+    conf = (NeuralNetConfiguration.builder().seed(0)
+            .updater(Adam(learning_rate=1e-3))
+            .input_type(InputType.convolutional(1, 12, 12,
+                                                data_format="NHWC"))
+            .list(PrimaryCapsules(capsule_dimensions=4, channels=3,
+                                  kernel=(5, 5), stride=(2, 2)),
+                  CapsuleLayer(capsules=5, capsule_dimensions=6, routings=2),
+                  CapsuleStrengthLayer(),
+                  LossLayer(loss="mse", activation="identity"))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    x = RNG.normal(size=(4, 12, 12, 1)).astype(np.float32)
+    out = net.output(x)
+    assert out.shape == (4, 5)
+    # capsule strengths are squashed norms -> in [0, 1)
+    assert (np.asarray(out) >= 0).all() and (np.asarray(out) < 1).all()
+    y = np.eye(5, dtype=np.float32)[RNG.integers(0, 5, 4)]
+    before = float(net.score(DataSet(x, y)))
+    net.fit(DataSet(x, y), epochs=8)
+    after = float(net.score(DataSet(x, y)))
+    assert after < before
+
+
+@layer("test_sd_dense")
+class _SdDense(SameDiffLayer):
+    """Test subclass: dense+tanh expressed as a SameDiff graph."""
+    n_in: int = 6
+    n_out: int = 4
+    name = None
+
+    def define_parameters(self):
+        return {"W": (self.n_in, self.n_out), "b": (1, self.n_out)}
+
+    def define_layer(self, sd, x, p):
+        return sd.tanh(x.mmul(p["W"]) + p["b"])
+
+    def output_shape(self, input_shape):
+        return input_shape[:-1] + (self.n_out,)
+
+
+def test_samediff_layer_bridge_in_network():
+    conf = (NeuralNetConfiguration.builder().seed(0)
+            .updater(Adam(learning_rate=0.05))
+            .input_type(InputType.feed_forward(6))
+            .list(_SdDense(n_in=6, n_out=4),
+                  OutputLayer(n_out=3))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    x = RNG.normal(size=(32, 6)).astype(np.float32)
+    # forward equals the hand-computed graph
+    W, b = (np.asarray(net.params["0"]["W"]), np.asarray(net.params["0"]["b"]))
+    h = np.tanh(x @ W + b)
+    Wo, bo = (np.asarray(net.params["1"]["W"]), np.asarray(net.params["1"]["b"]))
+    logits = h @ Wo + bo
+    probs = np.exp(logits - logits.max(-1, keepdims=True))
+    probs /= probs.sum(-1, keepdims=True)
+    np.testing.assert_allclose(np.asarray(net.output(x)), probs,
+                               rtol=1e-4, atol=1e-5)
+    # trains through the bridge (gradients flow into SameDiff params)
+    y = np.eye(3, dtype=np.float32)[(x.sum(-1) > 0).astype(int) + 1]
+    w0 = np.asarray(net.params["0"]["W"]).copy()
+    net.fit(DataSet(x, y), epochs=5)
+    assert np.abs(np.asarray(net.params["0"]["W"]) - w0).max() > 1e-5
+    assert np.isfinite(float(net.score()))
+
+
+def test_dilated_conv_shapes_agree_with_runtime():
+    """initialize() must account for dilation (regression: declared shapes
+    ignored it in 2D and 3D, crashing any dilated conv inside a net)."""
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.nn.layers.conv import ConvolutionLayer
+    l2d = ConvolutionLayer(n_out=2, kernel=(3, 3), dilation=(2, 2),
+                           data_format="NHWC")
+    p, _, declared = l2d.initialize(jax.random.PRNGKey(0), (8, 8, 3),
+                                    jnp.float32)
+    y, _, _ = l2d.apply(p, jnp.zeros((1, 8, 8, 3)), {})
+    assert tuple(y.shape[1:]) == tuple(declared)
+
+    l3d = Convolution3D(n_out=2, kernel=(3, 3, 3), dilation=(2, 2, 2))
+    p3, _, d3 = l3d.initialize(jax.random.PRNGKey(0), (1, 8, 8, 8),
+                               jnp.float32)
+    y3, _, _ = l3d.apply(p3, jnp.zeros((1, 1, 8, 8, 8)), {})
+    assert tuple(y3.shape[1:]) == tuple(d3)
+
+    # scalar kernel/stride forms accepted (regression: PrimaryCapsules)
+    pc = PrimaryCapsules(capsule_dimensions=4, channels=2, kernel=5, stride=2)
+    pp, _, out = pc.initialize(jax.random.PRNGKey(0), (12, 12, 1),
+                               jnp.float32)
+    yc, _, _ = pc.apply(pp, jnp.zeros((1, 12, 12, 1)), {})
+    assert tuple(yc.shape[1:]) == tuple(out)
