@@ -339,7 +339,22 @@ class SameDiff:
             new_vals = jax.tree.map(lambda p, d: p - d, train_vals, delta)
             return new_vals, new_opt, loss
 
-        step = jax.jit(step, donate_argnums=(0, 1))
+        # cache ONE compiled step across fit() calls — re-jitting a large
+        # imported graph per call costs seconds (found fine-tuning
+        # BERT-base). Keyed on the updater's CONFIG (hyperparameters are
+        # baked into the trace, so mutating them must retrace), and only the
+        # latest step is kept (old compiled executables for big graphs are
+        # device memory worth releasing).
+        import json as _json
+        spec = ("fit", loss_name,
+                _json.dumps(updater.to_dict(), sort_keys=True, default=str),
+                tuple(train_names))
+        cached = self._fn_cache.get("__fit_step__")
+        if cached is not None and cached[0] == spec:
+            step = cached[1]
+        else:
+            step = jax.jit(step, donate_argnums=(0, 1))
+            self._fn_cache["__fit_step__"] = (spec, step)
         train_vals = {n: self._values[n] for n in train_names}
         other_vals = {n: v for n, v in self._values.items()
                       if n not in train_names}
@@ -355,7 +370,8 @@ class SameDiff:
                 losses.append(float(loss))
                 i += 1
         self._values.update(train_vals)
-        self._fn_cache.clear()
+        # no cache clear: sessions/steps take values as ARGUMENTS, so the
+        # updated weights flow through; only graph mutation (call()) clears
         return losses
 
     # ------------------------------------------------------------ accessors

@@ -106,6 +106,7 @@ _UNARY = {"Relu": "act.relu", "Relu6": "act.relu6", "Elu": "act.elu",
           "Asin": "math.asin", "Acos": "math.acos", "Atan": "math.atan",
           "Reciprocal": "math.reciprocal", "Expm1": "math.expm1",
           "IsNan": "math.isnan", "IsInf": "math.isinf",
+          "Erfc": "math.erfc",
           "LogicalNot": "math.logical_not"}
 
 _BINARY = {"Add": "math.add", "AddV2": "math.add", "BiasAdd": "math.add",
@@ -144,12 +145,13 @@ def _einsum(node, ctx, ins):
                        attrs={"equation": _attr(node, "equation")})
 
 
-@tf_op("BatchMatMul", "BatchMatMulV2")
+@tf_op("BatchMatMul", "BatchMatMulV2", "BatchMatMulV3")
 def _batch_matmul(node, ctx, ins):
-    if _attr(node, "adj_x", False) or _attr(node, "adj_y", False):
-        raise ValueError("BatchMatMul adjoint not supported")
+    # adjoint == transpose for real tensors (our import surface is real)
     return ctx.sd.call("linalg.mmul", ctx.get(ins[0]), ctx.get(ins[1]),
-                       name=node.name)
+                       name=node.name,
+                       attrs={"transpose_a": bool(_attr(node, "adj_x", False)),
+                              "transpose_b": bool(_attr(node, "adj_y", False))})
 
 
 @tf_op("Conv2D")
@@ -319,6 +321,49 @@ def _leaky(node, ctx, ins):
                        attrs={"alpha": alpha})
 
 
+@tf_op("Fill")
+def _fill(node, ctx, ins):
+    dims = [int(d) for d in np.asarray(ctx.const_value(ins[0])).tolist()]
+    return ctx.sd.call("shape.broadcast_to", ctx.get(ins[1]),
+                       name=node.name, attrs={"shape": dims})
+
+
+@tf_op("Range")
+def _range(node, ctx, ins):
+    start = np.asarray(ctx.const_value(ins[0]))
+    limit = np.asarray(ctx.const_value(ins[1]))
+    delta = np.asarray(ctx.const_value(ins[2]))
+    value = np.arange(start, limit, delta)
+    ctx.consts[node.name] = value
+    return ctx.sd.constant(node.name, value)
+
+
+@tf_op("All", "Any")
+def _reduce_bool(node, ctx, ins):
+    # feeds Asserts in frozen graphs; map faithfully anyway. Lowered via
+    # reduce.min/max on the bool array (catalog has no reduce.all);
+    # min==True iff all True, max==True iff any True
+    axes = np.asarray(ctx.const_value(ins[1])).reshape(-1).tolist()
+    red = "reduce.min" if node.op == "All" else "reduce.max"
+    return ctx.sd.call(red, ctx.get(ins[0]), name=node.name,
+                       attrs={"axis": tuple(int(a) for a in axes),
+                              "keepdims": bool(_attr(node, "keep_dims",
+                                                     False))})
+
+
+@tf_op("Slice")
+def _slice(node, ctx, ins):
+    begin = [int(v) for v in np.asarray(ctx.const_value(ins[1])).tolist()]
+    size = [int(v) for v in np.asarray(ctx.const_value(ins[2])).tolist()]
+    end = [b + s if s != -1 else None for b, s in zip(begin, size)]
+    # lower to strided_slice with unit strides
+    return ctx.sd.call("shape.strided_slice", ctx.get(ins[0]),
+                       name=node.name,
+                       attrs={"begin": begin,
+                              "end": [e if e is not None else 2**31 - 1
+                                      for e in end]})
+
+
 @tf_op("OneHot")
 def _one_hot(node, ctx, ins):
     depth = int(np.asarray(ctx.const_value(ins[1])))
@@ -331,10 +376,15 @@ class TensorflowFrameworkImporter:
     ``TFGraphMapper.importGraph``†)."""
 
     @staticmethod
-    def import_graph_def(graph_def) -> SameDiff:
+    def import_graph_def(graph_def, trainable: bool = False) -> SameDiff:
         """Frozen GraphDef (proto object or serialized bytes) → SameDiff.
         Placeholders become SameDiff placeholders; run with
-        ``sd.output({placeholder: value}, [output_names])``."""
+        ``sd.output({placeholder: value}, [output_names])``.
+
+        ``trainable=True`` imports non-scalar FLOAT constants (the frozen
+        model's weights) as trainable VARIABLEs, so the imported graph
+        fine-tunes via ``sd.fit`` — the BERT-via-TF-import baseline path.
+        Scalar/int consts (shapes, axes, epsilons) stay constant."""
         if isinstance(graph_def, (bytes, bytearray)):
             from tensorflow.core.framework import graph_pb2  # type: ignore
             gd = graph_pb2.GraphDef()
@@ -348,12 +398,17 @@ class TensorflowFrameworkImporter:
             if node.op == "Const":
                 value = _tensor_value(node)
                 ctx.consts[node.name] = value
-                ctx.vars[node.name] = sd.constant(node.name, value)
+                if value.dtype == np.object_ or value.dtype.kind == "U":
+                    continue  # string consts (Assert messages): attr-only
+                if trainable and value.dtype.kind == "f" and value.ndim >= 1:
+                    ctx.vars[node.name] = sd.var(node.name, value)
+                else:
+                    ctx.vars[node.name] = sd.constant(node.name, value)
             elif node.op in ("Placeholder", "PlaceholderV2"):
                 shape = _attr_shape(node)
                 ctx.vars[node.name] = sd.placeholder(node.name, shape)
-            elif node.op == "NoOp":
-                continue
+            elif node.op in ("NoOp", "Assert"):
+                continue  # control-flow only; referenced via ^control deps
             elif node.op in _UNARY:
                 ctx.vars[node.name] = _map_unary(node, ctx, ins)
             elif node.op in _BINARY:

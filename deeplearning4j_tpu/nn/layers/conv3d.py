@@ -282,3 +282,143 @@ class SameDiffLayer(Layer):
         # ops, so this inlines into the surrounding jit program
         env = sd._compute({**params}, {"x": x})
         return env[out.name], state, mask
+
+
+@layer("deconv3d")
+class Deconvolution3D(Layer):
+    """DL4J Deconvolution3D (transposed 3D conv). W: [nOut, nIn, kD, kH, kW]."""
+    n_out: int = 0
+    kernel: Tuple[int, int, int] = (2, 2, 2)
+    stride: Tuple[int, int, int] = (1, 1, 1)
+    padding: Tuple[int, int, int] = (0, 0, 0)
+    dilation: Tuple[int, int, int] = (1, 1, 1)
+    mode: str = "truncate"
+    activation: str = "identity"
+    weight_init: str = "relu"
+    has_bias: bool = True
+    data_format: str = "NCDHW"
+    l1: float = 0.0
+    l2: float = 0.0
+    name: Optional[str] = None
+
+    def initialize(self, key, input_shape, dtype):
+        k = _triple(self.kernel)
+        s = _triple(self.stride)
+        p = _triple(self.padding)
+        d = _triple(self.dilation)
+        c_in = int(input_shape[0] if self.data_format == "NCDHW"
+                   else input_shape[-1])
+        fan_in = c_in * k[0] * k[1] * k[2]
+        w = _winit.init(self.weight_init, key,
+                        (self.n_out, c_in) + k, fan_in,
+                        self.n_out * k[0] * k[1] * k[2], dtype)
+        params = {"W": w}
+        if self.has_bias:
+            params["b"] = jnp.zeros((self.n_out,), dtype)
+        spatial = (tuple(int(v) for v in input_shape[1:])
+                   if self.data_format == "NCDHW"
+                   else tuple(int(v) for v in input_shape[:-1]))
+
+        def out_size(i):
+            if self.mode == "same":
+                return spatial[i] * s[i]
+            k_eff = (k[i] - 1) * d[i] + 1
+            return s[i] * (spatial[i] - 1) + k_eff - 2 * p[i]
+        out_sp = tuple(out_size(i) for i in range(3))
+        out = ((self.n_out,) + out_sp if self.data_format == "NCDHW"
+               else out_sp + (self.n_out,))
+        return params, {}, out
+
+    def apply(self, params, x, state, *, train=False, rng=None, mask=None):
+        y = nnops.deconv3d(x, params["W"], params.get("b"), self.stride,
+                           self.padding, self.dilation, self.mode,
+                           self.data_format)
+        return _act.get(self.activation)(y), state, mask
+
+
+@layer("zeropad3d")
+class ZeroPadding3DLayer(Layer):
+    """DL4J ZeroPadding3DLayer: symmetric (pd, ph, pw)."""
+    padding: Tuple[int, int, int] = (1, 1, 1)
+    data_format: str = "NCDHW"
+    name: Optional[str] = None
+
+    def has_params(self):
+        return False
+
+    def initialize(self, key, input_shape, dtype):
+        p = _triple(self.padding)
+        if self.data_format == "NCDHW":
+            c, d, h, w = (int(v) for v in input_shape)
+            out = (c, d + 2 * p[0], h + 2 * p[1], w + 2 * p[2])
+        else:
+            d, h, w, c = (int(v) for v in input_shape)
+            out = (d + 2 * p[0], h + 2 * p[1], w + 2 * p[2], c)
+        return {}, {}, out
+
+    def apply(self, params, x, state, *, train=False, rng=None, mask=None):
+        p = _triple(self.padding)
+        sp = [(pi, pi) for pi in p]
+        widths = ([(0, 0), (0, 0)] + sp if self.data_format == "NCDHW"
+                  else [(0, 0)] + sp + [(0, 0)])
+        return jnp.pad(x, widths), state, mask
+
+
+@layer("cropping3d")
+class Cropping3D(Layer):
+    """DL4J Cropping3D: symmetric (cd, ch, cw)."""
+    cropping: Tuple[int, int, int] = (1, 1, 1)
+    data_format: str = "NCDHW"
+    name: Optional[str] = None
+
+    def has_params(self):
+        return False
+
+    def initialize(self, key, input_shape, dtype):
+        c_ = _triple(self.cropping)
+        if self.data_format == "NCDHW":
+            c, d, h, w = (int(v) for v in input_shape)
+            out = (c, d - 2 * c_[0], h - 2 * c_[1], w - 2 * c_[2])
+        else:
+            d, h, w, c = (int(v) for v in input_shape)
+            out = (d - 2 * c_[0], h - 2 * c_[1], w - 2 * c_[2], c)
+        return {}, {}, out
+
+    def apply(self, params, x, state, *, train=False, rng=None, mask=None):
+        cd, ch, cw = _triple(self.cropping)
+        if self.data_format == "NCDHW":
+            y = x[:, :, cd:x.shape[2] - cd, ch:x.shape[3] - ch,
+                  cw:x.shape[4] - cw]
+        else:
+            y = x[:, cd:x.shape[1] - cd, ch:x.shape[2] - ch,
+                  cw:x.shape[3] - cw, :]
+        return y, state, mask
+
+
+@layer("space_to_batch")
+class SpaceToBatchLayer(Layer):
+    """DL4J SpaceToBatchLayer (2D): batch dim absorbs block_size^2."""
+    block_size: int = 2
+    padding: Tuple[int, int] = (0, 0)
+    data_format: str = "NCHW"
+    name: Optional[str] = None
+
+    def has_params(self):
+        return False
+
+    def initialize(self, key, input_shape, dtype):
+        bs = self.block_size
+        ph, pw = self.padding
+        if self.data_format == "NCHW":
+            c, h, w = (int(v) for v in input_shape)
+            out = (c, (h + 2 * ph) // bs, (w + 2 * pw) // bs)
+        else:
+            h, w, c = (int(v) for v in input_shape)
+            out = ((h + 2 * ph) // bs, (w + 2 * pw) // bs, c)
+        return {}, {}, out
+
+    def apply(self, params, x, state, *, train=False, rng=None, mask=None):
+        ph, pw = self.padding
+        y = nnops.space_to_batch(x, self.block_size,
+                                 ((ph, ph), (pw, pw)), self.data_format)
+        return y, state, None

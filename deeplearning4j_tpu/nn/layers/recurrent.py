@@ -86,9 +86,11 @@ class _RecurrentLayer(Layer):
     def init_stream_state(self, params, batch: int):
         raise NotImplementedError
 
-    def scan_with_state(self, params, x, carry, mask=None):
+    def scan_with_state(self, params, x, carry, mask=None, grad_path=True):
         """(y [B,T,u], final_carry) — used by apply() (zero carry) and by the
-        model's rnnTimeStep streaming (persisted carry)."""
+        model's rnnTimeStep streaming (persisted carry). ``grad_path=False``
+        marks calls that are never differentiated (inference/streaming),
+        letting layers pick forward-only fused kernels."""
         raise NotImplementedError
 
     def apply(self, params, x, state, *, train=False, rng=None, mask=None):
@@ -99,13 +101,19 @@ class _RecurrentLayer(Layer):
 
 @layer("lstm")
 class LSTM(_RecurrentLayer):
-    """Standard (non-peephole) LSTM (DL4J LSTM / LSTMBlock helper path)."""
+    """Standard (non-peephole) LSTM (DL4J LSTM / LSTMBlock helper path).
+
+    ``use_pallas_cell=True`` opts the INFERENCE/STREAMING paths (output(),
+    rnnTimeStep) into the fused Pallas cell (ops/pallas_kernels.py) when
+    running on TPU and the operands fit VMEM; training always uses the lax
+    cell (the Pallas kernel is forward-only — no custom VJP)."""
     n_out: int = 0
     n_in: Optional[int] = None
     activation: str = "tanh"            # DL4J exposes it; cell uses tanh
     forget_bias: float = 1.0            # DL4J LSTM forgetGateBiasInit default
     weight_init: str = "xavier"
     tbptt_length: Optional[int] = None  # stamped from conf by the builder
+    use_pallas_cell: bool = False
     l1: float = 0.0
     l2: float = 0.0
     name: Optional[str] = None
@@ -125,20 +133,38 @@ class LSTM(_RecurrentLayer):
         dt = params["W"].dtype
         return (jnp.zeros((batch, u), dt), jnp.zeros((batch, u), dt))
 
-    def scan_with_state(self, params, x, carry, mask=None):
+    def _cell(self, grad_path: bool):
+        if not grad_path and self.use_pallas_cell:
+            from ...ops import pallas_kernels as pk
+            return pk.lstm_cell_fused if pk.available() else nnops.lstm_cell
+        return nnops.lstm_cell
+
+    def scan_with_state(self, params, x, carry, mask=None, grad_path=True):
         w, rw, b = params["W"], params["RW"], params["b"]
         fb = self.forget_bias
+        cell = self._cell(grad_path)
+        if cell is not nnops.lstm_cell:
+            from ...ops import pallas_kernels as pk
+            if not pk.fits_vmem(x.shape[0], w.shape[0], rw.shape[0]):
+                cell = nnops.lstm_cell
 
         def step(carry, inp):
             x_t, m_t, _ = inp
             h, c = carry
-            h_new, c_new = nnops.lstm_cell(x_t, h, c, w, rw, b, forget_bias=fb)
+            h_new, c_new = cell(x_t, h, c, w, rw, b, forget_bias=fb)
             if m_t.shape[-1]:
                 h_new = _gate(m_t, h_new, h)
                 c_new = _gate(m_t, c_new, c)
             return (h_new, c_new), h_new
 
         return _scan_ret(step, carry, x, mask, self.tbptt_length)
+
+    def apply(self, params, x, state, *, train=False, rng=None, mask=None):
+        carry = self.init_stream_state(params, x.shape[0])
+        # train=True is the gradient path: the fused Pallas cell is
+        # forward-only, so it only serves inference/streaming
+        y, _ = self.scan_with_state(params, x, carry, mask, grad_path=train)
+        return y, state, mask
 
 
 @layer("graves_lstm")
@@ -170,7 +196,7 @@ class GravesLSTM(_RecurrentLayer):
         dt = params["W"].dtype
         return (jnp.zeros((batch, u), dt), jnp.zeros((batch, u), dt))
 
-    def scan_with_state(self, params, x, carry, mask=None):
+    def scan_with_state(self, params, x, carry, mask=None, grad_path=True):
         w, rw, pw, b = params["W"], params["RW"], params["PW"], params["b"]
 
         def step(carry, inp):
@@ -209,7 +235,7 @@ class SimpleRnn(_RecurrentLayer):
     def init_stream_state(self, params, batch):
         return (jnp.zeros((batch, params["RW"].shape[0]), params["W"].dtype),)
 
-    def scan_with_state(self, params, x, carry, mask=None):
+    def scan_with_state(self, params, x, carry, mask=None, grad_path=True):
         w, rw, b = params["W"], params["RW"], params["b"]
         act = _act.get(self.activation)
 
@@ -260,12 +286,14 @@ class Bidirectional(_RecurrentLayer):
         return (self.layer.init_stream_state(params["fw"], batch),
                 self.layer.init_stream_state(params["bw"], batch))
 
-    def scan_with_state(self, params, x, carry, mask=None):
-        y_fw, c_fw = self.layer.scan_with_state(params["fw"], x, carry[0], mask)
+    def scan_with_state(self, params, x, carry, mask=None, grad_path=True):
+        y_fw, c_fw = self.layer.scan_with_state(params["fw"], x, carry[0],
+                                                mask, grad_path=grad_path)
         x_rev = jnp.flip(x, axis=1)
         m_rev = None if mask is None else jnp.flip(mask, axis=1)
         y_bw, c_bw = self.layer.scan_with_state(params["bw"], x_rev,
-                                                carry[1], m_rev)
+                                                carry[1], m_rev,
+                                                grad_path=grad_path)
         y_bw = jnp.flip(y_bw, axis=1)
         if self.mode == "concat":
             y = jnp.concatenate([y_fw, y_bw], axis=-1)

@@ -57,9 +57,10 @@ class FrozenLayer(Layer):
     def init_stream_state(self, params, batch):
         return self.layer.init_stream_state(params, batch)
 
-    def scan_with_state(self, params, x, carry, mask=None):
+    def scan_with_state(self, params, x, carry, mask=None, grad_path=True):
         p = jax.tree.map(jax.lax.stop_gradient, params)
-        return self.layer.scan_with_state(p, x, carry, mask)
+        return self.layer.scan_with_state(p, x, carry, mask,
+                                          grad_path=grad_path)
 
     def loss_value(self, out, y, mask=None, weights=None):
         return self.layer.loss_value(out, y, mask=mask, weights=weights)

@@ -313,7 +313,8 @@ class MultiLayerNetwork:
                     carry = stream.get(si)
                     if carry is None:
                         carry = layer.init_stream_state(p, x.shape[0])
-                    x, carry = layer.scan_with_state(p, x, carry)
+                    x, carry = layer.scan_with_state(p, x, carry,
+                                                     grad_path=False)
                     new_stream[si] = carry
                 else:
                     x, _, _ = layer.apply(p, x, s, train=False, rng=None)

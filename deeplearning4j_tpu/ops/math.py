@@ -78,6 +78,7 @@ register("math.sign", category="transform", differentiable=False)(jnp.sign)
 register("math.reciprocal", category="transform")(jnp.reciprocal)
 register("math.rsqrt", category="transform")(lax.rsqrt)
 register("math.erf", category="transform")(jax.scipy.special.erf)
+register("math.erfc", category="transform")(jax.scipy.special.erfc)
 
 
 @register("math.clip", category="transform")

@@ -467,3 +467,67 @@ def upsampling3d(x, size, data_format="NCDHW"):
     y = jnp.repeat(x, sd_, axis=axes[0])
     y = jnp.repeat(y, sh, axis=axes[1])
     return jnp.repeat(y, sw, axis=axes[2])
+
+
+@register("deconv3d", category="cnn")
+def deconv3d(x, w, b=None, stride=(1, 1, 1), padding=0,
+             dilation=(1, 1, 1), mode="truncate", data_format="NCDHW"):
+    """Transposed 3D convolution (libnd4j ``deconv3d``). w: [O,I,kD,kH,kW];
+    out = (in-1)*s + k_eff - 2p per spatial dim (same padding mapping as
+    deconv2d — lax.conv_transpose explicit padding is additive)."""
+    stride, dilation = _triple(stride), _triple(dilation)
+    kd, kh, kw = w.shape[2], w.shape[3], w.shape[4]
+    io = "NCDHW" if data_format == "NCDHW" else "NDHWC"
+    dn = lax.conv_dimension_numbers(
+        x.shape, (w.shape[1], w.shape[0], kd, kh, kw), (io, "OIDHW", io))
+    if mode == "same":
+        pad = "SAME"
+    else:
+        p = _triple(padding)
+        k_eff = tuple((k - 1) * d + 1 for k, d in zip((kd, kh, kw), dilation))
+        pad = [(k_eff[i] - 1 - p[i], k_eff[i] - 1 - p[i]) for i in range(3)]
+    y = lax.conv_transpose(
+        x, jnp.swapaxes(w, 0, 1), strides=stride, padding=pad,
+        rhs_dilation=dilation, dimension_numbers=dn, transpose_kernel=True,
+        precision=precision_for(x, w))
+    if b is not None:
+        shape = (1, -1, 1, 1, 1) if data_format == "NCDHW" else (1, 1, 1, 1, -1)
+        y = y + b.reshape(shape)
+    return y
+
+
+@register("space_to_batch", category="cnn")
+def space_to_batch(x, block_size, paddings=((0, 0), (0, 0)),
+                   data_format="NCHW"):
+    """TF-style space_to_batch for 2D inputs (libnd4j ``space_to_batch``)."""
+    bs = block_size if isinstance(block_size, (tuple, list)) else (block_size,) * 2
+    if data_format == "NCHW":
+        x = jnp.transpose(x, (0, 2, 3, 1))
+    B, H, W, C = x.shape
+    x = jnp.pad(x, [(0, 0), tuple(paddings[0]), tuple(paddings[1]), (0, 0)])
+    Hp, Wp = x.shape[1], x.shape[2]
+    x = x.reshape(B, Hp // bs[0], bs[0], Wp // bs[1], bs[1], C)
+    x = jnp.transpose(x, (2, 4, 0, 1, 3, 5))
+    x = x.reshape(B * bs[0] * bs[1], Hp // bs[0], Wp // bs[1], C)
+    if data_format == "NCHW":
+        x = jnp.transpose(x, (0, 3, 1, 2))
+    return x
+
+
+@register("batch_to_space", category="cnn")
+def batch_to_space(x, block_size, crops=((0, 0), (0, 0)),
+                   data_format="NCHW"):
+    """Inverse of space_to_batch."""
+    bs = block_size if isinstance(block_size, (tuple, list)) else (block_size,) * 2
+    if data_format == "NCHW":
+        x = jnp.transpose(x, (0, 2, 3, 1))
+    Bb, H, W, C = x.shape
+    B = Bb // (bs[0] * bs[1])
+    x = x.reshape(bs[0], bs[1], B, H, W, C)
+    x = jnp.transpose(x, (2, 3, 0, 4, 1, 5))
+    x = x.reshape(B, H * bs[0], W * bs[1], C)
+    (ct, cb), (cl, cr) = crops
+    x = x[:, ct:x.shape[1] - cb, cl:x.shape[2] - cr, :]
+    if data_format == "NCHW":
+        x = jnp.transpose(x, (0, 3, 1, 2))
+    return x

@@ -1,0 +1,90 @@
+"""Pallas TPU kernels for hot ops.
+
+The SURVEY.md §7.2 M5 note ("+Pallas fused cell if needed for perf") and
+§7.3 item 3 flag the LSTM cell as the op worth hand-fusing: per scan step
+the lax path emits two matmuls plus a chain of elementwise gate ops, and
+although XLA fuses most of the chain, the fused kernel keeps gates, state
+update, and both matmuls in VMEM with one HBM round-trip per step.
+
+Kernel strategy: single-block (whole operands in VMEM) — LSTM step
+operands are [B,F]/[F,4U] sized, far under the ~16 MB VMEM budget for any
+practical cell; ``fits_vmem`` guards the dispatch and callers fall back to
+``nnops.lstm_cell`` above the budget or off-TPU. Forward-only: the scan
+layers call this under ``jax.checkpoint``-free inference/streaming paths;
+training keeps the lax cell (custom VJP for the kernel is not worth the
+maintenance while XLA's fused backward is this close).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_VMEM_BUDGET = 8 * 1024 * 1024  # conservative half of ~16MB VMEM
+
+
+def available() -> bool:
+    """Pallas TPU lowering available on the default backend?"""
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:
+        return False
+
+
+def fits_vmem(batch: int, n_in: int, units: int, bytes_per: int = 4) -> bool:
+    total = (batch * n_in + batch * units * 2      # x, h, c
+             + n_in * 4 * units + units * 4 * units  # W, RW
+             + 4 * units                            # b
+             + batch * 4 * units                    # z scratch
+             + batch * units * 2) * bytes_per       # outputs
+    return total < _VMEM_BUDGET
+
+
+def _lstm_kernel(forget_bias, x_ref, h_ref, c_ref, w_ref, rw_ref, b_ref,
+                 h_out, c_out):
+    z = (jnp.dot(x_ref[:], w_ref[:], preferred_element_type=jnp.float32)
+         + jnp.dot(h_ref[:], rw_ref[:], preferred_element_type=jnp.float32)
+         + b_ref[:])
+    u = z.shape[-1] // 4
+    i = jax.nn.sigmoid(z[:, :u])
+    f = jax.nn.sigmoid(z[:, u:2 * u] + forget_bias)
+    o = jax.nn.sigmoid(z[:, 2 * u:3 * u])
+    g = jnp.tanh(z[:, 3 * u:])
+    c_new = f * c_ref[:].astype(jnp.float32) + i * g
+    h_new = o * jnp.tanh(c_new)
+    h_out[:] = h_new.astype(h_out.dtype)
+    c_out[:] = c_new.astype(c_out.dtype)
+
+
+def lstm_cell_fused(x, h, c, w_ih, w_hh, b, forget_bias: float = 0.0,
+                    interpret: bool = False):
+    """Fused LSTM step (gate order [i,f,o,g], matching nnops.lstm_cell).
+
+    All operands land in VMEM; both matmuls accumulate f32 on the MXU and
+    the whole gate chain runs before anything returns to HBM. Raises
+    ValueError when the operands exceed the VMEM budget — callers guard
+    with :func:`fits_vmem` and fall back to the lax cell.
+    """
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, F = x.shape
+    U = w_hh.shape[0]
+    if not fits_vmem(B, F, U, np.dtype(x.dtype).itemsize):
+        raise ValueError(
+            f"lstm_cell_fused operands exceed the VMEM budget "
+            f"(B={B}, F={F}, U={U}); use nnops.lstm_cell")
+    kernel = functools.partial(_lstm_kernel, float(forget_bias))
+    spec = pl.BlockSpec(memory_space=pltpu.ANY if interpret else pltpu.VMEM)
+    h_new, c_new = pl.pallas_call(
+        kernel,
+        out_shape=(jax.ShapeDtypeStruct((B, U), x.dtype),
+                   jax.ShapeDtypeStruct((B, U), x.dtype)),
+        in_specs=[spec] * 6,
+        out_specs=(spec, spec),
+        interpret=interpret,
+    )(x, h, c, w_ih, w_hh, b)
+    return h_new, c_new

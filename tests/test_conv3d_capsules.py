@@ -161,3 +161,40 @@ def test_dilated_conv_shapes_agree_with_runtime():
                                jnp.float32)
     yc, _, _ = pc.apply(pp, jnp.zeros((1, 12, 12, 1)), {})
     assert tuple(yc.shape[1:]) == tuple(out)
+
+
+def test_deconv3d_zeropad_crop_space_to_batch_layers():
+    from deeplearning4j_tpu.nn.layers.conv3d import (Cropping3D,
+                                                     Deconvolution3D,
+                                                     SpaceToBatchLayer,
+                                                     ZeroPadding3DLayer)
+    x = jnp.asarray(RNG.normal(size=(2, 3, 4, 4, 4)), jnp.float32)
+    dc = Deconvolution3D(n_out=5, kernel=(2, 2, 2), stride=(2, 2, 2))
+    p, _, declared = dc.initialize(jax.random.PRNGKey(0), (3, 4, 4, 4),
+                                   jnp.float32)
+    y, _, _ = dc.apply(p, x, {})
+    assert tuple(y.shape[1:]) == tuple(declared) == (5, 8, 8, 8)
+
+    zp = ZeroPadding3DLayer(padding=(1, 0, 2))
+    yz, _, _ = zp.apply({}, x, {})
+    assert yz.shape == (2, 3, 6, 4, 8)
+    cr = Cropping3D(cropping=(1, 1, 0))
+    yc, _, _ = cr.apply({}, x, {})
+    assert yc.shape == (2, 3, 2, 2, 4)
+
+    img = jnp.asarray(RNG.normal(size=(2, 3, 6, 6)), jnp.float32)
+    s2b = SpaceToBatchLayer(block_size=2)
+    ys, _, _ = s2b.apply({}, img, {})
+    assert ys.shape == (8, 3, 3, 3)
+
+
+def test_emnist_iterator_shapes_and_splits():
+    from deeplearning4j_tpu.data.emnist import EmnistDataSetIterator
+    it = EmnistDataSetIterator("balanced", batch_size=16, num_examples=64)
+    assert it.source in ("idx", "synthetic")
+    assert len(it.labels) == 47
+    ds = next(iter(it))
+    assert ds.features.shape == (16, 1, 28, 28)
+    assert ds.labels.shape == (16, 47)
+    with pytest.raises(ValueError, match="unknown EMNIST split"):
+        EmnistDataSetIterator("nope")

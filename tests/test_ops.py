@@ -457,3 +457,31 @@ def test_bf16_conv_net_trains(rng):
     y = np.eye(4, dtype=np.float32)[rng.integers(0, 4, 16)]
     net.fit(DataSet(x, y), epochs=5)
     assert np.isfinite(float(net.score()))
+
+
+def test_pallas_lstm_cell_matches_lax(rng):
+    """Fused Pallas LSTM cell == lax cell (interpret mode on the CPU mesh;
+    the real-TPU path is exercised by the bench/verify drives)."""
+    from deeplearning4j_tpu.ops import pallas_kernels as pk
+
+    B, F, U = 8, 12, 16
+    x = jnp.asarray(rng.normal(size=(B, F)).astype(np.float32))
+    h = jnp.asarray(rng.normal(size=(B, U)).astype(np.float32))
+    c = jnp.asarray(rng.normal(size=(B, U)).astype(np.float32))
+    w = jnp.asarray(rng.normal(0, 0.1, size=(F, 4 * U)).astype(np.float32))
+    rw = jnp.asarray(rng.normal(0, 0.1, size=(U, 4 * U)).astype(np.float32))
+    b = jnp.asarray(rng.normal(size=(4 * U,)).astype(np.float32))
+    ref_h, ref_c = nnops.lstm_cell(x, h, c, w, rw, b, forget_bias=1.0)
+    got_h, got_c = pk.lstm_cell_fused(x, h, c, w, rw, b, forget_bias=1.0,
+                                      interpret=True)
+    np.testing.assert_allclose(np.asarray(got_h), np.asarray(ref_h),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(got_c), np.asarray(ref_c),
+                               rtol=1e-5, atol=1e-5)
+    assert not pk.fits_vmem(512, 512, 512)  # budget guard engages
+    with pytest.raises(ValueError, match="VMEM budget"):
+        pk.lstm_cell_fused(jnp.zeros((512, 512)), jnp.zeros((512, 512)),
+                           jnp.zeros((512, 512)),
+                           jnp.zeros((512, 4 * 512)),
+                           jnp.zeros((512, 4 * 512)),
+                           jnp.zeros((4 * 512,)))

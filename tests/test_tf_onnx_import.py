@@ -239,3 +239,62 @@ def test_onnx_asymmetric_pool_pads_loud():
         a = n.attribute.add(); a.name = name; a.type = 7; a.ints.extend(ints)
     with pytest.raises(ValueError, match="asymmetric"):
         OnnxFrameworkImporter.import_model_proto(m.SerializeToString())
+
+
+def test_bert_via_tf_import_matches_and_finetunes():
+    """The BASELINE.md row 'BERT-base via TF-import path trains': a (shrunk)
+    HF TFBert freezes -> imports -> matches TF outputs -> fine-tunes with a
+    classification head through sd.fit (weights imported as VARIABLEs)."""
+    import os
+    os.environ["TRANSFORMERS_OFFLINE"] = "1"
+    transformers = pytest.importorskip("transformers")
+    from transformers import BertConfig, TFBertModel
+    from tensorflow.python.framework.convert_to_constants import (
+        convert_variables_to_constants_v2)
+
+    cfg = BertConfig(vocab_size=200, hidden_size=32, num_hidden_layers=2,
+                     num_attention_heads=2, intermediate_size=64,
+                     max_position_embeddings=32)
+    m = TFBertModel(cfg)
+
+    @tf.function
+    def f(ids):
+        return m(ids).last_hidden_state
+
+    conc = f.get_concrete_function(tf.TensorSpec([4, 8], tf.int32))
+    frozen = convert_variables_to_constants_v2(conc)
+    gd = frozen.graph.as_graph_def()
+    iname = frozen.inputs[0].name.split(":")[0]
+    oname = frozen.outputs[0].name.split(":")[0]
+
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, 200, (4, 8)).astype(np.int32)
+    ref = f(tf.constant(ids)).numpy()
+
+    # inference import: numeric parity with TF
+    from deeplearning4j_tpu.modelimport.tensorflow import (
+        TensorflowFrameworkImporter)
+    sd = TensorflowFrameworkImporter.import_graph_def(gd)
+    got = np.asarray(sd.output({iname: ids}, [oname])[oname])
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-4)
+
+    # trainable import: attach a mean-pool + dense + softmax-CE head in
+    # SameDiff ops and fine-tune — loss must decrease
+    from deeplearning4j_tpu.autodiff.samediff import VARIABLE
+    from deeplearning4j_tpu.nn.updaters import Adam
+    sdt = TensorflowFrameworkImporter.import_graph_def(gd, trainable=True)
+    n_vars = sum(1 for v in sdt._vars.values() if v.kind == VARIABLE)
+    assert n_vars > 20  # the transformer weights became trainable
+
+    hidden = sdt._vars[oname]
+    pooled = hidden.mean(axis=1)                      # [B, H]
+    w = sdt.var("cls_W", rng.normal(0, 0.05, (32, 2)).astype(np.float32))
+    b = sdt.var("cls_b", np.zeros((2,), np.float32))
+    logits = pooled.mmul(w) + b
+    labels = sdt.placeholder("labels")
+    loss = sdt.call("loss.softmax_ce_logits", labels, logits)
+    sdt.set_loss(loss).set_updater(Adam(learning_rate=5e-4))
+
+    y = np.eye(2, dtype=np.float32)[(ids.sum(axis=1) % 2)]
+    losses = sdt.fit({iname: ids, "labels": y}, epochs=25)
+    assert losses[-1] < losses[0] * 0.9, (losses[0], losses[-1])
