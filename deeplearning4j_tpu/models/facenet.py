@@ -1,0 +1,211 @@
+"""Face-embedding zoo models: InceptionResNetV1 and FaceNetNN4Small2.
+
+TPU-native equivalents of the reference zoo (reference:
+``deeplearning4j-zoo .../zoo/model/{InceptionResNetV1,FaceNetNN4Small2}.java``
++ ``FaceNetHelper``† per SURVEY.md §2.5; reference mount was empty,
+citations upstream-relative, unverified).
+
+Both are ComputationGraphs ending in an L2-normalized embedding with a
+center-loss classification head — the FaceNet training recipe the
+reference ships. NHWC throughout; ``blocks35/17/8`` counts are
+parameters so tests can shrink the middle flows (defaults faithful:
+5/10/5 and the NN4-small2 module table).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+from ..nn.config import InputType, NeuralNetConfiguration
+from ..nn.graph import ComputationGraph
+from ..nn.layers.conv import (BatchNormalization, ConvolutionLayer,
+                              GlobalPoolingLayer, SubsamplingLayer)
+from ..nn.layers.core import ActivationLayer, DenseLayer, DropoutLayer
+from ..nn.layers.special import CenterLossOutputLayer
+from ..nn.updaters import Adam
+from ..nn.vertices import ElementWiseVertex, L2NormalizeVertex, MergeVertex, ScaleVertex
+
+NHWC = "NHWC"
+
+
+def _conv(g, name, inp, n, kernel, stride=1, act="relu", bn=True):
+    k = kernel if isinstance(kernel, tuple) else (kernel, kernel)
+    g.add_layer(f"{name}_c", ConvolutionLayer(
+        n_out=n, kernel=k, stride=(stride, stride), mode="same",
+        activation="identity" if bn else act, has_bias=not bn,
+        data_format=NHWC), inp)
+    if not bn:
+        return f"{name}_c"
+    g.add_layer(f"{name}_bn", BatchNormalization(data_format=NHWC),
+                f"{name}_c")
+    if act == "identity":
+        return f"{name}_bn"
+    g.add_layer(f"{name}_a", ActivationLayer(activation=act), f"{name}_bn")
+    return f"{name}_a"
+
+
+def _pool(g, name, inp, k=3, s=2, kind="max"):
+    g.add_layer(name, SubsamplingLayer(kernel=(k, k), stride=(s, s),
+                                       pool_type=kind, mode="same",
+                                       data_format=NHWC), inp)
+    return name
+
+
+def inception_resnet_v1(num_classes: int = 1000, embedding_size: int = 128,
+                        input_shape: Tuple[int, int, int] = (160, 160, 3),
+                        blocks35: int = 5, blocks17: int = 10,
+                        blocks8: int = 5, seed: int = 42,
+                        updater=None) -> ComputationGraph:
+    """InceptionResNetV1 (the FaceNet backbone): stem → scaled residual
+    inception blocks (A/B/C) with reductions → L2 embedding →
+    center-loss head."""
+    h, w, c = input_shape
+    gb = (NeuralNetConfiguration.builder().seed(seed)
+          .updater(updater or Adam(learning_rate=1e-3))
+          .graph_builder()
+          .add_inputs("in")
+          .set_input_types(InputType.convolutional(c, h, w, NHWC)))
+
+    top = _conv(gb, "stem1", "in", 32, 3, stride=2)
+    top = _conv(gb, "stem2", top, 32, 3)
+    top = _conv(gb, "stem3", top, 64, 3)
+    top = _pool(gb, "stem_pool", top)
+    top = _conv(gb, "stem4", top, 80, 1)
+    top = _conv(gb, "stem5", top, 192, 3)
+    top = _conv(gb, "stem6", top, 256, 3, stride=2)
+
+    def resnet_block(name, inp, branches, up_channels, scale):
+        """Scaled-residual inception block: branches -> concat -> linear 1x1
+        up-conv -> scale -> add residual -> relu (shared by blocks A/B/C)."""
+        outs = [builder(f"{name}_b{k}", inp)
+                for k, builder in enumerate(branches)]
+        gb.add_vertex(f"{name}_cat", MergeVertex(data_format=NHWC), *outs)
+        up = _conv(gb, f"{name}_up", f"{name}_cat", up_channels, 1,
+                   act="identity", bn=False)
+        gb.add_vertex(f"{name}_scale", ScaleVertex(scale=scale), up)
+        gb.add_vertex(f"{name}_add", ElementWiseVertex(op="add"),
+                      inp, f"{name}_scale")
+        gb.add_layer(f"{name}_relu", ActivationLayer(activation="relu"),
+                     f"{name}_add")
+        return f"{name}_relu"
+
+    def block35(name, inp):  # Inception-ResNet-A @ 256ch
+        return resnet_block(name, inp, [
+            lambda n, i: _conv(gb, n, i, 32, 1),
+            lambda n, i: _conv(gb, f"{n}b", _conv(gb, f"{n}a", i, 32, 1),
+                               32, 3),
+            lambda n, i: _conv(gb, f"{n}c", _conv(gb, f"{n}b",
+                               _conv(gb, f"{n}a", i, 32, 1), 32, 3), 32, 3),
+        ], 256, 0.17)
+
+    for i in range(blocks35):
+        top = block35(f"a{i}", top)
+
+    # reduction-A -> 896ch
+    ra0 = _conv(gb, "ra0", top, 384, 3, stride=2)
+    ra1 = _conv(gb, "ra1c", _conv(gb, "ra1b", _conv(gb, "ra1a", top, 192, 1),
+                                  192, 3), 256, 3, stride=2)
+    ra2 = _pool(gb, "ra_pool", top)
+    gb.add_vertex("ra_cat", MergeVertex(data_format=NHWC), ra0, ra1, ra2)
+    top = "ra_cat"
+
+    def block17(name, inp):  # Inception-ResNet-B @ 896ch
+        return resnet_block(name, inp, [
+            lambda n, i: _conv(gb, n, i, 128, 1),
+            lambda n, i: _conv(gb, f"{n}c", _conv(gb, f"{n}b",
+                               _conv(gb, f"{n}a", i, 128, 1), 128, (1, 7)),
+                               128, (7, 1)),
+        ], 896, 0.10)
+
+    for i in range(blocks17):
+        top = block17(f"b{i}", top)
+
+    # reduction-B -> 1792ch
+    rb0 = _conv(gb, "rb0b", _conv(gb, "rb0a", top, 256, 1), 384, 3, stride=2)
+    rb1 = _conv(gb, "rb1b", _conv(gb, "rb1a", top, 256, 1), 256, 3, stride=2)
+    rb2 = _conv(gb, "rb2c", _conv(gb, "rb2b", _conv(gb, "rb2a", top, 256, 1),
+                                  256, 3), 256, 3, stride=2)
+    rb3 = _pool(gb, "rb_pool", top)
+    gb.add_vertex("rb_cat", MergeVertex(data_format=NHWC),
+                  rb0, rb1, rb2, rb3)
+    top = "rb_cat"
+
+    def block8(name, inp):  # Inception-ResNet-C @ 1792ch
+        return resnet_block(name, inp, [
+            lambda n, i: _conv(gb, n, i, 192, 1),
+            lambda n, i: _conv(gb, f"{n}c", _conv(gb, f"{n}b",
+                               _conv(gb, f"{n}a", i, 192, 1), 192, (1, 3)),
+                               192, (3, 1)),
+        ], 1792, 0.20)
+
+    for i in range(blocks8):
+        top = block8(f"c{i}", top)
+
+    gb.add_layer("gap", GlobalPoolingLayer(pool_type="avg",
+                                           data_format=NHWC), top)
+    gb.add_layer("drop", DropoutLayer(rate=0.2), "gap")
+    gb.add_layer("bottleneck", DenseLayer(n_out=embedding_size,
+                                          activation="identity"), "drop")
+    gb.add_vertex("embeddings", L2NormalizeVertex(), "bottleneck")
+    gb.add_layer("out", CenterLossOutputLayer(n_out=num_classes,
+                                              lambda_=2e-4), "embeddings")
+    gb.set_outputs("out")
+    return ComputationGraph(gb.build())
+
+
+def facenet_nn4_small2(num_classes: int = 1000, embedding_size: int = 128,
+                       input_shape: Tuple[int, int, int] = (96, 96, 3),
+                       seed: int = 42, updater=None) -> ComputationGraph:
+    """FaceNetNN4Small2: the NN4 "small2" GoogLeNet-style inception net
+    with an L2 embedding + center-loss head (zoo FaceNetNN4Small2.java†,
+    module widths per the NN4-small2 table)."""
+    h, w, c = input_shape
+    gb = (NeuralNetConfiguration.builder().seed(seed)
+          .updater(updater or Adam(learning_rate=1e-3))
+          .graph_builder()
+          .add_inputs("in")
+          .set_input_types(InputType.convolutional(c, h, w, NHWC)))
+
+    top = _conv(gb, "c1", "in", 64, 7, stride=2)
+    top = _pool(gb, "p1", top)
+    top = _conv(gb, "c2", top, 64, 1)
+    top = _conv(gb, "c3", top, 192, 3)
+    top = _pool(gb, "p2", top)
+
+    def inception(name, inp, o1, r3, o3, r5, o5, pool_proj, pool_stride=1):
+        branches = []
+        if o1:
+            branches.append(_conv(gb, f"{name}_1", inp, o1, 1))
+        b3 = _conv(gb, f"{name}_3r", inp, r3, 1)
+        branches.append(_conv(gb, f"{name}_3", b3, o3, 3,
+                              stride=pool_stride))
+        if o5:
+            b5 = _conv(gb, f"{name}_5r", inp, r5, 1)
+            branches.append(_conv(gb, f"{name}_5", b5, o5, 5,
+                                  stride=pool_stride))
+        p = _pool(gb, f"{name}_p", inp, 3, pool_stride)
+        if pool_proj:
+            branches.append(_conv(gb, f"{name}_pp", p, pool_proj, 1))
+        else:
+            branches.append(p)
+        gb.add_vertex(f"{name}_cat", MergeVertex(data_format=NHWC),
+                      *branches)
+        return f"{name}_cat"
+
+    top = inception("i3a", top, 64, 96, 128, 16, 32, 32)
+    top = inception("i3b", top, 64, 96, 128, 32, 64, 64)
+    top = inception("i3c", top, 0, 128, 256, 32, 64, 0, pool_stride=2)
+    top = inception("i4a", top, 256, 96, 192, 32, 64, 128)
+    top = inception("i4e", top, 0, 160, 256, 64, 128, 0, pool_stride=2)
+    top = inception("i5a", top, 256, 96, 384, 0, 0, 96)
+    top = inception("i5b", top, 256, 96, 384, 0, 0, 96)
+
+    gb.add_layer("gap", GlobalPoolingLayer(pool_type="avg",
+                                           data_format=NHWC), top)
+    gb.add_layer("bottleneck", DenseLayer(n_out=embedding_size,
+                                          activation="identity"), "gap")
+    gb.add_vertex("embeddings", L2NormalizeVertex(), "bottleneck")
+    gb.add_layer("out", CenterLossOutputLayer(n_out=num_classes,
+                                              lambda_=2e-4), "embeddings")
+    gb.set_outputs("out")
+    return ComputationGraph(gb.build())

@@ -240,7 +240,10 @@ class ComputationGraph:
         for o in conf.outputs:
             v = self._vertex_map[o][0]
             lyr = v.layer if isinstance(v, LayerVertex) else None
-            if isinstance(lyr, (OutputLayer, LossLayer)):
+            # duck-typed loss heads (OutputLayer, LossLayer, CenterLoss,
+            # Yolo2Output, custom) — same probe as the sequential engine
+            from .model import _is_loss_head
+            if lyr is not None and _is_loss_head(lyr):
                 self._out_layers[o] = lyr
 
     # ------------------------------------------------------------------ init
@@ -378,9 +381,26 @@ class ComputationGraph:
                     layer = out_layers[o]
                     # intersect explicit label mask with the propagated mask
                     m = _loss.combine_masks(lm, mks.get(o))
-                    total = total + layer.loss_value(
-                        acts[o], y, mask=m,
-                        weights=getattr(layer, "loss_weights", None))
+                    if hasattr(layer, "update_centers"):
+                        # CenterLossOutputLayer: pull the stashed features
+                        # out of the aux state channel (must not persist),
+                        # EMA-update centers outside the gradient
+                        st = dict(new_bn[o])
+                        feats = st.pop("__features__")
+                        centers = bn_state[o]["centers"]
+                        st["centers"] = jax.lax.stop_gradient(
+                            layer.update_centers(
+                                centers, jax.lax.stop_gradient(feats), y))
+                        new_bn = {**new_bn, o: st}
+                        total = total + layer.loss_value(
+                            acts[o], y, mask=m,
+                            weights=getattr(layer, "loss_weights", None),
+                            features=feats,
+                            centers=jax.lax.stop_gradient(centers))
+                    else:
+                        total = total + layer.loss_value(
+                            acts[o], y, mask=m,
+                            weights=getattr(layer, "loss_weights", None))
                 return total + self._regularization(p), new_bn
 
             (loss, new_bn), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
@@ -459,7 +479,7 @@ class ComputationGraph:
             return self._score
         mds = data if isinstance(data, MultiDataSet) else \
             MultiDataSet.from_dataset(data)
-        acts, _, mks = self._forward(
+        acts, new_bn, mks = self._forward(
             self.params,
             {n: jnp.asarray(f) for n, f in zip(self.conf.inputs, mds.features)},
             self.state, train=True, rng=None,
@@ -471,7 +491,15 @@ class ComputationGraph:
             layer = self._out_layers[o]
             m = _loss.combine_masks(
                 None if lm is None else jnp.asarray(lm), mks.get(o))
-            total = total + layer.loss_value(acts[o], jnp.asarray(y), mask=m)
+            if hasattr(layer, "update_centers"):
+                # same quantity as the fit loop: CE + center penalty
+                total = total + layer.loss_value(
+                    acts[o], jnp.asarray(y), mask=m,
+                    features=new_bn[o]["__features__"],
+                    centers=self.state[o]["centers"])
+            else:
+                total = total + layer.loss_value(acts[o], jnp.asarray(y),
+                                                 mask=m)
         return float(total + self._regularization(self.params))
 
     def evaluate(self, data, labels=None, output: int = 0):

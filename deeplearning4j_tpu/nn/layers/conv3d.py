@@ -411,10 +411,15 @@ class SpaceToBatchLayer(Layer):
         ph, pw = self.padding
         if self.data_format == "NCHW":
             c, h, w = (int(v) for v in input_shape)
-            out = (c, (h + 2 * ph) // bs, (w + 2 * pw) // bs)
         else:
             h, w, c = (int(v) for v in input_shape)
-            out = ((h + 2 * ph) // bs, (w + 2 * pw) // bs, c)
+        if (h + 2 * ph) % bs or (w + 2 * pw) % bs:
+            raise ValueError(
+                f"SpaceToBatch: padded spatial dims ({h + 2 * ph}, "
+                f"{w + 2 * pw}) must be divisible by block_size={bs}")
+        out_sp = ((h + 2 * ph) // bs, (w + 2 * pw) // bs)
+        out = ((c,) + out_sp if self.data_format == "NCHW"
+               else out_sp + (c,))
         return {}, {}, out
 
     def apply(self, params, x, state, *, train=False, rng=None, mask=None):

@@ -78,7 +78,7 @@ def lstm_cell_fused(x, h, c, w_ih, w_hh, b, forget_bias: float = 0.0,
             f"lstm_cell_fused operands exceed the VMEM budget "
             f"(B={B}, F={F}, U={U}); use nnops.lstm_cell")
     kernel = functools.partial(_lstm_kernel, float(forget_bias))
-    spec = pl.BlockSpec(memory_space=pltpu.ANY if interpret else pltpu.VMEM)
+    spec = pl.BlockSpec(memory_space=pl.ANY if interpret else pltpu.VMEM)
     h_new, c_new = pl.pallas_call(
         kernel,
         out_shape=(jax.ShapeDtypeStruct((B, U), x.dtype),

@@ -106,3 +106,71 @@ def test_text_generation_lstm():
     assert np.isfinite(float(net.score()))
     out = net.output(x)
     assert out.shape == (3, 9, 12)
+
+
+def test_inception_resnet_v1():
+    from deeplearning4j_tpu.models import inception_resnet_v1
+    net = inception_resnet_v1(num_classes=5, embedding_size=32,
+                              input_shape=(64, 64, 3), blocks35=1,
+                              blocks17=1, blocks8=1,
+                              updater=Sgd(learning_rate=1e-3))
+    net.init()
+    x = RNG.normal(size=(2, 64, 64, 3)).astype(np.float32)
+    emb = net.output(x)          # center-loss head emits class probs at eval
+    assert emb.shape == (2, 5)
+    y = np.eye(5, dtype=np.float32)[RNG.integers(0, 5, 2)]
+    net.fit(DataSet(x, y), epochs=1)
+    assert np.isfinite(float(net.score()))
+    # centers moved (the graph-engine center-loss hook engaged)
+    assert np.abs(np.asarray(net.state["out"]["centers"])).max() > 0
+    assert "__features__" not in net.state["out"]
+
+
+def test_facenet_nn4_small2():
+    from deeplearning4j_tpu.models import facenet_nn4_small2
+    net = facenet_nn4_small2(num_classes=4, embedding_size=16,
+                             input_shape=(64, 64, 3),
+                             updater=Sgd(learning_rate=1e-3))
+    net.init()
+    x = RNG.normal(size=(2, 64, 64, 3)).astype(np.float32)
+    y = np.eye(4, dtype=np.float32)[RNG.integers(0, 4, 2)]
+    net.fit(DataSet(x, y), epochs=1)
+    assert np.isfinite(float(net.score()))
+    # embeddings are L2-normalized
+    import jax.numpy as jnp
+    acts, _, _ = net._forward(net.params, {"in": jnp.asarray(x)}, net.state,
+                              train=False, rng=None)
+    emb = np.asarray(acts["embeddings"])
+    np.testing.assert_allclose(np.linalg.norm(emb, axis=-1), 1.0, rtol=1e-4)
+
+
+def test_graph_center_loss_score_matches_fit():
+    """Graph-engine score(data) includes the center term (regression: it
+    silently dropped it, so early stopping tracked a different objective)."""
+    from deeplearning4j_tpu.nn.config import (InputType,
+                                              NeuralNetConfiguration)
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+    from deeplearning4j_tpu.nn.layers.core import DenseLayer
+    from deeplearning4j_tpu.nn.layers.special import CenterLossOutputLayer
+    gb = (NeuralNetConfiguration.builder().seed(0)
+          .updater(Sgd(learning_rate=0.0))    # lr 0: params static
+          .graph_builder()
+          .add_inputs("in")
+          .set_input_types(InputType.feed_forward(8)))
+    gb.add_layer("d", DenseLayer(n_out=16, activation="relu"), "in")
+    gb.add_layer("out", CenterLossOutputLayer(n_out=3, lambda_=1.0,
+                                              alpha=0.0), "d")
+    gb.set_outputs("out")
+    net = ComputationGraph(gb.build()).init()
+    x = RNG.normal(size=(24, 8)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[RNG.integers(0, 3, 24)]
+    net.fit(DataSet(x, y), epochs=1)
+    assert abs(float(net.score()) - float(net.score(DataSet(x, y)))) < 1e-5
+
+
+def test_space_to_batch_rejects_indivisible():
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.nn.layers.conv3d import SpaceToBatchLayer
+    with pytest.raises(ValueError, match="divisible"):
+        SpaceToBatchLayer(block_size=2).initialize(None, (3, 5, 6),
+                                                   jnp.float32)
