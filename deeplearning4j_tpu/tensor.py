@@ -451,6 +451,211 @@ class Tensor:
         return self
 
 
+    # ---- INDArray breadth: elementwise -------------------------------------
+    def tan(self):
+        return self._unop("tan", jnp.tan)
+
+    def asin(self):
+        return self._unop("asin", jnp.arcsin)
+
+    def acos(self):
+        return self._unop("acos", jnp.arccos)
+
+    def atan(self):
+        return self._unop("atan", jnp.arctan)
+
+    def sinh(self):
+        return self._unop("sinh", jnp.sinh)
+
+    def cosh(self):
+        return self._unop("cosh", jnp.cosh)
+
+    def log1p(self):
+        return self._unop("log1p", jnp.log1p)
+
+    def expm1(self):
+        return self._unop("expm1", jnp.expm1)
+
+    def log10(self):
+        return self._unop("log10", jnp.log10)
+
+    def cube(self):
+        return self._unop("cube", lambda a: a ** 3)
+
+    def erf(self):
+        return self._unop("erf", jax.scipy.special.erf)
+
+    def softmax(self, axis=-1):
+        return _wrap(_jitted(("softmax", axis),
+                             lambda a: jax.nn.softmax(a, axis=axis))(self._a))
+
+    def clip(self, min_value, max_value):
+        """INDArray clip / Transforms.clip."""
+        return _wrap(_jitted("clip", jnp.clip)(self._a, min_value, max_value))
+
+    def lerp(self, other, t):
+        """this + t * (other - this) (INDArray lerp)."""
+        o = _unwrap(other)
+        return _wrap(_jitted("lerp", lambda a, b, w: a + w * (b - a))(
+            self._a, o, t))
+
+    def replace_where(self, value, cond):
+        """`value` where cond(bool tensor) holds, else this — returns a NEW
+        tensor (DL4J's BooleanIndexing.replaceWhere mutates in place; XLA
+        arrays are immutable — recorded divergence, see put()/put_row()).
+        Use :meth:`replace_wherei` for the rebinding spelling."""
+        return _wrap(_jitted("replace_where", jnp.where)(
+            _unwrap(cond), value, self._a))
+
+    def replace_wherei(self, value, cond):
+        """In-place spelling: rebinds this tensor's buffer (the ``*_i``
+        convention) and returns self."""
+        self._a = self.replace_where(value, cond)._a
+        return self
+
+    # ---- row/column vector broadcasting (DL4J add/sub/mul/divRowVector) ----
+    def _rowvec(self, name, fn, vec):
+        v = _unwrap(vec)
+        return _wrap(_jitted(("rowvec", name),
+                             lambda a, b: fn(a, b.reshape(1, -1)))(self._a, v))
+
+    def _colvec(self, name, fn, vec):
+        v = _unwrap(vec)
+        return _wrap(_jitted(("colvec", name),
+                             lambda a, b: fn(a, b.reshape(-1, 1)))(self._a, v))
+
+    def add_row_vector(self, v):
+        return self._rowvec("add", jnp.add, v)
+
+    def sub_row_vector(self, v):
+        return self._rowvec("sub", jnp.subtract, v)
+
+    def mul_row_vector(self, v):
+        return self._rowvec("mul", jnp.multiply, v)
+
+    def div_row_vector(self, v):
+        return self._rowvec("div", jnp.divide, v)
+
+    def add_column_vector(self, v):
+        return self._colvec("add", jnp.add, v)
+
+    def sub_column_vector(self, v):
+        return self._colvec("sub", jnp.subtract, v)
+
+    def mul_column_vector(self, v):
+        return self._colvec("mul", jnp.multiply, v)
+
+    def div_column_vector(self, v):
+        return self._colvec("div", jnp.divide, v)
+
+    # ---- rows/columns ------------------------------------------------------
+    def get_row(self, i):
+        return _wrap(self._a[i])
+
+    def get_column(self, i):
+        return _wrap(self._a[:, i])
+
+    def get_rows(self, idx):
+        return _wrap(jnp.take(self._a, jnp.asarray(idx), axis=0))
+
+    def get_columns(self, idx):
+        return _wrap(jnp.take(self._a, jnp.asarray(idx), axis=1))
+
+    def put_row(self, i, v):
+        """Functional putRow: returns the updated tensor (XLA arrays are
+        immutable; recorded divergence from DL4J's in-place)."""
+        return _wrap(self._a.at[i].set(_unwrap(v)))
+
+    def put_column(self, i, v):
+        return _wrap(self._a.at[:, i].set(_unwrap(v)))
+
+    # ---- sorting / selection ----------------------------------------------
+    def sort(self, axis=-1, descending=False):
+        def _sort(a):
+            out = jnp.sort(a, axis=axis)
+            return jnp.flip(out, axis=axis) if descending else out
+        return _wrap(_jitted(("sort", axis, descending), _sort)(self._a))
+
+    def argsort(self, axis=-1, descending=False):
+        def _argsort(a):
+            out = jnp.argsort(a, axis=axis)
+            return jnp.flip(out, axis=axis) if descending else out
+        return _wrap(_jitted(("argsort", axis, descending), _argsort)(self._a))
+
+    def topk(self, k, axis=-1):
+        """-> (values, indices), largest first (nd4j top_k)."""
+        a = jnp.moveaxis(self._a, axis, -1)
+        v, i = jax.lax.top_k(a, k)
+        return (_wrap(jnp.moveaxis(v, -1, axis)),
+                _wrap(jnp.moveaxis(i, -1, axis)))
+
+    def unique(self):
+        return _wrap(jnp.unique(self._a))
+
+    # ---- predicates / counts ----------------------------------------------
+    def any(self):
+        return bool(jnp.any(self._a))
+
+    def all(self):
+        return bool(jnp.all(self._a))
+
+    def count_nonzero(self):
+        return int(jnp.count_nonzero(self._a))
+
+    # ---- statistics --------------------------------------------------------
+    def amean(self, *dims):
+        """Mean of absolute values (nd4j amean)."""
+        return self.abs().mean(*dims)
+
+    def amax(self, *dims):
+        return self.abs().max(*dims)
+
+    def amin(self, *dims):
+        return self.abs().min(*dims)
+
+    def ptp(self):
+        return _wrap(jnp.ptp(self._a))
+
+    def entropy(self):
+        """-sum(p * log(p)) over all elements (nd4j entropy)."""
+        return _wrap(_jitted("entropy",
+                             lambda a: -jnp.sum(a * jnp.log(a)))(self._a))
+
+    def pnorm(self, p):
+        """General p-norm over ALL elements. Named ``pnorm`` (not ``norm``)
+        because the sibling reductions (norm1/norm2/normmax) take *dims*
+        positionally — a first-positional p on a ``norm`` spelling invites
+        axis-as-p mistakes."""
+        p = float(p)
+        if p <= 0:
+            raise ValueError(f"p-norm order must be > 0, got {p}")
+        return _wrap(_jitted(("pnorm", p),
+                             lambda a: jnp.sum(jnp.abs(a) ** p) ** (1.0 / p))(
+            self._a))
+
+    def distance2(self, other):
+        """Euclidean distance (INDArray distance2); one fused callable."""
+        return float(_jitted("distance2",
+                             lambda a, b: jnp.sqrt(jnp.sum((a - b) ** 2)))(
+            self._a, _unwrap(other)))
+
+    def distance1(self, other):
+        """Manhattan distance (INDArray distance1)."""
+        return float(_jitted("distance1",
+                             lambda a, b: jnp.sum(jnp.abs(a - b)))(
+            self._a, _unwrap(other)))
+
+    def cosine_sim(self, other):
+        def _cos(a, b):
+            num = jnp.sum(a * b)
+            den = jnp.linalg.norm(a) * jnp.linalg.norm(b)
+            return num / jnp.maximum(den, 1e-12)
+        return float(_jitted("cosine_sim", _cos)(self._a, _unwrap(other)))
+
+    def flatten(self):
+        return self.ravel()
+
+
 def _freeze(x):
     if isinstance(x, (list, tuple)):
         return tuple(_freeze(i) for i in x)

@@ -179,3 +179,44 @@ def test_astype_cast():
     assert t.dtype == np.float32
     assert t.cast_to("INT32").dtype == np.int32
     assert t.astype(dtypes.bfloat16).data_type() == "BFLOAT16"
+
+
+def test_indarray_breadth_methods():
+    import deeplearning4j_tpu.tensor as T
+    a = T.create(np.asarray([[4.0, 1.0, 3.0], [2.0, 6.0, 5.0]], np.float32))
+    v = T.create(np.asarray([1.0, 2.0, 3.0], np.float32))
+    cv = T.create(np.asarray([10.0, 20.0], np.float32))
+    np.testing.assert_allclose(a.add_row_vector(v).numpy(),
+                               a.numpy() + v.numpy()[None, :])
+    np.testing.assert_allclose(a.mul_column_vector(cv).numpy(),
+                               a.numpy() * cv.numpy()[:, None])
+    np.testing.assert_allclose(a.get_row(1).numpy(), [2.0, 6.0, 5.0])
+    np.testing.assert_allclose(a.get_column(2).numpy(), [3.0, 5.0])
+    np.testing.assert_allclose(a.put_row(0, v).numpy()[0], v.numpy())
+    np.testing.assert_allclose(a.sort(descending=True).numpy()[0],
+                               [4.0, 3.0, 1.0])
+    vals, idx = a.topk(2)
+    np.testing.assert_allclose(vals.numpy(), [[4.0, 3.0], [6.0, 5.0]])
+    assert a.any() and a.all() and a.count_nonzero() == 6
+    np.testing.assert_allclose(a.clip(2.0, 4.0).numpy().min(), 2.0)
+    np.testing.assert_allclose(a.lerp(a.add(2.0), 0.5).numpy(),
+                               a.numpy() + 1.0)
+    mask = a.gt(3.0)
+    np.testing.assert_allclose(a.replace_where(0.0, mask).numpy(),
+                               np.where(a.numpy() > 3.0, 0.0, a.numpy()))
+    assert abs(a.distance2(a.add(1.0)) - np.sqrt(6.0)) < 1e-5
+    assert abs(a.cosine_sim(a) - 1.0) < 1e-6
+    p = T.create(np.asarray([0.5, 0.5], np.float32))
+    assert abs(float(p.entropy().item()) - np.log(2.0)) < 1e-6
+    np.testing.assert_allclose(a.softmax().numpy().sum(-1), 1.0, rtol=1e-5)
+    assert abs(float(a.pnorm(3).item())
+               - (np.abs(a.numpy()) ** 3).sum() ** (1 / 3)) < 1e-4
+    import pytest as _pytest
+    with _pytest.raises(ValueError, match="p-norm order"):
+        a.pnorm(0)
+    # rebinding replace_wherei spelling
+    b = a.dup()
+    b.replace_wherei(0.0, b.gt(3.0))
+    np.testing.assert_allclose(b.numpy(),
+                               np.where(a.numpy() > 3.0, 0.0, a.numpy()))
+    np.testing.assert_allclose(a.amean().item(), np.abs(a.numpy()).mean())
