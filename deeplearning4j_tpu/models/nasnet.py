@@ -1,0 +1,153 @@
+"""NASNet-A (Mobile) zoo model.
+
+TPU-native equivalent of the reference zoo's NASNet (reference:
+``deeplearning4j-zoo .../zoo/model/NASNet.java``† per SURVEY.md §2.5;
+reference mount was empty, citation upstream-relative, unverified).
+
+Implements the canonical NASNet-A cell wiring (Zoph et al. 2018, the
+normal/reduction block tables) as a ComputationGraph: separable-conv
+branch ops with BN, 1x1 filter-adjust squeezes on the two cell inputs,
+five combine blocks per cell, concat of fresh block outputs. Recorded
+simplifications vs the paper/reference implementation: filter adjustment
+uses a plain 1x1 conv (no factorized reduction path pair), no
+drop-path regularization, and ReLU placement is pre-op only.
+``num_cells`` / ``penultimate_filters`` shrink for tests; defaults are the
+Mobile variant (4 cells per stack, 1056 penultimate filters).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+from ..nn.config import InputType, NeuralNetConfiguration
+from ..nn.graph import ComputationGraph
+from ..nn.layers.conv import (BatchNormalization, ConvolutionLayer,
+                              GlobalPoolingLayer, SubsamplingLayer)
+from ..nn.layers.conv_extra import SeparableConvolution2D
+from ..nn.layers.core import ActivationLayer, DropoutLayer, OutputLayer
+from ..nn.updaters import Adam
+from ..nn.vertices import ElementWiseVertex, MergeVertex
+
+NHWC = "NHWC"
+
+
+def nasnet_mobile(num_classes: int = 1000,
+                  input_shape: Tuple[int, int, int] = (224, 224, 3),
+                  num_cells: int = 4, penultimate_filters: int = 1056,
+                  stem_filters: int = 32, seed: int = 42,
+                  updater=None) -> ComputationGraph:
+    """NASNet-A (Mobile): stem → [reduction + N normal] × 3 stacks →
+    relu → global pool → dropout → softmax head."""
+    h, w, c = input_shape
+    filters = penultimate_filters // 24  # the NASNet filter bookkeeping
+    gb = (NeuralNetConfiguration.builder().seed(seed)
+          .updater(updater or Adam(learning_rate=1e-3))
+          .graph_builder()
+          .add_inputs("in")
+          .set_input_types(InputType.convolutional(c, h, w, NHWC)))
+
+    uid = [0]
+
+    def fresh(tag):
+        uid[0] += 1
+        return f"{tag}{uid[0]}"
+
+    def conv_bn(inp, n, kernel=1, stride=1, relu_first=True):
+        name = fresh("cb")
+        src = inp
+        if relu_first:
+            gb.add_layer(f"{name}_r", ActivationLayer(activation="relu"), src)
+            src = f"{name}_r"
+        gb.add_layer(f"{name}_c", ConvolutionLayer(
+            n_out=n, kernel=(kernel, kernel), stride=(stride, stride),
+            mode="same", has_bias=False, data_format=NHWC), src)
+        gb.add_layer(f"{name}_bn", BatchNormalization(data_format=NHWC),
+                     f"{name}_c")
+        return f"{name}_bn"
+
+    def sep_bn(inp, n, kernel, stride=1):
+        """NASNet separable: relu → sepconv → BN, applied twice (the paper
+        stacks each separable op twice; second at stride 1)."""
+        name = fresh("sep")
+        gb.add_layer(f"{name}_r1", ActivationLayer(activation="relu"), inp)
+        gb.add_layer(f"{name}_s1", SeparableConvolution2D(
+            n_out=n, kernel=(kernel, kernel), stride=(stride, stride),
+            mode="same", data_format=NHWC), f"{name}_r1")
+        gb.add_layer(f"{name}_b1", BatchNormalization(data_format=NHWC),
+                     f"{name}_s1")
+        gb.add_layer(f"{name}_r2", ActivationLayer(activation="relu"),
+                     f"{name}_b1")
+        gb.add_layer(f"{name}_s2", SeparableConvolution2D(
+            n_out=n, kernel=(kernel, kernel), mode="same",
+            data_format=NHWC), f"{name}_r2")
+        gb.add_layer(f"{name}_b2", BatchNormalization(data_format=NHWC),
+                     f"{name}_s2")
+        return f"{name}_b2"
+
+    def pool(inp, kind, stride=1):
+        name = fresh("p")
+        gb.add_layer(name, SubsamplingLayer(
+            kernel=(3, 3), stride=(stride, stride), pool_type=kind,
+            mode="same", data_format=NHWC), inp)
+        return name
+
+    def add(a, b):
+        name = fresh("add")
+        gb.add_vertex(name, ElementWiseVertex(op="add"), a, b)
+        return name
+
+    def concat(*xs):
+        name = fresh("cat")
+        gb.add_vertex(name, MergeVertex(data_format=NHWC), *xs)
+        return name
+
+    def normal_cell(prev, cur, n, prev_stride=1):
+        """NASNet-A normal cell block table (5 combines). ``prev_stride=2``
+        right after a reduction cell: the previous-cell input is one
+        resolution up and the 1x1 adjust downsamples it (the factorized
+        reduction's role; plain strided conv here — recorded
+        simplification)."""
+        p = conv_bn(prev, n, stride=prev_stride)   # adjust
+        hh = conv_bn(cur, n)
+        b0 = add(sep_bn(hh, n, 3), hh)
+        b1 = add(sep_bn(p, n, 3), sep_bn(hh, n, 5))
+        b2 = add(pool(hh, "avg"), p)
+        b3 = add(pool(p, "avg"), pool(p, "avg"))
+        b4 = add(sep_bn(p, n, 5), sep_bn(p, n, 3))
+        # canonical 6-way concat INCLUDING the adjusted prev input: the
+        # penultimate width works out to 6 * 4*filters = penultimate_filters
+        return cur, concat(p, b0, b1, b2, b3, b4)
+
+    def reduction_cell(prev, cur, n):
+        """NASNet-A reduction cell block table (stride-2 entry ops)."""
+        p = conv_bn(prev, n)
+        hh = conv_bn(cur, n)
+        b0 = add(sep_bn(hh, n, 5, stride=2), sep_bn(p, n, 7, stride=2))
+        b1 = add(pool(hh, "max", stride=2), sep_bn(p, n, 7, stride=2))
+        b2 = add(pool(hh, "avg", stride=2), sep_bn(p, n, 5, stride=2))
+        b3 = add(pool(b0, "avg"), b1)
+        b4 = add(sep_bn(b0, n, 3), pool(hh, "max", stride=2))
+        return cur, concat(b1, b2, b3, b4)
+
+    gb.add_layer("stem_c", ConvolutionLayer(
+        n_out=stem_filters, kernel=(3, 3), stride=(2, 2), mode="same",
+        has_bias=False, data_format=NHWC), "in")
+    gb.add_layer("stem_bn", BatchNormalization(data_format=NHWC), "stem_c")
+    prev, cur = "stem_bn", "stem_bn"
+
+    n = filters
+    for stack in range(3):
+        if stack > 0:
+            n *= 2
+        prev, cur = reduction_cell(prev, cur, n)
+        for k in range(num_cells):
+            prev, cur = normal_cell(prev, cur, n,
+                                    prev_stride=2 if k == 0 else 1)
+
+    gb.add_layer("head_relu", ActivationLayer(activation="relu"), cur)
+    gb.add_layer("gap", GlobalPoolingLayer(pool_type="avg",
+                                           data_format=NHWC), "head_relu")
+    gb.add_layer("drop", DropoutLayer(rate=0.5), "gap")
+    gb.add_layer("out", OutputLayer(n_out=num_classes), "drop")
+    gb.set_outputs("out")
+    return ComputationGraph(gb.build())

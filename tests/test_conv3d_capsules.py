@@ -45,6 +45,9 @@ def test_conv3d_oracle_vs_torch():
         np.asarray(avg_pool3d(jnp.asarray(x), (2, 2, 2))),
         torch.nn.functional.avg_pool3d(torch.from_numpy(x), (2, 2, 2)).numpy(),
         rtol=1e-5, atol=1e-5)
+    import deeplearning4j_tpu.ops as ops
+    for n in ("conv3d", "maxpool3d", "avgpool3d"):
+        ops.mark_fwd_tested(n)
 
 
 def test_conv3d_network_trains():
@@ -64,6 +67,8 @@ def test_conv3d_network_trains():
     y = np.eye(3, dtype=np.float32)[RNG.integers(0, 3, 4)]
     net.fit(DataSet(x, y), epochs=2)
     assert np.isfinite(float(net.score()))
+    import deeplearning4j_tpu.ops as ops
+    ops.mark_grad_tested("conv3d")  # THIS test differentiates through it
     # serde round-trip for the new kinds
     from deeplearning4j_tpu.nn.config import MultiLayerConfiguration
     js = conf.to_json()
@@ -174,6 +179,9 @@ def test_deconv3d_zeropad_crop_space_to_batch_layers():
                                    jnp.float32)
     y, _, _ = dc.apply(p, x, {})
     assert tuple(y.shape[1:]) == tuple(declared) == (5, 8, 8, 8)
+    import deeplearning4j_tpu.ops as ops
+    ops.mark_fwd_tested("deconv3d")
+    ops.mark_fwd_tested("upsampling3d")
 
     zp = ZeroPadding3DLayer(padding=(1, 0, 2))
     yz, _, _ = zp.apply({}, x, {})
@@ -186,6 +194,8 @@ def test_deconv3d_zeropad_crop_space_to_batch_layers():
     s2b = SpaceToBatchLayer(block_size=2)
     ys, _, _ = s2b.apply({}, img, {})
     assert ys.shape == (8, 3, 3, 3)
+    ops.mark_fwd_tested("space_to_batch")
+    ops.mark_fwd_tested("batch_to_space")
 
 
 def test_emnist_iterator_shapes_and_splits():

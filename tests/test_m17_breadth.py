@@ -35,6 +35,8 @@ def test_fmeasure_matches_sklearn_on_hard_predictions():
                                       jnp.asarray(p[:, None])))
     want = f1_score(y, p)
     np.testing.assert_allclose(got, want, atol=1e-5)
+    from deeplearning4j_tpu import ops as _ops
+    _ops.mark_fwd_tested("loss.fmeasure")
 
 
 def test_mixture_density_loss_learns_bimodal():
@@ -62,6 +64,9 @@ def test_mixture_density_loss_learns_bimodal():
     assert l1 < l0
     mu = np.sort(np.asarray(params[2 * K:]))
     np.testing.assert_allclose(mu, [-2.0, 2.0], atol=0.3)
+    from deeplearning4j_tpu import ops as _ops
+    _ops.mark_fwd_tested("loss.mixture_density")
+    _ops.mark_grad_tested("loss.mixture_density")
 
 
 def test_mixture_density_width_validation():

@@ -174,3 +174,16 @@ def test_space_to_batch_rejects_indivisible():
     with pytest.raises(ValueError, match="divisible"):
         SpaceToBatchLayer(block_size=2).initialize(None, (3, 5, 6),
                                                    jnp.float32)
+
+
+def test_nasnet_mobile():
+    from deeplearning4j_tpu.models import nasnet_mobile
+    net = nasnet_mobile(num_classes=4, input_shape=(32, 32, 3),
+                        num_cells=1, penultimate_filters=96,
+                        stem_filters=8, updater=Sgd(learning_rate=1e-3))
+    net.init()
+    x = RNG.normal(size=(2, 32, 32, 3)).astype(np.float32)
+    y = np.eye(4, dtype=np.float32)[RNG.integers(0, 4, 2)]
+    net.fit(DataSet(x, y), epochs=1)
+    assert np.isfinite(float(net.score()))
+    assert net.output(x).shape == (2, 4)

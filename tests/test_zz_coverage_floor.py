@@ -11,9 +11,10 @@ import pytest
 import deeplearning4j_tpu.ops as ops
 
 # Ratcheted each round (r1: 0.50/0.35; r2: 0.80/0.60 after the math/shape/
-# linalg/sort/scatter/random/image families landed with oracle tests).
-FWD_FLOOR = 0.80
-GRAD_FLOOR = 0.60
+# linalg/sort/scatter/random/image families landed; r2 late: 0.85/0.65 once
+# the 3D conv family, einsum, fmeasure/mixture-density marked their tests).
+FWD_FLOOR = 0.85
+GRAD_FLOOR = 0.65
 
 
 def test_coverage_floor():
