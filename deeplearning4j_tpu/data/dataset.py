@@ -143,6 +143,10 @@ class NumpyMultiDataSetIterator(MultiDataSetIterator):
         return {"epoch": self._epoch, "pos": self._pos, "seed": self._seed}
 
     def set_state(self, state: dict):
+        if state.get("seed", self._seed) != self._seed:
+            raise ValueError(
+                f"iterator state was captured with seed {state['seed']}, "
+                f"this iterator has seed {self._seed}")
         self._epoch = int(state.get("epoch", 0))
         self._pos = int(state.get("pos", 0))
 

@@ -171,11 +171,21 @@ def _pool(node, ctx, at):
             raise ValueError(f"asymmetric {node.op_type} pads {pads} not "
                              "supported (end-side padding would be dropped)")
         mode, pad = "truncate", (int(pads[0]), int(pads[1]))
+    attrs = {"kernel": tuple(int(k) for k in at["kernel_shape"]),
+             "stride": tuple(int(s) for s in at.get("strides", at["kernel_shape"])),
+             "padding": pad, "mode": mode,
+             "data_format": "NCHW"}
+    if op == "avgpool2d":
+        # ONNX default count_include_pad=0: padded cells are EXCLUDED from
+        # the divisor, unlike DL4J truncate-mode avg pool.
+        cip = bool(at.get("count_include_pad", 0))
+        if cip and mode == "same":
+            raise ValueError(
+                "AveragePool auto_pad=SAME with count_include_pad=1 not "
+                "supported (our same-mode divisor always excludes padding)")
+        attrs["count_include_pad"] = cip
     return ctx.sd.call(op, ctx.get(node.input[0]), name=node.output[0],
-                       attrs={"kernel": tuple(int(k) for k in at["kernel_shape"]),
-                              "stride": tuple(int(s) for s in at.get("strides", at["kernel_shape"])),
-                              "padding": pad, "mode": mode,
-                              "data_format": "NCHW"})
+                       attrs=attrs)
 
 
 @onnx_op("GlobalAveragePool")

@@ -109,7 +109,7 @@ _UNARY = {"Relu": "act.relu", "Relu6": "act.relu6", "Elu": "act.elu",
           "Erfc": "math.erfc",
           "LogicalNot": "math.logical_not"}
 
-_BINARY = {"Add": "math.add", "AddV2": "math.add", "BiasAdd": "math.add",
+_BINARY = {"Add": "math.add", "AddV2": "math.add",
            "Sub": "math.sub", "Mul": "math.mul", "RealDiv": "math.div",
            "Div": "math.div", "FloorDiv": "math.floordiv",
            "Maximum": "math.maximum", "Minimum": "math.minimum",
@@ -152,6 +152,16 @@ def _batch_matmul(node, ctx, ins):
                        name=node.name,
                        attrs={"transpose_a": bool(_attr(node, "adj_x", False)),
                               "transpose_b": bool(_attr(node, "adj_y", False))})
+
+
+@tf_op("BiasAdd")
+def _bias_add(node, ctx, ins):
+    # NCHW BiasAdd would need the [C] bias broadcast over axis 1, not the
+    # trailing axis plain add gives — reject it like the Conv2D/pool guards.
+    if _attr(node, "data_format", "NHWC") != "NHWC":
+        raise ValueError("BiasAdd NCHW graphs not supported (convert to NHWC)")
+    return ctx.sd.call("math.add", ctx.get(ins[0]), ctx.get(ins[1]),
+                       name=node.name)
 
 
 @tf_op("Conv2D")

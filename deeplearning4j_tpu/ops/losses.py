@@ -260,7 +260,14 @@ def mixture_density(labels, predictions, mask=None, weights=None,
     """LossMixtureDensity: negative log-likelihood of an isotropic Gaussian
     mixture. Network output layout matches the reference:
     ``[alpha (K) | sigma (K) | mu (K*L)]`` with labels [.., L]; K inferred
-    from the widths when not given (width = K*(2+L))."""
+    from the widths when not given (width = K*(2+L)).
+
+    The sigma block is passed through ``exp`` — DL4J's LossMixtureDensity
+    treats the network output as log-sigma (reference†
+    nd4j …/lossfunctions/impl/LossMixtureDensity.java applies exp to the
+    sigma slice; mount empty, unverified). An additive EPS floor keeps
+    sigma**2 away from f32 underflow (exp alone hits 0 below logit ~-104,
+    turning the nll into inf/NaN) while leaving gradients nonzero."""
     L = labels.shape[-1]
     width = predictions.shape[-1]
     K = num_mixtures or width // (2 + L)
@@ -268,7 +275,7 @@ def mixture_density(labels, predictions, mask=None, weights=None,
         raise ValueError(f"output width {width} != K*(2+L) for labels "
                          f"width {L}")
     alpha = predictions[..., :K]
-    sigma = jnp.maximum(jnp.abs(predictions[..., K:2 * K]), EPS)
+    sigma = jnp.exp(predictions[..., K:2 * K]) + EPS
     mu = predictions[..., 2 * K:].reshape(predictions.shape[:-1] + (K, L))
     log_pi = jax.nn.log_softmax(alpha, axis=-1)
     d2 = jnp.sum((labels[..., None, :] - mu) ** 2, axis=-1)     # [.., K]

@@ -138,7 +138,8 @@ def separable_conv2d(x, w_depth, w_point, b=None, stride=(1, 1), padding=0,
     return conv2d(y, w_point, b, (1, 1), 0, (1, 1), "truncate", data_format)
 
 
-def _pool(x, kind, kernel, stride, padding, mode, data_format, pnorm_p=2.0):
+def _pool(x, kind, kernel, stride, padding, mode, data_format, pnorm_p=2.0,
+          count_include_pad=True):
     kh, kw = _pair(kernel)
     sh, sw = _pair(stride)
     if data_format == "NCHW":
@@ -160,7 +161,11 @@ def _pool(x, kind, kernel, stride, padding, mode, data_format, pnorm_p=2.0):
         s = lax.reduce_window(x, 0.0, lax.add, window, strides, pad)
         # DL4J avg pool divides by the full kernel size (incl. padding cells)
         # in Truncate mode; with SAME it divides by the actual window count.
-        if mode == "same":
+        # count_include_pad=False forces the window-count divisor for
+        # explicit padding too (ONNX AveragePool default semantics); with no
+        # padding every window is full, so skip the count pass.
+        explicit_pad = mode != "same" and any(p != (0, 0) for p in pad)
+        if mode == "same" or (not count_include_pad and explicit_pad):
             ones = jnp.ones_like(x)
             cnt = lax.reduce_window(ones, 0.0, lax.add, window, strides, pad)
             y = s / cnt
@@ -181,8 +186,10 @@ def max_pool2d(x, kernel, stride=None, padding=0, mode="truncate", data_format="
 
 
 @register("avgpool2d", category="cnn")
-def avg_pool2d(x, kernel, stride=None, padding=0, mode="truncate", data_format="NCHW"):
-    return _pool(x, "avg", kernel, stride or kernel, padding, mode, data_format)
+def avg_pool2d(x, kernel, stride=None, padding=0, mode="truncate",
+               data_format="NCHW", count_include_pad=True):
+    return _pool(x, "avg", kernel, stride or kernel, padding, mode,
+                 data_format, count_include_pad=count_include_pad)
 
 
 @register("pnormpool2d", category="cnn")

@@ -24,8 +24,18 @@ import numpy as np
 from .. import native as _native
 
 
+# u32 codewords carry the element index in the upper 31 bits — larger
+# arrays would silently wrap and decode into the wrong positions.
+_MAX_ELEMENTS = (1 << 31) - 1
+
+
 def _as_f32c(a) -> np.ndarray:
-    return np.ascontiguousarray(np.asarray(a, dtype=np.float32).ravel())
+    g = np.ascontiguousarray(np.asarray(a, dtype=np.float32).ravel())
+    if g.size > _MAX_ELEMENTS:
+        raise ValueError(
+            f"array of {g.size} elements exceeds the 2^31-1 limit of the "
+            "31-bit index codeword; shard the gradient before encoding")
+    return g
 
 
 class ThresholdCompression:

@@ -214,3 +214,15 @@ def test_async_iterator_abandon_mid_epoch_rewinds():
     assert len(seen) == len(full)
     for a, b in zip(seen, full):
         np.testing.assert_array_equal(a, b)
+
+
+def test_multidataset_iterator_seed_mismatch_raises():
+    """Restoring a cursor into a differently-seeded iterator must fail
+    loudly, not silently resume a different shuffle permutation."""
+    from deeplearning4j_tpu.data.dataset import NumpyMultiDataSetIterator
+    x = np.arange(40, dtype=np.float32).reshape(20, 2)
+    it = NumpyMultiDataSetIterator([x], [x], batch_size=4, shuffle=True, seed=1)
+    st = it.state()
+    it2 = NumpyMultiDataSetIterator([x], [x], batch_size=4, shuffle=True, seed=2)
+    with pytest.raises(ValueError, match="seed"):
+        it2.set_state(st)

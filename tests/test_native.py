@@ -149,3 +149,17 @@ def test_fast_csv_trailing_tab_does_not_merge_rows(tmp_path):
     p.write_bytes(b"1,2\t\n3,4\n")
     m = load_csv_floats(str(p))
     np.testing.assert_allclose(m, [[1, 2], [3, 4]])
+
+
+def test_threshold_encode_rejects_oversized_arrays(monkeypatch):
+    """Indices are packed into 31 bits of a u32 codeword; arrays past
+    2^31-1 elements would silently wrap. The guard must trip (limit
+    shrunk so the test doesn't need an 8GB buffer)."""
+    from deeplearning4j_tpu.utils import compression
+    monkeypatch.setattr(compression, "_MAX_ELEMENTS", 15)
+    tc = ThresholdCompression(threshold=0.01)
+    with pytest.raises(ValueError, match="2\\^31-1"):
+        tc.encode(np.ones(16, np.float32))
+    with pytest.raises(ValueError, match="2\\^31-1"):
+        tc.encode_residual(np.ones(16, np.float32))
+    tc.encode(np.ones(15, np.float32))  # at the limit: fine

@@ -244,6 +244,41 @@ def test_onnx_asymmetric_pool_pads_loud():
         OnnxFrameworkImporter.import_model_proto(m.SerializeToString())
 
 
+def test_onnx_avgpool_count_include_pad_default_excludes():
+    """ONNX AveragePool default count_include_pad=0: border windows divide
+    by the real cell count, not the full kernel (torch oracle)."""
+    torch = pytest.importorskip("torch")
+    from deeplearning4j_tpu.modelimport.proto import onnx_min_pb2 as P
+    m = P.ModelProto(); m.ir_version = 8
+    g = m.graph
+    g.input.append(_onnx_io(P, "x", [1, 2, 6, 6]))
+    g.output.append(_onnx_io(P, "y", [1, 2, 3, 3]))
+    n = g.node.add(); n.op_type = "AveragePool"
+    n.input.append("x"); n.output.append("y")
+    for name, ints in [("kernel_shape", [3, 3]), ("strides", [2, 2]),
+                       ("pads", [1, 1, 1, 1])]:
+        a = n.attribute.add(); a.name = name; a.type = 7; a.ints.extend(ints)
+    sd = OnnxFrameworkImporter.import_model_proto(m.SerializeToString())
+    x = np.random.default_rng(0).normal(size=(1, 2, 6, 6)).astype(np.float32)
+    want = torch.nn.functional.avg_pool2d(
+        torch.from_numpy(x), 3, 2, 1, count_include_pad=False).numpy()
+    got = np.asarray(sd.output({"x": x}, ["y"])["y"])
+    np.testing.assert_allclose(got, want, rtol=RTOL, atol=ATOL)
+
+
+def test_tf_biasadd_nchw_rejected():
+    """A standalone NCHW BiasAdd would broadcast the [C] bias over W if
+    mapped to plain add — it must be rejected like the Conv2D/pool guards."""
+    b = tf.constant([1.0, 2.0])
+
+    def f(x):
+        return tf.nn.bias_add(x, b, data_format="NCHW")
+
+    gd, ins, outs = _freeze(f, tf.TensorSpec([1, 2, 3, 3], tf.float32))
+    with pytest.raises(ValueError, match="NCHW"):
+        TensorflowFrameworkImporter.import_graph_def(gd)
+
+
 def test_bert_via_tf_import_matches_and_finetunes():
     """The BASELINE.md row 'BERT-base via TF-import path trains': a (shrunk)
     HF TFBert freezes -> imports -> matches TF outputs -> fine-tunes with a
