@@ -2,9 +2,10 @@
 
 Headline (round 2+): ResNet-50 ComputationGraph training on the real chip,
 reported as **MFU** (the BASELINE.md north-star metric: ≥35% on v5e-64)
-plus examples/sec and step time. bf16 end-to-end (SURVEY.md §7.3 item 8:
-the MFU bar requires bf16 matmuls/convs; divergence recorded — master
-weights are bf16 too, not fp32, pending a mixed-precision optimizer state).
+plus examples/sec and step time. Mixed precision per SURVEY.md §7.3 item 8:
+dtype="BFLOAT16" now means fp32 MASTER weights + updater state with bf16
+compute (activations/matmul/conv inputs cast inside the jitted step) — the
+exact policy the ≥35% target is defined over.
 
 Methodology notes (honesty over flattery):
 - Data is DEVICE-RESIDENT during timing: this measures the compiled-step

@@ -87,3 +87,49 @@ def name_of(dtype) -> str:
 
 def is_floating(dtype) -> bool:
     return np.dtype(dtype) in {np.dtype(d) for d in FLOATING}
+
+
+# ---- mixed precision (SURVEY.md §7.3 item 8) --------------------------------
+# A 16-bit network dtype selects the COMPUTE dtype only: the engines keep
+# fp32 master params + fp32 updater state and cast params/activations to the
+# compute dtype inside the jitted step, so matmuls/convs hit the MXU in
+# bf16 while weight updates retain full mantissa. (bf16 shares fp32's
+# exponent range, so no loss scaling is needed; fp16 nets get the same
+# master-weight treatment but remain exotic on TPU.)
+
+_SIXTEEN_BIT = {np.dtype(np.float16), np.dtype(bfloat16)}
+
+
+def is_mixed(dtype) -> bool:
+    """True when `dtype` names a 16-bit compute policy with fp32 masters."""
+    return resolve(dtype) in _SIXTEEN_BIT
+
+
+def param_dtype(dtype) -> np.dtype:
+    """Storage dtype for params/updater state under the network dtype."""
+    d = resolve(dtype)
+    return np.dtype(np.float32) if d in _SIXTEEN_BIT else d
+
+
+def cast_floating(tree, dtype):
+    """Cast every floating-point leaf of a pytree to `dtype` (ints/bools
+    untouched). Identity for leaves already in `dtype`."""
+    import jax
+
+    d = np.dtype(dtype)
+
+    def _cast(a):
+        if hasattr(a, "dtype") and jnp.issubdtype(a.dtype, jnp.floating) \
+                and a.dtype != d:
+            return a.astype(d)
+        return a
+
+    return jax.tree.map(_cast, tree)
+
+
+def upcast_16(a):
+    """Promote a 16-bit floating array to fp32 (loss/eval heads compute in
+    fp32 under the mixed-precision policy); other dtypes pass through."""
+    if hasattr(a, "dtype") and np.dtype(a.dtype) in _SIXTEEN_BIT:
+        return a.astype(np.float32)
+    return a

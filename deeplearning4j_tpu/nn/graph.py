@@ -251,7 +251,9 @@ class ComputationGraph:
         if set(self.conf.input_shapes) != set(self.conf.inputs):
             missing = set(self.conf.inputs) - set(self.conf.input_shapes)
             raise ValueError(f"set_input_types missing for inputs {sorted(missing)}")
-        dtype = _dt.resolve(self.conf.dtype)
+        # mixed precision: 16-bit net dtypes keep fp32 master params
+        # (cast to the compute dtype inside _forward)
+        dtype = _dt.param_dtype(self.conf.dtype)
         shapes: Dict[str, Tuple[int, ...]] = {
             k: tuple(v) for k, v in self.conf.input_shapes.items()}
         key = jax.random.PRNGKey(self.conf.seed)
@@ -303,6 +305,10 @@ class ComputationGraph:
                                             jnp.floating)
                           and jnp.asarray(v).dtype != dt else v)
                       for k, v in inputs.items()}  # cast to net dtype (DL4J)
+        if _dt.is_mixed(self.conf.dtype):
+            # fp32 masters -> compute-dtype working copy; grads flow back
+            # through the cast and land in fp32
+            params = _dt.cast_floating(params, dt)
         acts: Dict[str, jax.Array] = dict(inputs)
         mks: Dict[str, Any] = dict(masks or {})
         new_state = dict(state)

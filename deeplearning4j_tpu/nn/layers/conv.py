@@ -165,14 +165,24 @@ class BatchNormalization(Layer):
         gamma = params.get("gamma")
         beta = params.get("beta")
         if train:
-            mean = jnp.mean(x, axis=reduce_axes)
-            var = jnp.var(x, axis=reduce_axes)
+            # moments in fp32: a bf16-accumulated mean over B*H*W elements
+            # loses ~3 decimal digits; the normalization itself stays in the
+            # compute dtype (stats cast back to x.dtype)
+            from ... import dtypes as _dt
+            xs = _dt.upcast_16(x)
+            mean = jnp.mean(xs, axis=reduce_axes)
+            var = jnp.var(xs, axis=reduce_axes)
             d = self.decay
-            new_state = {"mean": d * state["mean"] + (1 - d) * mean,
-                         "var": d * state["var"] + (1 - d) * var}
-            y = nnops.batch_norm(x, gamma, beta, mean, var, self.eps, axis)
+            new_state = {"mean": (d * state["mean"]
+                                  + (1 - d) * mean).astype(state["mean"].dtype),
+                         "var": (d * state["var"]
+                                 + (1 - d) * var).astype(state["var"].dtype)}
+            y = nnops.batch_norm(x, gamma, beta, mean.astype(x.dtype),
+                                 var.astype(x.dtype), self.eps, axis)
             return y, new_state, mask
-        y = nnops.batch_norm(x, gamma, beta, state["mean"], state["var"],
+        y = nnops.batch_norm(x, gamma, beta,
+                             state["mean"].astype(x.dtype),
+                             state["var"].astype(x.dtype),
                              self.eps, axis)
         return y, state, mask
 

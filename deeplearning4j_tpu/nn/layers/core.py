@@ -154,6 +154,9 @@ class _BaseOutput:
     """
 
     def loss_value(self, logits, labels, mask=None, weights=None):
+        from ... import dtypes as _dt
+        logits = _dt.upcast_16(logits)  # loss math in fp32 (mixed precision)
+        labels = _dt.upcast_16(labels)
         act, lname = self.activation, self.loss
         if act == "softmax" and lname in ("mcxent", "sparse_mcxent"):
             if lname == "sparse_mcxent":

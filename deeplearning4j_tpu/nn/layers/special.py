@@ -294,6 +294,9 @@ class CenterLossOutputLayer(Layer, _BaseOutput):
         ce = _BaseOutput.loss_value(self, logits, labels, mask, weights)
         if features is None or centers is None:
             return ce
+        from ... import dtypes as _dt
+        features = _dt.upcast_16(features)
+        labels = _dt.upcast_16(labels)
         cls_centers = jnp.matmul(labels, centers)  # one-hot pick
         center_term = jnp.mean(jnp.sum((features - cls_centers) ** 2, axis=-1))
         return ce + 0.5 * self.lambda_ * center_term
@@ -327,6 +330,9 @@ class Yolo2OutputLayer(Layer):
     def loss_value(self, pred, label, mask=None, weights=None):
         """label: [B, H, W, A*(5+C)] with per-anchor
         [objectness, tx, ty, tw, th, class...] — same layout as pred."""
+        from ... import dtypes as _dt
+        pred = _dt.upcast_16(pred)
+        label = _dt.upcast_16(label)
         A = len(self.boxes)
         B, H, W, D = pred.shape
         C = D // A - 5

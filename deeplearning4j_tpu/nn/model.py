@@ -96,7 +96,9 @@ class MultiLayerNetwork:
     def init(self) -> "MultiLayerNetwork":
         if self.conf.input_shape is None:
             raise ValueError("config needs input_type(...) to initialize")
-        dtype = _dt.resolve(self.conf.dtype)
+        # mixed precision: 16-bit net dtypes keep fp32 master params
+        # (cast to the compute dtype inside _forward)
+        dtype = _dt.param_dtype(self.conf.dtype)
         shape = tuple(self.conf.input_shape)
         key = jax.random.PRNGKey(self.conf.seed)
         params, state = {}, {}
@@ -128,6 +130,10 @@ class MultiLayerNetwork:
                 jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating) and \
                 jnp.asarray(x).dtype != dt:
             x = jnp.asarray(x, dt)  # cast inputs to the network dtype (DL4J)
+        if _dt.is_mixed(self.conf.dtype):
+            # fp32 masters -> compute-dtype working copy; grads flow back
+            # through the cast and land in fp32
+            params = _dt.cast_floating(params, dt)
         new_state = dict(state)
         for i, layer in enumerate(self.layers):
             si = str(i)
@@ -304,6 +310,11 @@ class MultiLayerNetwork:
                     "sequence (DL4J throws here too); use output() instead")
 
         def step(params, state, x, stream):
+            if _dt.is_mixed(self.conf.dtype):
+                cdt = _dt.resolve(self.conf.dtype)
+                params = _dt.cast_floating(params, cdt)
+                if jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating):
+                    x = jnp.asarray(x, cdt)  # match _forward's input cast
             new_stream = dict(stream)
             for i, layer in enumerate(self.layers):
                 si = str(i)

@@ -121,11 +121,20 @@ def load_model(path: str, load_updater: bool = True):
             model = MultiLayerNetwork(
                 MultiLayerConfiguration.from_json(conf_json))
         model.init()  # builds structure; then overwrite arrays
-        model.params = _npz_bytes_to_tree(zf.read("coefficients.npz"))
+
+        # mixed-precision policy: a pre-policy checkpoint may hold 16-bit
+        # params/updater state; masters are fp32 now, so upcast on load
+        # (no-op for checkpoints already saved under the policy)
+        from .. import dtypes as _dt
+        pdt = _dt.param_dtype(model.conf.dtype)
+
+        model.params = _dt.cast_floating(
+            _npz_bytes_to_tree(zf.read("coefficients.npz")), pdt)
         model.state = _npz_bytes_to_tree(zf.read("state.npz"))
         names = zf.namelist()
         if load_updater and "updaterState.npz" in names:
-            model.updater_state = _npz_bytes_to_tree(zf.read("updaterState.npz"))
+            model.updater_state = _dt.cast_floating(
+                _npz_bytes_to_tree(zf.read("updaterState.npz")), pdt)
         if "meta.json" in names:
             meta = json.loads(zf.read("meta.json"))
             model.iteration = meta.get("iteration", 0)

@@ -1,0 +1,139 @@
+"""Mixed-precision policy (SURVEY.md §7.3 item 8): a 16-bit network dtype
+selects the COMPUTE dtype only — params and updater state stay fp32 masters
+(reference† nd4j …/linalg/learning/ updater-state contracts expect full-
+precision state; mount empty, unverified). Validated against an fp32 oracle
+with tolerance bands."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import dtypes as _dt
+from deeplearning4j_tpu.nn.config import InputType, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.layers.core import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.updaters import Adam, Sgd
+from deeplearning4j_tpu.nn.model import MultiLayerNetwork
+from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+
+def _mln(dtype, seed=7):
+    from deeplearning4j_tpu.nn.layers.conv import BatchNormalization
+    conf = (NeuralNetConfiguration.builder()
+            .seed(seed)
+            .data_type(dtype)
+            .updater(Adam(learning_rate=1e-2))
+            .input_type(InputType.feed_forward(12))
+            .list(DenseLayer(n_out=24, activation="relu"),
+                  BatchNormalization(),
+                  DenseLayer(n_out=16, activation="tanh"),
+                  OutputLayer(n_out=3, loss="mcxent", activation="softmax"))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _data(n=64, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, 12)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, n)]
+    return x, y
+
+
+def test_bf16_net_keeps_fp32_masters():
+    net = _mln("BFLOAT16")
+    for leaf in jax.tree.leaves(net.params):
+        assert leaf.dtype == jnp.float32, "master params must be fp32"
+    for leaf in jax.tree.leaves(net.updater_state):
+        if jnp.issubdtype(leaf.dtype, jnp.floating):
+            assert leaf.dtype == jnp.float32, "updater state must be fp32"
+    # BN running stats are fp32 storage too
+    for leaf in jax.tree.leaves(net.state):
+        if jnp.issubdtype(leaf.dtype, jnp.floating):
+            assert leaf.dtype == jnp.float32
+
+
+def test_bf16_compute_dtype_reaches_activations():
+    net = _mln("BFLOAT16")
+    x, _ = _data(8)
+    out = net._forward(net.params, jnp.asarray(x), net.state,
+                       train=False, rng=None)[0]
+    assert out.dtype == jnp.bfloat16, "activations must be bf16"
+
+
+def test_bf16_training_tracks_fp32_oracle():
+    x, y = _data(64)
+    ref = _mln("FLOAT")
+    mix = _mln("BFLOAT16")
+    ref_losses, mix_losses = [], []
+    for _ in range(20):
+        ref.fit(x, y)
+        mix.fit(x, y)
+        ref_losses.append(float(ref._score))
+        mix_losses.append(float(mix._score))
+    # params stay fp32 after stepping
+    for leaf in jax.tree.leaves(mix.params):
+        assert leaf.dtype == jnp.float32
+    # same trajectory within bf16 tolerance; both must actually learn
+    assert ref_losses[-1] < ref_losses[0] * 0.9
+    assert mix_losses[-1] < mix_losses[0] * 0.9
+    np.testing.assert_allclose(mix_losses, ref_losses, rtol=7e-2, atol=5e-2)
+
+
+def test_bf16_beats_pure_bf16_updates_long_horizon():
+    """The point of fp32 masters: tiny Adam deltas below bf16 resolution
+    still accumulate. A pure-bf16 weight update p - d drops deltas once
+    |d| < ~0.004|p| (8-bit mantissa); the master-weight path keeps them."""
+    rng = np.random.default_rng(1)
+    p0 = np.float32(1.0)
+    delta = np.float32(1e-3)
+    steps = 64
+    p_bf16 = jnp.bfloat16(p0)
+    p_master = jnp.float32(p0)
+    for _ in range(steps):
+        p_bf16 = (p_bf16 - jnp.bfloat16(delta)).astype(jnp.bfloat16)
+        p_master = p_master - jnp.float32(delta)
+    # bf16 at 1.0 has ULP 0.0078 > 2*delta: every subtraction rounds back up
+    assert float(p_master) == pytest.approx(1.0 - steps * 1e-3, rel=1e-4)
+    assert abs(float(p_bf16) - (1.0 - steps * 1e-3)) > 0.01
+
+
+def test_bf16_graph_engine_masters_and_step():
+    g = (NeuralNetConfiguration.builder()
+         .seed(3)
+         .data_type("BFLOAT16")
+         .updater(Sgd(learning_rate=0.1))
+         .graph_builder()
+         .add_inputs("in")
+         .set_input_types(InputType.feed_forward(10))
+         .add_layer("d1", DenseLayer(n_out=16, activation="relu"), "in")
+         .add_layer("out", OutputLayer(n_out=4, loss="mcxent",
+                                       activation="softmax"), "d1")
+         .set_outputs("out")
+         .build())
+    g = ComputationGraph(g).init()
+    for leaf in jax.tree.leaves(g.params):
+        assert leaf.dtype == jnp.float32
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(16, 10)).astype(np.float32)
+    y = np.eye(4, dtype=np.float32)[rng.integers(0, 4, 16)]
+    g.fit(x, y, epochs=2)
+    assert np.isfinite(float(g._score))
+    for leaf in jax.tree.leaves(g.params):
+        assert leaf.dtype == jnp.float32
+
+
+def test_bf16_net_serializes_and_resumes_fp32_masters(tmp_path):
+    from deeplearning4j_tpu.utils import serializer
+    net = _mln("BFLOAT16")
+    x, y = _data(32)
+    net.fit(x, y)
+    p = str(tmp_path / "mix.zip")
+    net.save(p)
+    net2 = type(net).load(p)
+    assert net2.conf.dtype == "BFLOAT16"
+    for leaf in jax.tree.leaves(net2.params):
+        assert leaf.dtype == jnp.float32
+    a = net.output(x[:4])
+    b = net2.output(x[:4])
+    np.testing.assert_allclose(np.asarray(a, np.float32),
+                               np.asarray(b, np.float32), rtol=1e-2)

@@ -169,8 +169,14 @@ def test_save_load_roundtrip(tmp_path):
 
 
 def test_bfloat16_save_load_roundtrip(tmp_path):
-    """ADVICE r1: bf16 params must survive the ZIP (np.savez can't store
-    ml_dtypes natively — serializer views them as uint16 + dtype sidecar)."""
+    """A BFLOAT16 net saves fp32 MASTER params (mixed-precision policy) and
+    restores with identical outputs; raw bf16 arrays still survive the npz
+    via the uint16-carrier path (ADVICE r1 — np.savez can't store ml_dtypes
+    natively)."""
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.utils.serializer import (_npz_bytes_to_tree,
+                                                     _tree_to_npz_bytes)
     conf = (NeuralNetConfiguration.builder().seed(7)
             .data_type("BFLOAT16")
             .updater(Adam(learning_rate=0.01))
@@ -178,13 +184,20 @@ def test_bfloat16_save_load_roundtrip(tmp_path):
             .list(DenseLayer(n_out=8, activation="relu"),
                   OutputLayer(n_out=2)).build())
     net = MultiLayerNetwork(conf).init()
-    assert str(net.params["0"]["W"].dtype) == "bfloat16"
+    assert str(net.params["0"]["W"].dtype) == "float32"  # fp32 masters
     path = os.path.join(tmp_path, "bf16.zip")
     net.save(path)
     net2 = MultiLayerNetwork.load(path)
-    assert str(net2.params["0"]["W"].dtype) == "bfloat16"
+    assert net2.conf.dtype == "BFLOAT16"
+    assert str(net2.params["0"]["W"].dtype) == "float32"
     x = np.random.default_rng(0).normal(size=(4, 2)).astype(np.float32)
     np.testing.assert_array_equal(net.output(x), net2.output(x))
+    # the bf16 uint16-carrier path, exercised directly
+    raw = {"a": jnp.asarray([1.5, -2.25], jnp.bfloat16)}
+    back = _npz_bytes_to_tree(_tree_to_npz_bytes(raw))
+    assert str(back["a"].dtype) == "bfloat16"
+    np.testing.assert_array_equal(np.asarray(back["a"], np.float32),
+                                  np.asarray(raw["a"], np.float32))
 
 
 def test_params_flat_roundtrip():
