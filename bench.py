@@ -1,20 +1,29 @@
 """Benchmark: prints ONE JSON line for the driver.
 
 Headline (round 2+): ResNet-50 ComputationGraph training on the real chip,
-reported as **MFU** (the BASELINE.md north-star metric: ≥35% on v5e-64)
-plus examples/sec and step time. Mixed precision per SURVEY.md §7.3 item 8:
-dtype="BFLOAT16" now means fp32 MASTER weights + updater state with bf16
-compute (activations/matmul/conv inputs cast inside the jitted step) — the
-exact policy the ≥35% target is defined over.
+reported as **MFU** (the BASELINE.md north-star metric: >=35%) plus
+examples/sec and step time. Mixed precision per SURVEY.md §7.3 item 8:
+dtype="BFLOAT16" means fp32 MASTER weights + updater state with bf16
+compute — the exact policy the >=35% target is defined over.
 
 Methodology notes (honesty over flattery):
-- Data is DEVICE-RESIDENT during timing: this measures the compiled-step
-  compute rate. Input-pipeline transfer is excluded — in production the
-  async prefetch overlaps it; over this environment's tunneled single chip
-  it cannot be overlapped and would dominate (~40ms per 77MB batch).
-- Timing forces a host readback of the final loss: on this PJRT plugin
-  ``block_until_ready`` returns before device work completes, so
-  dispatch-only timing would overstate throughput ~50x (measured).
+- Training runs through the framework's compiled on-device epoch loop
+  (``ComputationGraph._build_epoch_fn``: ``lax.scan`` of the fused
+  train step over device-resident batches) — a first-class framework
+  feature (tests/test_fit_on_device.py proves it bit-identical to the
+  per-batch ``fit()`` path), not a bench-only construct. Distinct
+  synthetic batches are uploaded ONCE before timing: this measures the
+  compiled-step compute rate; input-pipeline transfer is excluded (in
+  production async prefetch overlaps it; over this environment's
+  tunneled single chip it cannot be overlapped and would dominate).
+- Timing forces a host readback of the loss history at the end of each
+  measured chain: on this PJRT plugin ``block_until_ready`` returns
+  before device work completes, so dispatch-only timing would overstate
+  throughput ~50x (measured round 2). The readback itself costs a fixed
+  ~85 ms tunnel round-trip that has nothing to do with the training
+  step, so the step time is taken as the SLOPE between a long and a
+  short chain of epochs — the fixed RTT cancels; every step timed is a
+  real on-device training step on its own batch.
 - ``accuracy`` is null: synthetic data (zero-egress); LeNet-MNIST
   convergence is asserted in tests/test_model.py.
 - ``vs_baseline`` is null: the reference publishes no numbers
@@ -37,29 +46,40 @@ def main():
     from deeplearning4j_tpu.optimize.listeners import _detect_peak_flops
 
     rng = np.random.default_rng(0)
+    nsteps = 8  # distinct device-resident batches per epoch chain link
 
     def run(batch):
         net = resnet50(updater=Sgd(learning_rate=0.1),
                        dtype="BFLOAT16").init()
-        x = jax.device_put(jnp.asarray(
-            rng.normal(size=(batch, 224, 224, 3)).astype(np.float32),
+        xs = jax.device_put(jnp.asarray(
+            rng.normal(size=(nsteps, batch, 224, 224, 3)).astype(np.float32),
             dtype=jnp.bfloat16))
-        y = jax.device_put(jnp.asarray(
-            np.eye(1000, dtype=np.float32)[rng.integers(0, 1000, batch)],
+        ys = jax.device_put(jnp.asarray(
+            np.eye(1000, dtype=np.float32)[
+                rng.integers(0, 1000, (nsteps, batch))],
             dtype=jnp.bfloat16))
-        step = net._build_train_step()
+        xs.block_until_ready()
+        ep = net._build_epoch_fn()
         key = jax.random.PRNGKey(0)
-        params, opt, bn = net.params, net.updater_state, net.state
-        params, opt, bn, loss = step(params, opt, bn, jnp.int32(0), key,
-                                     (x,), (y,), (None,), (None,))
-        float(loss)  # compile + settle
-        steps = 20
-        t0 = time.perf_counter()
-        for i in range(1, steps + 1):
-            params, opt, bn, loss = step(params, opt, bn, jnp.int32(i), key,
-                                         (x,), (y,), (None,), (None,))
-        final_loss = float(loss)  # forces the whole chain
-        dt = (time.perf_counter() - t0) / steps
+
+        def chain(k_epochs):
+            params, opt, bn = jax.tree.map(
+                jnp.copy, (net.params, net.updater_state, net.state))
+            losses = None
+            t0 = time.perf_counter()
+            for e in range(k_epochs):
+                params, opt, bn, losses = ep(
+                    params, opt, bn, jnp.int32(e * nsteps),
+                    jax.random.fold_in(key, e), (xs,), (ys,))
+            fl = float(np.asarray(losses)[-1])  # forces the whole chain
+            return time.perf_counter() - t0, fl
+
+        chain(1)  # compile + settle
+        k_short, k_long = 2, 10
+        t_short = min(chain(k_short)[0] for _ in range(2))
+        t_long, final_loss = chain(k_long)
+        t_long = min(t_long, chain(k_long)[0])
+        dt = (t_long - t_short) / ((k_long - k_short) * nsteps)
         return net, dt, final_loss
 
     batch = 128
@@ -85,8 +105,9 @@ def main():
         "vs_baseline": None,
         "vs_baseline_reason": "reference publishes no benchmark numbers "
                               "(BASELINE.md: unavailable)",
-        "model": "ResNet-50 ComputationGraph, NHWC, 224x224, bf16, "
-                 "synthetic device-resident data",
+        "model": "ResNet-50 ComputationGraph, NHWC, 224x224, bf16 compute / "
+                 "fp32 master, on-device epoch loop, synthetic "
+                 "device-resident data",
         "batch": batch,
         "examples_per_sec": round(eps, 1),
         "step_time_ms": round(step_time * 1e3, 2),

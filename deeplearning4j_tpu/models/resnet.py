@@ -19,8 +19,7 @@ from typing import Sequence, Tuple
 from ..nn.config import InputType, NeuralNetConfiguration
 from ..nn.graph import ComputationGraph, GraphBuilder
 from ..nn.layers.conv import (BatchNormalization, ConvolutionLayer,
-                              GlobalPoolingLayer, SubsamplingLayer,
-                              ZeroPadding2D)
+                              GlobalPoolingLayer, SubsamplingLayer)
 from ..nn.layers.core import ActivationLayer, OutputLayer
 from ..nn.updaters import Adam
 from ..nn.vertices import ElementWiseVertex
@@ -101,17 +100,17 @@ def resnet(depth: int = 50, *, num_classes: int = 1000,
          .add_inputs("in")
          .set_input_types(InputType.convolutional(c, h, w, data_format="NHWC")))
 
-    # stem: 7x7/2 conv + BN + relu + 3x3/2 maxpool (zero-pad to keep parity
-    # with the canonical 'same'-style stem sizes)
-    g.add_layer("stem_pad", ZeroPadding2D(padding=(3, 3), data_format="NHWC"),
-                "in")
-    top = _conv_bn(g, "stem", "stem_pad", 64, (7, 7), (2, 2), act="relu")
-    g.add_layer("stem_poolpad", ZeroPadding2D(padding=(1, 1),
-                                              data_format="NHWC"), top)
+    # stem: 7x7/2 conv + BN + relu + 3x3/2 maxpool. Padding is folded into
+    # the conv/pool ops (shape-identical to an explicit ZeroPadding2D but
+    # avoids materializing padded copies of the two largest activations —
+    # XLA pad is an HBM round-trip).
+    top = _conv_bn(g, "stem", "in", 64, (7, 7), (2, 2), padding=(3, 3),
+                   act="relu")
     g.add_layer("stem_pool", SubsamplingLayer(kernel=(3, 3), stride=(2, 2),
+                                              padding=(1, 1),
                                               pool_type="max",
                                               data_format="NHWC"),
-                "stem_poolpad")
+                top)
     top = "stem_pool"
 
     block_fn = _bottleneck if bottleneck else _basic

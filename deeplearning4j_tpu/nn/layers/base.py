@@ -26,6 +26,11 @@ from typing import Any, Dict, Optional, Tuple
 
 LAYERS: Dict[str, type] = {}
 
+#: Exact layer classes known not to consume a PRNG key (see
+#: ``Layer.stochastic``). Populated by ``nn/layers/__init__.py`` once all
+#: built-in modules are registered.
+DETERMINISTIC_BUILTINS: set = set()
+
 
 def layer(kind: str):
     """Class decorator: make a dataclass layer and register for serde."""
@@ -40,6 +45,21 @@ def layer(kind: str):
 class Layer:
     kind = "base"
     name: Optional[str] = None
+
+    @property
+    def stochastic(self):
+        """Whether ``apply`` consumes the per-layer PRNG key. The engines
+        only split a key for stochastic layers — an unconditional per-vertex
+        ``jax.random.split`` costs ~30 HLO instructions per vertex, which on
+        a 107-vertex ResNet-50 is thousands of dead threefry ops bloating
+        the compiled program.
+
+        Membership is by EXACT type in ``DETERMINISTIC_BUILTINS`` (filled by
+        ``nn/layers/__init__.py``) so user subclasses of a deterministic
+        built-in fall back to the conservative True default and still get a
+        key; a subclass may also just set ``stochastic = False/True`` as a
+        class attribute (shadows this property via the MRO)."""
+        return type(self) not in DETERMINISTIC_BUILTINS
 
     # -- to be implemented by subclasses ------------------------------------
     def initialize(self, key, input_shape, dtype):

@@ -274,6 +274,10 @@ class Bidirectional(_RecurrentLayer):
     # needs the full future); DL4J throws the same way
     supports_streaming = False
 
+    @property
+    def stochastic(self):
+        return getattr(self.layer, "stochastic", True)
+
     def initialize(self, key, input_shape, dtype):
         k1, k2 = jax.random.split(key)
         p_fw, _, out = self.layer.initialize(k1, input_shape, dtype)

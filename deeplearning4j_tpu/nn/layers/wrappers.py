@@ -103,6 +103,10 @@ class TimeDistributed(Layer):
     layer: Any = None
     name: Optional[str] = None
 
+    @property
+    def stochastic(self):
+        return getattr(self.layer, "stochastic", True)
+
     def has_params(self):
         return self.layer.has_params()
 

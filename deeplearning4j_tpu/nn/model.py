@@ -139,7 +139,7 @@ class MultiLayerNetwork:
             si = str(i)
             p = params.get(si, {})
             s = state.get(si, {})
-            if rng is not None:
+            if rng is not None and getattr(layer, "stochastic", True):
                 rng, sub = jax.random.split(rng)
             else:
                 sub = None

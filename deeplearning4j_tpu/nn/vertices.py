@@ -46,6 +46,15 @@ def vertex(kind: str):
 class GraphVertex:
     kind = "base"
 
+    @property
+    def stochastic(self):
+        """Whether apply() consumes a PRNG key — the engine only splits keys
+        for stochastic vertices (see Layer.stochastic for why). Built-in
+        vertices are deterministic (exact-type check below, so user vertex
+        subclasses keep the conservative True default); LayerVertex
+        overrides this to delegate to its layer."""
+        return type(self) not in _DETERMINISTIC_VERTICES
+
     def initialize(self, key, input_shapes: List[Tuple[int, ...]], dtype):
         """-> (params, state, output_shape)"""
         return {}, {}, tuple(input_shapes[0])
@@ -103,6 +112,10 @@ class LayerVertex(GraphVertex):
 
     def __post_init__(self):
         self._flatten = False
+
+    @property
+    def stochastic(self):
+        return getattr(self.layer, "stochastic", True)
 
     def has_params(self) -> bool:
         return self.layer.has_params()
@@ -419,3 +432,11 @@ class DotProductAttentionVertex(GraphVertex):
         # softmax — propagating it downstream would mis-mask a T_q sequence
         out_mask = masks[0] if masks else None
         return y, state, out_mask
+
+
+#: Exact built-in vertex classes that never consume a PRNG key (all of
+#: them; LayerVertex is excluded because its property delegates to the
+#: wrapped layer). User GraphVertex subclasses are not in the set, so they
+#: keep the conservative stochastic=True default and always receive a key.
+_DETERMINISTIC_VERTICES = frozenset(
+    cls for cls in VERTICES.values() if cls is not LayerVertex)
