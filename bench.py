@@ -75,11 +75,16 @@ def main():
             return time.perf_counter() - t0, fl
 
         chain(1)  # compile + settle
-        k_short, k_long = 2, 10
-        t_short = min(chain(k_short)[0] for _ in range(2))
-        t_long, final_loss = chain(k_long)
-        t_long = min(t_long, chain(k_long)[0])
-        dt = (t_long - t_short) / ((k_long - k_short) * nsteps)
+        # The tunneled chip is multi-tenant: observed chain throughput
+        # swings ~±20% minute to minute. Estimator: min over several
+        # 128-step chains — the least-contended window — with the fixed
+        # ~85 ms readback RTT left IN the divisor (≈0.7 ms/step,
+        # pessimistic direction). Slope/subtraction schemes were rejected:
+        # under multiplicative contention noise they can bias LOW.
+        k = 16
+        runs = [chain(k) for _ in range(5)]
+        final_loss = runs[0][1]
+        dt = min(r[0] for r in runs) / (k * nsteps)
         return net, dt, final_loss
 
     batch = 128

@@ -72,6 +72,13 @@ class Environment:
         # Default CNN data format for layers ("NCHW" = DL4J default; "NHWC"
         # is the TPU-preferred layout zoo/bench configs use).
         self.default_data_format = os.environ.get("DL4J_TPU_DATA_FORMAT", "NCHW")
+        # XLA latency-hiding scheduler for the engines' TPU programs:
+        # overlaps the async HBM copies (weight/activation layout
+        # conversions) with compute. Measured ~3% faster ResNet-50 bf16
+        # train step on v5e; harmless single-chip, designed for multi-chip
+        # collective overlap. DL4J_TPU_LHS=0 disables.
+        self.latency_hiding_scheduler = os.environ.get(
+            "DL4J_TPU_LHS", "1") == "1"
 
     @classmethod
     def instance(cls) -> "Environment":
@@ -87,6 +94,22 @@ class Environment:
 
 
 _DEFAULT_BACKEND = None  # cached: backend probing is the only expensive part
+
+
+def engine_compiler_options():
+    """``compiler_options`` for the engines' jitted train/epoch programs.
+
+    TPU-only (CPU/GPU backends reject unknown TPU flags): enables the XLA
+    latency-hiding scheduler unless Environment disables it. Returns None
+    when there is nothing to apply (jax.jit treats None as default)."""
+    global _DEFAULT_BACKEND
+    if _DEFAULT_BACKEND is None:
+        _DEFAULT_BACKEND = jax.default_backend()
+    if _DEFAULT_BACKEND != "tpu":
+        return None
+    if not Environment.instance().latency_hiding_scheduler:
+        return None
+    return {"xla_tpu_enable_latency_hiding_scheduler": "true"}
 
 
 def _resolved_f32_precision():

@@ -87,7 +87,7 @@ def _basic(g: GraphBuilder, name: str, inp: str, channels: int,
 def resnet(depth: int = 50, *, num_classes: int = 1000,
            input_shape: Tuple[int, int, int] = (224, 224, 3),
            updater=None, seed: int = 1234,
-           dtype: str = "FLOAT") -> ComputationGraph:
+           dtype: str = "FLOAT", s2d_stem: bool = True) -> ComputationGraph:
     """Build a ResNet ComputationGraph. input_shape is NHWC-style (H, W, C)."""
     if depth not in _SPECS:
         raise ValueError(f"depth must be one of {sorted(_SPECS)}")
@@ -103,9 +103,22 @@ def resnet(depth: int = 50, *, num_classes: int = 1000,
     # stem: 7x7/2 conv + BN + relu + 3x3/2 maxpool. Padding is folded into
     # the conv/pool ops (shape-identical to an explicit ZeroPadding2D but
     # avoids materializing padded copies of the two largest activations —
-    # XLA pad is an HBM round-trip).
-    top = _conv_bn(g, "stem", "in", 64, (7, 7), (2, 2), padding=(3, 3),
-                   act="relu")
+    # XLA pad is an HBM round-trip). The conv itself runs through the
+    # space-to-depth rearrangement when the spatial dims are even
+    # (numerically identical, same stored weights — see
+    # SpaceToDepthStemConv) so the MXU is not starved by 3 input channels.
+    if s2d_stem and h % 2 == 0 and w % 2 == 0:
+        from ..nn.layers.conv_extra import SpaceToDepthStemConv
+        g.add_layer("stem_conv", SpaceToDepthStemConv(n_out=64,
+                                                      weight_init="relu"),
+                    "in")
+        g.add_layer("stem_bn", BatchNormalization(data_format="NHWC"),
+                    "stem_conv")
+        g.add_layer("stem_act", ActivationLayer(activation="relu"), "stem_bn")
+        top = "stem_act"
+    else:
+        top = _conv_bn(g, "stem", "in", 64, (7, 7), (2, 2), padding=(3, 3),
+                       act="relu")
     g.add_layer("stem_pool", SubsamplingLayer(kernel=(3, 3), stride=(2, 2),
                                               padding=(1, 1),
                                               pool_type="max",
@@ -147,7 +160,13 @@ def estimate_flops_per_example(net: ComputationGraph) -> float:
             continue
         lyr = v.layer
         out_shape = net._shapes[name]
-        if isinstance(lyr, ConvolutionLayer):
+        from ..nn.layers.conv_extra import SpaceToDepthStemConv
+        if isinstance(lyr, SpaceToDepthStemConv):
+            # same MACs as the 7x7 conv it re-expresses
+            oh, ow, co = out_shape
+            in_shape = net._shapes.get(ins[0]) or net.conf.input_shapes[ins[0]]
+            flops += 2.0 * 49 * in_shape[-1] * co * oh * ow
+        elif isinstance(lyr, ConvolutionLayer):
             kh, kw = (lyr.kernel if isinstance(lyr.kernel, tuple)
                       else (lyr.kernel, lyr.kernel))
             if lyr.data_format == "NHWC":

@@ -43,6 +43,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .. import dtypes as _dt
+from .. import environment as _env
 from ..data.dataset import (DataSet, DataSetIterator, MultiDataSet,
                             MultiDataSetIterator, NumpyMultiDataSetIterator)
 from ..ops import losses as _loss
@@ -419,7 +420,8 @@ class ComputationGraph:
                 self.conf.constraints, new_params, skip=frozen_keys)
             return new_params, new_opt, new_bn, loss
 
-        return jax.jit(step_fn, donate_argnums=(0, 1, 2))
+        return jax.jit(step_fn, donate_argnums=(0, 1, 2),
+                       compiler_options=_env.engine_compiler_options())
 
     # ------------------------------------------------- on-device epoch loop
     def _build_epoch_fn(self):
@@ -451,7 +453,8 @@ class ComputationGraph:
                 body, (params, opt_state, bn_state, start_step), (xs, ys))
             return params, opt_state, bn_state, losses
 
-        return jax.jit(epoch_fn, donate_argnums=(0, 1, 2))
+        return jax.jit(epoch_fn, donate_argnums=(0, 1, 2),
+                       compiler_options=_env.engine_compiler_options())
 
     def fit_on_device(self, features, labels, epochs: int = 1,
                       batch_size: Optional[int] = None) -> np.ndarray:

@@ -27,6 +27,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .. import dtypes as _dt
+from .. import environment as _env
 from ..data.dataset import DataSet, DataSetIterator, NumpyDataSetIterator
 from . import constraints as _constraints
 from ..ops import losses as _loss
@@ -230,7 +231,8 @@ class MultiLayerNetwork:
 
         # donate params/opt/bn buffers: in-place update on device (workspace
         # arenas' moral equivalent, handled by XLA)
-        return jax.jit(step_fn, donate_argnums=(0, 1, 2))
+        return jax.jit(step_fn, donate_argnums=(0, 1, 2),
+                       compiler_options=_env.engine_compiler_options())
 
     def fit(self, data, labels=None, epochs: int = 1) -> "MultiLayerNetwork":
         """DL4J fit(): accepts DataSetIterator, DataSet, or (features, labels)."""
