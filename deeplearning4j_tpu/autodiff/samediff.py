@@ -138,14 +138,58 @@ class SDVariable:
 
 
 class _OpRecord:
-    __slots__ = ("op", "inputs", "output", "attrs")
+    """One recorded op application. ``outputs`` is a list — multi-output ops
+    (split/unstack/top_k, nd4j multi-output DynamicCustomOps) bind every
+    element of the returned tuple to its own graph name. Control-flow
+    records (op ``__cond__``/``__while__``/``__scan__``) carry their traced
+    subgraphs in ``attrs`` (see SameDiff.cond)."""
+    __slots__ = ("op", "inputs", "outputs", "attrs")
 
-    def __init__(self, op: str, inputs: List[str], output: str,
-                 attrs: Dict[str, Any]):
+    def __init__(self, op: str, inputs: List[str], outputs, attrs: Dict[str, Any]):
         self.op = op
         self.inputs = inputs
-        self.output = output
+        self.outputs = [outputs] if isinstance(outputs, str) else list(outputs)
         self.attrs = attrs
+
+    @property
+    def output(self) -> str:
+        return self.outputs[0]
+
+    def referenced(self) -> List[str]:
+        """All graph names this record reads — its direct inputs plus, for
+        control flow, everything its subgraphs read (captured parent
+        references included; formals excluded is unnecessary for
+        reachability since formals map back to inputs anyway)."""
+        names = list(self.inputs)
+        for key in ("true", "false", "cond", "body"):
+            sub = self.attrs.get(key)
+            if isinstance(sub, _Subgraph):
+                for rec in sub.ops:
+                    names.extend(rec.referenced())
+        return names
+
+
+class _Subgraph:
+    """A traced sub-program for control flow: formal parameter names, result
+    names, and the op list. Ops may reference names from the ENCLOSING graph
+    (captured constants/variables) — at execution the subgraph environment
+    is seeded with the parent environment."""
+    __slots__ = ("params", "results", "ops")
+
+    def __init__(self, params: List[str], results: List[str],
+                 ops: List[_OpRecord]):
+        self.params = list(params)
+        self.results = list(results)
+        self.ops = list(ops)
+
+    def to_dict(self):
+        return {"params": self.params, "results": self.results,
+                "ops": [_op_to_dict(r) for r in self.ops]}
+
+    @staticmethod
+    def from_dict(d):
+        return _Subgraph(d["params"], d["results"],
+                         [_op_from_dict(od) for od in d["ops"]])
 
 
 class SameDiff:
@@ -159,6 +203,22 @@ class SameDiff:
         self._fn_cache: Dict[Tuple, Callable] = {}
         self.updater = None
         self.loss_name: Optional[str] = None
+        self._listeners: List[Any] = []
+        self.iteration = 0
+        self.epoch = 0
+        self._score = float("nan")
+
+    # listener-facing Model protocol (Score/Collect/Checkpoint listeners)
+    def score(self) -> float:
+        return self._score
+
+    def set_listeners(self, *listeners) -> "SameDiff":
+        self._listeners = list(listeners)
+        return self
+
+    def add_listener(self, l) -> "SameDiff":
+        self._listeners.append(l)
+        return self
 
     @staticmethod
     def create() -> "SameDiff":
@@ -219,6 +279,125 @@ class SameDiff:
         self._fn_cache.clear()
         return v
 
+    def call_multi(self, op_name: str, *inputs: SDVariable, n_outputs: int,
+                   name: Optional[str] = None,
+                   attrs: Optional[Dict[str, Any]] = None,
+                   **kw_attrs) -> Tuple[SDVariable, ...]:
+        """Record a MULTI-OUTPUT catalog op (split/unstack/top_k/...; nd4j
+        multi-output DynamicCustomOp equivalent). The op must return a
+        tuple/list of ``n_outputs`` arrays; each element gets its own graph
+        name ``<base>__k``."""
+        if _catalog.lookup(op_name) is None:
+            raise ValueError(f"unknown op {op_name!r} (not in the catalog)")
+        if n_outputs < 1:
+            raise ValueError("n_outputs must be >= 1")
+        attrs = dict(attrs or {})
+        attrs.update(kw_attrs)
+        for v in inputs:
+            if v.name not in self._vars:
+                raise ValueError(f"input {v.name!r} is not in this graph")
+        base = name or self._fresh(op_name.split(".")[-1])
+        outs = [base if k == 0 else f"{base}__{k}" for k in range(n_outputs)]
+        vs = tuple(self._register(o, ARRAY) for o in outs)
+        self._ops.append(_OpRecord(op_name, [i.name for i in inputs], outs, attrs))
+        self._fn_cache.clear()
+        return vs
+
+    # ------------------------------------------------------------ control flow
+    def _trace_subgraph(self, fn: Callable, formals: Sequence[SDVariable]):
+        """Run a Python builder function while recording into a fresh op
+        list. The builder receives this SameDiff (so constants/captured
+        variables land in the shared registry) plus the formal SDVariables;
+        it returns one SDVariable or a tuple."""
+        outer_ops = self._ops
+        self._ops = []
+        try:
+            res = fn(self, *formals)
+        finally:
+            sub_ops, self._ops = self._ops, outer_ops
+        res_vars = list(res) if isinstance(res, (tuple, list)) else [res]
+        return _Subgraph([f.name for f in formals],
+                         [r.name for r in res_vars], sub_ops), res_vars
+
+    def cond(self, pred: SDVariable, true_fn: Callable, false_fn: Callable,
+             *operands: SDVariable, name: Optional[str] = None
+             ) -> Tuple[SDVariable, ...]:
+        """``lax.cond`` record (nd4j If/Switch-Merge equivalent). Both
+        branch builders get ``(sd, *formal_operands)`` and must return
+        structurally matching outputs. Returns the output SDVariables
+        (tuple even for a single output)."""
+        formals = [self._register(self._fresh("cond_arg"), ARRAY)
+                   for _ in operands]
+        sub_t, res_t = self._trace_subgraph(true_fn, formals)
+        formals_f = [self._register(self._fresh("cond_arg"), ARRAY)
+                     for _ in operands]
+        sub_f, res_f = self._trace_subgraph(false_fn, formals_f)
+        if len(res_t) != len(res_f):
+            raise ValueError(
+                f"cond branches return {len(res_t)} vs {len(res_f)} outputs")
+        base = name or self._fresh("cond")
+        outs = [base if k == 0 else f"{base}__{k}" for k in range(len(res_t))]
+        vs = tuple(self._register(o, ARRAY) for o in outs)
+        self._ops.append(_OpRecord(
+            "__cond__", [pred.name] + [o.name for o in operands], outs,
+            {"true": sub_t, "false": sub_f}))
+        self._fn_cache.clear()
+        return vs
+
+    def while_loop(self, cond_fn: Callable, body_fn: Callable,
+                   *loop_vars: SDVariable, name: Optional[str] = None
+                   ) -> Tuple[SDVariable, ...]:
+        """``lax.while_loop`` record (nd4j While equivalent). ``cond_fn``
+        returns a scalar-bool SDVariable; ``body_fn`` returns new loop vars
+        (same structure). Reverse-mode gradients through a while loop are
+        not defined (same as JAX); use scan for differentiable loops."""
+        formals_c = [self._register(self._fresh("while_arg"), ARRAY)
+                     for _ in loop_vars]
+        sub_c, res_c = self._trace_subgraph(cond_fn, formals_c)
+        if len(res_c) != 1:
+            raise ValueError("while_loop cond_fn must return one scalar bool")
+        formals_b = [self._register(self._fresh("while_arg"), ARRAY)
+                     for _ in loop_vars]
+        sub_b, res_b = self._trace_subgraph(body_fn, formals_b)
+        if len(res_b) != len(loop_vars):
+            raise ValueError(
+                f"while_loop body returns {len(res_b)} values for "
+                f"{len(loop_vars)} loop vars")
+        base = name or self._fresh("while")
+        outs = [base if k == 0 else f"{base}__{k}"
+                for k in range(len(loop_vars))]
+        vs = tuple(self._register(o, ARRAY) for o in outs)
+        self._ops.append(_OpRecord("__while__", [o.name for o in loop_vars],
+                                   outs, {"cond": sub_c, "body": sub_b}))
+        self._fn_cache.clear()
+        return vs
+
+    def scan(self, body_fn: Callable, carry: Sequence[SDVariable],
+             xs: Sequence[SDVariable], name: Optional[str] = None
+             ) -> Tuple[Tuple[SDVariable, ...], Tuple[SDVariable, ...]]:
+        """``lax.scan`` record: ``body_fn(sd, *carry, *x_slices)`` returns
+        ``(*new_carry, *y_slices)``. ``xs`` are scanned over their leading
+        axis. Returns ``(final_carry_vars, stacked_y_vars)``. Differentiable
+        (the TPU-native way to express sequential loops)."""
+        carry = list(carry)
+        xs = list(xs)
+        formals = [self._register(self._fresh("scan_arg"), ARRAY)
+                   for _ in range(len(carry) + len(xs))]
+        sub, res = self._trace_subgraph(body_fn, formals)
+        n_carry = len(carry)
+        n_ys = len(res) - n_carry
+        if n_ys < 0:
+            raise ValueError("scan body must return at least the new carry")
+        base = name or self._fresh("scan")
+        outs = [base if k == 0 else f"{base}__{k}"
+                for k in range(n_carry + n_ys)]
+        vs = tuple(self._register(o, ARRAY) for o in outs)
+        self._ops.append(_OpRecord(
+            "__scan__", [c.name for c in carry] + [x.name for x in xs], outs,
+            {"body": sub, "n_carry": n_carry}))
+        self._fn_cache.clear()
+        return vs[:n_carry], vs[n_carry:]
+
     # nd4j namespace sugar (sd.nn()/sd.math() style collapsed to methods)
     def relu(self, x, name=None):
         return self.call("act.relu", x, name=name)
@@ -242,12 +421,71 @@ class SameDiff:
         env: Dict[str, jnp.ndarray] = {}
         env.update(values)
         env.update(feeds)
-        for rec in self._ops:
-            fn = _catalog.get(rec.op).fn
-            args = [env[i] for i in rec.inputs]
-            attrs = {k: _attr_in(v) for k, v in rec.attrs.items()}
-            env[rec.output] = fn(*args, **attrs)
+        self._exec_ops(self._ops, env)
         return env
+
+    def _exec_ops(self, ops: List[_OpRecord], env: Dict[str, jnp.ndarray]):
+        """Execute a recorded op list into ``env`` (shared by subgraphs —
+        the recursion point for control flow)."""
+        for rec in ops:
+            if rec.op == "__cond__":
+                pred = jnp.asarray(env[rec.inputs[0]], bool).reshape(())
+                operands = tuple(env[i] for i in rec.inputs[1:])
+                t, f = rec.attrs["true"], rec.attrs["false"]
+                res = jax.lax.cond(pred,
+                                   self._subgraph_fn(t, env),
+                                   self._subgraph_fn(f, env), operands)
+            elif rec.op == "__while__":
+                operands = tuple(env[i] for i in rec.inputs)
+                c, b = rec.attrs["cond"], rec.attrs["body"]
+                cf = self._subgraph_fn(c, env)
+                bf = self._subgraph_fn(b, env)
+                res = jax.lax.while_loop(
+                    lambda vs: jnp.asarray(cf(vs)[0], bool).reshape(()),
+                    bf, operands)
+            elif rec.op == "__scan__":
+                n_carry = int(rec.attrs["n_carry"])
+                carry0 = tuple(env[i] for i in rec.inputs[:n_carry])
+                xs = tuple(env[i] for i in rec.inputs[n_carry:])
+                bf = self._subgraph_fn(rec.attrs["body"], env)
+
+                def scan_body(carry, x_slices, _bf=bf, _n=n_carry):
+                    out = _bf(tuple(carry) + tuple(x_slices))
+                    return out[:_n], out[_n:]
+                final, ys = jax.lax.scan(scan_body, carry0, xs)
+                res = tuple(final) + tuple(ys)
+            else:
+                fn = _catalog.get(rec.op).fn
+                args = [env[i] for i in rec.inputs]
+                attrs = {k: _attr_in(v) for k, v in rec.attrs.items()}
+                res = fn(*args, **attrs)
+            if len(rec.outputs) == 1:
+                env[rec.outputs[0]] = res if not isinstance(res, (tuple, list)) \
+                    else res[0]
+            else:
+                if not isinstance(res, (tuple, list)) or \
+                        len(res) != len(rec.outputs):
+                    got = (len(res) if isinstance(res, (tuple, list))
+                           else type(res).__name__)
+                    raise ValueError(
+                        f"op {rec.op!r} bound to {len(rec.outputs)} outputs "
+                        f"but returned {got}")
+                for o, r in zip(rec.outputs, res):
+                    env[o] = r
+
+    def _subgraph_fn(self, sub: _Subgraph, parent_env: Dict[str, jnp.ndarray]):
+        """Callable over a tuple of operand values; the subgraph environment
+        is seeded with a SNAPSHOT of the parent env so captured names
+        (constants, variables, earlier results) resolve — they become
+        closure constants of the traced branch, exactly lax semantics."""
+        captured = dict(parent_env)
+
+        def run(operand_vals):
+            env = dict(captured)
+            env.update(zip(sub.params, operand_vals))
+            self._exec_ops(sub.ops, env)
+            return tuple(env[r] for r in sub.results)
+        return run
 
     def _session(self, targets: Tuple[str, ...]) -> Callable:
         """Compile-once-execute-many (InferenceSession equivalent): one jit
@@ -274,8 +512,9 @@ class SameDiff:
         return {k: np.asarray(v) for k, v in out.items()}
 
     def _needed_placeholders(self, targets) -> set:
-        """Backward reachability: which placeholders feed the targets."""
-        producers = {r.output: r for r in self._ops}
+        """Backward reachability: which placeholders feed the targets
+        (traverses control-flow subgraphs via _OpRecord.referenced)."""
+        producers = {o: r for r in self._ops for o in r.outputs}
         need, stack = set(), list(targets)
         seen = set()
         while stack:
@@ -288,7 +527,7 @@ class SameDiff:
                 need.add(n)
             rec = producers.get(n)
             if rec:
-                stack.extend(rec.inputs)
+                stack.extend(rec.referenced())
         return need
 
     # ------------------------------------------------------------- training
@@ -320,9 +559,14 @@ class SameDiff:
             train, other, {k: jnp.asarray(v) for k, v in feeds.items()})
         return {k: np.asarray(v) for k, v in g.items()}
 
-    def fit(self, feeds_iter, epochs: int = 1) -> List[float]:
+    def fit(self, feeds_iter, epochs: int = 1, listeners: Optional[List] = None
+            ) -> "History":
         """Minibatch training. feeds_iter: iterable of feed dicts (or a single
-        dict). Returns per-step losses (History equivalent)."""
+        dict). Returns a History (loss curve + per-epoch averages — nd4j
+        ``History``†). ``listeners`` (or ones attached via set_listeners)
+        receive the same iteration_done/on_epoch_end callbacks as the nn
+        engines; ``self`` quacks enough like a Model for Score/Collect/
+        Checkpoint listeners (score(), iteration, epoch, save())."""
         if self.loss_name is None or self.updater is None:
             raise ValueError("set_loss(...) and set_updater(...) first")
         feeds_list = [feeds_iter] if isinstance(feeds_iter, dict) else list(feeds_iter)
@@ -359,20 +603,38 @@ class SameDiff:
         other_vals = {n: v for n, v in self._values.items()
                       if n not in train_names}
         opt_state = updater.init_state(train_vals)
-        losses = []
-        i = 0
+        cbs = list(self._listeners) + list(listeners or [])
+        history = History()
+        i = self.iteration
         for _ in range(epochs):
+            epoch_losses = []
             for feeds in feeds_list:
                 feeds = {k: jnp.asarray(v) for k, v in feeds.items()}
                 train_vals, opt_state, loss = step(
                     train_vals, opt_state, other_vals,
                     jnp.asarray(i, jnp.int32), feeds)
-                losses.append(float(loss))
+                loss = float(loss)
+                history.losses.append(loss)
+                epoch_losses.append(loss)
+                self._score = loss
                 i += 1
+                self.iteration = i
+                if cbs:
+                    # listeners may save/inspect: publish updated weights
+                    self._values.update(train_vals)
+                for cb in cbs:
+                    cb.iteration_done(self, i, self.epoch)
+            self.epoch += 1
+            history.epoch_losses.append(
+                sum(epoch_losses) / max(1, len(epoch_losses)))
+            if cbs:
+                self._values.update(train_vals)
+            for cb in cbs:
+                cb.on_epoch_end(self)
         self._values.update(train_vals)
         # no cache clear: sessions/steps take values as ARGUMENTS, so the
         # updated weights flow through; only graph mutation (call()) clears
-        return losses
+        return history
 
     # ------------------------------------------------------------ accessors
     def get_value(self, name: str) -> np.ndarray:
@@ -390,14 +652,12 @@ class SameDiff:
     # ------------------------------------------------------------ serde
     def to_json(self) -> str:
         return json.dumps({
-            "format_version": 1,
+            "format_version": 2,
             "model_class": "SameDiff",
             "variables": [{"name": v.name, "kind": v.kind,
                            "shape": list(v.shape) if v.shape else None}
                           for v in self._vars.values()],
-            "ops": [{"op": r.op, "inputs": r.inputs, "output": r.output,
-                     "attrs": {k: _attr_out(v) for k, v in r.attrs.items()}}
-                    for r in self._ops],
+            "ops": [_op_to_dict(r) for r in self._ops],
             "loss": self.loss_name,
             "updater": self.updater.to_dict() if self.updater else None,
         }, indent=2)
@@ -413,33 +673,68 @@ class SameDiff:
             sd._register(vd["name"], vd["kind"],
                          tuple(vd["shape"]) if vd.get("shape") else None)
         for od in d["ops"]:
-            sd._ops.append(_OpRecord(od["op"], list(od["inputs"]),
-                                     od["output"], dict(od.get("attrs", {}))))
+            sd._ops.append(_op_from_dict(od))
         sd.loss_name = d.get("loss")
         if d.get("updater"):
             sd.updater = _upd.Updater.from_dict(d["updater"])
         return sd
 
     def save(self, path: str) -> None:
-        """graph.json + values.npz in a zip (the .fb-equivalent artifact)."""
+        """graph.json + values.npz in a zip (the .fb-equivalent artifact).
+
+        Values are stored under positional npz keys with a JSON name table:
+        the shared tree serializer treats ``/`` as a nesting separator, but
+        SameDiff names are FLAT and TF-imported graphs are full of slashes
+        (``bert/encoder/...``)."""
         from ..utils.serializer import _tree_to_npz_bytes
+        names = list(self._values.keys())
         with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as zf:
             zf.writestr("graph.json", self.to_json())
+            zf.writestr("value_names.json", json.dumps(names))
             zf.writestr("values.npz", _tree_to_npz_bytes(
-                {k: v for k, v in self._values.items()}))
+                {f"v{i}": self._values[n] for i, n in enumerate(names)}))
 
     @staticmethod
     def load(path: str) -> "SameDiff":
         from ..utils.serializer import _npz_bytes_to_tree
         with zipfile.ZipFile(path, "r") as zf:
             sd = SameDiff.from_json(zf.read("graph.json").decode())
-            sd._values = dict(_npz_bytes_to_tree(zf.read("values.npz")))
+            tree = _npz_bytes_to_tree(zf.read("values.npz"))
+            if "value_names.json" in zf.namelist():
+                names = json.loads(zf.read("value_names.json").decode())
+                sd._values = {n: tree[f"v{i}"] for i, n in enumerate(names)}
+            else:  # round-2 artifact: flat keys, no slashes in names
+                sd._values = dict(tree)
         return sd
+
+
+class History:
+    """Training history (nd4j ``History``† — loss curve plus per-epoch
+    aggregates; evaluations attach via listeners). Iterable/indexable as the
+    per-iteration loss list for round-2 call-site compatibility."""
+
+    def __init__(self):
+        self.losses: List[float] = []        # one per iteration
+        self.epoch_losses: List[float] = []  # mean loss per epoch
+
+    def loss_curve(self) -> List[float]:
+        return list(self.losses)
+
+    def __len__(self):
+        return len(self.losses)
+
+    def __iter__(self):
+        return iter(self.losses)
+
+    def __getitem__(self, i):
+        return self.losses[i]
 
 
 def _attr_out(v):
     if isinstance(v, tuple):
         return list(v)
+    if isinstance(v, _Subgraph):
+        return {"__subgraph__": v.to_dict()}
     return v
 
 
@@ -447,3 +742,18 @@ def _attr_in(v):
     if isinstance(v, list):
         return tuple(v)
     return v
+
+
+def _op_to_dict(r: _OpRecord) -> Dict[str, Any]:
+    return {"op": r.op, "inputs": r.inputs, "outputs": list(r.outputs),
+            "attrs": {k: _attr_out(v) for k, v in r.attrs.items()}}
+
+
+def _op_from_dict(od: Dict[str, Any]) -> _OpRecord:
+    attrs = {}
+    for k, v in dict(od.get("attrs", {})).items():
+        if isinstance(v, dict) and "__subgraph__" in v:
+            v = _Subgraph.from_dict(v["__subgraph__"])
+        attrs[k] = v
+    outs = od["outputs"] if "outputs" in od else od["output"]  # v1 compat
+    return _OpRecord(od["op"], list(od["inputs"]), outs, attrs)

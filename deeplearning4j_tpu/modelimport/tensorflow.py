@@ -30,15 +30,24 @@ from ..autodiff.samediff import SameDiff, SDVariable
 
 
 class _Ctx:
-    def __init__(self, sd: SameDiff):
+    def __init__(self, sd: SameDiff, library: Optional[Dict] = None,
+                 prefix: str = ""):
         self.sd = sd
         self.vars: Dict[str, SDVariable] = {}     # tf tensor name -> SDVar
         self.consts: Dict[str, np.ndarray] = {}   # tf node name -> value
+        self.library = library or {}              # FunctionDef name -> def
+        self.prefix = prefix                      # graph-name prefix (fn bodies)
 
     def get(self, ref: str) -> SDVariable:
-        name = _strip(ref)
+        name, idx = _split_ref(ref)
+        if idx and f"{name}:{idx}" in self.vars:
+            return self.vars[f"{name}:{idx}"]
         if name not in self.vars:
             raise ValueError(f"reference to unknown tensor {ref!r}")
+        if idx:
+            raise ValueError(
+                f"reference {ref!r} wants output slot {idx} of a "
+                "single-output mapping")
         return self.vars[name]
 
     def const_value(self, ref: str) -> np.ndarray:
@@ -48,10 +57,35 @@ class _Ctx:
                 f"op needs a static value but input {ref!r} is not Const")
         return self.consts[name]
 
+    def local_key(self, node_name: str) -> str:
+        """Graph names inside function bodies are prefixed (``fn/node``);
+        tensor refs within the body use the unprefixed name."""
+        if self.prefix and node_name.startswith(self.prefix):
+            return node_name[len(self.prefix):]
+        return node_name
+
+    def set_const(self, node_name: str, value) -> None:
+        self.consts[self.local_key(node_name)] = value
+
+    def bind_outputs(self, node_name: str, vs) -> SDVariable:
+        """Register the extra output slots of a multi-output node."""
+        key = self.local_key(node_name)
+        for k, v in enumerate(vs):
+            if k:
+                self.vars[f"{key}:{k}"] = v
+        return vs[0]
+
 
 def _strip(ref: str) -> str:
     """'node:0' -> 'node'; control deps '^node' are filtered earlier."""
     return ref.split(":")[0]
+
+
+def _split_ref(ref: str):
+    """GraphDef 'node:1' / FunctionDef 'node:out_name:1' -> (node, 1)."""
+    parts = ref.split(":")
+    idx = int(parts[-1]) if len(parts) > 1 and parts[-1].isdigit() else 0
+    return parts[0], idx
 
 
 def _attr(node, key, default=None):
@@ -125,7 +159,20 @@ def _map_unary(node, ctx, ins):
     return ctx.sd.call(_UNARY[node.op], ctx.get(ins[0]), name=node.name)
 
 
+# numpy equivalents for import-time const-folding: TF shape arithmetic
+# (Shape -> StridedSlice -> Mul/Pack -> Reshape) must stay statically
+# resolvable for const-consuming mappers like Reshape/Tile/Fill
+_NP_BINARY = {"Add": np.add, "AddV2": np.add, "Sub": np.subtract,
+              "Mul": np.multiply, "RealDiv": np.divide, "Div": np.divide,
+              "FloorDiv": np.floor_divide, "Maximum": np.maximum,
+              "Minimum": np.minimum, "FloorMod": np.fmod}
+
+
 def _map_binary(node, ctx, ins):
+    if node.op in _NP_BINARY and all(_strip(i) in ctx.consts for i in ins):
+        ctx.set_const(node.name, _NP_BINARY[node.op](
+            np.asarray(ctx.consts[_strip(ins[0])]),
+            np.asarray(ctx.consts[_strip(ins[1])])))
     return ctx.sd.call(_BINARY[node.op], ctx.get(ins[0]), ctx.get(ins[1]),
                        name=node.name)
 
@@ -218,9 +265,16 @@ def _reduce(node, ctx, ins):
           "Min": "reduce.min", "Prod": "reduce.prod"}[node.op]
     axes = ctx.const_value(ins[1]).tolist()
     axes = axes if isinstance(axes, list) else [axes]
+    keep = bool(_attr(node, "keep_dims", False))
+    if _strip(ins[0]) in ctx.consts:  # tf.reduce_prod(shape) etc.
+        np_red = {"Mean": np.mean, "Sum": np.sum, "Max": np.max,
+                  "Min": np.min, "Prod": np.prod}[node.op]
+        ctx.set_const(node.name, np_red(
+            np.asarray(ctx.consts[_strip(ins[0])]),
+            axis=tuple(int(a) for a in axes) or None, keepdims=keep))
     return ctx.sd.call(op, ctx.get(ins[0]), name=node.name,
                        attrs={"axis": tuple(int(a) for a in axes),
-                              "keepdims": bool(_attr(node, "keep_dims", False))})
+                              "keepdims": keep})
 
 
 @tf_op("ArgMax", "ArgMin")
@@ -270,9 +324,12 @@ def _concat(node, ctx, ins):
 
 @tf_op("Pack")
 def _pack(node, ctx, ins):
+    axis = int(_attr(node, "axis", 0))
+    if all(_strip(i) in ctx.consts for i in ins):
+        ctx.set_const(node.name, np.stack(
+            [np.asarray(ctx.consts[_strip(i)]) for i in ins], axis=axis))
     return ctx.sd.call("shape.stack_v", *[ctx.get(i) for i in ins],
-                       name=node.name,
-                       attrs={"axis": int(_attr(node, "axis", 0))})
+                       name=node.name, attrs={"axis": axis})
 
 
 @tf_op("GatherV2", "Gather")
@@ -300,9 +357,160 @@ def _tile(node, ctx, ins):
 
 @tf_op("Cast")
 def _cast(node, ctx, ins):
-    # dtype tracking is owned by XLA here; pass-through (recorded divergence:
-    # the reference maps DstT; our catalog ops promote per jnp rules)
-    return ctx.sd.call("act.identity", ctx.get(ins[0]), name=node.name)
+    """Faithful Cast: maps DstT to a math.cast with the target dtype (the
+    reference maps DstT the same way; the former identity mapping silently
+    relied on jnp promotion — a landmine for int->float graphs)."""
+    from tensorflow.python.framework import dtypes as _tfd  # type: ignore
+    np_dt = np.dtype(_tfd.as_dtype(int(_attr(node, "DstT"))).as_numpy_dtype)
+    if _strip(ins[0]) in ctx.consts:
+        # const-fold so shape-arithmetic chains stay statically resolvable
+        ctx.set_const(node.name, np.asarray(
+            ctx.consts[_strip(ins[0])]).astype(np_dt))
+    return ctx.sd.call("math.cast", ctx.get(ins[0]), name=node.name,
+                       attrs={"dtype": np_dt.name})
+
+
+@tf_op("Shape")
+def _shape_op(node, ctx, ins):
+    """Static fold when the producer's shape is fully known (placeholders
+    and constants record shapes); otherwise a shape_of op — any consumer
+    that needs it as a STATIC value will raise the usual const error."""
+    var = ctx.get(ins[0])
+    if var.shape is not None and all(s is not None for s in var.shape):
+        val = np.asarray(var.shape, np.int32)
+        ctx.set_const(node.name, val)
+        return ctx.sd.constant(node.name, val)
+    return ctx.sd.call("shape.shape_of", var, name=node.name)
+
+
+@tf_op("StridedSlice")
+def _strided_slice(node, ctx, ins):
+    """Full StridedSlice: begin/end/ellipsis/new-axis/shrink-axis masks are
+    lowered to a numpy-style per-dim spec (shape.strided_slice_v2)."""
+    begin = np.asarray(ctx.const_value(ins[1])).reshape(-1).tolist()
+    end = np.asarray(ctx.const_value(ins[2])).reshape(-1).tolist()
+    strides = np.asarray(ctx.const_value(ins[3])).reshape(-1).tolist() \
+        if len(ins) > 3 else [1] * len(begin)
+    bm = int(_attr(node, "begin_mask", 0))
+    em = int(_attr(node, "end_mask", 0))
+    el = int(_attr(node, "ellipsis_mask", 0))
+    na = int(_attr(node, "new_axis_mask", 0))
+    sh = int(_attr(node, "shrink_axis_mask", 0))
+    spec = []
+    for i in range(len(begin)):
+        if (el >> i) & 1:
+            spec.append(["ellipsis"])
+        elif (na >> i) & 1:
+            spec.append(["newaxis"])
+        elif (sh >> i) & 1:
+            spec.append(["index", int(begin[i])])
+        else:
+            spec.append(["slice",
+                         None if (bm >> i) & 1 else int(begin[i]),
+                         None if (em >> i) & 1 else int(end[i]),
+                         int(strides[i])])
+    if _strip(ins[0]) in ctx.consts:
+        idx = tuple(slice(e[1], e[2], e[3]) if e[0] == "slice"
+                    else int(e[1]) if e[0] == "index"
+                    else None if e[0] == "newaxis" else Ellipsis
+                    for e in spec)
+        ctx.set_const(node.name, np.asarray(
+            ctx.consts[_strip(ins[0])])[idx])
+    return ctx.sd.call("shape.strided_slice_v2", ctx.get(ins[0]),
+                       name=node.name, attrs={"spec": spec})
+
+
+@tf_op("Split")
+def _split(node, ctx, ins):
+    axis = int(np.asarray(ctx.const_value(ins[0])))
+    num = int(_attr(node, "num_split"))
+    vs = ctx.sd.call_multi("shape.split", ctx.get(ins[1]), n_outputs=num,
+                           name=node.name,
+                           attrs={"indices_or_sections": num, "axis": axis})
+    return ctx.bind_outputs(node.name, vs)
+
+
+@tf_op("SplitV")
+def _split_v(node, ctx, ins):
+    sizes = np.asarray(ctx.const_value(ins[1])).reshape(-1).tolist()
+    axis = int(np.asarray(ctx.const_value(ins[2])))
+    if any(s < 0 for s in sizes):
+        raise ValueError("SplitV with -1 (inferred) size not supported")
+    cuts = np.cumsum(sizes)[:-1].tolist()
+    vs = ctx.sd.call_multi("shape.split", ctx.get(ins[0]),
+                           n_outputs=len(sizes), name=node.name,
+                           attrs={"indices_or_sections": [int(c) for c in cuts],
+                                  "axis": axis})
+    return ctx.bind_outputs(node.name, vs)
+
+
+@tf_op("Unpack")
+def _unpack(node, ctx, ins):
+    num = int(_attr(node, "num"))
+    axis = int(_attr(node, "axis", 0))
+    vs = ctx.sd.call_multi("shape.unstack", ctx.get(ins[0]), n_outputs=num,
+                           name=node.name, attrs={"axis": axis})
+    return ctx.bind_outputs(node.name, vs)
+
+
+@tf_op("TopKV2")
+def _topk(node, ctx, ins):
+    k = int(np.asarray(ctx.const_value(ins[1])))
+    vs = ctx.sd.call_multi("sort.top_k", ctx.get(ins[0]), n_outputs=2,
+                           name=node.name, attrs={"k": k})
+    return ctx.bind_outputs(node.name, vs)
+
+
+def _import_function(ctx, fn_name: str, formals, sd):
+    """Trace a GraphDef library FunctionDef as a SameDiff subgraph body.
+    ``formals`` are the subgraph's formal SDVariables (one per signature
+    input); returns the function's result SDVariables."""
+    if fn_name not in ctx.library:
+        raise ValueError(f"function {fn_name!r} not in graph library")
+    fdef = ctx.library[fn_name]
+    sub = _Ctx(sd, library=ctx.library, prefix=f"{ctx.prefix}{fn_name}/")
+    args = list(fdef.signature.input_arg)
+    if len(args) != len(formals):
+        raise ValueError(f"function {fn_name!r} takes {len(args)} args, "
+                         f"got {len(formals)}")
+    for arg, var in zip(args, formals):
+        sub.vars[arg.name] = var
+    _map_nodes(fdef.node_def, sub, trainable=False)
+    return [sub.get(fdef.ret[o.name]) for o in fdef.signature.output_arg]
+
+
+@tf_op("StatelessIf", "If")
+def _if(node, ctx, ins):
+    """tf.cond: branch FunctionDefs become SameDiff cond subgraphs."""
+    then_fn = _attr(node, "then_branch").name
+    else_fn = _attr(node, "else_branch").name
+    operands = [ctx.get(i) for i in ins[1:]]
+
+    def mk(fname):
+        def body(sd, *formals):
+            return tuple(_import_function(ctx, fname, formals, sd))
+        return body
+
+    vs = ctx.sd.cond(ctx.get(ins[0]), mk(then_fn), mk(else_fn), *operands,
+                     name=node.name)
+    return ctx.bind_outputs(node.name, vs)
+
+
+@tf_op("StatelessWhile", "While")
+def _while(node, ctx, ins):
+    """tf.while_loop: cond/body FunctionDefs become while subgraphs."""
+    cond_fn = _attr(node, "cond").name
+    body_fn = _attr(node, "body").name
+    loop_vars = [ctx.get(i) for i in ins]
+
+    def mk(fname):
+        def body(sd, *formals):
+            return tuple(_import_function(ctx, fname, formals, sd))
+        return body
+
+    vs = ctx.sd.while_loop(mk(cond_fn), mk(body_fn), *loop_vars,
+                           name=node.name)
+    return ctx.bind_outputs(node.name, vs)
 
 
 @tf_op("StopGradient", "Identity", "PreventGradient", "CheckNumerics")
@@ -344,7 +552,7 @@ def _range(node, ctx, ins):
     limit = np.asarray(ctx.const_value(ins[1]))
     delta = np.asarray(ctx.const_value(ins[2]))
     value = np.arange(start, limit, delta)
-    ctx.consts[node.name] = value
+    ctx.set_const(node.name, value)
     return ctx.sd.constant(node.name, value)
 
 
@@ -381,6 +589,63 @@ def _one_hot(node, ctx, ins):
                        attrs={"depth": depth})
 
 
+class _Renamed:
+    """Node shim that presents a prefixed graph name (function-body nodes
+    must not collide with main-graph names) while passing everything else
+    through to the proto node."""
+
+    def __init__(self, node, name):
+        self._node = node
+        self.name = name
+
+    def __getattr__(self, attr):
+        return getattr(self._node, attr)
+
+
+def _map_nodes(nodes, ctx: _Ctx, trainable: bool):
+    """Map a node list (GraphDef.node or FunctionDef.node_def) into
+    ``ctx.sd``. ``ctx.vars``/``ctx.consts`` are keyed by the LOCAL (tf)
+    names; SameDiff graph names carry ``ctx.prefix``."""
+    sd = ctx.sd
+    for node in nodes:
+        key = node.name
+        if ctx.prefix:
+            node = _Renamed(node, ctx.prefix + node.name)
+        ins = [i for i in node.input if not i.startswith("^")]
+        if node.op == "Const":
+            value = _tensor_value(node)
+            ctx.consts[key] = value
+            if value.dtype == np.object_ or value.dtype.kind == "U":
+                continue  # string consts (Assert messages): attr-only
+            if trainable and value.dtype.kind == "f" and value.ndim >= 1:
+                ctx.vars[key] = sd.var(node.name, value)
+            else:
+                ctx.vars[key] = sd.constant(node.name, value)
+        elif node.op in ("Placeholder", "PlaceholderV2"):
+            ctx.vars[key] = sd.placeholder(node.name, _attr_shape(node))
+        elif node.op in ("NoOp", "Assert"):
+            continue  # control-flow only; referenced via ^control deps
+        elif node.op in _UNARY:
+            ctx.vars[key] = _map_unary(node, ctx, ins)
+        elif node.op in _BINARY:
+            ctx.vars[key] = _map_binary(node, ctx, ins)
+        elif node.op in _MAPPERS:
+            ctx.vars[key] = _MAPPERS[node.op](node, ctx, ins)
+        elif node.op in ("Switch", "Merge", "Enter", "Exit",
+                         "NextIteration", "LoopCond"):
+            raise ValueError(
+                f"v1-style dataflow control flow ({node.op!r}, node "
+                f"{node.name!r}) is not supported — re-freeze with "
+                "convert_variables_to_constants_v2(..., "
+                "lower_control_flow=False) to keep functional "
+                "StatelessIf/StatelessWhile nodes, which import as "
+                "SameDiff cond/while subgraphs")
+        else:
+            raise ValueError(
+                f"unsupported TF op type {node.op!r} (node "
+                f"{node.name!r}) — extend modelimport/tensorflow.py")
+
+
 class TensorflowFrameworkImporter:
     """Reference-parity entry point (``TensorflowFrameworkImporter`` /
     ``TFGraphMapper.importGraph``†)."""
@@ -394,7 +659,11 @@ class TensorflowFrameworkImporter:
         ``trainable=True`` imports non-scalar FLOAT constants (the frozen
         model's weights) as trainable VARIABLEs, so the imported graph
         fine-tunes via ``sd.fit`` — the BERT-via-TF-import baseline path.
-        Scalar/int consts (shapes, axes, epsilons) stay constant."""
+        Scalar/int consts (shapes, axes, epsilons) stay constant.
+
+        Control flow: StatelessIf/If and StatelessWhile/While nodes import
+        their branch/cond/body FunctionDefs (``graph_def.library``) as
+        SameDiff cond/while subgraphs → ``lax.cond``/``lax.while_loop``."""
         if isinstance(graph_def, (bytes, bytearray)):
             from tensorflow.core.framework import graph_pb2  # type: ignore
             gd = graph_pb2.GraphDef()
@@ -402,33 +671,11 @@ class TensorflowFrameworkImporter:
             graph_def = gd
 
         sd = SameDiff()
-        ctx = _Ctx(sd)
-        for node in graph_def.node:
-            ins = [i for i in node.input if not i.startswith("^")]
-            if node.op == "Const":
-                value = _tensor_value(node)
-                ctx.consts[node.name] = value
-                if value.dtype == np.object_ or value.dtype.kind == "U":
-                    continue  # string consts (Assert messages): attr-only
-                if trainable and value.dtype.kind == "f" and value.ndim >= 1:
-                    ctx.vars[node.name] = sd.var(node.name, value)
-                else:
-                    ctx.vars[node.name] = sd.constant(node.name, value)
-            elif node.op in ("Placeholder", "PlaceholderV2"):
-                shape = _attr_shape(node)
-                ctx.vars[node.name] = sd.placeholder(node.name, shape)
-            elif node.op in ("NoOp", "Assert"):
-                continue  # control-flow only; referenced via ^control deps
-            elif node.op in _UNARY:
-                ctx.vars[node.name] = _map_unary(node, ctx, ins)
-            elif node.op in _BINARY:
-                ctx.vars[node.name] = _map_binary(node, ctx, ins)
-            elif node.op in _MAPPERS:
-                ctx.vars[node.name] = _MAPPERS[node.op](node, ctx, ins)
-            else:
-                raise ValueError(
-                    f"unsupported TF op type {node.op!r} (node "
-                    f"{node.name!r}) — extend modelimport/tensorflow.py")
+        library = {f.signature.name: f
+                   for f in graph_def.library.function} \
+            if graph_def.HasField("library") else {}
+        ctx = _Ctx(sd, library=library)
+        _map_nodes(graph_def.node, ctx, trainable)
         return sd
 
     @staticmethod

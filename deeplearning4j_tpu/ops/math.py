@@ -180,6 +180,15 @@ def _flatten2d(x):
     'keep the batch dim' is not expressible as a static reshape attr."""
     return jnp.reshape(x, (x.shape[0], -1))
 register("shape.split", category="shape")(jnp.split)
+
+
+@register("shape.unstack", category="shape")
+def _unstack(x, axis=0):
+    """TF Unpack / nd4j unstack: split along ``axis`` into rank-1-lower
+    pieces (multi-output; pairs with shape.stack)."""
+    n = x.shape[axis]
+    return tuple(jnp.squeeze(s, axis=axis)
+                 for s in jnp.split(x, n, axis=axis))
 register("shape.tile", category="shape")(jnp.tile)
 register("shape.repeat", category="shape")(jnp.repeat)
 register("shape.flip", category="shape")(jnp.flip)
@@ -197,6 +206,46 @@ def strided_slice(a, begin, end, strides=None):
     idx = tuple(slice(b, e, s) for b, e, s in
                 zip(begin, end, strides or [1] * len(begin)))
     return a[idx]
+
+
+@register("shape.strided_slice_v2", category="shape")
+def strided_slice_v2(a, spec):
+    """General numpy-style indexing from a serializable per-dim spec (the
+    lowering target for TF StridedSlice with begin/end/ellipsis/new-axis/
+    shrink-axis masks). Each spec entry is one of::
+
+        ["slice", begin|None, end|None, stride]   # a[b:e:s]
+        ["index", i]                              # a[i] (shrink axis)
+        ["newaxis"]                               # a[None]
+        ["ellipsis"]                              # a[...]
+    """
+    idx = []
+    for ent in spec:
+        kind = ent[0]
+        if kind == "slice":
+            idx.append(slice(ent[1], ent[2], ent[3]))
+        elif kind == "index":
+            idx.append(int(ent[1]))
+        elif kind == "newaxis":
+            idx.append(None)
+        elif kind == "ellipsis":
+            idx.append(Ellipsis)
+        else:
+            raise ValueError(f"bad strided-slice spec entry {ent!r}")
+    return a[tuple(idx)]
+
+
+@register("math.cast", category="math")
+def cast(a, dtype="float32"):
+    """Explicit dtype conversion (TF Cast / nd4j CastOp). ``dtype`` is a
+    string for graph-serializability; bfloat16 supported via jnp."""
+    return jnp.asarray(a).astype(jnp.dtype(dtype))
+
+
+@register("shape.shape_of", category="shape", differentiable=False)
+def shape_of(a):
+    """TF Shape: the (static under jit) shape as an int32 vector."""
+    return jnp.asarray(a.shape, jnp.int32)
 
 
 @register("shape.one_hot", category="shape", differentiable=False)
