@@ -285,8 +285,10 @@ class SameDiff:
                    **kw_attrs) -> Tuple[SDVariable, ...]:
         """Record a MULTI-OUTPUT catalog op (split/unstack/top_k/...; nd4j
         multi-output DynamicCustomOp equivalent). The op must return a
-        tuple/list of ``n_outputs`` arrays; each element gets its own graph
-        name ``<base>__k``."""
+        tuple/list of ``n_outputs`` arrays. ``name`` may be a base string
+        (outputs named ``<base>``, ``<base>__k``) or a sequence of
+        ``n_outputs`` explicit names (importers bind source-graph tensor
+        names this way); None entries get generated names."""
         if _catalog.lookup(op_name) is None:
             raise ValueError(f"unknown op {op_name!r} (not in the catalog)")
         if n_outputs < 1:
@@ -296,8 +298,15 @@ class SameDiff:
         for v in inputs:
             if v.name not in self._vars:
                 raise ValueError(f"input {v.name!r} is not in this graph")
-        base = name or self._fresh(op_name.split(".")[-1])
-        outs = [base if k == 0 else f"{base}__{k}" for k in range(n_outputs)]
+        if isinstance(name, (list, tuple)):
+            if len(name) != n_outputs:
+                raise ValueError(
+                    f"{len(name)} output names for n_outputs={n_outputs}")
+            outs = [n or self._fresh(op_name.split(".")[-1]) for n in name]
+        else:
+            base = name or self._fresh(op_name.split(".")[-1])
+            outs = [base if k == 0 else f"{base}__{k}"
+                    for k in range(n_outputs)]
         vs = tuple(self._register(o, ARRAY) for o in outs)
         self._ops.append(_OpRecord(op_name, [i.name for i in inputs], outs, attrs))
         self._fn_cache.clear()

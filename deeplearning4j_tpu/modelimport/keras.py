@@ -38,7 +38,7 @@ from ..nn.layers.conv import (BatchNormalization, ConvolutionLayer,
                               Upsampling2D, ZeroPadding2D)
 from ..nn.layers.core import (ActivationLayer, DenseLayer, DropoutLayer,
                               EmbeddingLayer, FlattenLayer)
-from ..nn.layers.recurrent import LSTM, SimpleRnn
+from ..nn.layers.recurrent import GRU, LSTM, Bidirectional, SimpleRnn
 
 _ACT = {"linear": "identity", "relu": "relu", "relu6": "relu6",
         "tanh": "tanh", "sigmoid": "sigmoid", "hard_sigmoid": "hardsigmoid",
@@ -168,6 +168,162 @@ def _map_lstm(cfg) -> _Mapped:
         "return_sequences": bool(cfg.get("return_sequences", False))}))
 
 
+def _map_gru(cfg) -> _Mapped:
+    if cfg.get("return_state"):
+        raise ValueError("GRU return_state not supported in import")
+    if _act(cfg.get("activation", "tanh")) != "tanh" or \
+            cfg.get("recurrent_activation", "sigmoid") != "sigmoid":
+        raise ValueError("only tanh/sigmoid GRU variants import")
+    reset_after = bool(cfg.get("reset_after", True))
+    u = int(cfg["units"])
+    lyr = GRU(n_out=u, reset_after=reset_after)
+
+    def w(ws):
+        k, rk = ws[0], ws[1]
+        # Keras gate order [z, r, h] matches ours — no reorder
+        if reset_after:
+            b = ws[2] if len(ws) > 2 else np.zeros((2, 3 * u), np.float32)
+            b = np.asarray(b).reshape(2, 3 * u)
+            return {"W": k, "RW": rk, "b": b[0], "rb": b[1]}
+        b = ws[2] if len(ws) > 2 else np.zeros(3 * u, np.float32)
+        return {"W": k, "RW": rk, "b": b}
+
+    return _Mapped(lyr, w, vertex=("rnn", {
+        "return_sequences": bool(cfg.get("return_sequences", False))}))
+
+
+def _map_bidirectional(cfg) -> _Mapped:
+    inner_cfg = cfg["layer"]
+    inner_cls = inner_cfg["class_name"]
+    if inner_cls not in ("LSTM", "GRU", "SimpleRNN"):
+        raise ValueError(
+            f"Bidirectional around {inner_cls!r} not supported")
+    inner = _MAPPERS[inner_cls](inner_cfg["config"])
+    merge = {"concat": "concat", "sum": "add", "mul": "mul",
+             "ave": "average"}.get(cfg.get("merge_mode", "concat"))
+    if merge is None:
+        raise ValueError(
+            f"Bidirectional merge_mode={cfg.get('merge_mode')!r} "
+            "not supported (concat/sum/mul/ave)")
+    rs = bool(inner_cfg["config"].get("return_sequences", False))
+    # return_sequences=False lives on the Bidirectional layer itself (the
+    # keras semantics merge each direction's OWN last step — a LastTimeStep
+    # over the merged sequence would take the backward stream's first step)
+    lyr = Bidirectional(layer=inner.layer, mode=merge, return_sequences=rs)
+
+    def w(ws):
+        ws = list(ws)
+        if len(ws) % 2:
+            raise ValueError(
+                f"Bidirectional expects paired fw/bw weights, got {len(ws)}")
+        half = len(ws) // 2
+        return {"fw": inner.weights(ws[:half]),
+                "bw": inner.weights(ws[half:])}
+
+    return _Mapped(lyr, w, vertex=("rnn", {"return_sequences": True}))
+
+
+def _map_conv1d(cfg) -> _Mapped:
+    from ..nn.layers.conv_extra import Convolution1D
+    if cfg.get("data_format", "channels_last") != "channels_last":
+        raise ValueError("Conv1D channels_first not supported")
+    pad = cfg.get("padding", "valid")
+    if pad not in ("valid", "same"):
+        raise ValueError(f"Conv1D padding={pad!r} not supported")
+    lyr = Convolution1D(
+        n_out=int(cfg["filters"]), kernel=int(_one(cfg["kernel_size"])),
+        stride=int(_one(cfg.get("strides", 1))),
+        dilation=int(_one(cfg.get("dilation_rate", 1))),
+        mode="same" if pad == "same" else "truncate",
+        activation=_act(cfg.get("activation")),
+        has_bias=bool(cfg.get("use_bias", True)))
+
+    def w(ws):
+        # Keras kernel [k, in, out] -> ours [out, in, 1, k]
+        kern = np.transpose(np.asarray(ws[0]), (2, 1, 0))[:, :, None, :]
+        out = {"W": kern}
+        if len(ws) > 1:
+            out["b"] = ws[1]
+        return out
+
+    return _Mapped(lyr, w)
+
+
+def _map_conv3d(cfg) -> _Mapped:
+    from ..nn.layers.conv3d import Convolution3D
+    if cfg.get("data_format", "channels_last") != "channels_last":
+        raise ValueError("Conv3D channels_first not supported")
+    pad = cfg.get("padding", "valid")
+    if pad not in ("valid", "same"):
+        raise ValueError(f"Conv3D padding={pad!r} not supported")
+    lyr = Convolution3D(
+        n_out=int(cfg["filters"]), kernel=_triple3(cfg["kernel_size"]),
+        stride=_triple3(cfg.get("strides", 1)),
+        dilation=_triple3(cfg.get("dilation_rate", 1)),
+        mode="same" if pad == "same" else "truncate",
+        activation=_act(cfg.get("activation")),
+        has_bias=bool(cfg.get("use_bias", True)), data_format="NDHWC")
+
+    def w(ws):
+        # Keras kernel [kd, kh, kw, in, out] -> ours [out, in, kd, kh, kw]
+        kern = np.transpose(np.asarray(ws[0]), (4, 3, 0, 1, 2))
+        out = {"W": kern}
+        if len(ws) > 1:
+            out["b"] = ws[1]
+        return out
+
+    return _Mapped(lyr, w)
+
+
+def _map_pool1d(cfg, pool_type: str) -> _Mapped:
+    from ..nn.layers.conv_extra import Subsampling1DLayer
+    pad = cfg.get("padding", "valid")
+    if pad not in ("valid", "same"):
+        raise ValueError(f"Pooling1D padding={pad!r} not supported")
+    return _Mapped(Subsampling1DLayer(
+        kernel=int(_one(cfg.get("pool_size", 2))),
+        stride=int(_one(cfg.get("strides") or cfg.get("pool_size", 2))),
+        pool_type=pool_type, mode="same" if pad == "same" else "truncate"))
+
+
+def _one(v):
+    return v[0] if isinstance(v, (list, tuple)) else v
+
+
+def _triple3(v):
+    return tuple(v) if isinstance(v, (list, tuple)) else (int(v),) * 3
+
+
+#: name -> Layer instance; Keras Lambda layers serialize arbitrary Python,
+#: so the import cannot reconstruct them — users register the equivalent
+#: layer under the LAMBDA LAYER'S NAME (the reference's
+#: KerasLayer.registerLambdaLayer contract).
+_LAMBDA_LAYERS: Dict[str, Any] = {}
+
+
+def register_lambda_layer(name: str, layer) -> None:
+    _LAMBDA_LAYERS[name] = layer
+
+
+def register_custom_layer(class_name: str, mapper: Callable) -> None:
+    """Register an import mapper for a custom Keras layer class
+    (``KerasLayer.registerCustomLayer``†): ``mapper(config_dict) -> _Mapped``
+    (or anything exposing .layer/.weights/.vertex)."""
+    _MAPPERS[class_name] = mapper
+
+
+def _map_lambda(cfg) -> _Mapped:
+    name = cfg.get("name")
+    if name in _LAMBDA_LAYERS:
+        return _Mapped(_LAMBDA_LAYERS[name])
+    raise ValueError(
+        f"Lambda layer {name!r}: Keras Lambdas serialize arbitrary Python "
+        "and cannot be imported mechanically — call "
+        "modelimport.keras.register_lambda_layer({name!r}, <equivalent "
+        "Layer>) before importing (reference: KerasLayer."
+        "registerLambdaLayer)")
+
+
 def _map_simple_rnn(cfg) -> _Mapped:
     lyr = SimpleRnn(n_out=int(cfg["units"]),
                     activation=_act(cfg.get("activation", "tanh")))
@@ -235,7 +391,18 @@ _MAPPERS: Dict[str, Callable[[dict], _Mapped]] = {
         size=_pair(c.get("size", 2)), data_format="NHWC")),
     "Embedding": _map_embedding,
     "LSTM": _map_lstm,
+    "GRU": _map_gru,
     "SimpleRNN": _map_simple_rnn,
+    "Bidirectional": _map_bidirectional,
+    "Conv1D": _map_conv1d,
+    "Conv3D": _map_conv3d,
+    "MaxPooling1D": lambda c: _map_pool1d(c, "max"),
+    "AveragePooling1D": lambda c: _map_pool1d(c, "avg"),
+    "GlobalAveragePooling1D": lambda c: _Mapped(
+        GlobalPoolingLayer(pool_type="avg")),
+    "GlobalMaxPooling1D": lambda c: _Mapped(
+        GlobalPoolingLayer(pool_type="max")),
+    "Lambda": _map_lambda,
     "SeparableConv2D": lambda c: _map_separable(c),
     "DepthwiseConv2D": lambda c: _map_depthwise(c),
     "PReLU": lambda c: _map_prelu(c),
@@ -330,6 +497,10 @@ def _input_type_from_batch_shape(shape) -> tuple:
         h, w, c = dims
         return InputType.convolutional(int(c), int(h), int(w),
                                        data_format="NHWC")
+    if len(dims) == 4:
+        d, h, w, c = dims
+        return InputType.convolutional3d(int(c), int(d), int(h), int(w),
+                                         data_format="NDHWC")
     raise ValueError(f"unsupported input shape {shape}")
 
 
@@ -432,14 +603,22 @@ def _set_params(model_params, model_state, key: str, mapped: _Mapped,
     out = mapped.weights(kws)
     params = out.get("__params__", out if "__state__" not in out else {})
     state = out.get("__state__")
-    tgt = model_params.get(key, {})
-    for name, val in params.items():
-        if name in tgt and tuple(tgt[name].shape) != tuple(val.shape):
-            raise ValueError(
-                f"shape mismatch importing {key}/{name}: "
-                f"ours {tuple(tgt[name].shape)} vs h5 {tuple(val.shape)}")
-        tgt[name] = jnp.asarray(val)
-    model_params[key] = tgt
+    def merge(tgt, src, path):
+        for name, val in src.items():
+            if isinstance(val, dict):  # nested (Bidirectional fw/bw)
+                tgt[name] = merge(dict(tgt.get(name, {})), val,
+                                  f"{path}/{name}")
+                continue
+            if name in tgt and tuple(tgt[name].shape) != tuple(
+                    np.asarray(val).shape):
+                raise ValueError(
+                    f"shape mismatch importing {path}/{name}: "
+                    f"ours {tuple(tgt[name].shape)} vs h5 "
+                    f"{tuple(np.asarray(val).shape)}")
+            tgt[name] = jnp.asarray(val)
+        return tgt
+
+    model_params[key] = merge(dict(model_params.get(key, {})), params, key)
     if state:
         st = model_state.get(key, {})
         for name, val in state.items():

@@ -264,6 +264,73 @@ def _squeeze(node, ctx, at):
                        name=node.output[0], attrs=attrs)
 
 
+def _rnn_optional(ctx, node, idx):
+    """Optional ONNX input: returns the tensor name or None for ''/absent."""
+    if len(node.input) > idx and node.input[idx]:
+        return node.input[idx]
+    return None
+
+
+def _rnn_check_initial(ctx, name, what):
+    if name is None:
+        return
+    if name in ctx.consts and not np.any(ctx.consts[name]):
+        return  # zero initial state == our default
+    raise ValueError(f"{what} with non-zero initial state not supported")
+
+
+@onnx_op("LSTM", "GRU")
+def _rnn(node, ctx, at):
+    """ONNX LSTM/GRU -> onnx_lstm/onnx_gru catalog ops (multi-output:
+    Y/Y_h[/Y_c]). Default activations, layout=0, zero initial state,
+    no sequence_lens (matches torch.onnx.export of nn.LSTM/nn.GRU)."""
+    kind = node.op_type
+    if at.get("layout"):
+        raise ValueError(f"{kind} layout=1 not supported (re-export with "
+                         "the default seq-major layout)")
+    if at.get("clip"):
+        raise ValueError(f"{kind} clip not supported")
+    if at.get("activations"):
+        raise ValueError(f"{kind} custom activations not supported")
+    hidden = int(at["hidden_size"])
+    direction = at.get("direction", "forward")
+    n_dirs = 2 if direction == "bidirectional" else 1
+    x = ctx.get(node.input[0])
+    w = ctx.get(node.input[1])
+    r = ctx.get(node.input[2])
+    b_name = _rnn_optional(ctx, node, 3)
+    if b_name is None:
+        width = 8 * hidden if kind == "LSTM" else 6 * hidden
+        b = ctx.sd._lift(np.zeros((n_dirs, width), np.float32))
+    else:
+        b = ctx.get(b_name)
+    seq_lens = _rnn_optional(ctx, node, 4)
+    if seq_lens is not None:
+        raise ValueError(f"{kind} sequence_lens not supported "
+                         "(pad to a fixed length)")
+    _rnn_check_initial(ctx, _rnn_optional(ctx, node, 5), f"{kind} initial_h")
+    if kind == "LSTM":
+        _rnn_check_initial(ctx, _rnn_optional(ctx, node, 6),
+                           "LSTM initial_c")
+        names = [node.output[k] if len(node.output) > k and node.output[k]
+                 else None for k in range(3)]
+        vs = ctx.sd.call_multi(
+            "onnx_lstm", x, w, r, b, n_outputs=3, name=names,
+            attrs={"direction": direction, "hidden_size": hidden})
+    else:
+        names = [node.output[k] if len(node.output) > k and node.output[k]
+                 else None for k in range(2)]
+        vs = ctx.sd.call_multi(
+            "onnx_gru", x, w, r, b, n_outputs=2, name=names,
+            attrs={"direction": direction, "hidden_size": hidden,
+                   "linear_before_reset": int(
+                       at.get("linear_before_reset", 0))})
+    for out_name, v in zip(node.output, vs):
+        if out_name:
+            ctx.vars[out_name] = v
+    return vs[0]
+
+
 @onnx_op("ReduceMean", "ReduceSum", "ReduceMax", "ReduceMin")
 def _reduce(node, ctx, at):
     op = {"ReduceMean": "reduce.mean", "ReduceSum": "reduce.sum",

@@ -211,6 +211,52 @@ class GravesLSTM(_RecurrentLayer):
         return _scan_ret(step, carry, x, mask, self.tbptt_length)
 
 
+@layer("gru")
+class GRU(_RecurrentLayer):
+    """GRU (gate order [z, r, h~], Keras/CuDNN convention). DL4J has no GRU
+    layer — this exists for Keras/ONNX importer parity and as a first-class
+    recurrent cell. ``reset_after=True`` (Keras v2 default) keeps a separate
+    recurrent bias "rb" and applies the reset gate AFTER the recurrent
+    matmul (CuDNN-compatible math); False is the classic formulation."""
+    n_out: int = 0
+    n_in: Optional[int] = None
+    reset_after: bool = True
+    weight_init: str = "xavier"
+    tbptt_length: Optional[int] = None
+    l1: float = 0.0
+    l2: float = 0.0
+    name: Optional[str] = None
+
+    def initialize(self, key, input_shape, dtype):
+        n_in = self.n_in or int(input_shape[-1])
+        u = self.n_out
+        k1, k2 = jax.random.split(key)
+        w = _winit.init(self.weight_init, k1, (n_in, 3 * u), n_in, u, dtype)
+        rw = _winit.init(self.weight_init, k2, (u, 3 * u), u, u, dtype)
+        params = {"W": w, "RW": rw, "b": jnp.zeros((3 * u,), dtype)}
+        if self.reset_after:
+            params["rb"] = jnp.zeros((3 * u,), dtype)
+        return params, {}, input_shape[:-1] + (u,)
+
+    def init_stream_state(self, params, batch):
+        u = params["RW"].shape[0]
+        return (jnp.zeros((batch, u), params["W"].dtype),)
+
+    def scan_with_state(self, params, x, carry, mask=None, grad_path=True):
+        w, rw, b = params["W"], params["RW"], params["b"]
+        rb = params.get("rb")
+
+        def step(carry, inp):
+            x_t, m_t, _ = inp
+            (h,) = carry
+            h_new = nnops.gru_cell(x_t, h, w, rw, b, rb)
+            if m_t.shape[-1]:
+                h_new = _gate(m_t, h_new, h)
+            return (h_new,), h_new
+
+        return _scan_ret(step, carry, x, mask, self.tbptt_length)
+
+
 @layer("simple_rnn")
 class SimpleRnn(_RecurrentLayer):
     """Elman RNN: h_t = act(x W + h_{t-1} RW + b) (DL4J SimpleRnn)."""
@@ -268,6 +314,12 @@ class Bidirectional(_RecurrentLayer):
     """
     layer: Any = None           # the wrapped recurrent Layer config
     mode: str = "concat"
+    #: False = emit only the LAST output of each direction, merged — the
+    #: forward direction's t=T-1 with the backward direction's t=0 (its own
+    #: final state). Keras Bidirectional(return_sequences=False) semantics;
+    #: a LastTimeStep over the merged sequence would wrongly take t=T-1 of
+    #: the backward stream (its FIRST step).
+    return_sequences: bool = True
     name: Optional[str] = None
 
     # rnnTimeStep is ill-defined for bidirectional nets (the backward pass
@@ -284,7 +336,36 @@ class Bidirectional(_RecurrentLayer):
         p_bw, _, _ = self.layer.initialize(k2, input_shape, dtype)
         if self.mode == "concat":
             out = out[:-1] + (out[-1] * 2,)
+        if not self.return_sequences:
+            out = (out[-1],)
         return {"fw": p_fw, "bw": p_bw}, {}, out
+
+    def apply(self, params, x, state, *, train=False, rng=None, mask=None):
+        carry = self.init_stream_state(params, x.shape[0])
+        if self.return_sequences:
+            y, _ = self.scan_with_state(params, x, carry, mask)
+            return y, state, mask
+        # per-direction final outputs, merged. Carry gating makes both ends
+        # correct under end-padded masks: the forward stream holds its last
+        # valid value through trailing pads, and the reversed stream's final
+        # position is its state after the original t=0.
+        y_fw, _ = self.layer.scan_with_state(params["fw"], x, carry[0], mask)
+        x_rev = jnp.flip(x, axis=1)
+        m_rev = None if mask is None else jnp.flip(mask, axis=1)
+        y_bw, _ = self.layer.scan_with_state(params["bw"], x_rev, carry[1],
+                                             m_rev)
+        fw_last, bw_last = y_fw[:, -1], y_bw[:, -1]
+        if self.mode == "concat":
+            last = jnp.concatenate([fw_last, bw_last], axis=-1)
+        elif self.mode == "add":
+            last = fw_last + bw_last
+        elif self.mode == "mul":
+            last = fw_last * bw_last
+        elif self.mode == "average":
+            last = (fw_last + bw_last) / 2
+        else:
+            raise ValueError(f"unknown Bidirectional mode {self.mode!r}")
+        return last, state, None
 
     def init_stream_state(self, params, batch):
         return (self.layer.init_stream_state(params["fw"], batch),
@@ -313,11 +394,13 @@ class Bidirectional(_RecurrentLayer):
 
     def to_dict(self):
         return {"kind": "bidirectional", "mode": self.mode,
+                "return_sequences": self.return_sequences,
                 "layer": self.layer.to_dict(), "name": self.name}
 
     @staticmethod
     def _from_dict_fields(d):
         return {"mode": d.get("mode", "concat"),
+                "return_sequences": d.get("return_sequences", True),
                 "layer": Layer.from_dict(d["layer"]), "name": d.get("name")}
 
 

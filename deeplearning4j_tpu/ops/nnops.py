@@ -311,10 +311,148 @@ def graves_lstm_cell(x, h, c, w_ih, w_hh, b, w_peep):
     return h_new, c_new
 
 
+@register("gru_cell", category="rnn")
+def gru_cell(x, h, w_ih, w_hh, b, rb=None):
+    """GRU cell, gate order [z, r, h~] (Keras/CuDNN order — DL4J has no GRU;
+    this exists for importer parity and as a first-class cell).
+
+    ``rb`` (recurrent bias [3u]) selects the Keras ``reset_after=True`` /
+    CuDNN formulation (candidate uses r * (h.RWh + rb_h)); ``rb=None`` is
+    the classic reset-before form (candidate uses (r*h).RWh).
+    One fused [B, in]x[in,3u] + [B,u]x[u,3u] matmul pair per step.
+    """
+    prec = precision_for(x, w_ih)
+    xi = jnp.dot(x, w_ih, precision=prec) + b
+    xz, xr, xh = jnp.split(xi, 3, axis=-1)
+    if rb is not None:
+        hi = jnp.dot(h, w_hh, precision=prec) + rb
+        hz, hr, hh = jnp.split(hi, 3, axis=-1)
+        z = jax.nn.sigmoid(xz + hz)
+        r = jax.nn.sigmoid(xr + hr)
+        n = jnp.tanh(xh + r * hh)
+    else:
+        u = w_hh.shape[0]
+        hz = jnp.dot(h, w_hh[:, :u], precision=prec)
+        hr = jnp.dot(h, w_hh[:, u:2 * u], precision=prec)
+        z = jax.nn.sigmoid(xz + hz)
+        r = jax.nn.sigmoid(xr + hr)
+        n = jnp.tanh(xh + jnp.dot(r * h, w_hh[:, 2 * u:], precision=prec))
+    return z * h + (1.0 - z) * n
+
+
 @register("simple_rnn_cell", category="rnn")
 def simple_rnn_cell(x, h, w_ih, w_hh, b, activation=jnp.tanh):
     prec = precision_for(x, w_ih)
     return activation(jnp.dot(x, w_ih, precision=prec) + jnp.dot(h, w_hh, precision=prec) + b)
+
+
+def _onnx_dirs(direction, n_dirs):
+    if direction == "forward":
+        want = 1
+    elif direction == "reverse":
+        want = 1
+    elif direction == "bidirectional":
+        want = 2
+    else:
+        raise ValueError(f"ONNX RNN direction {direction!r} not supported")
+    if n_dirs != want:
+        raise ValueError(
+            f"direction={direction!r} expects {want} weight slice(s), "
+            f"got {n_dirs}")
+
+
+@register("onnx_lstm", category="rnn")
+def onnx_lstm(x, w, r, b, direction="forward", hidden_size=0):
+    """ONNX ``LSTM`` node semantics (default activations, layout=0).
+
+    x: [T, B, I]; w: [D, 4H, I] gate rows in ONNX order [i, o, f, c];
+    r: [D, 4H, H]; b: [D, 8H] (Wb || Rb). Returns the ONNX output triple
+    (Y [T, D, B, H], Y_h [D, B, H], Y_c [D, B, H]) — a multi-output op,
+    recorded via SameDiff.call_multi. Runs as lax.scan over our fused
+    lstm_cell (gate order [i, f, o, g]) after an in-graph reorder, so
+    gradients flow to the ONNX-layout weights (imported graphs fine-tune).
+    """
+    H = int(hidden_size)
+
+    def reorder(m):  # [4H, K] rows iofc -> columns [K, 4H] ifog
+        i, o, f, c = (m[0:H], m[H:2 * H], m[2 * H:3 * H], m[3 * H:4 * H])
+        return jnp.concatenate([i, f, o, c], axis=0).T
+
+    def run_dir(xs, wd, rd, bd, rev):
+        w2, r2 = reorder(wd), reorder(rd)
+        bb = bd[:4 * H] + bd[4 * H:]
+        b2 = jnp.concatenate([bb[0:H], bb[2 * H:3 * H], bb[H:2 * H],
+                              bb[3 * H:4 * H]])
+        if rev:
+            xs = jnp.flip(xs, axis=0)
+        B = xs.shape[1]
+        h0 = jnp.zeros((B, H), xs.dtype)
+        c0 = jnp.zeros((B, H), xs.dtype)
+
+        def step(carry, x_t):
+            h, c = carry
+            h, c = lstm_cell(x_t, h, c, w2, r2, b2)
+            return (h, c), h
+
+        (h_T, c_T), ys = jax.lax.scan(step, (h0, c0), xs)
+        if rev:
+            ys = jnp.flip(ys, axis=0)
+        return ys, h_T, c_T
+
+    n_dirs = w.shape[0]
+    _onnx_dirs(direction, n_dirs)
+    outs = []
+    for d in range(n_dirs):
+        rev = (direction == "reverse") or (d == 1)
+        outs.append(run_dir(x, w[d], r[d], b[d], rev))
+    Y = jnp.stack([o[0] for o in outs], axis=1)        # [T, D, B, H]
+    Y_h = jnp.stack([o[1] for o in outs], axis=0)      # [D, B, H]
+    Y_c = jnp.stack([o[2] for o in outs], axis=0)
+    return Y, Y_h, Y_c
+
+
+@register("onnx_gru", category="rnn")
+def onnx_gru(x, w, r, b, direction="forward", hidden_size=0,
+             linear_before_reset=0):
+    """ONNX ``GRU`` node semantics (default activations, layout=0).
+
+    x: [T, B, I]; w: [D, 3H, I] gate rows [z, r, h]; r: [D, 3H, H];
+    b: [D, 6H] (Wb || Rb). ``linear_before_reset=1`` is the CuDNN/Keras
+    ``reset_after`` form (our gru_cell with a separate recurrent bias).
+    Returns (Y [T, D, B, H], Y_h [D, B, H]).
+    """
+    H = int(hidden_size)
+
+    def run_dir(xs, wd, rd, bd, rev):
+        w2, r2 = wd.T, rd.T              # [I,3H] / [H,3H], order z,r,h = ours
+        wb, rb = bd[:3 * H], bd[3 * H:]
+        if rev:
+            xs = jnp.flip(xs, axis=0)
+        B = xs.shape[1]
+        h0 = jnp.zeros((B, H), xs.dtype)
+        if linear_before_reset:
+            cell = lambda x_t, h: gru_cell(x_t, h, w2, r2, wb, rb)
+        else:
+            cell = lambda x_t, h: gru_cell(x_t, h, w2, r2, wb + rb, None)
+
+        def step(h, x_t):
+            h = cell(x_t, h)
+            return h, h
+
+        h_T, ys = jax.lax.scan(step, h0, xs)
+        if rev:
+            ys = jnp.flip(ys, axis=0)
+        return ys, h_T
+
+    n_dirs = w.shape[0]
+    _onnx_dirs(direction, n_dirs)
+    outs = []
+    for d in range(n_dirs):
+        rev = (direction == "reverse") or (d == 1)
+        outs.append(run_dir(x, w[d], r[d], b[d], rev))
+    Y = jnp.stack([o[0] for o in outs], axis=1)
+    Y_h = jnp.stack([o[1] for o in outs], axis=0)
+    return Y, Y_h
 
 
 @register("dot_product_attention", category="attention")

@@ -1,0 +1,154 @@
+"""ONNX LSTM/GRU node import, golden vs torch.
+
+The onnx pip package is absent (zero-egress), so ModelProtos are built with
+the vendored minimal schema and hold REAL torch nn.LSTM/nn.GRU weights —
+reference outputs come from torch itself. Gate reorders applied exactly as
+torch.onnx.export does: LSTM [i,f,g,o] -> ONNX [i,o,f,c]; GRU [r,z,n] ->
+ONNX [z,r,n] with linear_before_reset=1."""
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+jnp = pytest.importorskip("jax.numpy")
+
+from deeplearning4j_tpu.modelimport.onnx import OnnxFrameworkImporter
+from deeplearning4j_tpu.modelimport.proto import onnx_min_pb2 as P
+
+
+def _tensor(name, arr):
+    t = P.TensorProto()
+    t.name = name
+    t.dims.extend(arr.shape)
+    t.data_type = 1
+    t.raw_data = np.ascontiguousarray(arr, dtype=np.float32).tobytes()
+    return t
+
+
+def _io(name, shape):
+    vi = P.ValueInfoProto()
+    vi.name = name
+    vi.type.tensor_type.elem_type = 1
+    for d in shape:
+        dim = vi.type.tensor_type.shape.dim.add()
+        if d is None:
+            dim.dim_param = "N"
+        else:
+            dim.dim_value = d
+    return vi
+
+
+def _attr_int(name, v):
+    a = P.AttributeProto()
+    a.name = name
+    a.type = 2
+    a.i = v
+    return a
+
+
+def _attr_str(name, v):
+    a = P.AttributeProto()
+    a.name = name
+    a.type = 3
+    a.s = v.encode()
+    return a
+
+
+def _lstm_onnx_weights(rnn, H, bidirectional):
+    """torch LSTM params -> ONNX W [D,4H,I], R [D,4H,H], B [D,8H]."""
+    def reorder(m):  # torch rows [i,f,g,o] -> onnx [i,o,f,c]
+        i, f, g, o = np.split(m, 4, axis=0)
+        return np.concatenate([i, o, f, g], axis=0)
+    sfx = [""] + (["_reverse"] if bidirectional else [])
+    Ws, Rs, Bs = [], [], []
+    for s in sfx:
+        Ws.append(reorder(getattr(rnn, f"weight_ih_l0{s}").detach().numpy()))
+        Rs.append(reorder(getattr(rnn, f"weight_hh_l0{s}").detach().numpy()))
+        Bs.append(np.concatenate([
+            reorder(getattr(rnn, f"bias_ih_l0{s}").detach().numpy()[:, None])[:, 0],
+            reorder(getattr(rnn, f"bias_hh_l0{s}").detach().numpy()[:, None])[:, 0]]))
+    return np.stack(Ws), np.stack(Rs), np.stack(Bs)
+
+
+def _gru_onnx_weights(rnn, H, bidirectional):
+    """torch GRU params -> ONNX W [D,3H,I], R, B [D,6H] (z,r,n order)."""
+    def reorder(m):  # torch rows [r,z,n] -> onnx [z,r,n]
+        r, z, n = np.split(m, 3, axis=0)
+        return np.concatenate([z, r, n], axis=0)
+    sfx = [""] + (["_reverse"] if bidirectional else [])
+    Ws, Rs, Bs = [], [], []
+    for s in sfx:
+        Ws.append(reorder(getattr(rnn, f"weight_ih_l0{s}").detach().numpy()))
+        Rs.append(reorder(getattr(rnn, f"weight_hh_l0{s}").detach().numpy()))
+        Bs.append(np.concatenate([
+            reorder(getattr(rnn, f"bias_ih_l0{s}").detach().numpy()[:, None])[:, 0],
+            reorder(getattr(rnn, f"bias_hh_l0{s}").detach().numpy()[:, None])[:, 0]]))
+    return np.stack(Ws), np.stack(Rs), np.stack(Bs)
+
+
+def _model(kind, W, R, B, T, I, H, direction, extra_attrs=()):
+    m = P.ModelProto()
+    g = m.graph
+    node = g.node.add()
+    node.op_type = kind
+    node.name = "rnn0"
+    node.input.extend(["x", "W", "R", "B"])
+    node.output.extend(["Y", "Y_h"] + (["Y_c"] if kind == "LSTM" else []))
+    node.attribute.extend([_attr_int("hidden_size", H),
+                           _attr_str("direction", direction),
+                           *extra_attrs])
+    g.initializer.extend([_tensor("W", W), _tensor("R", R), _tensor("B", B)])
+    g.input.append(_io("x", [T, None, I]))
+    g.output.append(_io("Y", []))
+    return m.SerializeToString()
+
+
+@pytest.mark.parametrize("bidirectional", [False, True])
+def test_onnx_lstm_matches_torch(bidirectional):
+    torch.manual_seed(0)
+    T, B, I, H = 7, 2, 5, 4
+    rnn = torch.nn.LSTM(I, H, bidirectional=bidirectional).eval()
+    x = torch.randn(T, B, I)
+    ref, _ = rnn(x)
+    ref = ref.detach().numpy()  # [T, B, D*H]
+    W, R, Bb = _lstm_onnx_weights(rnn, H, bidirectional)
+    direction = "bidirectional" if bidirectional else "forward"
+    sd = OnnxFrameworkImporter.import_model_proto(
+        _model("LSTM", W, R, Bb, T, I, H, direction))
+    out = sd.output({"x": x.numpy()}, ["Y"])["Y"]  # [T, D, B, H]
+    D = 2 if bidirectional else 1
+    got = np.moveaxis(out, 1, 2).reshape(T, B, D * H)
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("bidirectional", [False, True])
+def test_onnx_gru_matches_torch(bidirectional):
+    torch.manual_seed(1)
+    T, B, I, H = 5, 3, 3, 6
+    rnn = torch.nn.GRU(I, H, bidirectional=bidirectional).eval()
+    x = torch.randn(T, B, I)
+    ref, _ = rnn(x)
+    ref = ref.detach().numpy()
+    W, R, Bb = _gru_onnx_weights(rnn, H, bidirectional)
+    direction = "bidirectional" if bidirectional else "forward"
+    sd = OnnxFrameworkImporter.import_model_proto(
+        _model("GRU", W, R, Bb, T, I, H, direction,
+               extra_attrs=(_attr_int("linear_before_reset", 1),)))
+    out = sd.output({"x": x.numpy()}, ["Y"])["Y"]
+    D = 2 if bidirectional else 1
+    got = np.moveaxis(out, 1, 2).reshape(T, B, D * H)
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_onnx_lstm_hidden_state_consumable():
+    """Y_h (output slot 1) feeds downstream graph ops."""
+    torch.manual_seed(2)
+    T, B, I, H = 6, 2, 4, 3
+    rnn = torch.nn.LSTM(I, H).eval()
+    x = torch.randn(T, B, I)
+    _, (h, _) = rnn(x)
+    ref = h[-1].detach().numpy()
+    W, R, Bb = _lstm_onnx_weights(rnn, H, False)
+    sd = OnnxFrameworkImporter.import_model_proto(
+        _model("LSTM", W, R, Bb, T, I, H, "forward"))
+    out = sd.output({"x": x.numpy()}, ["Y_h"])["Y_h"]  # [1, B, H]
+    np.testing.assert_allclose(out[0], ref, rtol=1e-4, atol=1e-5)
