@@ -60,6 +60,8 @@ class MultiLayerConfiguration:
     l2: float = 0.0
     gradient_clip_value: Optional[float] = None      # clip by value
     gradient_clip_l2: Optional[float] = None         # clip by global L2 norm
+    gradient_normalization: Optional[str] = None     # GradientNormalization mode
+    gradient_normalization_threshold: float = 1.0
     tbptt_length: Optional[int] = None               # truncated BPTT window
     constraints: Any = None                          # [(BaseConstraint, scope)]
 
@@ -75,6 +77,9 @@ class MultiLayerConfiguration:
             "l2": self.l2,
             "gradient_clip_value": self.gradient_clip_value,
             "gradient_clip_l2": self.gradient_clip_l2,
+            "gradient_normalization": self.gradient_normalization,
+            "gradient_normalization_threshold":
+                self.gradient_normalization_threshold,
             "tbptt_length": self.tbptt_length,
             "constraints": _constraints.encode_constraints(self.constraints),
             "layers": [l.to_dict() for l in self.layers],
@@ -94,6 +99,9 @@ class MultiLayerConfiguration:
             l2=d.get("l2", 0.0),
             gradient_clip_value=d.get("gradient_clip_value"),
             gradient_clip_l2=d.get("gradient_clip_l2"),
+            gradient_normalization=d.get("gradient_normalization"),
+            gradient_normalization_threshold=d.get(
+                "gradient_normalization_threshold", 1.0),
             tbptt_length=d.get("tbptt_length"),
             constraints=_constraints.decode_constraints(d.get("constraints")),
         )
@@ -111,6 +119,8 @@ class NeuralNetConfiguration:
         self._l2 = 0.0
         self._clip_value = None
         self._clip_l2 = None
+        self._grad_norm = None
+        self._grad_norm_threshold = 1.0
         self._input_shape = None
         self._tbptt = None
         self._constraints = []
@@ -145,6 +155,17 @@ class NeuralNetConfiguration:
 
     def gradient_clip_l2(self, v: float):
         self._clip_l2 = v
+        return self
+
+    def gradient_normalization(self, mode: str, threshold: float = 1.0):
+        """DL4J GradientNormalization mode (RenormalizeL2PerLayer,
+        RenormalizeL2PerParamType, ClipElementWiseAbsoluteValue,
+        ClipL2PerLayer, ClipL2PerParamType); threshold feeds the Clip*
+        modes (ignored by the Renormalize* modes, as in DL4J)."""
+        from . import gradnorm as _gn
+        _gn.validate(mode)
+        self._grad_norm = mode
+        self._grad_norm_threshold = float(threshold)
         return self
 
     def tbptt_length(self, n: int):
@@ -196,6 +217,8 @@ class NeuralNetConfiguration:
             layers=layers, input_shape=self._input_shape, seed=self._seed,
             dtype=self._dtype, updater=self._updater, l1=self._l1, l2=self._l2,
             gradient_clip_value=self._clip_value, gradient_clip_l2=self._clip_l2,
+            gradient_normalization=self._grad_norm,
+            gradient_normalization_threshold=self._grad_norm_threshold,
             tbptt_length=self._tbptt, constraints=self._constraints or None)
 
 

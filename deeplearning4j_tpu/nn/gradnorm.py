@@ -1,0 +1,75 @@
+"""Gradient normalization (DL4J ``GradientNormalization`` enum +
+``BaseMultiLayerUpdater.preApply``† per SURVEY.md §2.4 "Updater plumbing";
+reference mount was empty, citation upstream-relative, unverified).
+
+The five reference modes, applied to the whole-net gradient pytree BEFORE
+the updater (same position as the reference's preApply). "Layer" granularity
+is a top-level key of the gradient tree (MLN layer index / graph vertex
+name); "param type" is one leaf array (W, b, gamma, ...). Zero norms are
+guarded with a tiny epsilon instead of the reference's raw divide — a
+division by an exactly-zero norm would poison the whole step with NaNs
+under XLA, and 0/eps preserves the all-zero gradient.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+MODES = (
+    "RenormalizeL2PerLayer",
+    "RenormalizeL2PerParamType",
+    "ClipElementWiseAbsoluteValue",
+    "ClipL2PerLayer",
+    "ClipL2PerParamType",
+)
+
+_EPS = 1e-12
+
+
+def validate(mode: Optional[str]) -> None:
+    if mode is not None and mode not in MODES:
+        raise ValueError(f"unknown GradientNormalization mode {mode!r}; "
+                         f"expected one of {MODES}")
+
+
+def _tree_l2(tree) -> jnp.ndarray:
+    return jnp.sqrt(sum(jnp.sum(jnp.square(g))
+                        for g in jax.tree.leaves(tree)) + 0.0)
+
+
+def apply(mode: Optional[str], threshold: float,
+          grads: Dict[str, Any]) -> Dict[str, Any]:
+    """Normalize the gradient tree ``{layer_key: {param: arr}}``."""
+    if mode is None:
+        return grads
+    if mode == "RenormalizeL2PerLayer":
+        return {k: jax.tree.map(
+            lambda g, n=_tree_l2(v): g / jnp.maximum(n, _EPS), v)
+            for k, v in grads.items()}
+    if mode == "RenormalizeL2PerParamType":
+        return jax.tree.map(
+            lambda g: g / jnp.maximum(jnp.sqrt(jnp.sum(jnp.square(g))),
+                                      _EPS), grads)
+    if mode == "ClipElementWiseAbsoluteValue":
+        t = float(threshold)
+        return jax.tree.map(lambda g: jnp.clip(g, -t, t), grads)
+    if mode == "ClipL2PerLayer":
+        t = float(threshold)
+        out = {}
+        for k, v in grads.items():
+            n = _tree_l2(v)
+            scale = jnp.where(n > t, t / jnp.maximum(n, _EPS), 1.0)
+            out[k] = jax.tree.map(lambda g, s=scale: g * s, v)
+        return out
+    if mode == "ClipL2PerParamType":
+        t = float(threshold)
+
+        def clip_one(g):
+            n = jnp.sqrt(jnp.sum(jnp.square(g)))
+            return g * jnp.where(n > t, t / jnp.maximum(n, _EPS), 1.0)
+        return jax.tree.map(clip_one, grads)
+    validate(mode)
+    return grads

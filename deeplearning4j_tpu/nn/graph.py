@@ -65,6 +65,8 @@ class ComputationGraphConfiguration:
                  l1: float = 0.0, l2: float = 0.0,
                  gradient_clip_value: Optional[float] = None,
                  gradient_clip_l2: Optional[float] = None,
+                 gradient_normalization: Optional[str] = None,
+                 gradient_normalization_threshold: float = 1.0,
                  tbptt_length: Optional[int] = None,
                  constraints: Any = None):
         self.inputs = list(inputs)
@@ -78,6 +80,10 @@ class ComputationGraphConfiguration:
         self.l2 = l2
         self.gradient_clip_value = gradient_clip_value
         self.gradient_clip_l2 = gradient_clip_l2
+        from . import gradnorm as _gn
+        _gn.validate(gradient_normalization)
+        self.gradient_normalization = gradient_normalization
+        self.gradient_normalization_threshold = gradient_normalization_threshold
         self.tbptt_length = tbptt_length
         self.constraints = constraints
         self._validate()
@@ -130,6 +136,9 @@ class ComputationGraphConfiguration:
             "l1": self.l1, "l2": self.l2,
             "gradient_clip_value": self.gradient_clip_value,
             "gradient_clip_l2": self.gradient_clip_l2,
+            "gradient_normalization": self.gradient_normalization,
+            "gradient_normalization_threshold":
+                self.gradient_normalization_threshold,
             "tbptt_length": self.tbptt_length,
             "constraints": _constraints.encode_constraints(self.constraints),
             "network_inputs": self.inputs,
@@ -153,6 +162,9 @@ class ComputationGraphConfiguration:
             l1=d.get("l1", 0.0), l2=d.get("l2", 0.0),
             gradient_clip_value=d.get("gradient_clip_value"),
             gradient_clip_l2=d.get("gradient_clip_l2"),
+            gradient_normalization=d.get("gradient_normalization"),
+            gradient_normalization_threshold=d.get(
+                "gradient_normalization_threshold", 1.0),
             tbptt_length=d.get("tbptt_length"),
             constraints=_constraints.decode_constraints(d.get("constraints")))
 
@@ -215,6 +227,9 @@ class GraphBuilder:
             l1=b._l1 if b else 0.0, l2=b._l2 if b else 0.0,
             gradient_clip_value=b._clip_value if b else None,
             gradient_clip_l2=b._clip_l2 if b else None,
+            gradient_normalization=b._grad_norm if b else None,
+            gradient_normalization_threshold=(
+                b._grad_norm_threshold if b else 1.0),
             tbptt_length=b._tbptt if b else None,
             constraints=(b._constraints or None) if b else None)
 
@@ -352,6 +367,9 @@ class ComputationGraph:
         return total
 
     def _clip(self, grads):
+        from . import gradnorm as _gn
+        grads = _gn.apply(self.conf.gradient_normalization,
+                          self.conf.gradient_normalization_threshold, grads)
         cv, cl2 = self.conf.gradient_clip_value, self.conf.gradient_clip_l2
         if cv:
             grads = jax.tree.map(lambda g: jnp.clip(g, -cv, cv), grads)

@@ -170,6 +170,9 @@ class MultiLayerNetwork:
         return total
 
     def _clip(self, grads):
+        from . import gradnorm as _gn
+        grads = _gn.apply(self.conf.gradient_normalization,
+                          self.conf.gradient_normalization_threshold, grads)
         cv, cl2 = self.conf.gradient_clip_value, self.conf.gradient_clip_l2
         if cv:
             grads = jax.tree.map(lambda g: jnp.clip(g, -cv, cv), grads)
