@@ -1,0 +1,246 @@
+"""Join + Reducer: the relational half of the transform DSL.
+
+TPU-native equivalent of datavec's join/reduce verbs (reference:
+``datavec-api .../transform/join/Join.java`` and
+``.../transform/reduce/Reducer.java``† per SURVEY.md §2.3; reference mount
+was empty, citations upstream-relative, unverified).
+
+Same altitude as schema.py: configs are JSON-serializable builders, the
+executor is plain host-side Python over list-records — ETL runs on the
+host; the device sees numpy batches.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from .schema import CATEGORICAL, DOUBLE, INTEGER, STRING, Schema
+
+INNER = "Inner"
+LEFT_OUTER = "LeftOuter"
+RIGHT_OUTER = "RightOuter"
+FULL_OUTER = "FullOuter"
+
+
+class Join:
+    """Key-column join of two record sets (reference ``Join.Builder``†).
+
+    Output schema: key columns once, then the left non-key columns, then
+    the right non-key columns (the reference's ordering). Missing sides of
+    outer joins fill with None."""
+
+    def __init__(self, join_type: str, keys: List[str],
+                 left_schema: Schema, right_schema: Schema):
+        if join_type not in (INNER, LEFT_OUTER, RIGHT_OUTER, FULL_OUTER):
+            raise ValueError(f"unknown join type {join_type!r}")
+        self.join_type = join_type
+        self.keys = list(keys)
+        self.left_schema = left_schema
+        self.right_schema = right_schema
+        for k in self.keys:
+            left_schema.index_of(k)
+            right_schema.index_of(k)
+
+    class Builder:
+        def __init__(self, join_type: str = INNER):
+            self._type = join_type
+            self._keys: List[str] = []
+            self._left: Optional[Schema] = None
+            self._right: Optional[Schema] = None
+
+        def set_join_columns(self, *names: str) -> "Join.Builder":
+            self._keys = list(names)
+            return self
+
+        def set_schemas(self, left: Schema, right: Schema) -> "Join.Builder":
+            self._left, self._right = left, right
+            return self
+
+        def build(self) -> "Join":
+            if not self._keys or self._left is None or self._right is None:
+                raise ValueError("join needs key columns and both schemas")
+            return Join(self._type, self._keys, self._left, self._right)
+
+    def output_schema(self) -> Schema:
+        cols = []
+        for k in self.keys:
+            cols.append(dict(self.left_schema.column(k)))
+        for c in self.left_schema.columns:
+            if c["name"] not in self.keys:
+                cols.append(dict(c))
+        for c in self.right_schema.columns:
+            if c["name"] not in self.keys:
+                cols.append(dict(c))
+        return Schema(cols)
+
+    def execute(self, left: Sequence[Sequence],
+                right: Sequence[Sequence]) -> List[list]:
+        lk = [self.left_schema.index_of(k) for k in self.keys]
+        rk = [self.right_schema.index_of(k) for k in self.keys]
+        lv = [i for i in range(self.left_schema.num_columns()) if i not in lk]
+        rv = [i for i in range(self.right_schema.num_columns()) if i not in rk]
+
+        right_by_key: Dict[tuple, List[list]] = {}
+        for r in right:
+            right_by_key.setdefault(tuple(r[i] for i in rk), []).append(list(r))
+
+        out: List[list] = []
+        matched_right = set()
+        for l in left:
+            key = tuple(l[i] for i in lk)
+            matches = right_by_key.get(key, [])
+            if matches:
+                matched_right.add(key)
+                for m in matches:
+                    out.append(list(key) + [l[i] for i in lv]
+                               + [m[i] for i in rv])
+            elif self.join_type in (LEFT_OUTER, FULL_OUTER):
+                out.append(list(key) + [l[i] for i in lv]
+                           + [None] * len(rv))
+        if self.join_type in (RIGHT_OUTER, FULL_OUTER):
+            for key, matches in right_by_key.items():
+                if key in matched_right:
+                    continue
+                for m in matches:
+                    out.append(list(key) + [None] * len(lv)
+                               + [m[i] for i in rv])
+        return out
+
+    # -- serde --
+    def to_json(self) -> str:
+        return json.dumps({
+            "join_type": self.join_type, "keys": self.keys,
+            "left_schema": {"columns": self.left_schema.columns},
+            "right_schema": {"columns": self.right_schema.columns}})
+
+    @staticmethod
+    def from_json(js: str) -> "Join":
+        d = json.loads(js)
+        return Join(d["join_type"], d["keys"],
+                    Schema(d["left_schema"]["columns"]),
+                    Schema(d["right_schema"]["columns"]))
+
+
+_REDUCE_OPS = ("sum", "mean", "min", "max", "count", "first", "last",
+               "stdev", "range", "count_unique")
+
+
+class Reducer:
+    """Aggregate-by-key (reference ``Reducer.Builder(keyColumns...)``† with
+    sumColumns/meanColumns/...). Output schema: key columns, then one
+    column per aggregation named ``op(column)`` (reference naming)."""
+
+    def __init__(self, keys: List[str], aggs: Optional[List[dict]] = None):
+        self.keys = list(keys)
+        self.aggs = aggs or []  # [{"op": ..., "column": ...}]
+
+    class Builder:
+        def __init__(self, *key_columns: str):
+            self._keys = list(key_columns)
+            self._aggs: List[dict] = []
+
+        def _add(self, op: str, names):
+            for n in names:
+                self._aggs.append({"op": op, "column": n})
+            return self
+
+        def sum_columns(self, *names: str):
+            return self._add("sum", names)
+
+        def mean_columns(self, *names: str):
+            return self._add("mean", names)
+
+        def min_columns(self, *names: str):
+            return self._add("min", names)
+
+        def max_columns(self, *names: str):
+            return self._add("max", names)
+
+        def count_columns(self, *names: str):
+            return self._add("count", names)
+
+        def first_columns(self, *names: str):
+            return self._add("first", names)
+
+        def last_columns(self, *names: str):
+            return self._add("last", names)
+
+        def stdev_columns(self, *names: str):
+            return self._add("stdev", names)
+
+        def range_columns(self, *names: str):
+            return self._add("range", names)
+
+        def count_unique_columns(self, *names: str):
+            return self._add("count_unique", names)
+
+        def build(self) -> "Reducer":
+            if not self._keys:
+                raise ValueError("Reducer needs at least one key column")
+            return Reducer(self._keys, self._aggs)
+
+    @staticmethod
+    def builder(*key_columns: str) -> "Reducer.Builder":
+        return Reducer.Builder(*key_columns)
+
+    def output_schema(self, schema: Schema) -> Schema:
+        cols = [dict(schema.column(k)) for k in self.keys]
+        for a in self.aggs:
+            src = schema.column(a["column"])
+            numeric_out = DOUBLE if a["op"] in (
+                "sum", "mean", "min", "max", "stdev", "range") else INTEGER
+            out_type = numeric_out if a["op"] != "first" and a["op"] != "last" \
+                else src["type"]
+            col = {"name": f"{a['op']}({a['column']})", "type": out_type}
+            if "states" in src and a["op"] in ("first", "last"):
+                col["states"] = list(src["states"])
+            cols.append(col)
+        return Schema(cols)
+
+    def execute(self, schema: Schema,
+                records: Sequence[Sequence]) -> List[list]:
+        ki = [schema.index_of(k) for k in self.keys]
+        ai = [(schema.index_of(a["column"]), a["op"]) for a in self.aggs]
+        groups: Dict[tuple, List[Sequence]] = {}
+        order: List[tuple] = []
+        for r in records:
+            key = tuple(r[i] for i in ki)
+            if key not in groups:
+                groups[key] = []
+                order.append(key)
+            groups[key].append(r)
+        out = []
+        for key in order:
+            rows = groups[key]
+            rec = list(key)
+            for i, op in ai:
+                vals = [r[i] for r in rows]
+                if op == "count":
+                    rec.append(len(vals))
+                elif op == "count_unique":
+                    rec.append(len(set(vals)))
+                elif op == "first":
+                    rec.append(vals[0])
+                elif op == "last":
+                    rec.append(vals[-1])
+                else:
+                    a = np.asarray([float(v) for v in vals], np.float64)
+                    rec.append(float({
+                        "sum": a.sum(), "mean": a.mean(),
+                        "min": a.min(), "max": a.max(),
+                        "stdev": a.std(ddof=1) if a.size > 1 else 0.0,
+                        "range": a.max() - a.min()}[op]))
+            out.append(rec)
+        return out
+
+    # -- serde --
+    def to_json(self) -> str:
+        return json.dumps({"keys": self.keys, "aggs": self.aggs})
+
+    @staticmethod
+    def from_json(js: str) -> "Reducer":
+        d = json.loads(js)
+        return Reducer(d["keys"], d["aggs"])

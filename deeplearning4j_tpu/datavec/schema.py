@@ -170,6 +170,44 @@ class TransformProcess:
                                 "value": value})
             return self
 
+        # -- sequence verbs (reference .../transform/sequence/**†) --
+        def convert_to_sequence(self, key_column: str, sort_column: str):
+            """Group flat records into sequences by ``key_column``, each
+            sorted ascending by ``sort_column`` (reference
+            ``convertToSequence(key, comparator)``). Subsequent column
+            steps apply per sequence step; finish with
+            ``execute_to_sequences``."""
+            self._steps.append({"op": "to_sequence", "key": key_column,
+                                "sort": sort_column})
+            return self
+
+        def offset_sequence(self, columns: Sequence[str], offset: int):
+            """Shift ``columns`` by ``offset`` steps within each sequence
+            (positive = value from ``offset`` steps EARLIER appears at the
+            current step — the autoregressive-label shift); edge rows
+            without complete data are trimmed (reference
+            ``SequenceOffsetTransform`` trim mode)."""
+            self._steps.append({"op": "seq_offset",
+                                "names": list(columns),
+                                "offset": int(offset)})
+            return self
+
+        def sequence_window(self, window_size: int, step: Optional[int] = None):
+            """Split each sequence into fixed-size windows; ``step`` <
+            ``window_size`` gives overlapping windows (reference
+            time-window functions, index-based form). Short tails drop."""
+            self._steps.append({"op": "seq_window",
+                                "size": int(window_size),
+                                "step": int(step or window_size)})
+            return self
+
+        def trim_sequence(self, n: int, from_start: bool = True):
+            """Remove ``n`` steps from the start (or end) of each sequence
+            (reference ``SequenceTrimTransform``)."""
+            self._steps.append({"op": "seq_trim", "n": int(n),
+                                "from_start": bool(from_start)})
+            return self
+
         def build(self) -> "TransformProcess":
             return TransformProcess(self._schema, self._steps)
 
@@ -179,18 +217,68 @@ class TransformProcess:
 
     # -- execution --
     def final_schema(self) -> Schema:
-        schema, _ = self._run(None)
+        schema, _, _ = self._run(None)
         return schema
 
     def execute(self, records: Sequence[Sequence]) -> List[list]:
-        _, out = self._run([list(r) for r in records])
+        _, out, is_seq = self._run([list(r) for r in records])
+        if is_seq:
+            raise ValueError("pipeline produces sequences — call "
+                             "execute_to_sequences()")
+        return out
+
+    def execute_to_sequences(self, records: Sequence[Sequence]) -> List[list]:
+        """Run a pipeline containing sequence verbs; returns a list of
+        sequences (each a list of record rows)."""
+        _, out, is_seq = self._run([list(r) for r in records])
+        if not is_seq:
+            raise ValueError("pipeline produces flat records — call "
+                             "execute()")
         return out
 
     def _run(self, records: Optional[List[list]]):
         schema = Schema([dict(c) for c in self.initial_schema.columns])
+        is_seq = False
         for st in self.steps:
-            schema, records = _apply_step(st, schema, records)
-        return schema, records
+            op = st["op"]
+            if op == "to_sequence":
+                if is_seq:
+                    raise ValueError("already in sequence form")
+                if records is not None:
+                    key = schema.index_of(st["key"])
+                    srt = schema.index_of(st["sort"])
+                    groups: Dict[Any, List[list]] = {}
+                    order = []
+                    for r in records:
+                        k = r[key]
+                        if k not in groups:
+                            groups[k] = []
+                            order.append(k)
+                        groups[k].append(r)
+                    records = [sorted(groups[k], key=lambda r: r[srt])
+                               for k in order]
+                is_seq = True
+            elif op in ("seq_offset", "seq_window", "seq_trim"):
+                if not is_seq:
+                    raise ValueError(f"{op} requires convert_to_sequence "
+                                     "first")
+                schema, records = _apply_seq_step(st, schema, records)
+            elif is_seq:
+                # column steps apply within each sequence step
+                if records is None:
+                    schema, _ = _apply_step(st, schema, None)
+                else:
+                    new_records = []
+                    new_schema = None
+                    for seq in records:
+                        s2 = Schema([dict(c) for c in schema.columns])
+                        s2, seq2 = _apply_step(dict(st), s2, seq)
+                        new_records.append(seq2)
+                        new_schema = s2
+                    schema, records = new_schema, new_records
+            else:
+                schema, records = _apply_step(st, schema, records)
+        return schema, records, is_seq
 
     # -- serde --
     def to_json(self) -> str:
@@ -334,6 +422,80 @@ def _apply_step(st: dict, schema: Schema, records: Optional[List[list]]):
     return schema, records
 
 
+def _apply_seq_step(st: dict, schema: Schema, sequences):
+    """Sequence-form steps: sequences is List[List[row]] (or None for
+    schema-only propagation)."""
+    op = st["op"]
+    if op == "seq_offset":
+        off = st["offset"]
+        idxs = [schema.index_of(n) for n in st["names"]]
+        if sequences is not None:
+            out = []
+            for seq in sequences:
+                n = len(seq)
+                lo, hi = (off, n) if off > 0 else (0, n + off)
+                new_seq = []
+                for t in range(lo, hi):
+                    row = list(seq[t])
+                    for i in idxs:
+                        row[i] = seq[t - off][i]
+                    new_seq.append(row)
+                out.append(new_seq)
+            sequences = out
+    elif op == "seq_window":
+        size, step = st["size"], st["step"]
+        if sequences is not None:
+            out = []
+            for seq in sequences:
+                for s in range(0, len(seq) - size + 1, step):
+                    out.append([list(r) for r in seq[s:s + size]])
+            sequences = out
+    elif op == "seq_trim":
+        n = st["n"]
+        if sequences is not None:
+            sequences = [seq[n:] if st["from_start"] else seq[:-n]
+                         for seq in sequences]
+            sequences = [s for s in sequences if s]
+    else:
+        raise ValueError(f"unknown sequence step {op!r}")
+    return schema, sequences
+
+
+class DataQualityAnalysis:
+    """Per-column data-quality counts (reference ``AnalyzeLocal
+    .analyzeQuality`` / ``DataQualityAnalysis``†): missing, invalid
+    (unparseable/NaN numeric, out-of-state categorical), and total."""
+
+    def __init__(self, schema: Schema, records: Sequence[Sequence]):
+        self.schema = schema
+        self.columns: Dict[str, dict] = {}
+        for i, c in enumerate(schema.columns):
+            missing = invalid = 0
+            for r in records:
+                v = r[i] if i < len(r) else None
+                if v is None or (isinstance(v, str) and not v.strip()):
+                    missing += 1
+                    continue
+                if c["type"] in (INTEGER, DOUBLE):
+                    try:
+                        f = float(v)
+                        if math.isnan(f):
+                            invalid += 1
+                        elif c["type"] == INTEGER and f != int(f):
+                            invalid += 1
+                    except (TypeError, ValueError):
+                        invalid += 1
+                elif c["type"] == CATEGORICAL:
+                    if str(v) not in c.get("states", []):
+                        invalid += 1
+            self.columns[c["name"]] = {"missing": missing,
+                                       "invalid": invalid,
+                                       "total": len(records)}
+
+    def column(self, name: str) -> dict:
+        return self.columns[name]
+
+
 class DataAnalysis:
     """Per-column statistics over records (reference ``AnalyzeLocal`` /
     ``DataAnalysis``†): min/max/mean/std for numeric columns, state counts
@@ -346,10 +508,24 @@ class DataAnalysis:
         for i, c in enumerate(schema.columns):
             vals = [r[i] for r in records]
             if c["type"] in (INTEGER, DOUBLE):
-                a = np.asarray([float(v) for v in vals], dtype=np.float64)
+                parsed = []
+                missing = 0
+                for v in vals:
+                    try:
+                        f = float(v)
+                        if math.isnan(f):
+                            missing += 1
+                        else:
+                            parsed.append(f)
+                    except (TypeError, ValueError):
+                        missing += 1
+                a = np.asarray(parsed, dtype=np.float64)
                 self.columns[c["name"]] = {
-                    "min": float(a.min()), "max": float(a.max()),
-                    "mean": float(a.mean()), "std": float(a.std()),
+                    "min": float(a.min()) if a.size else float("nan"),
+                    "max": float(a.max()) if a.size else float("nan"),
+                    "mean": float(a.mean()) if a.size else float("nan"),
+                    "std": float(a.std()) if a.size else float("nan"),
+                    "missing": missing,
                     "count": int(a.size)}
             else:
                 counts: Dict[str, int] = {}

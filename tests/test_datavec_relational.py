@@ -1,0 +1,162 @@
+"""Transform DSL round 3: joins, reducers, sequence verbs, quality analysis
+(SURVEY.md §2.3; ref datavec-api transform/{join,reduce,sequence,analysis}†,
+mount empty, unverified)."""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datavec.relational import (FULL_OUTER, INNER,
+                                                   LEFT_OUTER, RIGHT_OUTER,
+                                                   Join, Reducer)
+from deeplearning4j_tpu.datavec.schema import (DataAnalysis,
+                                               DataQualityAnalysis, Schema,
+                                               TransformProcess)
+
+
+def _people():
+    s = (Schema.builder().add_column_integer("id")
+         .add_column_string("name").build())
+    rows = [[1, "ada"], [2, "bob"], [3, "cyd"]]
+    return s, rows
+
+
+def _orders():
+    s = (Schema.builder().add_column_integer("id")
+         .add_column_double("amount").build())
+    rows = [[1, 10.0], [1, 5.0], [3, 7.5], [4, 99.0]]
+    return s, rows
+
+
+def test_inner_join():
+    ls, lr = _people()
+    rs, rr = _orders()
+    j = (Join.Builder(INNER).set_join_columns("id")
+         .set_schemas(ls, rs).build())
+    out = j.execute(lr, rr)
+    assert sorted(out) == [[1, "ada", 10.0], [1, "ada", 5.0],
+                           [3, "cyd", 7.5]] or \
+        sorted(map(tuple, out)) == sorted(
+            [(1, "ada", 10.0), (1, "ada", 5.0), (3, "cyd", 7.5)])
+    assert j.output_schema().names() == ["id", "name", "amount"]
+
+
+def test_left_right_full_outer_join():
+    ls, lr = _people()
+    rs, rr = _orders()
+    left = Join.Builder(LEFT_OUTER).set_join_columns("id") \
+        .set_schemas(ls, rs).build().execute(lr, rr)
+    assert [2, "bob", None] in left and len(left) == 4
+    right = Join.Builder(RIGHT_OUTER).set_join_columns("id") \
+        .set_schemas(ls, rs).build().execute(lr, rr)
+    assert [4, None, 99.0] in right and len(right) == 4
+    full = Join.Builder(FULL_OUTER).set_join_columns("id") \
+        .set_schemas(ls, rs).build().execute(lr, rr)
+    assert [2, "bob", None] in full and [4, None, 99.0] in full
+    assert len(full) == 5
+
+
+def test_join_json_roundtrip():
+    ls, _ = _people()
+    rs, _ = _orders()
+    j = Join.Builder(INNER).set_join_columns("id") \
+        .set_schemas(ls, rs).build()
+    j2 = Join.from_json(j.to_json())
+    assert j2.join_type == INNER and j2.keys == ["id"]
+    assert j2.output_schema().names() == j.output_schema().names()
+
+
+def test_reducer_aggregations():
+    s = (Schema.builder().add_column_string("key")
+         .add_column_double("x").add_column_integer("y").build())
+    rows = [["a", 1.0, 10], ["b", 4.0, 1], ["a", 3.0, 20], ["a", 2.0, 30]]
+    red = (Reducer.builder("key").sum_columns("x").mean_columns("x")
+           .min_columns("y").max_columns("y").count_columns("y")
+           .first_columns("y").last_columns("y").stdev_columns("x")
+           .build())
+    out = red.execute(s, rows)
+    by_key = {r[0]: r for r in out}
+    a = by_key["a"]
+    assert a[1] == pytest.approx(6.0)          # sum(x)
+    assert a[2] == pytest.approx(2.0)          # mean(x)
+    assert a[3] == pytest.approx(10)           # min(y)
+    assert a[4] == pytest.approx(30)           # max(y)
+    assert a[5] == 3                           # count(y)
+    assert a[6] == 10 and a[7] == 30           # first/last(y)
+    assert a[8] == pytest.approx(np.std([1, 3, 2], ddof=1))
+    names = red.output_schema(s).names()
+    assert names == ["key", "sum(x)", "mean(x)", "min(y)", "max(y)",
+                     "count(y)", "first(y)", "last(y)", "stdev(x)"]
+    r2 = Reducer.from_json(red.to_json())
+    assert r2.execute(s, rows) == out
+
+
+def test_sequence_convert_offset_window():
+    s = (Schema.builder().add_column_string("sensor")
+         .add_column_integer("t").add_column_double("v").build())
+    rows = [["a", 2, 3.0], ["a", 0, 1.0], ["b", 0, 10.0],
+            ["a", 1, 2.0], ["b", 1, 20.0], ["a", 3, 4.0]]
+    tp = (TransformProcess.builder(s)
+          .convert_to_sequence("sensor", "t")
+          .build())
+    seqs = tp.execute_to_sequences(rows)
+    assert len(seqs) == 2
+    assert [r[2] for r in seqs[0]] == [1.0, 2.0, 3.0, 4.0]  # sorted by t
+
+    # offset: v shifted by +1 (previous step's value), edges trimmed
+    tp2 = (TransformProcess.builder(s)
+           .convert_to_sequence("sensor", "t")
+           .offset_sequence(["v"], 1)
+           .build())
+    seqs2 = tp2.execute_to_sequences(rows)
+    assert [r[2] for r in seqs2[0]] == [1.0, 2.0, 3.0]  # values from t-1
+    assert [r[1] for r in seqs2[0]] == [1, 2, 3]        # rows t=1..3
+
+    # windows of 2, step 1 over the length-4 'a' sequence -> 3 windows;
+    # the length-2 'b' sequence -> 1 window
+    tp3 = (TransformProcess.builder(s)
+           .convert_to_sequence("sensor", "t")
+           .sequence_window(2, 1)
+           .build())
+    seqs3 = tp3.execute_to_sequences(rows)
+    assert len(seqs3) == 4
+    assert all(len(w) == 2 for w in seqs3)
+
+    # JSON round-trip keeps sequence steps executable
+    tp4 = TransformProcess.from_json(tp3.to_json())
+    assert len(tp4.execute_to_sequences(rows)) == 4
+
+
+def test_column_ops_apply_within_sequences():
+    s = (Schema.builder().add_column_string("k")
+         .add_column_integer("t").add_column_double("v").build())
+    rows = [["a", 0, 1.0], ["a", 1, 2.0], ["b", 0, 3.0]]
+    tp = (TransformProcess.builder(s)
+          .convert_to_sequence("k", "t")
+          .double_math_op("v", "multiply", 10.0)
+          .build())
+    seqs = tp.execute_to_sequences(rows)
+    assert [r[2] for r in seqs[0]] == [10.0, 20.0]
+    assert [r[2] for r in seqs[1]] == [30.0]
+
+
+def test_trim_sequence():
+    s = (Schema.builder().add_column_string("k")
+         .add_column_integer("t").build())
+    rows = [["a", i] for i in range(5)]
+    tp = (TransformProcess.builder(s).convert_to_sequence("k", "t")
+          .trim_sequence(2, from_start=True).build())
+    seqs = tp.execute_to_sequences(rows)
+    assert [r[1] for r in seqs[0]] == [2, 3, 4]
+
+
+def test_quality_analysis_and_missing_stats():
+    s = (Schema.builder().add_column_double("x")
+         .add_column_categorical("c", "yes", "no").build())
+    rows = [[1.0, "yes"], ["oops", "maybe"], [None, "no"],
+            [float("nan"), "yes"], [2.0, ""]]
+    q = DataQualityAnalysis(s, rows)
+    assert q.column("x") == {"missing": 1, "invalid": 2, "total": 5}
+    assert q.column("c") == {"missing": 1, "invalid": 1, "total": 5}
+    da = DataAnalysis(s, rows)
+    assert da.column("x")["count"] == 2
+    assert da.column("x")["missing"] == 3
+    assert da.column("x")["min"] == 1.0 and da.column("x")["max"] == 2.0
