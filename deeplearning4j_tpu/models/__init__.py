@@ -6,4 +6,4 @@ from .nasnet import nasnet_mobile  # noqa: F401
 from .facenet import facenet_nn4_small2, inception_resnet_v1  # noqa: F401
 from .zoo import (alexnet, darknet19, simple_cnn, squeezenet,  # noqa: F401
                   text_generation_lstm, tiny_yolo, unet, vgg16, vgg19,
-                  xception)
+                  xception, yolo2)

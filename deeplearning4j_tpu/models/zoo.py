@@ -211,6 +211,73 @@ def text_generation_lstm(vocab_size: int = 77, embedding: Optional[int] = None,
 
 # ---- graph CNNs --------------------------------------------------------------
 
+def yolo2(num_classes: int = 80, input_shape=(608, 608, 3),
+          boxes=((0.57273, 0.677385), (1.87446, 2.06253),
+                 (3.33843, 5.47434), (7.88282, 3.52778),
+                 (9.77052, 9.16828)),
+          seed: int = 42, updater=None) -> ComputationGraph:
+    """YOLO2 (zoo ``YOLO2.java``†): full Darknet-19 backbone with the
+    passthrough (reorg) skip — the mid-level 512-channel feature map is
+    1x1-reduced to 64 channels, space-to-depth'd 2x to the coarse grid, and
+    concatenated with the deep path before the detection head. The one zoo
+    entry round 2 lacked."""
+    from ..nn.layers.conv_extra import SpaceToDepthLayer
+    h, w, c = input_shape
+    a = len(boxes)
+    gb = (_builder(seed, updater).graph_builder()
+          .add_inputs("in")
+          .set_input_types(InputType.convolutional(c, h, w, NHWC)))
+
+    def cbl(name, n, k, inp):
+        gb.add_layer(f"{name}_conv",
+                     ConvolutionLayer(n_out=n, kernel=(k, k), mode="same",
+                                      has_bias=False, data_format=NHWC), inp)
+        gb.add_layer(f"{name}_bn", BatchNormalization(data_format=NHWC),
+                     f"{name}_conv")
+        gb.add_layer(f"{name}_act",
+                     ActivationLayer(activation="leakyrelu", alpha=0.1),
+                     f"{name}_bn")
+        return f"{name}_act"
+
+    top = cbl("c1", 32, 3, "in")
+    gb.add_layer("p1", _pool(2), top)
+    top = cbl("c2", 64, 3, "p1")
+    gb.add_layer("p2", _pool(2), top)
+    top = cbl("c3", 128, 3, "p2")
+    top = cbl("c4", 64, 1, top)
+    top = cbl("c5", 128, 3, top)
+    gb.add_layer("p3", _pool(2), top)
+    top = cbl("c6", 256, 3, "p3")
+    top = cbl("c7", 128, 1, top)
+    top = cbl("c8", 256, 3, top)
+    gb.add_layer("p4", _pool(2), top)
+    top = cbl("c9", 512, 3, "p4")
+    top = cbl("c10", 256, 1, top)
+    top = cbl("c11", 512, 3, top)
+    top = cbl("c12", 256, 1, top)
+    passthrough = cbl("c13", 512, 3, top)     # 512ch at stride 16
+    gb.add_layer("p5", _pool(2), passthrough)
+    top = cbl("c14", 1024, 3, "p5")
+    top = cbl("c15", 512, 1, top)
+    top = cbl("c16", 1024, 3, top)
+    top = cbl("c17", 512, 1, top)
+    top = cbl("c18", 1024, 3, top)
+    top = cbl("c19", 1024, 3, top)
+    deep = cbl("c20", 1024, 3, top)
+    # passthrough: 1x1 to 64ch, reorg 2x2 -> 256ch at the coarse grid
+    reduced = cbl("c21", 64, 1, passthrough)
+    gb.add_layer("reorg", SpaceToDepthLayer(block_size=2, data_format=NHWC),
+                 reduced)
+    gb.add_vertex("route", MergeVertex(data_format=NHWC), "reorg", deep)
+    top = cbl("c22", 1024, 3, "route")
+    gb.add_layer("det_conv",
+                 ConvolutionLayer(n_out=a * (5 + num_classes), kernel=(1, 1),
+                                  mode="same", data_format=NHWC), top)
+    gb.add_layer("out", Yolo2OutputLayer(boxes=tuple(boxes)), "det_conv")
+    gb.set_outputs("out")
+    return ComputationGraph(gb.build())
+
+
 def squeezenet(num_classes: int = 1000, input_shape=(227, 227, 3),
                seed: int = 42, updater=None) -> ComputationGraph:
     """SqueezeNet v1.1 (zoo ``SqueezeNet.java``†: fire modules =

@@ -187,3 +187,54 @@ def test_nasnet_mobile():
     net.fit(DataSet(x, y), epochs=1)
     assert np.isfinite(float(net.score()))
     assert net.output(x).shape == (2, 4)
+
+
+def test_yolo2():
+    """YOLO2 (the round-2 gap): full darknet backbone + reorg passthrough
+    concat; forward shape and a finite train step on a shrunk config."""
+    from deeplearning4j_tpu.models.zoo import yolo2
+    boxes = ((1.0, 1.0), (2.0, 2.0))
+    net = yolo2(num_classes=3, input_shape=(64, 64, 3), boxes=boxes,
+                updater=Sgd(learning_rate=1e-4))
+    net.init()
+    x = RNG.normal(size=(2, 64, 64, 3)).astype(np.float32)
+    out = net.output(x)
+    grid = 64 // 32  # five 2x pools
+    assert out.shape == (2, grid, grid, len(boxes) * (5 + 3))
+    label = np.zeros((2, grid, grid, len(boxes), 8), np.float32)
+    label[0, 0, 0, 0] = [1, 0.5, 0.5, 0.1, 0.1, 1, 0, 0]
+    net.fit(x, label.reshape(2, grid, grid, -1))
+    assert np.isfinite(float(net.score()))
+
+
+def test_pretrained_path_h5_weight_interchange(tmp_path):
+    """The initPretrained-equivalent path (zero-egress honest): a tf.keras
+    model with REAL (trained-in-process) weights saves to h5, imports, and
+    predicts IDENTICALLY — proving pretrained Keras checkpoints are a
+    faithful weight source for this framework."""
+    import tensorflow as tf
+    from deeplearning4j_tpu.modelimport import KerasModelImport
+
+    m = tf.keras.Sequential([
+        tf.keras.layers.Input(shape=(8, 8, 3)),
+        tf.keras.layers.Conv2D(8, 3, padding="same", name="c1"),
+        tf.keras.layers.BatchNormalization(name="bn"),
+        tf.keras.layers.Activation("relu", name="a"),
+        tf.keras.layers.GlobalAveragePooling2D(name="gap"),
+        tf.keras.layers.Dense(4, activation="softmax", name="out"),
+    ])
+    m.compile(optimizer="adam", loss="categorical_crossentropy")
+    x_train = RNG.normal(size=(64, 8, 8, 3)).astype(np.float32)
+    y_train = np.eye(4, dtype=np.float32)[RNG.integers(0, 4, 64)]
+    m.fit(x_train, y_train, epochs=2, batch_size=16, verbose=0)  # real weights
+
+    p = str(tmp_path / "pretrained.h5")
+    m.save(p)
+    net = KerasModelImport.import_keras_model_and_weights(p)
+    x = RNG.normal(size=(5, 8, 8, 3)).astype(np.float32)
+    ref = m.predict(x, verbose=0)
+    np.testing.assert_allclose(np.asarray(net.output(x)), ref,
+                               rtol=1e-4, atol=1e-4)
+    # and the imported model fine-tunes
+    net.fit(DataSet(x_train[:16], y_train[:16]))
+    assert np.isfinite(float(net.score()))
