@@ -286,6 +286,83 @@ def mixture_density(labels, predictions, mask=None, weights=None,
     return _per_example(nll, mask)
 
 
+@_loss("ctc")
+def ctc(labels, predictions, mask=None, weights=None, blank=0):
+    """Connectionist Temporal Classification negative log-likelihood
+    (libnd4j ``ctc_loss`` declarable op / cuDNN ctcLoss helper path† per
+    SURVEY.md §2.1; mount empty, unverified).
+
+    ``predictions``: [B, T, C] LOGITS (use activation="identity" on the
+    loss layer; log_softmax is applied here, matching torch/cudnn).
+    ``labels``: [B, S] integer class ids, padded with any NEGATIVE value;
+    label lengths are the per-row count of non-negative entries. ``blank``
+    is class 0 (torch/cudnn convention). ``mask``: optional [B, T] input
+    mask; input lengths are its per-row sums (None = full length).
+
+    Forward algorithm in log space as ONE ``lax.scan`` over time (the XLA
+    shape: the [B, 2S+1] alpha lattice updates are fused elementwise +
+    gathers). Gradients come from jax.grad through the scan — no
+    hand-written beta recursion needed. Returns the batch MEAN of the
+    per-sequence NLL (torch reduction='sum over lattice, mean over batch
+    without length scaling' == reduction='none'.mean()).
+    """
+    lp = jax.nn.log_softmax(predictions, axis=-1)          # [B,T,C]
+    B, T, C = lp.shape
+    S = labels.shape[1]
+    lab = jnp.maximum(labels, 0)
+    label_len = jnp.sum(labels >= 0, axis=1)               # [B]
+    if mask is None:
+        input_len = jnp.full((B,), T, jnp.int32)
+    else:
+        input_len = jnp.sum(jnp.asarray(mask) > 0, axis=1).astype(jnp.int32)
+    NEG = jnp.asarray(jnp.finfo(lp.dtype).min / 2, lp.dtype)
+
+    # extended label sequence: blank, l1, blank, l2, ..., blank  [B, 2S+1]
+    ext = jnp.full((B, 2 * S + 1), blank, lab.dtype)
+    ext = ext.at[:, 1::2].set(lab)
+    # skip transition k-2 -> k allowed when ext[k] is a label differing
+    # from ext[k-2]
+    ext_m2 = jnp.concatenate([jnp.full((B, 2), blank, lab.dtype),
+                              ext[:, :-2]], axis=1)
+    can_skip = (ext != blank) & (ext != ext_m2)
+    can_skip = can_skip.at[:, :2].set(False)
+    # positions beyond this row's 2*label_len are invalid lattice states
+    pos = jnp.arange(2 * S + 1)[None, :]
+    valid_state = pos <= 2 * label_len[:, None]
+
+    emit0 = jnp.take_along_axis(lp[:, 0, :], ext, axis=1)  # [B, 2S+1]
+    alpha0 = jnp.where(pos <= 1, emit0, NEG)
+    alpha0 = jnp.where(valid_state, alpha0, NEG)
+
+    def step(alpha, inp):
+        lp_t, t = inp                                       # [B,C], scalar
+        a1 = jnp.concatenate([jnp.full((B, 1), NEG, lp.dtype),
+                              alpha[:, :-1]], axis=1)
+        a2 = jnp.concatenate([jnp.full((B, 2), NEG, lp.dtype),
+                              alpha[:, :-2]], axis=1)
+        a2 = jnp.where(can_skip, a2, NEG)
+        merged = jnp.logaddexp(jnp.logaddexp(alpha, a1), a2)
+        emit = jnp.take_along_axis(lp_t, ext, axis=1)
+        new = jnp.where(valid_state, merged + emit, NEG)
+        active = (t < input_len)[:, None]                   # padded steps hold
+        return jnp.where(active, new, alpha), None
+
+    lps = jnp.moveaxis(lp[:, 1:, :], 1, 0)                  # [T-1,B,C]
+    ts = jnp.arange(1, T, dtype=jnp.int32)
+    alpha, _ = jax.lax.scan(step, alpha0, (lps, ts))
+
+    idx_last = (2 * label_len)[:, None]                     # final blank
+    idx_prev = jnp.maximum(2 * label_len - 1, 0)[:, None]   # final label
+    a_last = jnp.take_along_axis(alpha, idx_last, axis=1)[:, 0]
+    a_prev = jnp.where(label_len > 0,
+                       jnp.take_along_axis(alpha, idx_prev, axis=1)[:, 0],
+                       NEG)
+    nll = -jnp.logaddexp(a_last, a_prev)                    # [B]
+    if weights is not None:
+        nll = nll * jnp.asarray(weights)
+    return jnp.mean(nll)
+
+
 def get(name_or_fn):
     if callable(name_or_fn):
         return name_or_fn

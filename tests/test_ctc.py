@@ -1,0 +1,102 @@
+"""CTC loss: forward + gradient checked against the torch oracle
+(torch.nn.functional.ctc_loss), plus RnnLossLayer wiring with masks
+(SURVEY.md §2.1 cuDNN ctcLoss helper row)."""
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+jnp = pytest.importorskip("jax.numpy")
+import jax
+
+from deeplearning4j_tpu.ops import losses as L
+
+
+def _torch_ctc(logits, labels, input_len, label_len, reduction="none"):
+    lp = torch.nn.functional.log_softmax(
+        torch.tensor(logits).transpose(0, 1), dim=-1)  # [T,B,C]
+    return torch.nn.functional.ctc_loss(
+        lp, torch.tensor(labels), torch.tensor(input_len),
+        torch.tensor(label_len), blank=0, reduction=reduction,
+        zero_infinity=False)
+
+
+def test_ctc_forward_matches_torch():
+    rng = np.random.default_rng(0)
+    B, T, C, S = 3, 9, 6, 4
+    logits = rng.normal(size=(B, T, C)).astype(np.float32)
+    labels = np.array([[1, 2, 2, 3], [4, 1, -1, -1], [5, -1, -1, -1]],
+                      np.int32)
+    label_len = (labels >= 0).sum(1).astype(np.int64)
+    input_len = np.array([9, 9, 9], np.int64)
+    ref = _torch_ctc(logits, np.maximum(labels, 0), input_len,
+                     label_len).numpy()
+    ours = L.ctc(jnp.asarray(labels), jnp.asarray(logits))
+    np.testing.assert_allclose(float(ours), ref.mean(), rtol=1e-5)
+
+
+def test_ctc_respects_input_mask():
+    rng = np.random.default_rng(1)
+    B, T, C = 2, 8, 5
+    logits = rng.normal(size=(B, T, C)).astype(np.float32)
+    labels = np.array([[1, 3], [2, -1]], np.int32)
+    mask = np.zeros((B, T), np.float32)
+    mask[0, :6] = 1
+    mask[1, :4] = 1
+    ref = _torch_ctc(logits, np.maximum(labels, 0),
+                     np.array([6, 4], np.int64),
+                     np.array([2, 1], np.int64)).numpy()
+    ours = L.ctc(jnp.asarray(labels), jnp.asarray(logits),
+                 mask=jnp.asarray(mask))
+    np.testing.assert_allclose(float(ours), ref.mean(), rtol=1e-5)
+
+
+def test_ctc_gradient_matches_torch():
+    rng = np.random.default_rng(2)
+    B, T, C, S = 2, 7, 5, 3
+    logits = rng.normal(size=(B, T, C)).astype(np.float32)
+    labels = np.array([[1, 2, 1], [3, 4, -1]], np.int32)
+    label_len = (labels >= 0).sum(1).astype(np.int64)
+    input_len = np.array([7, 7], np.int64)
+
+    t_logits = torch.tensor(logits, requires_grad=True)
+    lp = torch.nn.functional.log_softmax(t_logits.transpose(0, 1), dim=-1)
+    loss = torch.nn.functional.ctc_loss(
+        lp, torch.tensor(np.maximum(labels, 0)), torch.tensor(input_len),
+        torch.tensor(label_len), blank=0, reduction="none").mean()
+    loss.backward()
+    ref_grad = t_logits.grad.numpy()
+
+    g = jax.grad(lambda lo: L.ctc(jnp.asarray(labels), lo))(
+        jnp.asarray(logits))
+    np.testing.assert_allclose(np.asarray(g), ref_grad, rtol=1e-4, atol=1e-6)
+
+
+def test_rnn_loss_layer_ctc_trains():
+    """RnnLossLayer(loss='ctc', activation='identity') on an LSTM stack:
+    the CTC NLL decreases on a fixed tiny dataset."""
+    from deeplearning4j_tpu.nn.config import (InputType,
+                                              NeuralNetConfiguration)
+    from deeplearning4j_tpu.nn.layers.core import DenseLayer
+    from deeplearning4j_tpu.nn.layers.recurrent import LSTM, RnnLossLayer
+    from deeplearning4j_tpu.nn.model import MultiLayerNetwork
+    from deeplearning4j_tpu.nn.updaters import Adam
+
+    rng = np.random.default_rng(3)
+    B, T, F, C = 4, 10, 3, 5
+    x = rng.normal(size=(B, T, F)).astype(np.float32)
+    labels = np.array([[1, 2, -1], [3, -1, -1], [2, 2, -1], [4, 1, 2]],
+                      np.int32)
+    conf = (NeuralNetConfiguration.builder().seed(0)
+            .updater(Adam(learning_rate=3e-2))
+            .input_type(InputType.recurrent(F, T))
+            .list(LSTM(n_out=16),
+                  DenseLayer(n_out=C, activation="identity"),
+                  RnnLossLayer(loss="ctc", activation="identity"))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    from deeplearning4j_tpu.data.dataset import DataSet
+    net.fit(DataSet(x, labels), epochs=1)
+    first = float(net.score())
+    net.fit(DataSet(x, labels), epochs=30)
+    assert float(net.score()) < first
+    assert np.isfinite(float(net.score()))
