@@ -655,6 +655,485 @@ class Tensor:
     def flatten(self):
         return self.ravel()
 
+    # ---- INDArray tail (round 3): structure probes -------------------------
+    # (demand-driven per dl4j-examples usage; the remaining unported tail is
+    # documented in PARITY.md — strided views/ordering/workspaces)
+    def rank(self) -> int:
+        return self._a.ndim
+
+    def rows(self) -> int:
+        if self._a.ndim != 2:
+            raise ValueError("rows() requires a matrix")
+        return self._a.shape[0]
+
+    def columns(self) -> int:
+        if self._a.ndim != 2:
+            raise ValueError("columns() requires a matrix")
+        return self._a.shape[1]
+
+    def is_matrix(self) -> bool:
+        return self._a.ndim == 2
+
+    def is_vector(self) -> bool:
+        return self._a.ndim == 1 or (
+            self._a.ndim == 2 and 1 in self._a.shape)
+
+    def is_row_vector(self) -> bool:
+        return self._a.ndim == 1 or (self._a.ndim == 2
+                                     and self._a.shape[0] == 1)
+
+    def is_column_vector(self) -> bool:
+        return self._a.ndim == 2 and self._a.shape[1] == 1
+
+    def is_scalar(self) -> bool:
+        return self._a.ndim == 0 or self._a.size == 1
+
+    def is_square(self) -> bool:
+        return self._a.ndim == 2 and self._a.shape[0] == self._a.shape[1]
+
+    def is_empty(self) -> bool:
+        return self._a.size == 0
+
+    # ---- scalar getters / converters (INDArray getDouble/toXVector) -------
+    def get_double(self, *idx) -> float:
+        return float(self._a[tuple(idx)])
+
+    def get_float(self, *idx) -> float:
+        return float(self._a[tuple(idx)])
+
+    def get_int(self, *idx) -> int:
+        return int(self._a[tuple(idx)])
+
+    def get_long(self, *idx) -> int:
+        return int(self._a[tuple(idx)])
+
+    def put_scalar(self, idx, value) -> "Tensor":
+        """DL4J putScalar (rebinds, returns self)."""
+        return self.puti(idx if isinstance(idx, tuple) else (idx,), value)
+
+    def to_double_vector(self):
+        return np.asarray(self._a, np.float64).reshape(-1)
+
+    def to_float_vector(self):
+        return np.asarray(self._a, np.float32).reshape(-1)
+
+    def to_int_vector(self):
+        return np.asarray(self._a, np.int32).reshape(-1)
+
+    def to_double_matrix(self):
+        if self._a.ndim != 2:
+            raise ValueError("to_double_matrix() requires a matrix")
+        return np.asarray(self._a, np.float64)
+
+    def to_float_matrix(self):
+        if self._a.ndim != 2:
+            raise ValueError("to_float_matrix() requires a matrix")
+        return np.asarray(self._a, np.float32)
+
+    def to_int_matrix(self):
+        if self._a.ndim != 2:
+            raise ValueError("to_int_matrix() requires a matrix")
+        return np.asarray(self._a, np.int32)
+
+    # ---- views / slicing (NDArrayIndex get/put, TADs) ----------------------
+    def get(self, *indices) -> "Tensor":
+        """``INDArray.get(NDArrayIndex...)``: see :class:`NDArrayIndex`.
+        Plain ints/slices work too. Returns a copy (XLA has no views —
+        recorded divergence)."""
+        return _wrap(self._a[_ndindex(indices)])
+
+    def put_indexed(self, indices, value) -> "Tensor":
+        """``INDArray.put(NDArrayIndex[], value)`` — functional, returns a
+        new tensor; ``puti_indexed`` rebinds."""
+        return _wrap(self._a.at[_ndindex(indices)].set(_unwrap(value)))
+
+    def puti_indexed(self, indices, value) -> "Tensor":
+        self._a = self.put_indexed(indices, value)._a
+        return self
+
+    def slice_at(self, i: int, dim: int = 0) -> "Tensor":
+        """DL4J ``slice(i, dim)``: drop ``dim`` at index i."""
+        return _wrap(jnp.take(self._a, i, axis=dim))
+
+    def num_slices(self, dim: int = 0) -> int:
+        return self._a.shape[dim]
+
+    def tensor_along_dimension(self, index: int, *dims) -> "Tensor":
+        """DL4J ``tensorAlongDimension(index, dims...)``: the index-th
+        sub-tensor spanning ``dims`` (remaining dims enumerate the TADs,
+        C-order)."""
+        dims = tuple(sorted(d % self._a.ndim for d in _normalize_dims(dims)))
+        other = [d for d in range(self._a.ndim) if d not in dims]
+        perm = other + list(dims)
+        moved = jnp.transpose(self._a, perm)
+        lead = 1
+        for d in other:
+            lead *= self._a.shape[d]
+        flat = moved.reshape((lead,) + tuple(self._a.shape[d] for d in dims))
+        return _wrap(flat[index])
+
+    def num_tensors_along_dimension(self, *dims) -> int:
+        dims = tuple(d % self._a.ndim for d in _normalize_dims(dims))
+        n = 1
+        for d in range(self._a.ndim):
+            if d not in dims:
+                n *= self._a.shape[d]
+        return n
+
+    def vector_along_dimension(self, index: int, dim: int) -> "Tensor":
+        return self.tensor_along_dimension(index, dim)
+
+    def sub_array(self, offsets, shape) -> "Tensor":
+        """DL4J subArray(offsets, shape): rectangular window copy."""
+        idx = tuple(slice(int(o), int(o) + int(s))
+                    for o, s in zip(offsets, shape))
+        return _wrap(self._a[idx])
+
+    def diag(self) -> "Tensor":
+        """Nd4j.diag: matrix -> its diagonal; vector -> diagonal matrix."""
+        return _wrap(jnp.diag(self._a))
+
+    def trace(self) -> float:
+        return float(jnp.trace(self._a))
+
+    def tril(self, k: int = 0) -> "Tensor":
+        return _wrap(jnp.tril(self._a, k))
+
+    def triu(self, k: int = 0) -> "Tensor":
+        return _wrap(jnp.triu(self._a, k))
+
+    def rot90(self, k: int = 1) -> "Tensor":
+        return _wrap(jnp.rot90(self._a, k))
+
+    def reverse(self) -> "Tensor":
+        """Nd4j.reverse: flip over every axis."""
+        return _wrap(jnp.flip(self._a))
+
+    def flip(self, *dims) -> "Tensor":
+        return _wrap(jnp.flip(self._a, _normalize_dims(dims)))
+
+    def roll(self, shift: int, axis=None) -> "Tensor":
+        return _wrap(jnp.roll(self._a, shift, axis=axis))
+
+    def pad(self, pad_width, value=0.0) -> "Tensor":
+        return _wrap(jnp.pad(self._a, pad_width, constant_values=value))
+
+    def split(self, n: int, axis: int = 0):
+        return [_wrap(p) for p in jnp.split(self._a, n, axis=axis)]
+
+    # ---- elementwise tail --------------------------------------------------
+    def asinh(self):
+        return self._unop("asinh", jnp.arcsinh)
+
+    def acosh(self):
+        return self._unop("acosh", jnp.arccosh)
+
+    def atanh(self):
+        return self._unop("atanh", jnp.arctanh)
+
+    def atan2(self, other):
+        return self._binop(other, "atan2", jnp.arctan2)
+
+    def rint(self):
+        return self._unop("rint", jnp.rint)
+
+    def trunc(self):
+        return self._unop("trunc", jnp.trunc)
+
+    def rsqrt(self):
+        return self._unop("rsqrt", lambda a: 1.0 / jnp.sqrt(a))
+
+    def cbrt(self):
+        return self._unop("cbrt", jnp.cbrt)
+
+    def log2(self):
+        return self._unop("log2", jnp.log2)
+
+    def mod(self, other):
+        return self._binop(other, "mod", jnp.mod)
+
+    def modi(self, other):
+        self._a = self.mod(other)._a
+        return self
+
+    def floor_div(self, other):
+        return self._binop(other, "floor_div", jnp.floor_divide)
+
+    def negi(self):
+        self._a = self.neg()._a
+        return self
+
+    def rsubi(self, other):
+        self._a = self.rsub(other)._a
+        return self
+
+    def rdivi(self, other):
+        self._a = self.rdiv(other)._a
+        return self
+
+    def powi(self, other):
+        self._a = self.pow(other)._a
+        return self
+
+    # Transforms.* activation sugar (nd4j ops/transforms/Transforms.java)
+    def elu(self):
+        return self._unop("elu", jax.nn.elu)
+
+    def softplus(self):
+        return self._unop("softplus", jax.nn.softplus)
+
+    def softsign(self):
+        return self._unop("softsign", jax.nn.soft_sign)
+
+    def swish(self):
+        return self._unop("swish", jax.nn.swish)
+
+    def gelu(self):
+        return self._unop("gelu", jax.nn.gelu)
+
+    def mish(self):
+        return self._unop("mish", jax.nn.mish)
+
+    def hard_tanh(self):
+        return self._unop("hard_tanh", jax.nn.hard_tanh)
+
+    def hard_sigmoid(self):
+        return self._unop("hard_sigmoid", jax.nn.hard_sigmoid)
+
+    def leaky_relu(self, alpha: float = 0.01):
+        return _wrap(_jitted(("leaky_relu", float(alpha)),
+                             lambda a: jnp.where(a >= 0, a, alpha * a))(
+            self._a))
+
+    def relu6(self):
+        return self._unop("relu6", jax.nn.relu6)
+
+    def log_sigmoid(self):
+        return self._unop("log_sigmoid", jax.nn.log_sigmoid)
+
+    def step(self):
+        """Heaviside step (Transforms.step)."""
+        return self._unop("step", lambda a: (a > 0).astype(a.dtype))
+
+    # ---- reductions tail ---------------------------------------------------
+    def median(self, axis=None):
+        r = jnp.median(self._a, axis=axis)
+        return float(r) if axis is None else _wrap(r)
+
+    def percentile(self, q, axis=None):
+        r = jnp.percentile(self._a, q, axis=axis)
+        return float(r) if axis is None and jnp.ndim(r) == 0 else _wrap(r)
+
+    def cumprod(self, axis=None) -> "Tensor":
+        return _wrap(jnp.cumprod(self._a, axis=axis))
+
+    def cummax(self, axis: int = 0) -> "Tensor":
+        return _wrap(jax.lax.cummax(self._a, axis=axis))
+
+    def cummin(self, axis: int = 0) -> "Tensor":
+        return _wrap(jax.lax.cummin(self._a, axis=axis))
+
+    def nansum(self, axis=None):
+        r = jnp.nansum(self._a, axis=axis)
+        return float(r) if axis is None else _wrap(r)
+
+    def nanmean(self, axis=None):
+        r = jnp.nanmean(self._a, axis=axis)
+        return float(r) if axis is None else _wrap(r)
+
+    def logsumexp(self, axis=None):
+        r = jax.nn.logsumexp(self._a, axis=axis)
+        return float(r) if axis is None else _wrap(r)
+
+    def shannon_entropy(self):
+        """-sum(p * log2(p)) (nd4j shannonEntropy)."""
+        return float(_jitted("shannon_entropy",
+                             lambda a: -jnp.sum(a * jnp.log2(a)))(self._a))
+
+    def log_entropy(self):
+        """log(entropy) (nd4j logEntropy)."""
+        return float(np.log(self.entropy().item()))
+
+    # ---- comparison / condition tail ---------------------------------------
+    def equals(self, other) -> bool:
+        o = _unwrap(other)
+        return (self._a.shape == o.shape
+                and bool(jnp.all(self._a == o)))
+
+    def equals_with_eps(self, other, eps: float = 1e-5) -> bool:
+        o = _unwrap(other)
+        return (self._a.shape == o.shape
+                and bool(jnp.all(jnp.abs(self._a - o) <= eps)))
+
+    def all_close(self, other, rtol: float = 1e-5, atol: float = 1e-8) -> bool:
+        return bool(jnp.allclose(self._a, _unwrap(other), rtol=rtol,
+                                 atol=atol))
+
+    def match_condition_count(self, cond: str, value) -> int:
+        """BooleanIndexing ``MatchCondition`` count: elements where the
+        condition holds. cond in {eq, neq, lt, lte, gt, gte}."""
+        return int(jnp.sum(_condition_mask(self._a, cond, value)))
+
+    def match_condition(self, cond: str, value) -> "Tensor":
+        """Boolean mask of elements satisfying the condition."""
+        return _wrap(_condition_mask(self._a, cond, value))
+
+    def replace_where_condition(self, cond: str, value, replacement
+                                ) -> "Tensor":
+        """BooleanIndexing.replaceWhere with a named condition."""
+        return _wrap(jnp.where(_condition_mask(self._a, cond, value),
+                               jnp.asarray(replacement, self._a.dtype),
+                               self._a))
+
+    # ---- combining ---------------------------------------------------------
+    def hstack(self, *others) -> "Tensor":
+        return _wrap(jnp.hstack([self._a] + [_unwrap(o) for o in others]))
+
+    def vstack(self, *others) -> "Tensor":
+        return _wrap(jnp.vstack([self._a] + [_unwrap(o) for o in others]))
+
+    def concat_with(self, axis, *others) -> "Tensor":
+        return _wrap(jnp.concatenate([self._a]
+                                     + [_unwrap(o) for o in others],
+                                     axis=axis))
+
+    def stack_with(self, axis, *others) -> "Tensor":
+        return _wrap(jnp.stack([self._a] + [_unwrap(o) for o in others],
+                               axis=axis))
+
+    def kron(self, other) -> "Tensor":
+        return _wrap(jnp.kron(self._a, _unwrap(other)))
+
+    def outer(self, other) -> "Tensor":
+        return _wrap(jnp.outer(self._a, _unwrap(other)))
+
+    def inner(self, other) -> "Tensor":
+        return _wrap(jnp.inner(self._a, _unwrap(other)))
+
+    def cross(self, other, axis: int = -1) -> "Tensor":
+        return _wrap(jnp.cross(self._a, _unwrap(other), axis=axis))
+
+    def mmuli(self, other) -> "Tensor":
+        self._a = self.mmul(other)._a
+        return self
+
+    # ---- gather / scatter tail ---------------------------------------------
+    def take(self, indices, axis=None) -> "Tensor":
+        return _wrap(jnp.take(self._a, jnp.asarray(_unwrap(indices)),
+                              axis=axis))
+
+    def take_along_dimension(self, indices, dim: int) -> "Tensor":
+        return _wrap(jnp.take_along_axis(
+            self._a, jnp.asarray(_unwrap(indices)), axis=dim))
+
+    def nonzero(self) -> "Tensor":
+        """Indices of nonzero elements, [n, ndim] (host sync — the result
+        shape is data-dependent)."""
+        return _wrap(jnp.stack(jnp.nonzero(self._a), axis=1))
+
+    def extract(self, mask) -> "Tensor":
+        """Elements where mask is true, flattened (host sync)."""
+        return _wrap(self._a[jnp.asarray(_unwrap(mask), bool)])
+
+    def scatter_add(self, idx, value) -> "Tensor":
+        if isinstance(idx, Tensor):
+            idx = idx._a
+        elif isinstance(idx, tuple):
+            idx = tuple(i._a if isinstance(i, Tensor) else i for i in idx)
+        return _wrap(self._a.at[idx].add(_unwrap(value)))
+
+    def one_hot(self, depth: int, dtype=None) -> "Tensor":
+        return _wrap(jax.nn.one_hot(
+            jnp.asarray(self._a, jnp.int32), depth,
+            dtype=_dt.resolve(dtype) if dtype else jnp.float32))
+
+    # ---- distances tail ----------------------------------------------------
+    def squared_distance(self, other) -> float:
+        return float(_jitted("squared_distance",
+                             lambda a, b: jnp.sum((a - b) ** 2))(
+            self._a, _unwrap(other)))
+
+    def hamming_distance(self, other) -> float:
+        return float(_jitted("hamming_distance",
+                             lambda a, b: jnp.sum(a != b))(
+            self._a, _unwrap(other)))
+
+    def jaccard_distance(self, other) -> float:
+        def _jac(a, b):
+            mn = jnp.sum(jnp.minimum(a, b))
+            mx = jnp.maximum(jnp.sum(jnp.maximum(a, b)), 1e-12)
+            return 1.0 - mn / mx
+        return float(_jitted("jaccard_distance", _jac)(self._a,
+                                                       _unwrap(other)))
+
+    # ---- broadcast-along-dimension family (nd4j Broadcast ops) -------------
+    def _broadcast_op(self, op, vec, dim: int):
+        v = jnp.asarray(_unwrap(vec))
+        shape = [1] * self._a.ndim
+        shape[dim] = self._a.shape[dim]
+        return _wrap(op(self._a, v.reshape(shape)))
+
+    def add_along_dimension(self, vec, dim: int) -> "Tensor":
+        return self._broadcast_op(jnp.add, vec, dim)
+
+    def sub_along_dimension(self, vec, dim: int) -> "Tensor":
+        return self._broadcast_op(jnp.subtract, vec, dim)
+
+    def mul_along_dimension(self, vec, dim: int) -> "Tensor":
+        return self._broadcast_op(jnp.multiply, vec, dim)
+
+    def div_along_dimension(self, vec, dim: int) -> "Tensor":
+        return self._broadcast_op(jnp.divide, vec, dim)
+
+
+class NDArrayIndex:
+    """nd4j ``NDArrayIndex`` spellings for :meth:`Tensor.get` /
+    ``put_indexed`` (reference ``nd4j …/indexing/NDArrayIndex.java``†,
+    mount empty, unverified): ``all()``, ``point(i)``,
+    ``interval(a, b[, step])``, ``indices(...)``, ``new_axis()``."""
+
+    @staticmethod
+    def all():
+        return slice(None)
+
+    @staticmethod
+    def point(i: int):
+        return int(i)
+
+    @staticmethod
+    def interval(start: int, end: int, step: int = 1):
+        return slice(int(start), int(end), int(step))
+
+    @staticmethod
+    def indices(*idx):
+        if len(idx) == 1 and isinstance(idx[0], (list, tuple, np.ndarray)):
+            idx = idx[0]
+        return np.asarray(idx, np.int32)
+
+    @staticmethod
+    def new_axis():
+        return None
+
+
+def _ndindex(indices):
+    out = []
+    for i in indices:
+        if isinstance(i, Tensor):
+            out.append(i._a)
+        else:
+            out.append(i)
+    return tuple(out)
+
+
+def _condition_mask(a, cond: str, value):
+    ops = {"eq": lambda: a == value, "neq": lambda: a != value,
+           "lt": lambda: a < value, "lte": lambda: a <= value,
+           "gt": lambda: a > value, "gte": lambda: a >= value}
+    if cond not in ops:
+        raise ValueError(f"unknown condition {cond!r}; "
+                         f"expected one of {sorted(ops)}")
+    return ops[cond]()
+
 
 def _freeze(x):
     if isinstance(x, (list, tuple)):

@@ -38,6 +38,75 @@ _PAGE = """<!DOCTYPE html>
 <div class="card"><h2>update : parameter ratio (log10)</h2>
 <canvas id="ratio"></canvas></div>
 <div class="card"><h2>iterations / sec</h2><canvas id="speed"></canvas></div>
+<div class="card"><h2>model graph</h2><canvas id="graph"
+ style="height:260px"></canvas></div>
+<div class="card"><h2>parameter / update histograms (latest)</h2>
+<div id="hists"></div></div>
+<script>
+function drawHist(canvas, h, color) {
+  const ctx = canvas.getContext('2d');
+  canvas.width = canvas.clientWidth; canvas.height = canvas.clientHeight;
+  ctx.clearRect(0,0,canvas.width,canvas.height);
+  if (!h) return;
+  const m = Math.max(...h.counts, 1), n = h.counts.length;
+  const bw = (canvas.width-40)/n;
+  ctx.fillStyle = color;
+  h.counts.forEach((c,i)=>{ const bh=(c/m)*(canvas.height-25);
+    ctx.fillRect(30+i*bw, canvas.height-15-bh, bw-1, bh); });
+  ctx.fillStyle='#333'; ctx.font='10px sans-serif';
+  ctx.fillText(h.edges[0].toPrecision(2), 28, canvas.height-3);
+  ctx.fillText(h.edges[h.edges.length-1].toPrecision(2),
+               canvas.width-45, canvas.height-3);
+}
+function drawGraph(id, g) {
+  const c = document.getElementById(id), ctx = c.getContext('2d');
+  c.width = c.clientWidth; c.height = c.clientHeight;
+  ctx.clearRect(0,0,c.width,c.height);
+  if (!g.nodes.length) return;
+  // layered layout: depth = longest path from an input
+  const depth = {}, parents = {};
+  g.edges.forEach(([a,b])=>{ (parents[b]=parents[b]||[]).push(a); });
+  const d = n => { if (depth[n]!==undefined) return depth[n];
+    depth[n] = parents[n] ? 1+Math.max(...parents[n].map(d)) : 0;
+    return depth[n]; };
+  g.nodes.forEach(n=>d(n.name));
+  const cols = {}, maxd = Math.max(...Object.values(depth));
+  g.nodes.forEach(n=>{ (cols[depth[n.name]]=cols[depth[n.name]]||[]).push(n); });
+  const pos = {};
+  Object.entries(cols).forEach(([dd,ns])=>{ ns.forEach((n,i)=>{
+    pos[n.name]=[30+(dd/(maxd||1))*(c.width-140),
+                 20+(i+0.5)*(c.height-40)/ns.length]; }); });
+  ctx.strokeStyle='#aac';
+  g.edges.forEach(([a,b])=>{ if(!pos[a]||!pos[b])return;
+    ctx.beginPath(); ctx.moveTo(pos[a][0]+45,pos[a][1]);
+    ctx.lineTo(pos[b][0],pos[b][1]); ctx.stroke(); });
+  ctx.font='9px sans-serif';
+  g.nodes.forEach(n=>{ const [x,y]=pos[n.name];
+    ctx.fillStyle = n.kind==='input' ? '#ded' : n.output ? '#fdd' : '#eef';
+    ctx.fillRect(x,y-8,90,16);
+    ctx.strokeStyle='#889'; ctx.strokeRect(x,y-8,90,16);
+    ctx.fillStyle='#223';
+    ctx.fillText(n.name.slice(0,14)+' ['+n.kind.slice(0,10)+']', x+2, y+3);});
+}
+function renderHists(hists) {
+  const div = document.getElementById('hists');
+  const names = Object.keys(hists);
+  // (re)build rows once per layer set
+  if (div.dataset.sig !== names.join(',')) {
+    div.dataset.sig = names.join(',');
+    div.innerHTML = names.map((n,i) =>
+      '<div style="display:flex;align-items:center;margin:2px 0">' +
+      '<span style="width:180px;font-size:.75em;color:#555">'+n+'</span>' +
+      '<canvas id="hp'+i+'" style="width:240px;height:60px"></canvas>' +
+      '<canvas id="hu'+i+'" style="width:240px;height:60px"></canvas>' +
+      '</div>').join('');
+  }
+  names.forEach((n,i)=>{ drawHist(document.getElementById('hp'+i),
+                                  hists[n].param, '#36c');
+                         drawHist(document.getElementById('hu'+i),
+                                  hists[n].update, '#c63'); });
+}
+</script>
 <script>
 function draw(id, series, logy) {
   const c = document.getElementById(id), ctx = c.getContext('2d');
@@ -74,9 +143,46 @@ async function tick() {
   draw('score', {score: d.score}, false);
   draw('ratio', d.ratios, true);
   draw('speed', {ips: d.speed}, false);
+  drawGraph('graph', d.graph);
+  renderHists(d.histograms);
 }
 tick(); setInterval(tick, 2000);
 </script></body></html>"""
+
+
+def _model_graph(configuration_json) -> dict:
+    """Topology payload for the dashboard's graph view: nodes (name, kind)
+    in topological/layer order + directed edges. Understands both engines'
+    config JSON; unknown/absent config yields an empty graph."""
+    if not configuration_json:
+        return {"nodes": [], "edges": []}
+    try:
+        conf = json.loads(configuration_json)
+    except (TypeError, ValueError):
+        return {"nodes": [], "edges": []}
+    nodes, edges = [], []
+    if conf.get("model_class") == "ComputationGraph":
+        for inp in conf.get("network_inputs", []):
+            nodes.append({"name": inp, "kind": "input"})
+        for vd in conf.get("vertices", []):
+            v = vd.get("vertex", {})
+            kind = v.get("kind", "?")
+            if kind == "layer":
+                kind = v.get("layer", {}).get("kind", "layer")
+            nodes.append({"name": vd["name"], "kind": kind,
+                          "output": vd["name"] in conf.get(
+                              "network_outputs", [])})
+            for parent in vd.get("inputs", []):
+                edges.append([parent, vd["name"]])
+    elif conf.get("model_class") == "MultiLayerNetwork":
+        prev = "input"
+        nodes.append({"name": "input", "kind": "input"})
+        for i, ld in enumerate(conf.get("layers", [])):
+            name = f"{i}:{ld.get('kind', '?')}"
+            nodes.append({"name": name, "kind": ld.get("kind", "?")})
+            edges.append([prev, name])
+            prev = name
+    return {"nodes": nodes, "edges": edges}
 
 
 class UIServer:
@@ -99,6 +205,22 @@ class UIServer:
         for r in stats:
             for path, v in r.get("ratios", {}).items():
                 ratios.setdefault(path, []).append([r["iteration"], v])
+        # latest collected histograms per layer path (param + update) —
+        # the reference dashboard's load-bearing debugging view
+        histograms: dict = {}
+        for r in reversed(stats):
+            if any("hist_counts" in s for s in r.get("params", {}).values()):
+                for path, s in r.get("params", {}).items():
+                    if "hist_counts" in s:
+                        histograms.setdefault(path, {})["param"] = {
+                            "counts": s["hist_counts"],
+                            "edges": s["hist_edges"]}
+                for path, s in r.get("updates", {}).items():
+                    if "hist_counts" in s:
+                        histograms.setdefault(path, {})["update"] = {
+                            "counts": s["hist_counts"],
+                            "edges": s["hist_edges"]}
+                break
         return {
             "num_records": len(stats),
             "model_class": meta.get("model_class"),
@@ -107,6 +229,8 @@ class UIServer:
             "ratios": ratios,
             "speed": [[r["iteration"], r["iterations_per_sec"]]
                       for r in stats if r.get("iterations_per_sec")],
+            "histograms": histograms,
+            "graph": _model_graph(meta.get("configuration")),
         }
 
     # -- server ---------------------------------------------------------------

@@ -31,3 +31,11 @@ import pytest  # noqa: E402
 @pytest.fixture
 def rng():
     return np.random.default_rng(1234)
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: heavy tests (imports of real TF/BERT graphs, zoo builds, "
+        "multihost, ring-attention grads) — excluded from the fast suite "
+        "via -m 'not slow'")

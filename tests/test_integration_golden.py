@@ -9,6 +9,8 @@ import os
 
 import pytest
 
+pytestmark = pytest.mark.slow
+
 from golden_harness import FIXTURE, compare, run_reference_training
 
 

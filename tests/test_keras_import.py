@@ -12,6 +12,8 @@ import json
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow
+
 tf = pytest.importorskip("tensorflow")
 
 from deeplearning4j_tpu.modelimport import KerasModelImport  # noqa: E402

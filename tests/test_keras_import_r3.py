@@ -4,6 +4,8 @@ against live tf.keras (KerasModelEndToEndTest contract, SURVEY.md §3.5)."""
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow
+
 tf = pytest.importorskip("tensorflow")
 
 from deeplearning4j_tpu.modelimport import KerasModelImport  # noqa: E402

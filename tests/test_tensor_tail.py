@@ -1,0 +1,259 @@
+"""INDArray method tail (round 3, VERDICT item 10): numpy oracles for the
+~100 added Tensor methods — structure probes, NDArrayIndex get/put, TADs,
+elementwise/reduction tails, conditions, combining, broadcast-along-dim."""
+import numpy as np
+import pytest
+
+jnp = pytest.importorskip("jax.numpy")
+import jax
+
+import deeplearning4j_tpu.tensor as T
+from deeplearning4j_tpu.tensor import NDArrayIndex as I
+from deeplearning4j_tpu.tensor import Tensor
+
+
+@pytest.fixture
+def a():
+    return np.random.default_rng(0).normal(size=(3, 4)).astype(np.float32)
+
+
+def test_structure_probes(a):
+    t = Tensor(a)
+    assert t.rank() == 2 and t.rows() == 3 and t.columns() == 4
+    assert t.is_matrix() and not t.is_vector() and not t.is_scalar()
+    assert not t.is_square()
+    assert Tensor(np.zeros((2, 2))).is_square()
+    assert Tensor(np.zeros(3)).is_vector()
+    assert Tensor(np.zeros((1, 5))).is_row_vector()
+    assert Tensor(np.zeros((5, 1))).is_column_vector()
+    assert Tensor(np.float32(2.0)).is_scalar()
+    assert Tensor(np.zeros((0,))).is_empty()
+
+
+def test_scalar_getters_and_converters(a):
+    t = Tensor(a)
+    assert t.get_double(1, 2) == pytest.approx(float(a[1, 2]))
+    assert t.get_int(0, 0) == int(a[0, 0])
+    np.testing.assert_allclose(t.to_double_vector(), a.reshape(-1).astype(np.float64))
+    np.testing.assert_allclose(t.to_float_matrix(), a)
+    assert t.to_int_matrix().dtype == np.int32
+    t2 = Tensor(a.copy()).put_scalar((0, 0), 9.0)
+    assert t2.get_double(0, 0) == 9.0
+
+
+def test_ndarray_index_get_put(a):
+    t = Tensor(a)
+    got = t.get(I.all(), I.interval(1, 3))
+    np.testing.assert_allclose(np.asarray(got), a[:, 1:3])
+    got2 = t.get(I.point(1), I.indices(0, 3))
+    np.testing.assert_allclose(np.asarray(got2), a[1, [0, 3]])
+    put = t.put_indexed((I.interval(0, 2), I.point(0)), 5.0)
+    ref = a.copy()
+    ref[0:2, 0] = 5.0
+    np.testing.assert_allclose(np.asarray(put), ref)
+    np.testing.assert_allclose(np.asarray(t), a)  # original untouched
+
+
+def test_tads_and_slices():
+    x = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+    t = Tensor(x)
+    np.testing.assert_allclose(np.asarray(t.slice_at(1)), x[1])
+    np.testing.assert_allclose(np.asarray(t.slice_at(2, dim=2)), x[:, :, 2])
+    assert t.num_slices(1) == 3
+    # TADs over dim 2: enumerate (2,3) leading combos C-order
+    assert t.num_tensors_along_dimension(2) == 6
+    np.testing.assert_allclose(
+        np.asarray(t.tensor_along_dimension(4, 2)),
+        x.reshape(6, 4)[4])
+    # TAD spanning two dims
+    np.testing.assert_allclose(
+        np.asarray(t.tensor_along_dimension(1, 1, 2)), x[1])
+    np.testing.assert_allclose(np.asarray(t.sub_array((0, 1, 1), (2, 2, 2))),
+                               x[0:2, 1:3, 1:3])
+
+
+def test_diag_tri_rot_flip(a):
+    t = Tensor(a)
+    np.testing.assert_allclose(np.asarray(t.diag()), np.diag(a))
+    np.testing.assert_allclose(np.asarray(t.tril()), np.tril(a))
+    np.testing.assert_allclose(np.asarray(t.triu(1)), np.triu(a, 1))
+    np.testing.assert_allclose(np.asarray(t.rot90()), np.rot90(a))
+    np.testing.assert_allclose(np.asarray(t.reverse()), a[::-1, ::-1])
+    np.testing.assert_allclose(np.asarray(t.flip(0)), a[::-1])
+    np.testing.assert_allclose(np.asarray(t.roll(1, axis=1)),
+                               np.roll(a, 1, axis=1))
+    np.testing.assert_allclose(np.asarray(t.pad(((1, 0), (0, 2)), 7.0)),
+                               np.pad(a, ((1, 0), (0, 2)),
+                                      constant_values=7.0))
+    parts = t.split(2, axis=1)
+    assert len(parts) == 2
+    np.testing.assert_allclose(np.asarray(parts[1]), a[:, 2:])
+    sq = Tensor(np.arange(9.0).reshape(3, 3))
+    assert sq.trace() == pytest.approx(0 + 4 + 8)
+
+
+def test_elementwise_tail(a):
+    t = Tensor(np.abs(a) * 0.5 + 0.1)
+    np.testing.assert_allclose(np.asarray(t.asinh()),
+                               np.arcsinh(np.asarray(t)), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(Tensor(a).atan2(Tensor(np.abs(a)))),
+                               np.arctan2(a, np.abs(a)), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(Tensor(a).rint()), np.rint(a))
+    np.testing.assert_allclose(np.asarray(Tensor(a).trunc()), np.trunc(a))
+    np.testing.assert_allclose(np.asarray(t.rsqrt()),
+                               1 / np.sqrt(np.asarray(t)), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(Tensor(a).cbrt()), np.cbrt(a),
+                               rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(t.log2()), np.log2(np.asarray(t)),
+                               rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(Tensor(a).mod(2.0)),
+                               np.mod(a, 2.0), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(Tensor(a).floor_div(2.0)),
+                               np.floor_divide(a, 2.0))
+    # in-place rebinds
+    t2 = Tensor(a.copy())
+    t2.negi()
+    np.testing.assert_allclose(np.asarray(t2), -a)
+    t3 = Tensor(a.copy()).rsubi(1.0)
+    np.testing.assert_allclose(np.asarray(t3), 1.0 - a, rtol=1e-6)
+    t4 = Tensor(np.abs(a) + 0.5).powi(2.0)
+    np.testing.assert_allclose(np.asarray(t4), (np.abs(a) + 0.5) ** 2,
+                               rtol=1e-5)
+
+
+def test_activation_sugar(a):
+    t = Tensor(a)
+    np.testing.assert_allclose(np.asarray(t.elu()),
+                               np.where(a > 0, a, np.expm1(a)), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(t.softplus()),
+                               np.log1p(np.exp(a)), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(t.softsign()),
+                               a / (1 + np.abs(a)), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(t.leaky_relu(0.1)),
+                               np.where(a >= 0, a, 0.1 * a), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(t.step()),
+                               (a > 0).astype(np.float32))
+    for m in ("swish", "gelu", "mish", "hard_tanh", "hard_sigmoid",
+              "relu6", "log_sigmoid"):
+        assert np.all(np.isfinite(np.asarray(getattr(t, m)())))
+
+
+def test_reduction_tail(a):
+    t = Tensor(a)
+    assert t.median() == pytest.approx(float(np.median(a)))
+    np.testing.assert_allclose(np.asarray(t.median(axis=0)),
+                               np.median(a, axis=0), rtol=1e-6)
+    assert t.percentile(75) == pytest.approx(
+        float(np.percentile(a, 75)), rel=1e-5)
+    np.testing.assert_allclose(np.asarray(t.cumprod(axis=1)),
+                               np.cumprod(a, axis=1), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(t.cummax(axis=0)),
+                               np.maximum.accumulate(a, axis=0))
+    np.testing.assert_allclose(np.asarray(t.cummin(axis=1)),
+                               np.minimum.accumulate(a, axis=1))
+    x = a.copy()
+    x[0, 0] = np.nan
+    assert Tensor(x).nansum() == pytest.approx(float(np.nansum(x)), rel=1e-5)
+    assert Tensor(x).nanmean() == pytest.approx(float(np.nanmean(x)),
+                                                rel=1e-5)
+    from scipy.special import logsumexp as _lse  # scipy in env? guard
+    assert t.logsumexp() == pytest.approx(float(_lse(a)), rel=1e-5)
+    p = np.abs(a).reshape(-1)
+    p /= p.sum()
+    assert Tensor(p).shannon_entropy() == pytest.approx(
+        float(-(p * np.log2(p)).sum()), rel=1e-4)
+
+
+def test_conditions(a):
+    t = Tensor(a)
+    assert t.match_condition_count("gt", 0.0) == int((a > 0).sum())
+    np.testing.assert_array_equal(np.asarray(t.match_condition("lte", 0.0)),
+                                  a <= 0)
+    np.testing.assert_allclose(
+        np.asarray(t.replace_where_condition("lt", 0.0, 0.0)),
+        np.where(a < 0, 0.0, a))
+    with pytest.raises(ValueError, match="condition"):
+        t.match_condition("bogus", 0)
+    assert t.equals(Tensor(a.copy()))
+    assert not t.equals(Tensor(a + 1))
+    assert t.equals_with_eps(Tensor(a + 1e-7), eps=1e-5)
+    assert t.all_close(Tensor(a + 1e-9))
+
+
+def test_combining(a):
+    t = Tensor(a)
+    b = np.random.default_rng(1).normal(size=(3, 4)).astype(np.float32)
+    np.testing.assert_allclose(np.asarray(t.hstack(Tensor(b))),
+                               np.hstack([a, b]))
+    np.testing.assert_allclose(np.asarray(t.vstack(Tensor(b))),
+                               np.vstack([a, b]))
+    np.testing.assert_allclose(np.asarray(t.concat_with(1, Tensor(b))),
+                               np.concatenate([a, b], axis=1))
+    np.testing.assert_allclose(np.asarray(t.stack_with(0, Tensor(b))),
+                               np.stack([a, b]))
+    v1, v2 = a[0], b[1]
+    np.testing.assert_allclose(np.asarray(Tensor(v1).outer(Tensor(v2))),
+                               np.outer(v1, v2), rtol=1e-6)
+    assert float(np.asarray(Tensor(v1).inner(Tensor(v2)))) == pytest.approx(
+        float(np.inner(v1, v2)), rel=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(Tensor(v1[:3]).cross(Tensor(v2[:3]))),
+        np.cross(v1[:3], v2[:3]), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(Tensor(a[:2, :2]).kron(
+        Tensor(b[:2, :2]))), np.kron(a[:2, :2], b[:2, :2]), rtol=1e-5)
+    m = Tensor(a.copy())
+    m.mmuli(Tensor(b.T))
+    np.testing.assert_allclose(np.asarray(m), a @ b.T, rtol=1e-4)
+
+
+def test_gather_scatter_tail(a):
+    t = Tensor(a)
+    np.testing.assert_allclose(np.asarray(t.take([2, 0], axis=0)),
+                               a[[2, 0]])
+    idx = np.argsort(a, axis=1)
+    np.testing.assert_allclose(
+        np.asarray(t.take_along_dimension(idx, 1)),
+        np.take_along_axis(a, idx, axis=1))
+    x = np.array([[0.0, 1.0], [2.0, 0.0]], np.float32)
+    nz = np.asarray(Tensor(x).nonzero())
+    np.testing.assert_array_equal(nz, np.stack(np.nonzero(x), axis=1))
+    np.testing.assert_allclose(np.asarray(Tensor(x).extract(x > 0)),
+                               x[x > 0])
+    s = Tensor(np.zeros(4, np.float32)).scatter_add(
+        np.array([1, 1, 3]), np.ones(3, np.float32))
+    np.testing.assert_allclose(np.asarray(s), [0, 2, 0, 1])
+    oh = Tensor(np.array([0, 2])).one_hot(3)
+    np.testing.assert_allclose(np.asarray(oh), [[1, 0, 0], [0, 0, 1]])
+
+
+def test_distances_tail(a):
+    b = a + 1.0
+    assert Tensor(a).squared_distance(Tensor(b)) == pytest.approx(
+        float(((a - b) ** 2).sum()), rel=1e-5)
+    x = np.array([1, 0, 1, 1], np.float32)
+    y = np.array([1, 1, 0, 1], np.float32)
+    assert Tensor(x).hamming_distance(Tensor(y)) == 2.0
+    jac = 1 - np.minimum(x, y).sum() / np.maximum(x, y).sum()
+    assert Tensor(x).jaccard_distance(Tensor(y)) == pytest.approx(jac,
+                                                                  rel=1e-5)
+
+
+def test_broadcast_along_dimension(a):
+    t = Tensor(a)
+    v0 = np.arange(3, dtype=np.float32)
+    v1 = np.arange(4, dtype=np.float32)
+    np.testing.assert_allclose(np.asarray(t.add_along_dimension(v0, 0)),
+                               a + v0[:, None])
+    np.testing.assert_allclose(np.asarray(t.sub_along_dimension(v1, 1)),
+                               a - v1[None, :])
+    np.testing.assert_allclose(np.asarray(t.mul_along_dimension(v0, 0)),
+                               a * v0[:, None])
+    np.testing.assert_allclose(np.asarray(t.div_along_dimension(v1 + 1, 1)),
+                               a / (v1 + 1)[None, :], rtol=1e-6)
+
+
+def test_method_count_floor():
+    """The INDArray facade keeps growing: >= 230 public methods (round-2
+    verdict target; round 2 had 128)."""
+    n = len([m for m in dir(Tensor) if not m.startswith("_")])
+    assert n >= 230, f"Tensor public methods regressed: {n}"

@@ -5,6 +5,8 @@ recorded inputs/outputs compared numerically)."""
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow
+
 from deeplearning4j_tpu.modelimport.onnx import OnnxFrameworkImporter
 from deeplearning4j_tpu.modelimport.tensorflow import TensorflowFrameworkImporter
 

@@ -5,6 +5,8 @@ the reference's zoo tests instantiate each model and run a fit batch)."""
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow
+
 from deeplearning4j_tpu.data.dataset import DataSet
 from deeplearning4j_tpu.models import (alexnet, darknet19, simple_cnn,
                                        squeezenet, text_generation_lstm,
