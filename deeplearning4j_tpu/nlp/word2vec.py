@@ -65,6 +65,50 @@ class _Vocab:
         return len(self.words)
 
 
+def _huffman_tree(counts):
+    """word2vec-c Huffman coding: per-word (code bits, inner-node points),
+    padded arrays + mask + the inner-node count. Inner node ids are
+    heap-order minus V (so syn1 holds V-1 inner vectors)."""
+    import heapq
+    V = len(counts)
+    if V == 1:
+        return (np.zeros((1, 1), np.float32), np.zeros((1, 1), np.int32),
+                np.ones((1, 1), np.float32), 1)
+    heap = [(int(c), i) for i, c in enumerate(counts)]
+    heapq.heapify(heap)
+    parent: dict = {}
+    bit: dict = {}
+    nxt = V
+    while len(heap) > 1:
+        c1, a = heapq.heappop(heap)
+        c2, b = heapq.heappop(heap)
+        parent[a], parent[b] = nxt, nxt
+        bit[a], bit[b] = 0, 1
+        heapq.heappush(heap, (c1 + c2, nxt))
+        nxt += 1
+    root = nxt - 1
+    codes, points = [], []
+    for w in range(V):
+        c, p = [], []
+        n = w
+        while n != root:
+            c.append(bit[n])
+            p.append(parent[n] - V)
+            n = parent[n]
+        codes.append(c[::-1])
+        points.append(p[::-1])
+    L = max(len(c) for c in codes)
+    code_a = np.zeros((V, L), np.float32)
+    point_a = np.zeros((V, L), np.int32)
+    mask_a = np.zeros((V, L), np.float32)
+    for w in range(V):
+        k = len(codes[w])
+        code_a[w, :k] = codes[w]
+        point_a[w, :k] = points[w]
+        mask_a[w, :k] = 1.0
+    return code_a, point_a, mask_a, nxt - V
+
+
 class SequenceVectors:
     """Skip-gram negative-sampling over generic element sequences
     (reference ``SequenceVectors``): Word2Vec specializes it with a
@@ -74,7 +118,8 @@ class SequenceVectors:
                  min_count: int = 5, negative: int = 5,
                  subsample: float = 1e-3, epochs: int = 1,
                  learning_rate: float = 0.025, min_learning_rate: float = 1e-4,
-                 batch_size: int = 2048, seed: int = 123):
+                 batch_size: int = 2048, seed: int = 123,
+                 use_hierarchic_softmax: bool = False):
         self.layer_size = layer_size
         self.window = window
         self.min_count = min_count
@@ -85,6 +130,10 @@ class SequenceVectors:
         self.min_learning_rate = min_learning_rate
         self.batch_size = batch_size
         self.seed = seed
+        #: DL4J useHierarchicSoftmax: Huffman-tree output layer instead of
+        #: negative sampling (reference supports both; the SGNS path stays
+        #: the default, as in modern word2vec practice)
+        self.use_hierarchic_softmax = use_hierarchic_softmax
         self.vocab: Optional[_Vocab] = None
         self.syn0: Optional[np.ndarray] = None   # input embeddings
         self.syn1: Optional[np.ndarray] = None   # output embeddings
@@ -100,7 +149,17 @@ class SequenceVectors:
         if V == 0:
             raise ValueError(f"empty vocabulary (min_count={self.min_count})")
         self.syn0 = ((rng.random((V, D)) - 0.5) / D).astype(np.float32)
-        self.syn1 = np.zeros((V, D), dtype=np.float32)
+        if self.use_hierarchic_softmax:
+            hs_code, hs_point, hs_mask, n_inner = _huffman_tree(
+                self.vocab.counts)
+            # small random init (word2vec-c zeros syn1; with zero inner
+            # vectors the input-embedding gradient is exactly zero until
+            # syn1 drifts, a needlessly slow bootstrap on small corpora —
+            # recorded divergence)
+            self.syn1 = ((rng.random((n_inner, D)) - 0.5) / D).astype(
+                np.float32)
+        else:
+            self.syn1 = np.zeros((V, D), dtype=np.float32)
 
         counts = np.asarray(self.vocab.counts, dtype=np.float64)
         # unigram^0.75 negative table (as probabilities, not the reference's
@@ -117,6 +176,22 @@ class SequenceVectors:
         ids_stream = [np.asarray([self.vocab.word2idx[t] for t in toks
                                   if t in self.vocab.word2idx], dtype=np.int32)
                       for toks in sequences]
+
+        @jax.jit
+        def hs_step(syn0, syn1, center, points, codes, pmask, lr):
+            # center [B]; points/codes/pmask [B, L]: one sigmoid per Huffman
+            # inner node on the path; label = 1 - code (word2vec-c)
+            def loss_fn(s0, s1):
+                v = s0[center]                       # [B, D]
+                u = s1[points]                       # [B, L, D]
+                logits = jnp.einsum("bd,bld->bl", v, u)
+                lbl = 1.0 - codes
+                l = jnp.maximum(logits, 0) - logits * lbl + \
+                    jnp.log1p(jnp.exp(-jnp.abs(logits)))
+                return (l * pmask).sum() / center.shape[0]
+
+            g0, g1 = jax.grad(loss_fn, argnums=(0, 1))(syn0, syn1)
+            return syn0 - lr * g0, syn1 - lr * g1
 
         @jax.jit
         def step(syn0, syn1, center, context, labels, lr):
@@ -160,8 +235,14 @@ class SequenceVectors:
                 frac = min(1.0, n_steps / total_steps)
                 lr = max(self.min_learning_rate,
                          self.learning_rate * (1.0 - frac))
-                syn0, syn1 = step(syn0, syn1, c, ctx, labels,
-                                  np.float32(lr))
+                if self.use_hierarchic_softmax:
+                    tgt = ctx[:, 0]
+                    syn0, syn1 = hs_step(syn0, syn1, c, hs_point[tgt],
+                                         hs_code[tgt], hs_mask[tgt],
+                                         np.float32(lr))
+                else:
+                    syn0, syn1 = step(syn0, syn1, c, ctx, labels,
+                                      np.float32(lr))
                 n_steps += 1
 
         def draw_negatives(center, context) -> List[int]:
@@ -196,7 +277,10 @@ class SequenceVectors:
                             continue
                         c, ctx = int(kept[pos]), int(kept[j])
                         centers.append(c)
-                        contexts.append([ctx] + draw_negatives(c, ctx))
+                        if self.use_hierarchic_softmax:
+                            contexts.append([ctx])
+                        else:
+                            contexts.append([ctx] + draw_negatives(c, ctx))
                 flush()
         flush(force=True)
         self.syn0 = np.asarray(syn0)

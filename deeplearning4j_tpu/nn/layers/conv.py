@@ -177,13 +177,28 @@ class BatchNormalization(Layer):
             # error harmless at BN's operating magnitudes.
             from ... import dtypes as _dt
             xs = _dt.upcast_16(x)
-            n_red = 1
-            for i in reduce_axes:
-                n_red *= x.shape[i]
-            s1 = jnp.sum(xs, axis=reduce_axes)
-            s2 = jnp.sum(jnp.square(xs), axis=reduce_axes)
-            mean = s1 / n_red
-            var = jnp.maximum(s2 / n_red - jnp.square(mean), 0.0)
+            if mask is not None:
+                # mask-aware moments: padded examples (ParallelWrapper
+                # ragged-tail pad) and masked timesteps must not perturb
+                # batch statistics. mask is [B] or [B,T] over the leading
+                # dims; broadcast it across the remaining axes.
+                m = jnp.asarray(mask, xs.dtype)
+                while m.ndim < xs.ndim:
+                    m = m[..., None]
+                cnt = jnp.maximum(jnp.sum(
+                    jnp.broadcast_to(m, xs.shape), axis=reduce_axes), 1.0)
+                s1 = jnp.sum(xs * m, axis=reduce_axes)
+                s2 = jnp.sum(jnp.square(xs) * m, axis=reduce_axes)
+                mean = s1 / cnt
+                var = jnp.maximum(s2 / cnt - jnp.square(mean), 0.0)
+            else:
+                n_red = 1
+                for i in reduce_axes:
+                    n_red *= x.shape[i]
+                s1 = jnp.sum(xs, axis=reduce_axes)
+                s2 = jnp.sum(jnp.square(xs), axis=reduce_axes)
+                mean = s1 / n_red
+                var = jnp.maximum(s2 / n_red - jnp.square(mean), 0.0)
             d = self.decay
             new_state = {"mean": (d * state["mean"]
                                   + (1 - d) * mean).astype(state["mean"].dtype),

@@ -49,9 +49,11 @@ class ParallelWrapper:
     Batches whose size is not divisible by the mesh size are padded to the
     next multiple and the padded examples are masked out of the loss (DL4J's
     prefetch splitter silently constrained batch%workers; pad-and-mask keeps
-    every example contributing exactly once). Caveat recorded: in train mode
-    BatchNorm batch statistics see the zero-padded rows of the tail batch —
-    a bounded, tail-only artifact; the loss and gradients exclude them.
+    every example contributing exactly once). A pad feature mask is
+    synthesized alongside the loss mask, so train-mode BatchNorm computes
+    mask-aware batch moments — padded rows perturb neither the loss nor
+    the running statistics (the round-2 recorded artifact, now fixed;
+    equivalence to the unpadded single-chip step is tested).
     """
 
     def __init__(self, model, mesh: Optional[Mesh] = None):
@@ -182,6 +184,16 @@ def _pad_and_mask(x, y, fm, lm, pad):
     x, y = zpad(x), zpad(y)
     if fm is not None:
         fm = zpad(fm)  # padded rows have all-zero feature mask
+    else:
+        # synthesize a pad feature mask so mask-aware layers (train-mode
+        # BatchNorm moments) exclude the padded rows: per-timestep [B,T]
+        # for sequence inputs, per-example [B] otherwise
+        if x.ndim == 3:
+            fm = np.ones(x.shape[:2], np.float32)
+            fm[-pad:] = 0.0
+        else:
+            fm = np.ones((x.shape[0],), np.float32)
+            fm[-pad:] = 0.0
     if lm is not None:
         lm = zpad(lm)  # padded rows masked (zeros)
     else:
@@ -202,7 +214,19 @@ def _pad_and_mask_multi(fs, ls, fms, lms, pad):
 
     fs = [zpad(a) for a in fs]
     ls = [zpad(a) for a in ls]
-    fms = [None if m is None else zpad(m) for m in fms]
+    new_fms = []
+    for x, m in zip(fs, fms):
+        if m is not None:
+            new_fms.append(zpad(m))
+        elif x.ndim == 3:
+            fm = np.ones(x.shape[:2], np.float32)
+            fm[-pad:] = 0.0
+            new_fms.append(fm)
+        else:
+            fm = np.ones((x.shape[0],), np.float32)
+            fm[-pad:] = 0.0
+            new_fms.append(fm)
+    fms = new_fms
     out_lms = []
     for y, m in zip(ls, lms):
         if m is not None:

@@ -147,3 +147,51 @@ def test_dp_params_replicated_after_step():
     ParallelWrapper(net).fit(DataSet(x, y), epochs=1)
     w = net.params["0"]["W"]
     assert w.sharding.is_fully_replicated
+
+
+def test_ragged_tail_bn_stats_match_unpadded_step():
+    """Pad-and-mask DP step == plain single-chip step on the unpadded batch:
+    params AND BatchNorm running stats identical (the round-2 recorded
+    BN-padding artifact is gone)."""
+    from deeplearning4j_tpu.nn.config import (InputType,
+                                              NeuralNetConfiguration)
+    from deeplearning4j_tpu.nn.layers.conv import (BatchNormalization,
+                                                   ConvolutionLayer)
+    from deeplearning4j_tpu.nn.layers.core import OutputLayer
+    from deeplearning4j_tpu.nn.model import MultiLayerNetwork
+    from deeplearning4j_tpu.nn.updaters import Sgd
+    from deeplearning4j_tpu.parallel.data_parallel import ParallelWrapper
+    from deeplearning4j_tpu.data.dataset import DataSet
+
+    def build():
+        conf = (NeuralNetConfiguration.builder().seed(3)
+                .updater(Sgd(learning_rate=0.1))
+                .input_type(InputType.convolutional(3, 8, 8,
+                                                    data_format="NHWC"))
+                .list(ConvolutionLayer(n_out=4, kernel=(3, 3), mode="same",
+                                       data_format="NHWC"),
+                      BatchNormalization(data_format="NHWC"),
+                      OutputLayer(n_out=2)).build())
+        return MultiLayerNetwork(conf).init()
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(5, 8, 8, 3)).astype(np.float32)  # 5 % 8 != 0
+    y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 5)]
+
+    dp = build()
+    ParallelWrapper(dp).fit(DataSet(x, y))
+
+    ref = build()
+    ref.fit(DataSet(x, y))
+
+    np.testing.assert_allclose(np.asarray(dp.state["1"]["mean"]),
+                               np.asarray(ref.state["1"]["mean"]),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(dp.state["1"]["var"]),
+                               np.asarray(ref.state["1"]["var"]),
+                               rtol=1e-5, atol=1e-6)
+    for k in ref.params:
+        for p in ref.params[k]:
+            np.testing.assert_allclose(np.asarray(dp.params[k][p]),
+                                       np.asarray(ref.params[k][p]),
+                                       rtol=1e-4, atol=1e-5)

@@ -69,6 +69,7 @@ def test_lstm_forward_matches_torch():
     lambda: SimpleRnn(n_out=3),
     lambda: Bidirectional(layer=LSTM(n_out=3), mode="concat"),
 ])
+@pytest.mark.slow  # ~5 min across the param grid (f64 FD on CPU)
 def test_rnn_layer_gradients_match_fd(layer_fn):
     layer = layer_fn()
     params, _, _ = _init_layer(layer, (4, 2))

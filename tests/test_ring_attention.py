@@ -7,6 +7,10 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+# differentiating/running shard_map programs on the 8-device CPU mesh costs
+# 30-80s of compile per case; the multichip dryrun covers the basic path
+pytestmark = pytest.mark.slow
+
 from deeplearning4j_tpu.parallel.sequence import (make_sp_mesh,
                                                   ring_attention,
                                                   sequence_sharded)

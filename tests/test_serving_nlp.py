@@ -180,3 +180,39 @@ def test_parallel_inference_rejects_bad_shape_in_caller():
                                    atol=1e-6)
     finally:
         pi.shutdown()
+
+
+def test_word2vec_hierarchical_softmax_learns():
+    """useHierarchicSoftmax path (DL4J parity): Huffman-tree output layer —
+    co-occurring words end up closer than non-co-occurring ones, same
+    contract as the SGNS test."""
+    from deeplearning4j_tpu.nlp.word2vec import Word2Vec
+
+    corpus = (["red green blue red green blue red green"] * 30
+              + ["cat dog mouse cat dog mouse cat dog"] * 30)
+    w2v = Word2Vec(layer_size=16, window=2, min_count=1, epochs=20,
+                   seed=7, batch_size=256, subsample=0.0, learning_rate=0.1,
+                   use_hierarchic_softmax=True)
+    w2v.fit(corpus)
+    assert w2v.syn1.shape[0] == len(w2v.vocab) - 1  # V-1 inner nodes
+    same = w2v.similarity("red", "green")
+    cross = w2v.similarity("red", "dog")
+    assert same > cross, (same, cross)
+
+
+def test_huffman_tree_codes_are_prefix_free():
+    from deeplearning4j_tpu.nlp.word2vec import _huffman_tree
+    counts = [50, 30, 10, 5, 3, 2]
+    code, point, mask, n_inner = _huffman_tree(counts)
+    assert n_inner == len(counts) - 1
+    paths = []
+    for w in range(len(counts)):
+        bits = tuple(int(b) for b, m in zip(code[w], mask[w]) if m)
+        paths.append(bits)
+    # prefix-free: no code is a prefix of another
+    for i, a in enumerate(paths):
+        for j, b in enumerate(paths):
+            if i != j:
+                assert a != b[:len(a)]
+    # frequent words get shorter codes
+    assert mask[0].sum() <= mask[-1].sum()
