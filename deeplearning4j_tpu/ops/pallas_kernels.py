@@ -13,6 +13,15 @@ practical cell; ``fits_vmem`` guards the dispatch and callers fall back to
 layers call this under ``jax.checkpoint``-free inference/streaming paths;
 training keeps the lax cell (custom VJP for the kernel is not worth the
 maintenance while XLA's fused backward is this close).
+
+NEGATIVE RESULT (round 3, recorded so it is not retried): a fused
+1x1-conv backward kernel (dX + dW from one pass over dY, f32 VMEM
+accumulator across a row-tiled grid) was numerically correct but ~50%
+SLOWER than XLA's derived backward on the real v5e chip (ResNet-50 step
+54 -> 80 ms), and even rerouting the 1x1 forward from lax.conv to a dot
+(no Pallas) cost ~20% — XLA's conv fusions carry layout/epilogue
+decisions a naive contraction loses. Don't fight the conv pipeline with
+hand kernels here; the remaining bwd HBM traffic is structural.
 """
 
 from __future__ import annotations
