@@ -452,7 +452,7 @@ def _apply_seq_step(st: dict, schema: Schema, sequences):
             sequences = out
     elif op == "seq_trim":
         n = st["n"]
-        if sequences is not None:
+        if sequences is not None and n > 0:
             sequences = [seq[n:] if st["from_start"] else seq[:-n]
                          for seq in sequences]
             sequences = [s for s in sequences if s]

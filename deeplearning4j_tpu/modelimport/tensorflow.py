@@ -39,6 +39,14 @@ class _Ctx:
         self.prefix = prefix                      # graph-name prefix (fn bodies)
 
     def get(self, ref: str) -> SDVariable:
+        parts = ref.split(":")
+        if len(parts) == 3:
+            # FunctionDef-body ref 'node:out_arg_name:k' — k indexes WITHIN
+            # the named output arg, so the flat slot needs the producing
+            # op's output-arg table (bind_outputs registers these keys)
+            named = f"{parts[0]}:{parts[1]}:{parts[2]}"
+            if named in self.vars:
+                return self.vars[named]
         name, idx = _split_ref(ref)
         if idx and f"{name}:{idx}" in self.vars:
             return self.vars[f"{name}:{idx}"]
@@ -67,12 +75,29 @@ class _Ctx:
     def set_const(self, node_name: str, value) -> None:
         self.consts[self.local_key(node_name)] = value
 
-    def bind_outputs(self, node_name: str, vs) -> SDVariable:
-        """Register the extra output slots of a multi-output node."""
+    def bind_outputs(self, node_name: str, vs,
+                     op_type: Optional[str] = None) -> SDVariable:
+        """Register the extra output slots of a multi-output node. With
+        ``op_type``, also register FunctionDef-style named-arg keys
+        (``node:out_arg:k``) from the TF op registry — refs inside If/While
+        bodies use that spelling, and resolving only the trailing integer
+        would alias every arg's slot 0."""
         key = self.local_key(node_name)
         for k, v in enumerate(vs):
             if k:
                 self.vars[f"{key}:{k}"] = v
+        if op_type is not None:
+            try:
+                from tensorflow.python.framework import (  # type: ignore
+                    op_def_registry)
+                op_def = op_def_registry.get(op_type)
+            except Exception:
+                op_def = None
+            if op_def is not None and len(op_def.output_arg) == len(vs):
+                # one tensor per output arg (true for TopKV2/Split-style
+                # ops we map; number_attr/list outputs would need widths)
+                for arg, v in zip(op_def.output_arg, vs):
+                    self.vars[f"{key}:{arg.name}:0"] = v
         return vs[0]
 
 
@@ -148,7 +173,7 @@ _BINARY = {"Add": "math.add", "AddV2": "math.add",
            "Div": "math.div", "FloorDiv": "math.floordiv",
            "Maximum": "math.maximum", "Minimum": "math.minimum",
            "Pow": "math.pow", "SquaredDifference": "math.squared_difference",
-           "FloorMod": "math.fmod", "Atan2": "math.atan2",
+           "FloorMod": "math.mod", "Atan2": "math.atan2",
            "Greater": "math.greater", "GreaterEqual": "math.greater_equal",
            "Less": "math.less", "LessEqual": "math.less_equal",
            "Equal": "math.equal", "NotEqual": "math.not_equal",
@@ -165,7 +190,7 @@ def _map_unary(node, ctx, ins):
 _NP_BINARY = {"Add": np.add, "AddV2": np.add, "Sub": np.subtract,
               "Mul": np.multiply, "RealDiv": np.divide, "Div": np.divide,
               "FloorDiv": np.floor_divide, "Maximum": np.maximum,
-              "Minimum": np.minimum, "FloorMod": np.fmod}
+              "Minimum": np.minimum, "FloorMod": np.mod}
 
 
 def _map_binary(node, ctx, ins):
@@ -427,7 +452,7 @@ def _split(node, ctx, ins):
     vs = ctx.sd.call_multi("shape.split", ctx.get(ins[1]), n_outputs=num,
                            name=node.name,
                            attrs={"indices_or_sections": num, "axis": axis})
-    return ctx.bind_outputs(node.name, vs)
+    return ctx.bind_outputs(node.name, vs, op_type=node.op)
 
 
 @tf_op("SplitV")
@@ -441,7 +466,7 @@ def _split_v(node, ctx, ins):
                            n_outputs=len(sizes), name=node.name,
                            attrs={"indices_or_sections": [int(c) for c in cuts],
                                   "axis": axis})
-    return ctx.bind_outputs(node.name, vs)
+    return ctx.bind_outputs(node.name, vs, op_type=node.op)
 
 
 @tf_op("Unpack")
@@ -450,7 +475,7 @@ def _unpack(node, ctx, ins):
     axis = int(_attr(node, "axis", 0))
     vs = ctx.sd.call_multi("shape.unstack", ctx.get(ins[0]), n_outputs=num,
                            name=node.name, attrs={"axis": axis})
-    return ctx.bind_outputs(node.name, vs)
+    return ctx.bind_outputs(node.name, vs, op_type=node.op)
 
 
 @tf_op("TopKV2")
@@ -458,7 +483,7 @@ def _topk(node, ctx, ins):
     k = int(np.asarray(ctx.const_value(ins[1])))
     vs = ctx.sd.call_multi("sort.top_k", ctx.get(ins[0]), n_outputs=2,
                            name=node.name, attrs={"k": k})
-    return ctx.bind_outputs(node.name, vs)
+    return ctx.bind_outputs(node.name, vs, op_type=node.op)
 
 
 def _import_function(ctx, fn_name: str, formals, sd):
@@ -493,7 +518,7 @@ def _if(node, ctx, ins):
 
     vs = ctx.sd.cond(ctx.get(ins[0]), mk(then_fn), mk(else_fn), *operands,
                      name=node.name)
-    return ctx.bind_outputs(node.name, vs)
+    return ctx.bind_outputs(node.name, vs, op_type=node.op)
 
 
 @tf_op("StatelessWhile", "While")
@@ -510,7 +535,7 @@ def _while(node, ctx, ins):
 
     vs = ctx.sd.while_loop(mk(cond_fn), mk(body_fn), *loop_vars,
                            name=node.name)
-    return ctx.bind_outputs(node.name, vs)
+    return ctx.bind_outputs(node.name, vs, op_type=node.op)
 
 
 @tf_op("StopGradient", "Identity", "PreventGradient", "CheckNumerics")

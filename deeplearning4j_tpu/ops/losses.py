@@ -360,7 +360,11 @@ def ctc(labels, predictions, mask=None, weights=None, blank=0):
     nll = -jnp.logaddexp(a_last, a_prev)                    # [B]
     if weights is not None:
         nll = nll * jnp.asarray(weights)
-    return jnp.mean(nll)
+    # average over examples with at least one valid timestep: a fully
+    # masked row (ParallelWrapper pad) must not leak its garbage NLL into
+    # the batch mean — same contract as _per_example for the other losses
+    return _per_example(nll, (input_len > 0).astype(nll.dtype)
+                        if mask is not None else None)
 
 
 def get(name_or_fn):

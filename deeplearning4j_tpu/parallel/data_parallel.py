@@ -170,6 +170,15 @@ class ParallelWrapper:
                 yield (x, y, fm, lm)
 
 
+def _synth_pad_feature_mask(x, pad):
+    """Pad feature mask so mask-aware layers (train-mode BatchNorm moments)
+    exclude the padded rows: per-timestep [B,T] for sequence inputs,
+    per-example [B] otherwise. ``x`` is already zero-padded by ``pad``."""
+    fm = np.ones(x.shape[:2] if x.ndim == 3 else (x.shape[0],), np.float32)
+    fm[-pad:] = 0.0
+    return fm
+
+
 def _pad_and_mask(x, y, fm, lm, pad):
     """Zero-pad `pad` examples onto the batch and mask them out of the loss.
 
@@ -185,15 +194,7 @@ def _pad_and_mask(x, y, fm, lm, pad):
     if fm is not None:
         fm = zpad(fm)  # padded rows have all-zero feature mask
     else:
-        # synthesize a pad feature mask so mask-aware layers (train-mode
-        # BatchNorm moments) exclude the padded rows: per-timestep [B,T]
-        # for sequence inputs, per-example [B] otherwise
-        if x.ndim == 3:
-            fm = np.ones(x.shape[:2], np.float32)
-            fm[-pad:] = 0.0
-        else:
-            fm = np.ones((x.shape[0],), np.float32)
-            fm[-pad:] = 0.0
+        fm = _synth_pad_feature_mask(x, pad)
     if lm is not None:
         lm = zpad(lm)  # padded rows masked (zeros)
     else:
@@ -214,19 +215,8 @@ def _pad_and_mask_multi(fs, ls, fms, lms, pad):
 
     fs = [zpad(a) for a in fs]
     ls = [zpad(a) for a in ls]
-    new_fms = []
-    for x, m in zip(fs, fms):
-        if m is not None:
-            new_fms.append(zpad(m))
-        elif x.ndim == 3:
-            fm = np.ones(x.shape[:2], np.float32)
-            fm[-pad:] = 0.0
-            new_fms.append(fm)
-        else:
-            fm = np.ones((x.shape[0],), np.float32)
-            fm[-pad:] = 0.0
-            new_fms.append(fm)
-    fms = new_fms
+    fms = [zpad(m) if m is not None else _synth_pad_feature_mask(x, pad)
+           for x, m in zip(fs, fms)]
     out_lms = []
     for y, m in zip(ls, lms):
         if m is not None:

@@ -100,3 +100,27 @@ def test_rnn_loss_layer_ctc_trains():
     net.fit(DataSet(x, labels), epochs=30)
     assert float(net.score()) < first
     assert np.isfinite(float(net.score()))
+
+
+def test_ctc_ignores_fully_masked_pad_rows():
+    """A zero-padded example with an all-zero input mask (ParallelWrapper
+    ragged tail) must not change the loss or its gradient."""
+    rng = np.random.default_rng(4)
+    B, T, C = 2, 6, 5
+    logits = rng.normal(size=(B, T, C)).astype(np.float32)
+    labels = np.array([[1, 2], [3, -1]], np.int32)
+    mask = np.ones((B, T), np.float32)
+    base = float(L.ctc(jnp.asarray(labels), jnp.asarray(logits),
+                       mask=jnp.asarray(mask)))
+
+    logits_p = np.concatenate([logits, np.zeros((1, T, C), np.float32)])
+    labels_p = np.concatenate([labels, np.zeros((1, 2), np.int32)])
+    mask_p = np.concatenate([mask, np.zeros((1, T), np.float32)])
+    padded = float(L.ctc(jnp.asarray(labels_p), jnp.asarray(logits_p),
+                         mask=jnp.asarray(mask_p)))
+    assert padded == pytest.approx(base, rel=1e-6)
+
+    g = jax.grad(lambda lo: L.ctc(jnp.asarray(labels_p), lo,
+                                  mask=jnp.asarray(mask_p)))(
+        jnp.asarray(logits_p))
+    assert float(jnp.max(jnp.abs(g[-1]))) == 0.0  # pad row: zero gradient

@@ -150,3 +150,21 @@ def test_cond_graph_serde_roundtrip(tmp_path):
     ref = np.asarray(frozen(x=tf.constant(x)))
     np.testing.assert_allclose(a, ref.reshape(a.shape), rtol=1e-6)
     np.testing.assert_allclose(a, b, rtol=1e-6)
+
+
+def test_cond_branch_with_multi_output_op():
+    """Multi-output op INSIDE a branch FunctionDef: 'node:indices:0'-style
+    refs must resolve to the right slot, not alias slot 0."""
+    @tf.function
+    def f(x):
+        def t():
+            vals, idx = tf.math.top_k(x, k=2)
+            return tf.cast(idx, tf.float32) + vals * 0.0
+        def e():
+            return -x[:, :2]
+        return tf.cond(tf.reduce_sum(x) > 0.0, t, e)
+
+    spec = [tf.TensorSpec([2, 4], tf.float32, name="x")]
+    x = np.array([[0.1, 3.0, 2.0, -1.0], [5.0, 0.0, 1.0, 4.0]], np.float32)
+    ref, got = _roundtrip(f, {"x": x}, spec)
+    np.testing.assert_allclose(got, ref, rtol=1e-6)  # indices, not values
