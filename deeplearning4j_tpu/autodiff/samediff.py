@@ -207,6 +207,7 @@ class SameDiff:
         self.iteration = 0
         self.epoch = 0
         self._score = float("nan")
+        self.train_config: Dict[str, Any] = {}
 
     # listener-facing Model protocol (Score/Collect/Checkpoint listeners)
     def score(self) -> float:
@@ -548,6 +549,31 @@ class SameDiff:
         self.updater = updater
         return self
 
+    def set_training_config(self, updater=None, l1: float = 0.0,
+                            l2: float = 0.0,
+                            gradient_clip_value: Optional[float] = None,
+                            gradient_clip_l2: Optional[float] = None,
+                            gradient_normalization: Optional[str] = None,
+                            gradient_normalization_threshold: float = 1.0
+                            ) -> "SameDiff":
+        """nd4j ``TrainingConfig`` parity: updater + l1/l2 regularization
+        over VARIABLEs + gradient clipping/normalization, all applied inside
+        the compiled fit step. GradientNormalization 'per layer' means per
+        VARIABLE here (SameDiff has no layer grouping — recorded)."""
+        from ..nn import gradnorm as _gn
+        _gn.validate(gradient_normalization)
+        if updater is not None:
+            self.updater = updater
+        self.train_config = {
+            "l1": float(l1), "l2": float(l2),
+            "clip_value": gradient_clip_value,
+            "clip_l2": gradient_clip_l2,
+            "grad_norm": gradient_normalization,
+            "grad_norm_threshold": float(gradient_normalization_threshold),
+        }
+        self._fn_cache.pop("__fit_step__", None)
+        return self
+
     def grad(self, feeds: Dict[str, Any],
              wrt: Optional[Sequence[str]] = None) -> Dict[str, np.ndarray]:
         """Gradients of the loss w.r.t. VARIABLEs (createGradFunction +
@@ -583,11 +609,34 @@ class SameDiff:
         train_names = [n for n, v in self._vars.items() if v.kind == VARIABLE]
         updater = self.updater
 
+        tc = dict(self.train_config)
+
         def step(train_vals, opt_state, other_vals, step_i, feeds):
             def loss_fn(tv):
                 env = self._compute({**other_vals, **tv}, feeds)
-                return env[loss_name]
+                total = env[loss_name]
+                if tc.get("l1"):
+                    total = total + tc["l1"] * sum(
+                        jnp.sum(jnp.abs(v)) for v in tv.values())
+                if tc.get("l2"):
+                    total = total + 0.5 * tc["l2"] * sum(
+                        jnp.sum(jnp.square(v)) for v in tv.values())
+                return total
             loss, grads = jax.value_and_grad(loss_fn)(train_vals)
+            if tc.get("grad_norm"):
+                from ..nn import gradnorm as _gn
+                # per-VARIABLE grouping: wrap each leaf as its own "layer"
+                grads = {k: v["g"] for k, v in _gn.apply(
+                    tc["grad_norm"], tc["grad_norm_threshold"],
+                    {k: {"g": g} for k, g in grads.items()}).items()}
+            if tc.get("clip_value"):
+                cv = tc["clip_value"]
+                grads = jax.tree.map(lambda g: jnp.clip(g, -cv, cv), grads)
+            if tc.get("clip_l2"):
+                norm = jnp.sqrt(sum(jnp.sum(jnp.square(g))
+                                    for g in jax.tree.leaves(grads)))
+                scale = jnp.minimum(1.0, tc["clip_l2"] / (norm + 1e-12))
+                grads = jax.tree.map(lambda g: g * scale, grads)
             delta, new_opt = updater.apply(grads, opt_state, train_vals, step_i)
             new_vals = jax.tree.map(lambda p, d: p - d, train_vals, delta)
             return new_vals, new_opt, loss
@@ -601,6 +650,7 @@ class SameDiff:
         import json as _json
         spec = ("fit", loss_name,
                 _json.dumps(updater.to_dict(), sort_keys=True, default=str),
+                _json.dumps(self.train_config, sort_keys=True, default=str),
                 tuple(train_names))
         cached = self._fn_cache.get("__fit_step__")
         if cached is not None and cached[0] == spec:
@@ -645,6 +695,22 @@ class SameDiff:
         # updated weights flow through; only graph mutation (call()) clears
         return history
 
+    def evaluate(self, data_iter, output_name: str,
+                 evaluation=None):
+        """nd4j ``SameDiff.evaluate`` equivalent: run ``output_name`` over
+        an iterable of ``(feeds_dict, labels_array)`` pairs and accumulate
+        a classification Evaluation (one-hot or index labels)."""
+        from ..eval.evaluation import Evaluation
+        ev = evaluation or Evaluation()
+        for feeds, labels in data_iter:
+            out = self.output(feeds, [output_name])[output_name]
+            labels = np.asarray(labels)
+            if labels.ndim == out.ndim - 1:  # index labels -> one-hot
+                labels = np.eye(out.shape[-1],
+                                dtype=np.float32)[labels.astype(int)]
+            ev.eval(labels, out)
+        return ev
+
     # ------------------------------------------------------------ accessors
     def get_value(self, name: str) -> np.ndarray:
         return np.asarray(self._values[name])
@@ -669,6 +735,7 @@ class SameDiff:
             "ops": [_op_to_dict(r) for r in self._ops],
             "loss": self.loss_name,
             "updater": self.updater.to_dict() if self.updater else None,
+            "training_config": self.train_config or None,
         }, indent=2)
 
     @staticmethod
@@ -686,6 +753,7 @@ class SameDiff:
         sd.loss_name = d.get("loss")
         if d.get("updater"):
             sd.updater = _upd.Updater.from_dict(d["updater"])
+        sd.train_config = d.get("training_config") or {}
         return sd
 
     def save(self, path: str) -> None:

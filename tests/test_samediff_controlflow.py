@@ -154,3 +154,57 @@ def test_fit_returns_history_and_drives_listeners(tmp_path, rng):
     # a checkpoint reloads and carries the TRAINED weights of its epoch
     sd2 = SameDiff.load(str(sorted(saved)[-1]))
     assert not np.allclose(sd2.get_value("w"), 0.0)
+
+
+def test_training_config_regularization_and_clipping(rng):
+    """TrainingConfig parity: l2 + ClipL2PerParamType on the SameDiff fit
+    path match a hand-built oracle step exactly."""
+    import jax
+    from deeplearning4j_tpu.nn import gradnorm
+
+    def build():
+        sd = SameDiff.create()
+        x = sd.placeholder("x", (None, 3))
+        t = sd.placeholder("t", (None, 2))
+        sd.var("w", np.full((3, 2), 0.5, np.float32))
+        pred = x.mmul(sd._vars["w"])
+        sd.set_loss(((pred - t) ** 2.0).mean())
+        return sd
+
+    xv = rng.normal(size=(16, 3)).astype(np.float32)
+    tv = rng.normal(size=(16, 2)).astype(np.float32)
+
+    sd = build()
+    sd.set_training_config(updater=Sgd(learning_rate=0.1), l2=0.01,
+                           gradient_normalization="ClipL2PerParamType",
+                           gradient_normalization_threshold=0.05)
+    sd.fit({"x": xv, "t": tv}, epochs=1)
+
+    # oracle
+    ref = build()
+    w0 = jnp.asarray(np.full((3, 2), 0.5, np.float32))
+
+    def loss(w):
+        pred = jnp.asarray(xv) @ w
+        return jnp.mean((pred - jnp.asarray(tv)) ** 2) \
+            + 0.5 * 0.01 * jnp.sum(jnp.square(w))
+    g = jax.grad(loss)(w0)
+    g = gradnorm.apply("ClipL2PerParamType", 0.05, {"w": {"g": g}})["w"]["g"]
+    expected = w0 - 0.1 * g
+    np.testing.assert_allclose(sd.get_value("w"), np.asarray(expected),
+                               rtol=1e-5, atol=1e-6)
+    # serde round-trips the config
+    sd2 = SameDiff.from_json(sd.to_json())
+    assert sd2.train_config["l2"] == pytest.approx(0.01)
+    assert sd2.train_config["grad_norm"] == "ClipL2PerParamType"
+
+
+def test_samediff_evaluate(rng):
+    sd = SameDiff.create()
+    x = sd.placeholder("x", (None, 2))
+    w = sd.var("w", np.asarray([[3.0, -3.0], [-3.0, 3.0]], np.float32))
+    out = sd.softmax(x.mmul(w), name="probs")
+    xv = np.asarray([[1, 0], [0, 1], [1, 0]], np.float32)
+    labels = np.array([0, 1, 0])
+    ev = sd.evaluate([({"x": xv}, labels)], "probs")
+    assert ev.accuracy() == pytest.approx(1.0)
