@@ -264,6 +264,167 @@ def _squeeze(node, ctx, at):
                        name=node.output[0], attrs=attrs)
 
 
+@onnx_op("Shape")
+def _shape(node, ctx, at):
+    """Static fold when the producer's shape is known (placeholders with
+    full shapes, initializers); else a runtime shape_of (const-consuming
+    downstream nodes will raise the usual named error)."""
+    name = node.input[0]
+    var = ctx.get(name)
+    if name in ctx.consts:
+        val = np.asarray(np.asarray(ctx.consts[name]).shape, np.int64)
+        ctx.consts[node.output[0]] = val
+        ctx.vars[node.output[0]] = ctx.sd.constant(node.output[0], val)
+        return ctx.vars[node.output[0]]
+    if var.shape is not None and all(s is not None for s in var.shape):
+        val = np.asarray(var.shape, np.int64)
+        ctx.consts[node.output[0]] = val
+        ctx.vars[node.output[0]] = ctx.sd.constant(node.output[0], val)
+        return ctx.vars[node.output[0]]
+    return ctx.sd.call("shape.shape_of", var, name=node.output[0])
+
+
+@onnx_op("Gather")
+def _gather(node, ctx, at):
+    axis = int(at.get("axis", 0))
+    if node.input[0] in ctx.consts and node.input[1] in ctx.consts:
+        ctx.consts[node.output[0]] = np.take(
+            np.asarray(ctx.consts[node.input[0]]),
+            np.asarray(ctx.consts[node.input[1]]).astype(np.int64),
+            axis=axis)
+    return ctx.sd.call("shape.gather", ctx.get(node.input[0]),
+                       ctx.get(node.input[1]), name=node.output[0],
+                       attrs={"axis": axis})
+
+
+@onnx_op("Cast")
+def _cast(node, ctx, at):
+    np_dt = _DTYPES.get(int(at.get("to", 1)))
+    if np_dt is None:
+        raise ValueError(f"Cast to unsupported ONNX dtype {at.get('to')}")
+    if node.input[0] in ctx.consts:
+        ctx.consts[node.output[0]] = np.asarray(
+            ctx.consts[node.input[0]]).astype(np_dt)
+    return ctx.sd.call("math.cast", ctx.get(node.input[0]),
+                       name=node.output[0],
+                       attrs={"dtype": np.dtype(np_dt).name})
+
+
+@onnx_op("Slice")
+def _slice(node, ctx, at):
+    """Opset-10+ form (starts/ends/axes/steps as const inputs) and the
+    opset-1 attribute form."""
+    if len(node.input) > 1:
+        starts = np.asarray(ctx.consts[node.input[1]]).tolist()
+        ends = np.asarray(ctx.consts[node.input[2]]).tolist()
+        axes = np.asarray(ctx.consts[node.input[3]]).tolist() \
+            if len(node.input) > 3 and node.input[3] else \
+            list(range(len(starts)))
+        steps = np.asarray(ctx.consts[node.input[4]]).tolist() \
+            if len(node.input) > 4 and node.input[4] else [1] * len(starts)
+    else:
+        starts = at["starts"]
+        ends = at["ends"]
+        axes = at.get("axes", list(range(len(starts))))
+        steps = [1] * len(starts)
+    by_axis = {int(a): (int(s), int(e), int(st))
+               for a, s, e, st in zip(axes, starts, ends, steps)}
+    max_axis = max(by_axis) if by_axis else -1
+    INT_MAX = 2 ** 31 - 1
+    spec = []
+    for ax in range(max_axis + 1):
+        if ax in by_axis:
+            s, e, st = by_axis[ax]
+            # ONNX clamps out-of-range ends; huge sentinels -> None
+            spec.append(["slice", None if abs(s) >= INT_MAX else s,
+                         None if abs(e) >= INT_MAX else e, st])
+        else:
+            spec.append(["slice", None, None, 1])
+    if node.input[0] in ctx.consts:
+        idx = tuple(slice(e[1], e[2], e[3]) for e in spec)
+        ctx.consts[node.output[0]] = np.asarray(
+            ctx.consts[node.input[0]])[idx]
+    return ctx.sd.call("shape.strided_slice_v2", ctx.get(node.input[0]),
+                       name=node.output[0], attrs={"spec": spec})
+
+
+@onnx_op("Expand")
+def _expand(node, ctx, at):
+    shape = [int(s) for s in
+             np.asarray(ctx.consts[node.input[1]]).tolist()]
+    return ctx.sd.call("shape.broadcast_to", ctx.get(node.input[0]),
+                       name=node.output[0], attrs={"shape": shape})
+
+
+@onnx_op("Where")
+def _where(node, ctx, at):
+    return ctx.sd.call("math.where", ctx.get(node.input[0]),
+                       ctx.get(node.input[1]), ctx.get(node.input[2]),
+                       name=node.output[0])
+
+
+@onnx_op("ConstantOfShape")
+def _const_of_shape(node, ctx, at):
+    shape = [int(s) for s in
+             np.asarray(ctx.consts[node.input[0]]).tolist()]
+    value = at.get("value")
+    fill = np.asarray(value).reshape(-1)[0] if value is not None else \
+        np.float32(0.0)
+    arr = np.full(shape, fill)
+    ctx.consts[node.output[0]] = arr
+    ctx.vars[node.output[0]] = ctx.sd.constant(node.output[0], arr)
+    return ctx.vars[node.output[0]]
+
+
+@onnx_op("Split")
+def _split_onnx(node, ctx, at):
+    axis = int(at.get("axis", 0))
+    sizes = at.get("split")
+    if sizes is None and len(node.input) > 1 and node.input[1]:
+        sizes = np.asarray(ctx.consts[node.input[1]]).tolist()
+    x = ctx.get(node.input[0])
+    n_out = len(node.output)
+    if sizes:
+        cuts = np.cumsum([int(s) for s in sizes])[:-1].tolist()
+        attrs = {"indices_or_sections": [int(c) for c in cuts],
+                 "axis": axis}
+    else:
+        attrs = {"indices_or_sections": n_out, "axis": axis}
+    vs = ctx.sd.call_multi("shape.split", x, n_outputs=n_out,
+                           name=list(node.output), attrs=attrs)
+    for out_name, v in zip(node.output, vs):
+        ctx.vars[out_name] = v
+    return vs[0]
+
+
+@onnx_op("Tile")
+def _tile_onnx(node, ctx, at):
+    reps = [int(r) for r in np.asarray(ctx.consts[node.input[1]]).tolist()]
+    return ctx.sd.call("shape.tile", ctx.get(node.input[0]),
+                       name=node.output[0], attrs={"reps": reps})
+
+
+@onnx_op("Pad")
+def _pad_onnx(node, ctx, at):
+    mode = at.get("mode", "constant")
+    if mode not in ("constant", b"constant"):
+        raise ValueError(f"Pad mode {mode!r} not supported")
+    if len(node.input) > 1:
+        pads = np.asarray(ctx.consts[node.input[1]]).tolist()
+        value = float(np.asarray(
+            ctx.consts[node.input[2]]).reshape(-1)[0]) \
+            if len(node.input) > 2 and node.input[2] else 0.0
+    else:
+        pads = at["pads"]
+        value = float(at.get("value", 0.0))
+    n = len(pads) // 2
+    widths = [(int(pads[i]), int(pads[i + n])) for i in range(n)]
+    return ctx.sd.call("shape.pad", ctx.get(node.input[0]),
+                       name=node.output[0],
+                       attrs={"pad_width": widths,
+                              "constant_values": value})
+
+
 def _rnn_optional(ctx, node, idx):
     """Optional ONNX input: returns the tensor name or None for ''/absent."""
     if len(node.input) > idx and node.input[idx]:

@@ -152,3 +152,101 @@ def test_onnx_lstm_hidden_state_consumable():
         _model("LSTM", W, R, Bb, T, I, H, "forward"))
     out = sd.output({"x": x.numpy()}, ["Y_h"])["Y_h"]  # [1, B, H]
     np.testing.assert_allclose(out[0], ref, rtol=1e-4, atol=1e-5)
+
+
+def _node(g, op, inputs, outputs, attrs=()):
+    n = g.node.add()
+    n.op_type = op
+    n.input.extend(inputs)
+    n.output.extend(outputs)
+    n.attribute.extend(attrs)
+    return n
+
+
+def test_onnx_shape_gather_slice_cast_chain():
+    """The torch-export staples: Shape -> Gather -> arithmetic feeding
+    Reshape, plus Slice/Cast/Expand/Where/ConstantOfShape/Split/Tile/Pad —
+    composite graph vs numpy."""
+    rng = np.random.default_rng(3)
+    x = rng.normal(size=(2, 3, 4)).astype(np.float32)
+
+    m = P.ModelProto()
+    g = m.graph
+    g.input.append(_io("x", [2, 3, 4]))
+    g.initializer.extend([
+        _tensor("idx0", np.asarray([0], np.float32)),  # placeholder unused
+    ])
+    # shape -> gather(0) -> cast float -> where(>1, x2, x3) style chain
+    _node(g, "Shape", ["x"], ["s"])                       # [2,3,4]
+    gat = _node(g, "Gather", ["s", "gidx"], ["d0"],
+                [_attr_int("axis", 0)])
+    gidx = P.TensorProto()
+    gidx.name = "gidx"
+    gidx.dims.extend([])
+    gidx.data_type = 7  # int64
+    gidx.raw_data = np.asarray(2, np.int64).tobytes()
+    g.initializer.append(gidx)
+    # slice x[:, 1:, ::2]
+    for nm, vals in (("st", [1, 0]), ("en", [2**31 - 1, 2**31 - 1]),
+                     ("ax", [1, 2]), ("sp", [1, 2])):
+        t = P.TensorProto()
+        t.name = nm
+        t.dims.extend([2])
+        t.data_type = 7
+        t.raw_data = np.asarray(vals, np.int64).tobytes()
+        g.initializer.append(t)
+    _node(g, "Slice", ["x", "st", "en", "ax", "sp"], ["sl"])  # [2,2,2]
+    _node(g, "Cast", ["sl"], ["slf"], [_attr_int("to", 1)])
+    # split into two along axis 1
+    _node(g, "Split", ["slf"], ["sp0", "sp1"], [_attr_int("axis", 1)])
+    _node(g, "Add", ["sp0", "sp1"], ["added"])                # [2,1,2]
+    # tile + pad
+    tt = P.TensorProto()
+    tt.name = "reps"
+    tt.dims.extend([3])
+    tt.data_type = 7
+    tt.raw_data = np.asarray([1, 2, 1], np.int64).tobytes()
+    g.initializer.append(tt)
+    _node(g, "Tile", ["added", "reps"], ["tiled"])            # [2,2,2]
+    _node(g, "Relu", ["tiled"], ["y"])
+    g.output.append(_io("y", []))
+    sd = OnnxFrameworkImporter.import_model_proto(m.SerializeToString())
+
+    ref_sl = x[:, 1:, ::2]
+    ref = np.maximum(np.tile(ref_sl[:, :1] + ref_sl[:, 1:], (1, 2, 1)), 0)
+    out = sd.output({"x": x}, ["y"])["y"]
+    np.testing.assert_allclose(out, ref, rtol=1e-6)
+
+
+def test_onnx_where_constantofshape_expand():
+    m = P.ModelProto()
+    g = m.graph
+    g.input.append(_io("x", [2, 3]))
+    shp = P.TensorProto()
+    shp.name = "shp"
+    shp.dims.extend([2])
+    shp.data_type = 7
+    shp.raw_data = np.asarray([2, 3], np.int64).tobytes()
+    g.initializer.append(shp)
+    val = P.AttributeProto()
+    val.name = "value"
+    val.type = 4
+    val.t.dims.extend([1])
+    val.t.data_type = 1
+    val.t.raw_data = np.asarray([0.5], np.float32).tobytes()
+    _node(g, "ConstantOfShape", ["shp"], ["half"], [val])
+    _node(g, "Greater", ["x", "half"], ["m0"])
+    ones = P.TensorProto()
+    ones.name = "one"
+    ones.dims.extend([1])
+    ones.data_type = 1
+    ones.raw_data = np.asarray([1.0], np.float32).tobytes()
+    g.initializer.append(ones)
+    _node(g, "Expand", ["one", "shp"], ["ones2d"])
+    _node(g, "Where", ["m0", "ones2d", "x"], ["y"])
+    g.output.append(_io("y", []))
+    sd = OnnxFrameworkImporter.import_model_proto(m.SerializeToString())
+    x = np.asarray([[0.2, 0.8, 0.5], [1.2, -0.1, 0.6]], np.float32)
+    ref = np.where(x > 0.5, np.ones_like(x), x)
+    out = sd.output({"x": x}, ["y"])["y"]
+    np.testing.assert_allclose(out, ref, rtol=1e-6)
