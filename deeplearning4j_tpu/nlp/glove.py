@@ -1,0 +1,113 @@
+"""GloVe: global-vector embeddings from co-occurrence statistics.
+
+TPU-native equivalent of the reference's GloVe implementation (reference:
+``deeplearning4j-nlp-parent .../models/glove/Glove.java``† per SURVEY.md
+§2.5; reference mount was empty, citation upstream-relative, unverified).
+
+Same architecture split as word2vec.py: co-occurrence accumulation is
+host-side (a dict over the corpus — the reference shuffles a co-occurrence
+file), and training is a BATCHED jitted AdaGrad step over co-occurrence
+entries: one fused gather → dot → weighted-square-error → scatter program
+per batch (Pennington et al. 2014 objective, f(x) = min(1, (x/xmax)^alpha)).
+Word vectors are w + w_tilde (the standard sum of the two matrices).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Optional, Tuple
+
+import numpy as np
+
+from .word2vec import SequenceVectors, TokenizerFactory, _Vocab
+
+
+class Glove(SequenceVectors):
+    """DL4J ``Glove`` builder spellings where they exist; query surface
+    (similarity / words_nearest) inherited from SequenceVectors."""
+
+    def __init__(self, layer_size: int = 100, window: int = 5,
+                 min_count: int = 5, xmax: float = 100.0,
+                 alpha: float = 0.75, learning_rate: float = 0.05,
+                 epochs: int = 5, batch_size: int = 4096, seed: int = 123,
+                 tokenizer: Optional[TokenizerFactory] = None):
+        super().__init__(layer_size=layer_size, window=window,
+                         min_count=min_count, epochs=epochs,
+                         learning_rate=learning_rate,
+                         batch_size=batch_size, seed=seed)
+        self.xmax = xmax
+        self.alpha = alpha
+        self.tokenizer = tokenizer or TokenizerFactory()
+
+    def fit(self, sentences: Iterable[str]) -> "Glove":
+        return self.fit_sequences(
+            [self.tokenizer.tokenize(s) for s in sentences])
+
+    def fit_sequences(self, sequences) -> "Glove":
+        import jax
+        import jax.numpy as jnp
+
+        rng = np.random.default_rng(self.seed)
+        self.vocab = _Vocab.build(sequences, self.min_count)
+        V, D = len(self.vocab), self.layer_size
+        if V == 0:
+            raise ValueError(f"empty vocabulary (min_count={self.min_count})")
+
+        # co-occurrence with 1/distance weighting, symmetric window
+        cooc: Dict[Tuple[int, int], float] = {}
+        for toks in sequences:
+            ids = [self.vocab.word2idx[t] for t in toks
+                   if t in self.vocab.word2idx]
+            for pos, wi in enumerate(ids):
+                for off in range(1, self.window + 1):
+                    j = pos + off
+                    if j >= len(ids):
+                        break
+                    inc = 1.0 / off
+                    cooc[(wi, ids[j])] = cooc.get((wi, ids[j]), 0.0) + inc
+                    cooc[(ids[j], wi)] = cooc.get((ids[j], wi), 0.0) + inc
+        if not cooc:
+            raise ValueError("no co-occurrences (corpus too small)")
+
+        entries = np.asarray([(i, j, x) for (i, j), x in cooc.items()],
+                             np.float64)
+        ii = entries[:, 0].astype(np.int32)
+        jj = entries[:, 1].astype(np.int32)
+        logx = np.log(entries[:, 2]).astype(np.float32)
+        fx = np.minimum(1.0, (entries[:, 2] / self.xmax) ** self.alpha
+                        ).astype(np.float32)
+
+        w = ((rng.random((V, D)) - 0.5) / D).astype(np.float32)
+        wt = ((rng.random((V, D)) - 0.5) / D).astype(np.float32)
+        b = np.zeros((V,), np.float32)
+        bt = np.zeros((V,), np.float32)
+        # AdaGrad accumulators (the reference/original trains with AdaGrad)
+        state = tuple(jnp.ones_like(jnp.asarray(a))
+                      for a in (w, wt, b, bt))
+        params = tuple(jnp.asarray(a) for a in (w, wt, b, bt))
+        lr = np.float32(self.learning_rate)
+
+        @jax.jit
+        def step(params, state, i_b, j_b, logx_b, fx_b):
+            def loss_fn(ps):
+                w, wt, b, bt = ps
+                diff = (jnp.sum(w[i_b] * wt[j_b], axis=1)
+                        + b[i_b] + bt[j_b] - logx_b)
+                return jnp.sum(fx_b * diff * diff)
+            grads = jax.grad(loss_fn)(params)
+            new_state = tuple(s + g * g for s, g in zip(state, grads))
+            new_params = tuple(p - lr * g / jnp.sqrt(s)
+                               for p, g, s in zip(params, grads, new_state))
+            return new_params, new_state
+
+        n = ii.shape[0]
+        bs = min(self.batch_size, n)
+        for _ in range(self.epochs):
+            order = rng.permutation(n)
+            for k in range(0, n - bs + 1, bs):
+                sel = order[k:k + bs]
+                params, state = step(params, state, ii[sel], jj[sel],
+                                     logx[sel], fx[sel])
+        w, wt, b, bt = (np.asarray(p) for p in params)
+        self.syn0 = w + wt          # standard GloVe: sum both matrices
+        self.syn1 = wt
+        return self

@@ -329,6 +329,91 @@ class Word2Vec(SequenceVectors):
             [self.tokenizer.tokenize(s) for s in sentences])
 
 
+class ParagraphVectors(Word2Vec):
+    """Doc2vec, PV-DBOW form: a document vector predicts the words of its
+    document through the shared SGNS output matrix (reference
+    ``deeplearning4j-nlp .../models/paragraphvectors/ParagraphVectors.java``†
+    per SURVEY.md §2.5; mount empty, unverified. DL4J defaults to PV-DM;
+    DBOW is its ``sequenceLearningAlgorithm(DBOW)`` variant — recorded
+    choice: DBOW reuses the batched SGNS step unchanged, which is the
+    TPU-friendly shape).
+
+    ``fit_labelled([(label, text), ...])`` trains word vectors first
+    (skip-gram), then document vectors against the frozen word output
+    matrix. ``infer_vector(text)`` trains a fresh doc vector the same way.
+    """
+
+    def __init__(self, infer_epochs: int = 20, **kw):
+        super().__init__(**kw)
+        self.infer_epochs = infer_epochs
+        self.doc_labels: List[str] = []
+        self.doc_vectors: Optional[np.ndarray] = None
+
+    def fit_labelled(self, docs: Sequence[Tuple[str, str]]
+                     ) -> "ParagraphVectors":
+        texts = [self.tokenizer.tokenize(t) for _, t in docs]
+        self.fit_sequences(texts)          # word vectors + syn1
+        self.doc_labels = [l for l, _ in docs]
+        self.doc_vectors = np.stack([self._train_doc_vector(toks)
+                                     for toks in texts])
+        return self
+
+    def _train_doc_vector(self, tokens: List[str]) -> np.ndarray:
+        """SGNS with the doc vector as the (only) input embedding and the
+        trained syn1 frozen."""
+        import jax
+        import jax.numpy as jnp
+
+        rng = np.random.default_rng(self.seed)
+        ids = np.asarray([self.vocab.word2idx[t] for t in tokens
+                          if t in self.vocab.word2idx], np.int32)
+        d = ((rng.random(self.layer_size) - 0.5)
+             / self.layer_size).astype(np.float32)
+        if ids.size == 0:
+            return d
+        counts = np.asarray(self.vocab.counts, np.float64)
+        neg_p = counts ** 0.75
+        neg_p /= neg_p.sum()
+        syn1 = jnp.asarray(self.syn1)
+        K = 1 + self.negative
+
+        @jax.jit
+        def step(dv, ctx, labels, lr):
+            def loss_fn(v):
+                u = syn1[ctx]                        # [B, K, D]
+                logits = jnp.einsum("d,bkd->bk", v, u)
+                l = jnp.maximum(logits, 0) - logits * labels + \
+                    jnp.log1p(jnp.exp(-jnp.abs(logits)))
+                return l.sum() / ctx.shape[0]
+            return dv - lr * jax.grad(loss_fn)(dv)
+
+        dv = jnp.asarray(d)
+        for ep in range(self.infer_epochs):
+            negs = rng.choice(len(self.vocab), size=(ids.size, K - 1),
+                              p=neg_p).astype(np.int32)
+            ctx = np.concatenate([ids[:, None], negs], axis=1)
+            labels = np.zeros((ids.size, K), np.float32)
+            labels[:, 0] = 1.0
+            lr = np.float32(max(self.min_learning_rate,
+                                self.learning_rate
+                                * (1 - ep / self.infer_epochs)))
+            dv = step(dv, ctx, labels, lr)
+        return np.asarray(dv)
+
+    def infer_vector(self, text: str) -> np.ndarray:
+        if self.syn1 is None:
+            raise ValueError("fit_labelled(...) first")
+        return self._train_doc_vector(self.tokenizer.tokenize(text))
+
+    def get_doc_vector(self, label: str) -> np.ndarray:
+        return self.doc_vectors[self.doc_labels.index(label)]
+
+    def doc_similarity(self, a: str, b: str) -> float:
+        va, vb = self.get_doc_vector(a), self.get_doc_vector(b)
+        den = (np.linalg.norm(va) * np.linalg.norm(vb)) or 1e-12
+        return float(va @ vb / den)
+
+
 class WordVectorSerializer:
     """Text format save/load (reference ``WordVectorSerializer``:
     'word v1 v2 ...' per line, optional 'V D' header — the word2vec-c

@@ -296,6 +296,36 @@ def segment_sum(data, segment_ids, num_segments):
                                num_segments=num_segments)
 
 
+@register("scatter.segment_mean", category="scatter")
+def segment_mean(data, segment_ids, num_segments):
+    """libnd4j ``segment_mean`` / ``unsorted_segment_mean`` (our segment ops
+    are all unsorted-tolerant — jax.ops handles unsorted ids)."""
+    ids = jnp.asarray(segment_ids, jnp.int32)
+    s = jax.ops.segment_sum(data, ids, num_segments=num_segments)
+    n = jax.ops.segment_sum(jnp.ones(data.shape[:1], data.dtype), ids,
+                            num_segments=num_segments)
+    shape = (num_segments,) + (1,) * (data.ndim - 1)
+    return s / jnp.maximum(n.reshape(shape), 1)
+
+
+@register("scatter.segment_max", category="scatter")
+def segment_max(data, segment_ids, num_segments):
+    return jax.ops.segment_max(data, jnp.asarray(segment_ids, jnp.int32),
+                               num_segments=num_segments)
+
+
+@register("scatter.segment_min", category="scatter")
+def segment_min(data, segment_ids, num_segments):
+    return jax.ops.segment_min(data, jnp.asarray(segment_ids, jnp.int32),
+                               num_segments=num_segments)
+
+
+@register("scatter.segment_prod", category="scatter")
+def segment_prod(data, segment_ids, num_segments):
+    return jax.ops.segment_prod(data, jnp.asarray(segment_ids, jnp.int32),
+                                num_segments=num_segments)
+
+
 # -- accumulation / misc -----------------------------------------------------
 register("math.cumprod", category="reduce")(jnp.cumprod)
 

@@ -417,3 +417,28 @@ def test_ctc_loss_decreases_with_training_signal(rng):
     assert float(fn(jnp.asarray(better), jnp.asarray(labels))) < loss
     _mark("loss.ctc")
     _mark_grad("loss.ctc")
+
+
+def test_segment_ops_match_numpy():
+    """segment_{sum,mean,max,min,prod}: unsorted ids vs numpy groupby
+    (libnd4j segment/unsorted_segment families)."""
+    import deeplearning4j_tpu.ops as O
+    rng = np.random.default_rng(0)
+    data = rng.normal(size=(7, 3)).astype(np.float32)
+    ids = np.array([2, 0, 1, 0, 2, 2, 1], np.int32)
+    n = 3
+
+    def ref(op):
+        out = []
+        for s in range(n):
+            rows = data[ids == s]
+            out.append({"sum": rows.sum(0), "mean": rows.mean(0),
+                        "max": rows.max(0), "min": rows.min(0),
+                        "prod": rows.prod(0)}[op])
+        return np.stack(out)
+
+    for op in ("sum", "mean", "max", "min", "prod"):
+        got = np.asarray(O.get(f"scatter.segment_{op}").fn(
+            jnp.asarray(data), ids, n))
+        np.testing.assert_allclose(got, ref(op), rtol=1e-5,
+                                   err_msg=f"segment_{op}")

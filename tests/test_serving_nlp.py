@@ -216,3 +216,38 @@ def test_huffman_tree_codes_are_prefix_free():
                 assert a != b[:len(a)]
     # frequent words get shorter codes
     assert mask[0].sum() <= mask[-1].sum()
+
+
+def test_glove_learns_cooccurrence_structure():
+    from deeplearning4j_tpu.nlp.glove import Glove
+
+    corpus = (["red green blue red green blue red green"] * 40
+              + ["cat dog mouse cat dog mouse cat dog"] * 40)
+    g = Glove(layer_size=16, window=3, min_count=1, epochs=60,
+              learning_rate=0.05, seed=3, batch_size=64)
+    g.fit(corpus)
+    assert g.similarity("red", "green") > g.similarity("red", "dog")
+    near = [w for w, _ in g.words_nearest("cat", 2)]
+    assert set(near) <= {"dog", "mouse"}
+
+
+def test_paragraph_vectors_doc_similarity_and_infer():
+    from deeplearning4j_tpu.nlp.word2vec import ParagraphVectors
+
+    docs = ([(f"color_{i}", "red green blue red green blue") for i in range(6)]
+            + [(f"animal_{i}", "cat dog mouse cat dog mouse")
+               for i in range(6)])
+    pv = ParagraphVectors(layer_size=16, window=2, min_count=1, epochs=10,
+                          seed=5, batch_size=128, subsample=0.0,
+                          learning_rate=0.1, infer_epochs=30)
+    pv.fit_labelled(docs)
+    assert pv.doc_vectors.shape == (12, 16)
+    assert pv.doc_similarity("color_0", "color_1") > \
+        pv.doc_similarity("color_0", "animal_0")
+    # inference places an unseen color doc nearer the color cluster
+    v = pv.infer_vector("blue red green blue")
+    c = pv.get_doc_vector("color_0")
+    a = pv.get_doc_vector("animal_0")
+    cos = lambda x, y: float(x @ y / ((np.linalg.norm(x)
+                                       * np.linalg.norm(y)) or 1e-12))
+    assert cos(v, c) > cos(v, a)
