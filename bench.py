@@ -82,7 +82,7 @@ def main():
         # pessimistic direction). Slope/subtraction schemes were rejected:
         # under multiplicative contention noise they can bias LOW.
         k = 16
-        runs = [chain(k) for _ in range(5)]
+        runs = [chain(k) for _ in range(8)]
         final_loss = runs[0][1]
         dt = min(r[0] for r in runs) / (k * nsteps)
         return net, dt, final_loss

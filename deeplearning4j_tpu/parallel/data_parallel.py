@@ -38,6 +38,18 @@ def make_mesh(devices: Optional[Sequence] = None, axis: str = "data") -> Mesh:
     return Mesh(np.array(devs), (axis,))
 
 
+def make_dp_tp_mesh(data: int, model: int,
+                    devices: Optional[Sequence] = None) -> Mesh:
+    """2-axis ``('data', 'model')`` mesh for combined data + tensor
+    parallelism. Model-axis neighbors should be ICI-adjacent (the default
+    device order is), since the per-layer collectives ride that axis."""
+    devs = list(devices) if devices is not None else jax.devices()
+    if data * model != len(devs):
+        raise ValueError(f"data*model = {data * model} != "
+                         f"{len(devs)} devices")
+    return Mesh(np.array(devs).reshape(data, model), ("data", "model"))
+
+
 class ParallelWrapper:
     """Data-parallel fit() over a mesh (name kept for reference parity).
 
@@ -56,15 +68,48 @@ class ParallelWrapper:
     equivalence to the unpadded single-chip step is tested).
     """
 
-    def __init__(self, model, mesh: Optional[Mesh] = None):
+    def __init__(self, model, mesh: Optional[Mesh] = None,
+                 model_axis: Optional[str] = None):
         # model: MultiLayerNetwork or ComputationGraph (duck-typed: both
         # expose params/updater_state/state/_build_train_step with the same
         # pytree layout; only the batch-argument arity differs)
+        #
+        # model_axis: name of a mesh axis to TENSOR-PARALLEL the dense
+        # family over (make_dp_tp_mesh): dense/output kernels [in, out]
+        # shard over their out column, biases follow, everything else
+        # (conv/BN/recurrent) replicates. GSPMD inserts the per-layer
+        # collectives; updater state follows parameter sharding. This goes
+        # BEYOND the reference (DL4J's parallelism is data-parallel only) —
+        # the TPU-first extension SURVEY.md §3.4's translation invites.
         self.model = model
         self.mesh = mesh or make_mesh()
+        self.model_axis = model_axis
+        if model_axis is not None and model_axis not in self.mesh.axis_names:
+            raise ValueError(f"model_axis {model_axis!r} not in mesh axes "
+                             f"{self.mesh.axis_names}")
         self._step = None
         from ..nn.graph import ComputationGraph
         self._is_graph = isinstance(model, ComputationGraph)
+
+    def _param_spec(self, path: tuple, arr) -> P:
+        """PartitionSpec for one parameter leaf under tensor parallelism."""
+        if self.model_axis is None:
+            return P()
+        name = path[-1] if path else ""
+        if name == "W" and getattr(arr, "ndim", 0) == 2:
+            return P(None, self.model_axis)     # dense kernel: shard out-dim
+        if name == "b" and getattr(arr, "ndim", 0) == 1:
+            return P(self.model_axis)
+        return P()
+
+    def _param_shardings(self, params):
+        """NamedSharding tree matching the params pytree."""
+        from jax.tree_util import tree_map_with_path
+
+        def leaf(path, a):
+            names = tuple(str(getattr(k, "key", k)) for k in path)
+            return NamedSharding(self.mesh, self._param_spec(names, a))
+        return tree_map_with_path(leaf, params)
 
     def _build(self):
         base = self.model._build_train_step()  # already jit; re-wrap with shardings
@@ -102,8 +147,19 @@ class ParallelWrapper:
             return put(t, data)
 
         def shard_args(params, opt_state, bn_state, step, key, x, y, fm, lm):
-            params = jax.tree.map(lambda a: put(a, repl), params)
-            opt_state = jax.tree.map(lambda a: put(a, repl), opt_state)
+            from jax.tree_util import tree_structure
+            p_sh = self._param_shardings(params)
+            p_struct = tree_structure(params)
+            params = jax.tree.map(put, params, p_sh)
+            # updater state slots ("m"/"v"/"h"...) mirror the params tree —
+            # shard them identically so sharded weights keep sharded state
+            opt_state = {
+                k: (jax.tree.map(put, sub, p_sh)
+                    if tree_structure(sub) == p_struct
+                    else jax.tree.map(lambda a: put(a, repl), sub))
+                for k, sub in opt_state.items()
+            } if isinstance(opt_state, dict) else jax.tree.map(
+                lambda a: put(a, repl), opt_state)
             bn_state = jax.tree.map(lambda a: put(a, repl), bn_state)
             return (params, opt_state, bn_state,
                     put(step, repl), put(key, repl),

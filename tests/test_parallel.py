@@ -195,3 +195,51 @@ def test_ragged_tail_bn_stats_match_unpadded_step():
             np.testing.assert_allclose(np.asarray(dp.params[k][p]),
                                        np.asarray(ref.params[k][p]),
                                        rtol=1e-4, atol=1e-5)
+
+
+def test_tensor_parallel_dense_matches_data_parallel_only():
+    """DP+TP over a ('data','model') mesh: dense kernels sharded over the
+    model axis; training result identical to pure DP (GSPMD inserts the
+    collectives, math unchanged)."""
+    import jax
+    from deeplearning4j_tpu.nn.config import (InputType,
+                                              NeuralNetConfiguration)
+    from deeplearning4j_tpu.nn.layers.core import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.model import MultiLayerNetwork
+    from deeplearning4j_tpu.nn.updaters import Adam
+    from deeplearning4j_tpu.parallel.data_parallel import (ParallelWrapper,
+                                                           make_dp_tp_mesh,
+                                                           make_mesh)
+    from deeplearning4j_tpu.data.dataset import DataSet
+
+    def build():
+        conf = (NeuralNetConfiguration.builder().seed(9)
+                .updater(Adam(learning_rate=1e-2))
+                .input_type(InputType.feed_forward(6))
+                .list(DenseLayer(n_out=16, activation="tanh"),
+                      DenseLayer(n_out=8, activation="relu"),
+                      OutputLayer(n_out=4)).build())
+        return MultiLayerNetwork(conf).init()
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(16, 6)).astype(np.float32)
+    y = np.eye(4, dtype=np.float32)[rng.integers(0, 4, 16)]
+
+    tp_net = build()
+    mesh = make_dp_tp_mesh(2, 4)
+    pw = ParallelWrapper(tp_net, mesh, model_axis="model")
+    pw.fit(DataSet(x, y), epochs=2)
+    # kernels really are sharded over the model axis
+    w_shard = tp_net.params["0"]["W"].sharding
+    assert "model" in str(w_shard.spec), w_shard
+    # and Adam state follows the parameter sharding
+    m_shard = tp_net.updater_state["m"]["0"]["W"].sharding
+    assert str(m_shard.spec) == str(w_shard.spec)
+
+    dp_net = build()
+    ParallelWrapper(dp_net, make_mesh()).fit(DataSet(x, y), epochs=2)
+    for k in dp_net.params:
+        for p in dp_net.params[k]:
+            np.testing.assert_allclose(np.asarray(tp_net.params[k][p]),
+                                       np.asarray(dp_net.params[k][p]),
+                                       rtol=2e-5, atol=2e-6)
