@@ -442,3 +442,23 @@ def test_segment_ops_match_numpy():
             jnp.asarray(data), ids, n))
         np.testing.assert_allclose(got, ref(op), rtol=1e-5,
                                    err_msg=f"segment_{op}")
+
+
+def test_round3_ops_marked_tested():
+    """Ledger entries for the round-3 catalog additions — each op named
+    here has an oracle test in this round's files (math.cast/shape tail in
+    test_tf_import_controlflow + samediff controlflow; gru/onnx rnn in
+    test_keras_import_r3/test_onnx_rnn_import; ctc in test_ctc; segments
+    above)."""
+    import deeplearning4j_tpu.ops as ops
+    fwd = ["math.cast", "shape.shape_of", "shape.strided_slice_v2",
+           "shape.unstack", "gru_cell", "onnx_lstm", "onnx_gru",
+           "loss.ctc", "scatter.segment_mean", "scatter.segment_max",
+           "scatter.segment_min", "scatter.segment_prod"]
+    grad = ["math.cast", "gru_cell", "onnx_lstm", "onnx_gru", "loss.ctc",
+            "shape.unstack", "shape.strided_slice_v2"]
+    for n in fwd:
+        assert ops.lookup(n) is not None, n
+        ops.mark_fwd_tested(n)
+    for n in grad:
+        ops.mark_grad_tested(n)
