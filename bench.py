@@ -19,11 +19,13 @@ Methodology notes (honesty over flattery):
 - Timing forces a host readback of the loss history at the end of each
   measured chain: on this PJRT plugin ``block_until_ready`` returns
   before device work completes, so dispatch-only timing would overstate
-  throughput ~50x (measured round 2). The readback itself costs a fixed
-  ~85 ms tunnel round-trip that has nothing to do with the training
-  step, so the step time is taken as the SLOPE between a long and a
-  short chain of epochs — the fixed RTT cancels; every step timed is a
-  real on-device training step on its own batch.
+  throughput ~50x (measured round 2). The step time is the MIN over
+  eight 128-step chains (the tunneled chip is multi-tenant with ~±20%
+  throughput swings; min samples the least-contended window — timeit
+  posture), with the fixed ~85 ms readback RTT left IN the divisor
+  (≈0.7 ms/step, pessimistic direction). ``step_time_median_ms`` is
+  reported alongside so the contention spread is visible. Every step
+  timed is a real on-device training step on its own batch.
 - ``accuracy`` is null: synthetic data (zero-egress); LeNet-MNIST
   convergence is asserted in tests/test_model.py.
 - ``vs_baseline`` is null: the reference publishes no numbers
@@ -84,13 +86,15 @@ def main():
         k = 16
         runs = [chain(k) for _ in range(8)]
         final_loss = runs[0][1]
-        dt = min(r[0] for r in runs) / (k * nsteps)
-        return net, dt, final_loss
+        times = sorted(r[0] for r in runs)
+        dt = times[0] / (k * nsteps)
+        dt_median = times[len(times) // 2] / (k * nsteps)
+        return net, dt, dt_median, final_loss
 
     batch = 128
     while True:
         try:
-            net, step_time, final_loss = run(batch)
+            net, step_time, step_time_median, final_loss = run(batch)
             break
         except Exception as e:  # OOM on small chips: halve and retry
             if batch <= 16 or "RESOURCE_EXHAUSTED" not in str(e).upper():
@@ -116,6 +120,7 @@ def main():
         "batch": batch,
         "examples_per_sec": round(eps, 1),
         "step_time_ms": round(step_time * 1e3, 2),
+        "step_time_median_ms": round(step_time_median * 1e3, 2),
         "final_loss": round(final_loss, 3),
         "fwd_gflops_per_example": round(fwd_flops / 1e9, 2),
         "peak_tflops_bf16": round(peak / 1e12, 1) if peak else None,
