@@ -85,6 +85,7 @@ class MultiLayerNetwork:
         self._output_fn = None
         self._rnn_step_fn = None
         self._rnn_stream = None
+        self._epoch_fn = None
         self._key = jax.random.PRNGKey(conf.seed)
         self._out_layer = self.layers[-1] if self.layers else None
         if self.layers and not _is_loss_head(self._out_layer):
@@ -118,6 +119,7 @@ class MultiLayerNetwork:
         self._output_fn = None
         self._rnn_step_fn = None
         self._rnn_stream = None
+        self._epoch_fn = None
         return self
 
     def num_params(self) -> int:
@@ -236,6 +238,72 @@ class MultiLayerNetwork:
         # arenas' moral equivalent, handled by XLA)
         return jax.jit(step_fn, donate_argnums=(0, 1, 2),
                        compiler_options=_env.engine_compiler_options())
+
+    # ------------------------------------------------- on-device epoch loop
+    def _build_epoch_fn(self):
+        """lax.scan of the fused train step over a device-resident batch
+        stack — one XLA launch per epoch (see ComputationGraph.
+        _build_epoch_fn for the rationale; same contract, singular
+        batch arity)."""
+        step = self._build_train_step().__wrapped__
+
+        def epoch_fn(params, opt_state, bn_state, start_step, key, xs, ys):
+            def body(carry, xy):
+                params, opt_state, bn_state, i = carry
+                bx, by = xy
+                k = jax.random.fold_in(key, i)
+                params, opt_state, bn_state, loss = step(
+                    params, opt_state, bn_state, i, k, bx, by, None, None)
+                return (params, opt_state, bn_state, i + 1), loss
+            (params, opt_state, bn_state, _), losses = jax.lax.scan(
+                body, (params, opt_state, bn_state, start_step), (xs, ys))
+            return params, opt_state, bn_state, losses
+
+        return jax.jit(epoch_fn, donate_argnums=(0, 1, 2),
+                       compiler_options=_env.engine_compiler_options())
+
+    def fit_on_device(self, features, labels, epochs: int = 1,
+                      batch_size: Optional[int] = None) -> np.ndarray:
+        """Compiled on-device training (ComputationGraph.fit_on_device
+        contract): data reshaped to [n_batches, B, ...], uploaded once,
+        scanned per epoch; ragged tail dropped; returns the loss history.
+        Masked datasets must use fit()."""
+        if not self.params and not self.state:
+            self.init()
+        x = np.asarray(features)
+        y = np.asarray(labels)
+        n = x.shape[0]
+        b = batch_size or n
+        nb = n // b
+        if nb == 0:
+            raise ValueError(f"batch_size {b} exceeds dataset size {n}")
+        dt = _dt.resolve(self.conf.dtype)
+
+        def stack(a, cast):
+            a = a[:nb * b].reshape((nb, b) + a.shape[1:])
+            if cast and np.issubdtype(a.dtype, np.floating) and \
+                    jnp.issubdtype(dt, jnp.floating):
+                a = a.astype(dt)
+            return jax.device_put(jnp.asarray(a))
+        xs = stack(x, True)
+        ys = stack(y, False)
+        if getattr(self, "_epoch_fn", None) is None:
+            self._epoch_fn = self._build_epoch_fn()
+        history = []
+        for _ in range(epochs):
+            self._key, sub = jax.random.split(self._key)
+            self.params, self.updater_state, self.state, losses = \
+                self._epoch_fn(self.params, self.updater_state, self.state,
+                               jnp.int32(self.iteration), sub, xs, ys)
+            self.iteration += nb
+            self.epoch += 1
+            self._score = losses[-1]  # lazy device scalar for listeners
+            history.append(losses)
+            for cb in self._listeners:
+                cb.on_epoch_end(self)
+        out = np.concatenate([np.asarray(h) for h in history])
+        self._score = float(out[-1])
+        return out
 
     def fit(self, data, labels=None, epochs: int = 1) -> "MultiLayerNetwork":
         """DL4J fit(): accepts DataSetIterator, DataSet, or (features, labels)."""

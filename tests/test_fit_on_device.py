@@ -125,3 +125,50 @@ def test_resnet_s2d_stem_matches_direct_stem_forward():
     ka = "stem_conv" if "stem_conv" in ya else sa
     np.testing.assert_allclose(np.asarray(ya[ka]), np.asarray(yb[sb]),
                                rtol=1e-4, atol=1e-4)
+
+
+def test_mln_fit_on_device_matches_fit():
+    """MultiLayerNetwork.fit_on_device: bit-identical to per-batch fit()
+    (same contract as the graph engine's)."""
+    from deeplearning4j_tpu.nn.config import (InputType,
+                                              NeuralNetConfiguration)
+    from deeplearning4j_tpu.nn.layers.conv import (BatchNormalization,
+                                                   ConvolutionLayer)
+    from deeplearning4j_tpu.nn.layers.core import OutputLayer
+    from deeplearning4j_tpu.nn.model import MultiLayerNetwork
+    from deeplearning4j_tpu.nn.updaters import Sgd
+
+    def build():
+        conf = (NeuralNetConfiguration.builder().seed(21)
+                .updater(Sgd(learning_rate=0.05))
+                .input_type(InputType.convolutional(3, 8, 8,
+                                                    data_format="NHWC"))
+                .list(ConvolutionLayer(n_out=4, kernel=(3, 3), mode="same",
+                                       activation="relu",
+                                       data_format="NHWC"),
+                      BatchNormalization(data_format="NHWC"),
+                      OutputLayer(n_out=3)).build())
+        return MultiLayerNetwork(conf).init()
+
+    rng = np.random.default_rng(2)
+    x = rng.normal(size=(12, 8, 8, 3)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 12)]
+
+    a = build()
+    losses = a.fit_on_device(x, y, epochs=2, batch_size=4)
+    assert losses.shape == (6,) and np.all(np.isfinite(losses))
+
+    b = build()
+    for _ in range(2):
+        for i in range(3):
+            b.fit(DataSet(x[4 * i:4 * i + 4], y[4 * i:4 * i + 4]))
+
+    for k in a.params:
+        for p in a.params[k]:
+            np.testing.assert_allclose(np.asarray(a.params[k][p]),
+                                       np.asarray(b.params[k][p]),
+                                       rtol=1e-6, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(a.state["1"]["mean"]),
+                               np.asarray(b.state["1"]["mean"]),
+                               rtol=1e-6, atol=1e-6)
+    assert a.iteration == b.iteration == 6
