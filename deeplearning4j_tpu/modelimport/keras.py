@@ -312,6 +312,29 @@ def register_custom_layer(class_name: str, mapper: Callable) -> None:
     _MAPPERS[class_name] = mapper
 
 
+def _map_layer_norm(cfg) -> _Mapped:
+    from ..nn.layers.special import LayerNormalization
+    axis = cfg.get("axis", -1)
+    if isinstance(axis, (list, tuple)):
+        axis = axis[0] if len(axis) == 1 else axis
+    if axis not in (-1,):
+        raise ValueError(f"LayerNormalization axis={axis} not supported "
+                         "(last-axis only)")
+    lyr = LayerNormalization(eps=float(cfg.get("epsilon", 1e-3)),
+                             scale=bool(cfg.get("scale", True)),
+                             center=bool(cfg.get("center", True)))
+
+    def w(ws):
+        ws = list(ws)
+        out = {}
+        if lyr.scale:
+            out["gamma"] = ws.pop(0)
+        if lyr.center:
+            out["beta"] = ws.pop(0)
+        return out
+    return _Mapped(lyr, w)
+
+
 def _map_lambda(cfg) -> _Mapped:
     name = cfg.get("name")
     if name in _LAMBDA_LAYERS:
@@ -403,6 +426,9 @@ _MAPPERS: Dict[str, Callable[[dict], _Mapped]] = {
     "GlobalMaxPooling1D": lambda c: _Mapped(
         GlobalPoolingLayer(pool_type="max")),
     "Lambda": _map_lambda,
+    "LayerNormalization": lambda c: _map_layer_norm(c),
+    "ELU": lambda c: _Mapped(ActivationLayer(
+        activation="elu", alpha=float(c.get("alpha", 1.0)))),
     "SeparableConv2D": lambda c: _map_separable(c),
     "DepthwiseConv2D": lambda c: _map_depthwise(c),
     "PReLU": lambda c: _map_prelu(c),

@@ -26,6 +26,7 @@ from ...ops import losses as _loss
 from ...ops.math import precision_for
 from .. import weights as _winit
 from .base import Layer, layer
+from . import core as _core
 from .core import _BaseOutput
 
 
@@ -371,3 +372,43 @@ class EmbeddingSequenceLayer(Layer):
         if ids.ndim == 3 and ids.shape[-1] == 1:
             ids = ids[..., 0]
         return jnp.take(params["W"], ids, axis=0), state, mask
+
+
+@layer("layer_norm")
+class LayerNormalization(Layer):
+    """Per-feature layer normalization over the LAST axis with gamma/beta
+    (Keras ``LayerNormalization`` import target; DL4J exposes layer norm as
+    ``DenseLayer.hasLayerNorm`` rather than a standalone layer — recorded:
+    the standalone form subsumes it and is what imports need)."""
+    eps: float = 1e-3              # keras default epsilon
+    scale: bool = True
+    center: bool = True
+    name: Optional[str] = None
+
+    def initialize(self, key, input_shape, dtype):
+        n = int(input_shape[-1])
+        params = {}
+        if self.scale:
+            params["gamma"] = jnp.ones((n,), dtype)
+        if self.center:
+            params["beta"] = jnp.zeros((n,), dtype)
+        return params, {}, tuple(input_shape)
+
+    def has_params(self):
+        return self.scale or self.center
+
+    def apply(self, params, x, state, *, train=False, rng=None, mask=None):
+        from ...ops import nnops
+        gamma = params.get("gamma", jnp.ones((x.shape[-1],), x.dtype))
+        beta = params.get("beta", jnp.zeros((x.shape[-1],), x.dtype))
+        return nnops.layer_norm(x, gamma, beta, self.eps, axis=-1), \
+            state, mask
+
+
+@layer("cnn_loss")
+class CnnLossLayer(_core.LossLayer):
+    """Per-pixel loss head over [B,H,W,C] / [B,C,H,W] (DL4J ``CnnLossLayer``
+    — the segmentation head). Same math as LossLayer (our losses broadcast
+    over leading dims and sum the channel axis); exists as a named class
+    for config parity, carrying the data_format the reference records."""
+    data_format: str = "NHWC"

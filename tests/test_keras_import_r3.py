@@ -208,3 +208,27 @@ def test_custom_layer_registration(tmp_path):
                                    rtol=RTOL, atol=ATOL)
     finally:
         _MAPPERS.pop("Scale", None)
+
+
+def test_layer_normalization(tmp_path):
+    rng = np.random.default_rng(8)
+    m = tf.keras.Sequential([
+        tf.keras.layers.Input(shape=(6,)),
+        tf.keras.layers.Dense(8, name="d"),
+        tf.keras.layers.LayerNormalization(name="ln"),
+        tf.keras.layers.Dense(3, name="out"),
+    ])
+    for wv in m.weights:
+        wv.assign(rng.normal(scale=0.5, size=wv.shape).astype(np.float32))
+    _roundtrip(m, tmp_path, rng.normal(size=(4, 6)).astype(np.float32))
+
+
+def test_elu_layer(tmp_path):
+    rng = np.random.default_rng(9)
+    m = tf.keras.Sequential([
+        tf.keras.layers.Input(shape=(5,)),
+        tf.keras.layers.Dense(4, name="d"),
+        tf.keras.layers.ELU(name="e"),
+        tf.keras.layers.Dense(2, name="out"),
+    ])
+    _roundtrip(m, tmp_path, rng.normal(size=(3, 5)).astype(np.float32))
