@@ -272,6 +272,61 @@ def _pool(node, ctx, ins):
                "data_format": "NHWC"})
 
 
+@tf_op("DepthwiseConv2dNative")
+def _depthwise_conv(node, ctx, ins):
+    if _attr(node, "data_format", "NHWC") != "NHWC":
+        raise ValueError("DepthwiseConv2dNative NCHW not supported")
+    pad = _attr(node, "padding", "VALID")
+    if pad == "EXPLICIT":
+        raise ValueError("DepthwiseConv2dNative padding=EXPLICIT "
+                         "not supported")
+    # TF kernel [kH, kW, C, mult] -> our depthwise storage [C*mult, 1, kH, kW]
+    w = ctx.sd.call("shape.transpose", ctx.get(ins[1]),
+                    attrs={"axes": [2, 3, 0, 1]})      # [C, mult, kH, kW]
+    w = ctx.sd.call("shape.reshape", w,
+                    attrs={"shape": list(_depthwise_out_shape(ctx, ins[1]))})
+    return ctx.sd.call(
+        "depthwise_conv2d", ctx.get(ins[0]), w, name=node.name,
+        attrs={"stride": _pair_from(_attr(node, "strides", [1, 1, 1, 1])),
+               "dilation": _pair_from(_attr(node, "dilations", [1, 1, 1, 1])),
+               "mode": "same" if pad == "SAME" else "truncate",
+               "data_format": "NHWC"})
+
+
+def _depthwise_out_shape(ctx, wref):
+    """[C*mult, 1, kH, kW] target shape from the (const or shaped) kernel."""
+    name = _strip(wref)
+    if name in ctx.consts:
+        kh, kw, c, mult = np.asarray(ctx.consts[name]).shape
+    else:
+        var = ctx.get(wref)
+        if var.shape is None or any(s is None for s in var.shape):
+            raise ValueError("DepthwiseConv2dNative needs a static kernel "
+                             "shape")
+        kh, kw, c, mult = var.shape
+    return [c * mult, 1, kh, kw]
+
+
+@tf_op("ResizeBilinear", "ResizeNearestNeighbor")
+def _resize(node, ctx, ins):
+    # jax.image.resize samples half-pixel centers — the TF2 convention
+    # (tf.image.resize sets half_pixel_centers=True). The TF1 legacy grid
+    # (half_pixel_centers=False / align_corners) is a different sampling
+    # lattice; mapping it silently would be numerically wrong everywhere.
+    if _attr(node, "align_corners", False):
+        raise ValueError(f"{node.op} align_corners=True not supported")
+    if not _attr(node, "half_pixel_centers", False):
+        raise ValueError(
+            f"{node.op} with the TF1 legacy grid (half_pixel_centers=False) "
+            "not supported — re-export with tf.image.resize (TF2)")
+    size = [int(s) for s in
+            np.asarray(ctx.const_value(ins[1])).reshape(-1).tolist()]
+    op = ("image.resize_bilinear" if node.op == "ResizeBilinear"
+          else "image.resize_nearest")
+    return ctx.sd.call(op, ctx.get(ins[0]), name=node.name,
+                       attrs={"size": size, "data_format": "NHWC"})
+
+
 @tf_op("FusedBatchNorm", "FusedBatchNormV2", "FusedBatchNormV3")
 def _fused_bn(node, ctx, ins):
     if _attr(node, "is_training", False):

@@ -168,3 +168,47 @@ def test_cond_branch_with_multi_output_op():
     x = np.array([[0.1, 3.0, 2.0, -1.0], [5.0, 0.0, 1.0, 4.0]], np.float32)
     ref, got = _roundtrip(f, {"x": x}, spec)
     np.testing.assert_allclose(got, ref, rtol=1e-6)  # indices, not values
+
+
+def test_depthwise_conv_and_resize():
+    """MobileNet/segmentation staples: DepthwiseConv2dNative and
+    ResizeNearestNeighbor/Bilinear, golden vs TF."""
+    rng = np.random.default_rng(4)
+    kern = tf.constant(rng.normal(size=(3, 3, 4, 1)).astype(np.float32))
+
+    @tf.function
+    def f(x):
+        y = tf.nn.depthwise_conv2d(x, kern, strides=[1, 1, 1, 1],
+                                   padding="SAME")
+        y = tf.image.resize(y, [16, 16], method="nearest")
+        return tf.nn.relu(y)
+
+    x = rng.normal(size=(2, 8, 8, 4)).astype(np.float32)
+    ref, got = _roundtrip(f, {"x": x},
+                          [tf.TensorSpec([2, 8, 8, 4], tf.float32,
+                                         name="x")])
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_resize_bilinear():
+    rng = np.random.default_rng(5)
+
+    @tf.function
+    def f(x):
+        return tf.image.resize(x, [6, 6], method="bilinear")
+
+    x = rng.normal(size=(1, 3, 3, 2)).astype(np.float32)
+    ref, got = _roundtrip(f, {"x": x},
+                          [tf.TensorSpec([1, 3, 3, 2], tf.float32,
+                                         name="x")])
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-4)
+
+    # the TF1 legacy grid must be rejected, not silently mis-sampled
+    @tf.function
+    def g(x):
+        return tf.compat.v1.image.resize_bilinear(x, [6, 6])
+
+    gd, _, _, _ = _freeze(g, tf.TensorSpec([1, 3, 3, 2], tf.float32,
+                                           name="x"))
+    with pytest.raises(ValueError, match="half_pixel_centers"):
+        TensorflowFrameworkImporter.import_graph_def(gd)
