@@ -327,6 +327,17 @@ def _slice(node, ctx, at):
         ends = at["ends"]
         axes = at.get("axes", list(range(len(starts))))
         steps = [1] * len(starts)
+    if any(int(a) < 0 for a in axes):
+        # negative axes are spec-legal; normalize against the input rank
+        var = ctx.get(node.input[0])
+        if node.input[0] in ctx.consts:
+            rank = np.asarray(ctx.consts[node.input[0]]).ndim
+        elif var.shape is not None:
+            rank = len(var.shape)
+        else:
+            raise ValueError(
+                "Slice with negative axes needs a known input rank")
+        axes = [int(a) % rank for a in axes]
     by_axis = {int(a): (int(s), int(e), int(st))
                for a, s, e, st in zip(axes, starts, ends, steps)}
     max_axis = max(by_axis) if by_axis else -1

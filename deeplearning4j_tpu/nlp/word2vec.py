@@ -345,6 +345,11 @@ class ParagraphVectors(Word2Vec):
 
     def __init__(self, infer_epochs: int = 20, **kw):
         super().__init__(**kw)
+        if self.use_hierarchic_softmax:
+            raise ValueError(
+                "ParagraphVectors implements the DBOW/negative-sampling "
+                "form; hierarchical softmax doc training is not supported "
+                "(syn1 would hold Huffman inner nodes, not word rows)")
         self.infer_epochs = infer_epochs
         self.doc_labels: List[str] = []
         self.doc_vectors: Optional[np.ndarray] = None

@@ -146,10 +146,18 @@ class ParallelWrapper:
                 return tuple(shard_batch(a) for a in t)
             return put(t, data)
 
+        from jax.tree_util import tree_structure
+        p_sh_cache = {}
+
         def shard_args(params, opt_state, bn_state, step, key, x, y, fm, lm):
-            from jax.tree_util import tree_structure
-            p_sh = self._param_shardings(params)
-            p_struct = tree_structure(params)
+            # params/opt structure and model_axis are fixed after init;
+            # build the sharding tree once, not per step (after the first
+            # step every put() is a pass-through anyway)
+            if "sh" not in p_sh_cache:
+                p_sh_cache["sh"] = self._param_shardings(params)
+                p_sh_cache["struct"] = tree_structure(params)
+            p_sh = p_sh_cache["sh"]
+            p_struct = p_sh_cache["struct"]
             params = jax.tree.map(put, params, p_sh)
             # updater state slots ("m"/"v"/"h"...) mirror the params tree —
             # shard them identically so sharded weights keep sharded state

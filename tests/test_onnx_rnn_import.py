@@ -250,3 +250,22 @@ def test_onnx_where_constantofshape_expand():
     ref = np.where(x > 0.5, np.ones_like(x), x)
     out = sd.output({"x": x}, ["y"])["y"]
     np.testing.assert_allclose(out, ref, rtol=1e-6)
+
+
+def test_onnx_slice_negative_axis():
+    m = P.ModelProto()
+    g = m.graph
+    g.input.append(_io("x", [2, 5]))
+    for nm, vals in (("st", [1]), ("en", [4]), ("ax", [-1])):
+        t = P.TensorProto()
+        t.name = nm
+        t.dims.extend([1])
+        t.data_type = 7
+        t.raw_data = np.asarray(vals, np.int64).tobytes()
+        g.initializer.append(t)
+    _node(g, "Slice", ["x", "st", "en", "ax"], ["y"])
+    g.output.append(_io("y", []))
+    sd = OnnxFrameworkImporter.import_model_proto(m.SerializeToString())
+    x = np.arange(10, dtype=np.float32).reshape(2, 5)
+    out = sd.output({"x": x}, ["y"])["y"]
+    np.testing.assert_allclose(out, x[:, 1:4])
