@@ -457,3 +457,34 @@ class WordVectorSerializer:
         m.syn0 = np.asarray(vecs, dtype=np.float32)
         m.syn1 = np.zeros_like(m.syn0)
         return m
+
+
+def initialize_embedding_from_word_vectors(net, layer_index: int,
+                                           vectors: "SequenceVectors",
+                                           word_index,
+                                           trainable: bool = True):
+    """Load pretrained word vectors into a network's EmbeddingLayer params
+    (DL4J ``EmbeddingInitializer`` / ``WordVectorSerializer.loadTxtVectors``
+    → ``EmbeddingLayer`` path†; mount empty, unverified).
+
+    ``word_index``: dict word -> row id in the network's embedding (the
+    tokenizer's vocabulary). Rows whose word the vectors model does not
+    know keep their random init. ``trainable=False`` wraps nothing — freeze
+    via FrozenLayer at config time if desired (recorded divergence: DL4J
+    bakes frozen-ness into the initializer flag).
+    Returns the number of rows initialized.
+    """
+    import jax.numpy as jnp
+    key = str(layer_index)
+    w = np.asarray(net.params[key]["W"]).copy()
+    if w.shape[1] != vectors.layer_size:
+        raise ValueError(f"embedding dim {w.shape[1]} != word-vector dim "
+                         f"{vectors.layer_size}")
+    hits = 0
+    for word, row in word_index.items():
+        if 0 <= row < w.shape[0] and vectors.has_word(word):
+            w[row] = vectors.get_word_vector(word)
+            hits += 1
+    net.params[key] = {**net.params[key], "W": jnp.asarray(w)}
+    net._train_step = None  # params replaced outside the jit chain
+    return hits

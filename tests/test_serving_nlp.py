@@ -251,3 +251,40 @@ def test_paragraph_vectors_doc_similarity_and_infer():
     cos = lambda x, y: float(x @ y / ((np.linalg.norm(x)
                                        * np.linalg.norm(y)) or 1e-12))
     assert cos(v, c) > cos(v, a)
+
+
+def test_embedding_initialized_from_word2vec():
+    """Pretrained Word2Vec rows land in an EmbeddingLayer (the DL4J
+    EmbeddingInitializer path) and the network trains on from them."""
+    from deeplearning4j_tpu.nlp.word2vec import (
+        Word2Vec, initialize_embedding_from_word_vectors)
+    from deeplearning4j_tpu.nn.config import (InputType,
+                                              NeuralNetConfiguration)
+    from deeplearning4j_tpu.nn.layers.core import EmbeddingLayer, OutputLayer
+    from deeplearning4j_tpu.nn.layers.recurrent import LSTM, LastTimeStep
+    from deeplearning4j_tpu.nn.model import MultiLayerNetwork
+    from deeplearning4j_tpu.nn.updaters import Adam
+
+    w2v = Word2Vec(layer_size=8, window=2, min_count=1, epochs=3, seed=1,
+                   batch_size=64, subsample=0.0)
+    w2v.fit(["red green blue red green", "cat dog mouse cat dog"] * 10)
+    word_index = {w: i for i, w in enumerate(w2v.vocab.words)}
+
+    conf = (NeuralNetConfiguration.builder().seed(0)
+            .updater(Adam(learning_rate=1e-2))
+            .input_type(InputType.recurrent(1, 4))
+            .list(EmbeddingLayer(n_in=len(word_index), n_out=8),
+                  LSTM(n_out=8), LastTimeStep(), OutputLayer(n_out=2))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    hits = initialize_embedding_from_word_vectors(net, 0, w2v, word_index)
+    assert hits == len(word_index)
+    np.testing.assert_allclose(np.asarray(net.params["0"]["W"])[0],
+                               w2v.get_word_vector(w2v.vocab.words[0]),
+                               rtol=1e-6)
+    rng = np.random.default_rng(0)
+    x = rng.integers(0, len(word_index), (6, 4, 1)).astype(np.int32)
+    y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 6)]
+    from deeplearning4j_tpu.data.dataset import DataSet
+    net.fit(DataSet(x, y), epochs=2)
+    assert np.isfinite(float(net.score()))
