@@ -610,6 +610,37 @@ class KerasModelImport:
                 return _import_functional(cfg, f)
             raise ValueError(f"unsupported Keras model class {cls!r}")
 
+    @staticmethod
+    def import_keras_model_configuration(source: str):
+        """Config-ONLY import (DL4J ``importKerasModelConfiguration``):
+        ``source`` is a model-config JSON string, a path to a .json file,
+        or a .h5 whose config attribute is read without touching weights.
+        Returns an initialized network with fresh (random) parameters."""
+        import os
+        if source.lstrip().startswith("{"):
+            cfg = json.loads(source)
+        elif os.path.splitext(source)[1].lower() in (".h5", ".hdf5"):
+            import h5py
+            with h5py.File(source, "r") as f:
+                cfg = json.loads(f.attrs["model_config"])
+        else:
+            with open(source) as f:
+                cfg = json.load(f)
+        cls = cfg["class_name"]
+        if cls == "Sequential":
+            return _import_sequential(cfg, None)
+        if cls in ("Functional", "Model"):
+            return _import_functional(cfg, None)
+        raise ValueError(f"unsupported Keras model class {cls!r}")
+
+    @staticmethod
+    def import_keras_sequential_configuration(source: str):
+        model = KerasModelImport.import_keras_model_configuration(source)
+        from ..nn.model import MultiLayerNetwork
+        if not isinstance(model, MultiLayerNetwork):
+            raise TypeError("configuration is not Sequential")
+        return model
+
 
 def _map_layer(lcfg: dict) -> _Mapped:
     cls = lcfg["class_name"]
@@ -693,9 +724,10 @@ def _import_sequential(cfg: dict, f):
     b = (NeuralNetConfiguration.builder().input_type(input_type)
          .list(*[m.layer for _, m in ours]))
     net = MultiLayerNetwork(b.build()).init()
-    for i, (kname, mapped) in enumerate(ours):
-        _set_params(net.params, net.state, str(i), mapped,
-                    _h5_weights(f, kname))
+    if f is not None:  # config-only import keeps the random init
+        for i, (kname, mapped) in enumerate(ours):
+            _set_params(net.params, net.state, str(i), mapped,
+                        _h5_weights(f, kname))
     return net
 
 
@@ -775,7 +807,8 @@ def _import_functional(cfg: dict, f):
     gb.set_input_types(*input_types)
     gb.set_outputs(*output_names)
     net = ComputationGraph(gb.build()).init()
-    for name, mapped in mapped_by_name.items():
-        _set_params(net.params, net.state, name, mapped,
-                    _h5_weights(f, name))
+    if f is not None:  # config-only import keeps the random init
+        for name, mapped in mapped_by_name.items():
+            _set_params(net.params, net.state, name, mapped,
+                        _h5_weights(f, name))
     return net

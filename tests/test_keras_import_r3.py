@@ -232,3 +232,33 @@ def test_elu_layer(tmp_path):
         tf.keras.layers.Dense(2, name="out"),
     ])
     _roundtrip(m, tmp_path, rng.normal(size=(3, 5)).astype(np.float32))
+
+
+def test_config_only_import(tmp_path):
+    """importKerasModelConfiguration parity: JSON string / .json file / .h5
+    all yield an initialized net with FRESH params (no weights read)."""
+    from deeplearning4j_tpu.nn.model import MultiLayerNetwork
+    m = tf.keras.Sequential([
+        tf.keras.layers.Input(shape=(6,)),
+        tf.keras.layers.Dense(5, activation="relu", name="d"),
+        tf.keras.layers.Dense(3, activation="softmax", name="out"),
+    ])
+    js = m.to_json()
+    net = KerasModelImport.import_keras_model_configuration(js)
+    assert isinstance(net, MultiLayerNetwork)
+    assert np.asarray(net.params["0"]["W"]).shape == (6, 5)
+
+    jp = str(tmp_path / "conf.json")
+    with open(jp, "w") as f:
+        f.write(js)
+    net2 = KerasModelImport.import_keras_sequential_configuration(jp)
+    assert np.asarray(net2.params["1"]["W"]).shape == (5, 3)
+
+    hp = str(tmp_path / "m.h5")
+    m.save(hp)
+    net3 = KerasModelImport.import_keras_model_configuration(hp)
+    # fresh params, NOT the h5 weights
+    assert not np.allclose(np.asarray(net3.params["0"]["W"]),
+                           m.get_weights()[0])
+    x = np.random.default_rng(0).normal(size=(2, 6)).astype(np.float32)
+    assert np.asarray(net3.output(x)).shape == (2, 3)
