@@ -531,6 +531,8 @@ def _input_type_from_batch_shape(shape) -> tuple:
 
 
 def _h5_weights(f, layer_name: str) -> List[np.ndarray]:
+    if isinstance(f, dict):        # .keras v3 path: weights precomputed
+        return f.get(layer_name, [])
     mw = f["model_weights"]
     if layer_name not in mw:
         return []
@@ -558,6 +560,69 @@ def _h5_weights(f, layer_name: str) -> List[np.ndarray]:
         g.visititems(visit)
         return [a for _, a in sorted(out, key=lambda kv: kv[0])]
     return [np.array(g[n]) for n in names]
+
+
+def _snake(name: str) -> str:
+    import re as _re
+    s = _re.sub(r"(.)([A-Z][a-z]+)", r"\1_\2", name)
+    return _re.sub(r"([a-z0-9])([A-Z])", r"\1_\2", s).lower()
+
+
+def _import_keras_v3(path: str):
+    """Keras 3 ``.keras`` archive: zip{config.json, model.weights.h5}.
+
+    The weight store keys layers by SNAKE-CASED CLASS NAME with an
+    occurrence counter ("dense", "dense_1", ...) in declaration order —
+    user layer names do not appear — so the mapping walks the config's
+    layer list rebuilding those keys. Flat ``vars`` groups only (nested
+    wrapper stores raise with the layer key)."""
+    import io as _io
+    import zipfile as _zip
+
+    import h5py
+
+    with _zip.ZipFile(path) as z:
+        cfg = json.loads(z.read("config.json"))
+        weights_data = z.read("model.weights.h5")
+
+    # rebuild the store keys from the config layer order
+    layers_cfg = cfg["config"]["layers"] if isinstance(cfg["config"], dict) \
+        else cfg["config"]
+    counters: Dict[str, int] = {}
+    by_config_name: Dict[str, str] = {}
+    for lcfg in layers_cfg:
+        cls = lcfg["class_name"]
+        if cls == "InputLayer":
+            continue
+        key = _snake(cls)
+        n = counters.get(key, 0)
+        counters[key] = n + 1
+        by_config_name[lcfg["config"]["name"]] = key if n == 0 \
+            else f"{key}_{n}"
+
+    weights: Dict[str, List[np.ndarray]] = {}
+    with h5py.File(_io.BytesIO(weights_data), "r") as f:
+        store = f["layers"] if "layers" in f else f
+        for cfg_name, store_key in by_config_name.items():
+            if store_key not in store:
+                continue
+            g = store[store_key]
+            if "vars" not in g:
+                sub = [k for k in g.keys()]
+                raise ValueError(
+                    f".keras layer store {store_key!r} has no flat vars "
+                    f"group (children: {sub}) — nested wrapper stores "
+                    "are not supported; save as legacy .h5 instead")
+            vs = g["vars"]
+            weights[cfg_name] = [np.array(vs[k])
+                                 for k in sorted(vs.keys(), key=int)]
+
+    cls = cfg["class_name"]
+    if cls == "Sequential":
+        return _import_sequential(cfg, weights)
+    if cls in ("Functional", "Model"):
+        return _import_functional(cfg, weights)
+    raise ValueError(f"unsupported Keras model class {cls!r}")
 
 
 def _inbound_parents(node_spec) -> List[str]:
@@ -601,6 +666,8 @@ class KerasModelImport:
         (Functional), weights copied and ready for inference/fine-tuning."""
         import h5py
 
+        if path.lower().endswith(".keras"):
+            return _import_keras_v3(path)
         with h5py.File(path, "r") as f:
             cfg = json.loads(f.attrs["model_config"])
             cls = cfg["class_name"]

@@ -262,3 +262,48 @@ def test_config_only_import(tmp_path):
                            m.get_weights()[0])
     x = np.random.default_rng(0).normal(size=(2, 6)).astype(np.float32)
     assert np.asarray(net3.output(x)).shape == (2, 3)
+
+
+def test_keras_v3_format_sequential(tmp_path):
+    """Modern .keras archive (zip config + class-keyed weight store)
+    imports with identical predictions."""
+    rng = np.random.default_rng(10)
+    m = tf.keras.Sequential([
+        tf.keras.layers.Input(shape=(8, 8, 3)),
+        tf.keras.layers.Conv2D(6, 3, padding="same", activation="relu",
+                               name="c1"),
+        tf.keras.layers.BatchNormalization(name="bn"),
+        tf.keras.layers.Conv2D(4, 3, name="c2"),
+        tf.keras.layers.GlobalAveragePooling2D(name="gap"),
+        tf.keras.layers.Dense(5, activation="relu", name="d1"),
+        tf.keras.layers.Dense(3, activation="softmax", name="out"),
+    ])
+    for wv in m.weights:
+        vals = rng.normal(scale=0.3, size=wv.shape).astype(np.float32)
+        if "variance" in wv.name:
+            vals = np.abs(vals) + 0.1  # a Gaussian variance would be NaN-bait
+        wv.assign(vals)
+    p = str(tmp_path / "m.keras")
+    m.save(p)
+    net = KerasModelImport.import_keras_model_and_weights(p)
+    x = rng.normal(size=(2, 8, 8, 3)).astype(np.float32)
+    ref = m.predict(x, verbose=0)
+    np.testing.assert_allclose(np.asarray(net.output(x)), ref,
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_keras_v3_format_functional(tmp_path):
+    rng = np.random.default_rng(11)
+    inp = tf.keras.Input(shape=(6,), name="in0")
+    a = tf.keras.layers.Dense(8, activation="tanh", name="a")(inp)
+    b = tf.keras.layers.Dense(8, activation="relu", name="b")(inp)
+    s = tf.keras.layers.Add(name="add")([a, b])
+    out = tf.keras.layers.Dense(2, name="out")(s)
+    m = tf.keras.Model(inp, out)
+    p = str(tmp_path / "f.keras")
+    m.save(p)
+    net = KerasModelImport.import_keras_model_and_weights(p)
+    x = rng.normal(size=(3, 6)).astype(np.float32)
+    ref = m.predict(x, verbose=0)
+    np.testing.assert_allclose(np.asarray(net.output(x)), ref,
+                               rtol=1e-4, atol=1e-4)
