@@ -563,9 +563,12 @@ def _h5_weights(f, layer_name: str) -> List[np.ndarray]:
 
 
 def _snake(name: str) -> str:
+    """keras.src.utils.naming.to_snake_case — note the second pattern is
+    [a-z] WITHOUT digits (Conv2D -> conv2d, not conv2_d)."""
     import re as _re
-    s = _re.sub(r"(.)([A-Z][a-z]+)", r"\1_\2", name)
-    return _re.sub(r"([a-z0-9])([A-Z])", r"\1_\2", s).lower()
+    s = _re.sub(r"\W+", "", name)
+    s = _re.sub(r"(.)([A-Z][a-z]+)", r"\1_\2", s)
+    return _re.sub(r"([a-z])([A-Z])", r"\1_\2", s).lower()
 
 
 def _import_keras_v3(path: str):
@@ -603,6 +606,15 @@ def _import_keras_v3(path: str):
     weights: Dict[str, List[np.ndarray]] = {}
     with h5py.File(_io.BytesIO(weights_data), "r") as f:
         store = f["layers"] if "layers" in f else f
+        unconsumed = set(store.keys()) - set(by_config_name.values())
+        if unconsumed:
+            # a key-derivation mismatch would otherwise leave layers on
+            # their random init SILENTLY (found the hard way: Conv2D vs a
+            # wrong snake-casing)
+            raise ValueError(
+                f".keras weight store entries {sorted(unconsumed)} match "
+                "no config layer — store-key derivation out of sync with "
+                "this keras version")
         for cfg_name, store_key in by_config_name.items():
             if store_key not in store:
                 continue
