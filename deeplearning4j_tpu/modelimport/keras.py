@@ -597,6 +597,7 @@ def _import_keras_v3(path: str):
         cls = lcfg["class_name"]
         if cls == "InputLayer":
             continue
+        # structural layers (Add/Concatenate/...) still occupy store keys
         key = _snake(cls)
         n = counters.get(key, 0)
         counters[key] = n + 1
@@ -606,11 +607,17 @@ def _import_keras_v3(path: str):
     weights: Dict[str, List[np.ndarray]] = {}
     with h5py.File(_io.BytesIO(weights_data), "r") as f:
         store = f["layers"] if "layers" in f else f
-        unconsumed = set(store.keys()) - set(by_config_name.values())
+        def _has_weights(key):
+            g = store[key]
+            return "vars" in g and len(g["vars"]) > 0
+        unconsumed = {k for k in store.keys()
+                      if k not in set(by_config_name.values())
+                      and _has_weights(k)}
         if unconsumed:
             # a key-derivation mismatch would otherwise leave layers on
             # their random init SILENTLY (found the hard way: Conv2D vs a
-            # wrong snake-casing)
+            # wrong snake-casing); empty groups of structural layers are
+            # fine to ignore
             raise ValueError(
                 f".keras weight store entries {sorted(unconsumed)} match "
                 "no config layer — store-key derivation out of sync with "
@@ -619,12 +626,15 @@ def _import_keras_v3(path: str):
             if store_key not in store:
                 continue
             g = store[store_key]
-            if "vars" not in g:
-                sub = [k for k in g.keys()]
-                raise ValueError(
-                    f".keras layer store {store_key!r} has no flat vars "
-                    f"group (children: {sub}) — nested wrapper stores "
-                    "are not supported; save as legacy .h5 instead")
+            if "vars" not in g or len(g["vars"]) == 0:
+                nested = [k for k in g.keys() if k != "vars"]
+                if nested:
+                    raise ValueError(
+                        f".keras layer store {store_key!r} has no flat "
+                        f"vars group (children: {nested}) — nested wrapper "
+                        "stores are not supported; save as legacy .h5 "
+                        "instead")
+                continue  # structural layer: nothing to copy
             vs = g["vars"]
             weights[cfg_name] = [np.array(vs[k])
                                  for k in sorted(vs.keys(), key=int)]
