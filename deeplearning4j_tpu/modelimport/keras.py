@@ -249,6 +249,35 @@ def _map_conv1d(cfg) -> _Mapped:
     return _Mapped(lyr, w)
 
 
+def _map_conv2d_transpose(cfg) -> _Mapped:
+    from ..nn.layers.conv_extra import Deconvolution2D
+    _check_channels_last(cfg, "Conv2DTranspose")
+    pad = cfg.get("padding", "valid")
+    if pad not in ("valid", "same"):
+        raise ValueError(f"Conv2DTranspose padding={pad!r} not supported")
+    if tuple(_pair(cfg.get("dilation_rate", 1))) != (1, 1):
+        raise ValueError("Conv2DTranspose dilation != 1 not supported")
+    if cfg.get("output_padding") not in (None,):
+        raise ValueError("Conv2DTranspose explicit output_padding "
+                         "not supported")
+    lyr = Deconvolution2D(
+        n_out=int(cfg["filters"]), kernel=_pair(cfg["kernel_size"]),
+        stride=_pair(cfg.get("strides", 1)),
+        mode="same" if pad == "same" else "truncate",
+        activation=_act(cfg.get("activation")),
+        has_bias=bool(cfg.get("use_bias", True)), data_format="NHWC")
+
+    def w(ws):
+        # Keras kernel [kH, kW, out, in] -> ours [out, in, kH, kW]
+        kern = np.transpose(np.asarray(ws[0]), (2, 3, 0, 1))
+        out = {"W": kern}
+        if len(ws) > 1:
+            out["b"] = ws[1]
+        return out
+
+    return _Mapped(lyr, w)
+
+
 def _map_conv3d(cfg) -> _Mapped:
     from ..nn.layers.conv3d import Convolution3D
     if cfg.get("data_format", "channels_last") != "channels_last":
@@ -418,6 +447,7 @@ _MAPPERS: Dict[str, Callable[[dict], _Mapped]] = {
     "SimpleRNN": _map_simple_rnn,
     "Bidirectional": _map_bidirectional,
     "Conv1D": _map_conv1d,
+    "Conv2DTranspose": lambda c: _map_conv2d_transpose(c),
     "Conv3D": _map_conv3d,
     "MaxPooling1D": lambda c: _map_pool1d(c, "max"),
     "AveragePooling1D": lambda c: _map_pool1d(c, "avg"),

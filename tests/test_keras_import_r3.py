@@ -307,3 +307,17 @@ def test_keras_v3_format_functional(tmp_path):
     ref = m.predict(x, verbose=0)
     np.testing.assert_allclose(np.asarray(net.output(x)), ref,
                                rtol=1e-4, atol=1e-4)
+
+
+@pytest.mark.parametrize("padding,strides", [("same", 2), ("valid", 2),
+                                             ("valid", 1)])
+def test_conv2d_transpose(tmp_path, padding, strides):
+    rng = np.random.default_rng(12)
+    m = tf.keras.Sequential([
+        tf.keras.layers.Input(shape=(5, 5, 3)),
+        tf.keras.layers.Conv2DTranspose(4, 3, strides=strides,
+                                        padding=padding,
+                                        activation="relu", name="up"),
+        tf.keras.layers.Conv2D(2, 3, padding="same", name="c"),
+    ])
+    _roundtrip(m, tmp_path, rng.normal(size=(2, 5, 5, 3)).astype(np.float32))
