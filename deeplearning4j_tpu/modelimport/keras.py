@@ -16,13 +16,18 @@ NHWC (channels_last) because that is also the TPU-preferred layout — no
 transpose at the data boundary at all. Handles the Keras 2 ("keras_version"
 2.x h5) and Keras 3 ("legacy h5" writer) flavors of the format.
 
-Supported layer classes: InputLayer, Dense, Conv2D, SeparableConv2D*,
-MaxPooling2D, AveragePooling2D, GlobalAveragePooling2D, GlobalMaxPooling2D,
-BatchNormalization, Dropout, Flatten, Activation, ReLU, LeakyReLU, Softmax,
-ZeroPadding2D, UpSampling2D, Embedding, LSTM, SimpleRNN, Add, Concatenate
-(*when the corresponding layer exists in nn/layers). Unsupported classes
-raise with the class name so coverage gaps are loud, mirroring the
-reference's UnsupportedKerasConfigurationException.
+Formats: legacy .h5 (Keras 2 and Keras 3 legacy writer), the modern
+.keras v3 zip archive, and config-only import
+(importKerasModelConfiguration parity). ~45 layer classes: the 2D conv
+family (Conv2D/Transpose/Separable/Depthwise, poolings, BN,
+zero-pad/crop/upsample), Conv1D + 1D poolings, Conv3D, Dense/Embedding/
+Flatten/Dropout family/activation layers incl. LayerNormalization and
+PReLU/ELU/ReLU variants, LSTM/GRU (both reset_after)/SimpleRNN/
+Bidirectional (all merge modes + return_sequences=False semantics),
+merge layers (Add/Subtract/Multiply/Maximum/Average/Concatenate), and
+Lambda + custom-layer registration hooks. Unsupported classes raise with
+the class name so coverage gaps are loud, mirroring the reference's
+UnsupportedKerasConfigurationException.
 """
 
 from __future__ import annotations
