@@ -188,6 +188,46 @@ def _pool(node, ctx, at):
                        attrs=attrs)
 
 
+@onnx_op("LeakyRelu")
+def _leaky_onnx(node, ctx, at):
+    return ctx.sd.call("act.leakyrelu", ctx.get(node.input[0]),
+                       name=node.output[0],
+                       attrs={"alpha": float(at.get("alpha", 0.01))})
+
+
+@onnx_op("PRelu")
+def _prelu_onnx(node, ctx, at):
+    # slope broadcasts per ONNX; a scalar/1-elem slope == leakyrelu,
+    # a [C] slope multiplies the negative part elementwise
+    x = ctx.get(node.input[0])
+    slope = ctx.get(node.input[1])
+    neg = ctx.sd.call("math.minimum", x, ctx.sd._lift(np.float32(0.0)))
+    pos = ctx.sd.call("math.maximum", x, ctx.sd._lift(np.float32(0.0)))
+    scaled = ctx.sd.call("math.mul", neg, slope)
+    return ctx.sd.call("math.add", pos, scaled, name=node.output[0])
+
+
+@onnx_op("Clip")
+def _clip_onnx(node, ctx, at):
+    # opset-11+: min/max as optional inputs; opset-6: attributes
+    def bound(idx, attr, default):
+        if len(node.input) > idx and node.input[idx]:
+            return float(np.asarray(ctx.consts[node.input[idx]]).reshape(()))
+        return float(at.get(attr, default))
+    lo = bound(1, "min", -3.4e38)
+    hi = bound(2, "max", 3.4e38)
+    return ctx.sd.call("math.clip", ctx.get(node.input[0]),
+                       name=node.output[0],
+                       attrs={"min_value": lo, "max_value": hi})
+
+
+@onnx_op("GlobalMaxPool")
+def _gmp(node, ctx, at):
+    return ctx.sd.call("reduce.max", ctx.get(node.input[0]),
+                       name=node.output[0],
+                       attrs={"axis": (2, 3), "keepdims": True})
+
+
 @onnx_op("GlobalAveragePool")
 def _gap(node, ctx, at):
     return ctx.sd.call("reduce.mean", ctx.get(node.input[0]),

@@ -269,3 +269,39 @@ def test_onnx_slice_negative_axis():
     x = np.arange(10, dtype=np.float32).reshape(2, 5)
     out = sd.output({"x": x}, ["y"])["y"]
     np.testing.assert_allclose(out, x[:, 1:4])
+
+
+def test_onnx_leaky_prelu_clip_globalmaxpool():
+    rng = np.random.default_rng(6)
+    m = P.ModelProto()
+    g = m.graph
+    g.input.append(_io("x", [2, 3, 4, 4]))
+    slope = P.TensorProto()
+    slope.name = "slope"
+    slope.dims.extend([3, 1, 1])
+    slope.data_type = 1
+    sl = np.asarray([0.1, 0.2, 0.3], np.float32).reshape(3, 1, 1)
+    slope.raw_data = sl.tobytes()
+    g.initializer.append(slope)
+    a = P.AttributeProto()
+    a.name = "alpha"
+    a.type = 1
+    a.f = 0.2
+    _node(g, "LeakyRelu", ["x"], ["l"], [a])
+    _node(g, "PRelu", ["l", "slope"], ["p"])
+    mn = P.TensorProto(); mn.name = "mn"; mn.data_type = 1
+    mn.raw_data = np.asarray(-0.5, np.float32).tobytes()
+    mx = P.TensorProto(); mx.name = "mx"; mx.data_type = 1
+    mx.raw_data = np.asarray(0.5, np.float32).tobytes()
+    g.initializer.extend([mn, mx])
+    _node(g, "Clip", ["p", "mn", "mx"], ["c"])
+    _node(g, "GlobalMaxPool", ["c"], ["y"])
+    g.output.append(_io("y", []))
+    sd = OnnxFrameworkImporter.import_model_proto(m.SerializeToString())
+    x = rng.normal(size=(2, 3, 4, 4)).astype(np.float32)
+    l = np.where(x >= 0, x, 0.2 * x)
+    pr = np.maximum(l, 0) + np.minimum(l, 0) * sl[None]
+    c = np.clip(pr, -0.5, 0.5)
+    ref = c.max(axis=(2, 3), keepdims=True)
+    out = sd.output({"x": x}, ["y"])["y"]
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
