@@ -202,6 +202,18 @@ def _map_binary(node, ctx, ins):
                        name=node.name)
 
 
+@tf_op("AddN")
+def _add_n(node, ctx, ins):
+    out = ctx.get(ins[0])
+    for i in ins[1:-1]:
+        out = ctx.sd.call("math.add", out, ctx.get(i))
+    if len(ins) > 1:
+        out = ctx.sd.call("math.add", out, ctx.get(ins[-1]),
+                          name=node.name)
+        return out
+    return ctx.sd.call("act.identity", out, name=node.name)
+
+
 @tf_op("MatMul")
 def _matmul(node, ctx, ins):
     return ctx.sd.call("linalg.mmul", ctx.get(ins[0]), ctx.get(ins[1]),

@@ -212,3 +212,14 @@ def test_resize_bilinear():
                                            name="x"))
     with pytest.raises(ValueError, match="half_pixel_centers"):
         TensorflowFrameworkImporter.import_graph_def(gd)
+
+
+def test_add_n():
+    @tf.function
+    def f(x):
+        return tf.add_n([x, x * 2.0, x * 3.0])
+
+    x = np.arange(4, dtype=np.float32)
+    ref, got = _roundtrip(f, {"x": x},
+                          [tf.TensorSpec([4], tf.float32, name="x")])
+    np.testing.assert_allclose(got, ref, rtol=1e-6)
