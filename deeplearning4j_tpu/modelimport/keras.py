@@ -144,9 +144,21 @@ def _map_bn(cfg) -> _Mapped:
     return _Mapped(lyr, w)
 
 
+def _check_go_backwards(cfg, cls):
+    # go_backwards reverses the scan direction; importing it as a forward
+    # RNN would be silently wrong (a standalone reversed layer has no
+    # forward twin to pair with, unlike inside Bidirectional where Keras
+    # sets it on the backward copy and the wrapper handles the flip).
+    if cfg.get("go_backwards"):
+        raise ValueError(
+            f"standalone {cls} with go_backwards=True not supported "
+            "(wrap in Bidirectional or reverse the time axis upstream)")
+
+
 def _map_lstm(cfg) -> _Mapped:
     if cfg.get("return_state"):
         raise ValueError("LSTM return_state not supported in import")
+    _check_go_backwards(cfg, "LSTM")
     if _act(cfg.get("activation", "tanh")) != "tanh" or \
             cfg.get("recurrent_activation", "sigmoid") != "sigmoid":
         # hard_sigmoid gates would silently change the cell math — our
@@ -176,6 +188,7 @@ def _map_lstm(cfg) -> _Mapped:
 def _map_gru(cfg) -> _Mapped:
     if cfg.get("return_state"):
         raise ValueError("GRU return_state not supported in import")
+    _check_go_backwards(cfg, "GRU")
     if _act(cfg.get("activation", "tanh")) != "tanh" or \
             cfg.get("recurrent_activation", "sigmoid") != "sigmoid":
         raise ValueError("only tanh/sigmoid GRU variants import")
@@ -203,7 +216,34 @@ def _map_bidirectional(cfg) -> _Mapped:
     if inner_cls not in ("LSTM", "GRU", "SimpleRNN"):
         raise ValueError(
             f"Bidirectional around {inner_cls!r} not supported")
-    inner = _MAPPERS[inner_cls](inner_cfg["config"])
+    fwd_cfg = dict(inner_cfg["config"])
+    if fwd_cfg.get("go_backwards"):
+        # cfg["layer"] is the FORWARD layer; go_backwards=True here means
+        # the user swapped the scan directions — importing as the mirrored
+        # default would silently swap the output streams
+        raise ValueError(
+            "Bidirectional with go_backwards=True on the forward layer "
+            "not supported")
+    bwd = cfg.get("backward_layer")
+    if bwd is not None:
+        # Keras 3 always serializes the backward copy; accept only the
+        # mirrored default (identical config up to name + flipped
+        # go_backwards) and raise loudly on a genuinely custom one
+        def norm(c):
+            c = dict(c)
+            c.pop("name", None)
+            c.pop("go_backwards", None)
+            return c
+        bwd_cfg = dict(bwd.get("config", {}))
+        if (bwd.get("class_name") != inner_cls
+                or not bwd_cfg.get("go_backwards", False)
+                or norm(bwd_cfg) != norm(fwd_cfg)):
+            raise ValueError(
+                "Bidirectional with a non-mirrored backward_layer config "
+                "is not supported (only the default mirrored form)")
+    inner_imp = dict(fwd_cfg)
+    inner_imp.pop("go_backwards", None)  # mirrored default: wrapper owns it
+    inner = _MAPPERS[inner_cls](inner_imp)
     merge = {"concat": "concat", "sum": "add", "mul": "mul",
              "ave": "average"}.get(cfg.get("merge_mode", "concat"))
     if merge is None:
@@ -382,6 +422,7 @@ def _map_lambda(cfg) -> _Mapped:
 
 
 def _map_simple_rnn(cfg) -> _Mapped:
+    _check_go_backwards(cfg, "SimpleRNN")
     lyr = SimpleRnn(n_out=int(cfg["units"]),
                     activation=_act(cfg.get("activation", "tanh")))
     u = int(cfg["units"])

@@ -209,13 +209,26 @@ def _prelu_onnx(node, ctx, at):
 
 @onnx_op("Clip")
 def _clip_onnx(node, ctx, at):
-    # opset-11+: min/max as optional inputs; opset-6: attributes
-    def bound(idx, attr, default):
+    # opset-11+: min/max as optional inputs; opset-6: attributes.
+    # Absent bounds mean "no bound" (not ±3.4e38, which would clip
+    # legitimate float64 values); runtime (non-initializer) bounds are
+    # unsupported and must raise the named error, not a bare KeyError.
+    def bound(idx, attr):
         if len(node.input) > idx and node.input[idx]:
-            return float(np.asarray(ctx.consts[node.input[idx]]).reshape(()))
-        return float(at.get(attr, default))
-    lo = bound(1, "min", -3.4e38)
-    hi = bound(2, "max", 3.4e38)
+            name = node.input[idx]
+            if name not in ctx.consts:
+                raise ValueError(
+                    f"Clip with runtime (non-initializer) {attr} input "
+                    f"{name!r} not supported")
+            return float(np.asarray(ctx.consts[name]).reshape(()))
+        return float(at[attr]) if attr in at else None
+    lo = bound(1, "min")
+    hi = bound(2, "max")
+    if lo is None and hi is None:
+        return ctx.sd.call("act.identity", ctx.get(node.input[0]),
+                           name=node.output[0])
+    lo = -np.inf if lo is None else lo
+    hi = np.inf if hi is None else hi
     return ctx.sd.call("math.clip", ctx.get(node.input[0]),
                        name=node.output[0],
                        attrs={"min_value": lo, "max_value": hi})

@@ -88,14 +88,38 @@ class ParallelWrapper:
             raise ValueError(f"model_axis {model_axis!r} not in mesh axes "
                              f"{self.mesh.axis_names}")
         self._step = None
+        self._dense_key_cache = None
         from ..nn.graph import ComputationGraph
         self._is_graph = isinstance(model, ComputationGraph)
+
+    def _dense_keys(self) -> set:
+        """Top-level param keys (layer index / vertex name) whose layer is
+        in the dense family — the only layers TP shards. Matching on the
+        leaf name 'W' alone would also catch embedding tables and LSTM/GRU
+        input kernels, whose per-step collectives hurt the TP path."""
+        from ..nn.layers.core import (DenseLayer, LossLayer, OutputLayer)
+        dense = (DenseLayer, OutputLayer, LossLayer)
+        keys = set()
+        if self._is_graph:
+            from ..nn.vertices import LayerVertex
+            for name, v, _ in self.model.conf.vertices:
+                if isinstance(v, LayerVertex) and isinstance(v.layer, dense):
+                    keys.add(str(name))
+        else:
+            for i, lyr in enumerate(self.model.layers):
+                if isinstance(lyr, dense):
+                    keys.add(str(i))
+        return keys
 
     def _param_spec(self, path: tuple, arr) -> P:
         """PartitionSpec for one parameter leaf under tensor parallelism."""
         if self.model_axis is None:
             return P()
-        name = path[-1] if path else ""
+        if self._dense_key_cache is None:
+            self._dense_key_cache = self._dense_keys()
+        if not path or str(path[0]) not in self._dense_key_cache:
+            return P()
+        name = path[-1]
         if name == "W" and getattr(arr, "ndim", 0) == 2:
             return P(None, self.model_axis)     # dense kernel: shard out-dim
         if name == "b" and getattr(arr, "ndim", 0) == 1:
@@ -239,7 +263,8 @@ def _synth_pad_feature_mask(x, pad):
     exclude the padded rows: per-timestep [B,T] for sequence inputs,
     per-example [B] otherwise. ``x`` is already zero-padded by ``pad``."""
     fm = np.ones(x.shape[:2] if x.ndim == 3 else (x.shape[0],), np.float32)
-    fm[-pad:] = 0.0
+    if pad:  # fm[-0:] would zero the ENTIRE mask
+        fm[-pad:] = 0.0
     return fm
 
 

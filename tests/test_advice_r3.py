@@ -1,0 +1,168 @@
+"""Regression tests for round-3 advisor findings (ADVICE.md).
+
+Fast suite: these exercise mapper/helper logic directly, no live tf/torch.
+"""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.modelimport import keras as kimp
+from deeplearning4j_tpu.parallel.data_parallel import _synth_pad_feature_mask
+
+
+def test_go_backwards_lstm_raises():
+    with pytest.raises(ValueError, match="go_backwards"):
+        kimp._map_lstm({"units": 4, "go_backwards": True})
+
+
+def test_go_backwards_gru_raises():
+    with pytest.raises(ValueError, match="go_backwards"):
+        kimp._map_gru({"units": 4, "go_backwards": True})
+
+
+def test_go_backwards_simple_rnn_raises():
+    with pytest.raises(ValueError, match="go_backwards"):
+        kimp._map_simple_rnn({"units": 4, "go_backwards": True})
+
+
+def test_bidirectional_non_mirrored_backward_layer_raises():
+    cfg = {
+        "layer": {"class_name": "LSTM", "config": {"units": 4}},
+        "backward_layer": {"class_name": "LSTM",
+                           "config": {"units": 8, "go_backwards": True}},
+    }
+    with pytest.raises(ValueError, match="non-mirrored"):
+        kimp._map_bidirectional(cfg)
+
+
+def test_bidirectional_keras3_mirrored_backward_layer_accepted():
+    # Keras 3 ALWAYS serializes backward_layer; the mirrored default
+    # differs from the forward config only in name + flipped go_backwards
+    # and must import fine
+    cfg = {
+        "layer": {"class_name": "LSTM",
+                  "config": {"units": 4, "name": "forward_lstm",
+                             "go_backwards": False}},
+        "backward_layer": {"class_name": "LSTM",
+                           "config": {"units": 4, "name": "backward_lstm",
+                                      "go_backwards": True}},
+    }
+    mapped = kimp._map_bidirectional(cfg)
+    assert mapped.layer is not None
+
+
+def test_bidirectional_forward_go_backwards_raises():
+    # go_backwards=True on the FORWARD layer swaps the scan directions;
+    # importing it as the mirrored default would be silently wrong
+    cfg = {"layer": {"class_name": "LSTM",
+                     "config": {"units": 4, "go_backwards": True}}}
+    with pytest.raises(ValueError, match="go_backwards"):
+        kimp._map_bidirectional(cfg)
+
+
+def test_synth_pad_mask_pad_zero_keeps_everything():
+    x = np.ones((6, 3), np.float32)
+    fm = _synth_pad_feature_mask(x, 0)
+    assert fm.sum() == 6.0
+
+
+def test_synth_pad_mask_pads_tail():
+    x = np.ones((6, 3), np.float32)
+    fm = _synth_pad_feature_mask(x, 2)
+    assert fm.tolist() == [1, 1, 1, 1, 0, 0]
+
+
+class _FakeNode:
+    def __init__(self, inputs, outputs):
+        self.input = inputs
+        self.output = outputs
+        self.op_type = "Clip"
+
+
+class _FakeSd:
+    def __init__(self):
+        self.calls = []
+
+    def call(self, op, *a, **kw):
+        self.calls.append((op, kw.get("attrs")))
+        return "out"
+
+
+class _FakeCtx:
+    def __init__(self, consts):
+        self.consts = consts
+        self.sd = _FakeSd()
+
+    def get(self, name):
+        return name
+
+
+def test_clip_runtime_bound_raises_named_error():
+    from deeplearning4j_tpu.modelimport.onnx import _clip_onnx
+    node = _FakeNode(["x", "runtime_min"], ["y"])
+    ctx = _FakeCtx(consts={})
+    with pytest.raises(ValueError, match="runtime"):
+        _clip_onnx(node, ctx, {})
+
+
+def test_clip_no_bounds_is_identity_not_3e38():
+    from deeplearning4j_tpu.modelimport.onnx import _clip_onnx
+    node = _FakeNode(["x"], ["y"])
+    ctx = _FakeCtx(consts={})
+    _clip_onnx(node, ctx, {})
+    op, attrs = ctx.sd.calls[0]
+    assert op == "act.identity"
+
+
+def test_clip_single_bound_uses_inf_for_missing():
+    from deeplearning4j_tpu.modelimport.onnx import _clip_onnx
+    node = _FakeNode(["x", "lo"], ["y"])
+    ctx = _FakeCtx(consts={"lo": np.float32(0.0)})
+    _clip_onnx(node, ctx, {})
+    op, attrs = ctx.sd.calls[0]
+    assert op == "math.clip"
+    assert attrs["min_value"] == 0.0 and attrs["max_value"] == np.inf
+
+
+def test_tp_dense_only_sharding_graph_engine():
+    # tensor-parallel sharding must consult the layer kind: dense/output
+    # kernels shard over the model axis, LSTM/embedding kernels replicate —
+    # and the ComputationGraph path must not crash (conf.vertices)
+    from deeplearning4j_tpu.nn.config import NeuralNetConfiguration, InputType
+    from deeplearning4j_tpu.nn.layers.core import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.layers.recurrent import LSTM
+    from deeplearning4j_tpu.nn.model import MultiLayerNetwork
+    from deeplearning4j_tpu.parallel.data_parallel import (ParallelWrapper,
+                                                           make_dp_tp_mesh)
+
+    cfg = (NeuralNetConfiguration.builder().seed(1)
+           .input_type(InputType.recurrent(5))
+           .list(LSTM(n_out=8),
+                 DenseLayer(n_out=8, activation="relu"),
+                 OutputLayer(n_out=4, loss="mcxent"))
+           .build())
+    net = MultiLayerNetwork(cfg).init()
+    pw = ParallelWrapper(net, mesh=make_dp_tp_mesh(4, 2), model_axis="model")
+    specs = {}
+    from jax.tree_util import tree_map_with_path
+    def rec(path, a):
+        names = tuple(str(getattr(k, "key", k)) for k in path)
+        specs[names] = pw._param_spec(names, a)
+        return a
+    tree_map_with_path(rec, net.params)
+    # LSTM (layer 0) kernels replicate; dense/output kernels shard
+    assert specs[("0", "W")] == ()  # P() == empty tuple semantics
+    assert tuple(specs[("1", "W")]) == (None, "model")
+    assert tuple(specs[("2", "W")]) == (None, "model")
+
+
+def test_tp_dense_keys_graph_conf_vertices():
+    from deeplearning4j_tpu.models.lenet import lenet
+    from deeplearning4j_tpu.parallel.data_parallel import (ParallelWrapper,
+                                                           make_dp_tp_mesh)
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+    net = lenet().init()
+    if isinstance(net, ComputationGraph):
+        pw = ParallelWrapper(net, mesh=make_dp_tp_mesh(4, 2),
+                             model_axis="model")
+        assert isinstance(pw._dense_keys(), set)
