@@ -91,7 +91,11 @@ def main():
         dt_median = times[len(times) // 2] / (k * nsteps)
         return net, dt, dt_median, final_loss
 
-    batch = 128
+    # Batch 256 (r4): interleaved A/B on the real chip measured ~17%
+    # relative MFU gain over 128 — per-step fixed costs (BN moment chains,
+    # scheduling bubbles) amortize over 2x examples while the convs stay
+    # MXU-bound. OOM fallback halves back toward 128.
+    batch = 256
     while True:
         try:
             net, step_time, step_time_median, final_loss = run(batch)

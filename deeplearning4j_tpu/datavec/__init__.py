@@ -17,3 +17,5 @@ from .iterator import (RecordReaderDataSetIterator,  # noqa: F401
 from .image import (CenterCropImageTransform, FlipImageTransform,  # noqa: F401
                     ImageRecordReader, PipelineImageTransform,
                     RandomCropImageTransform, ResizeImageTransform)
+from .text import (BagOfWordsVectorizer, TfidfVectorizer,  # noqa: F401
+                   mel_filterbank, mfcc)

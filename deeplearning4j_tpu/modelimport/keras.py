@@ -452,10 +452,11 @@ def _map_zeropad(cfg) -> _Mapped:
     else:
         ph, pw = p
         if isinstance(ph, (list, tuple)):
-            if ph[0] != ph[1] or pw[0] != pw[1]:
-                raise ValueError("asymmetric ZeroPadding2D not supported")
-            ph, pw = ph[0], pw[0]
-        pad = (int(ph), int(pw))
+            # ((top,bottom),(left,right)) — legacy ResNet/Inception exports
+            # routinely pad (0,1); the layer takes the nested form verbatim
+            pad = ((int(ph[0]), int(ph[1])), (int(pw[0]), int(pw[1])))
+        else:
+            pad = (int(ph), int(pw))
     return _Mapped(ZeroPadding2D(padding=pad, data_format="NHWC"))
 
 
@@ -515,7 +516,237 @@ _MAPPERS: Dict[str, Callable[[dict], _Mapped]] = {
     "GaussianDropout": lambda c: _map_special(
         "GaussianDropout", rate=float(c["rate"])),
     "Cropping2D": lambda c: _map_cropping(c),
+    # ---- round-4 tail: seq2seq staples, 1D/3D variants, wrappers --------
+    "Permute": lambda c: _map_structural("PermuteLayer",
+                                         dims=tuple(int(d) for d in c["dims"])),
+    "Reshape": lambda c: _map_structural(
+        "ReshapeLayer", target_shape=tuple(int(t) for t in c["target_shape"])),
+    "Masking": lambda c: _map_structural(
+        "MaskingLayer", mask_value=float(c.get("mask_value", 0.0))),
+    "RepeatVector": lambda c: _map_wrapper("RepeatVector", n=int(c["n"])),
+    "TimeDistributed": lambda c: _map_time_distributed(c),
+    "ConvLSTM2D": lambda c: _map_convlstm2d(c),
+    "SeparableConv1D": lambda c: _map_separable1d(c),
+    "AlphaDropout": lambda c: _map_special(
+        "AlphaDropout", rate=float(c["rate"])),
+    "ThresholdedReLU": lambda c: _Mapped(ActivationLayer(
+        activation="thresholdedrelu", alpha=float(c.get("theta", 1.0)))),
+    "SpatialDropout1D": lambda c: _map_special(
+        "SpatialDropout", rate=float(c["rate"]), data_format="NWC"),
+    "SpatialDropout3D": lambda c: _map_special(
+        "SpatialDropout", rate=float(c["rate"]), data_format="NDHWC"),
+    "Cropping1D": lambda c: _map_crop1d(c),
+    "ZeroPadding1D": lambda c: _map_pad1d(c),
+    "UpSampling1D": lambda c: _map_upsampling1d(c),
+    "Cropping3D": lambda c: _map_3d_symmetric("Cropping3D", "cropping", c),
+    "ZeroPadding3D": lambda c: _map_3d_symmetric(
+        "ZeroPadding3DLayer", "padding", c),
+    "UpSampling3D": lambda c: _map_upsampling3d(c),
+    "MaxPooling3D": lambda c: _map_pool3d(c, "max"),
+    "AveragePooling3D": lambda c: _map_pool3d(c, "avg"),
+    "GlobalAveragePooling3D": lambda c: _Mapped(
+        GlobalPoolingLayer(pool_type="avg", data_format="NDHWC")),
+    "GlobalMaxPooling3D": lambda c: _Mapped(
+        GlobalPoolingLayer(pool_type="max", data_format="NDHWC")),
+    "LocallyConnected1D": lambda c: _map_locally_connected1d(c),
+    "LocallyConnected2D": lambda c: _map_locally_connected2d(c),
 }
+
+
+def _map_structural(cls_name: str, **kw) -> _Mapped:
+    from ..nn.layers import core as _core_layers
+    return _Mapped(getattr(_core_layers, cls_name)(**kw))
+
+
+def _map_wrapper(cls_name: str, **kw) -> _Mapped:
+    from ..nn.layers import wrappers as _wrap
+    return _Mapped(getattr(_wrap, cls_name)(**kw))
+
+
+def _map_time_distributed(cfg) -> _Mapped:
+    from ..nn.layers.wrappers import TimeDistributed
+    inner_cfg = cfg["layer"]
+    inner_cls = inner_cfg["class_name"]
+    if inner_cls not in _MAPPERS:
+        raise ValueError(
+            f"TimeDistributed around unmapped layer {inner_cls!r}")
+    inner = _MAPPERS[inner_cls](inner_cfg["config"])
+    if inner.vertex is not None:
+        raise ValueError(
+            f"TimeDistributed around recurrent layer {inner_cls!r} "
+            "not supported")
+    return _Mapped(TimeDistributed(layer=inner.layer), inner.weights)
+
+
+def _map_convlstm2d(cfg) -> _Mapped:
+    from ..nn.layers.recurrent import ConvLSTM2D
+    _check_go_backwards(cfg, "ConvLSTM2D")
+    if cfg.get("data_format", "channels_last") != "channels_last":
+        raise ValueError("ConvLSTM2D channels_first not supported")
+    if cfg.get("stateful"):
+        raise ValueError("stateful ConvLSTM2D not supported in import")
+    if tuple(_pair(cfg.get("dilation_rate", 1))) != (1, 1):
+        raise ValueError("dilated ConvLSTM2D not supported")
+    if cfg.get("return_state"):
+        raise ValueError("ConvLSTM2D return_state not supported in import")
+    act = _act(cfg.get("activation", "tanh"))
+    gate = {"sigmoid": "sigmoid", "hard_sigmoid": "hardsigmoid"}.get(
+        cfg.get("recurrent_activation", "hard_sigmoid"))
+    if act != "tanh" or gate is None:
+        raise ValueError("only tanh/(hard_)sigmoid ConvLSTM2D variants "
+                         "import")
+    pad = cfg.get("padding", "valid")
+    if pad not in ("valid", "same"):
+        raise ValueError(f"ConvLSTM2D padding={pad!r} not supported")
+    f = int(cfg["filters"])
+    lyr = ConvLSTM2D(
+        n_out=f, kernel=_pair(cfg["kernel_size"]),
+        stride=_pair(cfg.get("strides", 1)),
+        mode="same" if pad == "same" else "truncate",
+        return_sequences=bool(cfg.get("return_sequences", False)),
+        activation="tanh", gate_activation=gate)
+
+    def w(ws):
+        def reorder(m):  # Keras gates [i,f,c,o] -> ours [i,f,o,g]
+            blocks = np.split(np.asarray(m), 4, axis=-1)
+            return np.concatenate([blocks[0], blocks[1], blocks[3],
+                                   blocks[2]], axis=-1)
+        # [kh,kw,cin,4f] -> OIHW [4f,cin,kh,kw]
+        k = np.transpose(reorder(ws[0]), (3, 2, 0, 1))
+        rk = np.transpose(reorder(ws[1]), (3, 2, 0, 1))
+        b = reorder(ws[2]) if len(ws) > 2 else np.zeros(4 * f, np.float32)
+        return {"W": k, "RW": rk, "b": b}
+    return _Mapped(lyr, w)
+
+
+def _map_separable1d(cfg) -> _Mapped:
+    from ..nn.layers.conv_extra import SeparableConvolution1D
+    if cfg.get("data_format", "channels_last") != "channels_last":
+        raise ValueError("SeparableConv1D channels_first not supported")
+    pad = cfg.get("padding", "valid")
+    if pad not in ("valid", "same"):
+        raise ValueError(f"SeparableConv1D padding={pad!r} not supported")
+    lyr = SeparableConvolution1D(
+        n_out=int(cfg["filters"]), kernel=int(_one(cfg["kernel_size"])),
+        stride=int(_one(cfg.get("strides", 1))),
+        dilation=int(_one(cfg.get("dilation_rate", 1))),
+        depth_multiplier=int(cfg.get("depth_multiplier", 1)),
+        mode="same" if pad == "same" else "truncate",
+        activation=_act(cfg.get("activation")),
+        has_bias=bool(cfg.get("use_bias", True)))
+
+    def w(ws):
+        dk = np.asarray(ws[0])             # [k, cin, mult]
+        k, cin, mult = dk.shape
+        dw = dk.transpose(1, 2, 0).reshape(cin * mult, 1, 1, k)
+        pw = np.asarray(ws[1])             # [1, cin*mult, out]
+        pw = pw.transpose(2, 1, 0)[:, :, :, None]  # [out, cin*mult, 1, 1]
+        out = {"dW": dw, "pW": pw}
+        if cfg.get("use_bias", True):
+            out["b"] = ws[2]
+        return out
+    return _Mapped(lyr, w)
+
+
+def _map_crop1d(cfg) -> _Mapped:
+    from ..nn.layers.conv_extra import Cropping1D
+    cr = cfg["cropping"]
+    lo, hi = (cr, cr) if isinstance(cr, int) else (int(cr[0]), int(cr[1]))
+    return _Mapped(Cropping1D(cropping=(lo, hi)))
+
+
+def _map_pad1d(cfg) -> _Mapped:
+    from ..nn.layers.conv_extra import ZeroPadding1DLayer
+    p = cfg["padding"]
+    lo, hi = (p, p) if isinstance(p, int) else (int(p[0]), int(p[1]))
+    return _Mapped(ZeroPadding1DLayer(padding=(lo, hi)))
+
+
+def _map_upsampling1d(cfg) -> _Mapped:
+    from ..nn.layers.conv_extra import Upsampling1D
+    return _Mapped(Upsampling1D(size=int(cfg.get("size", 2))))
+
+
+def _map_3d_symmetric(cls_name: str, field: str, cfg) -> _Mapped:
+    from ..nn.layers import conv3d as _c3d
+    v = cfg["cropping" if field == "cropping" else "padding"]
+    if isinstance(v, int):
+        triple = (v, v, v)
+    else:
+        triple = []
+        for pair in v:
+            if isinstance(pair, (list, tuple)):
+                if pair[0] != pair[1]:
+                    raise ValueError(
+                        f"asymmetric {cls_name} {field} {v} not supported")
+                triple.append(int(pair[0]))
+            else:
+                triple.append(int(pair))
+        triple = tuple(triple)
+    return _Mapped(getattr(_c3d, cls_name)(
+        **{field: triple}, data_format="NDHWC"))
+
+
+def _map_upsampling3d(cfg) -> _Mapped:
+    from ..nn.layers.conv3d import Upsampling3D
+    s = cfg.get("size", 2)
+    size = (s, s, s) if isinstance(s, int) else tuple(int(v) for v in s)
+    return _Mapped(Upsampling3D(size=size, data_format="NDHWC"))
+
+
+def _map_pool3d(cfg, pool_type: str) -> _Mapped:
+    from ..nn.layers.conv3d import Subsampling3DLayer
+    if cfg.get("data_format", "channels_last") != "channels_last":
+        raise ValueError("Pooling3D channels_first not supported")
+    pad = cfg.get("padding", "valid")
+    if pad not in ("valid", "same"):
+        raise ValueError(f"Pooling3D padding={pad!r} not supported")
+    k = cfg.get("pool_size", 2)
+    kernel = (k, k, k) if isinstance(k, int) else tuple(int(v) for v in k)
+    s = cfg.get("strides") or kernel
+    stride = (s, s, s) if isinstance(s, int) else tuple(int(v) for v in s)
+    return _Mapped(Subsampling3DLayer(
+        kernel=kernel, stride=stride, pool_type=pool_type,
+        mode="same" if pad == "same" else "truncate", data_format="NDHWC"))
+
+
+def _map_locally_connected2d(cfg) -> _Mapped:
+    from ..nn.layers.conv_extra import LocallyConnected2D
+    if cfg.get("data_format", "channels_last") != "channels_last":
+        raise ValueError("LocallyConnected2D channels_first not supported")
+    if cfg.get("padding", "valid") != "valid":
+        raise ValueError("LocallyConnected2D padding='same' not supported "
+                         "(Keras only supports 'valid' either)")
+    lyr = LocallyConnected2D(
+        n_out=int(cfg["filters"]), kernel=_pair(cfg["kernel_size"]),
+        stride=_pair(cfg.get("strides", 1)),
+        activation=_act(cfg.get("activation")),
+        has_bias=bool(cfg.get("use_bias", True)))
+
+    def w(ws):
+        out = {"W": np.asarray(ws[0])}   # [P, khkwC, F] matches ours
+        if cfg.get("use_bias", True):
+            out["b"] = np.asarray(ws[1]).reshape(-1, out["W"].shape[-1])
+        return out
+    return _Mapped(lyr, w)
+
+
+def _map_locally_connected1d(cfg) -> _Mapped:
+    from ..nn.layers.conv_extra import LocallyConnected1D
+    if cfg.get("padding", "valid") != "valid":
+        raise ValueError("LocallyConnected1D padding='same' not supported")
+    lyr = LocallyConnected1D(
+        n_out=int(cfg["filters"]), kernel=int(_one(cfg["kernel_size"])),
+        stride=int(_one(cfg.get("strides", 1))),
+        activation=_act(cfg.get("activation")),
+        has_bias=bool(cfg.get("use_bias", True)))
+
+    def w(ws):
+        out = {"W": np.asarray(ws[0])}   # [To, k*F, F_out] matches ours
+        if cfg.get("use_bias", True):
+            out["b"] = np.asarray(ws[1])
+        return out
+    return _Mapped(lyr, w)
 
 
 def _map_special(cls_name: str, **kw) -> _Mapped:

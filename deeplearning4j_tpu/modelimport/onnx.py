@@ -76,6 +76,7 @@ class _Ctx:
         self.sd = sd
         self.vars: Dict[str, SDVariable] = {}
         self.consts: Dict[str, np.ndarray] = {}
+        self.opset = 1
 
     def get(self, name: str) -> SDVariable:
         if name not in self.vars:
@@ -83,15 +84,29 @@ class _Ctx:
         return self.vars[name]
 
 
-_M: Dict[str, Callable] = {}
+#: op_type -> [(since_version, handler)] sorted newest-first. Mirrors the
+#: reference import-registry's per-opset rule selection (samediff-import-api
+#: ``OpMappingRegistry``†): the handler with the largest since_version <=
+#: the model's declared ai.onnx opset wins.
+_M: Dict[str, list] = {}
 
 
-def onnx_op(*types):
+def onnx_op(*types, since: int = 1):
     def deco(fn):
         for t in types:
-            _M[t] = fn
+            _M.setdefault(t, []).append((since, fn))
+            _M[t].sort(key=lambda p: -p[0])
         return fn
     return deco
+
+
+def _select_handler(op_type: str, opset: int):
+    for since, fn in _M[op_type]:
+        if since <= opset:
+            return fn
+    raise ValueError(
+        f"ONNX op {op_type!r}: no handler for opset {opset} (handlers start "
+        f"at opset {_M[op_type][-1][0]})")
 
 
 _UNARY = {"Relu": "act.relu", "Sigmoid": "act.sigmoid", "Tanh": "act.tanh",
@@ -207,23 +222,9 @@ def _prelu_onnx(node, ctx, at):
     return ctx.sd.call("math.add", pos, scaled, name=node.output[0])
 
 
-@onnx_op("Clip")
-def _clip_onnx(node, ctx, at):
-    # opset-11+: min/max as optional inputs; opset-6: attributes.
+def _emit_clip(node, ctx, lo, hi):
     # Absent bounds mean "no bound" (not ±3.4e38, which would clip
-    # legitimate float64 values); runtime (non-initializer) bounds are
-    # unsupported and must raise the named error, not a bare KeyError.
-    def bound(idx, attr):
-        if len(node.input) > idx and node.input[idx]:
-            name = node.input[idx]
-            if name not in ctx.consts:
-                raise ValueError(
-                    f"Clip with runtime (non-initializer) {attr} input "
-                    f"{name!r} not supported")
-            return float(np.asarray(ctx.consts[name]).reshape(()))
-        return float(at[attr]) if attr in at else None
-    lo = bound(1, "min")
-    hi = bound(2, "max")
+    # legitimate float64 values).
     if lo is None and hi is None:
         return ctx.sd.call("act.identity", ctx.get(node.input[0]),
                            name=node.output[0])
@@ -232,6 +233,164 @@ def _clip_onnx(node, ctx, at):
     return ctx.sd.call("math.clip", ctx.get(node.input[0]),
                        name=node.output[0],
                        attrs={"min_value": lo, "max_value": hi})
+
+
+@onnx_op("Clip")  # opset 1-10: min/max as attributes
+def _clip_onnx_attrs(node, ctx, at):
+    if len(node.input) > 1:
+        # converter bumped opset_import without rewriting the node (or
+        # vice versa) — the bounds live in inputs; honor them
+        return _clip_onnx_inputs(node, ctx, at)
+    lo = float(at["min"]) if "min" in at else None
+    hi = float(at["max"]) if "max" in at else None
+    return _emit_clip(node, ctx, lo, hi)
+
+
+@onnx_op("Clip", since=11)  # opset 11+: min/max as optional inputs
+def _clip_onnx_inputs(node, ctx, at):
+    # runtime (non-initializer) bounds are unsupported and must raise the
+    # named error, not a bare KeyError
+    def bound(idx, attr):
+        if len(node.input) > idx and node.input[idx]:
+            name = node.input[idx]
+            if name not in ctx.consts:
+                raise ValueError(
+                    f"Clip with runtime (non-initializer) {attr} input "
+                    f"{name!r} not supported")
+            return float(np.asarray(ctx.consts[name]).reshape(()))
+        # attribute-form bounds on an opset-11+ node: converter artifact,
+        # the intent is unambiguous — honor rather than silently drop
+        return float(at[attr]) if attr in at else None
+    return _emit_clip(node, ctx, bound(1, "min"), bound(2, "max"))
+
+
+@onnx_op("ConvTranspose")
+def _conv_transpose(node, ctx, at):
+    """torchvision FCN/DeepLab-style deconv. ONNX weight layout is IOHW
+    ([Cin, Cout/g, kH, kW]) vs our deconv2d's OIHW — permuted in-graph so
+    the weight stays a trainable VARIABLE."""
+    if at.get("group", 1) != 1:
+        raise ValueError("grouped ConvTranspose not supported")
+    if any(int(v) for v in at.get("output_padding", [])):
+        raise ValueError("ConvTranspose output_padding not supported")
+    if at.get("output_shape"):
+        # spec derives effective pads from output_shape; defaulting pads
+        # to 0 would silently mis-size the deconv
+        raise ValueError("ConvTranspose output_shape not supported "
+                         "(re-export with explicit pads)")
+    if at.get("auto_pad", "NOTSET") not in ("NOTSET", "VALID"):
+        raise ValueError("ConvTranspose auto_pad SAME not supported")
+    pads = at.get("pads", [0, 0, 0, 0])
+    if len(pads) == 4 and (pads[0] != pads[2] or pads[1] != pads[3]):
+        raise ValueError("asymmetric ConvTranspose pads not supported")
+    w = ctx.sd.call("shape.permute", ctx.get(node.input[1]),
+                    attrs={"axes": (1, 0, 2, 3)})
+    args = [ctx.get(node.input[0]), w]
+    if len(node.input) > 2:
+        args.append(ctx.get(node.input[2]))
+    return ctx.sd.call(
+        "deconv2d", *args, name=node.output[0],
+        attrs={"stride": tuple(int(s) for s in at.get("strides", [1, 1])),
+               "padding": (int(pads[0]), int(pads[1])),
+               "dilation": tuple(int(d) for d in at.get("dilations", [1, 1])),
+               "mode": "truncate", "data_format": "NCHW"})
+
+
+@onnx_op("Resize", since=11)
+def _resize_onnx(node, ctx, at):
+    """Resize for the cases real exports hit: torch Upsample(nearest) =
+    asymmetric+floor with integer scales, and bilinear half_pixel
+    (align_corners=False). jax.image.resize samples half-pixel centers;
+    nearest with integer upscale is identical under both grids."""
+    mode = at.get("mode", "nearest")
+    ctm = at.get("coordinate_transformation_mode", "half_pixel")
+    x = ctx.get(node.input[0])
+    if mode == "nearest":
+        if ctm not in ("asymmetric", "half_pixel"):
+            raise ValueError(f"Resize nearest with {ctm!r} not supported")
+        method, sized_op = "nearest", "image.resize_nearest"
+    elif mode == "linear":
+        if ctm not in ("half_pixel", "pytorch_half_pixel"):
+            raise ValueError(f"Resize linear with {ctm!r} not supported "
+                             "(align_corners differs from half-pixel)")
+        method, sized_op = "bilinear", "image.resize_bilinear"
+    else:
+        raise ValueError(f"Resize mode {mode!r} not supported")
+    # opset 11/13 layout: X, roi, scales, sizes (trailing inputs optional)
+    if len(node.input) > 3 and node.input[3]:
+        nm = node.input[3]
+        if nm not in ctx.consts:
+            raise ValueError(f"Resize with runtime sizes {nm!r} not supported")
+        sz = [int(v) for v in np.asarray(ctx.consts[nm]).ravel()]
+        attrs = {"size": (sz[2], sz[3]), "data_format": "NCHW",
+                 # batch/channel sizes can't be checked at import (input
+                 # shape unknown) — the op asserts them at trace time
+                 "expect_leading": (sz[0], sz[1])}
+        if mode == "nearest" and ctm == "asymmetric":
+            # floor-grid == half-pixel-grid only for integer upscales;
+            # shapes are unknown at import, so the op checks at trace time
+            attrs["require_integer_upscale"] = True
+        return ctx.sd.call(sized_op, x, name=node.output[0], attrs=attrs)
+    if len(node.input) > 2 and node.input[2]:
+        nm = node.input[2]
+        if nm not in ctx.consts:
+            raise ValueError(
+                f"Resize with runtime scales {nm!r} not supported")
+        sc = [float(v) for v in np.asarray(ctx.consts[nm]).ravel()]
+        if len(sc) == 4:
+            if sc[0] != 1.0 or sc[1] != 1.0:
+                raise ValueError("Resize scaling batch/channel dims "
+                                 "not supported")
+            if mode == "nearest" and ctm == "asymmetric" and (
+                    sc[2] != int(sc[2]) or sc[3] != int(sc[3])):
+                raise ValueError(
+                    "Resize nearest asymmetric supports integer upscales "
+                    "only (fractional grids differ from half-pixel "
+                    "sampling)")
+            return ctx.sd.call("image.resize_scale", x, name=node.output[0],
+                               attrs={"scale": (sc[2], sc[3]),
+                                      "method": method,
+                                      "data_format": "NCHW"})
+    raise ValueError("Resize needs constant scales or sizes")
+
+
+@onnx_op("LayerNormalization", since=17)
+def _layer_norm_onnx(node, ctx, at):
+    """Opset-17 transformer exports. Single-output form (the training
+    mean/invstd outputs are not produced)."""
+    axis = int(at.get("axis", -1))
+    if axis not in (-1,):
+        raise ValueError("LayerNormalization axis != -1 not supported")
+    if len(node.output) > 1 and any(node.output[1:]):
+        raise ValueError(
+            "LayerNormalization mean/invstd outputs not supported")
+    x = ctx.get(node.input[0])
+    scale = ctx.get(node.input[1])
+    if len(node.input) > 2 and node.input[2]:
+        bias = ctx.get(node.input[2])
+    else:
+        bias = ctx.sd._lift(np.float32(0.0))
+    return ctx.sd.call("layer_norm", x, scale, bias, name=node.output[0],
+                       attrs={"eps": float(at.get("epsilon", 1e-5)),
+                              "axis": -1})
+
+
+@onnx_op("InstanceNormalization")
+def _instance_norm_onnx(node, ctx, at):
+    """Per-instance per-channel normalization (NCHW); scale/bias stay
+    trainable VARIABLEs for fine-tuning."""
+    return ctx.sd.call("instance_norm", ctx.get(node.input[0]),
+                       ctx.get(node.input[1]), ctx.get(node.input[2]),
+                       name=node.output[0],
+                       attrs={"eps": float(at.get("epsilon", 1e-5))})
+
+
+@onnx_op("Gelu", since=20)
+def _gelu_onnx(node, ctx, at):
+    approx = at.get("approximate", "none")
+    return ctx.sd.call("act.gelu", ctx.get(node.input[0]),
+                       name=node.output[0],
+                       attrs={"approximate": approx == "tanh"})
 
 
 @onnx_op("GlobalMaxPool")
@@ -588,6 +747,14 @@ class OnnxFrameworkImporter:
         g = model.graph
         sd = SameDiff()
         ctx = _Ctx(sd)
+        # declared ai.onnx opset drives per-handler since_version selection;
+        # a model with NO declaration (hand-built fixtures — real exporters
+        # always declare) is treated as a modern opset-13 graph
+        opset = 0
+        for oi in model.opset_import:
+            if oi.domain in ("", "ai.onnx"):
+                opset = max(opset, int(oi.version))
+        ctx.opset = opset = opset or 13
         for init in g.initializer:
             value = _tensor_to_np(init)
             ctx.consts[init.name] = value
@@ -616,7 +783,8 @@ class OnnxFrameworkImporter:
                     _BINARY[node.op_type], ctx.get(node.input[0]),
                     ctx.get(node.input[1]), name=node.output[0])
             elif node.op_type in _M:
-                ctx.vars[node.output[0]] = _M[node.op_type](node, ctx, at)
+                fn = _select_handler(node.op_type, opset)
+                ctx.vars[node.output[0]] = fn(node, ctx, at)
             else:
                 raise ValueError(
                     f"unsupported ONNX op {node.op_type!r} (node "

@@ -432,8 +432,8 @@ class ComputationGraph:
 
             (loss, new_bn), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
             grads = self._clip(grads)
-            delta, new_opt = updater.apply(grads, opt_state, params, step)
-            new_params = jax.tree.map(lambda p, d: p - d, params, delta)
+            new_params, new_opt = _upd.apply_fused(
+                updater, grads, opt_state, params, step)
             new_params = _constraints.apply_constraints(
                 self.conf.constraints, new_params, skip=frozen_keys)
             return new_params, new_opt, new_bn, loss

@@ -247,7 +247,10 @@ class GlobalPoolingLayer(Layer):
         return False
 
     def initialize(self, key, input_shape, dtype):
-        if len(input_shape) == 3:  # CNN [C,H,W] or [H,W,C]
+        if len(input_shape) == 4:  # CNN3D [C,D,H,W] or [D,H,W,C]
+            n = int(input_shape[0] if self.data_format in ("NCHW", "NCDHW")
+                    else input_shape[-1])
+        elif len(input_shape) == 3:  # CNN [C,H,W] or [H,W,C]
             n = int(input_shape[0] if self.data_format == "NCHW" else input_shape[-1])
         else:  # RNN [T, F] -> F
             n = int(input_shape[-1])
@@ -306,7 +309,9 @@ class Upsampling2D(Layer):
 
 @layer("zeropad2d")
 class ZeroPadding2D(Layer):
-    padding: Tuple[int, int] = (1, 1)
+    """``padding``: (pad_h, pad_w) symmetric, or the Keras asymmetric form
+    ((top, bottom), (left, right))."""
+    padding: Tuple = (1, 1)
     data_format: str = "NCHW"
     name: Optional[str] = None
 
@@ -314,8 +319,12 @@ class ZeroPadding2D(Layer):
         return False
 
     def initialize(self, key, input_shape, dtype):
-        pt = pb = int(_pair(self.padding)[0])
-        pl = pr = int(_pair(self.padding)[1])
+        if isinstance(self.padding[0], (tuple, list)):
+            (pt, pb), (pl, pr) = self.padding
+            pt, pb, pl, pr = int(pt), int(pb), int(pl), int(pr)
+        else:
+            pt = pb = int(_pair(self.padding)[0])
+            pl = pr = int(_pair(self.padding)[1])
         if self.data_format == "NCHW":
             c, h, w = (int(s) for s in input_shape)
             return {}, {}, (c, h + pt + pb, w + pl + pr)

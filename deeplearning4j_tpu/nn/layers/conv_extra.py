@@ -394,6 +394,54 @@ class Cropping1D(_Conv1DBase):
 
 # ---- locally connected ------------------------------------------------------
 
+@layer("separable_conv1d")
+class SeparableConvolution1D(_Conv1DBase):
+    """Depthwise-then-pointwise conv over [B,T,F] (Keras ``SeparableConv1D``;
+    no direct DL4J twin — DL4J only ships SeparableConvolution2D, ref†
+    ``.../nn/conf/layers/SeparableConvolution2D.java``). Implemented through
+    the 2D separable kernel with a height-1 axis, same as Convolution1D.
+    Params: dW [F*mult, 1, 1, k], pW [nOut, F*mult, 1, 1], b [nOut]."""
+    n_out: int = 0
+    kernel: int = 3
+    stride: int = 1
+    padding: int = 0
+    dilation: int = 1
+    depth_multiplier: int = 1
+    mode: str = "truncate"
+    activation: str = "identity"
+    weight_init: str = "relu"
+    has_bias: bool = True
+    l1: float = 0.0
+    l2: float = 0.0
+    name: Optional[str] = None
+
+    def initialize(self, key, input_shape, dtype):
+        t, f = int(input_shape[0]), int(input_shape[1])
+        k = int(self.kernel)
+        cm = f * self.depth_multiplier
+        k1, k2 = jax.random.split(key)
+        dw = _winit.init(self.weight_init, k1, (cm, 1, 1, k),
+                         k, k * self.depth_multiplier, dtype)
+        pw = _winit.init(self.weight_init, k2, (self.n_out, cm, 1, 1),
+                         cm, self.n_out, dtype)
+        params = {"dW": dw, "pW": pw}
+        if self.has_bias:
+            params["b"] = jnp.zeros((self.n_out,), dtype)
+        t_out = _conv_out(t, k, self.stride, self.padding, self.mode) \
+            if t > 0 else t
+        return params, {}, (t_out, self.n_out)
+
+    def apply(self, params, x, state, *, train=False, rng=None, mask=None):
+        y = nnops.separable_conv2d(
+            self._to2d(x), params["dW"], params["pW"], params.get("b"),
+            (1, self.stride), (0, self.padding), (1, self.dilation),
+            self.mode, "NHWC")
+        y = _act.get(self.activation)(self._from2d(y))
+        new_mask = mask if (mask is not None and self.stride == 1
+                            and self.mode == "same") else None
+        return y, state, new_mask
+
+
 @layer("locally_connected2d")
 class LocallyConnected2D(Layer):
     """DL4J LocallyConnected2D: conv with UNSHARED weights per output

@@ -108,6 +108,95 @@ class FlattenLayer(Layer):
         return x.reshape(x.shape[0], -1), state, mask
 
 
+@layer("permute")
+class PermuteLayer(Layer):
+    """Permute the non-batch axes (Keras ``Permute`` / DL4J
+    ``PermutePreprocessor``). ``dims`` are 1-indexed positions of the INPUT
+    axes in the output, batch excluded — Keras convention, e.g. (2, 1)
+    swaps the two non-batch axes."""
+    dims: Tuple[int, ...] = ()
+    name: Optional[str] = None
+
+    def has_params(self):
+        return False
+
+    def initialize(self, key, input_shape, dtype):
+        if sorted(self.dims) != list(range(1, len(input_shape) + 1)):
+            raise ValueError(
+                f"Permute dims {self.dims} must be a permutation of "
+                f"1..{len(input_shape)} for input {input_shape}")
+        return {}, {}, tuple(input_shape[d - 1] for d in self.dims)
+
+    def apply(self, params, x, state, *, train=False, rng=None, mask=None):
+        perm = (0,) + tuple(d for d in self.dims)
+        # mask semantics under permutation are ambiguous; drop it loudly
+        # downstream rather than silently mis-aligning timesteps
+        return jnp.transpose(x, perm), state, None
+
+
+@layer("reshape")
+class ReshapeLayer(Layer):
+    """Reshape the non-batch axes (Keras ``Reshape`` / DL4J
+    ``ReshapePreprocessor``). ``target_shape`` excludes the batch dim; one
+    entry may be -1 (inferred)."""
+    target_shape: Tuple[int, ...] = ()
+    name: Optional[str] = None
+
+    def has_params(self):
+        return False
+
+    def initialize(self, key, input_shape, dtype):
+        tgt = list(int(t) for t in self.target_shape)
+        total = 1
+        for s in input_shape:
+            total *= int(s)
+        if -1 in tgt:
+            known = 1
+            for t in tgt:
+                if t != -1:
+                    known *= t
+            tgt[tgt.index(-1)] = total // known
+        prod = 1
+        for t in tgt:
+            prod *= t
+        if prod != total:
+            raise ValueError(
+                f"Reshape target {self.target_shape} incompatible with "
+                f"input {input_shape}")
+        return {}, {}, tuple(tgt)
+
+    def apply(self, params, x, state, *, train=False, rng=None, mask=None):
+        tgt = list(int(t) for t in self.target_shape)
+        y = x.reshape((x.shape[0],) + tuple(tgt))
+        return y, state, None
+
+
+@layer("masking")
+class MaskingLayer(Layer):
+    """Keras ``Masking`` semantics: a timestep whose features ALL equal
+    ``mask_value`` is masked out. Emits/refines the per-timestep mask and
+    zeroes the masked steps so downstream layers that ignore the mask
+    channel still see neutral values."""
+    mask_value: float = 0.0
+    name: Optional[str] = None
+
+    def has_params(self):
+        return False
+
+    def initialize(self, key, input_shape, dtype):
+        if len(input_shape) != 2:
+            raise ValueError(f"Masking expects [T,F], got {input_shape}")
+        return {}, {}, input_shape
+
+    def apply(self, params, x, state, *, train=False, rng=None, mask=None):
+        step_mask = jnp.any(x != self.mask_value, axis=-1)  # [B,T]
+        new_mask = step_mask.astype(x.dtype)
+        if mask is not None:
+            new_mask = new_mask * mask.astype(x.dtype)
+        y = x * new_mask[..., None]
+        return y, state, new_mask
+
+
 @layer("embedding")
 class EmbeddingLayer(Layer):
     """DL4J EmbeddingLayer/EmbeddingSequenceLayer: int ids -> vectors."""

@@ -167,6 +167,100 @@ class LSTM(_RecurrentLayer):
         return y, state, mask
 
 
+@layer("convlstm2d")
+class ConvLSTM2D(_RecurrentLayer):
+    """Convolutional LSTM over [B,T,H,W,C] NHWC sequences (Keras
+    ``ConvLSTM2D``; Shi et al. 2015). No DL4J twin — imported Keras models
+    are the use case. Gates are convolutions: z = conv(x_t, W) +
+    conv(h_{t-1}, RW, same) + b, gate order [i,f,o,g] like our LSTM.
+
+    Params (OIHW, matching the conv stack): W [4f, Cin, kh, kw],
+    RW [4f, f, kh, kw], b [4f]. The recurrent conv is always 'same' over
+    the output spatial size (Keras semantics). ``return_sequences=False``
+    emits only the final state [B,H',W',f] (LastTimeStep cannot wrap 5-D
+    streams, so the collapse lives in-layer)."""
+    n_out: int = 0                      # filters
+    kernel: Tuple[int, int] = (3, 3)
+    stride: Tuple[int, int] = (1, 1)
+    mode: str = "same"                  # input conv padding: same|truncate
+    return_sequences: bool = True
+    activation: str = "tanh"            # cell/output transform
+    gate_activation: str = "sigmoid"    # Keras recurrent_activation
+    weight_init: str = "xavier"
+    tbptt_length: Optional[int] = None
+    l1: float = 0.0
+    l2: float = 0.0
+    name: Optional[str] = None
+
+    supports_streaming = False
+
+    def initialize(self, key, input_shape, dtype):
+        t, h, w, c = (int(s) for s in input_shape)
+        kh, kw = int(self.kernel[0]), int(self.kernel[1])
+        sh, sw = int(self.stride[0]), int(self.stride[1])
+        f = self.n_out
+        k1, k2 = jax.random.split(key)
+        wk = _winit.init(self.weight_init, k1, (4 * f, c, kh, kw),
+                         c * kh * kw, f * kh * kw, dtype)
+        rwk = _winit.init(self.weight_init, k2, (4 * f, f, kh, kw),
+                          f * kh * kw, f * kh * kw, dtype)
+        b = jnp.zeros((4 * f,), dtype)
+        from .conv import _conv_out
+        ho = _conv_out(h, kh, sh, 0, self.mode) if h > 0 else h
+        wo = _conv_out(w, kw, sw, 0, self.mode) if w > 0 else w
+        out = ((t, ho, wo, f) if self.return_sequences else (ho, wo, f))
+        return {"W": wk, "RW": rwk, "b": b}, {}, out
+
+    def apply(self, params, x, state, *, train=False, rng=None, mask=None):
+        wk, rwk, b = params["W"], params["RW"], params["b"]
+        f = self.n_out
+        B, T = x.shape[0], x.shape[1]
+        xs = jnp.moveaxis(x, 1, 0)  # [T,B,H,W,C]
+        ms = None if mask is None else jnp.moveaxis(mask, 1, 0)
+        # all input convs at once: big batched conv rides the MXU better
+        # than T small ones and is time-invariant (safe to hoist)
+        zx_all = nnops.conv2d(
+            xs.reshape((T * B,) + x.shape[2:]), wk, None,
+            stride=self.stride, padding=(0, 0), mode=self.mode,
+            data_format="NHWC")
+        zx_all = zx_all.reshape((T, B) + zx_all.shape[1:])
+        ho, wo = zx_all.shape[2], zx_all.shape[3]
+        h0 = jnp.zeros((B, ho, wo, f), x.dtype)
+        c0 = jnp.zeros((B, ho, wo, f), x.dtype)
+        ts = jnp.arange(T, dtype=jnp.int32)
+        tbptt = self.tbptt_length
+        gate = _act.get(self.gate_activation)
+        act = _act.get(self.activation)
+
+        def body(carry, inp):
+            if tbptt:
+                t = inp[-1]
+                carry = jax.lax.cond(
+                    t % tbptt == 0,
+                    lambda cc: jax.tree.map(jax.lax.stop_gradient, cc),
+                    lambda cc: cc, carry)
+            hprev, cprev = carry
+            zx_t, m_t = inp[0], inp[1]
+            zh = nnops.conv2d(hprev, rwk, None, stride=(1, 1),
+                              padding=(0, 0), mode="same",
+                              data_format="NHWC")
+            z = zx_t + zh + b
+            i, fg, o, g = jnp.split(z, 4, axis=-1)
+            c_new = gate(fg) * cprev + gate(i) * act(g)
+            h_new = gate(o) * act(c_new)
+            if m_t.shape[-1]:
+                m = m_t[:, None, None, None].astype(h_new.dtype)
+                h_new = m * h_new + (1.0 - m) * hprev
+                c_new = m * c_new + (1.0 - m) * cprev
+            return (h_new, c_new), h_new
+
+        feed = (zx_all, jnp.zeros((T, 0)) if ms is None else ms, ts)
+        (h_fin, _), ys = jax.lax.scan(body, (h0, c0), feed)
+        if not self.return_sequences:
+            return h_fin, state, None
+        return jnp.moveaxis(ys, 0, 1), state, mask
+
+
 @layer("graves_lstm")
 class GravesLSTM(_RecurrentLayer):
     """Peephole LSTM (DL4J GravesLSTM; Graves 2013). Peepholes i,f from

@@ -30,6 +30,7 @@ from .. import dtypes as _dt
 from .. import environment as _env
 from ..data.dataset import DataSet, DataSetIterator, NumpyDataSetIterator
 from . import constraints as _constraints
+from . import updaters as _updaters
 from ..ops import losses as _loss
 from .config import MultiLayerConfiguration
 from .layers.core import LossLayer, OutputLayer
@@ -228,8 +229,8 @@ class MultiLayerNetwork:
 
             (loss, new_bn), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
             grads = self._clip(grads)
-            delta, new_opt = updater.apply(grads, opt_state, params, step)
-            new_params = jax.tree.map(lambda p, d: p - d, params, delta)
+            new_params, new_opt = _updaters.apply_fused(
+                updater, grads, opt_state, params, step)
             new_params = _constraints.apply_constraints(
                 self.conf.constraints, new_params, skip=frozen_keys)
             return new_params, new_opt, new_bn, loss

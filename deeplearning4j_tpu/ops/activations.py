@@ -85,9 +85,10 @@ def selu(x):
 
 
 @_act("gelu")
-def gelu(x):
-    # DL4J GELU is the tanh approximation (matches original paper impl).
-    return jax.nn.gelu(x, approximate=True)
+def gelu(x, approximate=True):
+    # DL4J GELU is the tanh approximation (matches original paper impl);
+    # ONNX opset-20 Gelu defaults to the exact erf form (approximate=False).
+    return jax.nn.gelu(x, approximate=approximate)
 
 
 @_act("swish")

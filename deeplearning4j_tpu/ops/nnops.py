@@ -202,8 +202,12 @@ def pnorm_pool2d(x, kernel, stride=None, padding=0, mode="truncate",
 def global_pool(x, pool_type="max", data_format="NCHW", keepdims=False, p=2.0):
     """GlobalPoolingLayer: pool over all spatial (or time) dims.
     ``p`` is the pnorm exponent (DL4J GlobalPoolingLayer.pnorm)."""
-    axes = (2, 3) if (data_format == "NCHW" and x.ndim == 4) else \
-           (1, 2) if x.ndim == 4 else (2,) if data_format == "NCHW" else (1,)
+    if x.ndim == 5:  # CNN3D [N,C,D,H,W] or [N,D,H,W,C]
+        axes = (2, 3, 4) if data_format in ("NCHW", "NCDHW") else (1, 2, 3)
+    elif x.ndim == 4:
+        axes = (2, 3) if data_format == "NCHW" else (1, 2)
+    else:
+        axes = (2,) if data_format == "NCHW" else (1,)
     if pool_type == "max":
         return jnp.max(x, axis=axes, keepdims=keepdims)
     if pool_type == "avg":
@@ -240,6 +244,18 @@ def layer_norm(x, gamma, beta, eps=1e-5, axis=-1):
     var = jnp.var(x, axis=axis, keepdims=True)
     y = (x - mean) * lax.rsqrt(var + eps)
     return y * gamma + beta
+
+
+@register("instance_norm", category="normalization")
+def instance_norm(x, gamma, beta, eps=1e-5):
+    """Per-instance per-channel normalization over spatial dims, NCHW-style
+    [N,C,D1..Dn] (ONNX InstanceNormalization; torch InstanceNormNd)."""
+    axes = tuple(range(2, x.ndim))
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.var(x, axis=axes, keepdims=True)
+    y = (x - mean) * lax.rsqrt(var + eps)
+    cshape = (1, x.shape[1]) + (1,) * len(axes)
+    return y * gamma.reshape(cshape) + beta.reshape(cshape)
 
 
 @register("lrn", category="normalization")

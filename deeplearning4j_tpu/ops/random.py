@@ -49,24 +49,60 @@ def dropout_inverted(key, x, rate):
 
 # -- image ops ---------------------------------------------------------------
 @register("image.resize_bilinear", category="image")
-def resize_bilinear(x, size, data_format="NHWC"):
+def resize_bilinear(x, size, data_format="NHWC", expect_leading=None):
     """Resize spatial dims of [B,H,W,C] (or [B,C,H,W]) to `size` (h, w)."""
     h, w = size
     if data_format == "NHWC":
         shape = (x.shape[0], h, w, x.shape[3])
+        leading = (x.shape[0], x.shape[3])
     else:
         shape = (x.shape[0], x.shape[1], h, w)
+        leading = (x.shape[0], x.shape[1])
+    if expect_leading is not None and tuple(expect_leading) != leading:
+        raise ValueError(
+            f"resize: node requested leading dims {tuple(expect_leading)} "
+            f"but input has {leading} (batch/channel resize unsupported)")
     return jax.image.resize(x, shape, method="bilinear")
 
 
 @register("image.resize_nearest", category="image")
-def resize_nearest(x, size, data_format="NHWC"):
+def resize_nearest(x, size, data_format="NHWC", require_integer_upscale=False,
+                   expect_leading=None):
     h, w = size
     if data_format == "NHWC":
+        xh, xw = x.shape[1], x.shape[2]
         shape = (x.shape[0], h, w, x.shape[3])
+        leading = (x.shape[0], x.shape[3])
     else:
+        xh, xw = x.shape[2], x.shape[3]
         shape = (x.shape[0], x.shape[1], h, w)
+        leading = (x.shape[0], x.shape[1])
+    # trace-time guards for graph importers whose node metadata can't be
+    # validated at import (shapes unknown there, static here)
+    if expect_leading is not None and tuple(expect_leading) != leading:
+        raise ValueError(
+            f"resize: node requested leading dims {tuple(expect_leading)} "
+            f"but input has {leading} (batch/channel resize unsupported)")
+    if require_integer_upscale and (h % xh or w % xw):
+        raise ValueError(
+            f"nearest resize {xh}x{xw} -> {h}x{w}: asymmetric-floor grid "
+            "only matches half-pixel sampling for integer upscales")
     return jax.image.resize(x, shape, method="nearest")
+
+
+@register("image.resize_scale", category="image")
+def resize_scale(x, scale, method="nearest", data_format="NHWC"):
+    """Resize spatial dims by a (sh, sw) scale factor. Output size is
+    computed from the traced input shape, so graph importers can emit this
+    without knowing intermediate shapes (ONNX Resize scales form)."""
+    sh, sw = scale
+    if data_format == "NHWC":
+        shape = (x.shape[0], int(round(x.shape[1] * sh)),
+                 int(round(x.shape[2] * sw)), x.shape[3])
+    else:
+        shape = (x.shape[0], x.shape[1], int(round(x.shape[2] * sh)),
+                 int(round(x.shape[3] * sw)))
+    return jax.image.resize(x, shape, method=method)
 
 
 @register("image.crop_to_box", category="image", differentiable=False)

@@ -29,6 +29,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from .. import environment as _envmod
 from ..data.dataset import DataSetIterator, MultiDataSet
 from ..nn.model import MultiLayerNetwork, _as_iterator
 
@@ -136,15 +137,33 @@ class ParallelWrapper:
         return tree_map_with_path(leaf, params)
 
     def _build(self):
-        base = self.model._build_train_step()  # already jit; re-wrap with shardings
         mesh = self.mesh
         repl = NamedSharding(mesh, P())
         data = NamedSharding(mesh, P("data"))
 
         # Same pure step; GSPMD partitions the batch dim and inserts the
         # gradient AllReduce. Donation mirrors the single-chip path.
-        def step_fn(params, opt_state, bn_state, step, key, x, y, fm, lm):
-            return base(params, opt_state, bn_state, step, key, x, y, fm, lm)
+        # out_shardings pin the UPDATED params/state to the input layout:
+        # the engines' fused flat-buffer updater (updaters.apply_fused)
+        # ravels params through a concat/slice chain whose GSPMD-derived
+        # output shardings would otherwise drift from the TP layout and
+        # force a host reshard every step.
+        pure = self.model._build_train_step().__wrapped__
+        from jax.tree_util import tree_structure
+        p_sh = self._param_shardings(self.model.params)
+        p_struct = tree_structure(self.model.params)
+        opt = self.model.updater_state
+        if isinstance(opt, dict):
+            opt_sh = {k: (p_sh if tree_structure(sub) == p_struct
+                          else jax.tree.map(lambda a: repl, sub))
+                      for k, sub in opt.items()}
+        else:
+            opt_sh = jax.tree.map(lambda a: repl, opt)
+        bn_sh = jax.tree.map(lambda a: repl, self.model.state)
+        step_fn = jax.jit(
+            pure, donate_argnums=(0, 1, 2),
+            out_shardings=(p_sh, opt_sh, bn_sh, repl),
+            compiler_options=_envmod.engine_compiler_options())
 
         multi_host = jax.process_count() > 1
 

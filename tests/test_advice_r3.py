@@ -98,30 +98,92 @@ class _FakeCtx:
 
 
 def test_clip_runtime_bound_raises_named_error():
-    from deeplearning4j_tpu.modelimport.onnx import _clip_onnx
+    from deeplearning4j_tpu.modelimport.onnx import _clip_onnx_inputs
     node = _FakeNode(["x", "runtime_min"], ["y"])
     ctx = _FakeCtx(consts={})
     with pytest.raises(ValueError, match="runtime"):
-        _clip_onnx(node, ctx, {})
+        _clip_onnx_inputs(node, ctx, {})
 
 
 def test_clip_no_bounds_is_identity_not_3e38():
-    from deeplearning4j_tpu.modelimport.onnx import _clip_onnx
+    from deeplearning4j_tpu.modelimport.onnx import _clip_onnx_inputs
     node = _FakeNode(["x"], ["y"])
     ctx = _FakeCtx(consts={})
-    _clip_onnx(node, ctx, {})
+    _clip_onnx_inputs(node, ctx, {})
     op, attrs = ctx.sd.calls[0]
     assert op == "act.identity"
 
 
 def test_clip_single_bound_uses_inf_for_missing():
-    from deeplearning4j_tpu.modelimport.onnx import _clip_onnx
+    from deeplearning4j_tpu.modelimport.onnx import _clip_onnx_inputs
     node = _FakeNode(["x", "lo"], ["y"])
     ctx = _FakeCtx(consts={"lo": np.float32(0.0)})
-    _clip_onnx(node, ctx, {})
+    _clip_onnx_inputs(node, ctx, {})
     op, attrs = ctx.sd.calls[0]
     assert op == "math.clip"
     assert attrs["min_value"] == 0.0 and attrs["max_value"] == np.inf
+
+
+def test_clip_opset6_attr_form_no_bounds_is_identity():
+    from deeplearning4j_tpu.modelimport.onnx import _clip_onnx_attrs
+    node = _FakeNode(["x"], ["y"])
+    ctx = _FakeCtx(consts={})
+    _clip_onnx_attrs(node, ctx, {})
+    assert ctx.sd.calls[0][0] == "act.identity"
+
+
+def test_clip_opset11_node_with_attr_bounds_honored():
+    # converter artifact: opset>=11 model whose Clip still carries
+    # attribute bounds — must clip, not silently become identity
+    from deeplearning4j_tpu.modelimport.onnx import _clip_onnx_inputs
+    node = _FakeNode(["x"], ["y"])
+    ctx = _FakeCtx(consts={})
+    _clip_onnx_inputs(node, ctx, {"min": 0.0, "max": 6.0})
+    op, attrs = ctx.sd.calls[0]
+    assert op == "math.clip"
+    assert attrs["min_value"] == 0.0 and attrs["max_value"] == 6.0
+
+
+def test_clip_opset6_node_with_input_bounds_honored():
+    from deeplearning4j_tpu.modelimport.onnx import _clip_onnx_attrs
+    node = _FakeNode(["x", "mn", "mx"], ["y"])
+    ctx = _FakeCtx(consts={"mn": np.float32(-1.0), "mx": np.float32(1.0)})
+    _clip_onnx_attrs(node, ctx, {})
+    op, attrs = ctx.sd.calls[0]
+    assert op == "math.clip"
+    assert attrs["min_value"] == -1.0 and attrs["max_value"] == 1.0
+
+
+def test_resize_nearest_integer_upscale_guard():
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.ops.random import resize_nearest
+    x = jnp.ones((1, 2, 3, 4))
+    y = resize_nearest(x, (4, 6), require_integer_upscale=True)
+    assert y.shape == (1, 4, 6, 4)
+    with pytest.raises(ValueError, match="integer upscales"):
+        resize_nearest(x, (5, 6), require_integer_upscale=True)
+    with pytest.raises(ValueError, match="leading"):
+        resize_nearest(x, (4, 6), expect_leading=(1, 7))
+
+
+def test_conv_transpose_output_shape_raises():
+    from deeplearning4j_tpu.modelimport.onnx import _conv_transpose
+    node = _FakeNode(["x", "w"], ["y"])
+    node.op_type = "ConvTranspose"
+    ctx = _FakeCtx(consts={})
+    with pytest.raises(ValueError, match="output_shape"):
+        _conv_transpose(node, ctx, {"output_shape": [1, 4, 8, 8]})
+
+
+def test_opset_handler_selection():
+    from deeplearning4j_tpu.modelimport.onnx import (_select_handler,
+                                                     _clip_onnx_attrs,
+                                                     _clip_onnx_inputs)
+    assert _select_handler("Clip", 6) is _clip_onnx_attrs
+    assert _select_handler("Clip", 11) is _clip_onnx_inputs
+    assert _select_handler("Clip", 19) is _clip_onnx_inputs
+    with pytest.raises(ValueError, match="opset"):
+        _select_handler("LayerNormalization", 9)  # since=17
 
 
 def test_tp_dense_only_sharding_graph_engine():

@@ -1,0 +1,145 @@
+"""BagOfWords/TF-IDF vectorizers + MFCC (datavec-data-nlp / -audio parity,
+SURVEY.md §2.3). Oracles: hand counts, sklearn TfidfVectorizer, scipy DCT."""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datavec.text import (BagOfWordsVectorizer,
+                                             TfidfVectorizer, mfcc,
+                                             mel_filterbank, _dct2_ortho)
+from deeplearning4j_tpu.nlp.word2vec import TokenizerFactory
+
+DOCS = [
+    "the cat sat on the mat",
+    "the dog sat on the log",
+    "cats and dogs and cats",
+    "a log is not a mat",
+]
+
+
+def test_bow_counts_hand_oracle():
+    v = BagOfWordsVectorizer()
+    x = v.fit_transform(DOCS)
+    assert x.shape == (4, v.vocab_size())
+    the = v.vocab["the"]
+    cat = v.vocab["cat"]
+    assert x[0, the] == 2.0 and x[0, cat] == 1.0
+    assert x[2, the] == 0.0
+    assert x[2, v.vocab["and"]] == 2.0
+    # frequency-descending vocab: 'the' (4 occurrences) is index 0
+    assert the == 0
+
+
+def test_bow_min_frequency_and_limit():
+    v = BagOfWordsVectorizer(min_word_frequency=2)
+    v.fit(DOCS)
+    assert "sat" in v.vocab and "dog" not in v.vocab  # dog appears once
+    v2 = BagOfWordsVectorizer(vocab_limit=3)
+    v2.fit(DOCS)
+    assert v2.vocab_size() == 3
+
+
+def test_tfidf_matches_sklearn():
+    sk = pytest.importorskip("sklearn.feature_extraction.text")
+    ours = TfidfVectorizer(
+        tokenizer=TokenizerFactory(token_pattern=r"(?u)\b\w\w+\b"))
+    x = ours.fit_transform(DOCS)
+    ref = sk.TfidfVectorizer().fit_transform(DOCS).toarray()
+    skv = sk.TfidfVectorizer().fit(DOCS)
+    # align columns by token
+    perm = [ours.vocab[t] for t in skv.get_feature_names_out()]
+    np.testing.assert_allclose(x[:, perm], ref, rtol=1e-6, atol=1e-6)
+
+
+def test_tfidf_transform_unseen_tokens_ignored():
+    v = TfidfVectorizer()
+    v.fit(DOCS)
+    x = v.transform(["unseen words only zzz"])
+    assert x.shape == (1, v.vocab_size())
+    assert np.all(x == 0.0)
+
+
+def test_vectorizer_accepts_records():
+    # RecordReader rows are lists of writables; first string column is text
+    v = BagOfWordsVectorizer()
+    recs = [[d, 1] for d in DOCS]
+    v.fit(recs)
+    assert "cat" in v.vocab
+
+
+def test_text_pipeline_end_to_end_classification():
+    """reader -> tf-idf -> MLN: the §2.3 text-pipeline parity test."""
+    from deeplearning4j_tpu.datavec.records import (CollectionRecordReader)
+    from deeplearning4j_tpu.nn.config import (NeuralNetConfiguration,
+                                              InputType)
+    from deeplearning4j_tpu.nn.layers.core import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.model import MultiLayerNetwork
+    from deeplearning4j_tpu.nn.updaters import Adam
+
+    rng = np.random.default_rng(0)
+    sports = ["great game of football and goals", "the team won the match",
+              "score goals in the big game", "match day team football"]
+    cooking = ["bake the bread with flour", "recipe needs butter and flour",
+               "cook the soup then bake", "butter bread recipe soup"]
+    texts, ys = [], []
+    for _ in range(8):
+        for t in sports:
+            texts.append(t); ys.append(0)
+        for t in cooking:
+            texts.append(t); ys.append(1)
+    reader = CollectionRecordReader([[t, y] for t, y in zip(texts, ys)])
+    rows = list(reader)
+    v = TfidfVectorizer()
+    ds = v.fit_transform([r[0] for r in rows],
+                         labels=[int(r[1]) for r in rows], n_labels=2)
+    cfg = (NeuralNetConfiguration.builder().seed(7).updater(Adam(0.01))
+           .input_type(InputType.feed_forward(v.vocab_size()))
+           .list(DenseLayer(n_out=16, activation="relu"),
+                 OutputLayer(n_out=2, loss="mcxent"))
+           .build())
+    net = MultiLayerNetwork(cfg).init()
+    s0 = float(net.score(ds))
+    for _ in range(60):
+        net.fit(ds.features, ds.labels)
+    s1 = float(net.score(ds))
+    assert s1 < 0.1 < s0
+    pred = np.argmax(np.asarray(net.output(ds.features)), axis=1)
+    assert (pred == np.argmax(ds.labels, axis=1)).mean() == 1.0
+
+
+# ------------------------------------------------------------------- MFCC
+
+def test_dct2_ortho_matches_scipy():
+    from scipy.fftpack import dct
+    rng = np.random.default_rng(1)
+    x = rng.normal(size=(5, 26))
+    np.testing.assert_allclose(_dct2_ortho(x), dct(x, type=2, norm="ortho"),
+                               rtol=1e-10, atol=1e-12)
+
+
+def test_mel_filterbank_shape_and_coverage():
+    fb = mel_filterbank(26, 512, 16000)
+    assert fb.shape == (26, 257)
+    assert np.all(fb >= 0)
+    # every filter has support; bands tile the spectrum
+    assert np.all(fb.sum(axis=1) > 0)
+
+
+def test_mfcc_shape_and_framing():
+    rng = np.random.default_rng(2)
+    sig = rng.normal(size=16000)  # 1 s @ 16 kHz
+    feats = mfcc(sig, sample_rate=16000, n_mfcc=13,
+                 frame_length=400, frame_step=160)
+    assert feats.shape == ((16000 - 400) // 160 + 1, 13)
+    assert feats.dtype == np.float32
+    assert np.all(np.isfinite(feats))
+
+
+def test_mfcc_distinguishes_tones():
+    """MFCCs of a low tone and a high tone must differ systematically —
+    the feature does its job of summarizing spectral shape."""
+    t = np.arange(16000) / 16000.0
+    low = np.sin(2 * np.pi * 200.0 * t)
+    high = np.sin(2 * np.pi * 4000.0 * t)
+    f_low = mfcc(low).mean(axis=0)
+    f_high = mfcc(high).mean(axis=0)
+    assert np.linalg.norm(f_low - f_high) > 10.0

@@ -1,0 +1,243 @@
+"""Keras-H5 import round 4: the mapper tail — Permute/Reshape/Masking/
+TimeDistributed/RepeatVector (seq2seq staples), ConvLSTM2D, SeparableConv1D,
+1D/3D pad-crop-upsample-pool variants, LocallyConnected1D/2D, AlphaDropout,
+ThresholdedReLU, asymmetric ZeroPadding2D — golden against live tf.keras
+(KerasModelEndToEndTest contract, SURVEY.md §3.5)."""
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.slow
+
+tf = pytest.importorskip("tensorflow")
+
+from deeplearning4j_tpu.modelimport import KerasModelImport  # noqa: E402
+
+RTOL, ATOL = 1e-4, 1e-4
+
+
+def _roundtrip(m, tmp_path, x, atol=ATOL):
+    p = str(tmp_path / "m.h5")
+    m.save(p)
+    net = KerasModelImport.import_keras_model_and_weights(p)
+    ref = m.predict(x, verbose=0)
+    got = np.asarray(net.output(x))
+    np.testing.assert_allclose(got, ref, rtol=RTOL, atol=atol)
+    return net
+
+
+def _randomize(m, rng, scale=0.3):
+    for wv in m.weights:
+        wv.assign(rng.normal(scale=scale, size=wv.shape).astype(np.float32))
+
+
+def test_permute_reshape(tmp_path):
+    rng = np.random.default_rng(0)
+    m = tf.keras.Sequential([
+        tf.keras.layers.Input(shape=(6, 4)),
+        tf.keras.layers.Permute((2, 1)),
+        tf.keras.layers.Reshape((2, 12)),
+        tf.keras.layers.Flatten(),
+        tf.keras.layers.Dense(3, name="out"),
+    ])
+    _randomize(m, rng)
+    _roundtrip(m, tmp_path, rng.normal(size=(3, 6, 4)).astype(np.float32))
+
+
+def test_masking_lstm(tmp_path):
+    rng = np.random.default_rng(1)
+    m = tf.keras.Sequential([
+        tf.keras.layers.Input(shape=(7, 5)),
+        tf.keras.layers.Masking(mask_value=0.0),
+        tf.keras.layers.LSTM(6, return_sequences=False, name="l"),
+        tf.keras.layers.Dense(2, name="out"),
+    ])
+    _randomize(m, rng)
+    x = rng.normal(size=(3, 7, 5)).astype(np.float32)
+    x[:, 4:, :] = 0.0  # masked tail: Keras must ignore these steps
+    _roundtrip(m, tmp_path, x)
+
+
+def test_repeat_vector_seq2seq(tmp_path):
+    rng = np.random.default_rng(2)
+    m = tf.keras.Sequential([
+        tf.keras.layers.Input(shape=(5, 3)),
+        tf.keras.layers.LSTM(4, return_sequences=False),
+        tf.keras.layers.RepeatVector(6),
+        tf.keras.layers.LSTM(4, return_sequences=True),
+        tf.keras.layers.TimeDistributed(tf.keras.layers.Dense(2)),
+    ])
+    _randomize(m, rng)
+    _roundtrip(m, tmp_path, rng.normal(size=(2, 5, 3)).astype(np.float32))
+
+
+def test_conv_lstm2d(tmp_path):
+    rng = np.random.default_rng(3)
+    m = tf.keras.Sequential([
+        tf.keras.layers.Input(shape=(4, 8, 8, 3)),
+        tf.keras.layers.ConvLSTM2D(5, (3, 3), padding="same",
+                                   return_sequences=False, name="cl"),
+        tf.keras.layers.Flatten(),
+        tf.keras.layers.Dense(2, name="out"),
+    ])
+    _randomize(m, rng)
+    _roundtrip(m, tmp_path,
+               rng.normal(size=(2, 4, 8, 8, 3)).astype(np.float32))
+
+
+def test_conv_lstm2d_sequences_valid(tmp_path):
+    rng = np.random.default_rng(4)
+    m = tf.keras.Sequential([
+        tf.keras.layers.Input(shape=(3, 10, 10, 2)),
+        tf.keras.layers.ConvLSTM2D(4, (3, 3), padding="valid",
+                                   recurrent_activation="sigmoid",
+                                   return_sequences=True, name="cl"),
+        tf.keras.layers.Reshape((3 * 8 * 8 * 4,)),
+        tf.keras.layers.Dense(2, name="out"),
+    ])
+    _randomize(m, rng)
+    _roundtrip(m, tmp_path,
+               rng.normal(size=(2, 3, 10, 10, 2)).astype(np.float32))
+
+
+def test_separable_conv1d(tmp_path):
+    rng = np.random.default_rng(5)
+    m = tf.keras.Sequential([
+        tf.keras.layers.Input(shape=(12, 6)),
+        tf.keras.layers.SeparableConv1D(8, 3, padding="same",
+                                        depth_multiplier=2,
+                                        activation="relu", name="sc"),
+        tf.keras.layers.GlobalAveragePooling1D(),
+        tf.keras.layers.Dense(3, name="out"),
+    ])
+    _randomize(m, rng)
+    _roundtrip(m, tmp_path, rng.normal(size=(3, 12, 6)).astype(np.float32))
+
+
+def test_crop_pad_upsample_1d(tmp_path):
+    rng = np.random.default_rng(6)
+    m = tf.keras.Sequential([
+        tf.keras.layers.Input(shape=(10, 4)),
+        tf.keras.layers.ZeroPadding1D((1, 2)),
+        tf.keras.layers.Conv1D(6, 3, name="c"),
+        tf.keras.layers.UpSampling1D(2),
+        tf.keras.layers.Cropping1D((2, 1)),
+        tf.keras.layers.GlobalMaxPooling1D(),
+        tf.keras.layers.Dense(2, name="out"),
+    ])
+    _randomize(m, rng)
+    _roundtrip(m, tmp_path, rng.normal(size=(3, 10, 4)).astype(np.float32))
+
+
+def test_crop_pad_upsample_pool_3d(tmp_path):
+    rng = np.random.default_rng(7)
+    m = tf.keras.Sequential([
+        tf.keras.layers.Input(shape=(6, 8, 8, 2)),
+        tf.keras.layers.ZeroPadding3D(1),
+        tf.keras.layers.Conv3D(4, (3, 3, 3), name="c"),
+        tf.keras.layers.MaxPooling3D((2, 2, 2)),
+        tf.keras.layers.UpSampling3D((2, 2, 2)),
+        tf.keras.layers.Cropping3D(((1, 1), (1, 1), (1, 1))),
+        tf.keras.layers.GlobalAveragePooling3D(),
+        tf.keras.layers.Dense(2, name="out"),
+    ])
+    _randomize(m, rng)
+    _roundtrip(m, tmp_path,
+               rng.normal(size=(2, 6, 8, 8, 2)).astype(np.float32))
+
+
+def test_average_pooling3d(tmp_path):
+    rng = np.random.default_rng(8)
+    m = tf.keras.Sequential([
+        tf.keras.layers.Input(shape=(4, 6, 6, 3)),
+        tf.keras.layers.AveragePooling3D((2, 2, 2)),
+        tf.keras.layers.GlobalMaxPooling3D(),
+        tf.keras.layers.Dense(2, name="out"),
+    ])
+    _randomize(m, rng)
+    _roundtrip(m, tmp_path,
+               rng.normal(size=(2, 4, 6, 6, 3)).astype(np.float32))
+
+
+def test_locally_connected2d_mapper_numpy_oracle():
+    # Keras 3 removed LocallyConnected*; golden vs a numpy reference of the
+    # Keras-2 semantics instead (kernel [P, kh*kw*cin, F], valid padding)
+    from deeplearning4j_tpu.modelimport import keras as kimp
+    rng = np.random.default_rng(9)
+    H = W = 6; C = 3; F = 4; K = 3
+    ho = wo = H - K + 1
+    kernel = rng.normal(size=(ho * wo, K * K * C, F)).astype(np.float32)
+    bias = rng.normal(size=(ho, wo, F)).astype(np.float32)
+    m = kimp._MAPPERS["LocallyConnected2D"]({
+        "filters": F, "kernel_size": [K, K], "activation": "linear"})
+    params = m.weights([kernel, bias])
+    import jax
+    p = {k: np.asarray(v) for k, v in params.items()}
+    _, _, out_shape = m.layer.initialize(jax.random.PRNGKey(0), (H, W, C),
+                                         np.float32)
+    assert out_shape == (ho, wo, F)
+    x = rng.normal(size=(2, H, W, C)).astype(np.float32)
+    y, _, _ = m.layer.apply(p, x, {})
+    ref = np.zeros((2, ho, wo, F), np.float32)
+    for i in range(ho):
+        for j in range(wo):
+            patch = x[:, i:i + K, j:j + K, :].reshape(2, -1)
+            ref[:, i, j, :] = patch @ kernel[i * wo + j] + bias[i, j]
+    np.testing.assert_allclose(np.asarray(y), ref, rtol=1e-4, atol=1e-4)
+
+
+def test_locally_connected1d_mapper_numpy_oracle():
+    from deeplearning4j_tpu.modelimport import keras as kimp
+    rng = np.random.default_rng(10)
+    T = 9; Fin = 5; F = 4; K = 3
+    to = T - K + 1
+    kernel = rng.normal(size=(to, K * Fin, F)).astype(np.float32)
+    bias = rng.normal(size=(to, F)).astype(np.float32)
+    m = kimp._MAPPERS["LocallyConnected1D"]({
+        "filters": F, "kernel_size": [K], "activation": "linear"})
+    params = {k: np.asarray(v) for k, v in m.weights([kernel, bias]).items()}
+    x = rng.normal(size=(2, T, Fin)).astype(np.float32)
+    y, _, _ = m.layer.apply(params, x, {})
+    ref = np.zeros((2, to, F), np.float32)
+    for t in range(to):
+        patch = x[:, t:t + K, :].reshape(2, -1)
+        ref[:, t, :] = patch @ kernel[t] + bias[t]
+    np.testing.assert_allclose(np.asarray(y), ref, rtol=1e-4, atol=1e-4)
+
+
+def test_thresholded_relu_alpha_dropout(tmp_path):
+    rng = np.random.default_rng(11)
+    m = tf.keras.Sequential([
+        tf.keras.layers.Input(shape=(6,)),
+        tf.keras.layers.Dense(8, name="d"),
+        tf.keras.layers.ThresholdedReLU(theta=0.5),
+        tf.keras.layers.AlphaDropout(0.2),  # inference: identity
+        tf.keras.layers.Dense(2, name="out"),
+    ])
+    _randomize(m, rng)
+    _roundtrip(m, tmp_path, rng.normal(size=(4, 6)).astype(np.float32))
+
+
+def test_asymmetric_zeropadding2d(tmp_path):
+    rng = np.random.default_rng(12)
+    m = tf.keras.Sequential([
+        tf.keras.layers.Input(shape=(7, 7, 3)),
+        tf.keras.layers.ZeroPadding2D(((0, 1), (1, 0))),
+        tf.keras.layers.Conv2D(4, (3, 3), name="c"),
+        tf.keras.layers.Flatten(),
+        tf.keras.layers.Dense(2, name="out"),
+    ])
+    _randomize(m, rng)
+    _roundtrip(m, tmp_path, rng.normal(size=(2, 7, 7, 3)).astype(np.float32))
+
+
+def test_spatial_dropout_1d_3d_inference_identity(tmp_path):
+    rng = np.random.default_rng(13)
+    m = tf.keras.Sequential([
+        tf.keras.layers.Input(shape=(6, 4)),
+        tf.keras.layers.SpatialDropout1D(0.3),
+        tf.keras.layers.Conv1D(5, 3, name="c"),
+        tf.keras.layers.GlobalAveragePooling1D(),
+        tf.keras.layers.Dense(2, name="out"),
+    ])
+    _randomize(m, rng)
+    _roundtrip(m, tmp_path, rng.normal(size=(3, 6, 4)).astype(np.float32))
