@@ -2,3 +2,5 @@ from .dataset import (AsyncDataSetIterator, DataSet, DataSetIterator,  # noqa: F
                       ListDataSetIterator, NumpyDataSetIterator)
 from .normalizers import (ImagePreProcessingScaler, Normalizer,  # noqa: F401
                           NormalizerMinMaxScaler, NormalizerStandardize)
+from .svhn import (SvhnDataSetIterator,  # noqa: F401
+                   TinyImageNetDataSetIterator)

@@ -64,6 +64,10 @@ class MultiLayerConfiguration:
     gradient_normalization_threshold: float = 1.0
     tbptt_length: Optional[int] = None               # truncated BPTT window
     constraints: Any = None                          # [(BaseConstraint, scope)]
+    #: SGD | LBFGS | CONJUGATE_GRADIENT | LINE_GRADIENT_DESCENT
+    optimization_algo: str = "SGD"
+    solver_iterations: int = 5                       # per-batch solver iters
+    max_line_search_iterations: int = 5              # BackTrackLineSearch
 
     def to_json(self) -> str:
         d = {
@@ -82,6 +86,9 @@ class MultiLayerConfiguration:
                 self.gradient_normalization_threshold,
             "tbptt_length": self.tbptt_length,
             "constraints": _constraints.encode_constraints(self.constraints),
+            "optimization_algo": self.optimization_algo,
+            "solver_iterations": self.solver_iterations,
+            "max_line_search_iterations": self.max_line_search_iterations,
             "layers": [l.to_dict() for l in self.layers],
         }
         return json.dumps(d, indent=2)
@@ -104,6 +111,9 @@ class MultiLayerConfiguration:
                 "gradient_normalization_threshold", 1.0),
             tbptt_length=d.get("tbptt_length"),
             constraints=_constraints.decode_constraints(d.get("constraints")),
+            optimization_algo=d.get("optimization_algo", "SGD"),
+            solver_iterations=d.get("solver_iterations", 5),
+            max_line_search_iterations=d.get("max_line_search_iterations", 5),
         )
 
 
@@ -124,6 +134,9 @@ class NeuralNetConfiguration:
         self._input_shape = None
         self._tbptt = None
         self._constraints = []
+        self._opt_algo = "SGD"
+        self._solver_iterations = 5
+        self._max_ls_iterations = 5
 
     @staticmethod
     def builder() -> "NeuralNetConfiguration":
@@ -166,6 +179,22 @@ class NeuralNetConfiguration:
         _gn.validate(mode)
         self._grad_norm = mode
         self._grad_norm_threshold = float(threshold)
+        return self
+
+    def optimization_algo(self, name: str, iterations: int = 5,
+                          max_line_search_iterations: int = 5):
+        """DL4J ``optimizationAlgo(OptimizationAlgorithm.X)``: SGD (default
+        fused-step fit path) or LBFGS / CONJUGATE_GRADIENT /
+        LINE_GRADIENT_DESCENT (per-batch Solver.optimize path)."""
+        name = str(name).upper()
+        if name not in ("SGD", "STOCHASTIC_GRADIENT_DESCENT"):
+            from ..optimize.solvers import get_solver
+            get_solver(name, iterations, max_line_search_iterations)  # validate
+            self._opt_algo = name
+        else:
+            self._opt_algo = "SGD"
+        self._solver_iterations = int(iterations)
+        self._max_ls_iterations = int(max_line_search_iterations)
         return self
 
     def tbptt_length(self, n: int):
@@ -219,7 +248,10 @@ class NeuralNetConfiguration:
             gradient_clip_value=self._clip_value, gradient_clip_l2=self._clip_l2,
             gradient_normalization=self._grad_norm,
             gradient_normalization_threshold=self._grad_norm_threshold,
-            tbptt_length=self._tbptt, constraints=self._constraints or None)
+            tbptt_length=self._tbptt, constraints=self._constraints or None,
+            optimization_algo=self._opt_algo,
+            solver_iterations=self._solver_iterations,
+            max_line_search_iterations=self._max_ls_iterations)
 
 
 def stamp_tbptt(layer: Layer, tbptt: int) -> Layer:

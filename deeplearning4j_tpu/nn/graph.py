@@ -547,6 +547,7 @@ class ComputationGraph:
                 lms = tuple(None if m is None else jnp.asarray(m)
                             for m in mds.labels_masks)
                 step = jnp.asarray(self.iteration, dtype=jnp.int32)
+                self._last_batch = xs  # StatsListener activation sampling
                 self.params, self.updater_state, self.state, loss = \
                     self._train_step(self.params, self.updater_state,
                                      self.state, step, sub, xs, ys, fms, lms)
@@ -561,6 +562,14 @@ class ComputationGraph:
         return self
 
     # ------------------------------------------------------------- inference
+    def feed_forward(self, *inputs, train: bool = False):
+        """All vertex activations for the given inputs (DL4J
+        ``ComputationGraph.feedForward()``): {vertex_name: activation}."""
+        ins = dict(zip(self.conf.inputs, inputs))
+        acts, _, _ = self._forward(self.params, ins, self.state,
+                                   train=train, rng=None)
+        return acts
+
     def output(self, *inputs, train: bool = False):
         """Output activations for the network outputs. Returns a single array
         when the graph has one output, else a list (DL4J ``output()``)."""

@@ -87,6 +87,7 @@ class MultiLayerNetwork:
         self._rnn_step_fn = None
         self._rnn_stream = None
         self._epoch_fn = None
+        self._solver = None
         self._key = jax.random.PRNGKey(conf.seed)
         self._out_layer = self.layers[-1] if self.layers else None
         if self.layers and not _is_loss_head(self._out_layer):
@@ -121,14 +122,18 @@ class MultiLayerNetwork:
         self._rnn_step_fn = None
         self._rnn_stream = None
         self._epoch_fn = None
+        self._solver = None
         return self
 
     def num_params(self) -> int:
         return sum(int(np.prod(l.shape)) for l in jax.tree.leaves(self.params))
 
     # --------------------------------------------------------------- forward
-    def _forward(self, params, x, state, *, train, rng, mask=None):
-        """Pure layer stack walk. Returns (out, new_state)."""
+    def _forward(self, params, x, state, *, train, rng, mask=None,
+                 collect=False):
+        """Pure layer stack walk. Returns (out, new_state, mask), or
+        (acts_list, new_state, mask) with ``collect=True`` (acts_list is
+        [input, layer0_out, ...] — feedForward semantics)."""
         dt = _dt.resolve(self.conf.dtype)
         if jnp.issubdtype(dt, jnp.floating) and \
                 jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating) and \
@@ -139,6 +144,7 @@ class MultiLayerNetwork:
             # through the cast and land in fp32
             params = _dt.cast_floating(params, dt)
         new_state = dict(state)
+        acts = [x]
         for i, layer in enumerate(self.layers):
             si = str(i)
             p = params.get(si, {})
@@ -148,9 +154,11 @@ class MultiLayerNetwork:
             else:
                 sub = None
             x, s_new, mask = layer.apply(p, x, s, train=train, rng=sub, mask=mask)
+            if collect:
+                acts.append(x)
             if s_new:
                 new_state[si] = s_new
-        return x, new_state, mask
+        return (acts if collect else x), new_state, mask
 
     def _regularization(self, params):
         """Per-layer l1/l2 on weights (DL4J regularizes W, not b, by default)."""
@@ -313,6 +321,9 @@ class MultiLayerNetwork:
         it = _as_iterator(data, labels)
         if self._out_layer is None:
             raise ValueError("last layer must be an OutputLayer/LossLayer to fit()")
+        algo = getattr(self.conf, "optimization_algo", "SGD") or "SGD"
+        if algo.upper() not in ("SGD", "STOCHASTIC_GRADIENT_DESCENT"):
+            return self._fit_with_solver(data, labels, epochs)
         if self._train_step is None:
             self._train_step = self._build_train_step()
 
@@ -324,6 +335,7 @@ class MultiLayerNetwork:
                 fm = None if ds.features_mask is None else jnp.asarray(ds.features_mask)
                 lm = None if ds.labels_mask is None else jnp.asarray(ds.labels_mask)
                 step = jnp.asarray(self.iteration, dtype=jnp.int32)  # traced, no retrace per step
+                self._last_batch = x  # StatsListener activation sampling
                 self.params, self.updater_state, self.state, loss = \
                     self._train_step(self.params, self.updater_state, self.state,
                                      step, sub, x, y, fm, lm)
@@ -338,6 +350,46 @@ class MultiLayerNetwork:
                 cb.on_epoch_end(self)
             it = _as_iterator(data, labels)  # fresh pass
         return self
+
+    def _fit_with_solver(self, data, labels, epochs: int
+                         ) -> "MultiLayerNetwork":
+        """DL4J Solver.optimize path (§3.1): LBFGS/CG/line-search per batch
+        instead of the fused SGD step."""
+        from ..optimize.solvers import Solver
+        if self._solver is None:
+            self._solver = Solver(
+                self, self.conf.optimization_algo,
+                iterations=getattr(self.conf, "solver_iterations", 5),
+                max_line_search_iterations=getattr(
+                    self.conf, "max_line_search_iterations", 5))
+        it = _as_iterator(data, labels)
+        for _ in range(epochs):
+            for ds in it:
+                x = jnp.asarray(ds.features)
+                y = jnp.asarray(ds.labels)
+                fm = None if ds.features_mask is None else \
+                    jnp.asarray(ds.features_mask)
+                lm = None if ds.labels_mask is None else \
+                    jnp.asarray(ds.labels_mask)
+                self._last_batch = x  # StatsListener activation sampling
+                self._key, sub = jax.random.split(self._key)
+                self._score = self._solver.optimize(x, y, fm, lm, key=sub)
+                self.iteration += 1
+                for cb in self._listeners:
+                    cb.iteration_done(self, self.iteration, self.epoch)
+            self.epoch += 1
+            for cb in self._listeners:
+                cb.on_epoch_end(self)
+            it = _as_iterator(data, labels)
+        return self
+
+    def feed_forward(self, x, train: bool = False, rng=None):
+        """Per-layer activations for input ``x`` (DL4J ``feedForward()``:
+        returns the activation of every layer, input first). ``rng`` feeds
+        stochastic layers when ``train=True`` (None = deterministic)."""
+        acts, _, _ = self._forward(self.params, jnp.asarray(x), self.state,
+                                   train=train, rng=rng, collect=True)
+        return acts
 
     # ------------------------------------------------------------- inference
     def output(self, x, train: bool = False):

@@ -1,0 +1,275 @@
+"""Second-order-ish solvers: BackTrackLineSearch, LBFGS, ConjugateGradient.
+
+TPU-native equivalent of DL4J's legacy solver stack (reference:
+``deeplearning4j-nn .../optimize/solvers/{BackTrackLineSearch,LBFGS,
+ConjugateGradient,StochasticGradientDescent}.java``† per SURVEY.md §2.4
+optimizers row; reference mount was empty, citations upstream-relative,
+unverified).
+
+Design: the solvers are HOST-side control loops over ONE jitted
+value-and-grad of the network loss on a flat parameter vector (the
+flat-param contract, SURVEY.md §7.3.5) — each inner iteration is a single
+device call; the line search reuses the same compiled function. DL4J runs
+its solver per minibatch inside ``Solver.optimize`` (§3.1 call stack);
+``MultiLayerNetwork.fit`` routes here when the config says
+``optimization_algo("LBFGS" | "CONJUGATE_GRADIENT" |
+"LINE_GRADIENT_DESCENT")``.
+
+Recorded divergences: BatchNorm running averages are not refreshed by
+solver iterations (DL4J's computeGradientAndScore does refresh them);
+CenterLossOutputLayer is unsupported under solvers; dropout draws ONE mask
+per optimize() call (per minibatch) rather than per forward — the line
+search needs a deterministic objective, so every trial within a batch sees
+the same mask.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class BackTrackLineSearch:
+    """Armijo backtracking (reference BackTrackLineSearch†: sufficient
+    decrease with geometric step contraction; DL4J defaults
+    maxIterations=5)."""
+
+    def __init__(self, max_iterations: int = 5, c1: float = 1e-4,
+                 contraction: float = 0.5, initial_step: float = 1.0,
+                 min_step: float = 1e-12):
+        self.max_iterations = int(max_iterations)
+        self.c1 = float(c1)
+        self.contraction = float(contraction)
+        self.initial_step = float(initial_step)
+        self.min_step = float(min_step)
+
+    def search(self, f: Callable[[jnp.ndarray], Tuple],
+               x: jnp.ndarray, fx: float, g: jnp.ndarray,
+               direction: jnp.ndarray, initial: Optional[float] = None):
+        """-> (step, new_x, new_f, new_g); step 0.0 means no acceptable
+        point was found (caller should fall back to plain gradient).
+        ``initial`` overrides the first trial step (solvers pass the
+        previous accepted step so the search adapts to the local scale).
+        When the first trial already satisfies Armijo, the step is
+        greedily doubled while it keeps satisfying it (cheap bracketing —
+        pure backtracking stalls nonlinear CG on anisotropic bowls)."""
+        slope = float(jnp.vdot(g, direction))
+        if slope >= 0:  # not a descent direction
+            return 0.0, x, fx, g
+        step = float(initial) if initial else self.initial_step
+
+        def trial(s):
+            x_n = x + s * direction
+            f_n, g_n = f(x_n)
+            return float(f_n), x_n, g_n
+
+        f_new, x_new, g_new = trial(step)
+        if np.isfinite(f_new) and f_new <= fx + self.c1 * step * slope:
+            # expansion: accept the largest doubling that still satisfies
+            for _ in range(self.max_iterations):
+                f2, x2, g2 = trial(step * 2.0)
+                if not (np.isfinite(f2)
+                        and f2 <= fx + self.c1 * step * 2.0 * slope
+                        and f2 < f_new):
+                    break
+                step *= 2.0
+                f_new, x_new, g_new = f2, x2, g2
+            return step, x_new, f_new, g_new
+        # backtracking runs to min_step regardless of max_iterations: a
+        # stiff direction may need a tiny step, and giving up early turns
+        # the whole solver iteration into a no-op (~40 trials worst case)
+        while True:
+            step *= self.contraction
+            if step < self.min_step:
+                break
+            f_new, x_new, g_new = trial(step)
+            if np.isfinite(f_new) and f_new <= fx + self.c1 * step * slope:
+                return step, x_new, f_new, g_new
+        return 0.0, x, fx, g
+
+
+class LBFGS:
+    """Limited-memory BFGS, two-loop recursion (reference LBFGS†; memory
+    default mirrors DL4J's m=10)."""
+
+    def __init__(self, iterations: int = 5, memory: int = 10,
+                 line_search: Optional[BackTrackLineSearch] = None,
+                 tolerance: float = 1e-8):
+        self.iterations = int(iterations)
+        self.memory = int(memory)
+        self.line_search = line_search or BackTrackLineSearch()
+        self.tolerance = float(tolerance)
+
+    def minimize(self, f, x0) -> Tuple[jnp.ndarray, float]:
+        x = jnp.asarray(x0)
+        fx, g = f(x)
+        fx = float(fx)
+        s_hist, y_hist, rho = [], [], []
+        for _ in range(self.iterations):
+            # two-loop recursion
+            q = g
+            alphas = []
+            for s, y, r in zip(reversed(s_hist), reversed(y_hist),
+                               reversed(rho)):
+                a = r * float(jnp.vdot(s, q))
+                alphas.append(a)
+                q = q - a * y
+            if y_hist:
+                ys = float(jnp.vdot(s_hist[-1], y_hist[-1]))
+                yy = float(jnp.vdot(y_hist[-1], y_hist[-1]))
+                q = q * (ys / max(yy, 1e-20))
+            for (s, y, r), a in zip(zip(s_hist, y_hist, rho),
+                                    reversed(alphas)):
+                b = r * float(jnp.vdot(y, q))
+                q = q + (a - b) * s
+            direction = -q
+            step, x_new, f_new, g_new = self.line_search.search(
+                f, x, fx, g, direction)
+            if step == 0.0:
+                # fall back to steepest descent once; stop if that fails too
+                step, x_new, f_new, g_new = self.line_search.search(
+                    f, x, fx, g, -g)
+                if step == 0.0:
+                    break
+            s = x_new - x
+            y = g_new - g
+            sy = float(jnp.vdot(s, y))
+            if sy > 1e-10:  # curvature condition: keep the pair
+                s_hist.append(s)
+                y_hist.append(y)
+                rho.append(1.0 / sy)
+                if len(s_hist) > self.memory:
+                    s_hist.pop(0); y_hist.pop(0); rho.pop(0)
+            if abs(fx - f_new) < self.tolerance:
+                x, fx, g = x_new, f_new, g_new
+                break
+            x, fx, g = x_new, f_new, g_new
+        return x, fx
+
+
+class ConjugateGradient:
+    """Nonlinear CG, Polak-Ribière+ with automatic restart (reference
+    ConjugateGradient†)."""
+
+    def __init__(self, iterations: int = 5,
+                 line_search: Optional[BackTrackLineSearch] = None,
+                 tolerance: float = 1e-8):
+        self.iterations = int(iterations)
+        self.line_search = line_search or BackTrackLineSearch()
+        self.tolerance = float(tolerance)
+
+    def minimize(self, f, x0) -> Tuple[jnp.ndarray, float]:
+        x = jnp.asarray(x0)
+        fx, g = f(x)
+        fx = float(fx)
+        d = -g
+        prev_step = None
+        for _ in range(self.iterations):
+            step, x_new, f_new, g_new = self.line_search.search(
+                f, x, fx, g, d, initial=prev_step)
+            if step == 0.0:
+                break
+            prev_step = step
+            gg = float(jnp.vdot(g, g))
+            beta = max(0.0, float(jnp.vdot(g_new, g_new - g)) /
+                       max(gg, 1e-20))  # PR+ (restart on negative)
+            d = -g_new + beta * d
+            if abs(fx - f_new) < self.tolerance:
+                x, fx, g = x_new, f_new, g_new
+                break
+            x, fx, g = x_new, f_new, g_new
+        return x, fx
+
+
+class LineGradientDescent:
+    """Steepest descent with line search (reference
+    LineGradientDescent†)."""
+
+    def __init__(self, iterations: int = 5,
+                 line_search: Optional[BackTrackLineSearch] = None):
+        self.iterations = int(iterations)
+        self.line_search = line_search or BackTrackLineSearch()
+
+    def minimize(self, f, x0) -> Tuple[jnp.ndarray, float]:
+        x = jnp.asarray(x0)
+        fx, g = f(x)
+        fx = float(fx)
+        for _ in range(self.iterations):
+            step, x, fx, g = self.line_search.search(f, x, fx, g, -g)
+            if step == 0.0:
+                break
+        return x, fx
+
+
+_SOLVERS = {
+    "LBFGS": LBFGS,
+    "CONJUGATE_GRADIENT": ConjugateGradient,
+    "LINE_GRADIENT_DESCENT": LineGradientDescent,
+}
+
+
+def get_solver(name: str, iterations: int = 5,
+               max_line_search_iterations: int = 5):
+    key = str(name).upper()
+    if key not in _SOLVERS:
+        raise ValueError(f"unknown optimization_algo {name!r}; known: "
+                         f"SGD (default fit path) + {sorted(_SOLVERS)}")
+    return _SOLVERS[key](
+        iterations=iterations,
+        line_search=BackTrackLineSearch(
+            max_iterations=max_line_search_iterations))
+
+
+class Solver:
+    """DL4J ``Solver`` equivalent: owns the jitted flat value-and-grad of a
+    model's loss and runs the configured algorithm per minibatch."""
+
+    def __init__(self, model, algo: str, iterations: int = 5,
+                 max_line_search_iterations: int = 5):
+        self.model = model
+        self.opt = get_solver(algo, iterations, max_line_search_iterations)
+        self._vg = None
+        self._unravel = None
+
+    def _build(self, x, y, fm, lm):
+        from jax.flatten_util import ravel_pytree
+        model = self.model
+        _, unravel = ravel_pytree(model.params)
+        self._unravel = unravel
+
+        out_layer = model._out_layer
+        from ..ops import losses as _loss
+
+        def loss_fn(vec, x, y, fm, lm, key):
+            params = unravel(vec)
+            out, _, out_mask = model._forward(
+                params, x, model.state, train=True, rng=key, mask=fm)
+            m = _loss.combine_masks(lm, out_mask)
+            data_loss = out_layer.loss_value(
+                out, y, mask=m,
+                weights=getattr(out_layer, "loss_weights", None))
+            return data_loss + model._regularization(params)
+
+        vg = jax.jit(jax.value_and_grad(loss_fn))
+        self._vg = vg
+
+    def optimize(self, x, y, fm=None, lm=None, key=None) -> float:
+        """One DL4J Solver.optimize call: run the algorithm's iterations on
+        this batch, write the result back into the model. Returns the final
+        loss. ``key`` seeds dropout/noise for the WHOLE call (held fixed so
+        the line-search objective is deterministic)."""
+        from jax.flatten_util import ravel_pytree
+        model = self.model
+        if self._vg is None:
+            self._build(x, y, fm, lm)
+        vec0, _ = ravel_pytree(model.params)
+
+        def f(vec):
+            return self._vg(vec, x, y, fm, lm, key)
+
+        vec, fx = self.opt.minimize(f, vec0)
+        model.params = self._unravel(vec)
+        return fx

@@ -42,6 +42,13 @@ _PAGE = """<!DOCTYPE html>
  style="height:260px"></canvas></div>
 <div class="card"><h2>parameter / update histograms (latest)</h2>
 <div id="hists"></div></div>
+<div class="card"><h2>activation mean per layer</h2>
+<canvas id="actmean"></canvas></div>
+<div class="card"><h2>activation std per layer</h2>
+<canvas id="actstd"></canvas></div>
+<div class="card"><h2>activation histograms (latest)</h2>
+<div id="acthists"></div></div>
+<div class="card"><h2>device memory (MiB)</h2><canvas id="mem"></canvas></div>
 <script>
 function drawHist(canvas, h, color) {
   const ctx = canvas.getContext('2d');
@@ -143,8 +150,27 @@ async function tick() {
   draw('score', {score: d.score}, false);
   draw('ratio', d.ratios, true);
   draw('speed', {ips: d.speed}, false);
+  draw('actmean', d.activations_mean, false);
+  draw('actstd', d.activations_std, false);
+  draw('mem', {mem: d.device_memory_mb}, false);
   drawGraph('graph', d.graph);
   renderHists(d.histograms);
+  renderActHists(d.activation_histograms);
+}
+function renderActHists(hists) {
+  const div = document.getElementById('acthists');
+  if (!hists) return;
+  const names = Object.keys(hists);
+  if (div.dataset.sig !== names.join(',')) {
+    div.dataset.sig = names.join(',');
+    div.innerHTML = names.map((n,i) =>
+      '<div style="display:flex;align-items:center;margin:2px 0">' +
+      '<span style="width:180px;font-size:.75em;color:#555">'+n+'</span>' +
+      '<canvas id="ha'+i+'" style="width:240px;height:60px"></canvas>' +
+      '</div>').join('');
+  }
+  names.forEach((n,i)=>drawHist(document.getElementById('ha'+i),
+                                hists[n], '#393'));
 }
 tick(); setInterval(tick, 2000);
 </script></body></html>"""
@@ -221,6 +247,27 @@ class UIServer:
                             "counts": s["hist_counts"],
                             "edges": s["hist_edges"]}
                 break
+        # activation mean/std series + latest activation histograms
+        act_mean: dict = {}
+        act_std: dict = {}
+        act_hists: dict = {}
+        for r in stats:
+            for path, s in r.get("activations", {}).items():
+                act_mean.setdefault(path, []).append(
+                    [r["iteration"], s["mean"]])
+                act_std.setdefault(path, []).append(
+                    [r["iteration"], s["std"]])
+        for r in reversed(stats):
+            acts = r.get("activations", {})
+            if any("hist_counts" in s for s in acts.values()):
+                for path, s in acts.items():
+                    if "hist_counts" in s:
+                        act_hists[path] = {"counts": s["hist_counts"],
+                                           "edges": s["hist_edges"]}
+                break
+        memory = [[r["iteration"],
+                   r["device_memory"]["bytes_in_use"] / 2 ** 20]
+                  for r in stats if r.get("device_memory")]
         return {
             "num_records": len(stats),
             "model_class": meta.get("model_class"),
@@ -230,6 +277,10 @@ class UIServer:
             "speed": [[r["iteration"], r["iterations_per_sec"]]
                       for r in stats if r.get("iterations_per_sec")],
             "histograms": histograms,
+            "activations_mean": act_mean,
+            "activations_std": act_std,
+            "activation_histograms": act_hists,
+            "device_memory_mb": memory,
             "graph": _model_graph(meta.get("configuration")),
         }
 

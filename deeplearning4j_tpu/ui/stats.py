@@ -52,15 +52,67 @@ def _walk(tree, prefix=""):
 class StatsListener(TrainingListener):
     def __init__(self, storage: Optional[StatsStorage] = None,
                  frequency: int = 10, session_id: Optional[str] = None,
-                 collect_histograms: bool = True):
+                 collect_histograms: bool = True,
+                 collect_activations: bool = True,
+                 activation_sample: int = 32):
         self.storage = storage if storage is not None else InMemoryStatsStorage()
         self.frequency = max(1, int(frequency))
         self.session_id = session_id or f"train-{uuid.uuid4().hex[:8]}"
         self.collect_histograms = collect_histograms
+        self.collect_activations = collect_activations
+        self.activation_sample = int(activation_sample)
         self._prev_params: Optional[Dict[str, np.ndarray]] = None
         self._prev_iteration: Optional[int] = None
         self._last_time = None
         self._meta_written = False
+
+    def _activation_stats(self, model) -> Optional[dict]:
+        """Per-layer activation stats from the model's LAST training batch
+        (reference StatsListener collects activation mean/std/histograms the
+        same way — from the in-flight minibatch). Subsampled to
+        ``activation_sample`` examples to bound the extra forward pass."""
+        batch = getattr(model, "_last_batch", None)
+        ff = getattr(model, "feed_forward", None)
+        if batch is None or ff is None:
+            return None
+        try:
+            if isinstance(batch, tuple):  # ComputationGraph: input tuple
+                sample = tuple(b[:self.activation_sample] for b in batch)
+                acts = ff(*sample, train=False)
+                inputs = set(getattr(model.conf, "inputs", ()) or ())
+                # drop the raw input vertices: charting pixel stats as
+                # "activations" dwarfs the real series (MLN path drops the
+                # input via acts[1:] the same way)
+                items = ((k, v) for k, v in acts.items() if k not in inputs)
+            else:
+                sample = batch[:self.activation_sample]
+                acts = ff(sample, train=False)
+                items = ((str(i), a) for i, a in enumerate(acts[1:]))
+            out = {}
+            for name, a in items:
+                st = _leaf_stats(np.asarray(a))
+                if not self.collect_histograms:
+                    st.pop("hist_counts"), st.pop("hist_edges")
+                out[str(name)] = st
+            return out
+        except Exception:
+            return None  # stats must never kill training
+
+    @staticmethod
+    def _device_memory() -> Optional[dict]:
+        """Device HBM series (reference dashboard's system-metrics pane;
+        ours reads PJRT memory_stats — not every backend reports them)."""
+        try:
+            import jax
+            d = jax.local_devices()[0]
+            ms = d.memory_stats()
+            if not ms:
+                return None
+            return {"bytes_in_use": int(ms.get("bytes_in_use", 0)),
+                    "peak_bytes_in_use": int(ms.get("peak_bytes_in_use", 0)),
+                    "bytes_limit": int(ms.get("bytes_limit", 0))}
+        except Exception:
+            return None
 
     def _write_meta(self, model):
         self.storage.put_record({
@@ -111,6 +163,13 @@ class StatsListener(TrainingListener):
                 resource.RUSAGE_SELF).ru_maxrss / 1024.0
         except Exception:
             pass
+        if self.collect_activations:
+            act = self._activation_stats(model)
+            if act:
+                record["activations"] = act
+        dm = self._device_memory()
+        if dm:
+            record["device_memory"] = dm
         self._prev_params = cur
         self._prev_iteration = iteration
         self.storage.put_record(record)

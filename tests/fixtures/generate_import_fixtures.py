@@ -1,0 +1,116 @@
+"""Regenerate the committed importer smoke fixtures (run with live tf/torch):
+
+    python tests/fixtures/generate_import_fixtures.py
+
+Produces, next to this script:
+  keras_smoke.h5      — tiny Sequential (Conv2D/BN/pool/Dense) + recorded IO
+  tf_smoke.pb         — frozen GraphDef MLP (MatMul/BiasAdd/Relu/Softmax)
+  onnx_smoke.onnx     — torch conv-net export (Conv/Relu/MaxPool/Gemm)
+  import_smoke_io.npz — inputs + recorded reference outputs for all three
+
+The fast suite's test_import_smoke.py replays these with NO live tf/torch —
+the pre-built files + recorded outputs are the oracle (the reference keeps
+its import fixtures in dl4j-test-resources the same way, SURVEY.md §4).
+"""
+import io
+import os
+import sys
+
+import numpy as np
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.dirname(os.path.dirname(HERE)))  # repo root
+
+
+def gen_keras():
+    import tensorflow as tf
+    rng = np.random.default_rng(0)
+    m = tf.keras.Sequential([
+        tf.keras.layers.Input(shape=(8, 8, 3)),
+        tf.keras.layers.Conv2D(4, (3, 3), padding="same", activation="relu",
+                               name="c1"),
+        tf.keras.layers.BatchNormalization(name="bn"),
+        tf.keras.layers.MaxPooling2D((2, 2), name="p1"),
+        tf.keras.layers.Flatten(name="f"),
+        tf.keras.layers.Dense(5, activation="softmax", name="out"),
+    ])
+    for wv in m.weights:
+        wv.assign(rng.normal(scale=0.3, size=wv.shape).astype(np.float32))
+    # positive running variance
+    m.get_layer("bn").moving_variance.assign(
+        rng.uniform(0.5, 1.5, size=(4,)).astype(np.float32))
+    x = rng.normal(size=(2, 8, 8, 3)).astype(np.float32)
+    y = m.predict(x, verbose=0)
+    m.save(os.path.join(HERE, "keras_smoke.h5"))
+    return x, y
+
+
+def gen_tf():
+    import tensorflow as tf
+    from tensorflow.python.framework.convert_to_constants import (
+        convert_variables_to_constants_v2)
+    rng = np.random.default_rng(1)
+    w1 = tf.constant(rng.normal(size=(6, 8)).astype(np.float32))
+    b1 = tf.constant(rng.normal(size=(8,)).astype(np.float32))
+    w2 = tf.constant(rng.normal(size=(8, 3)).astype(np.float32))
+    b2 = tf.constant(rng.normal(size=(3,)).astype(np.float32))
+
+    @tf.function
+    def f(x):
+        h = tf.nn.relu(tf.linalg.matmul(x, w1) + b1)
+        return tf.nn.softmax(tf.linalg.matmul(h, w2) + b2)
+
+    conc = f.get_concrete_function(tf.TensorSpec([None, 6], tf.float32))
+    frozen = convert_variables_to_constants_v2(conc)
+    gd = frozen.graph.as_graph_def()
+    x = rng.normal(size=(3, 6)).astype(np.float32)
+    y = f(tf.constant(x)).numpy()
+    with open(os.path.join(HERE, "tf_smoke.pb"), "wb") as fh:
+        fh.write(gd.SerializeToString())
+    iname = frozen.inputs[0].name.split(":")[0]
+    oname = frozen.outputs[0].name.split(":")[0]
+    return x, y, iname, oname
+
+
+def gen_onnx():
+    import sys
+    import types
+    import torch
+    if "onnx" not in sys.modules:  # see test_onnx_import_r4.py
+        from deeplearning4j_tpu.modelimport.proto import onnx_min_pb2 as P
+
+        def _load(data):
+            m = P.ModelProto()
+            m.ParseFromString(data)
+            return m
+        stub = types.ModuleType("onnx")
+        stub.load_model_from_string = _load
+        sys.modules["onnx"] = stub
+    torch.manual_seed(2)
+    tm = torch.nn.Sequential(
+        torch.nn.Conv2d(2, 4, 3, padding=1), torch.nn.ReLU(),
+        torch.nn.MaxPool2d(2), torch.nn.Flatten(),
+        torch.nn.Linear(4 * 4 * 4, 3)).eval()
+    x = np.random.default_rng(2).normal(size=(2, 2, 8, 8)).astype(np.float32)
+    buf = io.BytesIO()
+    torch.onnx.export(tm, (torch.from_numpy(x),), buf, opset_version=13,
+                      input_names=["x"], output_names=["y"], dynamo=False)
+    with torch.no_grad():
+        y = tm(torch.from_numpy(x)).numpy()
+    with open(os.path.join(HERE, "onnx_smoke.onnx"), "wb") as fh:
+        fh.write(buf.getvalue())
+    return x, y
+
+
+def main():
+    kx, ky = gen_keras()
+    tx, ty, tin, tout = gen_tf()
+    ox, oy = gen_onnx()
+    np.savez(os.path.join(HERE, "import_smoke_io.npz"),
+             keras_x=kx, keras_y=ky, tf_x=tx, tf_y=ty, onnx_x=ox, onnx_y=oy,
+             tf_in=np.array(tin), tf_out=np.array(tout))
+    print("fixtures written to", HERE)
+
+
+if __name__ == "__main__":
+    main()

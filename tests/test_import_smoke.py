@@ -1,0 +1,50 @@
+"""FAST-suite importer smoke tests on committed pre-built fixtures.
+
+No live tf/torch needed: the .h5/.pb/.onnx files and their recorded outputs
+(import_smoke_io.npz) were generated once by
+fixtures/generate_import_fixtures.py — the reference keeps its import
+fixtures in dl4j-test-resources the same way (SURVEY.md §4 lesson 4). The
+default developer loop (`-m "not slow"`) now gets signal on all three
+import frontends; the deep per-layer goldens stay in the slow suite.
+"""
+import os
+
+import numpy as np
+
+HERE = os.path.join(os.path.dirname(os.path.abspath(__file__)), "fixtures")
+
+RTOL, ATOL = 1e-4, 1e-4
+
+
+def _io():
+    return np.load(os.path.join(HERE, "import_smoke_io.npz"))
+
+
+def test_keras_h5_smoke():
+    from deeplearning4j_tpu.modelimport import KerasModelImport
+    io = _io()
+    net = KerasModelImport.import_keras_model_and_weights(
+        os.path.join(HERE, "keras_smoke.h5"))
+    got = np.asarray(net.output(io["keras_x"]))
+    np.testing.assert_allclose(got, io["keras_y"], rtol=RTOL, atol=ATOL)
+
+
+def test_tf_graphdef_smoke():
+    from deeplearning4j_tpu.modelimport.tensorflow import (
+        TensorflowFrameworkImporter)
+    io = _io()
+    sd = TensorflowFrameworkImporter.import_file(
+        os.path.join(HERE, "tf_smoke.pb"))
+    iname, oname = str(io["tf_in"]), str(io["tf_out"])
+    got = np.asarray(sd.output({iname: io["tf_x"]}, [oname])[oname])
+    np.testing.assert_allclose(got, io["tf_y"], rtol=RTOL, atol=ATOL)
+
+
+def test_onnx_smoke():
+    from deeplearning4j_tpu.modelimport.onnx import OnnxFrameworkImporter
+    io = _io()
+    sd = OnnxFrameworkImporter.import_file(
+        os.path.join(HERE, "onnx_smoke.onnx"))
+    out = sd.onnx_outputs[0]
+    got = np.asarray(sd.output({"x": io["onnx_x"]}, [out])[out])
+    np.testing.assert_allclose(got, io["onnx_y"], rtol=RTOL, atol=ATOL)

@@ -15,12 +15,19 @@ torch = pytest.importorskip("torch")
 import sys  # noqa: E402
 import types  # noqa: E402
 
-if "onnx" not in sys.modules:
-    # torch.onnx.export only needs onnx.load_model_from_string for its
-    # onnxscript-function scan (a no-op for plain models — it returns the
-    # original bytes when nothing custom is found). The real onnx package
-    # is not in this environment; back the hook with our vendored minimal
-    # schema, which preserves unknown fields on reserialization.
+from deeplearning4j_tpu.modelimport.onnx import OnnxFrameworkImporter  # noqa: E402
+
+
+def _install_onnx_stub():
+    """torch.onnx.export only needs onnx.load_model_from_string for its
+    onnxscript-function scan (a no-op for plain models — it returns the
+    original bytes when nothing custom is found). The real onnx package is
+    not in this environment; back the hook with our vendored minimal
+    schema. Installed lazily (NOT at module import — pytest imports this
+    file during collection even for fast runs, and a module-scope stub
+    leaked into unrelated torch-using tests)."""
+    if "onnx" in sys.modules:
+        return
     from deeplearning4j_tpu.modelimport.proto import onnx_min_pb2 as _P
 
     def _load_model_from_string(data):
@@ -32,12 +39,11 @@ if "onnx" not in sys.modules:
     stub.load_model_from_string = _load_model_from_string
     sys.modules["onnx"] = stub
 
-from deeplearning4j_tpu.modelimport.onnx import OnnxFrameworkImporter  # noqa: E402
-
 RTOL, ATOL = 1e-4, 1e-4
 
 
 def _export(model, x, opset):
+    _install_onnx_stub()
     buf = io.BytesIO()
     torch.onnx.export(model, (x,), buf, opset_version=opset,
                       input_names=["x"], output_names=["y"],

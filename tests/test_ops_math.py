@@ -462,3 +462,22 @@ def test_round3_ops_marked_tested():
         ops.mark_fwd_tested(n)
     for n in grad:
         ops.mark_grad_tested(n)
+
+
+def test_einsum_erfc_numpy_oracle():
+    """Fast-suite oracles for linalg.einsum and math.erfc so the slow TF
+    import goldens are not the only thing marking them (round-4 floor
+    hygiene: the coverage floor must assert on `-m "not slow"` runs)."""
+    import math as _math
+    import deeplearning4j_tpu.ops as ops
+    rng = np.random.default_rng(5)
+    a = rng.normal(size=(3, 4)).astype(np.float32)
+    b = rng.normal(size=(4, 5)).astype(np.float32)
+    got = np.asarray(ops.lookup("linalg.einsum")(a, b, equation="ij,jk->ik"))
+    np.testing.assert_allclose(got, a @ b, rtol=1e-5, atol=1e-5)
+    x = np.linspace(-2, 2, 9).astype(np.float32)
+    got = np.asarray(ops.lookup("math.erfc")(x))
+    ref = np.asarray([_math.erfc(float(v)) for v in x], np.float32)
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+    ops.mark_fwd_tested("linalg.einsum")
+    ops.mark_fwd_tested("math.erfc")

@@ -1,0 +1,144 @@
+"""LBFGS / ConjugateGradient / BackTrackLineSearch solvers (SURVEY.md §2.4
+optimizers row — the last core-framework gap). Convergence on convex
+problems + the MLN Solver.optimize path + config JSON round-trip."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.optimize.solvers import (BackTrackLineSearch,
+                                                 ConjugateGradient, LBFGS,
+                                                 LineGradientDescent,
+                                                 get_solver)
+
+
+def _quadratic(n=12, seed=0, cond=30.0):
+    rng = np.random.default_rng(seed)
+    q, _ = np.linalg.qr(rng.normal(size=(n, n)))
+    eigs = np.linspace(1.0, cond, n)
+    A = (q * eigs) @ q.T
+    b = rng.normal(size=(n,))
+    A = jnp.asarray(A, jnp.float32)
+    b = jnp.asarray(b, jnp.float32)
+
+    @jax.jit
+    def f(x):
+        v = 0.5 * x @ A @ x - b @ x
+        return v, A @ x - b
+
+    x_star = np.linalg.solve(np.asarray(A), np.asarray(b))
+    return f, x_star
+
+
+def test_line_search_armijo_decrease():
+    f, _ = _quadratic()
+    x = jnp.zeros(12)
+    fx, g = f(x)
+    ls = BackTrackLineSearch()
+    step, x_new, f_new, _ = ls.search(f, x, float(fx), g, -g)
+    assert step > 0.0
+    assert f_new < float(fx)
+
+
+def test_line_search_rejects_ascent_direction():
+    f, _ = _quadratic()
+    x = jnp.zeros(12)
+    fx, g = f(x)
+    step, *_ = BackTrackLineSearch().search(f, x, float(fx), g, g)
+    assert step == 0.0
+
+
+def test_lbfgs_converges_on_quadratic():
+    f, x_star = _quadratic()
+    opt = LBFGS(iterations=60, memory=10)
+    x, fx = opt.minimize(f, jnp.zeros(12))
+    np.testing.assert_allclose(np.asarray(x), x_star, rtol=1e-3, atol=1e-3)
+
+
+def test_cg_converges_on_quadratic():
+    f, x_star = _quadratic()
+    opt = ConjugateGradient(iterations=120)
+    x, fx = opt.minimize(f, jnp.zeros(12))
+    np.testing.assert_allclose(np.asarray(x), x_star, rtol=1e-2, atol=1e-2)
+
+
+def test_lbfgs_beats_plain_gd_on_ill_conditioned():
+    f, x_star = _quadratic(cond=300.0, seed=3)
+    lb, _ = LBFGS(iterations=40).minimize(f, jnp.zeros(12))
+    gd, _ = LineGradientDescent(iterations=40).minimize(f, jnp.zeros(12))
+    err_lb = np.linalg.norm(np.asarray(lb) - x_star)
+    err_gd = np.linalg.norm(np.asarray(gd) - x_star)
+    assert err_lb < err_gd * 0.5
+
+
+def test_lbfgs_rosenbrock():
+    @jax.jit
+    def f(x):
+        v = (1 - x[0]) ** 2 + 100.0 * (x[1] - x[0] ** 2) ** 2
+        return v, jax.grad(
+            lambda z: (1 - z[0]) ** 2 + 100.0 * (z[1] - z[0] ** 2) ** 2)(x)
+
+    x, fx = LBFGS(iterations=200).minimize(f, jnp.asarray([-1.2, 1.0]))
+    np.testing.assert_allclose(np.asarray(x), [1.0, 1.0], atol=2e-2)
+
+
+def test_get_solver_validates():
+    with pytest.raises(ValueError, match="optimization_algo"):
+        get_solver("NEWTON")
+
+
+def test_mln_lbfgs_fit_and_json_roundtrip():
+    from deeplearning4j_tpu.nn.config import (InputType,
+                                              MultiLayerConfiguration,
+                                              NeuralNetConfiguration)
+    from deeplearning4j_tpu.nn.layers.core import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.model import MultiLayerNetwork
+
+    cfg = (NeuralNetConfiguration.builder().seed(2)
+           .optimization_algo("LBFGS", iterations=8)
+           .input_type(InputType.feed_forward(6))
+           .list(DenseLayer(n_out=12, activation="tanh"),
+                 OutputLayer(n_out=3, loss="mcxent"))
+           .build())
+    assert cfg.optimization_algo == "LBFGS"
+    # JSON round-trip preserves the solver config
+    cfg2 = MultiLayerConfiguration.from_json(cfg.to_json())
+    assert cfg2.optimization_algo == "LBFGS"
+    assert cfg2.solver_iterations == 8
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(64, 6)).astype(np.float32)
+    w = rng.normal(size=(6, 3)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[np.argmax(x @ w, axis=1)]
+
+    net = MultiLayerNetwork(cfg).init()
+    from deeplearning4j_tpu.data.dataset import DataSet
+    s0 = float(net.score(DataSet(x, y)))
+    for _ in range(6):
+        net.fit(x, y)
+    s1 = float(net.score(DataSet(x, y)))
+    assert s1 < s0 * 0.5, (s0, s1)
+
+
+def test_mln_cg_fit():
+    from deeplearning4j_tpu.nn.config import (InputType,
+                                              NeuralNetConfiguration)
+    from deeplearning4j_tpu.nn.layers.core import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.model import MultiLayerNetwork
+    from deeplearning4j_tpu.data.dataset import DataSet
+
+    cfg = (NeuralNetConfiguration.builder().seed(4)
+           .optimization_algo("CONJUGATE_GRADIENT", iterations=6)
+           .input_type(InputType.feed_forward(5))
+           .list(DenseLayer(n_out=8, activation="relu"),
+                 OutputLayer(n_out=2, loss="mcxent"))
+           .build())
+    rng = np.random.default_rng(1)
+    x = rng.normal(size=(48, 5)).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[(x.sum(axis=1) > 0).astype(int)]
+    net = MultiLayerNetwork(cfg).init()
+    ds = DataSet(x, y)
+    s0 = float(net.score(ds))
+    for _ in range(5):
+        net.fit(x, y)
+    assert float(net.score(ds)) < s0 * 0.7

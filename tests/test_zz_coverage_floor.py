@@ -20,9 +20,12 @@ GRAD_FLOOR = 0.65
 # every file that marks the ledger; the floor is only meaningful when ALL
 # of them ran in this session (a chunked run would partially populate the
 # ledger and trip the floors spuriously — the round-2 judge hit exactly
-# this). Keep in sync with `grep -rl mark_fwd_tested tests/`.
+# this). Keep in sync with `grep -rl mark_fwd_tested tests/`. Round 4:
+# all marking files are FAST — the floor now asserts on `-m "not slow"`
+# runs too (the einsum/erfc marks moved from the slow TF goldens to
+# fast numpy oracles in test_ops_math.py).
 _MARKING_FILES = {"test_conv3d_capsules.py", "test_m17_breadth.py",
-                  "test_ops.py", "test_ops_math.py", "test_tf_onnx_import.py"}
+                  "test_ops.py", "test_ops_math.py"}
 
 
 def test_coverage_floor(request):
