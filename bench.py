@@ -83,8 +83,11 @@ def main():
         # ~85 ms readback RTT left IN the divisor (≈0.7 ms/step,
         # pessimistic direction). Slope/subtraction schemes were rejected:
         # under multiplicative contention noise they can bias LOW.
+        # 12 chains (r4, was 8): the tunneled chip is fair-share timeshared
+        # and whole minutes can run at ~55% throughput — more chains sample
+        # more windows for the min estimator at ~1 min extra cost
         k = 16
-        runs = [chain(k) for _ in range(8)]
+        runs = [chain(k) for _ in range(12)]
         final_loss = runs[0][1]
         times = sorted(r[0] for r in runs)
         dt = times[0] / (k * nsteps)

@@ -101,7 +101,9 @@ def _read_tin(root: str, train: bool, limit: Optional[int]
 
     def load(p):
         im = Image.open(p).convert("RGB").resize((64, 64))
-        return np.asarray(im, np.float32)
+        # uint8 at rest: the full train split is 100k images (~1.2 GB u8
+        # vs ~4.7 GB f32); the iterator casts per batch
+        return np.asarray(im, np.uint8)
 
     if train:
         # interleave classes when capped: filling sequentially would make a
@@ -134,7 +136,8 @@ def _read_tin(root: str, train: bool, limit: Optional[int]
 
 
 class TinyImageNetDataSetIterator(NumpyDataSetIterator):
-    """TinyImageNet-200 (200 classes, 64x64)."""
+    """TinyImageNet-200 (200 classes, 64x64). Real images are held uint8
+    in host RAM and cast to float32 [0,255] per emitted batch."""
 
     N_CLASSES = 200
 
@@ -149,9 +152,15 @@ class TinyImageNetDataSetIterator(NumpyDataSetIterator):
             n = num_examples or (4000 if train else 1000)
             x, y = _synthetic_digits(n, seed if train else seed + 1, 64,
                                      self.N_CLASSES)
+            x = x.astype(np.uint8)  # same at-rest dtype as the real path
             self.source = "synthetic"
             self.labels = [f"class_{i}" for i in range(self.N_CLASSES)]
         if num_examples is not None:
             x, y = x[:num_examples], y[:num_examples]
         onehot = np.eye(self.N_CLASSES, dtype=np.float32)[y]
         super().__init__(x, onehot, batch_size, shuffle=shuffle, seed=seed)
+
+    def __iter__(self):
+        for ds in super().__iter__():
+            ds.features = ds.features.astype(np.float32)
+            yield ds

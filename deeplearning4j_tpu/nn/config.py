@@ -235,6 +235,12 @@ class NeuralNetConfiguration:
     def graph_builder(self):
         """DAG config builder carrying this builder's seed/updater/etc.
         (DL4J ``.graphBuilder()``)."""
+        if self._opt_algo != "SGD":
+            # silent SGD fallback would betray the configured solver
+            raise ValueError(
+                f"optimization_algo({self._opt_algo!r}) is not supported on "
+                "the ComputationGraph engine (MultiLayerNetwork only this "
+                "round); use SGD or the sequential engine")
         from .graph import GraphBuilder
         return GraphBuilder(self)
 

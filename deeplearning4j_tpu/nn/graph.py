@@ -562,12 +562,18 @@ class ComputationGraph:
         return self
 
     # ------------------------------------------------------------- inference
-    def feed_forward(self, *inputs, train: bool = False):
+    def feed_forward(self, *inputs, train: bool = False, rng=None):
         """All vertex activations for the given inputs (DL4J
-        ``ComputationGraph.feedForward()``): {vertex_name: activation}."""
+        ``ComputationGraph.feedForward()``): {vertex_name: activation}.
+        ``rng`` feeds stochastic layers when ``train=True`` (None =
+        deterministic)."""
+        if len(inputs) != len(self.conf.inputs):
+            raise ValueError(
+                f"feed_forward takes {len(self.conf.inputs)} inputs "
+                f"({self.conf.inputs}), got {len(inputs)}")
         ins = dict(zip(self.conf.inputs, inputs))
         acts, _, _ = self._forward(self.params, ins, self.state,
-                                   train=train, rng=None)
+                                   train=train, rng=rng)
         return acts
 
     def output(self, *inputs, train: bool = False):

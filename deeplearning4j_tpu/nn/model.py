@@ -318,12 +318,12 @@ class MultiLayerNetwork:
         """DL4J fit(): accepts DataSetIterator, DataSet, or (features, labels)."""
         if not self.params and not self.state:
             self.init()
-        it = _as_iterator(data, labels)
         if self._out_layer is None:
             raise ValueError("last layer must be an OutputLayer/LossLayer to fit()")
         algo = getattr(self.conf, "optimization_algo", "SGD") or "SGD"
         if algo.upper() not in ("SGD", "STOCHASTIC_GRADIENT_DESCENT"):
             return self._fit_with_solver(data, labels, epochs)
+        it = _as_iterator(data, labels)
         if self._train_step is None:
             self._train_step = self._build_train_step()
 

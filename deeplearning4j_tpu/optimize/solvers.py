@@ -20,7 +20,9 @@ solver iterations (DL4J's computeGradientAndScore does refresh them);
 CenterLossOutputLayer is unsupported under solvers; dropout draws ONE mask
 per optimize() call (per minibatch) rather than per forward — the line
 search needs a deterministic objective, so every trial within a batch sees
-the same mask.
+the same mask; gradient clipping/normalization configs RAISE (clipped
+gradients would poison LBFGS curvature pairs) while weight constraints are
+projected after each optimize() like the SGD path.
 """
 
 from __future__ import annotations
@@ -229,18 +231,33 @@ class Solver:
 
     def __init__(self, model, algo: str, iterations: int = 5,
                  max_line_search_iterations: int = 5):
+        conf = model.conf
+        if (conf.gradient_normalization or conf.gradient_clip_value
+                or conf.gradient_clip_l2):
+            # the SGD step applies these per update; the solver's curvature
+            # estimates (LBFGS s/y pairs) would be poisoned by clipped
+            # gradients — refuse rather than silently ignore the config
+            raise ValueError(
+                "gradient clipping/normalization is not supported with "
+                f"optimization_algo({algo!r}); remove it or use SGD")
         self.model = model
         self.opt = get_solver(algo, iterations, max_line_search_iterations)
         self._vg = None
         self._unravel = None
 
-    def _build(self, x, y, fm, lm):
+    def _build(self):
         from jax.flatten_util import ravel_pytree
         model = self.model
         _, unravel = ravel_pytree(model.params)
         self._unravel = unravel
 
         out_layer = model._out_layer
+        if hasattr(out_layer, "update_centers"):
+            # CenterLoss needs its features/centers plumbing (SGD step only);
+            # silently training with bare CE would be a different loss
+            raise ValueError(
+                "CenterLossOutputLayer is not supported with solver "
+                "optimization_algo; use SGD")
         from ..ops import losses as _loss
 
         def loss_fn(vec, x, y, fm, lm, key):
@@ -262,14 +279,25 @@ class Solver:
         loss. ``key`` seeds dropout/noise for the WHOLE call (held fixed so
         the line-search objective is deterministic)."""
         from jax.flatten_util import ravel_pytree
+        from ..nn import constraints as _constraints
         model = self.model
         if self._vg is None:
-            self._build(x, y, fm, lm)
+            self._build()
         vec0, _ = ravel_pytree(model.params)
 
         def f(vec):
             return self._vg(vec, x, y, fm, lm, key)
 
         vec, fx = self.opt.minimize(f, vec0)
-        model.params = self._unravel(vec)
+        new_params = self._unravel(vec)
+        # weight constraints project after the solver step, same as the
+        # SGD path applies them after each update — and with the same
+        # frozen-layer exemption ("no updates of any kind")
+        from ..nn.layers.wrappers import FrozenLayer
+        frozen_keys = frozenset(
+            str(i) for i, l in enumerate(model.layers)
+            if isinstance(l, FrozenLayer))
+        new_params = _constraints.apply_constraints(
+            model.conf.constraints, new_params, skip=frozen_keys)
+        model.params = new_params
         return fx

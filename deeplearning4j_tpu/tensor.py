@@ -1085,6 +1085,472 @@ class Tensor:
     def div_along_dimension(self, vec, dim: int) -> "Tensor":
         return self._broadcast_op(jnp.divide, vec, dim)
 
+    def rsub_along_dimension(self, vec, dim: int) -> "Tensor":
+        return self._broadcast_op(lambda a, b: b - a, vec, dim)
+
+    def rdiv_along_dimension(self, vec, dim: int) -> "Tensor":
+        return self._broadcast_op(lambda a, b: b / a, vec, dim)
+
+    def remainder_along_dimension(self, vec, dim: int) -> "Tensor":
+        return self._broadcast_op(jnp.remainder, vec, dim)
+
+    def addi_along_dimension(self, vec, dim: int) -> "Tensor":
+        self._a = self.add_along_dimension(vec, dim)._a
+        return self
+
+    def subi_along_dimension(self, vec, dim: int) -> "Tensor":
+        self._a = self.sub_along_dimension(vec, dim)._a
+        return self
+
+    def muli_along_dimension(self, vec, dim: int) -> "Tensor":
+        self._a = self.mul_along_dimension(vec, dim)._a
+        return self
+
+    def divi_along_dimension(self, vec, dim: int) -> "Tensor":
+        self._a = self.div_along_dimension(vec, dim)._a
+        return self
+
+    # ---- row/column broadcast tail (BaseNDArray {r}{op}{i}{Row,Column}Vector)
+    def rsub_column_vector(self, v) -> "Tensor":
+        return self._colvec("rsub", lambda a, b: b - a, v)
+
+    def rsub_row_vector(self, v) -> "Tensor":
+        return self._rowvec("rsub", lambda a, b: b - a, v)
+
+    def rdiv_column_vector(self, v) -> "Tensor":
+        return self._colvec("rdiv", lambda a, b: b / a, v)
+
+    def rdiv_row_vector(self, v) -> "Tensor":
+        return self._rowvec("rdiv", lambda a, b: b / a, v)
+
+    def addi_column_vector(self, v) -> "Tensor":
+        self._a = self.add_column_vector(v)._a
+        return self
+
+    def addi_row_vector(self, v) -> "Tensor":
+        self._a = self.add_row_vector(v)._a
+        return self
+
+    def subi_column_vector(self, v) -> "Tensor":
+        self._a = self.sub_column_vector(v)._a
+        return self
+
+    def subi_row_vector(self, v) -> "Tensor":
+        self._a = self.sub_row_vector(v)._a
+        return self
+
+    def muli_column_vector(self, v) -> "Tensor":
+        self._a = self.mul_column_vector(v)._a
+        return self
+
+    def muli_row_vector(self, v) -> "Tensor":
+        self._a = self.mul_row_vector(v)._a
+        return self
+
+    def divi_column_vector(self, v) -> "Tensor":
+        self._a = self.div_column_vector(v)._a
+        return self
+
+    def divi_row_vector(self, v) -> "Tensor":
+        self._a = self.div_row_vector(v)._a
+        return self
+
+    def rsubi_column_vector(self, v) -> "Tensor":
+        self._a = self.rsub_column_vector(v)._a
+        return self
+
+    def rsubi_row_vector(self, v) -> "Tensor":
+        self._a = self.rsub_row_vector(v)._a
+        return self
+
+    def rdivi_column_vector(self, v) -> "Tensor":
+        self._a = self.rdiv_column_vector(v)._a
+        return self
+
+    def rdivi_row_vector(self, v) -> "Tensor":
+        self._a = self.rdiv_row_vector(v)._a
+        return self
+
+    # ---- *Number() scalar-returning reductions (INDArray xxxNumber()) ------
+    def max_number(self) -> float:
+        return float(jnp.max(self._a))
+
+    def min_number(self) -> float:
+        return float(jnp.min(self._a))
+
+    def mean_number(self) -> float:
+        return float(jnp.mean(self._a))
+
+    def sum_number(self) -> float:
+        return float(jnp.sum(self._a))
+
+    def prod_number(self) -> float:
+        return float(jnp.prod(self._a))
+
+    def std_number(self, bias_corrected: bool = True) -> float:
+        return float(jnp.std(self._a, ddof=1 if bias_corrected else 0))
+
+    def var_number(self, bias_corrected: bool = True) -> float:
+        return float(jnp.var(self._a, ddof=1 if bias_corrected else 0))
+
+    def norm1_number(self) -> float:
+        return float(jnp.sum(jnp.abs(self._a)))
+
+    def norm2_number(self) -> float:
+        return float(jnp.sqrt(jnp.sum(jnp.square(self._a))))
+
+    def normmax_number(self) -> float:
+        return float(jnp.max(jnp.abs(self._a)))
+
+    def amax_number(self) -> float:
+        return float(jnp.max(jnp.abs(self._a)))
+
+    def amin_number(self) -> float:
+        return float(jnp.min(jnp.abs(self._a)))
+
+    def amean_number(self) -> float:
+        return float(jnp.mean(jnp.abs(self._a)))
+
+    def median_number(self) -> float:
+        return float(jnp.median(self._a))
+
+    def entropy_number(self) -> float:
+        p = self._a.ravel()
+        return float(-jnp.sum(p * jnp.log(jnp.maximum(p, 1e-30))))
+
+    # ---- in-place comparison-assign (INDArray eqi/neqi/gti/lti...) ---------
+    def eqi(self, other) -> "Tensor":
+        self._a = jnp.asarray(self._a == _unwrap(other), self._a.dtype)
+        return self
+
+    def neqi(self, other) -> "Tensor":
+        self._a = jnp.asarray(self._a != _unwrap(other), self._a.dtype)
+        return self
+
+    def gti(self, other) -> "Tensor":
+        self._a = jnp.asarray(self._a > _unwrap(other), self._a.dtype)
+        return self
+
+    def gtei(self, other) -> "Tensor":
+        self._a = jnp.asarray(self._a >= _unwrap(other), self._a.dtype)
+        return self
+
+    def lti(self, other) -> "Tensor":
+        self._a = jnp.asarray(self._a < _unwrap(other), self._a.dtype)
+        return self
+
+    def ltei(self, other) -> "Tensor":
+        self._a = jnp.asarray(self._a <= _unwrap(other), self._a.dtype)
+        return self
+
+    # ---- structure / layout introspection ----------------------------------
+    def ordering(self) -> str:
+        """'c' — XLA arrays are logically row-major at this API level
+        (physical tiling is the compiler's business; recorded divergence
+        from nd4j's c/f orderings)."""
+        return "c"
+
+    def stride(self, dim: int | None = None):
+        """Logical element strides of the dense row-major layout."""
+        strides = []
+        acc = 1
+        for s in reversed(self._a.shape):
+            strides.append(acc)
+            acc *= int(s)
+        strides = tuple(reversed(strides))
+        return strides if dim is None else strides[dim]
+
+    def offset(self) -> int:
+        return 0  # no view offsets (XLA copies; recorded divergence)
+
+    def element_wise_stride(self) -> int:
+        return 1
+
+    def is_view(self) -> bool:
+        return False  # indexing copies (module docstring divergence)
+
+    def is_attached(self) -> bool:
+        return False  # no workspaces: XLA/PJRT own memory
+
+    def is_sparse(self) -> bool:
+        return False
+
+    def is_compressed(self) -> bool:
+        return False
+
+    def is_row_vector_or_scalar(self) -> bool:
+        return self.is_row_vector() or self.is_scalar()
+
+    def is_column_vector_or_scalar(self) -> bool:
+        return self.is_column_vector() or self.is_scalar()
+
+    def get_leading_ones(self) -> int:
+        n = 0
+        for s in self._a.shape:
+            if s != 1:
+                break
+            n += 1
+        return n
+
+    def get_trailing_ones(self) -> int:
+        n = 0
+        for s in reversed(self._a.shape):
+            if s != 1:
+                break
+            n += 1
+        return n
+
+    def data(self) -> np.ndarray:
+        """Host copy of the buffer (nd4j ``data()`` returns the DataBuffer;
+        here the host-side value — device buffers aren't addressable)."""
+        return np.asarray(self._a).ravel()
+
+    def element(self) -> float:
+        """Single-element tensor -> its value (INDArray ``element()``)."""
+        if self.size != 1:
+            raise ValueError(f"element() needs length-1 tensor, got "
+                             f"{self.shape}")
+        return self._a.reshape(()).item()
+
+    def equal_shapes(self, other) -> bool:
+        return tuple(self._a.shape) == tuple(_unwrap(other).shape)
+
+    def to_string(self) -> str:
+        return str(np.asarray(self._a))
+
+    def close(self) -> None:
+        """INDArray AutoCloseable parity: no-op (PJRT frees buffers on GC)."""
+
+    def detach(self) -> "Tensor":
+        """Workspace API parity: no workspaces here — returns self."""
+        return self
+
+    def leverage(self) -> "Tensor":
+        return self  # workspace API parity (no-op; see detach)
+
+    def leverage_to(self, workspace_id: str) -> "Tensor":
+        return self  # workspace API parity (no-op; see detach)
+
+    def migrate(self) -> "Tensor":
+        return self  # workspace API parity (no-op; see detach)
+
+    # ---- structural tail ----------------------------------------------------
+    def permute(self, *dims) -> "Tensor":
+        """INDArray ``permute(int...)``."""
+        if len(dims) == 1 and isinstance(dims[0], (tuple, list)):
+            dims = tuple(dims[0])
+        return _wrap(jnp.transpose(self._a, dims))
+
+    def permutei(self, *dims) -> "Tensor":
+        self._a = self.permute(*dims)._a
+        return self
+
+    def transposei(self) -> "Tensor":
+        self._a = jnp.transpose(self._a)
+        return self
+
+    def broadcast(self, *shape) -> "Tensor":
+        """INDArray ``broadcast(long...)``."""
+        if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
+            shape = tuple(shape[0])
+        return _wrap(jnp.broadcast_to(self._a, shape))
+
+    def repmat(self, *reps) -> "Tensor":
+        """INDArray ``repmat(int...)`` — tile per dimension."""
+        if len(reps) == 1 and isinstance(reps[0], (tuple, list)):
+            reps = tuple(reps[0])
+        return _wrap(jnp.tile(self._a, reps))
+
+    def cast_to(self, dtype) -> "Tensor":
+        """INDArray ``castTo(DataType)``."""
+        return _wrap(jnp.asarray(self._a, _dt.resolve(dtype)))
+
+    def like(self) -> "Tensor":
+        """INDArray ``like()``: zeroed same-shape/dtype tensor."""
+        return _wrap(jnp.zeros_like(self._a))
+
+    def ulike(self) -> "Tensor":
+        """INDArray ``ulike()``: uninitialized same-shape tensor (zeroed
+        here — XLA has no uninitialized allocation)."""
+        return _wrap(jnp.zeros_like(self._a))
+
+    def slice(self, i: int, dim: int = 0) -> "Tensor":
+        """INDArray ``slice(i[, dim])`` (alias of :meth:`slice_at`)."""
+        return self.slice_at(i, dim)
+
+    def slices(self):
+        """Iterate dim-0 slices (INDArray slice iteration)."""
+        return (self.slice_at(i, 0) for i in range(self._a.shape[0]))
+
+    def put_slice(self, i: int, value) -> "Tensor":
+        """INDArray ``putSlice(int, INDArray)`` — functional; returns new."""
+        return _wrap(self._a.at[i].set(_unwrap(value)))
+
+    def puti_slice(self, i: int, value) -> "Tensor":
+        self._a = self.put_slice(i, value)._a
+        return self
+
+    # ---- conditional access (BaseNDArray getWhere/putWhere/cond) -----------
+    def cond(self, cond: str, value) -> "Tensor":
+        """INDArray ``cond(Condition)``: elementwise 0/1 mask."""
+        return _wrap(jnp.asarray(
+            _condition_mask(self._a, cond, value), self._a.dtype))
+
+    def get_where(self, comp, cond: str) -> "Tensor":
+        """INDArray ``getWhere(Number, Condition)``: the elements
+        satisfying the condition, as a flat vector (host-side filter —
+        data-dependent shape cannot stay on device; recorded)."""
+        mask = np.asarray(_condition_mask(self._a, cond, comp))
+        return _wrap(jnp.asarray(np.asarray(self._a)[mask]))
+
+    def put_where(self, comp, put, cond: str) -> "Tensor":
+        """INDArray ``putWhere(Number comp, Number/INDArray put,
+        Condition)`` — functional; returns new."""
+        mask = _condition_mask(self._a, cond, comp)
+        putv = _unwrap(put)
+        return _wrap(jnp.where(mask, putv, self._a))
+
+    def put_where_with_mask(self, mask, put) -> "Tensor":
+        """INDArray ``putWhereWithMask(INDArray mask, INDArray put)``."""
+        m = jnp.asarray(_unwrap(mask), bool)
+        return _wrap(jnp.where(m, _unwrap(put), self._a))
+
+    # ---- math tail ---------------------------------------------------------
+    def remainder(self, other) -> "Tensor":
+        return _wrap(jnp.remainder(self._a, _unwrap(other)))
+
+    def remainderi(self, other) -> "Tensor":
+        self._a = jnp.remainder(self._a, _unwrap(other))
+        return self
+
+    def fmodi(self, other) -> "Tensor":
+        self._a = jnp.fmod(self._a, _unwrap(other))
+        return self
+
+    def isfinite(self) -> "Tensor":
+        return _wrap(jnp.isfinite(self._a))
+
+    def cumsumi(self, dim: int = -1) -> "Tensor":
+        self._a = jnp.cumsum(self._a, axis=dim)
+        return self
+
+    def cumprodi(self, dim: int = -1) -> "Tensor":
+        self._a = jnp.cumprod(self._a, axis=dim)
+        return self
+
+    def skewness(self, *dims):
+        """Fisher skewness (Nd4j SummaryStats ``skewness``)."""
+        d = _normalize_dims(dims)
+        m = jnp.mean(self._a, axis=d, keepdims=True)
+        s = jnp.std(self._a, axis=d, keepdims=True)
+        out = jnp.mean(((self._a - m) / jnp.maximum(s, 1e-30)) ** 3, axis=d)
+        return _wrap(out) if d is not None else float(out)
+
+    def kurtosis(self, *dims):
+        """Excess kurtosis (Nd4j SummaryStats ``kurtosis``)."""
+        d = _normalize_dims(dims)
+        m = jnp.mean(self._a, axis=d, keepdims=True)
+        s = jnp.std(self._a, axis=d, keepdims=True)
+        out = jnp.mean(((self._a - m) / jnp.maximum(s, 1e-30)) ** 4,
+                       axis=d) - 3.0
+        return _wrap(out) if d is not None else float(out)
+
+    # ---- INDArray interface tail -------------------------------------------
+    def size_at(self, dim: int) -> int:
+        """INDArray ``size(int dimension)`` (our ``size`` property is the
+        total length = DL4J ``length()``; recorded naming divergence)."""
+        return int(self._a.shape[dim])
+
+    def num_vectors_along_dimension(self, dim: int) -> int:
+        """INDArray ``vectorsAlongDimension(int)`` count."""
+        return int(self._a.size // self._a.shape[dim]) if self._a.size else 0
+
+    def dim_shuffle(self, pattern, *broadcastable) -> "Tensor":
+        """BaseNDArray ``dimShuffle``: permute + insert broadcast axes;
+        'x' entries in ``pattern`` are new length-1 axes (theano heritage)."""
+        a = self._a
+        perm = [p for p in pattern if p != "x"]
+        a = jnp.transpose(a, tuple(int(p) for p in perm))
+        out_idx = []
+        k = 0
+        for p in pattern:
+            if p == "x":
+                out_idx.append(None)
+            else:
+                out_idx.append(k)
+                k += 1
+        slicer = tuple(jnp.newaxis if i is None else slice(None)
+                       for i in out_idx)
+        return _wrap(a[slicer])
+
+    def eps(self, other, eps: float = 1e-5) -> "Tensor":
+        """INDArray ``eps``: elementwise |a-b| < eps mask."""
+        return _wrap(jnp.abs(self._a - _unwrap(other)) < eps)
+
+    def epsi(self, other, eps: float = 1e-5) -> "Tensor":
+        self._a = jnp.asarray(self.eps(other, eps)._a, self._a.dtype)
+        return self
+
+    def is_infinite(self) -> "Tensor":
+        return _wrap(jnp.isinf(self._a))
+
+    def is_nan(self) -> "Tensor":
+        return _wrap(jnp.isnan(self._a))
+
+    def is_r(self) -> bool:
+        """INDArray ``isR()``: floating-point dtype family."""
+        return bool(jnp.issubdtype(self._a.dtype, jnp.floating))
+
+    def is_z(self) -> bool:
+        """INDArray ``isZ()``: integer dtype family."""
+        return bool(jnp.issubdtype(self._a.dtype, jnp.integer))
+
+    def is_b(self) -> bool:
+        """INDArray ``isB()``: bool dtype."""
+        return self._a.dtype == jnp.bool_
+
+    def is_s(self) -> bool:
+        """INDArray ``isS()``: string dtype — never (no utf8 tensors)."""
+        return False
+
+    def closeable(self) -> bool:
+        return False  # buffers are GC-managed (see close())
+
+    def was_closed(self) -> bool:
+        return False
+
+    def shape_info_to_string(self) -> str:
+        return (f"Rank: {self._a.ndim}, DataType: {self.data_type()}, "
+                f"Shape: {list(self._a.shape)}, Stride: "
+                f"{list(self.stride())}, Order: c")
+
+    def check_dimensions(self, other) -> "Tensor":
+        """INDArray ``checkDimensions``: raise unless shapes match."""
+        if tuple(_unwrap(other).shape) != tuple(self._a.shape):
+            raise ValueError(
+                f"shape mismatch: {tuple(_unwrap(other).shape)} vs "
+                f"{tuple(self._a.shape)}")
+        return self
+
+    def is_vector_or_scalar(self) -> bool:
+        return self.is_vector() or self.is_scalar()
+
+    def puti_row(self, i: int, v) -> "Tensor":
+        self._a = self.put_row(i, v)._a
+        return self
+
+    def puti_column(self, j: int, v) -> "Tensor":
+        self._a = self.put_column(j, v)._a
+        return self
+
+    def puti_scalar(self, idx, value) -> "Tensor":
+        self._a = self.put_scalar(idx, value)._a
+        return self
+
+    def to_string_full(self) -> str:
+        with np.printoptions(threshold=np.inf, precision=8):
+            return str(np.asarray(self._a))
+
 
 class NDArrayIndex:
     """nd4j ``NDArrayIndex`` spellings for :meth:`Tensor.get` /
@@ -1125,13 +1591,23 @@ def _ndindex(indices):
     return tuple(out)
 
 
+#: DL4J ``Conditions.*`` factory names -> short condition keys
+_CONDITION_ALIASES = {
+    "equals": "eq", "notEquals": "neq",
+    "lessThan": "lt", "lessThanOrEqual": "lte",
+    "greaterThan": "gt", "greaterThanOrEqual": "gte",
+}
+
+
 def _condition_mask(a, cond: str, value):
+    cond = _CONDITION_ALIASES.get(cond, cond)
     ops = {"eq": lambda: a == value, "neq": lambda: a != value,
            "lt": lambda: a < value, "lte": lambda: a <= value,
            "gt": lambda: a > value, "gte": lambda: a >= value}
     if cond not in ops:
-        raise ValueError(f"unknown condition {cond!r}; "
-                         f"expected one of {sorted(ops)}")
+        raise ValueError(
+            f"unknown condition {cond!r}; expected one of {sorted(ops)} "
+            f"or DL4J spellings {sorted(_CONDITION_ALIASES)}")
     return ops[cond]()
 
 
@@ -1226,3 +1702,213 @@ def concat(tensors: Sequence[Tensor], axis=0) -> Tensor:
 
 def where(cond, x, y) -> Tensor:
     return Tensor(jnp.where(_unwrap(cond), _unwrap(x), _unwrap(y)))
+
+
+def empty(dtype=_dt.float32) -> Tensor:
+    """``Nd4j.empty``: zero-length tensor."""
+    return Tensor(jnp.zeros((0,), _dt.resolve(dtype)))
+
+
+def value_array_of(shape, value, dtype=_dt.float32) -> Tensor:
+    """``Nd4j.valueArrayOf``."""
+    return full(shape, value, dtype=dtype)
+
+
+def pile(tensors: Sequence[Tensor]) -> Tensor:
+    """``Nd4j.pile``: stack along a new leading dim."""
+    return stack(tensors, axis=0)
+
+
+def tear(t: Tensor, dim: int = 0):
+    """``Nd4j.tear``: split into slices along ``dim``."""
+    a = _unwrap(t)
+    return [Tensor(jnp.take(a, i, axis=dim)) for i in range(a.shape[dim])]
+
+
+def append(t: Tensor, pad: int, value, axis: int = -1) -> Tensor:
+    """``Nd4j.append``: pad ``pad`` copies of ``value`` after ``axis``."""
+    a = _unwrap(t)
+    cfg = [(0, 0)] * a.ndim
+    cfg[axis] = (0, int(pad))
+    return Tensor(jnp.pad(a, cfg, constant_values=value))
+
+
+def prepend(t: Tensor, pad: int, value, axis: int = -1) -> Tensor:
+    """``Nd4j.prepend``."""
+    a = _unwrap(t)
+    cfg = [(0, 0)] * a.ndim
+    cfg[axis] = (int(pad), 0)
+    return Tensor(jnp.pad(a, cfg, constant_values=value))
+
+
+def sort(t: Tensor, dim: int = -1, ascending: bool = True) -> Tensor:
+    """``Nd4j.sort``."""
+    a = jnp.sort(_unwrap(t), axis=dim)
+    return Tensor(a if ascending else jnp.flip(a, axis=dim))
+
+
+def expand_dims(t: Tensor, axis: int) -> Tensor:
+    """``Nd4j.expandDims``."""
+    return Tensor(jnp.expand_dims(_unwrap(t), axis))
+
+
+def squeeze(t: Tensor, axis: int) -> Tensor:
+    """``Nd4j.squeeze``."""
+    return Tensor(jnp.squeeze(_unwrap(t), axis))
+
+
+class Transforms:
+    """nd4j ``ops.transforms.Transforms`` statics (reference
+    ``nd4j-api .../linalg/ops/transforms/Transforms.java``†, mount empty,
+    unverified) — the helper surface dl4j-examples reach for. Each static
+    accepts a Tensor (or array-like) and returns a Tensor; ``_dup=False``
+    spellings (Transforms.exp(x, false)) are expressed by the caller using
+    the Tensor's in-place method instead."""
+
+    # -- elementwise ---------------------------------------------------------
+    abs = staticmethod(lambda t: _wrap(jnp.abs(_unwrap(t))))
+    exp = staticmethod(lambda t: _wrap(jnp.exp(_unwrap(t))))
+    log = staticmethod(lambda t: _wrap(jnp.log(_unwrap(t))))
+    sqrt = staticmethod(lambda t: _wrap(jnp.sqrt(_unwrap(t))))
+    sign = staticmethod(lambda t: _wrap(jnp.sign(_unwrap(t))))
+    floor = staticmethod(lambda t: _wrap(jnp.floor(_unwrap(t))))
+    ceil = staticmethod(lambda t: _wrap(jnp.ceil(_unwrap(t))))
+    round = staticmethod(lambda t: _wrap(jnp.round(_unwrap(t))))
+    sin = staticmethod(lambda t: _wrap(jnp.sin(_unwrap(t))))
+    cos = staticmethod(lambda t: _wrap(jnp.cos(_unwrap(t))))
+    tan = staticmethod(lambda t: _wrap(jnp.tan(_unwrap(t))))
+    asin = staticmethod(lambda t: _wrap(jnp.arcsin(_unwrap(t))))
+    acos = staticmethod(lambda t: _wrap(jnp.arccos(_unwrap(t))))
+    atan = staticmethod(lambda t: _wrap(jnp.arctan(_unwrap(t))))
+    sinh = staticmethod(lambda t: _wrap(jnp.sinh(_unwrap(t))))
+    cosh = staticmethod(lambda t: _wrap(jnp.cosh(_unwrap(t))))
+
+    @staticmethod
+    def pow(t, p):
+        return _wrap(jnp.power(_unwrap(t), _unwrap(p)))
+
+    @staticmethod
+    def atan2(y, x):
+        return _wrap(jnp.arctan2(_unwrap(y), _unwrap(x)))
+
+    @staticmethod
+    def max(a, b):
+        return _wrap(jnp.maximum(_unwrap(a), _unwrap(b)))
+
+    @staticmethod
+    def min(a, b):
+        return _wrap(jnp.minimum(_unwrap(a), _unwrap(b)))
+
+    # -- activations ---------------------------------------------------------
+    sigmoid = staticmethod(lambda t: _wrap(jax.nn.sigmoid(_unwrap(t))))
+    tanh = staticmethod(lambda t: _wrap(jnp.tanh(_unwrap(t))))
+    relu = staticmethod(lambda t: _wrap(jax.nn.relu(_unwrap(t))))
+    relu6 = staticmethod(lambda t: _wrap(jax.nn.relu6(_unwrap(t))))
+    elu = staticmethod(lambda t: _wrap(jax.nn.elu(_unwrap(t))))
+    softplus = staticmethod(lambda t: _wrap(jax.nn.softplus(_unwrap(t))))
+    softsign = staticmethod(lambda t: _wrap(jax.nn.soft_sign(_unwrap(t))))
+    softmax = staticmethod(lambda t: _wrap(jax.nn.softmax(_unwrap(t), axis=-1)))
+    log_softmax = staticmethod(
+        lambda t: _wrap(jax.nn.log_softmax(_unwrap(t), axis=-1)))
+    hard_sigmoid = staticmethod(
+        lambda t: _wrap(jnp.clip(0.2 * _unwrap(t) + 0.5, 0.0, 1.0)))
+    hard_tanh = staticmethod(lambda t: _wrap(jnp.clip(_unwrap(t), -1.0, 1.0)))
+
+    @staticmethod
+    def leaky_relu(t, alpha: float = 0.01):
+        return _wrap(jax.nn.leaky_relu(_unwrap(t), negative_slope=alpha))
+
+    @staticmethod
+    def stabilize(t, k: float = 1.0):
+        """Clamp to the numerically-safe exp/log band (Transforms.stabilize)."""
+        cutoff = 20.0 / k
+        return _wrap(jnp.clip(_unwrap(t), -cutoff, cutoff))
+
+    # -- vector geometry -----------------------------------------------------
+    @staticmethod
+    def unit_vec(t):
+        a = _unwrap(t)
+        n = jnp.linalg.norm(a)
+        return _wrap(a / jnp.maximum(n, 1e-30))
+
+    @staticmethod
+    def normalize_zero_mean_and_unit_variance(t):
+        a = _unwrap(t)
+        return _wrap((a - jnp.mean(a, axis=0, keepdims=True))
+                     / jnp.maximum(jnp.std(a, axis=0, keepdims=True), 1e-30))
+
+    @staticmethod
+    def euclidean_distance(a, b):
+        return float(jnp.linalg.norm(_unwrap(a) - _unwrap(b)))
+
+    @staticmethod
+    def manhattan_distance(a, b):
+        return float(jnp.sum(jnp.abs(_unwrap(a) - _unwrap(b))))
+
+    @staticmethod
+    def cosine_sim(a, b):
+        av, bv = _unwrap(a).ravel(), _unwrap(b).ravel()
+        na = jnp.maximum(jnp.linalg.norm(av), 1e-30)
+        nb = jnp.maximum(jnp.linalg.norm(bv), 1e-30)
+        return float(jnp.vdot(av, bv) / (na * nb))
+
+    @staticmethod
+    def cosine_distance(a, b):
+        return 1.0 - Transforms.cosine_sim(a, b)
+
+    @staticmethod
+    def hamming_distance(a, b):
+        return float(jnp.sum(_unwrap(a) != _unwrap(b)))
+
+    @staticmethod
+    def jaccard_distance(a, b):
+        av, bv = _unwrap(a), _unwrap(b)
+        mn = jnp.sum(jnp.minimum(av, bv))
+        mx = jnp.maximum(jnp.sum(jnp.maximum(av, bv)), 1e-30)
+        return float(1.0 - mn / mx)
+
+    @staticmethod
+    def dot(a, b):
+        return float(jnp.vdot(_unwrap(a), _unwrap(b)))
+
+    @staticmethod
+    def cross(a, b):
+        return _wrap(jnp.cross(_unwrap(a), _unwrap(b)))
+
+    # -- comparisons / logicals ---------------------------------------------
+    @staticmethod
+    def greater_than_or_equal(a, b):
+        return _wrap(_unwrap(a) >= _unwrap(b))
+
+    @staticmethod
+    def less_than_or_equal(a, b):
+        return _wrap(_unwrap(a) <= _unwrap(b))
+
+    @staticmethod
+    def and_(a, b):
+        return _wrap(jnp.logical_and(jnp.asarray(_unwrap(a), bool),
+                                     jnp.asarray(_unwrap(b), bool)))
+
+    @staticmethod
+    def or_(a, b):
+        return _wrap(jnp.logical_or(jnp.asarray(_unwrap(a), bool),
+                                    jnp.asarray(_unwrap(b), bool)))
+
+    @staticmethod
+    def xor(a, b):
+        return _wrap(jnp.logical_xor(jnp.asarray(_unwrap(a), bool),
+                                     jnp.asarray(_unwrap(b), bool)))
+
+    @staticmethod
+    def not_(a):
+        return _wrap(jnp.logical_not(jnp.asarray(_unwrap(a), bool)))
+
+    @staticmethod
+    def is_max(t, dim=None):
+        """1.0 at the argmax (per-dim or global), else 0 (Transforms.isMax)."""
+        a = _unwrap(t)
+        if dim is None:
+            m = jnp.max(a)
+        else:
+            m = jnp.max(a, axis=dim, keepdims=True)
+        return _wrap(jnp.asarray(a == m, a.dtype))

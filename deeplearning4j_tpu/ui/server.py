@@ -95,8 +95,9 @@ function drawGraph(id, g) {
     ctx.fillStyle='#223';
     ctx.fillText(n.name.slice(0,14)+' ['+n.kind.slice(0,10)+']', x+2, y+3);});
 }
-function renderHists(hists) {
-  const div = document.getElementById('hists');
+function renderHistRows(divId, hists, series) {
+  // series: [[selector(histsEntry)->hist, color], ...] — one canvas each
+  const div = document.getElementById(divId);
   const names = Object.keys(hists);
   // (re)build rows once per layer set
   if (div.dataset.sig !== names.join(',')) {
@@ -104,14 +105,16 @@ function renderHists(hists) {
     div.innerHTML = names.map((n,i) =>
       '<div style="display:flex;align-items:center;margin:2px 0">' +
       '<span style="width:180px;font-size:.75em;color:#555">'+n+'</span>' +
-      '<canvas id="hp'+i+'" style="width:240px;height:60px"></canvas>' +
-      '<canvas id="hu'+i+'" style="width:240px;height:60px"></canvas>' +
+      series.map((s,j) =>
+        '<canvas id="'+divId+i+'_'+j+'" style="width:240px;height:60px">' +
+        '</canvas>').join('') +
       '</div>').join('');
   }
-  names.forEach((n,i)=>{ drawHist(document.getElementById('hp'+i),
-                                  hists[n].param, '#36c');
-                         drawHist(document.getElementById('hu'+i),
-                                  hists[n].update, '#c63'); });
+  names.forEach((n,i)=>series.forEach((s,j)=>
+    drawHist(document.getElementById(divId+i+'_'+j), s[0](hists[n]), s[1])));
+}
+function renderHists(hists) {
+  renderHistRows('hists', hists, [[h=>h.param, '#36c'], [h=>h.update, '#c63']]);
 }
 </script>
 <script>
@@ -158,19 +161,8 @@ async function tick() {
   renderActHists(d.activation_histograms);
 }
 function renderActHists(hists) {
-  const div = document.getElementById('acthists');
   if (!hists) return;
-  const names = Object.keys(hists);
-  if (div.dataset.sig !== names.join(',')) {
-    div.dataset.sig = names.join(',');
-    div.innerHTML = names.map((n,i) =>
-      '<div style="display:flex;align-items:center;margin:2px 0">' +
-      '<span style="width:180px;font-size:.75em;color:#555">'+n+'</span>' +
-      '<canvas id="ha'+i+'" style="width:240px;height:60px"></canvas>' +
-      '</div>').join('');
-  }
-  names.forEach((n,i)=>drawHist(document.getElementById('ha'+i),
-                                hists[n], '#393'));
+  renderHistRows('acthists', hists, [[h=>h, '#393']]);
 }
 tick(); setInterval(tick, 2000);
 </script></body></html>"""

@@ -57,7 +57,6 @@ def test_svhn_trains_a_small_convnet():
                  OutputLayer(n_out=10, loss="mcxent"))
            .build())
     net = MultiLayerNetwork(cfg).init()
-    first = None
     for _ in range(6):
         net.fit(it)
     last = float(net.score())

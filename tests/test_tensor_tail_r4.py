@@ -1,0 +1,289 @@
+"""Round-4 INDArray tail: broadcast i-variants, *Number reductions,
+structure introspection, conditional access, Transforms statics, Nd4j
+factory additions — numpy oracles throughout (SURVEY.md §2.2)."""
+import numpy as np
+import pytest
+
+import deeplearning4j_tpu.tensor as T
+from deeplearning4j_tpu.tensor import Tensor, Transforms
+
+
+@pytest.fixture
+def a():
+    return np.random.default_rng(0).normal(size=(3, 4)).astype(np.float32)
+
+
+def t(x):
+    return Tensor(x)
+
+
+def test_r_broadcast_vectors(a):
+    col = np.arange(3, dtype=np.float32) + 1
+    row = np.arange(4, dtype=np.float32) + 1
+    np.testing.assert_allclose(t(a).rsub_column_vector(col).numpy(),
+                               col[:, None] - a, rtol=1e-6)
+    np.testing.assert_allclose(t(a).rsub_row_vector(row).numpy(),
+                               row[None, :] - a, rtol=1e-6)
+    np.testing.assert_allclose(t(a).rdiv_column_vector(col).numpy(),
+                               col[:, None] / a, rtol=1e-6)
+    np.testing.assert_allclose(t(a).rdiv_row_vector(row).numpy(),
+                               row[None, :] / a, rtol=1e-6)
+
+
+def test_inplace_broadcast_vectors(a):
+    col = np.arange(3, dtype=np.float32)
+    row = np.arange(4, dtype=np.float32)
+    for name, ref in [
+        ("addi_column_vector", a + col[:, None]),
+        ("addi_row_vector", a + row[None, :]),
+        ("subi_column_vector", a - col[:, None]),
+        ("subi_row_vector", a - row[None, :]),
+        ("muli_column_vector", a * col[:, None]),
+        ("muli_row_vector", a * row[None, :]),
+        ("divi_column_vector", a / (col[:, None] + 1)),
+        ("divi_row_vector", a / (row[None, :] + 1)),
+    ]:
+        x = t(a)
+        arg = col if "column" in name else row
+        if name.startswith("divi"):
+            arg = arg + 1
+        ret = getattr(x, name)(arg)
+        assert ret is x  # i-variants rebind and return self
+        np.testing.assert_allclose(x.numpy(), ref, rtol=1e-6, err_msg=name)
+
+
+def test_rsubi_rdivi_vectors(a):
+    col = np.arange(3, dtype=np.float32) + 2
+    x = t(a)
+    assert x.rsubi_column_vector(col) is x
+    np.testing.assert_allclose(x.numpy(), col[:, None] - a, rtol=1e-6)
+    y = t(a)
+    y.rdivi_row_vector(np.ones(4, np.float32) * 2)
+    np.testing.assert_allclose(y.numpy(), 2.0 / a, rtol=1e-6)
+
+
+def test_along_dimension_tail(a):
+    v = np.arange(4, dtype=np.float32) + 1
+    np.testing.assert_allclose(
+        t(a).rsub_along_dimension(v, 1).numpy(), v[None, :] - a, rtol=1e-6)
+    np.testing.assert_allclose(
+        t(a).rdiv_along_dimension(v, 1).numpy(), v[None, :] / a, rtol=1e-6)
+    np.testing.assert_allclose(
+        t(a).remainder_along_dimension(v, 1).numpy(),
+        np.remainder(a, v[None, :]), rtol=1e-6)
+    x = t(a)
+    assert x.addi_along_dimension(v, 1) is x
+    np.testing.assert_allclose(x.numpy(), a + v[None, :], rtol=1e-6)
+
+
+def test_number_reductions(a):
+    x = t(a)
+    assert np.isclose(x.max_number(), a.max())
+    assert np.isclose(x.min_number(), a.min())
+    assert np.isclose(x.mean_number(), a.mean())
+    assert np.isclose(x.sum_number(), a.sum())
+    assert np.isclose(x.prod_number(), np.prod(a.astype(np.float64)),
+                      rtol=1e-4)
+    assert np.isclose(x.std_number(), a.std(ddof=1), rtol=1e-5)
+    assert np.isclose(x.std_number(False), a.std(ddof=0), rtol=1e-5)
+    assert np.isclose(x.var_number(), a.var(ddof=1), rtol=1e-5)
+    assert np.isclose(x.norm1_number(), np.abs(a).sum(), rtol=1e-5)
+    assert np.isclose(x.norm2_number(), np.linalg.norm(a), rtol=1e-5)
+    assert np.isclose(x.normmax_number(), np.abs(a).max())
+    assert np.isclose(x.amean_number(), np.abs(a).mean(), rtol=1e-5)
+    assert np.isclose(x.median_number(), np.median(a))
+
+
+def test_inplace_comparisons(a):
+    x = t(a)
+    assert x.gti(0.0) is x
+    np.testing.assert_allclose(x.numpy(), (a > 0).astype(np.float32))
+    y = t(a)
+    y.ltei(0.0)
+    np.testing.assert_allclose(y.numpy(), (a <= 0).astype(np.float32))
+    z = t(a)
+    z.eqi(a)  # self-comparison: everything 1
+    assert z.numpy().sum() == a.size
+
+
+def test_structure_introspection(a):
+    x = t(a)
+    assert x.ordering() == "c"
+    assert x.stride() == (4, 1)
+    assert x.stride(0) == 4
+    assert x.offset() == 0 and x.element_wise_stride() == 1
+    assert not x.is_view() and not x.is_attached()
+    assert not x.is_sparse() and not x.is_compressed()
+    assert x.size_at(1) == 4
+    assert t(np.zeros((1, 1, 3, 1))).get_leading_ones() == 2
+    assert t(np.zeros((1, 1, 3, 1))).get_trailing_ones() == 1
+    assert x.equal_shapes(t(np.zeros((3, 4))))
+    assert not x.equal_shapes(t(np.zeros((4, 3))))
+    assert "Rank: 2" in x.shape_info_to_string()
+    assert x.data().shape == (12,)
+    with pytest.raises(ValueError):
+        x.check_dimensions(t(np.zeros((2, 2))))
+    assert x.check_dimensions(t(np.zeros((3, 4)))) is x
+    assert t(np.zeros(5)).is_vector_or_scalar()
+    assert x.is_r() and not x.is_z() and not x.is_b() and not x.is_s()
+    assert t(np.zeros(3, np.int32)).is_z()
+    # workspace-API no-ops return self
+    assert x.detach() is x and x.leverage() is x and x.migrate() is x
+    x.close()  # no-op, must not raise
+    assert not x.closeable() and not x.was_closed()
+
+
+def test_element_and_strings(a):
+    assert np.isclose(t(np.asarray([3.5])).element(), 3.5)
+    with pytest.raises(ValueError):
+        t(a).element()
+    assert "0." in t(np.zeros((2, 2))).to_string()
+    assert len(t(a).to_string_full()) >= len(t(a).to_string()) - 10
+
+
+def test_structural_tail(a):
+    np.testing.assert_allclose(t(a).permute(1, 0).numpy(), a.T)
+    x = t(a)
+    assert x.permutei(1, 0) is x and x.shape == (4, 3)
+    y = t(a)
+    assert y.transposei() is y and y.shape == (4, 3)
+    np.testing.assert_allclose(
+        t(np.ones((1, 4))).broadcast(3, 4).numpy(), np.ones((3, 4)))
+    np.testing.assert_allclose(t(a).repmat(2, 1).numpy(), np.tile(a, (2, 1)))
+    # (DOUBLE would need jax x64 mode; HALF exercises the same path)
+    assert t(a).cast_to("FLOAT16").numpy().dtype == np.float16
+    assert t(a).like().numpy().sum() == 0.0 and t(a).ulike().shape == (3, 4)
+    np.testing.assert_allclose(t(a).slice(1).numpy(), a[1])
+    assert len(list(t(a).slices())) == 3
+    np.testing.assert_allclose(
+        t(a).put_slice(0, np.zeros(4, np.float32)).numpy()[0], np.zeros(4))
+    x = t(a)
+    assert x.puti_slice(0, np.zeros(4, np.float32)) is x
+    assert x.numpy()[0].sum() == 0.0
+
+
+def test_dim_shuffle(a):
+    out = t(a).dim_shuffle([1, "x", 0])
+    assert out.shape == (4, 1, 3)
+    np.testing.assert_allclose(out.numpy()[:, 0, :], a.T)
+
+
+def test_conditional_access(a):
+    x = t(a)
+    mask = x.cond("greaterThan", 0.0).numpy()
+    np.testing.assert_allclose(mask, (a > 0).astype(np.float32))
+    got = x.get_where(0.0, "greaterThan").numpy()
+    np.testing.assert_allclose(np.sort(got), np.sort(a[a > 0]), rtol=1e-6)
+    put = x.put_where(0.0, -1.0, "greaterThan").numpy()
+    np.testing.assert_allclose(put, np.where(a > 0, -1.0, a), rtol=1e-6)
+    m = a < 0
+    np.testing.assert_allclose(
+        x.put_where_with_mask(m, np.zeros_like(a)).numpy(),
+        np.where(m, 0.0, a), rtol=1e-6)
+
+
+def test_math_tail(a):
+    b = np.abs(a) + 0.5
+    np.testing.assert_allclose(t(a).remainder(b).numpy(),
+                               np.remainder(a, b), rtol=1e-5)
+    x = t(a)
+    assert x.remainderi(b) is x
+    y = t(a)
+    assert y.fmodi(b) is y
+    np.testing.assert_allclose(y.numpy(), np.fmod(a, b), rtol=1e-5)
+    nan = np.array([1.0, np.nan, np.inf], np.float32)
+    np.testing.assert_array_equal(t(nan).isfinite().numpy(),
+                                  np.isfinite(nan))
+    np.testing.assert_array_equal(t(nan).is_nan().numpy(), np.isnan(nan))
+    np.testing.assert_array_equal(t(nan).is_infinite().numpy(),
+                                  np.isinf(nan))
+    np.testing.assert_array_equal(
+        t(a).eps(a + 1e-7).numpy(), np.ones_like(a, bool))
+    x = t(a)
+    assert x.cumsumi(1) is x
+    np.testing.assert_allclose(x.numpy(), np.cumsum(a, 1), rtol=1e-5)
+    y = t(a)
+    assert y.cumprodi(0) is y
+    np.testing.assert_allclose(y.numpy(), np.cumprod(a, 0), rtol=1e-5)
+
+
+def test_skewness_kurtosis():
+    from scipy import stats
+    rng = np.random.default_rng(3)
+    v = rng.normal(size=(500,)).astype(np.float64) ** 3  # skewed
+    assert np.isclose(float(Tensor(v).skewness()), stats.skew(v), rtol=1e-3)
+    assert np.isclose(float(Tensor(v).kurtosis()),
+                      stats.kurtosis(v), rtol=1e-3)
+    m = rng.normal(size=(100, 3))
+    np.testing.assert_allclose(np.asarray(Tensor(m).skewness(0).numpy()),
+                               stats.skew(m, axis=0), rtol=1e-4, atol=1e-5)
+
+
+def test_transforms_statics(a):
+    np.testing.assert_allclose(Transforms.exp(t(a)).numpy(), np.exp(a),
+                               rtol=1e-5)
+    np.testing.assert_allclose(Transforms.sigmoid(t(a)).numpy(),
+                               1 / (1 + np.exp(-a)), rtol=1e-5)
+    np.testing.assert_allclose(Transforms.pow(t(np.abs(a)), 2.0).numpy(),
+                               np.abs(a) ** 2, rtol=1e-5)
+    np.testing.assert_allclose(Transforms.max(t(a), 0.0).numpy(),
+                               np.maximum(a, 0), rtol=1e-6)
+    u = Transforms.unit_vec(t(a)).numpy()
+    assert np.isclose(np.linalg.norm(u), 1.0, rtol=1e-5)
+    nz = Transforms.normalize_zero_mean_and_unit_variance(t(a)).numpy()
+    np.testing.assert_allclose(nz.mean(axis=0), 0.0, atol=1e-5)
+    assert np.isclose(Transforms.euclidean_distance(t(a), t(a * 0.0)),
+                      np.linalg.norm(a), rtol=1e-5)
+    assert np.isclose(Transforms.manhattan_distance(t(a), t(a * 0.0)),
+                      np.abs(a).sum(), rtol=1e-5)
+    assert np.isclose(Transforms.cosine_sim(t(a), t(a)), 1.0, rtol=1e-5)
+    assert np.isclose(Transforms.cosine_distance(t(a), t(a)), 0.0,
+                      atol=1e-5)
+    im = Transforms.is_max(t(a)).numpy()
+    assert im.sum() == 1.0 and im.ravel()[a.argmax()] == 1.0
+    im0 = Transforms.is_max(t(a), 0).numpy()
+    np.testing.assert_allclose(im0.sum(axis=0), np.ones(4))
+    b = a > 0
+    np.testing.assert_array_equal(Transforms.and_(b, ~b).numpy(),
+                                  np.zeros_like(b))
+    np.testing.assert_array_equal(Transforms.not_(b).numpy(), ~b)
+    assert np.isclose(Transforms.stabilize(t(np.float32([100.0])), 1.0)
+                      .numpy()[0], 20.0)
+
+
+def test_factory_tail():
+    assert T.empty().shape == (0,)
+    np.testing.assert_allclose(T.value_array_of((2, 2), 7.0).numpy(),
+                               np.full((2, 2), 7.0))
+    ts = [Tensor(np.ones(3) * i) for i in range(3)]
+    np.testing.assert_allclose(
+        T.pile(ts).numpy(), np.stack([np.ones(3) * i for i in range(3)]))
+    torn = T.tear(T.pile(ts))
+    assert len(torn) == 3 and np.allclose(torn[2].numpy(), 2.0)
+    np.testing.assert_allclose(
+        T.append(Tensor(np.ones((2, 2))), 1, 5.0).numpy()[:, -1], 5.0)
+    np.testing.assert_allclose(
+        T.prepend(Tensor(np.ones((2, 2))), 1, 5.0).numpy()[:, 0], 5.0)
+    v = np.float32([3, 1, 2])
+    np.testing.assert_allclose(T.sort(Tensor(v)).numpy(), [1, 2, 3])
+    np.testing.assert_allclose(T.sort(Tensor(v), ascending=False).numpy(),
+                               [3, 2, 1])
+    assert T.expand_dims(Tensor(v), 0).shape == (1, 3)
+    assert T.squeeze(T.expand_dims(Tensor(v), 0), 0).shape == (3,)
+
+
+def test_num_vectors_along_dimension(a):
+    assert t(a).num_vectors_along_dimension(1) == 3
+    assert t(a).num_vectors_along_dimension(0) == 4
+
+
+def test_puti_row_column_scalar(a):
+    x = t(a)
+    assert x.puti_row(0, np.zeros(4, np.float32)) is x
+    assert x.numpy()[0].sum() == 0.0
+    y = t(a)
+    assert y.puti_column(1, np.zeros(3, np.float32)) is y
+    assert y.numpy()[:, 1].sum() == 0.0
+    z = t(a)
+    assert z.puti_scalar((0, 0), 9.0) is z
+    assert z.numpy()[0, 0] == 9.0
