@@ -790,4 +790,8 @@ class OnnxFrameworkImporter:
                     f"unsupported ONNX op {node.op_type!r} (node "
                     f"{node.name!r}) — extend modelimport/onnx.py")
         sd.onnx_outputs = [vi.name for vi in g.output]  # type: ignore
+        # declared graph-input order (initializers excluded): validation
+        # runners feed positional oracles (torch forward) in this order
+        sd.onnx_inputs = [vi.name for vi in g.input  # type: ignore
+                          if vi.name not in ctx.consts]
         return sd

@@ -1,0 +1,17 @@
+"""rl4j-equivalent reinforcement learning: MDP contract, experience replay,
+double-DQN trainer, policies.
+
+TPU-native equivalent of the reference's rl4j module (reference: ``rl4j/``†
+per SURVEY.md §2.5 — presence varies by snapshot and upstream deprecated
+it; reference mount was empty, citations upstream-relative, unverified).
+Scope mirrors rl4j's discrete-action core: ``MDP`` (gym-style contract),
+``ExpReplay``, ``QLearningDiscreteDense`` (DQN with target network, double
+Q-learning, epsilon-greedy annealing), ``DQNPolicy``/``EpsGreedy``. The
+async family (A3C/AsyncNStep) is out of scope this round (recorded).
+"""
+
+from .mdp import MDP, SimpleToyMDP  # noqa: F401
+from .replay import ExpReplay, Transition  # noqa: F401
+from .qlearning import (QLearningConfiguration,  # noqa: F401
+                        QLearningDiscreteDense)
+from .policy import DQNPolicy, EpsGreedy  # noqa: F401

@@ -1,0 +1,79 @@
+"""MDP contract (reference ``rl4j-api .../mdp/MDP.java``†: gym-style
+reset/step/isDone over typed observation/action spaces)."""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import numpy as np
+
+
+class MDP:
+    """Discrete-action MDP. Subclass and implement reset/step."""
+
+    #: observation vector length
+    obs_size: int = 0
+    #: number of discrete actions
+    n_actions: int = 0
+
+    def reset(self) -> np.ndarray:
+        raise NotImplementedError
+
+    def step(self, action: int) -> Tuple[np.ndarray, float, bool]:
+        """-> (next_observation, reward, done)"""
+        raise NotImplementedError
+
+    def is_done(self) -> bool:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+
+class SimpleToyMDP(MDP):
+    """1-D corridor with a goal: the canonical rl4j toy (reference
+    ``rl4j-core .../mdp/toy/SimpleToy.java``† — a deterministic chain whose
+    optimal return is known in closed form, used for trainer convergence
+    tests). State i in [0, length); action 1 moves right (+reward at the
+    end), action 0 moves left (small negative step reward). Optimal policy:
+    always right; optimal return from 0 = (length - 2) * -0.1 + 10 (the
+    final step into the goal earns the +10, not the step penalty).
+    """
+
+    def __init__(self, length: int = 8, max_steps: int = 50):
+        self.length = int(length)
+        self.max_steps = int(max_steps)
+        self.obs_size = self.length
+        self.n_actions = 2
+        self._pos = 0
+        self._t = 0
+        self._done = False
+
+    def _obs(self) -> np.ndarray:
+        v = np.zeros((self.obs_size,), np.float32)
+        v[self._pos] = 1.0
+        return v
+
+    def reset(self) -> np.ndarray:
+        self._pos = 0
+        self._t = 0
+        self._done = False
+        return self._obs()
+
+    def step(self, action: int):
+        if self._done:
+            raise RuntimeError("step() after done; call reset()")
+        self._t += 1
+        if action == 1:
+            self._pos += 1
+        else:
+            self._pos = max(0, self._pos - 1)
+        if self._pos >= self.length - 1:
+            self._done = True
+            return self._obs(), 10.0, True
+        if self._t >= self.max_steps:
+            self._done = True
+        return self._obs(), -0.1, self._done
+
+    def is_done(self) -> bool:
+        return self._done

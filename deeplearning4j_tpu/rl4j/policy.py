@@ -1,0 +1,55 @@
+"""Policies (reference ``rl4j-core .../policy/{DQNPolicy,EpsGreedy}.java``†)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class DQNPolicy:
+    """Greedy policy over a Q-network (any model exposing ``output``)."""
+
+    def __init__(self, network):
+        self.network = network
+
+    def next_action(self, obs: np.ndarray) -> int:
+        q = np.asarray(self.network.output(obs[None, :]))
+        return int(np.argmax(q[0]))
+
+    def play(self, mdp, max_steps: int = 1000) -> float:
+        """Roll one greedy episode; returns the undiscounted return."""
+        obs = mdp.reset()
+        total = 0.0
+        for _ in range(max_steps):
+            obs, r, done = mdp.step(self.next_action(obs))
+            total += r
+            if done:
+                break
+        return total
+
+
+class EpsGreedy:
+    """Annealed epsilon-greedy exploration wrapper (reference EpsGreedy†:
+    linear anneal from eps_init to eps_min over eps_decay_steps)."""
+
+    def __init__(self, policy: DQNPolicy, n_actions: int,
+                 eps_init: float = 1.0, eps_min: float = 0.05,
+                 eps_decay_steps: int = 1000, seed: int = 7):
+        self.policy = policy
+        self.n_actions = int(n_actions)
+        self.eps_init = float(eps_init)
+        self.eps_min = float(eps_min)
+        self.eps_decay_steps = int(eps_decay_steps)
+        self._step = 0
+        self._rng = np.random.default_rng(seed)
+
+    @property
+    def epsilon(self) -> float:
+        frac = min(1.0, self._step / max(1, self.eps_decay_steps))
+        return self.eps_init + frac * (self.eps_min - self.eps_init)
+
+    def next_action(self, obs: np.ndarray) -> int:
+        eps = self.epsilon
+        self._step += 1
+        if self._rng.random() < eps:
+            return int(self._rng.integers(0, self.n_actions))
+        return self.policy.next_action(obs)

@@ -1,0 +1,52 @@
+"""Experience replay (reference ``rl4j-core .../learning/sync/ExpReplay.java``†:
+bounded uniform-sampling transition store)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List
+
+import numpy as np
+
+
+@dataclass
+class Transition:
+    obs: np.ndarray
+    action: int
+    reward: float
+    next_obs: np.ndarray
+    done: bool
+
+
+class ExpReplay:
+    """Ring-buffer replay store with uniform batch sampling."""
+
+    def __init__(self, max_size: int = 10000, batch_size: int = 32,
+                 seed: int = 123):
+        self.max_size = int(max_size)
+        self.batch_size = int(batch_size)
+        self._buf: List[Transition] = []
+        self._pos = 0
+        self._rng = np.random.default_rng(seed)
+
+    def __len__(self) -> int:
+        return len(self._buf)
+
+    def store(self, t: Transition) -> None:
+        if len(self._buf) < self.max_size:
+            self._buf.append(t)
+        else:
+            self._buf[self._pos] = t
+        self._pos = (self._pos + 1) % self.max_size
+
+    def sample(self, batch_size: int | None = None):
+        """-> (obs [B,D], actions [B], rewards [B], next_obs [B,D],
+        dones [B]) as stacked numpy arrays."""
+        bs = batch_size or self.batch_size
+        idx = self._rng.integers(0, len(self._buf), bs)
+        ts = [self._buf[i] for i in idx]
+        return (np.stack([t.obs for t in ts]).astype(np.float32),
+                np.asarray([t.action for t in ts], np.int32),
+                np.asarray([t.reward for t in ts], np.float32),
+                np.stack([t.next_obs for t in ts]).astype(np.float32),
+                np.asarray([t.done for t in ts], np.float32))

@@ -1,0 +1,81 @@
+"""Import-validation runners (nd4j-tensorflow GraphRunner /
+nd4j-onnxruntime parity, SURVEY.md §2.2): live-source oracle + our import +
+numeric diff as a one-liner."""
+import io
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.slow
+
+tf = pytest.importorskip("tensorflow")
+
+from deeplearning4j_tpu.modelimport.validation import (  # noqa: E402
+    TensorflowGraphRunner, validate_onnx_import, validate_tf_import)
+
+
+def _frozen_mlp():
+    from tensorflow.python.framework.convert_to_constants import (
+        convert_variables_to_constants_v2)
+    rng = np.random.default_rng(0)
+    w = tf.constant(rng.normal(size=(5, 3)).astype(np.float32))
+    b = tf.constant(rng.normal(size=(3,)).astype(np.float32))
+
+    @tf.function
+    def f(x):
+        return tf.nn.softmax(tf.linalg.matmul(x, w) + b)
+
+    conc = f.get_concrete_function(tf.TensorSpec([None, 5], tf.float32))
+    frozen = convert_variables_to_constants_v2(conc)
+    gd = frozen.graph.as_graph_def()
+    iname = frozen.inputs[0].name.split(":")[0]
+    oname = frozen.outputs[0].name.split(":")[0]
+    return gd, iname, oname, f
+
+
+def test_tf_graph_runner_matches_tf_function():
+    gd, iname, oname, f = _frozen_mlp()
+    x = np.random.default_rng(1).normal(size=(4, 5)).astype(np.float32)
+    runner = TensorflowGraphRunner(gd, [iname], [oname])
+    got = runner.run({iname: x})[oname]
+    ref = f(tf.constant(x)).numpy()
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_validate_tf_import_ok_report():
+    gd, iname, oname, _ = _frozen_mlp()
+    x = np.random.default_rng(2).normal(size=(4, 5)).astype(np.float32)
+    rep = validate_tf_import(gd, {iname: x}, [oname])
+    assert rep.ok, rep.summary()
+    assert rep.max_abs_diff[oname] < 1e-4
+    assert "OK" in rep.summary()
+
+
+def test_validate_tf_import_reports_unsupported_op():
+    gd, iname, oname, _ = _frozen_mlp()
+    gd2 = type(gd)()
+    gd2.CopyFrom(gd)
+    # corrupt one op type -> importer must fail, report must carry it
+    for n in gd2.node:
+        if n.op == "Softmax":
+            n.op = "NotARealOp"
+    x = np.random.default_rng(3).normal(size=(2, 5)).astype(np.float32)
+    rep = validate_tf_import(gd2, {iname: x}, [oname])
+    assert not rep.ok
+    assert "NotARealOp" in (rep.error or "")
+    assert "FAILED" in rep.summary()
+
+
+def test_validate_onnx_import():
+    torch = pytest.importorskip("torch")
+    from tests.test_onnx_import_r4 import _install_onnx_stub
+    _install_onnx_stub()
+    torch.manual_seed(0)
+    m = torch.nn.Sequential(torch.nn.Linear(6, 4), torch.nn.ReLU(),
+                            torch.nn.Linear(4, 2)).eval()
+    x = np.random.default_rng(4).normal(size=(3, 6)).astype(np.float32)
+    buf = io.BytesIO()
+    torch.onnx.export(m, (torch.from_numpy(x),), buf, opset_version=13,
+                      input_names=["x"], output_names=["y"], dynamo=False)
+    rep = validate_onnx_import(buf.getvalue(), m, {"x": x})
+    assert rep.ok, rep.summary()
