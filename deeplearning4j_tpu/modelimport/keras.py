@@ -1184,8 +1184,24 @@ def _import_functional(cfg: dict, f):
         if cls == "Average":
             gb.add_vertex(name, ElementWiseVertex(op="average"), *parents)
             continue
+        if cls == "Minimum":
+            gb.add_vertex(name, ElementWiseVertex(op="min"), *parents)
+            continue
         if cls == "Concatenate":
             gb.add_vertex(name, MergeVertex(data_format="NHWC"), *parents)
+            continue
+        if cls == "Dot":
+            axes = lc.get("axes", -1)
+            if lc.get("normalize"):
+                raise ValueError("Dot(normalize=True) not supported")
+            if isinstance(axes, (list, tuple)):
+                if len(axes) != 2 or axes[0] != axes[1]:
+                    raise ValueError(
+                        f"Dot with differing axes {axes} not supported "
+                        "(contracts different dims of each input)")
+                axes = axes[0]
+            from ..nn.vertices import DotProductVertex
+            gb.add_vertex(name, DotProductVertex(axis=int(axes)), *parents)
             continue
         mapped = _map_layer(lcfg)
         mapped_by_name[name] = mapped

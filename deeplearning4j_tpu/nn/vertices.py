@@ -212,9 +212,47 @@ class ElementWiseVertex(GraphVertex):
             y = xs[0]
             for x in xs[1:]:
                 y = jnp.maximum(y, x)
+        elif op == "min":
+            y = xs[0]
+            for x in xs[1:]:
+                y = jnp.minimum(y, x)
         else:
             raise ValueError(f"unknown elementwise op {self.op!r}")
         return y, state, _first_mask(masks)
+
+
+@vertex("dot_product")
+class DotProductVertex(GraphVertex):
+    """Batch dot product along one shared axis (Keras ``Dot(axes=k)`` for
+    the equal-shape case — similarity heads, matching networks). Inputs
+    [B, ..., n, ...] x2 -> contraction over ``axis`` with the axis kept as
+    length 1 (Keras keeps a dim so downstream Dense sees rank 2)."""
+    axis: int = -1
+
+    def initialize(self, key, input_shapes, dtype):
+        a = list(input_shapes[0])
+        ax = self.axis
+        # shapes exclude batch; axis is Keras-style counting batch as 0
+        idx = (ax - 1) if ax > 0 else (len(a) + ax)
+        a[idx] = 1
+        return {}, {}, tuple(a)
+
+    def apply(self, params, xs, state, *, train=False, rng=None, masks=None):
+        if len(xs) != 2:
+            raise ValueError("Dot takes exactly 2 inputs")
+        a, b = xs
+        if a.shape != b.shape:
+            raise ValueError(
+                f"Dot supports equal-shape inputs, got {a.shape} vs "
+                f"{b.shape} (matmul-style axes pairs not supported)")
+        if a.ndim > 2:
+            # Keras batch_dot on rank>=3 is a MATMUL-style (B, n, n)
+            # contraction, not this elementwise sum — refuse loudly
+            raise ValueError(
+                f"Dot supports one non-batch dim, got rank {a.ndim} "
+                "(batch_dot matmul semantics not implemented)")
+        return (jnp.sum(a * b, axis=self.axis, keepdims=True), state,
+                _first_mask(masks))
 
 
 @vertex("subset")

@@ -230,6 +230,20 @@ def test_asymmetric_zeropadding2d(tmp_path):
     _roundtrip(m, tmp_path, rng.normal(size=(2, 7, 7, 3)).astype(np.float32))
 
 
+def test_functional_minimum_and_dot_merges(tmp_path):
+    rng = np.random.default_rng(14)
+    inp = tf.keras.layers.Input(shape=(6,))
+    a = tf.keras.layers.Dense(8, activation="tanh", name="a")(inp)
+    b = tf.keras.layers.Dense(8, activation="tanh", name="b")(inp)
+    mn = tf.keras.layers.Minimum()([a, b])
+    dt = tf.keras.layers.Dot(axes=1)([a, b])
+    merged = tf.keras.layers.Concatenate()([mn, dt])
+    out = tf.keras.layers.Dense(2, name="out")(merged)
+    m = tf.keras.Model(inp, out)
+    _randomize(m, rng)
+    _roundtrip(m, tmp_path, rng.normal(size=(3, 6)).astype(np.float32))
+
+
 def test_spatial_dropout_1d_3d_inference_identity(tmp_path):
     rng = np.random.default_rng(13)
     m = tf.keras.Sequential([
