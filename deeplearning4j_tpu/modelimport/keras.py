@@ -445,6 +445,14 @@ def _map_relu(cfg) -> _Mapped:
     raise ValueError(f"ReLU max_value={mv} not supported (only None/6.0)")
 
 
+def _upsample_interp(cfg) -> str:
+    interp = cfg.get("interpolation", "nearest")
+    if interp not in ("nearest", "bilinear"):
+        raise ValueError(
+            f"UpSampling2D interpolation={interp!r} not supported")
+    return interp
+
+
 def _map_zeropad(cfg) -> _Mapped:
     p = cfg["padding"]
     if isinstance(p, int):
@@ -487,7 +495,8 @@ _MAPPERS: Dict[str, Callable[[dict], _Mapped]] = {
     "Softmax": lambda c: _Mapped(ActivationLayer(activation="softmax")),
     "ZeroPadding2D": lambda c: _map_zeropad(c),
     "UpSampling2D": lambda c: _Mapped(Upsampling2D(
-        size=_pair(c.get("size", 2)), data_format="NHWC")),
+        size=_pair(c.get("size", 2)), data_format="NHWC",
+        interpolation=_upsample_interp(c))),
     "Embedding": _map_embedding,
     "LSTM": _map_lstm,
     "GRU": _map_gru,

@@ -156,8 +156,7 @@ def _conv(node, ctx, at):
     dil = at.get("dilations", [1, 1])
     pads = at.get("pads", [0, 0, 0, 0])
     auto = at.get("auto_pad", "NOTSET")
-    if at.get("group", 1) != 1:
-        raise ValueError("grouped Conv not supported yet")
+    groups = int(at.get("group", 1))
     if auto in ("SAME_UPPER", "SAME_LOWER"):
         mode, pad = "same", (0, 0)
     else:
@@ -167,11 +166,13 @@ def _conv(node, ctx, at):
     args = [ctx.get(node.input[0]), ctx.get(node.input[1])]
     if len(node.input) > 2:
         args.append(ctx.get(node.input[2]))
+    # ONNX grouped weight layout [M, C/g, kH, kW] == our conv2d contract
+    # (depthwise/MobileNet and ResNeXt exports)
     return ctx.sd.call("conv2d", *args, name=node.output[0],
                        attrs={"stride": tuple(int(s) for s in strides),
                               "padding": pad, "mode": mode,
                               "dilation": tuple(int(d) for d in dil),
-                              "data_format": "NCHW"})
+                              "data_format": "NCHW", "groups": groups})
 
 
 @onnx_op("MaxPool", "AveragePool")
@@ -438,9 +439,22 @@ def _flatten(node, ctx, at):
 
 
 @onnx_op("Softmax", "LogSoftmax")
-def _softmax(node, ctx, at):
+def _softmax_legacy(node, ctx, at):
+    """Opset 1-12 semantics: flatten to 2D at ``axis`` (default 1), softmax
+    over the SECOND dim, reshape back — implemented by a trace-time op
+    (intermediate shapes are unknown at import, so an import-time rank
+    guard cannot work)."""
+    return ctx.sd.call("act.softmax_onnx_legacy", ctx.get(node.input[0]),
+                       name=node.output[0],
+                       attrs={"axis": int(at.get("axis", 1)),
+                              "log": node.op_type == "LogSoftmax"})
+
+
+@onnx_op("Softmax", "LogSoftmax", since=13)
+def _softmax13(node, ctx, at):
     op = "act.softmax" if node.op_type == "Softmax" else "act.logsoftmax"
-    return ctx.sd.call(op, ctx.get(node.input[0]), name=node.output[0])
+    return ctx.sd.call(op, ctx.get(node.input[0]), name=node.output[0],
+                       attrs={"axis": int(at.get("axis", -1))})
 
 
 @onnx_op("Concat")

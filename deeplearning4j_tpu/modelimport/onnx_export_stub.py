@@ -1,0 +1,38 @@
+"""Let ``torch.onnx.export`` run without the ``onnx`` pip package.
+
+The legacy TorchScript exporter imports ``onnx`` only for its
+onnxscript-function scan (``load_model_from_string`` + a no-op walk for
+plain models — it returns the original bytes when nothing custom is
+found). This environment has no ``onnx`` package (our importer parses
+files via the vendored minimal schema, see ``proto/onnx_min_pb2``);
+installing this stub makes torch's exporter work end-to-end so users can
+produce .onnx artifacts to feed ``OnnxFrameworkImporter``.
+
+The stub carries a real ``ModuleSpec`` — a bare ModuleType has
+``__spec__=None``, which makes ``importlib.util.find_spec("onnx")``
+RAISE, crashing unrelated code that probes for onnx (torch._dynamo's
+trace_rules does exactly that).
+"""
+
+from __future__ import annotations
+
+import importlib.machinery
+import sys
+import types
+
+
+def install_onnx_export_stub() -> None:
+    """Idempotent: no-op when a real (or stub) ``onnx`` module exists."""
+    if "onnx" in sys.modules:
+        return
+    from .proto import onnx_min_pb2 as _P
+
+    def load_model_from_string(data):
+        m = _P.ModelProto()
+        m.ParseFromString(data)
+        return m
+
+    stub = types.ModuleType("onnx")
+    stub.load_model_from_string = load_model_from_string
+    stub.__spec__ = importlib.machinery.ModuleSpec("onnx", loader=None)
+    sys.modules["onnx"] = stub

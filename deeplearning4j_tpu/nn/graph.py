@@ -475,14 +475,17 @@ class ComputationGraph:
                        compiler_options=_env.engine_compiler_options())
 
     def fit_on_device(self, features, labels, epochs: int = 1,
-                      batch_size: Optional[int] = None) -> np.ndarray:
+                      batch_size: Optional[int] = None,
+                      drop_remainder: bool = False) -> np.ndarray:
         """Train with the compiled on-device epoch loop (see
         ``_build_epoch_fn``). ``features``/``labels`` are arrays (or lists of
         arrays for multi-input/output graphs); they are reshaped to
         ``[n_batches, batch_size, ...]``, uploaded ONCE, and scanned over
-        ``epochs`` times. Trailing examples that do not fill a batch are
-        dropped (device loops need static shapes). Returns the loss history
-        ``[epochs * n_batches]``. Masked datasets must use ``fit()``.
+        ``epochs`` times. A non-divisible dataset RAISES unless
+        ``drop_remainder=True`` explicitly discards the tail (device loops
+        need static shapes; silent data loss was r3's recorded footgun).
+        Returns the loss history ``[epochs * n_batches]``. Masked datasets
+        must use ``fit()``.
         """
         if not self.params and not self.state:
             self.init()
@@ -495,6 +498,12 @@ class ComputationGraph:
         nb = n // b
         if nb == 0:
             raise ValueError(f"batch_size {b} exceeds dataset size {n}")
+        if n % b and not drop_remainder:
+            raise ValueError(
+                f"dataset size {n} is not divisible by batch_size {b}: the "
+                f"on-device scan would drop {n % b} examples. Pass "
+                "drop_remainder=True to accept that, or use fit() which "
+                "pads and masks the tail")
         dt = _dt.resolve(self.conf.dtype)
         def stack(a, cast):
             a = a[:nb * b].reshape((nb, b) + a.shape[1:])

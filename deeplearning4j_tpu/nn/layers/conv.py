@@ -288,8 +288,12 @@ class GlobalPoolingLayer(Layer):
 
 @layer("upsampling2d")
 class Upsampling2D(Layer):
+    """``interpolation``: "nearest" (DL4J Upsampling2D = repeat) or
+    "bilinear" (Keras UpSampling2D option; half-pixel sampling, matching
+    tf.image.resize)."""
     size: Tuple[int, int] = (2, 2)
     data_format: str = "NCHW"
+    interpolation: str = "nearest"
     name: Optional[str] = None
 
     def has_params(self):
@@ -304,6 +308,15 @@ class Upsampling2D(Layer):
         return {}, {}, (h * sh, w * sw, c)
 
     def apply(self, params, x, state, *, train=False, rng=None, mask=None):
+        if self.interpolation not in ("nearest", "bilinear"):
+            raise ValueError(
+                f"Upsampling2D interpolation={self.interpolation!r} not "
+                "supported (nearest | bilinear)")
+        if self.interpolation == "bilinear":
+            from ...ops.random import resize_scale
+            y = resize_scale(x, _pair(self.size), method="bilinear",
+                             data_format=self.data_format)
+            return y, state, mask
         return nnops.upsampling2d(x, self.size, self.data_format), state, mask
 
 

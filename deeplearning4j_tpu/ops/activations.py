@@ -138,13 +138,30 @@ def recttanh(x):
 
 
 @_act("softmax")
-def softmax(x):
-    return jax.nn.softmax(x, axis=-1)
+def softmax(x, axis=-1):
+    return jax.nn.softmax(x, axis=axis)
 
 
 @_act("logsoftmax")
-def logsoftmax(x):
-    return jax.nn.log_softmax(x, axis=-1)
+def logsoftmax(x, axis=-1):
+    return jax.nn.log_softmax(x, axis=axis)
+
+
+@_act("softmax_onnx_legacy")
+def softmax_onnx_legacy(x, axis=1, log=False):
+    """ONNX opset<13 Softmax semantics: flatten to 2D at ``axis``
+    (coerce [d0..dn] -> [prod(:axis), prod(axis:)]), softmax over the
+    second dim, reshape back. Shapes resolve at trace time, so importers
+    can emit this without knowing intermediate ranks."""
+    shape = x.shape
+    ax = axis if axis >= 0 else len(shape) + axis
+    lead = 1
+    for s in shape[:ax]:
+        lead *= int(s)
+    flat = x.reshape(lead, -1)
+    y = jax.nn.log_softmax(flat, axis=-1) if log else \
+        jax.nn.softmax(flat, axis=-1)
+    return y.reshape(shape)
 
 
 @_act("softplus")

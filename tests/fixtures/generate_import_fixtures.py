@@ -73,19 +73,10 @@ def gen_tf():
 
 
 def gen_onnx():
-    import sys
-    import types
     import torch
-    if "onnx" not in sys.modules:  # see test_onnx_import_r4.py
-        from deeplearning4j_tpu.modelimport.proto import onnx_min_pb2 as P
-
-        def _load(data):
-            m = P.ModelProto()
-            m.ParseFromString(data)
-            return m
-        stub = types.ModuleType("onnx")
-        stub.load_model_from_string = _load
-        sys.modules["onnx"] = stub
+    from deeplearning4j_tpu.modelimport.onnx_export_stub import (
+        install_onnx_export_stub)
+    install_onnx_export_stub()
     torch.manual_seed(2)
     tm = torch.nn.Sequential(
         torch.nn.Conv2d(2, 4, 3, padding=1), torch.nn.ReLU(),

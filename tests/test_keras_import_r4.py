@@ -230,6 +230,20 @@ def test_asymmetric_zeropadding2d(tmp_path):
     _roundtrip(m, tmp_path, rng.normal(size=(2, 7, 7, 3)).astype(np.float32))
 
 
+def test_upsampling2d_bilinear(tmp_path):
+    # interpolation="bilinear" was silently imported as nearest before r4
+    rng = np.random.default_rng(15)
+    m = tf.keras.Sequential([
+        tf.keras.layers.Input(shape=(5, 5, 2)),
+        tf.keras.layers.UpSampling2D((2, 2), interpolation="bilinear"),
+        tf.keras.layers.Conv2D(3, (3, 3), name="c"),
+        tf.keras.layers.Flatten(),
+        tf.keras.layers.Dense(2, name="out"),
+    ])
+    _randomize(m, rng)
+    _roundtrip(m, tmp_path, rng.normal(size=(2, 5, 5, 2)).astype(np.float32))
+
+
 def test_functional_minimum_and_dot_merges(tmp_path):
     rng = np.random.default_rng(14)
     inp = tf.keras.layers.Input(shape=(6,))

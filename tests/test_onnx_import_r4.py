@@ -12,32 +12,13 @@ pytestmark = pytest.mark.slow
 
 torch = pytest.importorskip("torch")
 
-import sys  # noqa: E402
-import types  # noqa: E402
-
 from deeplearning4j_tpu.modelimport.onnx import OnnxFrameworkImporter  # noqa: E402
+# installed lazily in _export (NOT at module import: pytest imports this
+# file during collection even for fast runs, and a module-scope stub
+# would leak into unrelated torch-using tests)
+from deeplearning4j_tpu.modelimport.onnx_export_stub import (  # noqa: E402
+    install_onnx_export_stub as _install_onnx_stub)
 
-
-def _install_onnx_stub():
-    """torch.onnx.export only needs onnx.load_model_from_string for its
-    onnxscript-function scan (a no-op for plain models — it returns the
-    original bytes when nothing custom is found). The real onnx package is
-    not in this environment; back the hook with our vendored minimal
-    schema. Installed lazily (NOT at module import — pytest imports this
-    file during collection even for fast runs, and a module-scope stub
-    leaked into unrelated torch-using tests)."""
-    if "onnx" in sys.modules:
-        return
-    from deeplearning4j_tpu.modelimport.proto import onnx_min_pb2 as _P
-
-    def _load_model_from_string(data):
-        m = _P.ModelProto()
-        m.ParseFromString(data)
-        return m
-
-    stub = types.ModuleType("onnx")
-    stub.load_model_from_string = _load_model_from_string
-    sys.modules["onnx"] = stub
 
 RTOL, ATOL = 1e-4, 1e-4
 
@@ -121,6 +102,25 @@ def test_transformer_mlp_block_opset17():
     # the LayerNormalization handler (since=17) must actually have fired
     assert any(r.op == "layer_norm" for r in sd._ops), \
         "expected a layer_norm op in the imported graph"
+
+
+def test_grouped_and_depthwise_conv():
+    """MobileNet-style depthwise + ResNeXt-style grouped convs — ONNX
+    group attr maps straight onto our conv2d groups."""
+    torch.manual_seed(5)
+
+    class Net(torch.nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.dw = torch.nn.Conv2d(8, 8, 3, padding=1, groups=8)
+            self.grouped = torch.nn.Conv2d(8, 16, 3, padding=1, groups=4)
+            self.head = torch.nn.Conv2d(16, 4, 1)
+
+        def forward(self, x):
+            return self.head(torch.relu(self.grouped(torch.relu(self.dw(x)))))
+
+    x = np.random.default_rng(5).normal(size=(2, 8, 6, 6)).astype(np.float32)
+    _roundtrip(Net(), x)
 
 
 def test_instance_normalization():

@@ -305,3 +305,37 @@ def test_onnx_leaky_prelu_clip_globalmaxpool():
     ref = c.max(axis=(2, 3), keepdims=True)
     out = sd.output({"x": x}, ["y"])["y"]
     np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_onnx_softmax_opset_semantics():
+    """Opset<13 Softmax = flatten-to-2D at axis (default 1); opset 13+ =
+    single-axis. Both checked on a rank-3 tensor where they differ."""
+    rng = np.random.default_rng(9)
+    x = rng.normal(size=(2, 3, 4)).astype(np.float32)
+
+    def build(opset):
+        m = P.ModelProto()
+        op = m.opset_import.add()
+        op.version = opset
+        g = m.graph
+        g.input.append(_io("x", [2, 3, 4]))
+        _node(g, "Softmax", ["x"], ["y"])
+        g.output.append(_io("y", []))
+        return m
+
+    sd = OnnxFrameworkImporter.import_model_proto(
+        build(11).SerializeToString())
+    got = sd.output({"x": x}, ["y"])["y"]
+    flat = x.reshape(2, 12)
+    e = np.exp(flat - flat.max(axis=1, keepdims=True))
+    ref = (e / e.sum(axis=1, keepdims=True)).reshape(2, 3, 4)
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+    sd13 = OnnxFrameworkImporter.import_model_proto(
+        build(13).SerializeToString())
+    got13 = sd13.output({"x": x}, ["y"])["y"]
+    e2 = np.exp(x - x.max(axis=-1, keepdims=True))
+    ref13 = e2 / e2.sum(axis=-1, keepdims=True)
+    np.testing.assert_allclose(got13, ref13, rtol=1e-5, atol=1e-6)
+    # the two semantics genuinely differ on this input
+    assert np.abs(ref - ref13).max() > 1e-3
