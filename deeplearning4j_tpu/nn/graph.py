@@ -432,7 +432,13 @@ class ComputationGraph:
 
             (loss, new_bn), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
             grads = self._clip(grads)
-            new_params, new_opt = _upd.apply_fused(
+            # leaf-wise updater application. The flat-buffer variant
+            # (updaters.apply_fused) measured a LARGE regression here on the
+            # real chip — ResNet-50 bf16: -13 MFU points at batch 128, -7.7
+            # at 256 (DIAG3_r05.json, interleaved A/B) — the ravel/unravel
+            # round-trip defeats XLA's in-place param update through the
+            # scan carry. r4's "perf-neutral" adoption was wrong; reverted.
+            new_params, new_opt = _upd.apply_leafwise(
                 updater, grads, opt_state, params, step)
             new_params = _constraints.apply_constraints(
                 self.conf.constraints, new_params, skip=frozen_keys)

@@ -269,6 +269,18 @@ class NoOp(Updater):
         return _tmap(jnp.zeros_like, grads), state
 
 
+def apply_leafwise(updater, grads, state, params, step):
+    """Per-tensor updater application + subtraction — the form the engines'
+    hot train steps use (one small XLA fusion per parameter tensor, which
+    XLA schedules in place through the donated scan carry). See
+    ``apply_fused`` for why the flat-buffer alternative is NOT used there.
+
+    Returns ``(new_params, new_state)``.
+    """
+    delta, new_state = updater.apply(grads, state, params, step)
+    return _tmap(lambda p, d: p - d, params, delta), new_state
+
+
 def apply_fused(updater, grads, state, params, step):
     """Flat-buffer updater application — the TPU rendition of DL4J's
     flat-param contract (SURVEY.md §7.3.5: one contiguous param/grad
@@ -276,20 +288,39 @@ def apply_fused(updater, grads, state, params, step):
 
     Every updater in this module is strictly elementwise, so applying it
     to ONE raveled vector is algebraically identical (bit-identical per
-    element) to leaf-wise application. The payoff is scheduling, not
-    algebra: leaf-wise tree-maps compile to one small XLA fusion per
-    parameter tensor (~160 for ResNet-50 — profiled at ~9.6 ms of the
-    45.8 ms step, each op latency-bound on its HBM round trip), while the
-    raveled form is a single fused sweep over the master buffer (<1 ms).
+    element) to leaf-wise application.
+
+    **NEGATIVE PERF RESULT (r5) — do NOT use this in a hot train step.**
+    Round 4 adopted it in the engines' fused steps claiming perf-neutral;
+    round 5's interleaved 2x2 A/B on the real chip (DIAG3_r05.json)
+    measured it as a large regression on ResNet-50 bf16: 32.5 -> 19.2 MFU
+    at batch 128, 30.9 -> 23.3 at batch 256. The ravel/unravel round-trip
+    (concat of every param/grad leaf + slice-back, ~100 MB each way at
+    ResNet-50 scale) defeats XLA's in-place donated param update through
+    the scan carry; the "single fused sweep" intuition was wrong on TPU.
+    Both engines and rl4j reverted to leaf-wise ``updater.apply``. The
+    function stays for the flat-param *semantic* contract (bit-identical
+    result, tested) and for small models where the copies are noise.
 
     Returns ``(new_params, new_state)`` — subtraction is fused in.
     Falls back to leaf-wise application when ``updater.elementwise`` is
-    False (future per-tensor-norm updaters, e.g. LARS-style).
+    False (future per-tensor-norm updaters, e.g. LARS-style) or when any
+    state entry is not a param-shaped pytree.
     """
-    if not getattr(updater, "elementwise", True) or not jax.tree.leaves(grads):
-        delta, new_state = updater.apply(grads, state, params, step)
-        new_params = _tmap(lambda p, d: p - d, params, delta)
-        return new_params, new_state
+    def _mismatched(v):
+        if jax.tree.structure(v) != jax.tree.structure(params):
+            return True
+        return any(getattr(a, "shape", None) != getattr(p, "shape", None)
+                   for a, p in zip(jax.tree.leaves(v),
+                                   jax.tree.leaves(params)))
+
+    if (not getattr(updater, "elementwise", True)
+            or not jax.tree.leaves(grads)
+            or any(_mismatched(v) for v in state.values())):
+        # leaf-wise fallback: non-elementwise updaters, and any updater whose
+        # state entries are not param-shaped pytrees (raveling those with the
+        # params unraveller would silently corrupt them)
+        return apply_leafwise(updater, grads, state, params, step)
     from jax.flatten_util import ravel_pytree
     flat_g, _ = ravel_pytree(grads)
     flat_p, unravel = ravel_pytree(params)

@@ -144,10 +144,11 @@ class ParallelWrapper:
         # Same pure step; GSPMD partitions the batch dim and inserts the
         # gradient AllReduce. Donation mirrors the single-chip path.
         # out_shardings pin the UPDATED params/state to the input layout:
-        # the engines' fused flat-buffer updater (updaters.apply_fused)
-        # ravels params through a concat/slice chain whose GSPMD-derived
-        # output shardings would otherwise drift from the TP layout and
-        # force a host reshard every step.
+        # without the pin, GSPMD is free to pick different output shardings
+        # for the updated tree than the inputs carried (observed r4 with
+        # the then-fused updater's concat/slice chain), which would force a
+        # host reshard every step — the pin keeps the TP layout stable
+        # regardless of how the update arithmetic is expressed.
         pure = self.model._build_train_step().__wrapped__
         from jax.tree_util import tree_structure
         p_sh = self._param_shardings(self.model.params)
