@@ -208,6 +208,7 @@ class SameDiff:
         self.epoch = 0
         self._score = float("nan")
         self.train_config: Dict[str, Any] = {}
+        self.dtype = "FLOAT"  # "BFLOAT16" = bf16 compute / fp32 masters
 
     # listener-facing Model protocol (Score/Collect/Checkpoint listeners)
     def score(self) -> float:
@@ -549,6 +550,20 @@ class SameDiff:
         self.updater = updater
         return self
 
+    def set_dtype(self, dtype) -> "SameDiff":
+        """Training dtype policy — mirrors the nn engines' ``dtype=
+        "BFLOAT16"`` (SameDiff TrainingConfig dtype†, SURVEY.md §7.3.8):
+        under a 16-bit policy the compiled fit step keeps fp32 MASTER
+        weights/updater state and runs the graph (matmuls included) in the
+        compute dtype; gradients flow back through the cast and land in
+        fp32. Affects ``fit`` only — ``exec``/``output``/``grad`` stay in
+        the recorded dtypes (imported-graph inference parity)."""
+        from .. import dtypes as _dt
+        _dt.resolve(dtype)  # validate early
+        self.dtype = dtype
+        self._fn_cache.pop("__fit_step__", None)
+        return self
+
     def set_training_config(self, updater=None, l1: float = 0.0,
                             l2: float = 0.0,
                             gradient_clip_value: Optional[float] = None,
@@ -610,11 +625,22 @@ class SameDiff:
         updater = self.updater
 
         tc = dict(self.train_config)
+        from .. import dtypes as _dt
+        mixed = _dt.is_mixed(self.dtype)
+        cdt = _dt.resolve(self.dtype)
 
         def step(train_vals, opt_state, other_vals, step_i, feeds):
             def loss_fn(tv):
-                env = self._compute({**other_vals, **tv}, feeds)
+                vals, fd = {**other_vals, **tv}, feeds
+                if mixed:
+                    # fp32 masters -> compute-dtype working copies; grads
+                    # flow back through the cast into fp32 (engine parity)
+                    vals = _dt.cast_floating(vals, cdt)
+                    fd = _dt.cast_floating(fd, cdt)
+                env = self._compute(vals, fd)
                 total = env[loss_name]
+                if mixed:  # regularization/score accumulate in fp32
+                    total = jnp.asarray(total, jnp.float32)
                 if tc.get("l1"):
                     total = total + tc["l1"] * sum(
                         jnp.sum(jnp.abs(v)) for v in tv.values())
@@ -651,7 +677,7 @@ class SameDiff:
         spec = ("fit", loss_name,
                 _json.dumps(updater.to_dict(), sort_keys=True, default=str),
                 _json.dumps(self.train_config, sort_keys=True, default=str),
-                tuple(train_names))
+                str(self.dtype), tuple(train_names))
         cached = self._fn_cache.get("__fit_step__")
         if cached is not None and cached[0] == spec:
             step = cached[1]

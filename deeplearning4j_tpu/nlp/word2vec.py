@@ -330,26 +330,36 @@ class Word2Vec(SequenceVectors):
 
 
 class ParagraphVectors(Word2Vec):
-    """Doc2vec, PV-DBOW form: a document vector predicts the words of its
-    document through the shared SGNS output matrix (reference
-    ``deeplearning4j-nlp .../models/paragraphvectors/ParagraphVectors.java``†
-    per SURVEY.md §2.5; mount empty, unverified. DL4J defaults to PV-DM;
-    DBOW is its ``sequenceLearningAlgorithm(DBOW)`` variant — recorded
-    choice: DBOW reuses the batched SGNS step unchanged, which is the
-    TPU-friendly shape).
+    """Doc2vec: PV-DM (the DL4J default ``sequenceLearningAlgorithm``) and
+    PV-DBOW (reference ``deeplearning4j-nlp .../models/paragraphvectors/
+    ParagraphVectors.java``†, ``.../embeddings/learning/impl/sequence/
+    {DM,DBOW}.java``† per SURVEY.md §2.5; mount empty, unverified).
+
+    PV-DM: the doc vector is averaged with the context-window word vectors
+    and the mean predicts the center word through the shared output matrix
+    (the CBOW shape with the doc vector as an extra context slot). PV-DBOW:
+    the doc vector alone predicts each word of its document (the SGNS shape
+    unchanged). Recorded divergences: word vectors train first and stay
+    frozen during doc training (DL4J trains jointly — staged training is
+    the batched TPU-friendly shape, same recorded choice as r3's DBOW);
+    the DM window is fixed at ``window`` rather than sampled per position.
 
     ``fit_labelled([(label, text), ...])`` trains word vectors first
-    (skip-gram), then document vectors against the frozen word output
-    matrix. ``infer_vector(text)`` trains a fresh doc vector the same way.
+    (skip-gram), then document vectors against the frozen matrices.
+    ``infer_vector(text)`` trains a fresh doc vector the same way.
     """
 
-    def __init__(self, infer_epochs: int = 20, **kw):
+    def __init__(self, infer_epochs: int = 20, algorithm: str = "PV-DM",
+                 **kw):
         super().__init__(**kw)
         if self.use_hierarchic_softmax:
             raise ValueError(
-                "ParagraphVectors implements the DBOW/negative-sampling "
-                "form; hierarchical softmax doc training is not supported "
+                "ParagraphVectors implements the negative-sampling forms; "
+                "hierarchical softmax doc training is not supported "
                 "(syn1 would hold Huffman inner nodes, not word rows)")
+        if algorithm not in ("PV-DM", "PV-DBOW"):
+            raise ValueError(f"algorithm={algorithm!r}: PV-DM | PV-DBOW")
+        self.algorithm = algorithm
         self.infer_epochs = infer_epochs
         self.doc_labels: List[str] = []
         self.doc_vectors: Optional[np.ndarray] = None
@@ -364,23 +374,80 @@ class ParagraphVectors(Word2Vec):
         return self
 
     def _train_doc_vector(self, tokens: List[str]) -> np.ndarray:
-        """SGNS with the doc vector as the (only) input embedding and the
-        trained syn1 frozen."""
-        import jax
-        import jax.numpy as jnp
+        if self.algorithm == "PV-DM":
+            return self._train_doc_vector_dm(tokens)
+        return self._train_doc_vector_dbow(tokens)
 
+    def _doc_training_prelude(self, tokens):
+        """Shared DM/DBOW setup: rng, in-vocab ids, doc-vector init, the
+        word2vec-c unigram**0.75 negative table, and K = 1 + negative."""
         rng = np.random.default_rng(self.seed)
         ids = np.asarray([self.vocab.word2idx[t] for t in tokens
                           if t in self.vocab.word2idx], np.int32)
         d = ((rng.random(self.layer_size) - 0.5)
              / self.layer_size).astype(np.float32)
-        if ids.size == 0:
-            return d
         counts = np.asarray(self.vocab.counts, np.float64)
         neg_p = counts ** 0.75
         neg_p /= neg_p.sum()
+        return rng, ids, d, neg_p, 1 + self.negative
+
+    def _train_doc_vector_dm(self, tokens: List[str]) -> np.ndarray:
+        """PV-DM: mean(doc vector, frozen context word vectors) predicts the
+        center word through the frozen syn1, negative sampling; only the doc
+        vector receives gradient."""
+        import jax
+        import jax.numpy as jnp
+
+        rng, ids, d, neg_p, K = self._doc_training_prelude(tokens)
+        if ids.size == 0:
+            return d
+        n, W = ids.size, self.window
+        ctx = np.full((n, 2 * W), -1, np.int64)
+        for t in range(n):
+            around = [ids[j] for j in range(max(0, t - W),
+                                            min(n, t + W + 1)) if j != t]
+            ctx[t, :len(around)] = around
+        mask = (ctx >= 0).astype(np.float32)
+        syn0 = jnp.asarray(self.syn0)
         syn1 = jnp.asarray(self.syn1)
-        K = 1 + self.negative
+        ctx_j = jnp.asarray(np.maximum(ctx, 0))
+        mask_j = jnp.asarray(mask)
+
+        @jax.jit
+        def step(dv, targets_k, labels, lr):
+            def loss_fn(v):
+                cvec = (syn0[ctx_j] * mask_j[..., None]).sum(1)  # [n, D]
+                h = (v[None, :] + cvec) / (1.0 + mask_j.sum(1)[:, None])
+                u = syn1[targets_k]                  # [n, K, D]
+                logits = jnp.einsum("nd,nkd->nk", h, u)
+                l = jnp.maximum(logits, 0) - logits * labels + \
+                    jnp.log1p(jnp.exp(-jnp.abs(logits)))
+                return l.sum() / n
+            return dv - lr * jax.grad(loss_fn)(dv)
+
+        dv = jnp.asarray(d)
+        for ep in range(self.infer_epochs):
+            negs = rng.choice(len(self.vocab), size=(n, K - 1),
+                              p=neg_p).astype(np.int32)
+            targets = np.concatenate([ids[:, None], negs], axis=1)
+            labels = np.zeros((n, K), np.float32)
+            labels[:, 0] = 1.0
+            lr = np.float32(max(self.min_learning_rate,
+                                self.learning_rate
+                                * (1 - ep / self.infer_epochs)))
+            dv = step(dv, jnp.asarray(targets), jnp.asarray(labels), lr)
+        return np.asarray(dv)
+
+    def _train_doc_vector_dbow(self, tokens: List[str]) -> np.ndarray:
+        """PV-DBOW: SGNS with the doc vector as the (only) input embedding
+        and the trained syn1 frozen."""
+        import jax
+        import jax.numpy as jnp
+
+        rng, ids, d, neg_p, K = self._doc_training_prelude(tokens)
+        if ids.size == 0:
+            return d
+        syn1 = jnp.asarray(self.syn1)
 
         @jax.jit
         def step(dv, ctx, labels, lr):
@@ -420,9 +487,13 @@ class ParagraphVectors(Word2Vec):
 
 
 class WordVectorSerializer:
-    """Text format save/load (reference ``WordVectorSerializer``:
-    'word v1 v2 ...' per line, optional 'V D' header — the word2vec-c
-    compatible format)."""
+    """Word-vector save/load (reference ``WordVectorSerializer``†).
+
+    Text: 'word v1 v2 ...' per line, optional 'V D' header (word2vec-c
+    ``-binary 0``). Binary: the word2vec-c ``-binary 1`` format DL4J's
+    ``readBinaryModel``/Google-News vectors use — header line
+    ``V D\\n``, then per word: the word bytes, a space, D little-endian
+    float32s, and a trailing newline."""
 
     @staticmethod
     def write_word_vectors(model: SequenceVectors, path: str,
@@ -448,6 +519,49 @@ class WordVectorSerializer:
             words.append(parts[0])
             vecs.append([float(v) for v in parts[1:]])
         m = SequenceVectors(layer_size=len(vecs[0]) if vecs else 0)
+        v = _Vocab()
+        for w in words:
+            v.word2idx[w] = len(v.words)
+            v.words.append(w)
+            v.counts.append(1)
+        m.vocab = v
+        m.syn0 = np.asarray(vecs, dtype=np.float32)
+        m.syn1 = np.zeros_like(m.syn0)
+        return m
+
+    @staticmethod
+    def write_binary(model: SequenceVectors, path: str):
+        """word2vec-c ``-binary 1`` writer (Google-News .bin layout)."""
+        with open(path, "wb") as f:
+            f.write(f"{len(model.vocab)} {model.layer_size}\n"
+                    .encode("utf-8"))
+            for i, w in enumerate(model.vocab.words):
+                f.write(w.encode("utf-8") + b" ")
+                f.write(np.asarray(model.syn0[i], "<f4").tobytes())
+                f.write(b"\n")
+
+    @staticmethod
+    def read_binary(path: str) -> SequenceVectors:
+        """word2vec-c ``-binary 1`` reader (DL4J ``readBinaryModel``†
+        equivalent; tolerates both the trailing-newline and packed
+        layouts)."""
+        with open(path, "rb") as f:
+            data = f.read()
+        nl = data.index(b"\n")
+        vcount, dim = (int(x) for x in data[:nl].split())
+        pos = nl + 1
+        words, vecs = [], []
+        for _ in range(vcount):
+            sp = data.index(b" ", pos)
+            word = data[pos:sp].decode("utf-8").lstrip("\n")
+            pos = sp + 1
+            vec = np.frombuffer(data, "<f4", count=dim, offset=pos)
+            pos += 4 * dim
+            if pos < len(data) and data[pos:pos + 1] == b"\n":
+                pos += 1
+            words.append(word)
+            vecs.append(vec)
+        m = SequenceVectors(layer_size=dim)
         v = _Vocab()
         for w in words:
             v.word2idx[w] = len(v.words)

@@ -11,6 +11,8 @@ contract; DL4J's stateful Nd4jRandom maps to rng.py's seeded key streams).
 
 from __future__ import annotations
 
+import math
+
 import jax
 import jax.numpy as jnp
 
@@ -96,12 +98,14 @@ def resize_scale(x, scale, method="nearest", data_format="NHWC"):
     computed from the traced input shape, so graph importers can emit this
     without knowing intermediate shapes (ONNX Resize scales form)."""
     sh, sw = scale
+    # ONNX Resize output size is floor(input_size * scale) — round() would
+    # diverge by one pixel on fractional downscales (e.g. 0.5 on an odd dim)
     if data_format == "NHWC":
-        shape = (x.shape[0], int(round(x.shape[1] * sh)),
-                 int(round(x.shape[2] * sw)), x.shape[3])
+        shape = (x.shape[0], int(math.floor(x.shape[1] * sh)),
+                 int(math.floor(x.shape[2] * sw)), x.shape[3])
     else:
-        shape = (x.shape[0], x.shape[1], int(round(x.shape[2] * sh)),
-                 int(round(x.shape[3] * sw)))
+        shape = (x.shape[0], x.shape[1], int(math.floor(x.shape[2] * sh)),
+                 int(math.floor(x.shape[3] * sw)))
     return jax.image.resize(x, shape, method=method)
 
 

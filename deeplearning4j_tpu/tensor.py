@@ -1438,21 +1438,44 @@ class Tensor:
         self._a = jnp.cumprod(self._a, axis=dim)
         return self
 
-    def skewness(self, *dims):
-        """Fisher skewness (Nd4j SummaryStats ``skewness``)."""
+    def _std_moment(self, dims, p):
+        """mean(((x - mean) / std)**p): normalize-then-power keeps the
+        intermediate O(1) for any data scale (powering the raw moment first
+        underflows f32 for small-magnitude data)."""
         d = _normalize_dims(dims)
         m = jnp.mean(self._a, axis=d, keepdims=True)
-        s = jnp.std(self._a, axis=d, keepdims=True)
-        out = jnp.mean(((self._a - m) / jnp.maximum(s, 1e-30)) ** 3, axis=d)
+        c = self._a - m
+        s = jnp.sqrt(jnp.mean(c ** 2, axis=d, keepdims=True))
+        dt = np.dtype(s.dtype)
+        tiny = (np.finfo(dt).tiny if np.issubdtype(dt, np.floating)
+                else np.finfo(np.float32).tiny)
+        z = c / jnp.maximum(s, tiny)
+        n = (jnp.size(self._a) if d is None
+             else np.prod([self._a.shape[ax] for ax in
+                           (d if isinstance(d, tuple) else (d,))]))
+        return d, jnp.mean(z ** p, axis=d), float(n)
+
+    def skewness(self, *dims):
+        """Bias-corrected sample skewness — Nd4j SummaryStats ``skewness``
+        follows commons-math's adjusted Fisher-Pearson G1
+        (== scipy.stats.skew(bias=False)): sqrt(n(n-1))/(n-2) * g1.
+        NaN for n < 3 (commons-math contract); 0 for constant input."""
+        d, g1, n = self._std_moment(dims, 3)
+        factor = np.sqrt(n * (n - 1)) / (n - 2) if n > 2 else np.nan
+        out = g1 * factor
         return _wrap(out) if d is not None else float(out)
 
     def kurtosis(self, *dims):
-        """Excess kurtosis (Nd4j SummaryStats ``kurtosis``)."""
-        d = _normalize_dims(dims)
-        m = jnp.mean(self._a, axis=d, keepdims=True)
-        s = jnp.std(self._a, axis=d, keepdims=True)
-        out = jnp.mean(((self._a - m) / jnp.maximum(s, 1e-30)) ** 4,
-                       axis=d) - 3.0
+        """Bias-corrected sample excess kurtosis — Nd4j SummaryStats
+        ``kurtosis`` follows commons-math's G2
+        (== scipy.stats.kurtosis(bias=False)). NaN for n < 4
+        (commons-math contract)."""
+        d, m4, n = self._std_moment(dims, 4)
+        g2 = m4 - 3.0
+        if n > 3:
+            out = ((n + 1) * g2 + 6) * (n - 1) / ((n - 2) * (n - 3))
+        else:
+            out = g2 * np.nan
         return _wrap(out) if d is not None else float(out)
 
     # ---- INDArray interface tail -------------------------------------------

@@ -1,0 +1,52 @@
+"""LeNet-MNIST convergence — BASELINE.md target row 3.
+
+The reference's LeNet-MNIST example trains to >=99% test accuracy
+(reference: ``dl4j-examples .../LeNetMNIST.java``† per SURVEY.md §7.2 M1;
+reference mount was empty, citation upstream-relative, unverified).
+
+Two tiers, both asserted here:
+- synthetic MNIST (the zero-egress fallback documented in data/mnist.py):
+  the module claims LeNet reaches high-90s on it — asserted at >=0.95.
+- real idx files (``MnistDataSetIterator.source == "idx"``): >=0.99,
+  skip-guarded so the bar arms automatically the moment real data exists.
+
+bench.py's ``accuracy_reason`` cites this file — keep the claims in sync.
+"""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.data.mnist import MnistDataSetIterator
+from deeplearning4j_tpu.models import lenet
+
+
+def _train_lenet(train_it, test_it, epochs, batch=125):
+    net = lenet()
+    # single pass: a shuffling iterator re-permutes on reset, so collecting
+    # features and labels in two passes would misalign them
+    batches = [(d.features, d.labels) for d in train_it]
+    xs = np.concatenate([b[0] for b in batches])
+    ys = np.concatenate([b[1] for b in batches])
+    net.fit_on_device(xs, ys, epochs=epochs, batch_size=batch,
+                      drop_remainder=True)
+    return net.evaluate(test_it).accuracy()
+
+
+@pytest.mark.slow
+def test_lenet_synthetic_mnist_accuracy():
+    train_it = MnistDataSetIterator(125, train=True, num_examples=8000)
+    test_it = MnistDataSetIterator(500, train=False, num_examples=2000)
+    if train_it.source != "synthetic":
+        pytest.skip("real MNIST present; covered by the idx-tier test")
+    acc = _train_lenet(train_it, test_it, epochs=3)
+    assert acc >= 0.95, f"LeNet synthetic-MNIST accuracy {acc:.4f} < 0.95"
+
+
+@pytest.mark.slow
+def test_lenet_real_mnist_accuracy_99():
+    train_it = MnistDataSetIterator(125, train=True)
+    if train_it.source != "idx":
+        pytest.skip("real MNIST idx files not present (zero-egress env)")
+    test_it = MnistDataSetIterator(500, train=False)
+    acc = _train_lenet(train_it, test_it, epochs=12)
+    assert acc >= 0.99, f"LeNet MNIST accuracy {acc:.4f} < 0.99"

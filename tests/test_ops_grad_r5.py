@@ -1,0 +1,246 @@
+"""Round-5 gradient-coverage ratchet: f64 finite-difference checks for the
+catalog tail the ledger named grad-untested (VERDICT r4 weak #4 — the
+reference's OpValidation culture, SURVEY.md §4 row 4).
+
+Inputs are chosen away from kinks (relu6 at 0/6, hardtanh at +-1, l1 at 0,
+pool ties) so central differences are valid; that is the same discipline
+DL4J's GradientCheckUtil docs require (use tanh-ish activations / distinct
+values when gradient-checking).
+"""
+
+import numpy as np
+import pytest
+
+import deeplearning4j_tpu.ops as ops
+from deeplearning4j_tpu.utils.gradcheck import (check_gradients,
+                                                check_op_gradient)
+
+import jax.numpy as jnp
+
+
+def _op(name):
+    return ops.get(name).fn
+
+
+def _mark_grad(*names):
+    for n in names:
+        ops.mark_grad_tested(n)
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(55)
+
+
+# ------------------------------------------------------------- activations
+
+def test_activation_tail_gradients(rng):
+    # two clusters straddling thresholdedrelu's theta=1, away from every
+    # kink (0, +-1, +-2.5, 6)
+    x = np.concatenate([rng.uniform(0.2, 0.8, 6), rng.uniform(1.2, 1.8, 6)])
+    x = x.reshape(3, 4) * np.sign(rng.normal(size=(3, 4)) + 0.3)
+    x = np.where(np.abs(np.abs(x) - 1.0) < 0.1, x * 1.3, x)  # clear +-1
+    for name in ["act.hardsigmoid", "act.hardtanh", "act.identity",
+                 "act.logsoftmax", "act.recttanh", "act.relu6",
+                 "act.thresholdedrelu"]:
+        xx = np.abs(x) if name == "act.recttanh" else x  # tanh kink at 0
+        ok, worst, _ = check_op_gradient(_op(name), xx, max_rel_error=1e-4)
+        assert ok, f"{name}: worst {worst}"
+    ok, worst, _ = check_op_gradient(_op("act.softmax_onnx_legacy"),
+                                     rng.normal(size=(2, 3, 2)),
+                                     max_rel_error=1e-4)
+    assert ok, f"softmax_onnx_legacy: worst {worst}"
+    _mark_grad("act.hardsigmoid", "act.hardtanh", "act.identity",
+               "act.logsoftmax", "act.recttanh", "act.relu6",
+               "act.thresholdedrelu", "act.softmax_onnx_legacy")
+
+
+# -------------------------------------------------------------- reductions
+
+def test_reduction_gradients(rng):
+    # distinct, strictly positive values: max/min/normmax ties and norm1's
+    # kink at 0 are both avoided
+    a = (rng.permutation(12).astype(np.float64).reshape(3, 4) + 1.0) / 3.0
+    for name, kw in [("reduce.sum", {}), ("reduce.mean", {}),
+                     ("reduce.max", {}), ("reduce.min", {}),
+                     ("reduce.prod", {}), ("reduce.std", {}),
+                     ("reduce.var", {}), ("reduce.norm1", {}),
+                     ("reduce.norm2", {}), ("reduce.normmax", {}),
+                     ("reduce.logsumexp", {}), ("reduce.cumsum", {})]:
+        ok, worst, _ = check_op_gradient(_op(name), a, max_rel_error=1e-4,
+                                         **kw)
+        assert ok, f"{name}: worst {worst}"
+    _mark_grad("reduce.sum", "reduce.mean", "reduce.max", "reduce.min",
+               "reduce.prod", "reduce.std", "reduce.var", "reduce.norm1",
+               "reduce.norm2", "reduce.normmax", "reduce.logsumexp",
+               "reduce.cumsum")
+
+
+# ------------------------------------------------------------------ losses
+
+def test_loss_tail_gradients(rng):
+    y = np.abs(rng.normal(size=(4, 3))) + 0.5  # labels != preds: l1 kink clear
+    p = -np.abs(rng.normal(size=(4, 3))) - 0.2
+    onehot = np.eye(3)[rng.integers(0, 3, 4)]
+    probs = rng.uniform(0.1, 0.9, (4, 3))
+    probs = probs / probs.sum(-1, keepdims=True)
+    for name, labels, preds in [
+            ("loss.l1", y, p), ("loss.l2", y, p),
+            ("loss.sigmoid_bce_logits", onehot, p),
+            ("loss.softmax_ce_logits", onehot, rng.normal(size=(4, 3))),
+            ("loss.multi_label", onehot, rng.normal(size=(4, 3))),
+            ("loss.fmeasure", onehot[:, :1], probs[:, :1])]:
+        ok, worst, _ = check_op_gradient(_op(name), labels, preds, argnum=1,
+                                         max_rel_error=1e-4)
+        assert ok, f"{name}: worst {worst}"
+    # sparse_mcxent: integer labels must not be FD-perturbed -> closure
+    idx = rng.integers(0, 3, 4)
+    logits = rng.normal(size=(4, 3))
+    probs2 = np.exp(logits) / np.exp(logits).sum(-1, keepdims=True)
+
+    def sparse_fn(params):
+        return jnp.sum(_op("loss.sparse_mcxent")(jnp.asarray(idx),
+                                                 params["p"]))
+    ok, worst, _ = check_gradients(sparse_fn, {"p": probs2},
+                                   max_rel_error=1e-4)
+    assert ok, f"loss.sparse_mcxent: worst {worst}"
+    _mark_grad("loss.l1", "loss.l2", "loss.sigmoid_bce_logits",
+               "loss.softmax_ce_logits", "loss.multi_label", "loss.fmeasure",
+               "loss.sparse_mcxent")
+
+
+# --------------------------------------------------------- spatial / pools
+
+def test_pool_spatial_gradients(rng):
+    x = rng.permutation(32).astype(np.float64).reshape(1, 2, 4, 4) / 7.0
+    x3 = rng.permutation(32).astype(np.float64).reshape(1, 2, 2, 2, 4) / 7.0
+    cases = [
+        ("pnormpool2d", (x,), {"kernel": (2, 2)}),
+        ("maxpool3d", (x3,), {"kernel": (2, 2, 2), "stride": (1, 1, 2)}),
+        ("avgpool3d", (x3,), {"kernel": (2, 2, 2), "stride": (1, 1, 2)}),
+        ("upsampling2d", (x,), {"size": (2, 2)}),
+        ("upsampling3d", (x3,), {"size": (1, 2, 2)}),
+        ("cropping2d", (x,), {"cropping": (1, 1)}),
+        ("zero_padding2d", (x,), {"padding": (1, 1)}),
+        ("space_to_depth", (x,), {"block_size": 2}),
+        # depth_to_space needs C % block^2 == 0
+        ("depth_to_space", (rng.normal(size=(1, 4, 2, 2)),),
+         {"block_size": 2}),
+        ("space_to_batch", (x,), {"block_size": 2}),
+        ("batch_to_space", (rng.normal(size=(4, 2, 2, 2)),),
+         {"block_size": 2}),
+        ("lrn", (x,), {}),
+        ("image.resize_scale", (rng.normal(size=(1, 4, 4, 2)),),
+         {"scale": (0.5, 1.5), "method": "bilinear",
+          "data_format": "NHWC"}),
+    ]
+    for name, args, kw in cases:
+        ok, worst, _ = check_op_gradient(_op(name), *args,
+                                         max_rel_error=1e-4, **kw)
+        assert ok, f"{name}: worst {worst}"
+    _mark_grad("pnormpool2d", "maxpool3d", "avgpool3d", "upsampling2d",
+               "upsampling3d", "cropping2d", "zero_padding2d",
+               "space_to_depth", "depth_to_space", "space_to_batch",
+               "batch_to_space", "lrn", "image.resize_scale")
+
+
+# ------------------------------------------------------------------- convs
+
+def test_conv_tail_gradients(rng):
+    x = rng.normal(size=(1, 2, 4, 4))
+    w_dep = rng.normal(size=(2, 1, 2, 2)) * 0.5
+    w_pt = rng.normal(size=(3, 2, 1, 1)) * 0.5
+    for argnum, arrs in [(0, (x, w_dep)), (1, (x, w_dep))]:
+        ok, worst, _ = check_op_gradient(_op("depthwise_conv2d"), *arrs,
+                                         argnum=argnum, max_rel_error=1e-4)
+        assert ok, f"depthwise_conv2d argnum={argnum}: worst {worst}"
+    ok, worst, _ = check_op_gradient(_op("separable_conv2d"), x, w_dep, w_pt,
+                                     argnum=1, max_rel_error=1e-4)
+    assert ok, f"separable_conv2d: worst {worst}"
+    x5 = rng.normal(size=(1, 2, 2, 2, 2))
+    w5 = rng.normal(size=(2, 2, 2, 2, 2)) * 0.5
+    ok, worst, _ = check_op_gradient(_op("deconv3d"), x5, w5,
+                                     max_rel_error=1e-4)
+    assert ok, f"deconv3d: worst {worst}"
+    _mark_grad("depthwise_conv2d", "separable_conv2d", "deconv3d")
+
+
+# ------------------------------------------------------------------- norms
+
+def test_norm_tail_gradients(rng):
+    x = rng.normal(size=(2, 3, 4))
+    gamma = np.abs(rng.normal(size=(4,))) + 0.5
+    beta = rng.normal(size=(4,))
+    for argnum in (0, 1, 2):
+        ok, worst, _ = check_op_gradient(_op("layer_norm"), x, gamma, beta,
+                                         argnum=argnum, max_rel_error=1e-4)
+        assert ok, f"layer_norm argnum={argnum}: worst {worst}"
+    xi = rng.normal(size=(2, 3, 4, 4))
+    gi = np.abs(rng.normal(size=(3,))) + 0.5
+    bi = rng.normal(size=(3,))
+    ok, worst, _ = check_op_gradient(_op("instance_norm"), xi, gi, bi,
+                                     max_rel_error=1e-4)
+    assert ok, f"instance_norm: worst {worst}"
+    _mark_grad("layer_norm", "instance_norm")
+
+
+# -------------------------------------------------------------------- misc
+
+def test_misc_tail_gradients(rng):
+    ok, worst, _ = check_op_gradient(_op("math.erfc"),
+                                     rng.normal(size=(3, 3)),
+                                     max_rel_error=1e-4)
+    assert ok, f"math.erfc: worst {worst}"
+
+    a = rng.normal(size=(2, 3))
+    b = rng.normal(size=(3, 2))
+
+    def einsum_fn(params):
+        return jnp.sum(_op("linalg.einsum")(params["a"], jnp.asarray(b),
+                                            equation="ij,jk->ik"))
+    ok, worst, _ = check_gradients(einsum_fn, {"a": a}, max_rel_error=1e-4)
+    assert ok, f"linalg.einsum: worst {worst}"
+
+    # segment reductions: integer ids bound in a closure; distinct data so
+    # segment_max/min have unique argmaxes (FD-valid)
+    ids = np.array([0, 0, 1, 2, 2, 1])
+    data = (rng.permutation(6).astype(np.float64) + 1.0) / 3.0
+    # segment_prod excluded: jax.ops.segment_prod's scatter-mul gradient is
+    # NotImplemented upstream (repeated-index rule missing) — left
+    # grad-untested in the ledger rather than papering over it
+    for name, d, i in [("scatter.segment_max", data, ids),
+                       ("scatter.segment_min", data, ids),
+                       ("scatter.segment_mean", data, ids)]:
+        def seg_fn(params, _n=name, _i=i):
+            return jnp.sum(_op(_n)(params["d"], jnp.asarray(_i), 3))
+        ok, worst, _ = check_gradients(seg_fn, {"d": d},
+                                       max_rel_error=1e-4)
+        assert ok, f"{name}: worst {worst}"
+
+    # variadic concat/stack + flatten2d
+    c = rng.normal(size=(2, 2))
+    for name in ["shape.concat_v", "shape.stack_v"]:
+        def var_fn(params, _n=name):
+            return jnp.sum(_op(_n)(params["x"], jnp.asarray(c), axis=0))
+        ok, worst, _ = check_gradients(var_fn, {"x": c.copy()},
+                                       max_rel_error=1e-4)
+        assert ok, f"{name}: worst {worst}"
+    ok, worst, _ = check_op_gradient(_op("shape.flatten2d"),
+                                     rng.normal(size=(2, 3, 2)),
+                                     max_rel_error=1e-4)
+    assert ok, f"shape.flatten2d: worst {worst}"
+
+    # dropout: fixed key in closure, train path (scaled mask is linear in x)
+    import jax
+    key = jax.random.PRNGKey(0)
+    xd = rng.normal(size=(4, 4))
+
+    def drop_fn(params):
+        return jnp.sum(_op("dropout")(params["x"], 0.3, key))
+    ok, worst, _ = check_gradients(drop_fn, {"x": xd}, max_rel_error=1e-4)
+    assert ok, f"dropout: worst {worst}"
+
+    _mark_grad("math.erfc", "linalg.einsum", "scatter.segment_max",
+               "scatter.segment_min", "scatter.segment_mean",
+               "shape.concat_v", "shape.stack_v",
+               "shape.flatten2d", "dropout")

@@ -1,0 +1,69 @@
+"""SameDiff mixed-precision policy (r5 verdict item 3).
+
+The nn engines' ``dtype="BFLOAT16"`` policy (fp32 masters, bf16 compute)
+now applies to the SameDiff/import path via ``sd.set_dtype`` — mirroring
+SameDiff TrainingConfig's dtype† (SURVEY.md §7.3.8; reference mount empty,
+citation upstream-relative, unverified). Validated against the f32 oracle
+within tolerance bands, the same discipline the engines' bf16 tests use.
+"""
+
+import numpy as np
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.autodiff.samediff import SameDiff
+from deeplearning4j_tpu.nn.updaters import Adam, Sgd
+
+
+def _mlp(seed=0):
+    rng = np.random.default_rng(seed)
+    sd = SameDiff.create()
+    x = sd.placeholder("x")
+    y = sd.placeholder("y")
+    w1 = sd.var("w1", rng.normal(0, 0.4, (8, 16)).astype(np.float32))
+    b1 = sd.var("b1", np.zeros(16, np.float32))
+    w2 = sd.var("w2", rng.normal(0, 0.4, (16, 3)).astype(np.float32))
+    b2 = sd.var("b2", np.zeros(3, np.float32))
+    h = sd.call("act.tanh", x.mmul(w1) + b1)
+    logits = h.mmul(w2) + b2
+    sd.set_loss(sd.call("loss.softmax_ce_logits", y, logits))
+    return sd
+
+
+def _feeds(seed=1, n=6):
+    rng = np.random.default_rng(seed)
+    out = []
+    for _ in range(n):
+        x = rng.normal(size=(32, 8)).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 32)]
+        out.append({"x": x, "y": y})
+    return out
+
+
+def test_bf16_policy_tracks_f32_oracle():
+    feeds = _feeds()
+    f32 = _mlp().set_updater(Sgd(learning_rate=0.2))
+    h32 = f32.fit(feeds, epochs=4)
+    b16 = _mlp().set_updater(Sgd(learning_rate=0.2)).set_dtype("BFLOAT16")
+    h16 = b16.fit(feeds, epochs=4)
+    # both train; curves agree within bf16 tolerance bands
+    assert h32.losses[-1] < h32.losses[0]
+    assert h16.losses[-1] < h16.losses[0]
+    np.testing.assert_allclose(h16.losses[-1], h32.losses[-1],
+                               rtol=0.05, atol=0.02)
+    # masters stayed fp32 under the policy
+    for n in ("w1", "w2", "b1", "b2"):
+        assert b16._values[n].dtype == jnp.float32, n
+
+
+def test_bf16_policy_retraces_and_serves_inference_in_recorded_dtype():
+    feeds = _feeds(n=2)
+    sd = _mlp().set_updater(Adam(learning_rate=1e-2))
+    sd.fit(feeds, epochs=1)
+    spec_f32 = sd._fn_cache["__fit_step__"][0]
+    sd.set_dtype("BFLOAT16")
+    assert "__fit_step__" not in sd._fn_cache  # policy change invalidates
+    sd.fit(feeds, epochs=1)
+    assert sd._fn_cache["__fit_step__"][0] != spec_f32
+    # exec/output stays in the recorded dtype (imported-graph parity)
+    out = sd.output({"x": feeds[0]["x"], "y": feeds[0]["y"]}, [sd.loss_name])
+    assert np.asarray(out[sd.loss_name]).dtype == np.float32

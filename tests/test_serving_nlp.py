@@ -288,3 +288,46 @@ def test_embedding_initialized_from_word2vec():
     from deeplearning4j_tpu.data.dataset import DataSet
     net.fit(DataSet(x, y), epochs=2)
     assert np.isfinite(float(net.score()))
+
+
+def test_paragraph_vectors_dbow_variant_and_infer_parity():
+    """PV-DBOW stays available via algorithm=; for both algorithms
+    infer_vector on a training doc's own text lands near that doc's
+    trained vector (the DL4J inferVector contract)."""
+    from deeplearning4j_tpu.nlp.word2vec import ParagraphVectors
+
+    docs = ([(f"color_{i}", "red green blue red green blue")
+             for i in range(6)]
+            + [(f"animal_{i}", "cat dog mouse cat dog mouse")
+               for i in range(6)])
+    cos = lambda x, y: float(x @ y / ((np.linalg.norm(x)
+                                       * np.linalg.norm(y)) or 1e-12))
+    for algo in ("PV-DM", "PV-DBOW"):
+        pv = ParagraphVectors(layer_size=16, window=2, min_count=1,
+                              epochs=10, seed=5, batch_size=128,
+                              subsample=0.0, learning_rate=0.1,
+                              infer_epochs=30, algorithm=algo)
+        pv.fit_labelled(docs)
+        v = pv.infer_vector("red green blue red green blue")
+        assert cos(v, pv.get_doc_vector("color_0")) > \
+            cos(v, pv.get_doc_vector("animal_0")), algo
+
+
+def test_word_vector_serializer_binary_roundtrip(tmp_path):
+    """Binary (word2vec-c -binary 1) round-trip matches the text format
+    exactly on vocab and exceeds it on precision (raw float32 bytes)."""
+    from deeplearning4j_tpu.nlp.word2vec import (Word2Vec,
+                                                 WordVectorSerializer)
+
+    w2v = Word2Vec(layer_size=12, min_count=1, epochs=3, seed=3,
+                   subsample=0.0)
+    w2v.fit(["red green blue red green", "cat dog mouse cat dog"] * 3)
+    bpath = str(tmp_path / "vecs.bin")
+    tpath = str(tmp_path / "vecs.txt")
+    WordVectorSerializer.write_binary(w2v, bpath)
+    WordVectorSerializer.write_word_vectors(w2v, tpath)
+    mb = WordVectorSerializer.read_binary(bpath)
+    mt = WordVectorSerializer.read_word_vectors(tpath)
+    assert mb.vocab.words == mt.vocab.words == w2v.vocab.words
+    np.testing.assert_array_equal(mb.syn0, w2v.syn0)  # bit-exact
+    np.testing.assert_allclose(mt.syn0, w2v.syn0, atol=1e-6)

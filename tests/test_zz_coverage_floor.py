@@ -12,9 +12,12 @@ import deeplearning4j_tpu.ops as ops
 
 # Ratcheted each round (r1: 0.50/0.35; r2: 0.80/0.60 after the math/shape/
 # linalg/sort/scatter/random/image families landed; r2 late: 0.85/0.65 once
-# the 3D conv family, einsum, fmeasure/mixture-density marked their tests).
+# the 3D conv family, einsum, fmeasure/mixture-density marked their tests;
+# r5: grad 0.65 -> 0.95 after test_ops_grad_r5.py closed the tail — the only
+# grad-untested op left is scatter.segment_prod, whose scatter-mul gradient
+# is NotImplemented upstream in jax).
 FWD_FLOOR = 0.85
-GRAD_FLOOR = 0.65
+GRAD_FLOOR = 0.95
 
 
 # every file that marks the ledger; the floor is only meaningful when ALL
@@ -25,7 +28,7 @@ GRAD_FLOOR = 0.65
 # runs too (the einsum/erfc marks moved from the slow TF goldens to
 # fast numpy oracles in test_ops_math.py).
 _MARKING_FILES = {"test_conv3d_capsules.py", "test_m17_breadth.py",
-                  "test_ops.py", "test_ops_math.py"}
+                  "test_ops.py", "test_ops_math.py", "test_ops_grad_r5.py"}
 
 
 def test_coverage_floor(request):
