@@ -923,12 +923,47 @@ def _import_keras_v3(path: str):
     weights: Dict[str, List[np.ndarray]] = {}
     with h5py.File(_io.BytesIO(weights_data), "r") as f:
         store = f["layers"] if "layers" in f else f
-        def _has_weights(key):
-            g = store[key]
-            return "vars" in g and len(g["vars"]) > 0
+
+        # wrapper stores we know how to flatten, in legacy-h5 weight order;
+        # state-only groups carry no trainable weights and must NOT be
+        # swept into the weight list (an LSTM(dropout=...) stores RNG state
+        # under seed_generator next to cell/vars)
+        _WRAPPER_CHILDREN = ("cell", "forward_layer", "backward_layer",
+                             "layer")
+        _STATE_CHILDREN = ("seed_generator",)
+
+        def _collect_vars(g, key="?") -> List[np.ndarray]:
+            """Flatten a layer store depth-first: a layer's own ``vars``
+            first, then KNOWN nested wrapper stores (RNN layers keep
+            weights under ``cell/vars``; Bidirectional under
+            ``forward_layer``/``backward_layer`` — visited in that order to
+            match the legacy h5 weight ordering the mappers consume).
+            Unknown child groups that contain weights raise loudly rather
+            than misassigning them."""
+            out: List[np.ndarray] = []
+            if "vars" in g and len(g["vars"]) > 0:
+                vs = g["vars"]
+                out += [np.array(vs[k]) for k in sorted(vs.keys(), key=int)]
+            for k in _WRAPPER_CHILDREN:
+                if k in g and hasattr(g[k], "keys"):
+                    out += _collect_vars(g[k], key=f"{key}/{k}")
+            for k in g.keys():
+                if k == "vars" or k in _WRAPPER_CHILDREN \
+                        or k in _STATE_CHILDREN:
+                    continue
+                child = g[k]
+                if hasattr(child, "keys") and _collect_vars(child,
+                                                            key=f"{key}/{k}"):
+                    raise ValueError(
+                        f".keras layer store {key!r} has weights under an "
+                        f"unrecognized child group {k!r} — store layout out "
+                        "of sync with this keras version; save as legacy "
+                        ".h5 instead")
+            return out
+
         unconsumed = {k for k in store.keys()
                       if k not in set(by_config_name.values())
-                      and _has_weights(k)}
+                      and _collect_vars(store[k])}
         if unconsumed:
             # a key-derivation mismatch would otherwise leave layers on
             # their random init SILENTLY (found the hard way: Conv2D vs a
@@ -941,19 +976,9 @@ def _import_keras_v3(path: str):
         for cfg_name, store_key in by_config_name.items():
             if store_key not in store:
                 continue
-            g = store[store_key]
-            if "vars" not in g or len(g["vars"]) == 0:
-                nested = [k for k in g.keys() if k != "vars"]
-                if nested:
-                    raise ValueError(
-                        f".keras layer store {store_key!r} has no flat "
-                        f"vars group (children: {nested}) — nested wrapper "
-                        "stores are not supported; save as legacy .h5 "
-                        "instead")
-                continue  # structural layer: nothing to copy
-            vs = g["vars"]
-            weights[cfg_name] = [np.array(vs[k])
-                                 for k in sorted(vs.keys(), key=int)]
+            ws = _collect_vars(store[store_key])
+            if ws:
+                weights[cfg_name] = ws
 
     cls = cfg["class_name"]
     if cls == "Sequential":

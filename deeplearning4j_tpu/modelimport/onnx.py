@@ -424,9 +424,13 @@ def _reshape(node, ctx, at):
         np.asarray(at.get("shape", []))
     if shape is None:
         raise ValueError("Reshape with dynamic shape input not supported")
-    return ctx.sd.call("shape.reshape", ctx.get(node.input[0]),
+    # ONNX semantics: 0 copies the input dim (allowzero=0 default) —
+    # resolved at trace time by the catalog's reshape_onnx lowering
+    return ctx.sd.call("shape.reshape_onnx", ctx.get(node.input[0]),
                        name=node.output[0],
-                       attrs={"shape": [int(s) for s in np.asarray(shape).tolist()]})
+                       attrs={"shape": [int(s) for s in
+                                        np.asarray(shape).tolist()],
+                              "allowzero": int(at.get("allowzero", 0))})
 
 
 @onnx_op("Flatten")
@@ -459,15 +463,30 @@ def _softmax13(node, ctx, at):
 
 @onnx_op("Concat")
 def _concat(node, ctx, at):
+    if all(i in ctx.consts for i in node.input):
+        # shape-arithmetic fold: torch RNN exports build initial-state
+        # shapes via Shape->Gather->Unsqueeze->Concat->Expand; Expand
+        # needs the concatenated shape as a known const
+        ctx.consts[node.output[0]] = np.concatenate(
+            [np.asarray(ctx.consts[i]) for i in node.input],
+            axis=int(at["axis"]))
     return ctx.sd.call("shape.concat_v", *[ctx.get(i) for i in node.input],
                        name=node.output[0], attrs={"axis": int(at["axis"])})
 
 
 @onnx_op("Transpose")
 def _transpose(node, ctx, at):
-    return ctx.sd.call("shape.transpose", ctx.get(node.input[0]),
-                       name=node.output[0],
-                       attrs={"axes": [int(p) for p in at.get("perm", [])]})
+    perm = [int(p) for p in at.get("perm", [])]
+    v = ctx.sd.call("shape.transpose", ctx.get(node.input[0]),
+                    name=node.output[0], attrs={"axes": perm})
+    # propagate the static shape: torch RNN exports take Shape() of a
+    # transposed input to build initial states — without this the
+    # downstream Shape->...->Expand chain cannot const-fold
+    src = ctx.get(node.input[0])
+    if src.shape is not None and all(s is not None for s in src.shape):
+        order = perm or list(range(len(src.shape)))[::-1]
+        v.shape = tuple(src.shape[p] for p in order)
+    return v
 
 
 @onnx_op("Unsqueeze")
@@ -475,6 +494,11 @@ def _unsqueeze(node, ctx, at):
     axes = at.get("axes")
     if axes is None and len(node.input) > 1:
         axes = ctx.consts[node.input[1]].tolist()
+    if node.input[0] in ctx.consts:  # shape-arithmetic fold (see Concat)
+        v = np.asarray(ctx.consts[node.input[0]])
+        for a in sorted(int(a) for a in axes):
+            v = np.expand_dims(v, a)
+        ctx.consts[node.output[0]] = v
     return ctx.sd.call("shape.expand_dims", ctx.get(node.input[0]),
                        name=node.output[0],
                        attrs={"axis": tuple(int(a) for a in axes)})
@@ -589,6 +613,11 @@ def _slice(node, ctx, at):
 def _expand(node, ctx, at):
     shape = [int(s) for s in
              np.asarray(ctx.consts[node.input[1]]).tolist()]
+    if node.input[0] in ctx.consts:
+        # fold: torch RNN exports Expand a zero scalar into the initial
+        # state; the LSTM/GRU handler's zero-state check reads consts
+        ctx.consts[node.output[0]] = np.broadcast_to(
+            np.asarray(ctx.consts[node.input[0]]), shape)
     return ctx.sd.call("shape.broadcast_to", ctx.get(node.input[0]),
                        name=node.output[0], attrs={"shape": shape})
 

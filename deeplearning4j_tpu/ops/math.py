@@ -152,6 +152,18 @@ register("linalg.norm", category="linalg")(jnp.linalg.norm)
 
 # -- shape / structural ------------------------------------------------------
 register("shape.reshape", category="shape")(jnp.reshape)
+
+
+@register("shape.reshape_onnx", category="shape")
+def _reshape_onnx(x, shape, allowzero=0):
+    """ONNX Reshape semantics: a 0 entry copies the input dim at that
+    position (unless ``allowzero``), -1 infers as usual. Resolved at trace
+    time from the static input shape — torch RNN exports reshape
+    bidirectional outputs with 0-entries."""
+    shape = list(shape)
+    if not allowzero:
+        shape = [x.shape[i] if s == 0 else s for i, s in enumerate(shape)]
+    return jnp.reshape(x, shape)
 register("shape.transpose", category="shape")(jnp.transpose)
 register("shape.permute", category="shape")(jnp.transpose)  # DL4J name
 register("shape.squeeze", category="shape")(jnp.squeeze)

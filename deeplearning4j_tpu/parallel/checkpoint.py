@@ -81,6 +81,18 @@ class TrainingCheckpointer:
             tree["state"] = model.state
         if model.updater_state:
             tree["updater"] = model.updater_state
+        if jax.process_count() > 1:
+            # multi-host: globally-sharded leaves (params trained through
+            # ParallelWrapper) serialize as-is, but host-local single-device
+            # arrays (the RNG key, any state never touched by the sharded
+            # step) cannot — orbax refuses them. They are replicated by
+            # construction (same value computed on every host), so hand
+            # them over as numpy, which orbax writes from the primary host.
+            def _localize(x):
+                if isinstance(x, jax.Array) and len(x.sharding.device_set) == 1:
+                    return np.asarray(x)
+                return x
+            tree = jax.tree.map(_localize, tree)
         meta = {"iteration": int(model.iteration), "epoch": int(model.epoch),
                 "model_class": type(model).__name__,
                 "configuration": model.conf.to_json(),
@@ -111,9 +123,24 @@ class TrainingCheckpointer:
             step = self._mngr.latest_step()
         if step is None:
             return None
-        restored = self._mngr.restore(step, args=ocp.args.Composite(
-            tree=ocp.args.PyTreeRestore(),
-            meta=ocp.args.JsonRestore()))
+        try:
+            restored = self._mngr.restore(step, args=ocp.args.Composite(
+                tree=ocp.args.PyTreeRestore(),
+                meta=ocp.args.JsonRestore()))
+        except (ValueError, KeyError):
+            # topology change (e.g. a host died and the survivors restore
+            # on fewer devices — the §5 failure-recovery path): the saved
+            # shardings name devices that no longer exist. Re-read every
+            # leaf as host numpy; jnp.asarray below re-places on the
+            # current topology's default device and ParallelWrapper
+            # re-shards on the next step.
+            tree_meta = self._mngr.item_metadata(step)["tree"]
+            restore_args = jax.tree.map(
+                lambda _: ocp.RestoreArgs(restore_type=np.ndarray),
+                tree_meta)
+            restored = self._mngr.restore(step, args=ocp.args.Composite(
+                tree=ocp.args.PyTreeRestore(restore_args=restore_args),
+                meta=ocp.args.JsonRestore()))
         tree, meta = restored["tree"], restored["meta"]
         if meta["model_class"] != type(model).__name__:
             raise ValueError(

@@ -6,12 +6,17 @@ per SURVEY.md §2.5 — presence varies by snapshot and upstream deprecated
 it; reference mount was empty, citations upstream-relative, unverified).
 Scope mirrors rl4j's discrete-action core: ``MDP`` (gym-style contract),
 ``ExpReplay``, ``QLearningDiscreteDense`` (DQN with target network, double
-Q-learning, epsilon-greedy annealing), ``DQNPolicy``/``EpsGreedy``. The
-async family (A3C/AsyncNStep) is out of scope this round (recorded).
+Q-learning, epsilon-greedy annealing), ``QLearningDiscreteConv`` +
+``HistoryProcessor`` (the pixel path: frame stacking into a conv Q-net,
+solved on ``PixelGridworldMDP`` in-suite — ALE/gym emulators are absent in
+this environment, recorded), ``DQNPolicy``/``EpsGreedy``. The async family
+(A3C/AsyncNStep) is out of scope (recorded; upstream deprecated it).
 """
 
-from .mdp import MDP, SimpleToyMDP  # noqa: F401
+from .mdp import MDP, PixelGridworldMDP, SimpleToyMDP  # noqa: F401
 from .replay import ExpReplay, Transition  # noqa: F401
-from .qlearning import (QLearningConfiguration,  # noqa: F401
+from .qlearning import (HistoryProcessor,  # noqa: F401
+                        QLearningConfiguration,
+                        QLearningDiscreteConv,
                         QLearningDiscreteDense)
 from .policy import DQNPolicy, EpsGreedy  # noqa: F401

@@ -77,3 +77,64 @@ class SimpleToyMDP(MDP):
 
     def is_done(self) -> bool:
         return self._done
+
+
+class PixelGridworldMDP(MDP):
+    """Pixel-observation gridworld for the conv-DQN path (the in-suite
+    stand-in for the reference's ALE/gym pixel environments, which need
+    native emulators this environment lacks — reference
+    ``rl4j-gym``/``rl4j-ale``† per SURVEY.md §2.5).
+
+    The agent walks a ``size``x``size`` grid from (0,0) to the goal at
+    (size-1, size-1). Observations are raw frames [size, size] float32:
+    goal pixel = 0.5, agent pixel = 1.0 (overwrites the goal pixel when
+    standing on it). Actions: 0=right, 1=down, 2=left, 3=up. Reward +10
+    at the goal, -0.1 per step; episode truncates at ``max_steps``.
+    Optimal return = 10 - 0.1 * (2*(size-1) - 1).
+    """
+
+    def __init__(self, size: int = 4, max_steps: int = 40):
+        self.size = int(size)
+        self.max_steps = int(max_steps)
+        self.obs_size = self.size * self.size
+        self.n_actions = 4
+        self._pos = (0, 0)
+        self._t = 0
+        self._done = False
+
+    @property
+    def optimal_return(self) -> float:
+        return 10.0 - 0.1 * (2 * (self.size - 1) - 1)
+
+    def _frame(self) -> np.ndarray:
+        f = np.zeros((self.size, self.size), np.float32)
+        g = self.size - 1
+        f[g, g] = 0.5
+        r, c = self._pos
+        f[r, c] = 1.0
+        return f
+
+    def reset(self) -> np.ndarray:
+        self._pos = (0, 0)
+        self._t = 0
+        self._done = False
+        return self._frame()
+
+    def step(self, action: int):
+        if self._done:
+            raise RuntimeError("step() after done; call reset()")
+        self._t += 1
+        r, c = self._pos
+        dr, dc = [(0, 1), (1, 0), (0, -1), (-1, 0)][int(action)]
+        r = min(self.size - 1, max(0, r + dr))
+        c = min(self.size - 1, max(0, c + dc))
+        self._pos = (r, c)
+        if self._pos == (self.size - 1, self.size - 1):
+            self._done = True
+            return self._frame(), 10.0, True
+        if self._t >= self.max_steps:
+            self._done = True
+        return self._frame(), -0.1, self._done
+
+    def is_done(self) -> bool:
+        return self._done

@@ -60,6 +60,10 @@ class QLearningDiscreteDense:
         self.step_count = 0       # environment steps
         self.update_count = 0     # gradient updates (drives Adam/schedules)
         self.episode_returns = []
+        # set by play(): the shared mdp/history were driven off-policy, so
+        # the next train_step must start a fresh episode instead of pairing
+        # observations from two unrelated trajectories in the replay buffer
+        self._pending_reset = False
 
     # ------------------------------------------------------------ training
     def _build_update(self):
@@ -100,16 +104,25 @@ class QLearningDiscreteDense:
 
         return jax.jit(update, donate_argnums=(0, 1))
 
+    # observation hooks: the conv/pixel subclass stacks frame history here
+    def _observe_reset(self, frame):
+        return frame
+
+    def _observe_step(self, frame):
+        return frame
+
     def train_step(self) -> Optional[float]:
         """One environment step (+ one learn step once warm). Returns the
         TD loss when a learn step ran."""
         mdp = self.mdp
-        if mdp.is_done() or self.step_count == 0:
-            self._obs = mdp.reset()
+        if mdp.is_done() or self.step_count == 0 or self._pending_reset:
+            self._obs = self._observe_reset(mdp.reset())
             self._ep_ret = 0.0
+            self._pending_reset = False
         obs = self._obs
         action = self.explorer.next_action(obs)
-        next_obs, reward, done = mdp.step(action)
+        next_frame, reward, done = mdp.step(action)
+        next_obs = self._observe_step(next_frame)
         self.replay.store(Transition(obs, action, reward, next_obs, done))
         self._obs = next_obs
         self._ep_ret += reward
@@ -145,3 +158,68 @@ class QLearningDiscreteDense:
 
     def get_policy(self) -> DQNPolicy:
         return self.policy
+
+
+class HistoryProcessor:
+    """Rolling frame stack (reference ``rl4j-core .../learning/
+    HistoryProcessor.java``†: the Atari-style last-N-frames observation).
+    ``reset(frame)`` fills the stack with the first frame; ``add(frame)``
+    rolls it. Stacked output is [history, H, W] float32 — the channel axis
+    a NCHW conv Q-net consumes."""
+
+    def __init__(self, history_length: int = 4):
+        self.n = int(history_length)
+        self._frames = None
+
+    def reset(self, frame) -> np.ndarray:
+        f = np.asarray(frame, np.float32)
+        self._frames = [f] * self.n
+        return self.get()
+
+    def add(self, frame) -> np.ndarray:
+        self._frames = self._frames[1:] + [np.asarray(frame, np.float32)]
+        return self.get()
+
+    def get(self) -> np.ndarray:
+        return np.stack(self._frames, axis=0)
+
+
+class QLearningDiscreteConv(QLearningDiscreteDense):
+    """DQN over a convolutional Q-net on stacked pixel frames (reference
+    ``rl4j-core .../qlearning/discrete/QLearningDiscreteConv.java``†: the
+    flagship pixel-DQN entry point — HistoryProcessor frame stack feeding
+    a conv net through the same sync double-DQN machinery).
+
+    The MDP must emit 2-D frames [H, W]; observations seen by the replay
+    buffer, policy, and the jitted TD update are the stacked
+    [history, H, W] arrays. Everything else — replay, target network,
+    double-DQN TD update as one XLA program — is inherited unchanged."""
+
+    def __init__(self, mdp: MDP, network,
+                 conf: Optional[QLearningConfiguration] = None,
+                 history_length: int = 4):
+        super().__init__(mdp, network, conf)
+        self.history = HistoryProcessor(history_length)
+
+    def _observe_reset(self, frame):
+        return self.history.reset(frame)
+
+    def _observe_step(self, frame):
+        return self.history.add(frame)
+
+    def play(self, max_steps: int = 1000) -> float:
+        """Greedy rollout with the frame stack applied (DQNPolicy.play
+        sees raw frames; the conv Q-net needs stacked observations).
+        Drives the shared mdp/history, so the trainer is flagged to start
+        a fresh episode on the next train_step."""
+        obs = self.history.reset(self.mdp.reset())
+        total = 0.0
+        for _ in range(max_steps):
+            a = self.policy.next_action(obs)
+            frame, r, done = self.mdp.step(a)
+            obs = self.history.add(frame)
+            total += r
+            if done:
+                break
+        self._pending_reset = True
+        return total

@@ -3,10 +3,16 @@
 ``IntegrationTestRunner``† — full models trained N steps from a fixed seed,
 params/losses compared against stored snapshots with tolerance bands).
 
+r5 breadth (verdict item 4): four goldens — LeNet MLN, ResNet-18
+ComputationGraph (the north-star model family), a Bidirectional-LSTM
+sequence model, and a Keras-imported model (trained through the import
+path) — plus a committed serialization back-compat fixture
+(``compat_model_r5.zip``) that every later round must keep loading.
+
 Shared by the regression test (tests/test_integration_golden.py) and the
 fixture generator (``python tests/golden_harness.py`` regenerates
-tests/fixtures/lenet_golden.json — rerun after a DELIBERATE numeric change
-and commit the diff; an undeliberate change fails CI).
+tests/fixtures/*_golden.json and the compat zip — rerun after a DELIBERATE
+numeric change and commit the diff; an undeliberate change fails CI).
 """
 
 import json
@@ -14,16 +20,29 @@ import os
 
 import numpy as np
 
-FIXTURE = os.path.join(os.path.dirname(__file__), "fixtures",
-                       "lenet_golden.json")
+FIXTURE_DIR = os.path.join(os.path.dirname(__file__), "fixtures")
+FIXTURE = os.path.join(FIXTURE_DIR, "lenet_golden.json")  # legacy name
+COMPAT_ZIP = os.path.join(FIXTURE_DIR, "compat_model_r5.zip")
+COMPAT_JSON = os.path.join(FIXTURE_DIR, "compat_model_r5_expected.json")
 STEPS = 8
 BATCH = 16
 
 
-def run_reference_training() -> dict:
-    """Train LeNet STEPS fixed steps from fixed seeds; return the snapshot."""
+def _snapshot_net(net, losses) -> dict:
     import jax
 
+    params = {}
+    for path, leaf in jax.tree_util.tree_leaves_with_path(net.params):
+        key = "/".join(str(p) for p in path)
+        a = np.asarray(leaf, dtype=np.float64).ravel()
+        params[key] = {"mean": float(a.mean()), "std": float(a.std()),
+                       "head": [float(v) for v in a[:5]]}
+    return {"steps": len(losses), "batch": BATCH, "losses": losses,
+            "params": params}
+
+
+def run_reference_training() -> dict:
+    """LeNet MLN trained STEPS fixed steps from fixed seeds."""
     from deeplearning4j_tpu.data.dataset import DataSet
     from deeplearning4j_tpu.models.lenet import lenet
     from deeplearning4j_tpu.nn.updaters import Adam
@@ -36,15 +55,89 @@ def run_reference_training() -> dict:
         y = np.eye(10, dtype=np.float32)[rng.integers(0, 10, BATCH)]
         net.fit(DataSet(x, y), epochs=1)
         losses.append(float(net.score()))
+    return _snapshot_net(net, losses)
 
-    params = {}
-    for path, leaf in jax.tree_util.tree_leaves_with_path(net.params):
-        key = "/".join(str(p) for p in path)
-        a = np.asarray(leaf, dtype=np.float64).ravel()
-        params[key] = {"mean": float(a.mean()), "std": float(a.std()),
-                       "head": [float(v) for v in a[:5]]}
-    return {"steps": STEPS, "batch": BATCH, "losses": losses,
-            "params": params}
+
+def run_resnet18_cg() -> dict:
+    """Mini ResNet-18 ComputationGraph (residual blocks + BN + global
+    pool — the CG-family golden the r4 harness lacked)."""
+    from deeplearning4j_tpu.data.dataset import DataSet
+    from deeplearning4j_tpu.models.resnet import resnet
+    from deeplearning4j_tpu.nn.updaters import Sgd
+
+    rng = np.random.default_rng(20260731)
+    net = resnet(18, num_classes=8, input_shape=(32, 32, 3), seed=123,
+                 updater=Sgd(learning_rate=0.05)).init()
+    losses = []
+    for _ in range(6):
+        x = rng.normal(size=(8, 32, 32, 3)).astype(np.float32)
+        y = np.eye(8, dtype=np.float32)[rng.integers(0, 8, 8)]
+        net.fit(DataSet(x, y), epochs=1)
+        losses.append(float(net.score()))
+    return _snapshot_net(net, losses)
+
+
+def run_bilstm() -> dict:
+    """Bidirectional-LSTM sequence classifier (RNN-family golden)."""
+    from deeplearning4j_tpu.data.dataset import DataSet
+    from deeplearning4j_tpu.nn.config import (InputType,
+                                              NeuralNetConfiguration)
+    from deeplearning4j_tpu.nn.layers.recurrent import (Bidirectional,
+                                                        LSTM,
+                                                        RnnOutputLayer)
+    from deeplearning4j_tpu.nn.model import MultiLayerNetwork
+    from deeplearning4j_tpu.nn.updaters import Adam
+
+    rng = np.random.default_rng(20260732)
+    conf = (NeuralNetConfiguration.builder()
+            .seed(99).updater(Adam(learning_rate=2e-3))
+            .input_type(InputType.recurrent(6))
+            .list(Bidirectional(LSTM(n_out=12, activation="tanh")),
+                  RnnOutputLayer(n_out=4))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    losses = []
+    for _ in range(STEPS):
+        x = rng.normal(size=(BATCH, 10, 6)).astype(np.float32)  # [B, T, F]
+        idx = rng.integers(0, 4, (BATCH, 10))
+        y = np.eye(4, dtype=np.float32)[idx]                    # [B, T, C]
+        net.fit(DataSet(x, y), epochs=1)
+        losses.append(float(net.score()))
+    return _snapshot_net(net, losses)
+
+
+def run_keras_imported() -> dict:
+    """The committed keras_smoke.h5 (Conv2D/BN/pool/Dense Sequential,
+    input NHWC 8x8x3, 5 classes) imported and trained through the import
+    path (imported-model golden; no live TF needed)."""
+    from deeplearning4j_tpu.data.dataset import DataSet
+    from deeplearning4j_tpu.modelimport import KerasModelImport
+    from deeplearning4j_tpu.nn.updaters import Sgd
+
+    h5 = os.path.join(FIXTURE_DIR, "keras_smoke.h5")
+    net = KerasModelImport.import_keras_model_and_weights(h5)
+    net.conf.updater = Sgd(learning_rate=0.05)
+    net.updater_state = net.conf.updater.init_state(net.params)
+    rng = np.random.default_rng(20260733)
+    losses = []
+    for _ in range(STEPS):
+        x = rng.normal(size=(BATCH, 8, 8, 3)).astype(np.float32)
+        y = np.eye(5, dtype=np.float32)[rng.integers(0, 5, BATCH)]
+        net.fit(DataSet(x, y), epochs=1)
+        losses.append(float(net.score()))
+    return _snapshot_net(net, losses)
+
+
+MODELS = {
+    "lenet": (run_reference_training, FIXTURE),
+    "resnet18_cg": (run_resnet18_cg,
+                    os.path.join(FIXTURE_DIR, "resnet18_cg_golden.json")),
+    "bilstm": (run_bilstm,
+               os.path.join(FIXTURE_DIR, "bilstm_golden.json")),
+    "keras_imported": (run_keras_imported,
+                       os.path.join(FIXTURE_DIR,
+                                    "keras_imported_golden.json")),
+}
 
 
 def compare(snapshot: dict, golden: dict, rtol: float = 1e-3,
@@ -63,7 +156,39 @@ def compare(snapshot: dict, golden: dict, rtol: float = 1e-3,
             rtol=rtol, atol=atol, err_msg=f"param {key} drifted")
 
 
+def generate_compat_fixture():
+    """Save a trained model zip + expected outputs: later rounds must keep
+    loading it bit-for-bit (the reference's 'old models must still load'
+    tier)."""
+    from deeplearning4j_tpu.data.dataset import DataSet
+    from deeplearning4j_tpu.nn.config import (InputType,
+                                              NeuralNetConfiguration)
+    from deeplearning4j_tpu.nn.layers.core import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.model import MultiLayerNetwork
+    from deeplearning4j_tpu.nn.updaters import Adam
+
+    rng = np.random.default_rng(20260734)
+    conf = (NeuralNetConfiguration.builder()
+            .seed(7).updater(Adam(learning_rate=1e-2))
+            .input_type(InputType.feed_forward(5))
+            .list(DenseLayer(n_out=8, activation="tanh"),
+                  OutputLayer(n_out=3)).build())
+    net = MultiLayerNetwork(conf).init()
+    x = rng.normal(size=(32, 5)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 32)]
+    net.fit(DataSet(x, y), epochs=5)
+    net.save(COMPAT_ZIP)
+    probe = rng.normal(size=(4, 5)).astype(np.float32)
+    out = np.asarray(net.output(probe))
+    with open(COMPAT_JSON, "w") as f:
+        json.dump({"probe": probe.tolist(), "expected": out.tolist(),
+                   "iteration": net.iteration}, f, indent=1)
+
+
 if __name__ == "__main__":
+    import sys
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))  # repo root (script run from anywhere)
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     flags = os.environ.get("XLA_FLAGS", "")
     if "host_platform_device_count" not in flags:
@@ -72,8 +197,11 @@ if __name__ == "__main__":
     import jax
 
     jax.config.update("jax_platforms", "cpu")
-    os.makedirs(os.path.dirname(FIXTURE), exist_ok=True)
-    snap = run_reference_training()
-    with open(FIXTURE, "w") as f:
-        json.dump(snap, f, indent=1)
-    print(f"wrote {FIXTURE}: final loss {snap['losses'][-1]:.6f}")
+    os.makedirs(FIXTURE_DIR, exist_ok=True)
+    for name, (fn, path) in MODELS.items():
+        snap = fn()
+        with open(path, "w") as f:
+            json.dump(snap, f, indent=1)
+        print(f"wrote {path}: final loss {snap['losses'][-1]:.6f}")
+    generate_compat_fixture()
+    print(f"wrote {COMPAT_ZIP} + expected outputs")

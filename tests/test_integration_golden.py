@@ -1,46 +1,80 @@
-"""Golden-snapshot regression: LeNet trained N fixed steps from fixed seeds
-must reproduce the committed fixture within tolerance bands (SURVEY.md §4,
-``IntegrationTestRunner``† analog). Regenerate DELIBERATE changes with
-``python tests/golden_harness.py`` and commit the new fixture."""
+"""Golden-snapshot regression: fixed-seed trainings must reproduce the
+committed fixtures within tolerance bands (SURVEY.md §4,
+``IntegrationTestRunner``† analog). r5 breadth: LeNet MLN, ResNet-18 CG,
+Bidirectional-LSTM, a Keras-imported model, and a serialization
+back-compat zip. Regenerate DELIBERATE changes with
+``python tests/golden_harness.py`` and commit the new fixtures."""
 
 import copy
 import json
 import os
 
+import numpy as np
 import pytest
 
 pytestmark = pytest.mark.slow
 
-from golden_harness import FIXTURE, compare, run_reference_training
+from golden_harness import COMPAT_JSON, COMPAT_ZIP, MODELS, compare
 
 
 @pytest.fixture(scope="module")
-def snapshot():
-    return run_reference_training()
+def snapshots():
+    return {}
 
 
-def _golden():
-    if not os.path.exists(FIXTURE):
-        pytest.fail(f"golden fixture missing: {FIXTURE} — run "
+def _golden(path):
+    if not os.path.exists(path):
+        pytest.fail(f"golden fixture missing: {path} — run "
                     "`python tests/golden_harness.py` and commit it")
-    with open(FIXTURE) as f:
+    with open(path) as f:
         return json.load(f)
 
 
-def test_training_matches_golden_snapshot(snapshot):
-    compare(snapshot, _golden())
+@pytest.mark.parametrize("name", sorted(MODELS))
+def test_training_matches_golden_snapshot(name, snapshots):
+    fn, path = MODELS[name]
+    snapshots[name] = fn()
+    compare(snapshots[name], _golden(path))
 
 
-def test_harness_trips_on_numeric_drift(snapshot):
+def test_harness_trips_on_numeric_drift(snapshots):
     """Sensitivity check: a small deliberate perturbation must fail the
     comparison — otherwise the tolerance bands are too loose to guard
     anything."""
+    fn, path = MODELS["lenet"]
+    snapshot = snapshots.get("lenet") or fn()
     drifted = copy.deepcopy(snapshot)
     drifted["losses"][-1] *= 1.01
     with pytest.raises(AssertionError):
-        compare(drifted, _golden())
+        compare(drifted, _golden(path))
     drifted2 = copy.deepcopy(snapshot)
     key = next(iter(drifted2["params"]))
     drifted2["params"][key]["mean"] += 0.01
     with pytest.raises(AssertionError):
-        compare(drifted2, _golden())
+        compare(drifted2, _golden(path))
+
+
+def test_serialization_back_compat():
+    """The committed round-5-era model zip must keep loading and produce
+    the recorded outputs — the reference's 'old models must still load'
+    tier (ref† dl4j-integration-tests, SURVEY.md §4)."""
+    from deeplearning4j_tpu.nn.model import MultiLayerNetwork
+
+    if not os.path.exists(COMPAT_ZIP):
+        pytest.fail(f"compat fixture missing: {COMPAT_ZIP} — run "
+                    "`python tests/golden_harness.py` and commit it")
+    with open(COMPAT_JSON) as f:
+        expected = json.load(f)
+    net = MultiLayerNetwork.load(COMPAT_ZIP)
+    probe = np.asarray(expected["probe"], np.float32)
+    out = np.asarray(net.output(probe))
+    np.testing.assert_allclose(out, np.asarray(expected["expected"]),
+                               rtol=1e-5, atol=1e-6)
+    assert net.iteration == expected["iteration"]
+    # and it keeps TRAINING from the restored updater state
+    from deeplearning4j_tpu.data.dataset import DataSet
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(8, 5)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 8)]
+    net.fit(DataSet(x, y), epochs=1)
+    assert np.isfinite(net.score())

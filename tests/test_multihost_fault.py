@@ -1,0 +1,187 @@
+"""Multihost fault injection (SURVEY.md §5 "Failure detection / recovery"
+row + §4 lesson 3: the reference's distributed tests kill workers
+mid-training and assert recovery; r4 only had single-process kill-resume).
+
+Phase A: a 2-process (2 "hosts" x 4 virtual CPU devices) data-parallel run
+checkpoints every step (orbax, durable); after step 3 host 0 records the
+pre-crash truth (params npz) and host 1 SIGKILLs itself MID-EPOCH — a hard
+crash, not a clean exit. Host 0 then blocks in the next collective; the
+parent (playing the cluster supervisor) detects the dead partner and
+terminates it — that is the failure-detection tier this environment can
+express without a real cluster manager.
+
+Phase B: a fresh SINGLE-process run (the survivor topology) restores the
+latest checkpoint and must match the pre-crash truth BIT-EXACTLY (params,
+iteration, iterator cursor), then continues training to a finite loss.
+"""
+
+import os
+import signal
+import socket
+import subprocess
+import sys
+import textwrap
+import time
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.slow
+
+_PHASE_A = textwrap.dedent("""
+    import os, sys
+    import numpy as np
+
+    port, pid, ckdir, truth = sys.argv[1], int(sys.argv[2]), sys.argv[3], \\
+        sys.argv[4]
+
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    from deeplearning4j_tpu.parallel import launcher
+    launcher.initialize(coordinator_address=f"127.0.0.1:{port}",
+                        num_processes=2, process_id=pid)
+
+    from deeplearning4j_tpu.data.dataset import NumpyDataSetIterator
+    from deeplearning4j_tpu.nn.config import InputType, NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.layers.core import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.model import MultiLayerNetwork
+    from deeplearning4j_tpu.nn.updaters import Adam
+    from deeplearning4j_tpu.parallel.checkpoint import TrainingCheckpointer
+    from deeplearning4j_tpu.parallel.data_parallel import ParallelWrapper
+
+    conf = (NeuralNetConfiguration.builder()
+            .seed(0).updater(Adam(learning_rate=1e-2))
+            .input_type(InputType.feed_forward(6))
+            .list(DenseLayer(n_out=16, activation="tanh"),
+                  OutputLayer(n_out=3))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+
+    rng = np.random.default_rng(0)  # same data on every host; iterator shards
+    x = rng.normal(size=(96, 6)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 96)]
+    base = NumpyDataSetIterator(x, y, batch_size=16, shuffle=True, seed=4)
+    it = launcher.HostShardedIterator(base)
+
+    mesh = launcher.global_mesh()
+    pw = ParallelWrapper(net, mesh)
+    ckpt = TrainingCheckpointer(ckdir, max_to_keep=4)
+
+    # per-batch loop with a checkpoint after every step
+    for step, ds in enumerate(it, start=1):
+        pw.fit(ds, epochs=1)
+        ckpt.save(net, iterator=it, step=step, wait=True)
+        if step == 3:
+            if pid == 0:
+                flat = {"/".join(str(p) for p in path): np.asarray(a)
+                        for path, a in
+                        jax.tree_util.tree_leaves_with_path(net.params)}
+                np.savez(truth, iteration=net.iteration,
+                         cursor_position=it.state()["pos"], **flat)
+                print("host 0: truth recorded at step 3", flush=True)
+            else:
+                print("host 1: crashing mid-epoch", flush=True)
+                os.kill(os.getpid(), 9)   # hard kill, no cleanup
+    print(f"host {pid}: finished (should not happen for host 1)", flush=True)
+""")
+
+_PHASE_B = textwrap.dedent("""
+    import sys
+    import numpy as np
+
+    ckdir, truth = sys.argv[1], sys.argv[2]
+
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    from deeplearning4j_tpu.data.dataset import NumpyDataSetIterator
+    from deeplearning4j_tpu.nn.config import InputType, NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.layers.core import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.model import MultiLayerNetwork
+    from deeplearning4j_tpu.nn.updaters import Adam
+    from deeplearning4j_tpu.parallel.checkpoint import TrainingCheckpointer
+
+    conf = (NeuralNetConfiguration.builder()
+            .seed(0).updater(Adam(learning_rate=1e-2))
+            .input_type(InputType.feed_forward(6))
+            .list(DenseLayer(n_out=16, activation="tanh"),
+                  OutputLayer(n_out=3))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(96, 6)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 96)]
+    it = NumpyDataSetIterator(x, y, batch_size=16, shuffle=True, seed=4)
+
+    ckpt = TrainingCheckpointer(ckdir)
+    step = ckpt.restore(net, iterator=it)
+    assert step == 3, f"expected latest checkpoint at step 3, got {step}"
+
+    t = np.load(truth)
+    assert net.iteration == int(t["iteration"]), "iteration drifted"
+    assert it.state()["pos"] == int(t["cursor_position"]), \\
+        "iterator cursor drifted"
+    for path, a in jax.tree_util.tree_leaves_with_path(net.params):
+        key = "/".join(str(p) for p in path)
+        got = np.asarray(a)
+        np.testing.assert_array_equal(got, t[key], err_msg=key)
+
+    # survivor continues training on its own devices
+    for ds in it:
+        net.fit(ds, epochs=1)
+    assert np.isfinite(float(net.score()))
+    print("survivor: resumed bit-exact and finished epoch", flush=True)
+""")
+
+
+def test_kill_host_mid_epoch_resume_bit_exact(tmp_path):
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+
+    a = tmp_path / "phase_a.py"
+    a.write_text(_PHASE_A)
+    b = tmp_path / "phase_b.py"
+    b.write_text(_PHASE_B)
+    ckdir = str(tmp_path / "ckpt")
+    truth = str(tmp_path / "truth.npz")
+    env = dict(os.environ,
+               JAX_PLATFORMS="cpu",
+               XLA_FLAGS="--xla_force_host_platform_device_count=4",
+               PYTHONPATH=os.path.dirname(os.path.dirname(__file__)))
+
+    procs = [subprocess.Popen(
+        [sys.executable, str(a), str(port), str(i), ckdir, truth],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+        for i in range(2)]
+
+    # host 1 must die from its self-inflicted SIGKILL
+    try:
+        out1, _ = procs[1].communicate(timeout=240)
+    except subprocess.TimeoutExpired:
+        for q in procs:
+            q.kill()
+        raise
+    assert procs[1].returncode == -signal.SIGKILL, (
+        f"host 1 rc={procs[1].returncode}:\n{out1}")
+    assert "host 1: crashing mid-epoch" in out1
+
+    # host 0 is now partnerless (blocked in the next collective); the
+    # parent is the failure detector and reaps it
+    deadline = time.time() + 60
+    while procs[0].poll() is None and time.time() < deadline:
+        time.sleep(1.0)
+    if procs[0].poll() is None:
+        procs[0].terminate()
+    out0, _ = procs[0].communicate(timeout=60)
+    assert "host 0: truth recorded at step 3" in out0, out0
+    assert os.path.exists(truth), "pre-crash truth npz missing"
+
+    # phase B: survivor topology restores and continues
+    pb = subprocess.run([sys.executable, str(b), ckdir, truth], env=env,
+                        stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                        text=True, timeout=240)
+    assert pb.returncode == 0, pb.stdout
+    assert "survivor: resumed bit-exact and finished epoch" in pb.stdout

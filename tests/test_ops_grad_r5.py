@@ -229,6 +229,13 @@ def test_misc_tail_gradients(rng):
                                      rng.normal(size=(2, 3, 2)),
                                      max_rel_error=1e-4)
     assert ok, f"shape.flatten2d: worst {worst}"
+    # ONNX reshape: 0 copies the dim, -1 infers
+    r = _op("shape.reshape_onnx")(jnp.ones((2, 3, 4)), [0, -1])
+    assert r.shape == (2, 12)
+    ok, worst, _ = check_op_gradient(_op("shape.reshape_onnx"),
+                                     rng.normal(size=(2, 3, 2)),
+                                     shape=[0, -1], max_rel_error=1e-4)
+    assert ok, f"shape.reshape_onnx: worst {worst}"
 
     # dropout: fixed key in closure, train path (scaled mask is linear in x)
     import jax
@@ -243,4 +250,5 @@ def test_misc_tail_gradients(rng):
     _mark_grad("math.erfc", "linalg.einsum", "scatter.segment_max",
                "scatter.segment_min", "scatter.segment_mean",
                "shape.concat_v", "shape.stack_v",
-               "shape.flatten2d", "dropout")
+               "shape.flatten2d", "shape.reshape_onnx", "dropout")
+    ops.mark_fwd_tested("shape.reshape_onnx")

@@ -82,3 +82,56 @@ def test_dqn_learns_the_corridor():
     # learning actually happened (loss became finite + episodes completed)
     assert trainer.episode_returns, "no episodes finished"
     assert trainer.episode_returns[-1] >= trainer.episode_returns[0]
+
+
+def test_history_processor_stacks_frames():
+    from deeplearning4j_tpu.rl4j import HistoryProcessor
+    hp = HistoryProcessor(3)
+    f0 = np.zeros((2, 2), np.float32)
+    f1 = np.ones((2, 2), np.float32)
+    s = hp.reset(f0)
+    assert s.shape == (3, 2, 2) and s.sum() == 0
+    s = hp.add(f1)
+    np.testing.assert_array_equal(s[0], f0)
+    np.testing.assert_array_equal(s[2], f1)
+    s = hp.add(f1 * 2)
+    np.testing.assert_array_equal(s, np.stack([f0, f1, f1 * 2]))
+
+
+def test_pixel_conv_dqn_solves_gridworld():
+    """QLearningDiscreteConv (frame stack + conv Q-net, same jitted TD
+    update) solves the pixel gridworld to near the closed-form optimum —
+    the reference's QLearningDiscreteConv† flagship path in miniature."""
+    from deeplearning4j_tpu.nn.config import (InputType,
+                                              NeuralNetConfiguration)
+    from deeplearning4j_tpu.nn.layers.conv import ConvolutionLayer
+    from deeplearning4j_tpu.nn.layers.core import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.model import MultiLayerNetwork
+    from deeplearning4j_tpu.nn.updaters import Adam
+    from deeplearning4j_tpu.rl4j import (PixelGridworldMDP,
+                                         QLearningConfiguration,
+                                         QLearningDiscreteConv)
+
+    mdp = PixelGridworldMDP(size=4, max_steps=30)
+    hist = 2
+    cfg = (NeuralNetConfiguration.builder().seed(11)
+           .updater(Adam(3e-3))
+           .input_type(InputType.convolutional(hist, 4, 4))
+           .list(ConvolutionLayer(n_out=8, kernel=(2, 2), padding=(1, 1),
+                                  activation="relu"),
+                 DenseLayer(n_out=32, activation="relu"),
+                 OutputLayer(n_out=4, loss="mse", activation="identity"))
+           .build())
+    qnet = MultiLayerNetwork(cfg).init()
+    ql = QLearningDiscreteConv(
+        mdp, qnet,
+        QLearningConfiguration(seed=11, batch_size=32, gamma=0.95,
+                               eps_decay_steps=1500, update_start=64,
+                               target_dqn_update_freq=150,
+                               exp_replay_size=4000),
+        history_length=hist)
+    ql.train(max_steps=2600)
+    ret = ql.play(max_steps=30)
+    # optimal = 9.5; accept a near-optimal path (one detour)
+    assert ret >= mdp.optimal_return - 1.0, (
+        f"greedy return {ret} < {mdp.optimal_return - 1.0}")
