@@ -323,6 +323,109 @@ def _map_conv2d_transpose(cfg) -> _Mapped:
     return _Mapped(lyr, w)
 
 
+def _map_conv3d_transpose(cfg) -> _Mapped:
+    from ..nn.layers.conv3d import Deconvolution3D
+    if cfg.get("data_format", "channels_last") != "channels_last":
+        raise ValueError("Conv3DTranspose channels_first not supported")
+    pad = cfg.get("padding", "valid")
+    if pad not in ("valid", "same"):
+        raise ValueError(f"Conv3DTranspose padding={pad!r} not supported")
+    if tuple(_triple3(cfg.get("dilation_rate", 1))) != (1, 1, 1):
+        raise ValueError("Conv3DTranspose dilation != 1 not supported")
+    if cfg.get("output_padding") not in (None,):
+        raise ValueError("Conv3DTranspose explicit output_padding "
+                         "not supported")
+    lyr = Deconvolution3D(
+        n_out=int(cfg["filters"]), kernel=_triple3(cfg["kernel_size"]),
+        stride=_triple3(cfg.get("strides", 1)),
+        mode="same" if pad == "same" else "truncate",
+        activation=_act(cfg.get("activation")),
+        has_bias=bool(cfg.get("use_bias", True)), data_format="NDHWC")
+
+    def w(ws):
+        # Keras kernel [kD, kH, kW, out, in] -> ours [out, in, kD, kH, kW]
+        kern = np.transpose(np.asarray(ws[0]), (3, 4, 0, 1, 2))
+        out = {"W": kern}
+        if len(ws) > 1:
+            out["b"] = ws[1]
+        return out
+
+    return _Mapped(lyr, w)
+
+
+def _map_cudnn_lstm(cfg) -> _Mapped:
+    """tf.compat.v1 CuDNNLSTM: fixed tanh/sigmoid math (== our cell); the
+    only difference from LSTM is the DOUBLE bias (input + recurrent halves,
+    [2, 4u] or flat [8u]) which sums into one effective bias."""
+    cfg = dict(cfg)
+    cfg.setdefault("activation", "tanh")
+    cfg.setdefault("recurrent_activation", "sigmoid")
+    base = _map_lstm(cfg)
+    u = int(cfg["units"])
+
+    def w(ws):
+        ws = list(ws)
+        if len(ws) > 2 and np.asarray(ws[2]).size == 8 * u:
+            b2 = np.asarray(ws[2]).reshape(2, 4 * u)
+            ws[2] = b2[0] + b2[1]
+        return base.weights(ws)
+
+    return _Mapped(base.layer, w, vertex=base.vertex)
+
+
+def _map_cudnn_gru(cfg) -> _Mapped:
+    """tf.compat.v1 CuDNNGRU == GRU(reset_after=True) with the double
+    bias already in the [2, 3u] layout our reset_after mapper consumes."""
+    cfg = dict(cfg)
+    cfg.setdefault("activation", "tanh")
+    cfg.setdefault("recurrent_activation", "sigmoid")
+    cfg["reset_after"] = True
+    return _map_gru(cfg)
+
+
+def _map_multi_head_attention(cfg) -> _Mapped:
+    """Keras MultiHeadAttention in the self-attention arrangement
+    (query == value == key — the only form expressible in a single-input
+    layer stack; cross-attention needs graph-level wiring). Maps onto
+    SelfAttentionLayer with per-projection biases."""
+    from ..nn.layers.attention import SelfAttentionLayer
+    heads = int(cfg["num_heads"])
+    key_dim = int(cfg["key_dim"])
+    if cfg.get("value_dim") not in (None, key_dim):
+        raise ValueError("MultiHeadAttention value_dim != key_dim "
+                         "not supported")
+    if cfg.get("attention_axes") not in (None, [1], (1,)):
+        raise ValueError("MultiHeadAttention attention_axes beyond the "
+                         "time axis not supported")
+    use_bias = bool(cfg.get("use_bias", True))
+    oshape = cfg.get("output_shape")
+    if isinstance(oshape, (list, tuple)):
+        oshape = oshape[-1] if oshape else None
+    # n_out=0 resolves to the input feature dim at init (the keras default
+    # when output_shape is unset)
+    lyr = SelfAttentionLayer(n_out=int(oshape) if oshape else 0,
+                             n_heads=heads, head_size=key_dim,
+                             has_bias=use_bias)
+
+    def w(ws):
+        ws = [np.asarray(a) for a in ws]
+        if use_bias:
+            kq, bq, kk, bk, kv, bv, ko, bo = ws
+        else:
+            kq, kk, kv, ko = ws
+        f = kq.shape[0]
+        proj = heads * key_dim
+        out = {"Wq": kq.reshape(f, proj), "Wk": kk.reshape(f, proj),
+               "Wv": kv.reshape(f, proj),
+               "Wo": ko.reshape(proj, ko.shape[-1])}
+        if use_bias:
+            out.update({"bq": bq.reshape(proj), "bk": bk.reshape(proj),
+                        "bv": bv.reshape(proj), "bo": bo.reshape(-1)})
+        return out
+
+    return _Mapped(lyr, w)
+
+
 def _map_conv3d(cfg) -> _Mapped:
     from ..nn.layers.conv3d import Convolution3D
     if cfg.get("data_format", "channels_last") != "channels_last":
@@ -504,7 +607,13 @@ _MAPPERS: Dict[str, Callable[[dict], _Mapped]] = {
     "Bidirectional": _map_bidirectional,
     "Conv1D": _map_conv1d,
     "Conv2DTranspose": lambda c: _map_conv2d_transpose(c),
+    "Conv3DTranspose": lambda c: _map_conv3d_transpose(c),
     "Conv3D": _map_conv3d,
+    # legacy tf.compat.v1 cuDNN-pinned RNNs: same math as our cells with
+    # double (input+recurrent) biases
+    "CuDNNLSTM": _map_cudnn_lstm,
+    "CuDNNGRU": _map_cudnn_gru,
+    "MultiHeadAttention": _map_multi_head_attention,
     "MaxPooling1D": lambda c: _map_pool1d(c, "max"),
     "AveragePooling1D": lambda c: _map_pool1d(c, "avg"),
     "GlobalAveragePooling1D": lambda c: _Mapped(
@@ -1203,6 +1312,31 @@ def _import_functional(cfg: dict, f):
             input_types.append(_input_type_from_batch_shape(shape))
             continue
         parents = _inbound_parents(lcfg.get("inbound_nodes", []))
+        if cls == "MultiHeadAttention":
+            # keras MHA is called (query, value[, key]); the self-attention
+            # arrangement passes the same tensor — our SelfAttentionLayer
+            # takes it once. Distinct parents = cross-attention: unsupported
+            uniq = sorted(set(parents))
+            if len(uniq) > 1:
+                raise ValueError(
+                    "MultiHeadAttention with distinct query/value/key "
+                    "parents (cross-attention) is not supported in import")
+            parents = uniq
+            # call-time kwargs live in the inbound node spec; importing a
+            # causal model as full attention would be silently wrong
+            def _has_truthy(o, key):
+                if isinstance(o, dict):
+                    return bool(o.get(key)) or any(
+                        _has_truthy(v, key) for v in o.values())
+                if isinstance(o, (list, tuple)):
+                    return any(_has_truthy(v, key) for v in o)
+                return False
+            for bad in ("use_causal_mask", "attention_mask"):
+                if _has_truthy(lcfg.get("inbound_nodes", []), bad):
+                    raise ValueError(
+                        f"MultiHeadAttention called with {bad} is not "
+                        "supported in import (would silently import as "
+                        "full bidirectional attention)")
         if cls == "Add":
             gb.add_vertex(name, ElementWiseVertex(op="add"), *parents)
             continue

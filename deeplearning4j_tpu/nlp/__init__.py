@@ -1,5 +1,7 @@
 """NLP: word/sequence embeddings (SURVEY.md §2.5 deeplearning4j-nlp)."""
 
-from .word2vec import (SequenceVectors, TokenizerFactory,  # noqa: F401
+from .word2vec import (FastText, ParagraphVectors,  # noqa: F401
+                       SequenceVectors, TokenizerFactory,
                        Word2Vec, WordVectorSerializer)
+from .glove import Glove  # noqa: F401
 from .graph import DeepWalk, Graph  # noqa: F401

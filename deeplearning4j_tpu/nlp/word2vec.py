@@ -109,6 +109,27 @@ def _huffman_tree(counts):
     return code_a, point_a, mask_a, nxt - V
 
 
+def _draw_negatives(rng, neg_cum, negative, center, context) -> List[int]:
+    """Negative samples via searchsorted over the cumulative unigram^0.75
+    table (numpy's choice-with-p rebuilds the CDF per call — O(V) per
+    pair); resample draws that hit the positive pair, as word2vec-c does.
+    Shared by Word2Vec and FastText."""
+    out: List[int] = []
+    draws = np.searchsorted(neg_cum, rng.random(2 * negative))
+    for d in draws:
+        if d != center and d != context:
+            out.append(int(d))
+            if len(out) == negative:
+                return out
+    tries = 0
+    while len(out) < negative:  # rare: tiny vocab / unlucky
+        d = int(np.searchsorted(neg_cum, rng.random()))
+        tries += 1
+        if d != center and d != context or tries > 20:
+            out.append(d)  # degenerate 1-2 word vocab: accept
+    return out
+
+
 class SequenceVectors:
     """Skip-gram negative-sampling over generic element sequences
     (reference ``SequenceVectors``): Word2Vec specializes it with a
@@ -139,6 +160,33 @@ class SequenceVectors:
         self.syn1: Optional[np.ndarray] = None   # output embeddings
 
     # ---- training -----------------------------------------------------------
+    def _embedding_table_rows(self, V: int) -> int:
+        """syn0 row count — FastText appends hashed n-gram buckets."""
+        return V
+
+    def _make_ns_step(self):
+        """Jitted negative-sampling update; the input-embedding lookup is
+        the subclass seam (FastText means subword rows instead)."""
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def step(syn0, syn1, center, context, labels, lr):
+            # center [B], context [B, 1+neg], labels [B, 1+neg]
+            def loss_fn(s0, s1):
+                v = s0[center]                       # [B, D]
+                u = s1[context]                      # [B, K, D]
+                logits = jnp.einsum("bd,bkd->bk", v, u)
+                # sigmoid BCE on logits
+                l = jnp.maximum(logits, 0) - logits * labels + \
+                    jnp.log1p(jnp.exp(-jnp.abs(logits)))
+                return l.sum() / center.shape[0]
+
+            g0, g1 = jax.grad(loss_fn, argnums=(0, 1))(syn0, syn1)
+            return syn0 - lr * g0, syn1 - lr * g1
+
+        return step
+
     def fit_sequences(self, sequences: Sequence[List[str]]) -> "SequenceVectors":
         import jax
         import jax.numpy as jnp
@@ -148,7 +196,8 @@ class SequenceVectors:
         V, D = len(self.vocab), self.layer_size
         if V == 0:
             raise ValueError(f"empty vocabulary (min_count={self.min_count})")
-        self.syn0 = ((rng.random((V, D)) - 0.5) / D).astype(np.float32)
+        self.syn0 = ((rng.random((self._embedding_table_rows(V), D)) - 0.5)
+                     / D).astype(np.float32)
         if self.use_hierarchic_softmax:
             hs_code, hs_point, hs_mask, n_inner = _huffman_tree(
                 self.vocab.counts)
@@ -193,20 +242,7 @@ class SequenceVectors:
             g0, g1 = jax.grad(loss_fn, argnums=(0, 1))(syn0, syn1)
             return syn0 - lr * g0, syn1 - lr * g1
 
-        @jax.jit
-        def step(syn0, syn1, center, context, labels, lr):
-            # center [B], context [B, 1+neg], labels [B, 1+neg]
-            def loss_fn(s0, s1):
-                v = s0[center]                       # [B, D]
-                u = s1[context]                      # [B, K, D]
-                logits = jnp.einsum("bd,bkd->bk", v, u)
-                # sigmoid BCE on logits
-                l = jnp.maximum(logits, 0) - logits * labels + \
-                    jnp.log1p(jnp.exp(-jnp.abs(logits)))
-                return l.sum() / center.shape[0]
-
-            g0, g1 = jax.grad(loss_fn, argnums=(0, 1))(syn0, syn1)
-            return syn0 - lr * g0, syn1 - lr * g1
+        step = self._make_ns_step()
 
         syn0 = jnp.asarray(self.syn0)
         syn1 = jnp.asarray(self.syn1)
@@ -245,25 +281,6 @@ class SequenceVectors:
                                       np.float32(lr))
                 n_steps += 1
 
-        def draw_negatives(center, context) -> List[int]:
-            # searchsorted over the cumulative table (numpy's choice-with-p
-            # rebuilds the CDF per call — O(V) per pair); resample draws
-            # that hit the positive pair, as word2vec-c does
-            out: List[int] = []
-            draws = np.searchsorted(neg_cum, rng.random(2 * self.negative))
-            for d in draws:
-                if d != center and d != context:
-                    out.append(int(d))
-                    if len(out) == self.negative:
-                        return out
-            tries = 0
-            while len(out) < self.negative:  # rare: tiny vocab / unlucky
-                d = int(np.searchsorted(neg_cum, rng.random()))
-                tries += 1
-                if d != center and d != context or tries > 20:
-                    out.append(d)  # degenerate 1-2 word vocab: accept
-            return out
-
         for _ in range(self.epochs):
             for ids in ids_stream:
                 if ids.size == 0:
@@ -280,7 +297,8 @@ class SequenceVectors:
                         if self.use_hierarchic_softmax:
                             contexts.append([ctx])
                         else:
-                            contexts.append([ctx] + draw_negatives(c, ctx))
+                            contexts.append([ctx] + _draw_negatives(
+                                rng, neg_cum, self.negative, c, ctx))
                 flush()
         flush(force=True)
         self.syn0 = np.asarray(syn0)
@@ -486,6 +504,135 @@ class ParagraphVectors(Word2Vec):
         return float(va @ vb / den)
 
 
+def _fnv1a(s: str) -> int:
+    """FNV-1a over utf-8 bytes — the hash fastText buckets n-grams with."""
+    h = 2166136261
+    for b in s.encode("utf-8"):
+        h = ((h ^ b) * 16777619) & 0xFFFFFFFF
+    return h
+
+
+class FastText(Word2Vec):
+    """Subword-enriched word vectors, fastText-style (reference:
+    ``deeplearning4j-nlp .../fasttext/FastText.java``† per SURVEY.md §2.5 —
+    upstream wraps the JFastText C++ lib; this is a native reimplementation
+    of the skip-gram subword model, recorded divergence).
+
+    Each word's input vector is the MEAN of its own row plus hashed
+    character n-gram rows (word wrapped in ``<``/``>``, n-gram lengths
+    ``minn``..``maxn``, FNV-1a into ``bucket`` slots — the fastText
+    scheme), so morphology is shared across words and **out-of-vocabulary
+    words get vectors from their n-grams alone** — the fastText hallmark
+    ``get_word_vector`` supports here.
+    """
+
+    def __init__(self, minn: int = 3, maxn: int = 6, bucket: int = 100000,
+                 **kw):
+        super().__init__(**kw)
+        if self.use_hierarchic_softmax:
+            raise ValueError("FastText implements the negative-sampling "
+                             "form only")
+        self.minn, self.maxn, self.bucket = int(minn), int(maxn), int(bucket)
+        self._sub_ids: Optional[np.ndarray] = None   # [V, maxsub] padded
+        self._sub_mask: Optional[np.ndarray] = None
+
+    def _ngram_ids(self, word: str, V: int) -> List[int]:
+        """Hashed subword rows for a word (offset past the V word rows)."""
+        w = f"<{word}>"
+        out = []
+        for n in range(self.minn, self.maxn + 1):
+            for i in range(len(w) - n + 1):
+                g = w[i:i + n]
+                if g == w:
+                    continue  # the full token is the word row itself
+                out.append(V + _fnv1a(g) % self.bucket)
+        return out
+
+    def _build_subwords(self):
+        V = len(self.vocab)
+        rows = [[i] + self._ngram_ids(w, V)
+                for i, w in enumerate(self.vocab.words)]
+        m = max(len(r) for r in rows)
+        ids = np.zeros((V, m), np.int32)
+        mask = np.zeros((V, m), np.float32)
+        for i, r in enumerate(rows):
+            ids[i, :len(r)] = r
+            mask[i, :len(r)] = 1.0
+        self._sub_ids, self._sub_mask = ids, mask
+
+    # fit_sequences is INHERITED — these two hooks are the whole
+    # specialization (the pair generation, negative table, subsampling,
+    # and lr anneal are shared with Word2Vec)
+    def _embedding_table_rows(self, V: int) -> int:
+        self._build_subwords()
+        return V + self.bucket
+
+    def _make_ns_step(self):
+        import jax
+        import jax.numpy as jnp
+
+        sub_ids = jnp.asarray(self._sub_ids)
+        sub_mask = jnp.asarray(self._sub_mask)
+
+        @jax.jit
+        def step(syn0, syn1, center, context, labels, lr):
+            rows = sub_ids[center]                   # [B, S]
+            msk = sub_mask[center]                   # [B, S]
+
+            # gradients w.r.t. the GATHERED rows only, applied as
+            # scatter-adds: dense grads over the [V+bucket, D] table would
+            # rewrite ~bucket*D floats per batch regardless of batch size
+            def loss_fn(vr, ur):
+                v = (vr * msk[..., None]).sum(1) \
+                    / msk.sum(1, keepdims=True)      # mean of subword rows
+                logits = jnp.einsum("bd,bkd->bk", v, ur)
+                l = jnp.maximum(logits, 0) - logits * labels + \
+                    jnp.log1p(jnp.exp(-jnp.abs(logits)))
+                return l.sum() / center.shape[0]
+
+            gv, gu = jax.grad(loss_fn, argnums=(0, 1))(
+                syn0[rows], syn1[context])
+            return (syn0.at[rows].add(-lr * gv),
+                    syn1.at[context].add(-lr * gu))
+
+        return step
+
+    # ---- queries: subword composition, incl. out-of-vocabulary words ----
+    def get_word_vector(self, w: str) -> np.ndarray:
+        V = len(self.vocab)
+        if w in self.vocab.word2idx:
+            rows = [self.vocab.word2idx[w]] + self._ngram_ids(w, V)
+        else:
+            rows = self._ngram_ids(w, V)   # OOV: n-grams alone
+            if not rows:
+                return np.zeros((self.layer_size,), np.float32)
+        return np.asarray(self.syn0[rows].mean(axis=0))
+
+    def _word_matrix(self) -> np.ndarray:
+        """All composed in-vocab vectors in one vectorized pass over the
+        prebuilt padded subword-row tables."""
+        s = self.syn0[self._sub_ids] * self._sub_mask[..., None]
+        return s.sum(1) / self._sub_mask.sum(1, keepdims=True)
+
+    def words_nearest(self, w: str, top_n: int = 10):
+        """Nearest in-vocab words by cosine over COMPOSED vectors (the
+        inherited implementation walks raw syn0 rows, which here include
+        the n-gram buckets)."""
+        q = self.get_word_vector(w)
+        mat = self._word_matrix()
+        qn = q / (np.linalg.norm(q) or 1e-12)
+        mn = mat / np.maximum(np.linalg.norm(mat, axis=1, keepdims=True),
+                              1e-12)
+        sims = mn @ qn
+        order = np.argsort(-sims)
+        out = [(self.vocab.words[i], float(sims[i])) for i in order
+               if self.vocab.words[i] != w]
+        return out[:top_n]
+
+    def has_word(self, w: str) -> bool:  # every word has n-gram rows
+        return self.vocab is not None
+
+
 class WordVectorSerializer:
     """Word-vector save/load (reference ``WordVectorSerializer``†).
 
@@ -501,8 +648,11 @@ class WordVectorSerializer:
         with open(path, "w") as f:
             if header:
                 f.write(f"{len(model.vocab)} {model.layer_size}\n")
-            for i, w in enumerate(model.vocab.words):
-                vec = " ".join(f"{v:.6f}" for v in model.syn0[i])
+            for w in model.vocab.words:
+                # get_word_vector, not raw syn0 rows: FastText COMPOSES its
+                # vectors from subword rows — raw rows would silently
+                # change every vector on a save/load round trip
+                vec = " ".join(f"{v:.6f}" for v in model.get_word_vector(w))
                 f.write(f"{w} {vec}\n")
 
     @staticmethod
@@ -535,9 +685,10 @@ class WordVectorSerializer:
         with open(path, "wb") as f:
             f.write(f"{len(model.vocab)} {model.layer_size}\n"
                     .encode("utf-8"))
-            for i, w in enumerate(model.vocab.words):
+            for w in model.vocab.words:
                 f.write(w.encode("utf-8") + b" ")
-                f.write(np.asarray(model.syn0[i], "<f4").tobytes())
+                f.write(np.asarray(model.get_word_vector(w),
+                                   "<f4").tobytes())
                 f.write(b"\n")
 
     @staticmethod

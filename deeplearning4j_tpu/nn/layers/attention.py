@@ -47,12 +47,13 @@ def _key_mask(mask, like):
 
 
 def _mha(x_q, x_kv, params, n_heads, mask):
-    def proj(x, w):
-        return jnp.dot(x, w, precision=precision_for(x, w))
+    def proj(x, w, b=None):
+        y = jnp.dot(x, w, precision=precision_for(x, w))
+        return y if b is None else y + b
 
-    q = _heads_split(proj(x_q, params["Wq"]), n_heads)
-    k = _heads_split(proj(x_kv, params["Wk"]), n_heads)
-    v = _heads_split(proj(x_kv, params["Wv"]), n_heads)
+    q = _heads_split(proj(x_q, params["Wq"], params.get("bq")), n_heads)
+    k = _heads_split(proj(x_kv, params["Wk"], params.get("bk")), n_heads)
+    v = _heads_split(proj(x_kv, params["Wv"], params.get("bv")), n_heads)
     scale = 1.0 / jnp.sqrt(jnp.asarray(q.shape[-1], q.dtype))
     scores = jnp.einsum("bhqd,bhkd->bhqk", q, k,
                         precision=precision_for(q, k)) * scale
@@ -63,17 +64,21 @@ def _mha(x_q, x_kv, params, n_heads, mask):
     y = jnp.einsum("bhqk,bhkd->bhqd", att, v,
                    precision=precision_for(att, v))
     y = _heads_join(y)
-    return proj(y, params["Wo"])
+    return proj(y, params["Wo"], params.get("bo"))
 
 
 @layer("self_attention")
 class SelfAttentionLayer(Layer):
     """DL4J SelfAttentionLayer: multi-head scaled-dot self-attention with
-    input projections. Output [B, T, n_out]."""
+    input projections. Output [B, T, n_out]. ``n_out=0`` resolves to the
+    input feature dim at init (the Keras MultiHeadAttention default);
+    ``has_bias`` adds per-projection biases (Keras MHA use_bias — DL4J's
+    layer is bias-free, the default)."""
     n_out: int = 0
     n_heads: int = 1
     head_size: Optional[int] = None
     weight_init: str = "xavier"
+    has_bias: bool = False
     l1: float = 0.0
     l2: float = 0.0
     name: Optional[str] = None
@@ -84,6 +89,8 @@ class SelfAttentionLayer(Layer):
 
     def initialize(self, key, input_shape, dtype):
         t, f = int(input_shape[0]), int(input_shape[-1])
+        if not self.n_out:
+            self.n_out = f
         hs, proj = self._dims(f)
         ks = jax.random.split(key, 4)
         params = {
@@ -93,6 +100,11 @@ class SelfAttentionLayer(Layer):
             "Wo": _winit.init(self.weight_init, ks[3], (proj, self.n_out),
                               proj, self.n_out, dtype),
         }
+        if self.has_bias:
+            params.update({
+                "bq": jnp.zeros((proj,), dtype), "bk": jnp.zeros((proj,), dtype),
+                "bv": jnp.zeros((proj,), dtype),
+                "bo": jnp.zeros((self.n_out,), dtype)})
         return params, {}, (t, self.n_out)
 
     def apply(self, params, x, state, *, train=False, rng=None, mask=None):

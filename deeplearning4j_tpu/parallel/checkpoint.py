@@ -127,20 +127,29 @@ class TrainingCheckpointer:
             restored = self._mngr.restore(step, args=ocp.args.Composite(
                 tree=ocp.args.PyTreeRestore(),
                 meta=ocp.args.JsonRestore()))
-        except (ValueError, KeyError):
+        except ValueError as e:
             # topology change (e.g. a host died and the survivors restore
             # on fewer devices — the §5 failure-recovery path): the saved
-            # shardings name devices that no longer exist. Re-read every
-            # leaf as host numpy; jnp.asarray below re-places on the
-            # current topology's default device and ParallelWrapper
+            # shardings name devices that no longer exist. Only THAT case
+            # falls back (orbax phrases it as a device/sharding mismatch);
+            # any other ValueError — corrupt checkpoint, tree mismatch —
+            # re-raises untouched.
+            msg = str(e).lower()
+            if "device" not in msg and "sharding" not in msg:
+                raise
+            # Re-read every leaf as host numpy; jnp.asarray below re-places
+            # on the current topology's default device and ParallelWrapper
             # re-shards on the next step.
-            tree_meta = self._mngr.item_metadata(step)["tree"]
-            restore_args = jax.tree.map(
-                lambda _: ocp.RestoreArgs(restore_type=np.ndarray),
-                tree_meta)
-            restored = self._mngr.restore(step, args=ocp.args.Composite(
-                tree=ocp.args.PyTreeRestore(restore_args=restore_args),
-                meta=ocp.args.JsonRestore()))
+            try:
+                tree_meta = self._mngr.item_metadata(step)["tree"]
+                restore_args = jax.tree.map(
+                    lambda _: ocp.RestoreArgs(restore_type=np.ndarray),
+                    tree_meta)
+                restored = self._mngr.restore(step, args=ocp.args.Composite(
+                    tree=ocp.args.PyTreeRestore(restore_args=restore_args),
+                    meta=ocp.args.JsonRestore()))
+            except Exception:
+                raise e  # surface the ORIGINAL failure, not the fallback's
         tree, meta = restored["tree"], restored["meta"]
         if meta["model_class"] != type(model).__name__:
             raise ValueError(

@@ -331,3 +331,31 @@ def test_word_vector_serializer_binary_roundtrip(tmp_path):
     assert mb.vocab.words == mt.vocab.words == w2v.vocab.words
     np.testing.assert_array_equal(mb.syn0, w2v.syn0)  # bit-exact
     np.testing.assert_allclose(mt.syn0, w2v.syn0, atol=1e-6)
+
+
+def test_fasttext_subword_vectors_and_oov():
+    """FastText-style subword skip-gram: trains on the corpus, shares
+    morphology through hashed n-grams, and produces OOV vectors from
+    n-grams alone (the fastText hallmark)."""
+    from deeplearning4j_tpu.nlp.word2vec import FastText
+
+    corpus = (["red green blue red green blue"] * 6
+              + ["cat dog mouse cat dog mouse"] * 6
+              + ["reddish greenish blueish"] * 4)
+    ft = FastText(layer_size=16, window=2, min_count=1, epochs=8, seed=4,
+                  batch_size=256, subsample=0.0, learning_rate=0.1,
+                  minn=3, maxn=4, bucket=2000)
+    ft.fit(corpus)
+    cos = lambda a, b: float(a @ b / ((np.linalg.norm(a)
+                                       * np.linalg.norm(b)) or 1e-12))
+    # co-occurrence structure survives the subword composition: top-1 is
+    # a co-occurring animal (full top-2 is unstable on a toy corpus —
+    # n-gram hash collisions add noise word2vec doesn't have)
+    near = [w for w, _ in ft.words_nearest("cat", 2)]
+    assert near[0] in {"dog", "mouse"}, near
+    # OOV: "reddest" shares <red n-grams with "reddish"/"red" -> nearer
+    # the color cluster than the animals; and nonzero
+    v_oov = ft.get_word_vector("reddest")
+    assert np.linalg.norm(v_oov) > 0
+    assert cos(v_oov, ft.get_word_vector("reddish")) > \
+        cos(v_oov, ft.get_word_vector("mouse"))
