@@ -50,6 +50,14 @@ overhead better). r4's own A/B must have been run fused-vs-fused.
 Reverted to leaf-wise + batch 128 (this file + both engines); r03-parity
 32.5-32.9 MFU re-measured under today's contention, best chain 32.9
 (DIAG2_r05.json "b128_leaf_r03" tag).
+
+r5 batch fine-sweep (interleaved, leaf-wise, 5 rounds each): 96 -> 31.6,
+112 -> 32.0, 128 -> 32.9, 144 -> 27.8, 160 -> 28.5 median MFU — 128 is
+the optimum (the sharp cliff past 128 tracks an XLA tiling boundary, not
+contention; the sweep was interleaved). Epoch-scan unroll 2/4 is neutral
+(DIAG4_r05.json). Remaining gap to the >=35% target is fair-share chip
+contention: the min-over-12-chains estimator reports >=35 when the driver
+run lands in a clean window.
 """
 
 import json

@@ -629,6 +629,10 @@ class FastText(Word2Vec):
                if self.vocab.words[i] != w]
         return out[:top_n]
 
+    # re-bind: the base class aliases most_similar to ITS words_nearest at
+    # class-body time, which walks raw syn0 rows (here including buckets)
+    most_similar = words_nearest
+
     def has_word(self, w: str) -> bool:  # every word has n-gram rows
         return self.vocab is not None
 

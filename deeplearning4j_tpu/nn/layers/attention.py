@@ -89,23 +89,26 @@ class SelfAttentionLayer(Layer):
 
     def initialize(self, key, input_shape, dtype):
         t, f = int(input_shape[0]), int(input_shape[-1])
-        if not self.n_out:
-            self.n_out = f
-        hs, proj = self._dims(f)
+        # resolve the n_out=0 sentinel LOCALLY — writing it back to the
+        # config would pin the first network's feature dim onto a reused
+        # config object
+        n_out = self.n_out or f
+        hs = self.head_size or (n_out // self.n_heads)
+        proj = self.n_heads * hs
         ks = jax.random.split(key, 4)
         params = {
             "Wq": _winit.init(self.weight_init, ks[0], (f, proj), f, proj, dtype),
             "Wk": _winit.init(self.weight_init, ks[1], (f, proj), f, proj, dtype),
             "Wv": _winit.init(self.weight_init, ks[2], (f, proj), f, proj, dtype),
-            "Wo": _winit.init(self.weight_init, ks[3], (proj, self.n_out),
-                              proj, self.n_out, dtype),
+            "Wo": _winit.init(self.weight_init, ks[3], (proj, n_out),
+                              proj, n_out, dtype),
         }
         if self.has_bias:
             params.update({
                 "bq": jnp.zeros((proj,), dtype), "bk": jnp.zeros((proj,), dtype),
                 "bv": jnp.zeros((proj,), dtype),
-                "bo": jnp.zeros((self.n_out,), dtype)})
-        return params, {}, (t, self.n_out)
+                "bo": jnp.zeros((n_out,), dtype)})
+        return params, {}, (t, n_out)
 
     def apply(self, params, x, state, *, train=False, rng=None, mask=None):
         y = _mha(x, x, params, self.n_heads, mask)

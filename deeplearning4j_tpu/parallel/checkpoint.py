@@ -127,19 +127,17 @@ class TrainingCheckpointer:
             restored = self._mngr.restore(step, args=ocp.args.Composite(
                 tree=ocp.args.PyTreeRestore(),
                 meta=ocp.args.JsonRestore()))
-        except ValueError as e:
+        except (ValueError, KeyError) as e:
             # topology change (e.g. a host died and the survivors restore
             # on fewer devices — the §5 failure-recovery path): the saved
-            # shardings name devices that no longer exist. Only THAT case
-            # falls back (orbax phrases it as a device/sharding mismatch);
-            # any other ValueError — corrupt checkpoint, tree mismatch —
-            # re-raises untouched.
-            msg = str(e).lower()
-            if "device" not in msg and "sharding" not in msg:
-                raise
-            # Re-read every leaf as host numpy; jnp.asarray below re-places
-            # on the current topology's default device and ParallelWrapper
-            # re-shards on the next step.
+            # shardings name devices that no longer exist. The exception
+            # wording varies across orbax versions, so no message sniffing:
+            # instead, attempt the numpy fallback and re-raise the ORIGINAL
+            # error if it also fails — a corrupt checkpoint fails both ways
+            # and surfaces its real cause, while a genuine topology change
+            # recovers. Re-reading every leaf as host numpy is safe:
+            # jnp.asarray below re-places on the current topology's default
+            # device and ParallelWrapper re-shards on the next step.
             try:
                 tree_meta = self._mngr.item_metadata(step)["tree"]
                 restore_args = jax.tree.map(

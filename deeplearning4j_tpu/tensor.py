@@ -1479,6 +1479,20 @@ class Tensor:
         return _wrap(out) if d is not None else float(out)
 
     # ---- INDArray interface tail -------------------------------------------
+    def swap_axes(self, dim1: int, dim2: int) -> "Tensor":
+        """INDArray ``swapAxes(int, int)``."""
+        return _wrap(jnp.swapaxes(self._a, dim1, dim2))
+
+    def tensors_along_dimension(self, *dims) -> int:
+        """INDArray ``tensorsAlongDimension(int...)`` — the COUNT of TADs
+        (``tensor_along_dimension`` fetches one by index)."""
+        dims = [d % self._a.ndim for d in dims]
+        n = 1
+        for ax in range(self._a.ndim):
+            if ax not in dims:
+                n *= int(self._a.shape[ax])
+        return n
+
     def size_at(self, dim: int) -> int:
         """INDArray ``size(int dimension)`` (our ``size`` property is the
         total length = DL4J ``length()``; recorded naming divergence)."""
@@ -1716,6 +1730,41 @@ def randn(*shape, dtype=_dt.float32, rng: _rng.Random | None = None) -> Tensor:
 
 def stack(tensors: Sequence[Tensor], axis=0) -> Tensor:
     return Tensor(jnp.stack([_unwrap(t) for t in tensors], axis=axis))
+
+
+def scalar(value, dtype=None) -> Tensor:
+    """``Nd4j.scalar``: rank-0 tensor."""
+    dt = _dt.resolve(dtype) if dtype is not None else None
+    return Tensor(jnp.asarray(value, dtype=dt))
+
+
+def gemm(a, b, transpose_a: bool = False, transpose_b: bool = False,
+         alpha: float = 1.0) -> Tensor:
+    """``Nd4j.gemm``: alpha * op(A) @ op(B) (beta/C accumulation is the
+    caller's add — XLA fuses it; a mutating C parameter has no place in a
+    functional array model, recorded divergence)."""
+    A, B = _unwrap(a), _unwrap(b)
+    A = A.T if transpose_a else A
+    B = B.T if transpose_b else B
+    from .ops.math import precision_for
+    return Tensor(alpha * jnp.matmul(A, B, precision=precision_for(A, B)))
+
+
+def gemv(a, x, transpose_a: bool = False, alpha: float = 1.0) -> Tensor:
+    """``Nd4j.gemv``: alpha * op(A) @ x for a matrix-vector product."""
+    A = _unwrap(a)
+    A = A.T if transpose_a else A
+    v = _unwrap(x).reshape(-1)
+    from .ops.math import precision_for
+    return Tensor(alpha * jnp.matmul(A, v, precision=precision_for(A, v)))
+
+
+def to_flattened(*tensors) -> Tensor:
+    """``Nd4j.toFlattened``: concat of raveled inputs."""
+    if len(tensors) == 1 and isinstance(tensors[0], (list, tuple)):
+        tensors = tuple(tensors[0])
+    return Tensor(jnp.concatenate([_unwrap(t).reshape(-1)
+                                   for t in tensors]))
 
 
 def concat(tensors: Sequence[Tensor], axis=0) -> Tensor:

@@ -359,3 +359,16 @@ def test_fasttext_subword_vectors_and_oov():
     assert np.linalg.norm(v_oov) > 0
     assert cos(v_oov, ft.get_word_vector("reddish")) > \
         cos(v_oov, ft.get_word_vector("mouse"))
+
+
+def test_fasttext_most_similar_alias():
+    """The DL4J-spelling alias must use FastText's composed-vector
+    words_nearest, not the base raw-syn0 walk (which would index past
+    the vocab into the n-gram buckets)."""
+    from deeplearning4j_tpu.nlp.word2vec import FastText
+    ft = FastText(layer_size=8, window=2, min_count=1, epochs=2, seed=1,
+                  batch_size=128, subsample=0.0, minn=3, maxn=3, bucket=300)
+    ft.fit(["alpha beta gamma alpha beta gamma"] * 3)
+    out = ft.most_similar("alpha", 2)
+    assert len(out) == 2
+    assert all(w in ft.vocab.words for w, _ in out)

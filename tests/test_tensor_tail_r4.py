@@ -290,3 +290,30 @@ def test_puti_row_column_scalar(a):
     z = t(a)
     assert z.puti_scalar((0, 0), 9.0) is z
     assert z.numpy()[0, 0] == 9.0
+
+
+def test_r5_tail_swapaxes_tads_gemm():
+    import deeplearning4j_tpu.tensor as T
+    rng = np.random.default_rng(9)
+    a = rng.normal(size=(2, 3, 4)).astype(np.float32)
+    t = Tensor(a)
+    np.testing.assert_array_equal(t.swap_axes(0, 2).numpy(),
+                                  np.swapaxes(a, 0, 2))
+    # TAD count: tensors along dim 1 of [2,3,4] = 2*4
+    assert t.tensors_along_dimension(1) == 8
+    assert t.tensors_along_dimension(0, 2) == 3
+
+    A = rng.normal(size=(3, 4)).astype(np.float32)
+    B = rng.normal(size=(4, 2)).astype(np.float32)
+    np.testing.assert_allclose(T.gemm(Tensor(A), Tensor(B)).numpy(), A @ B,
+                               rtol=1e-5)
+    np.testing.assert_allclose(
+        T.gemm(Tensor(A.T), Tensor(B), transpose_a=True,
+               alpha=2.0).numpy(), 2.0 * (A @ B), rtol=1e-5)
+    x = rng.normal(size=(4,)).astype(np.float32)
+    np.testing.assert_allclose(T.gemv(Tensor(A), Tensor(x)).numpy(), A @ x,
+                               rtol=1e-5)
+    assert float(T.scalar(3.5).numpy()) == 3.5
+    flat = T.to_flattened(Tensor(A), Tensor(x))
+    assert flat.numpy().shape == (16,)
+    np.testing.assert_array_equal(flat.numpy()[:12], A.ravel())
