@@ -1181,7 +1181,58 @@ class KerasModelImport:
         return model
 
 
+# Keras-1 legacy spellings (DL4J's KerasLayerConfiguration carries both
+# generations of field names; same contract here). Class renames plus
+# per-config key translations — applied before mapper dispatch.
+_KERAS1_CLASS = {"Convolution2D": "Conv2D", "Convolution1D": "Conv1D",
+                 "Convolution3D": "Conv3D", "Deconvolution2D":
+                 "Conv2DTranspose", "Highway": None, "MaxoutDense": None}
+_KERAS1_KEYS = {"output_dim": "units", "nb_filter": "filters",
+                "subsample": "strides", "subsample_length": "strides",
+                "border_mode": "padding", "inner_activation":
+                "recurrent_activation", "p": "rate", "bias": "use_bias",
+                "nb_units": "units"}
+_KERAS1_DROPOUTS = ("Dropout", "SpatialDropout1D", "SpatialDropout2D",
+                    "SpatialDropout3D", "AlphaDropout", "GaussianDropout")
+
+
+def _normalize_keras1(lcfg: dict) -> dict:
+    """Translate a Keras-1 layer config to the Keras-2 spellings the
+    mappers consume. No-op for modern configs (key sets are disjoint)."""
+    cls = lcfg["class_name"]
+    c = lcfg.get("config", {})
+    legacy = (cls in _KERAS1_CLASS
+              or any(k in c for k in ("nb_filter", "output_dim",
+                                      "border_mode", "nb_row", "bias"))
+              # Keras-1 dropouts spell rate as "p" with no other marker
+              or (cls in _KERAS1_DROPOUTS and "p" in c
+                  and "rate" not in c))
+    if not legacy:
+        return lcfg
+    if cls in _KERAS1_CLASS and _KERAS1_CLASS[cls] is None:
+        raise ValueError(f"Keras-1 layer {cls!r} has no modern equivalent "
+                         "to map onto")
+    c = dict(c)
+    for old, new in _KERAS1_KEYS.items():
+        if old in c and new not in c:
+            c[new] = c.pop(old)
+    if "nb_row" in c:  # Convolution2D kernel spelling
+        c.setdefault("kernel_size", (int(c.pop("nb_row")),
+                                     int(c.pop("nb_col"))))
+    if "filter_length" in c:  # Convolution1D
+        c.setdefault("kernel_size", int(c.pop("filter_length")))
+    if c.get("padding") == "full":
+        raise ValueError("Keras-1 border_mode='full' is not supported")
+    if c.get("dim_ordering") == "th":
+        raise ValueError("Keras-1 dim_ordering='th' (channels_first) is "
+                         "not supported — NHWC imports only")
+    c.pop("dim_ordering", None)
+    c.pop("init", None)  # weights come from the h5, init is irrelevant
+    return {**lcfg, "class_name": _KERAS1_CLASS.get(cls, cls), "config": c}
+
+
 def _map_layer(lcfg: dict) -> _Mapped:
+    lcfg = _normalize_keras1(lcfg)
     cls = lcfg["class_name"]
     if cls not in _MAPPERS:
         raise ValueError(

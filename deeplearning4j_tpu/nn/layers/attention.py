@@ -83,10 +83,6 @@ class SelfAttentionLayer(Layer):
     l2: float = 0.0
     name: Optional[str] = None
 
-    def _dims(self, n_in):
-        hs = self.head_size or (self.n_out // self.n_heads)
-        return hs, self.n_heads * hs
-
     def initialize(self, key, input_shape, dtype):
         t, f = int(input_shape[0]), int(input_shape[-1])
         # resolve the n_out=0 sentinel LOCALLY — writing it back to the

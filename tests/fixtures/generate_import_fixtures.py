@@ -312,6 +312,43 @@ def gen_corpus_onnx():
     x = rng.normal(size=(2, 3, 4)).astype(np.float32)
     export("onnx_clipsoftmax_op9", ClipSoftmax(), x, 9)
     export("onnx_clipsoftmax_op13", ClipSoftmax(), x, 13)
+
+    # 12. full pre-norm transformer block: multi-head attention from
+    # primitives (4-D MatMul/Transpose/Softmax), LayerNorm, GELU (Erf),
+    # residuals — the op families a BERT-class ONNX export exercises
+    import math as _math
+    torch.manual_seed(12)
+
+    class TransformerBlock(torch.nn.Module):
+        def __init__(self, d=16, h=2):
+            super().__init__()
+            self.h, self.hd = h, d // h
+            self.q = torch.nn.Linear(d, d)
+            self.k = torch.nn.Linear(d, d)
+            self.v = torch.nn.Linear(d, d)
+            self.o = torch.nn.Linear(d, d)
+            self.ln1 = torch.nn.LayerNorm(d)
+            self.ln2 = torch.nn.LayerNorm(d)
+            self.fc1 = torch.nn.Linear(d, 32)
+            self.fc2 = torch.nn.Linear(32, d)
+
+        def forward(self, x):
+            B, T, D = x.shape
+            xn = self.ln1(x)
+
+            def split(t):
+                return t.reshape(B, T, self.h, self.hd).transpose(1, 2)
+            q, k, v = split(self.q(xn)), split(self.k(xn)), split(self.v(xn))
+            att = torch.softmax(
+                q @ k.transpose(-1, -2) / _math.sqrt(self.hd), dim=-1)
+            y = (att @ v).transpose(1, 2).reshape(B, T, D)
+            x = x + self.o(y)
+            x = x + self.fc2(
+                torch.nn.functional.gelu(self.fc1(self.ln2(x))))
+            return x
+
+    export("onnx_transformer_block", TransformerBlock(),
+           rng.normal(size=(2, 5, 16)).astype(np.float32), 13)
     return io_rec
 
 

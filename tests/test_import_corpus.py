@@ -64,7 +64,8 @@ def test_tf_corpus(name):
 
 @pytest.mark.parametrize("name", ["onnx_groupedconv", "onnx_lstm_corpus",
                                   "onnx_bigru", "onnx_clipsoftmax_op9",
-                                  "onnx_clipsoftmax_op13"])
+                                  "onnx_clipsoftmax_op13",
+                                  "onnx_transformer_block"])
 def test_onnx_corpus(name):
     from deeplearning4j_tpu.modelimport.onnx import OnnxFrameworkImporter
     io = _io()

@@ -93,3 +93,59 @@ def test_cudnn_gru_mapper_is_reset_after_gru():
     assert m.layer.reset_after
     np.testing.assert_allclose(p["b"], b.reshape(2, 3 * u)[0])
     np.testing.assert_allclose(p["rb"], b.reshape(2, 3 * u)[1])
+
+
+def test_keras1_legacy_config_import(tmp_path):
+    """Keras-1 spellings (bare-list Sequential config, Convolution2D with
+    nb_filter/nb_row/nb_col/border_mode/subsample, Dense with output_dim,
+    *_W/*_b weight names) import against a modern-keras oracle — the
+    reference's KerasLayerConfiguration carries both generations of field
+    names and DL4J keeps old models loading."""
+    import h5py
+    import json as _json
+
+    rng = np.random.default_rng(5)
+    # modern oracle model
+    m = tf.keras.Sequential([
+        tf.keras.layers.Input(shape=(6, 6, 2)),
+        tf.keras.layers.Conv2D(3, (3, 3), padding="same",
+                               activation="relu", name="conv1"),
+        tf.keras.layers.Flatten(name="flat"),
+        tf.keras.layers.Dense(4, activation="softmax", name="fc"),
+    ])
+    _seed_weights(m, rng)
+    x = rng.normal(size=(2, 6, 6, 2)).astype(np.float32)
+    want = m.predict(x, verbose=0)
+    wconv, bconv = m.get_layer("conv1").get_weights()
+    wfc, bfc = m.get_layer("fc").get_weights()
+
+    # Keras-1-style file: bare-list Sequential config + legacy keys
+    k1_cfg = {"class_name": "Sequential", "config": [
+        {"class_name": "Convolution2D", "config": {
+            "name": "conv1", "nb_filter": 3, "nb_row": 3, "nb_col": 3,
+            "border_mode": "same", "subsample": [1, 1],
+            "activation": "relu", "dim_ordering": "tf",
+            "init": "glorot_uniform",
+            "batch_input_shape": [None, 6, 6, 2]}},
+        {"class_name": "Dropout", "config": {"name": "drp", "p": 0.25}},
+        {"class_name": "Flatten", "config": {"name": "flat"}},
+        {"class_name": "Dense", "config": {
+            "name": "fc", "output_dim": 4, "activation": "softmax",
+            "init": "glorot_uniform"}},
+    ]}
+    path = str(tmp_path / "keras1.h5")
+    with h5py.File(path, "w") as f:
+        f.attrs["model_config"] = _json.dumps(k1_cfg)
+        mw = f.create_group("model_weights")
+        g = mw.create_group("conv1")
+        g.attrs["weight_names"] = [b"conv1_W", b"conv1_b"]
+        g.create_dataset("conv1_W", data=wconv)
+        g.create_dataset("conv1_b", data=bconv)
+        g2 = mw.create_group("fc")
+        g2.attrs["weight_names"] = [b"fc_W", b"fc_b"]
+        g2.create_dataset("fc_W", data=wfc)
+        g2.create_dataset("fc_b", data=bfc)
+
+    net = KerasModelImport.import_keras_model_and_weights(path)
+    got = np.asarray(net.output(x))
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
