@@ -1198,8 +1198,12 @@ _KERAS1_DROPOUTS = ("Dropout", "SpatialDropout1D", "SpatialDropout2D",
 
 def _normalize_keras1(lcfg: dict) -> dict:
     """Translate a Keras-1 layer config to the Keras-2 spellings the
-    mappers consume. No-op for modern configs (key sets are disjoint)."""
+    mappers consume. No-op for modern configs (key sets are disjoint —
+    EXCEPT Embedding, whose modern spelling is input_dim/output_dim in
+    every keras generation and must not be rewritten)."""
     cls = lcfg["class_name"]
+    if cls == "Embedding":
+        return lcfg
     c = lcfg.get("config", {})
     legacy = (cls in _KERAS1_CLASS
               or any(k in c for k in ("nb_filter", "output_dim",

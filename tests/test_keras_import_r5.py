@@ -149,3 +149,24 @@ def test_keras1_legacy_config_import(tmp_path):
     net = KerasModelImport.import_keras_model_and_weights(path)
     got = np.asarray(net.output(x))
     np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_normalize_keras1_leaves_modern_embedding_untouched():
+    """Regression (env-independent — the live-tf Embedding test shipped a
+    real bug past CI once): Embedding's modern spelling IS
+    input_dim/output_dim in every keras generation; the Keras-1
+    normalizer must not rewrite it, and the legacy Dense translation must
+    still fire."""
+    from deeplearning4j_tpu.modelimport.keras import (_MAPPERS,
+                                                      _normalize_keras1)
+
+    emb = {"class_name": "Embedding",
+           "config": {"name": "e", "input_dim": 20, "output_dim": 8}}
+    assert _normalize_keras1(emb) is emb  # untouched, not even copied
+    mapped = _MAPPERS["Embedding"](emb["config"])
+    assert mapped.layer.n_in == 20 and mapped.layer.n_out == 8
+
+    dense = {"class_name": "Dense",
+             "config": {"name": "d", "output_dim": 4, "init": "uniform"}}
+    out = _normalize_keras1(dense)
+    assert out["config"]["units"] == 4 and "init" not in out["config"]
