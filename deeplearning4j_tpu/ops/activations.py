@@ -179,6 +179,20 @@ def cube(x):
     return x ** 3
 
 
+@_act("rrelu")
+def rrelu(x, lower=1.0 / 8.0, upper=1.0 / 3.0, key=None):
+    """Randomized leaky ReLU (DL4J ``ActivationRReLU``): negative slope
+    drawn U(lower, upper) per element when a PRNG ``key`` is given (the
+    training mode), fixed at the mean slope otherwise (inference — also
+    what the plain activation-string path uses, since activation fns are
+    pure; pass a key explicitly for the stochastic mode, the same rng
+    plumbing dropout uses)."""
+    alpha = ((lower + upper) / 2.0 if key is None
+             else jax.random.uniform(key, x.shape, dtype=x.dtype,
+                                     minval=lower, maxval=upper))
+    return jnp.where(x >= 0, x, alpha * x)
+
+
 def get(name_or_fn):
     """Resolve an activation by DL4J-style name (case-insensitive) or passthrough."""
     if callable(name_or_fn):

@@ -252,3 +252,29 @@ def test_misc_tail_gradients(rng):
                "shape.concat_v", "shape.stack_v",
                "shape.flatten2d", "shape.reshape_onnx", "dropout")
     ops.mark_fwd_tested("shape.reshape_onnx")
+
+
+def test_rrelu_activation(rng):
+    """DL4J ActivationRReLU: mean-slope inference mode + per-element
+    random slope in U(lower, upper) under a key."""
+    import jax
+    op = _op("act.rrelu")
+    x = rng.normal(size=(4, 5))
+    det = np.asarray(op(jnp.asarray(x)))
+    alpha = (1 / 8 + 1 / 3) / 2
+    np.testing.assert_allclose(det, np.where(x >= 0, x, alpha * x),
+                               rtol=1e-6)
+    sto = np.asarray(op(jnp.asarray(np.float32(x)),
+                        key=jax.random.PRNGKey(0)))
+    neg = x < 0
+    slopes = sto[neg] / x[neg]
+    assert (slopes >= 1 / 8 - 1e-6).all() and (slopes <= 1 / 3 + 1e-6).all()
+    assert np.std(slopes) > 0.01  # actually randomized, not constant
+    np.testing.assert_allclose(sto[~neg], x[~neg], rtol=1e-5)
+    # grads (deterministic mode; input kept away from the kink at 0)
+    xx = np.abs(rng.normal(size=(3, 3))) + 0.1
+    ok, worst, _ = check_op_gradient(op, np.concatenate([xx, -xx]),
+                                     max_rel_error=1e-4)
+    assert ok, f"act.rrelu: worst {worst}"
+    _mark_grad("act.rrelu")
+    ops.mark_fwd_tested("act.rrelu")
