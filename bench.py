@@ -65,14 +65,14 @@ import time
 
 import numpy as np
 
-LOCAL_ARTIFACT = "BENCH_LOCAL_r05.json"
+LOCAL_ARTIFACT = "BENCH_LOCAL_r06.json"
 
 
 def _emit(lines):
     """Print metric lines with the HEADLINE (ResNet MFU) LAST — the driver's
     ``parsed`` field takes the last JSON line, and round 4 lost the ResNet
     number to exactly that (BERT printed last + tail truncation). Also mirror
-    every line to ``BENCH_LOCAL_r05.json`` so no truncation can eat a metric
+    every line to ``LOCAL_ARTIFACT`` so no truncation can eat a metric
     again."""
     order = sorted(lines, key=lambda d: d.get("metric") ==
                    "resnet50_train_mfu_pct")
@@ -363,12 +363,131 @@ def bench_bert():
     }
 
 
+def _opt_bytes_per_device(opt):
+    """Per-device updater-state footprint: one device's shard of every
+    leaf (== full size when replicated)."""
+    import jax
+    total = 0
+    for leaf in jax.tree.leaves(opt):
+        shp = leaf.sharding.shard_shape(leaf.shape)
+        total += int(np.prod(shp)) * leaf.dtype.itemsize
+    return total
+
+
+def _sharded_update_measure():
+    """Sharded-vs-replicated weight update (ZeRO-1,
+    ``ParallelWrapper(shard_update=True)``) on THIS process's devices:
+    per-device Adam m/v bytes and step time both ways. Runs wherever
+    ``len(jax.devices()) >= 4`` — the real pod path and the virtual-mesh
+    subprocess share this code."""
+    import jax
+
+    from deeplearning4j_tpu.data.dataset import DataSet
+    from deeplearning4j_tpu.nn.config import (InputType,
+                                              NeuralNetConfiguration)
+    from deeplearning4j_tpu.nn.layers.core import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.model import MultiLayerNetwork
+    from deeplearning4j_tpu.nn.updaters import Adam
+    from deeplearning4j_tpu.parallel.data_parallel import ParallelWrapper
+
+    ndev = len(jax.devices())
+    d = 512
+
+    def build():
+        conf = (NeuralNetConfiguration.builder().seed(0)
+                .updater(Adam(learning_rate=1e-3))
+                .input_type(InputType.feed_forward(d))
+                .list(DenseLayer(n_out=4 * d, activation="relu"),
+                      DenseLayer(n_out=4 * d, activation="relu"),
+                      OutputLayer(n_out=d)).build())
+        return MultiLayerNetwork(conf).init()
+
+    rng = np.random.default_rng(0)
+    batch = 8 * ndev
+    x = rng.normal(size=(batch, d)).astype(np.float32)
+    y = np.eye(d, dtype=np.float32)[rng.integers(0, d, batch)]
+    ds = DataSet(x, y)
+
+    def run(shard):
+        net = build()
+        pw = ParallelWrapper(net, shard_update=shard)
+        pw.fit(ds, epochs=2)      # compile + settle
+        float(net.score())        # force (block_until_ready unreliable here)
+        steps = 20
+        t0 = time.perf_counter()
+        pw.fit(ds, epochs=steps)
+        float(net.score())
+        dt = (time.perf_counter() - t0) / steps
+        return net, dt
+
+    net_r, dt_r = run(False)
+    bytes_r = _opt_bytes_per_device(net_r.updater_state)
+    net_s, dt_s = run(True)
+    bytes_s = _opt_bytes_per_device(net_s.updater_state)
+
+    return {
+        "metric": "sharded_update",
+        "value": round(bytes_r / bytes_s, 2),
+        "unit": "x_per_device_updater_bytes_reduction",
+        "model": f"MLP {d}-{4 * d}-{4 * d}-{d}, Adam, fp32",
+        "devices": ndev,
+        "params": net_r.num_params(),
+        "opt_bytes_per_device_replicated": bytes_r,
+        "opt_bytes_per_device_sharded": bytes_s,
+        "step_time_ms_replicated": round(dt_r * 1e3, 2),
+        "step_time_ms_sharded": round(dt_s * 1e3, 2),
+        "sharded_step_speedup": round(dt_r / dt_s, 3),
+        "batch": batch,
+    }
+
+
+def bench_sharded_update():
+    """ZeRO-1 sharded weight update metric. Needs >= 4 devices to mean
+    anything; on the tunneled single chip the measurement runs in a
+    subprocess on a virtual 8-device CPU mesh (the sharding math — bytes
+    per device — is topology arithmetic and transfers; the step-time
+    column there is CPU-relative, recorded as such)."""
+    import jax
+    if len(jax.devices()) >= 4:
+        return _sharded_update_measure()
+
+    import os
+    import subprocess
+    import sys
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                        + " --xla_force_host_platform_device_count=8").strip()
+    code = ("import json, bench; "
+            "print('@@RESULT@@' + json.dumps(bench._sharded_update_measure()))")
+    out = subprocess.run(
+        [sys.executable, "-c", code], env=env, capture_output=True,
+        text=True, timeout=900, cwd=os.path.dirname(os.path.abspath(__file__)))
+    for line in out.stdout.splitlines():
+        if line.startswith("@@RESULT@@"):
+            d = json.loads(line[len("@@RESULT@@"):])
+            d["note"] = ("single-device bench env: measured on a virtual "
+                         "8-device CPU mesh subprocess; bytes/device is "
+                         "topology arithmetic, step times are CPU-relative")
+            return d
+    raise RuntimeError("sharded-update subprocess produced no result: "
+                       + out.stderr[-400:])
+
+
 if __name__ == "__main__":
     lines = [bench_resnet()]  # headline first: must not be blocked by BERT
     # emit the headline IMMEDIATELY: if bench_bert dies process-fatally
     # (libtpu abort, OOM kill — not catchable below) the headline is
     # already on stdout and in the artifact; on success it is re-emitted
     # so it is also the LAST line (the driver parses the last JSON line)
+    _emit(lines)
+    try:
+        lines.append(bench_sharded_update())
+    except Exception as e:
+        lines.append({
+            "metric": "sharded_update", "value": None,
+            "unit": "x_per_device_updater_bytes_reduction",
+            "error": f"{type(e).__name__}: {e}"[:300]})
     _emit(lines)
     try:
         lines.append(bench_bert())

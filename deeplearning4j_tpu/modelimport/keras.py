@@ -1207,7 +1207,12 @@ def _normalize_keras1(lcfg: dict) -> dict:
     c = lcfg.get("config", {})
     legacy = (cls in _KERAS1_CLASS
               or any(k in c for k in ("nb_filter", "output_dim",
-                                      "border_mode", "nb_row", "bias"))
+                                      "border_mode", "nb_row"))
+              # 'bias' alone is ambiguous: gate on the absence of the
+              # modern 'use_bias' marker (mirroring the dropout 'p' check)
+              # so a modern layer legitimately carrying a 'bias' config key
+              # is not rewritten (ADVICE r5)
+              or ("bias" in c and "use_bias" not in c)
               # Keras-1 dropouts spell rate as "p" with no other marker
               or (cls in _KERAS1_DROPOUTS and "p" in c
                   and "rate" not in c))

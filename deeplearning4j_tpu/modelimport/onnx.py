@@ -496,7 +496,11 @@ def _unsqueeze(node, ctx, at):
         axes = ctx.consts[node.input[1]].tolist()
     if node.input[0] in ctx.consts:  # shape-arithmetic fold (see Concat)
         v = np.asarray(ctx.consts[node.input[0]])
-        for a in sorted(int(a) for a in axes):
+        # ONNX Unsqueeze axes refer to the OUTPUT rank; normalize negatives
+        # against it before sorting — raw mixed axes like [-3, 1] would
+        # sort as [-3, 1] and misplace dims or raise AxisError (ADVICE r5)
+        out_rank = v.ndim + len(axes)
+        for a in sorted(int(a) % out_rank for a in axes):
             v = np.expand_dims(v, a)
         ctx.consts[node.output[0]] = v
     return ctx.sd.call("shape.expand_dims", ctx.get(node.input[0]),

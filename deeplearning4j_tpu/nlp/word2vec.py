@@ -614,10 +614,20 @@ class FastText(Word2Vec):
         s = self.syn0[self._sub_ids] * self._sub_mask[..., None]
         return s.sum(1) / self._sub_mask.sum(1, keepdims=True)
 
-    def words_nearest(self, w: str, top_n: int = 10):
+    def words_nearest(self, w: str, n: int = 10,
+                      top_n: Optional[int] = None):
         """Nearest in-vocab words by cosine over COMPOSED vectors (the
         inherited implementation walks raw syn0 rows, which here include
-        the n-gram buckets)."""
+        the n-gram buckets). The count parameter keeps the base class's
+        name ``n`` so keyword callers work polymorphically across
+        Word2Vec/FastText (ADVICE r5); ``top_n`` stays as a deprecated
+        alias for callers of the old FastText-only spelling."""
+        if top_n is not None:
+            import warnings
+            warnings.warn("words_nearest(top_n=...) is deprecated; use the "
+                          "base-class parameter name n=...",
+                          DeprecationWarning, stacklevel=2)
+            n = top_n
         q = self.get_word_vector(w)
         mat = self._word_matrix()
         qn = q / (np.linalg.norm(q) or 1e-12)
@@ -627,7 +637,7 @@ class FastText(Word2Vec):
         order = np.argsort(-sims)
         out = [(self.vocab.words[i], float(sims[i])) for i in order
                if self.vocab.words[i] != w]
-        return out[:top_n]
+        return out[:n]
 
     # re-bind: the base class aliases most_similar to ITS words_nearest at
     # class-body time, which walks raw syn0 rows (here including buckets)

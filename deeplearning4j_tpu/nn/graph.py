@@ -381,11 +381,16 @@ class ComputationGraph:
         return grads
 
     # ------------------------------------------------------------ train step
-    def _build_train_step(self):
+    def _build_train_step(self, accum_steps: int = 1):
+        """Fused pure train step; ``accum_steps=k`` scans the gradient over
+        k microbatches before the single updater application (same contract
+        as ``MultiLayerNetwork._build_train_step`` — see
+        ``nn/microbatch.py``)."""
         updater = self.conf.updater
         outputs = self.conf.outputs
         from .layers.wrappers import FrozenLayer
         from .vertices import LayerVertex
+        from . import microbatch as _micro
         frozen_keys = frozenset(
             n for n, v, _ in self.conf.vertices
             if isinstance(v, LayerVertex) and isinstance(v.layer, FrozenLayer))
@@ -396,41 +401,50 @@ class ComputationGraph:
                 f"output vertices {bad} are not Output/Loss layers; fit() "
                 "needs a loss head on every network output")
 
-        def step_fn(params, opt_state, bn_state, step, key, xs, ys, fms, lms):
-            def loss_fn(p):
-                inputs = dict(zip(self.conf.inputs, xs))
-                masks = {n: m for n, m in zip(self.conf.inputs, fms)
-                         if m is not None}
-                acts, new_bn, mks = self._forward(
-                    p, inputs, bn_state, train=True, rng=key, masks=masks)
-                total = 0.0
-                for o, y, lm in zip(outputs, ys, lms):
-                    layer = out_layers[o]
-                    # intersect explicit label mask with the propagated mask
-                    m = _loss.combine_masks(lm, mks.get(o))
-                    if hasattr(layer, "update_centers"):
-                        # CenterLossOutputLayer: pull the stashed features
-                        # out of the aux state channel (must not persist),
-                        # EMA-update centers outside the gradient
-                        st = dict(new_bn[o])
-                        feats = st.pop("__features__")
-                        centers = bn_state[o]["centers"]
-                        st["centers"] = jax.lax.stop_gradient(
-                            layer.update_centers(
-                                centers, jax.lax.stop_gradient(feats), y))
-                        new_bn = {**new_bn, o: st}
-                        total = total + layer.loss_value(
-                            acts[o], y, mask=m,
-                            weights=getattr(layer, "loss_weights", None),
-                            features=feats,
-                            centers=jax.lax.stop_gradient(centers))
-                    else:
-                        total = total + layer.loss_value(
-                            acts[o], y, mask=m,
-                            weights=getattr(layer, "loss_weights", None))
-                return total + self._regularization(p), new_bn
+        def loss_fn(p, bn_state, key, xs, ys, fms, lms):
+            inputs = dict(zip(self.conf.inputs, xs))
+            masks = {n: m for n, m in zip(self.conf.inputs, fms)
+                     if m is not None}
+            acts, new_bn, mks = self._forward(
+                p, inputs, bn_state, train=True, rng=key, masks=masks)
+            total = 0.0
+            for o, y, lm in zip(outputs, ys, lms):
+                layer = out_layers[o]
+                # intersect explicit label mask with the propagated mask
+                m = _loss.combine_masks(lm, mks.get(o))
+                if hasattr(layer, "update_centers"):
+                    # CenterLossOutputLayer: pull the stashed features
+                    # out of the aux state channel (must not persist),
+                    # EMA-update centers outside the gradient
+                    st = dict(new_bn[o])
+                    feats = st.pop("__features__")
+                    centers = bn_state[o]["centers"]
+                    st["centers"] = jax.lax.stop_gradient(
+                        layer.update_centers(
+                            centers, jax.lax.stop_gradient(feats), y))
+                    new_bn = {**new_bn, o: st}
+                    total = total + layer.loss_value(
+                        acts[o], y, mask=m,
+                        weights=getattr(layer, "loss_weights", None),
+                        features=feats,
+                        centers=jax.lax.stop_gradient(centers))
+                else:
+                    total = total + layer.loss_value(
+                        acts[o], y, mask=m,
+                        weights=getattr(layer, "loss_weights", None))
+            return total + self._regularization(p), new_bn
 
-            (loss, new_bn), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+        vg_fn = jax.value_and_grad(loss_fn, has_aux=True)
+
+        def step_fn(params, opt_state, bn_state, step, key, xs, ys, fms, lms):
+            if accum_steps == 1:
+                (loss, new_bn), grads = vg_fn(
+                    params, bn_state, key, xs, ys, fms, lms)
+            else:
+                (loss, new_bn), grads = _micro.accumulate_gradients(
+                    vg_fn, params, bn_state, key, accum_steps,
+                    (xs, ys, fms, lms),
+                    weight_fn=_micro.multi_output_weight)
             grads = self._clip(grads)
             # leaf-wise updater application. The flat-buffer variant
             # (updaters.apply_fused) measured a LARGE regression here on the

@@ -195,47 +195,63 @@ class MultiLayerNetwork:
         return grads
 
     # ------------------------------------------------------------- train step
-    def _build_train_step(self):
+    def _build_train_step(self, accum_steps: int = 1):
+        """Fused pure train step. ``accum_steps=k`` splits the batch into k
+        microbatches and accumulates the mean gradient via ``lax.scan``
+        before the SINGLE updater application (see ``nn/microbatch.py`` for
+        the exactness contract) — peak activation memory drops to one
+        microbatch, so global batch can grow past HBM."""
         updater = self.conf.updater
         out_layer = self._out_layer
 
         ol_key = str(len(self.layers) - 1)
         center_loss = hasattr(out_layer, "update_centers")
         from .layers.wrappers import FrozenLayer
+        from . import microbatch as _micro
         frozen_keys = frozenset(str(i) for i, l in enumerate(self.layers)
                                 if isinstance(l, FrozenLayer))
 
-        def step_fn(params, opt_state, bn_state, step, key, x, y, fmask, lmask):
-            def loss_fn(p):
-                out, new_bn, out_mask = self._forward(
-                    p, x, bn_state, train=True, rng=key, mask=fmask)
-                # intersect, don't override: an explicit label mask (e.g. the
-                # DP pad mask) and the propagated feature mask must BOTH hold
-                lm = _loss.combine_masks(lmask, out_mask)
-                if center_loss:
-                    # CenterLossOutputLayer stashes its input features in the
-                    # state aux channel; pull them out (the key must NOT leak
-                    # into the persisted state tree) and EMA-update centers
-                    # outside the gradient
-                    st = dict(new_bn[ol_key])
-                    feats = st.pop("__features__")
-                    centers = bn_state[ol_key]["centers"]
-                    st["centers"] = jax.lax.stop_gradient(
-                        out_layer.update_centers(
-                            centers, jax.lax.stop_gradient(feats), y))
-                    new_bn = {**new_bn, ol_key: st}
-                    data_loss = out_layer.loss_value(
-                        out, y, mask=lm,
-                        weights=getattr(out_layer, "loss_weights", None),
-                        features=feats,
-                        centers=jax.lax.stop_gradient(centers))
-                else:
-                    data_loss = out_layer.loss_value(
-                        out, y, mask=lm,
-                        weights=getattr(out_layer, "loss_weights", None))
-                return data_loss + self._regularization(p), new_bn
+        def loss_fn(p, bn_state, key, x, y, fmask, lmask):
+            out, new_bn, out_mask = self._forward(
+                p, x, bn_state, train=True, rng=key, mask=fmask)
+            # intersect, don't override: an explicit label mask (e.g. the
+            # DP pad mask) and the propagated feature mask must BOTH hold
+            lm = _loss.combine_masks(lmask, out_mask)
+            if center_loss:
+                # CenterLossOutputLayer stashes its input features in the
+                # state aux channel; pull them out (the key must NOT leak
+                # into the persisted state tree) and EMA-update centers
+                # outside the gradient
+                st = dict(new_bn[ol_key])
+                feats = st.pop("__features__")
+                centers = bn_state[ol_key]["centers"]
+                st["centers"] = jax.lax.stop_gradient(
+                    out_layer.update_centers(
+                        centers, jax.lax.stop_gradient(feats), y))
+                new_bn = {**new_bn, ol_key: st}
+                data_loss = out_layer.loss_value(
+                    out, y, mask=lm,
+                    weights=getattr(out_layer, "loss_weights", None),
+                    features=feats,
+                    centers=jax.lax.stop_gradient(centers))
+            else:
+                data_loss = out_layer.loss_value(
+                    out, y, mask=lm,
+                    weights=getattr(out_layer, "loss_weights", None))
+            return data_loss + self._regularization(p), new_bn
 
-            (loss, new_bn), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+        vg_fn = jax.value_and_grad(loss_fn, has_aux=True)
+
+        def step_fn(params, opt_state, bn_state, step, key, x, y, fmask, lmask):
+            if accum_steps == 1:
+                (loss, new_bn), grads = vg_fn(
+                    params, bn_state, key, x, y, fmask, lmask)
+            else:
+                (loss, new_bn), grads = _micro.accumulate_gradients(
+                    vg_fn, params, bn_state, key, accum_steps,
+                    (x, y, fmask, lmask),
+                    weight_fn=lambda x, y, fm, lm:
+                        _micro.label_count_weight(lm))
             grads = self._clip(grads)
             # leaf-wise on purpose: apply_fused measured -8..-13 MFU points
             # on ResNet-50 (see ComputationGraph._build_train_step)

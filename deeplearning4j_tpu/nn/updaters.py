@@ -269,6 +269,30 @@ class NoOp(Updater):
         return _tmap(jnp.zeros_like, grads), state
 
 
+def apply_leaf(updater, grad, slots, param, step):
+    """Pure SINGLE-TENSOR update: ``slots`` is this leaf's updater-state
+    slice ``{slot_name: array}`` (e.g. Adam's ``{"m": m_leaf, "v":
+    v_leaf}``), and the return is ``(new_param_leaf, new_slots)``.
+
+    This is the contract point the cross-replica sharded weight update
+    (ZeRO-1, ``ParallelWrapper(shard_update=True)``) relies on: every
+    updater here is strictly **elementwise** (``updater.elementwise``), so
+    applying the update to a 1/N shard of ``(grad, slots, param)`` produces
+    exactly the matching shard of the full-tensor update — GSPMD can
+    therefore reduce-scatter the gradient, run this update on each
+    device's shard, and all-gather the fresh params, with bit-identical
+    results (tested in tests/test_shard_update.py). A future per-tensor-
+    norm updater (LARS-style, ``elementwise=False``) breaks the contract —
+    the runtime guard lives in ``ParallelWrapper.__init__``, which rejects
+    ``shard_update=True`` for non-elementwise updaters.
+
+    A bare array is a single-leaf pytree, so ``updater.apply`` runs
+    unchanged; Adam/RMSProp/AMSGrad/etc. all work with no per-updater code.
+    """
+    delta, new_slots = updater.apply(grad, slots, param, step)
+    return param - delta, new_slots
+
+
 def apply_leafwise(updater, grads, state, params, step):
     """Per-tensor updater application + subtraction — the form the engines'
     hot train steps use (one small XLA fusion per parameter tensor, which

@@ -81,6 +81,21 @@ class TrainingCheckpointer:
             tree["state"] = model.state
         if model.updater_state:
             tree["updater"] = model.updater_state
+        # GATHER-ON-SAVE: leaves sharded across devices (TP params, ZeRO-1
+        # updater state under ParallelWrapper(shard_update=True)) are pulled
+        # to host numpy when fully addressable, so the stored checkpoint is
+        # topology-independent — it restores bit-exactly onto any device
+        # count and either shard_update setting (re-sharding happens lazily
+        # on the wrapper's next step). Multi-host leaves are NOT fully
+        # addressable and stay as global arrays for orbax's OCDBT
+        # shard-per-host writes; the restore-side reshard covers them.
+        def _gather(x):
+            if (isinstance(x, jax.Array)
+                    and not x.sharding.is_fully_replicated
+                    and x.is_fully_addressable):
+                return np.asarray(x)
+            return x
+        tree = jax.tree.map(_gather, tree)
         if jax.process_count() > 1:
             # multi-host: globally-sharded leaves (params trained through
             # ParallelWrapper) serialize as-is, but host-local single-device
@@ -127,11 +142,13 @@ class TrainingCheckpointer:
             restored = self._mngr.restore(step, args=ocp.args.Composite(
                 tree=ocp.args.PyTreeRestore(),
                 meta=ocp.args.JsonRestore()))
-        except (ValueError, KeyError) as e:
+        except Exception as e:
             # topology change (e.g. a host died and the survivors restore
             # on fewer devices — the §5 failure-recovery path): the saved
             # shardings name devices that no longer exist. The exception
-            # wording varies across orbax versions, so no message sniffing:
+            # TYPE and wording vary across orbax versions (ValueError,
+            # KeyError, orbax-internal types — ADVICE r5), so catch broadly
+            # with no message sniffing:
             # instead, attempt the numpy fallback and re-raise the ORIGINAL
             # error if it also fails — a corrupt checkpoint fails both ways
             # and surfaces its real cause, while a genuine topology change

@@ -67,10 +67,40 @@ class ParallelWrapper:
     mask-aware batch moments — padded rows perturb neither the loss nor
     the running statistics (the round-2 recorded artifact, now fixed;
     equivalence to the unpadded single-chip step is tested).
+
+    ``shard_update=True`` (ZeRO-1, "Automatic Cross-Replica Sharding of
+    Weight Update in Data-Parallel Training", Xu et al. 2020, PAPERS.md):
+    the updater-state pytree and the weight-update computation are sharded
+    over the ``data`` mesh axis instead of replicated — each parameter leaf
+    gets its largest divisible dimension partitioned (composed with any
+    tensor-parallel ``model_axis`` sharding), ``out_shardings`` pin the
+    updated params back to their replicated/TP layout, and GSPMD emits the
+    reduce-scatter → 1/N-shard update → all-gather pipeline inside the one
+    compiled step (the TVM/GSPMD posture: sharding is a compiler
+    annotation, not hand-written collectives). Update FLOPs and updater
+    memory (Adam m/v ≈ 2x params) then scale with the per-device share,
+    not the model. Numerically equivalent to the replicated path — every
+    updater is elementwise (``nn.updaters.apply_leaf`` contract), so the
+    shard of the update equals the update of the shard; non-elementwise
+    updaters are rejected. Checkpoints gather on save and reshard lazily
+    on restore (``parallel/checkpoint.py``), so round-trips across
+    ``shard_update`` settings and topologies are exact.
+
+    ``accum_steps=k``: gradient micro-accumulation — each global batch is
+    split into k microbatches scanned on device (``nn/microbatch.py``),
+    with ONE updater application (and, under ``shard_update``, one
+    reduce-scatter/all-gather) per k microbatches, amortizing the update
+    collectives exactly as the paper prescribes. Pad granularity becomes
+    ``devices * accum_steps`` so microbatches stay equal-sized; microbatch
+    losses/gradients combine as a mean WEIGHTED by unmasked label count,
+    so a ragged tail whose padding lands unevenly across microbatches
+    (even entire all-pad microbatches) still reproduces the unpadded step
+    exactly (tested).
     """
 
     def __init__(self, model, mesh: Optional[Mesh] = None,
-                 model_axis: Optional[str] = None):
+                 model_axis: Optional[str] = None,
+                 shard_update: bool = False, accum_steps: int = 1):
         # model: MultiLayerNetwork or ComputationGraph (duck-typed: both
         # expose params/updater_state/state/_build_train_step with the same
         # pytree layout; only the batch-argument arity differs)
@@ -88,6 +118,25 @@ class ParallelWrapper:
         if model_axis is not None and model_axis not in self.mesh.axis_names:
             raise ValueError(f"model_axis {model_axis!r} not in mesh axes "
                              f"{self.mesh.axis_names}")
+        self.shard_update = bool(shard_update)
+        if accum_steps < 1:
+            raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
+        self.accum_steps = int(accum_steps)
+        if self.shard_update:
+            if "data" not in self.mesh.axis_names:
+                raise ValueError("shard_update needs a 'data' mesh axis to "
+                                 f"shard over; mesh has {self.mesh.axis_names}")
+            if model_axis == "data":
+                raise ValueError("model_axis cannot be the 'data' axis the "
+                                 "sharded update partitions over")
+            upd = getattr(model.conf, "updater", None)
+            if upd is not None and not getattr(upd, "elementwise", True):
+                # the ZeRO-1 shard-equivalence contract (updaters.apply_leaf)
+                # only holds for elementwise updaters: a per-tensor norm
+                # computed over a 1/N shard is not the global norm
+                raise ValueError(
+                    f"shard_update requires an elementwise updater; "
+                    f"{type(upd).__name__} is not")
         self._step = None
         self._dense_key_cache = None
         from ..nn.graph import ComputationGraph
@@ -127,14 +176,44 @@ class ParallelWrapper:
             return P(self.model_axis)
         return P()
 
-    def _param_shardings(self, params):
+    def _update_spec(self, path: tuple, arr) -> P:
+        """PartitionSpec for one UPDATER-STATE leaf under the sharded weight
+        update (ZeRO-1): on top of the parameter's own spec (replicated, or
+        the TP spec when ``model_axis`` is set), the largest still-free
+        dimension divisible by the data-axis size is partitioned over
+        ``'data'`` — e.g. a dense kernel [in, out] with out >= in becomes
+        ``P(None, 'data')`` plain, or ``P('data', 'model')`` under tensor
+        parallelism (out taken by 'model', so 'data' lands on the in dim).
+        Leaves with no divisible free dimension stay on the base spec
+        (replicated update for that leaf — correct, just not sharded)."""
+        base = self._param_spec(path, arr)
+        n = self.mesh.shape["data"]
+        ndim = getattr(arr, "ndim", 0)
+        if n <= 1 or ndim == 0:
+            return base
+        taken = {i for i, ax in enumerate(base) if ax is not None}
+        free = [d for d in range(ndim) if d not in taken]
+        for d in sorted(free, key=lambda d: -arr.shape[d]):
+            if arr.shape[d] % n == 0:
+                spec = list(base) + [None] * (ndim - len(base))
+                spec[d] = "data"
+                return P(*spec)
+        return base
+
+    def _shardings(self, params, spec_fn):
         """NamedSharding tree matching the params pytree."""
         from jax.tree_util import tree_map_with_path
 
         def leaf(path, a):
             names = tuple(str(getattr(k, "key", k)) for k in path)
-            return NamedSharding(self.mesh, self._param_spec(names, a))
+            return NamedSharding(self.mesh, spec_fn(names, a))
         return tree_map_with_path(leaf, params)
+
+    def _param_shardings(self, params):
+        return self._shardings(params, self._param_spec)
+
+    def _update_shardings(self, params):
+        return self._shardings(params, self._update_spec)
 
     def _build(self):
         mesh = self.mesh
@@ -149,13 +228,24 @@ class ParallelWrapper:
         # the then-fused updater's concat/slice chain), which would force a
         # host reshard every step — the pin keeps the TP layout stable
         # regardless of how the update arithmetic is expressed.
-        pure = self.model._build_train_step().__wrapped__
+        #
+        # shard_update=True: the OPT-STATE in/out shardings carry the
+        # P('data')-partitioned specs instead of the param specs, while the
+        # updated params stay pinned to their replicated/TP layout. GSPMD
+        # then materializes the ZeRO-1 pipeline inside this one program:
+        # the gradient arrives reduce-SCATTERED into the update's shard
+        # layout, the m/v/delta arithmetic runs on each device's 1/N
+        # share, and the params pin forces the all-gather of the fresh
+        # weights — no hand-written collectives anywhere.
+        pure = self.model._build_train_step(self.accum_steps).__wrapped__
         from jax.tree_util import tree_structure
         p_sh = self._param_shardings(self.model.params)
+        upd_sh = self._update_shardings(self.model.params) \
+            if self.shard_update else p_sh
         p_struct = tree_structure(self.model.params)
         opt = self.model.updater_state
         if isinstance(opt, dict):
-            opt_sh = {k: (p_sh if tree_structure(sub) == p_struct
+            opt_sh = {k: (upd_sh if tree_structure(sub) == p_struct
                           else jax.tree.map(lambda a: repl, sub))
                       for k, sub in opt.items()}
         else:
@@ -190,23 +280,17 @@ class ParallelWrapper:
                 return tuple(shard_batch(a) for a in t)
             return put(t, data)
 
-        from jax.tree_util import tree_structure
-        p_sh_cache = {}
-
         def shard_args(params, opt_state, bn_state, step, key, x, y, fm, lm):
-            # params/opt structure and model_axis are fixed after init;
-            # build the sharding tree once, not per step (after the first
-            # step every put() is a pass-through anyway)
-            if "sh" not in p_sh_cache:
-                p_sh_cache["sh"] = self._param_shardings(params)
-                p_sh_cache["struct"] = tree_structure(params)
-            p_sh = p_sh_cache["sh"]
-            p_struct = p_sh_cache["struct"]
+            # params/opt structure and model_axis are fixed after init, so
+            # the build-time sharding trees apply every step (after the
+            # first step every put() is a pass-through anyway)
             params = jax.tree.map(put, params, p_sh)
             # updater state slots ("m"/"v"/"h"...) mirror the params tree —
-            # shard them identically so sharded weights keep sharded state
+            # place them on the update sharding (== the param sharding when
+            # shard_update is off) so sharded state stays sharded, and a
+            # replicated restore (checkpoint) re-shards lazily here
             opt_state = {
-                k: (jax.tree.map(put, sub, p_sh)
+                k: (jax.tree.map(put, sub, upd_sh)
                     if tree_structure(sub) == p_struct
                     else jax.tree.map(lambda a: put(a, repl), sub))
                 for k, sub in opt_state.items()
@@ -249,8 +333,10 @@ class ParallelWrapper:
         engine, tuples-of-arrays for the graph engine — ragged tails padded
         to the device count and masked. Multi-host: batches are HOST-LOCAL
         shards (see launcher.HostShardedIterator), so the pad granularity is
-        the per-host device count, keeping every host's shard equal-sized."""
-        n = self.mesh.devices.size // jax.process_count()
+        the per-host device count, keeping every host's shard equal-sized.
+        With ``accum_steps=k`` the granularity is ``devices * k`` so the
+        microbatch split stays equal-sized."""
+        n = (self.mesh.devices.size // jax.process_count()) * self.accum_steps
         if self._is_graph:
             from ..nn.graph import _as_multi_iterator
             for mds in _as_multi_iterator(data):

@@ -1,0 +1,76 @@
+"""Round-6 advisor fixes (ADVICE.md r5 items): FastText words_nearest
+keyword polymorphism, ONNX Unsqueeze negative-axis const-folding, and the
+Keras-1 'bias' marker gated on modern-config absence."""
+
+import numpy as np
+import pytest
+
+
+def test_fasttext_words_nearest_accepts_base_class_keyword():
+    """words_nearest(w, n=...) must work polymorphically across
+    Word2Vec/FastText — FastText had renamed ``n`` to ``top_n``, breaking
+    keyword callers (ADVICE r5). Both spellings now work and agree."""
+    from deeplearning4j_tpu.nlp.word2vec import FastText
+    ft = FastText(layer_size=8, window=2, min_count=1, epochs=2, seed=1,
+                  batch_size=128, subsample=0.0, minn=3, maxn=3, bucket=300)
+    ft.fit(["alpha beta gamma delta alpha beta gamma delta"] * 3)
+
+    by_n = ft.words_nearest("alpha", n=2)
+    by_top_n = ft.words_nearest("alpha", top_n=2)  # old spelling still works
+    positional = ft.words_nearest("alpha", 2)
+    assert len(by_n) == len(by_top_n) == len(positional) == 2
+    assert [w for w, _ in by_n] == [w for w, _ in by_top_n] \
+        == [w for w, _ in positional]
+
+
+def test_onnx_unsqueeze_constfold_mixed_negative_axes():
+    """ONNX Unsqueeze axes refer to the OUTPUT rank; a mixed [-3, 1] on a
+    rank-1 const must fold to shape (1, 1, 4) — the raw-sort version raised
+    AxisError (ADVICE r5)."""
+    from deeplearning4j_tpu.autodiff.samediff import SameDiff
+    from deeplearning4j_tpu.modelimport.onnx import _Ctx, _select_handler
+
+    class _Node:
+        def __init__(self, inputs, outputs):
+            self.input = inputs
+            self.output = outputs
+
+    sd = SameDiff()
+    ctx = _Ctx(sd)
+    val = np.arange(4, dtype=np.int64)
+    ctx.consts["c"] = val
+    ctx.vars["c"] = sd.constant("c", val)
+
+    h = _select_handler("Unsqueeze", 13)
+    h(_Node(["c"], ["out"]), ctx, {"axes": [-3, 1]})
+
+    got = ctx.consts["out"]
+    want = np.expand_dims(np.expand_dims(val, 0), 1)  # axes {0,1} of rank 3
+    assert got.shape == (1, 1, 4)
+    np.testing.assert_array_equal(got, want)
+
+    # positive spellings of the same axes fold identically
+    ctx2 = _Ctx(SameDiff())
+    ctx2.consts["c"] = val
+    ctx2.vars["c"] = ctx2.sd.constant("c", val)
+    h(_Node(["c"], ["out"]), ctx2, {"axes": [0, 1]})
+    np.testing.assert_array_equal(ctx2.consts["out"], got)
+
+
+def test_keras1_bias_marker_gated_on_modern_config():
+    """A modern layer config legitimately carrying a 'bias' key must NOT be
+    rewritten as Keras-1 when it also carries the modern 'use_bias' marker;
+    a genuine Keras-1 config ('bias' alone) still normalizes."""
+    from deeplearning4j_tpu.modelimport.keras import _normalize_keras1
+
+    modern = {"class_name": "SomeFutureLayer",
+              "config": {"units": 4, "use_bias": True, "bias": [0.0] * 4}}
+    out = _normalize_keras1(modern)
+    assert out["config"] == modern["config"]  # untouched
+
+    legacy = {"class_name": "Dense",
+              "config": {"output_dim": 4, "bias": True}}
+    out = _normalize_keras1(legacy)
+    assert out["config"].get("use_bias") is True
+    assert "bias" not in out["config"]
+    assert out["config"].get("units") == 4
