@@ -1,0 +1,69 @@
+"""Compiled-trace cache management shared by the two network engines.
+
+``MultiLayerNetwork`` and ``ComputationGraph`` cache compiled callables
+(train step, train-mode output, epoch scan, serving engine executables)
+that bake the layer topology and the conf dtype policy in at trace time.
+This mixin owns the one invalidation contract for both, so a new cache
+site or mutation point gets fixed in exactly one place.
+"""
+
+from __future__ import annotations
+
+from .. import dtypes as _dt
+
+
+class CompiledCacheMixin:
+    """Invalidation + dtype-policy mutation + serving-engine access."""
+
+    # attributes cleared together on invalidation; subclasses extend
+    # (MultiLayerNetwork adds the rnn streaming pair)
+    _cache_attrs = ("_train_step", "_train_output_fn", "_epoch_fn")
+
+    def _replace_conf_dtype(self, dtype: str):
+        """Return a conf carrying ``dtype`` WITHOUT mutating the current
+        one in place — confs may be shared across nets, and a sibling's
+        live traces must not see the new policy without their own
+        invalidation."""
+        raise NotImplementedError
+
+    def _invalidate_compiled(self):
+        """Drop every cached compiled function. MUST be called at any
+        mutation that a live trace baked in — layer topology or the conf
+        dtype policy (param *values* are traced arguments and need no
+        invalidation; param avals retrace plain jits automatically, but
+        the AOT serving engine and conf-dependent closures do not)."""
+        for a in self._cache_attrs:
+            setattr(self, a, None)
+        # every engine serving this model (the lazily-built default AND
+        # externally constructed ones — engines self-register weakly)
+        for eng in list(getattr(self, "_serving_engines", ())):
+            eng.invalidate()
+
+    def set_dtype(self, dtype: str):
+        """Switch the network dtype policy in place (DL4J
+        ``convertDataType``): params/state/updater state are cast to the
+        new storage dtype (fp32 masters under a 16-bit compute policy)
+        and every compiled trace is invalidated — the old traces baked
+        the previous policy in and would silently serve it."""
+        _dt.resolve(dtype)  # validate the name before mutating anything
+        self.conf = self._replace_conf_dtype(dtype)
+        pdt = _dt.param_dtype(dtype)
+        self.params = _dt.cast_floating(self.params, pdt)
+        self.state = _dt.cast_floating(self.state, pdt)
+        if self.updater_state:
+            self.updater_state = _dt.cast_floating(self.updater_state, pdt)
+        self._invalidate_compiled()
+        return self
+
+    def inference_engine(self, **kwargs):
+        """The model's serving engine (``serving.engine.InferenceEngine``),
+        created lazily; ``output()`` routes through it. Pass kwargs (e.g.
+        ``mesh=``) on the first call to configure it."""
+        if self._inference_engine is None:
+            from ..serving.engine import InferenceEngine
+            self._inference_engine = InferenceEngine(self, **kwargs)
+        elif kwargs:
+            raise ValueError("inference engine already built; call "
+                             "inference_engine() without kwargs, or build "
+                             "an InferenceEngine directly")
+        return self._inference_engine

@@ -44,6 +44,7 @@ import numpy as np
 
 from .. import dtypes as _dt
 from .. import environment as _env
+from . import caches as _caches
 from ..data.dataset import (DataSet, DataSetIterator, MultiDataSet,
                             MultiDataSetIterator, NumpyMultiDataSetIterator)
 from ..ops import losses as _loss
@@ -234,8 +235,16 @@ class GraphBuilder:
             constraints=(b._constraints or None) if b else None)
 
 
-class ComputationGraph:
+class ComputationGraph(_caches.CompiledCacheMixin):
     """DAG network engine (DL4J ``ComputationGraph``)."""
+
+    def _replace_conf_dtype(self, dtype: str):
+        # shallow copy: the conf may be shared by other graphs ("the thing
+        # that serializes"); only this net's dtype policy changes
+        import copy
+        conf = copy.copy(self.conf)
+        conf.dtype = dtype
+        return conf
 
     def __init__(self, conf: ComputationGraphConfiguration):
         self.conf = conf
@@ -250,8 +259,9 @@ class ComputationGraph:
         self._score = float("nan")
         self._listeners: List[Any] = []
         self._train_step = None
-        self._output_fn = None
+        self._train_output_fn = None
         self._epoch_fn = None
+        self._inference_engine = None
         self._key = jax.random.PRNGKey(conf.seed)
         self._out_layers: Dict[str, Any] = {}
         for o in conf.outputs:
@@ -289,9 +299,7 @@ class ComputationGraph:
         self._shapes = shapes
         self.updater_state = self.conf.updater.init_state(params) \
             if self.conf.updater else {}
-        self._train_step = None
-        self._output_fn = None
-        self._epoch_fn = None
+        self._invalidate_compiled()
         return self
 
     def num_params(self) -> int:
@@ -607,20 +615,30 @@ class ComputationGraph:
 
     def output(self, *inputs, train: bool = False):
         """Output activations for the network outputs. Returns a single array
-        when the graph has one output, else a list (DL4J ``output()``)."""
-        if self._output_fn is None:
+        when the graph has one output, else a list (DL4J ``output()``).
+
+        ``train=False`` (serving) routes through the bucketed AOT
+        :meth:`inference_engine` — ragged request sizes pad to a bounded
+        bucket set instead of retracing per distinct batch size.
+        ``train=True`` runs stochastic layers with a fresh rng key —
+        its own cached trace, keyed on the flag."""
+        if not train:
+            return self.inference_engine().output(*inputs)
+        fn = self._train_output_fn
+        if fn is None:
             outputs = self.conf.outputs
 
-            def fwd(params, state, xs):
+            def fwd(params, state, xs, rng):
                 acts, _, _ = self._forward(
                     params, dict(zip(self.conf.inputs, xs)), state,
-                    train=False, rng=None)
+                    train=True, rng=rng)
                 return tuple(acts[o] for o in outputs)
 
-            self._output_fn = jax.jit(fwd)
+            fn = self._train_output_fn = jax.jit(fwd)
         xs = tuple(jnp.asarray(x) for x in inputs)
+        self._key, sub = jax.random.split(self._key)
         outs = [np.asarray(o) for o in
-                self._output_fn(self.params, self.state, xs)]
+                fn(self.params, self.state, xs, sub)]
         return outs[0] if len(outs) == 1 else outs
 
     def predict(self, *inputs) -> np.ndarray:

@@ -19,6 +19,7 @@ DL4J's param-name order (W, b, gamma, beta).
 
 from __future__ import annotations
 
+import dataclasses
 import functools
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -28,6 +29,7 @@ import numpy as np
 
 from .. import dtypes as _dt
 from .. import environment as _env
+from . import caches as _caches
 from ..data.dataset import DataSet, DataSetIterator, NumpyDataSetIterator
 from . import constraints as _constraints
 from . import updaters as _updaters
@@ -71,7 +73,15 @@ def _set_path(tree, path, value):
     return new
 
 
-class MultiLayerNetwork:
+class MultiLayerNetwork(_caches.CompiledCacheMixin):
+    # invalidation also drops the rnn streaming pair: a carry captured
+    # under the old dtype policy must not feed a retraced step
+    _cache_attrs = ("_train_step", "_train_output_fn", "_epoch_fn",
+                    "_rnn_step_fn", "_rnn_stream")
+
+    def _replace_conf_dtype(self, dtype: str):
+        return dataclasses.replace(self.conf, dtype=dtype)
+
     def __init__(self, conf: MultiLayerConfiguration):
         self.conf = conf
         self.layers = conf.layers
@@ -83,11 +93,12 @@ class MultiLayerNetwork:
         self._score = float("nan")
         self._listeners: List[Any] = []
         self._train_step = None
-        self._output_fn = None
+        self._train_output_fn = None
         self._rnn_step_fn = None
         self._rnn_stream = None
         self._epoch_fn = None
         self._solver = None
+        self._inference_engine = None
         self._key = jax.random.PRNGKey(conf.seed)
         self._out_layer = self.layers[-1] if self.layers else None
         if self.layers and not _is_loss_head(self._out_layer):
@@ -117,12 +128,8 @@ class MultiLayerNetwork:
         self.state = state
         self.updater_state = self.conf.updater.init_state(params) \
             if self.conf.updater else {}
-        self._train_step = None
-        self._output_fn = None
-        self._rnn_step_fn = None
-        self._rnn_stream = None
-        self._epoch_fn = None
         self._solver = None
+        self._invalidate_compiled()
         return self
 
     def num_params(self) -> int:
@@ -420,12 +427,23 @@ class MultiLayerNetwork:
 
     # ------------------------------------------------------------- inference
     def output(self, x, train: bool = False):
-        """Forward pass to output activations (DL4J ``output()``)."""
-        if self._output_fn is None:
-            self._output_fn = jax.jit(
-                lambda params, state, x: self._forward(
-                    params, x, state, train=False, rng=None)[0])
-        return np.asarray(self._output_fn(self.params, self.state, jnp.asarray(x)))
+        """Forward pass to output activations (DL4J ``output()``).
+
+        ``train=False`` (serving) routes through the bucketed AOT
+        :meth:`inference_engine`, so ragged request sizes pad to a bounded
+        bucket set instead of retracing per distinct batch size.
+        ``train=True`` runs stochastic layers (dropout fires) with a fresh
+        key from the model's rng stream — its own cached trace, keyed on
+        the flag."""
+        if not train:
+            return self.inference_engine().output(x)
+        fn = self._train_output_fn
+        if fn is None:
+            fn = self._train_output_fn = jax.jit(
+                lambda params, state, x, rng: self._forward(
+                    params, x, state, train=True, rng=rng)[0])
+        self._key, sub = jax.random.split(self._key)
+        return np.asarray(fn(self.params, self.state, jnp.asarray(x), sub))
 
     def predict(self, x) -> np.ndarray:
         """Class indices (DL4J ``predict()``)."""

@@ -304,6 +304,18 @@ class ParallelWrapper:
 
         return step_fn, shard_args
 
+    def serving_engine(self, **kwargs):
+        """A ``serving.engine.InferenceEngine`` over THIS wrapper's mesh:
+        train data-parallel, then serve the same slice — coalesced request
+        batches shard over the ``'data'`` axis (bucket floor rises to the
+        mesh size so every device holds equal rows). Keyword args pass
+        through (e.g. ``min_bucket=``)."""
+        from ..serving.engine import InferenceEngine
+        if "data" not in self.mesh.axis_names:
+            raise ValueError("serving_engine needs a 'data' mesh axis; "
+                             f"mesh has {self.mesh.axis_names}")
+        return InferenceEngine(self.model, mesh=self.mesh, **kwargs)
+
     def fit(self, data, epochs: int = 1):
         m = self.model
         if not m.params:

@@ -1,5 +1,7 @@
 """Inference serving (SURVEY.md §2.5/§2.6: ParallelInference +
-JsonModelServer)."""
+JsonModelServer, re-expressed for TPU as a bucketed AOT engine plus a
+dynamic micro-batching dispatcher)."""
 
-from .inference import InferenceMode, ParallelInference  # noqa: F401
+from .engine import InferenceEngine, default_buckets, next_bucket  # noqa: F401
+from .batcher import InferenceMode, ParallelInference  # noqa: F401
 from .server import JsonModelServer  # noqa: F401

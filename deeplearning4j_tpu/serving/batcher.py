@@ -1,0 +1,366 @@
+"""ParallelInference: dynamic micro-batching dispatcher for serving.
+
+TPU-native re-expression of the reference's ``ParallelInference``
+(reference: ``deeplearning4j-parallel-wrapper .../parallelism/
+ParallelInference.java``† per SURVEY.md §2.6; reference mount was empty,
+citation upstream-relative, unverified). The reference replicates the
+model per GPU and round-robins an observable queue; on TPU one compiled
+program serves the whole slice, so the contract that survives is the
+queueing semantics:
+
+- ``InferenceMode.SEQUENTIAL`` — requests run one at a time (a lock),
+  no coalescing; the reference's low-latency/low-traffic mode.
+- ``InferenceMode.BATCHED`` — a bounded request queue plus a dispatcher
+  thread that coalesces concurrent requests up to ``max_batch_size``
+  rows or ``max_wait_ms`` of linger into ONE
+  ``serving.engine.InferenceEngine`` call (padded to a compiled bucket),
+  then scatters the rows back and resolves per-request futures.
+
+Divergences from the reference (recorded in PARITY.md): futures instead
+of observables, bucket padding instead of per-batch-size queues, and a
+mesh option — the coalesced batch is placed over the ``'data'`` axis via
+``NamedSharding``, so serving throughput scales with the slice.
+
+Observability: per-request p50/p99 latency, queue depth, coalesced batch
+sizes, and the engine's bucket-hit/compile counters, via :meth:`stats`
+(pumped into the ui/stats storage by ``ui.stats.ServingStatsListener``).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future, TimeoutError as _FutTimeout
+from typing import List, Optional
+
+import numpy as np
+
+from .engine import InferenceEngine, next_bucket
+
+
+class InferenceMode:
+    SEQUENTIAL = "sequential"
+    BATCHED = "batched"
+
+
+class _Request:
+    __slots__ = ("x", "length", "future", "t_enqueue")
+
+    def __init__(self, x, length):
+        self.x = x
+        self.length = length          # true seq length (seq models)
+        self.future: Future = Future()
+        self.t_enqueue = time.perf_counter()
+
+
+class ParallelInference:
+    """Thread-safe inference front over a model's forward pass.
+
+    Usage::
+
+        pi = ParallelInference(net, mode=InferenceMode.BATCHED,
+                               max_batch_size=32, max_wait_ms=5)
+        y = pi.output(x)          # blocking, callable from many threads
+        f = pi.submit(x)          # non-blocking -> concurrent Future
+        pi.stats()                # p50/p99 latency, queue depth, buckets
+        pi.shutdown()
+
+    ``batch_limit`` is accepted as a deprecated alias of
+    ``max_batch_size`` (pre-engine API).
+    """
+
+    def __init__(self, model, mode: str = InferenceMode.BATCHED,
+                 max_batch_size: int = 32, max_wait_ms: float = 5.0,
+                 queue_limit: int = 256, mesh=None,
+                 engine: Optional[InferenceEngine] = None,
+                 warmup: bool = False,
+                 batch_limit: Optional[int] = None):
+        if mode not in (InferenceMode.SEQUENTIAL, InferenceMode.BATCHED):
+            raise ValueError(f"unknown inference mode {mode!r}")
+        if batch_limit is not None:  # deprecated alias
+            max_batch_size = batch_limit
+        self.model = model
+        self.mode = mode
+        self.max_batch_size = int(max_batch_size)
+        self.max_wait = max_wait_ms / 1e3
+        if engine is None:
+            # default: share the model's engine, so net.output() and the
+            # batcher hit the same warmed bucket cache; a mesh needs its
+            # own engine (sharded executables)
+            engine = InferenceEngine(model, mesh=mesh) if mesh is not None \
+                else model.inference_engine()
+        self.engine = engine
+        self._seq = any(engine._seq_input or ())
+        if warmup:
+            # cover every bucket a coalesced batch can land on: the
+            # dispatcher caps totals at max_batch_size, which pads up to
+            # next_bucket(max_batch_size)
+            from .engine import default_buckets
+            engine.warmup(default_buckets(
+                next_bucket(self.max_batch_size, engine.min_bucket),
+                minimum=engine.min_bucket))
+        self._q: "queue.Queue[_Request]" = queue.Queue(maxsize=queue_limit)
+        self._lock = threading.Lock()           # counters / latency deques
+        self._dispatch_lock = threading.Lock()  # SEQUENTIAL execution
+        self._shutdown = threading.Event()
+        self._worker: Optional[threading.Thread] = None
+        # -- observability (lock-protected) --
+        self._latencies = deque(maxlen=4096)   # seconds, per request
+        self._batch_sizes = deque(maxlen=4096)  # rows per coalesced call
+        self.requests = 0
+        self.batches = 0
+        self.failures = 0
+        if mode == InferenceMode.BATCHED:
+            self._worker = threading.Thread(
+                target=self._dispatcher, daemon=True,
+                name="ParallelInference-dispatcher")
+            self._worker.start()
+
+    # ---- public ------------------------------------------------------------
+    def submit(self, x) -> Future:
+        """Enqueue one request; resolves to the unpadded output rows.
+        Requests larger than ``max_batch_size`` are split into capped
+        chunks (each lands on a warmed bucket) and rejoined."""
+        if self._shutdown.is_set():
+            raise RuntimeError("ParallelInference is shut down")
+        x = self._validate(np.asarray(x))
+        with self._lock:
+            self.requests += 1
+        if self.mode == InferenceMode.SEQUENTIAL:
+            req = self._make_request(x)
+            try:
+                # dispatch lock only — stats() must not block behind a
+                # device call
+                with self._dispatch_lock:
+                    out = self.engine.output(x)
+                with self._lock:
+                    self.batches += 1
+                    self._batch_sizes.append(x.shape[0])
+                req.future.set_result(
+                    [np.asarray(o) for o in out] if isinstance(out, list)
+                    else np.asarray(out))
+            except Exception as e:
+                with self._lock:
+                    self.failures += 1
+                req.future.set_exception(e)
+            finally:
+                self._record_latency(req)
+            return req.future
+        if x.shape[0] > self.max_batch_size:
+            return self._submit_chunked(x)
+        return self._enqueue(self._make_request(x))
+
+    def _make_request(self, x) -> _Request:
+        return _Request(x, x.shape[1] if self._seq and x.ndim >= 2 else None)
+
+    def _enqueue(self, req: _Request) -> Future:
+        self._q.put(req)
+        # a shutdown() racing this put may already have drained the queue
+        # and joined the dispatcher — fail the future here rather than
+        # strand a submit() caller forever
+        if self._shutdown.is_set() and not req.future.done():
+            req.future.set_exception(RuntimeError(
+                "ParallelInference shut down before the request was served"))
+        return req.future
+
+    def _submit_chunked(self, x) -> Future:
+        """Split an oversized request into <= max_batch_size chunks (each
+        pads onto a warmed bucket — no compile under traffic) and resolve
+        one parent future with the rejoined rows."""
+        m = self.max_batch_size
+        subs = [self._make_request(x[i:i + m])
+                for i in range(0, x.shape[0], m)]
+        parent: Future = Future()
+        state = {"left": len(subs)}
+        plock = threading.Lock()
+
+        def on_done(f: Future):
+            with plock:
+                if parent.done():
+                    return
+                err = f.exception()
+                if err is not None:
+                    parent.set_exception(err)
+                    return
+                state["left"] -= 1
+                if state["left"]:
+                    return
+                results = [s.future.result() for s in subs]
+                if isinstance(results[0], list):  # multi-output graph
+                    parent.set_result([
+                        np.concatenate([r[k] for r in results])
+                        for k in range(len(results[0]))])
+                else:
+                    parent.set_result(np.concatenate(results))
+
+        for s in subs:
+            s.future.add_done_callback(on_done)
+        for s in subs:
+            self._enqueue(s)
+        return parent
+
+    def output(self, x) -> np.ndarray:
+        """Blocking convenience over :meth:`submit`; re-checks shutdown so
+        a racing ``shutdown()`` cannot strand the caller."""
+        fut = self.submit(x)
+        while True:
+            try:
+                return fut.result(timeout=0.2)
+            except _FutTimeout:
+                if self._shutdown.is_set() and not fut.done():
+                    raise RuntimeError(
+                        "ParallelInference shut down before the request "
+                        "was served") from None
+
+    def queue_depth(self) -> int:
+        return self._q.qsize()
+
+    def stats(self) -> dict:
+        """Serving health snapshot: request latency percentiles (ms),
+        queue depth, coalesced batch sizes, and the engine's bucket-hit /
+        compile counters."""
+        with self._lock:
+            lats = np.asarray(self._latencies, dtype=np.float64)
+            sizes = np.asarray(self._batch_sizes, dtype=np.float64)
+            out = {
+                "mode": self.mode,
+                "requests": self.requests,
+                "batches": self.batches,
+                "failures": self.failures,
+                "queue_depth": self._q.qsize(),
+                "latency_ms_p50": _pct(lats, 50),
+                "latency_ms_p99": _pct(lats, 99),
+                "batch_rows_mean": float(sizes.mean()) if sizes.size else None,
+                "batch_rows_max": int(sizes.max()) if sizes.size else None,
+            }
+        out["engine"] = self.engine.stats()
+        return out
+
+    def shutdown(self):
+        self._shutdown.set()
+        if self._worker:
+            self._worker.join(timeout=5)
+        # fail anything still queued — an unresolved future strands its
+        # caller in output()
+        while True:
+            try:
+                req = self._q.get_nowait()
+            except queue.Empty:
+                break
+            if not req.future.done():
+                req.future.set_exception(RuntimeError(
+                    "ParallelInference shut down before the request "
+                    "was served"))
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.shutdown()
+
+    # ---- internals ---------------------------------------------------------
+    def _validate(self, x: np.ndarray) -> np.ndarray:
+        in_shape = getattr(self.model.conf, "input_shape", None)
+        if in_shape is not None:
+            if x.ndim == len(in_shape):
+                x = x[None]  # single-example convenience
+            ok = x.ndim == len(in_shape) + 1 and (
+                self._seq  # [B,T,F]: T is ragged, F must match
+                and x.shape[2:] == tuple(in_shape[1:])
+                or not self._seq and tuple(x.shape[1:]) == tuple(in_shape))
+            if not ok:
+                # reject HERE, in the offending caller's thread — a bad
+                # shape inside a coalesced batch would fail everyone
+                raise ValueError(
+                    f"input shape {tuple(x.shape[1:])} does not match "
+                    f"model input {tuple(in_shape)}")
+        return x
+
+    def _record_latency(self, req: _Request):
+        with self._lock:
+            self._latencies.append(time.perf_counter() - req.t_enqueue)
+
+    def _dispatcher(self):
+        pending: Optional[_Request] = None  # carry-over, never overshoot
+        while not self._shutdown.is_set():
+            if pending is not None:
+                first, pending = pending, None
+            else:
+                try:
+                    first = self._q.get(timeout=0.05)
+                except queue.Empty:
+                    continue
+            batch: List[_Request] = [first]
+            total = first.x.shape[0]
+            deadline = time.perf_counter() + self.max_wait
+            while total < self.max_batch_size:
+                remaining = deadline - time.perf_counter()
+                if remaining <= 0:
+                    break
+                try:
+                    r = self._q.get(timeout=remaining)
+                except queue.Empty:
+                    break
+                if total + r.x.shape[0] > self.max_batch_size:
+                    # would overshoot the cap (and the warmed bucket set):
+                    # lead the NEXT batch with it instead
+                    pending = r
+                    break
+                batch.append(r)
+                total += r.x.shape[0]
+            self._run(batch, total)
+        if pending is not None:  # don't strand a carried request
+            pending.future.set_exception(RuntimeError(
+                "ParallelInference shut down before the request was served"))
+        # queued-request drain happens in shutdown() (this thread exits first)
+
+    def _run(self, batch: List[_Request], total: int):
+        try:
+            lengths = None
+            if self._seq:
+                # ragged T: end-pad every request to the coalesced max;
+                # the engine masks the pad steps out exactly
+                t_max = max(r.x.shape[1] for r in batch)
+                xs, lengths = [], []
+                for r in batch:
+                    t = r.x.shape[1]
+                    x = r.x if t == t_max else np.concatenate(
+                        [r.x, np.zeros((r.x.shape[0], t_max - t)
+                                       + r.x.shape[2:], r.x.dtype)], axis=1)
+                    xs.append(x)
+                    lengths.extend([t] * r.x.shape[0])
+                x = np.concatenate(xs, axis=0)
+                out = self.engine.output(x, lengths=np.asarray(lengths))
+            else:
+                x = np.concatenate([r.x for r in batch], axis=0)
+                out = self.engine.output(x)
+            outs = out if isinstance(out, list) else [out]
+            i = 0
+            done_t = time.perf_counter()
+            for r in batch:
+                n = r.x.shape[0]
+                rows = [o[i:i + n] for o in outs]
+                if self._seq and r.length is not None:
+                    rows = [o[:, :r.length] if o.ndim >= 3 else o
+                            for o in rows]
+                i += n
+                if not r.future.done():  # a shutdown race may have failed it
+                    r.future.set_result(rows if len(rows) > 1 else rows[0])
+            with self._lock:  # one lock round per coalesced batch
+                self.batches += 1
+                self._batch_sizes.append(total)
+                self._latencies.extend(done_t - r.t_enqueue for r in batch)
+        except Exception as e:  # propagate to every waiter
+            done_t = time.perf_counter()
+            with self._lock:
+                self.failures += len(batch)
+                self._latencies.extend(done_t - r.t_enqueue for r in batch)
+            for r in batch:
+                if not r.future.done():
+                    r.future.set_exception(e)
+
+
+def _pct(a: np.ndarray, q: float) -> Optional[float]:
+    return float(np.percentile(a, q) * 1e3) if a.size else None

@@ -1,0 +1,407 @@
+"""InferenceEngine: bucketed, AOT-compiled inference for serving.
+
+The serving analog of the training engines' "one compiled program" thesis
+(SURVEY.md §3.1): every inference entry point used to be a bare
+``jax.jit`` that retraced on every distinct batch size and seq length —
+fatal under ragged request traffic, where compiles (seconds) land *under
+load*. This engine:
+
+- pads the batch dimension (and the sequence dimension for recurrent
+  nets) up to a small set of power-of-two **buckets**, so the number of
+  compiled programs is O(log max_batch) instead of O(distinct sizes);
+- compiles each bucket **ahead of time** via
+  ``jax.jit(...).lower(...).compile()`` (``warmup()``), so no compile
+  ever happens under traffic;
+- unpads **mask-exactly**: padded batch rows never influence real rows
+  (inference is per-example), and padded time steps are masked out
+  through the layer stack's feature-mask path (recurrent carry gating,
+  masked pooling/attention), then sliced off;
+- counts bucket hits vs. compiles, per bucket — the serving health
+  signal (a compile after warmup is a bug, and tests assert zero);
+- optionally places the padded batch over the ``'data'`` axis of a
+  device mesh via ``NamedSharding``, so one coalesced request batch
+  spans the slice (composes with ``serving.batcher.ParallelInference``).
+
+Works for both engines: ``MultiLayerNetwork`` (single input) and
+``ComputationGraph`` (input tuple, output tuple) — both expose the pure
+``_forward`` walk this wraps.
+"""
+
+from __future__ import annotations
+
+import threading
+import weakref
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from .. import dtypes as _dt
+
+
+def next_bucket(n: int, minimum: int = 1) -> int:
+    """Smallest power of two >= n (and >= minimum)."""
+    b = max(1, int(minimum))
+    while b < n:
+        b <<= 1
+    return b
+
+
+def default_buckets(max_batch: int = 64, minimum: int = 1) -> List[int]:
+    """Power-of-two ladder [minimum..max_batch]."""
+    out, b = [], max(1, int(minimum))
+    while b <= max_batch:
+        out.append(b)
+        b <<= 1
+    return out
+
+
+class InferenceEngine:
+    """Bucketed AOT-compiled ``output()`` for one model.
+
+    Usage::
+
+        eng = InferenceEngine(net)
+        eng.warmup([1, 2, 4, 8, 16, 32])   # compile outside traffic
+        y = eng.output(x)                  # any batch size: zero compiles
+        eng.stats()                        # hits / compiles / per-bucket
+
+    ``mesh``: a ``jax.sharding.Mesh`` with a ``'data'`` axis — the padded
+    batch is placed over it (bucket floor rises to the axis size so every
+    device holds equal rows); params/state replicate.
+    """
+
+    def __init__(self, model, mesh=None, data_axis: str = "data",
+                 min_bucket: int = 1):
+        self.model = model
+        self.mesh = mesh
+        self.data_axis = data_axis
+        if mesh is not None:
+            if data_axis not in mesh.axis_names:
+                raise ValueError(f"mesh has no {data_axis!r} axis "
+                                 f"(axes: {mesh.axis_names})")
+            min_bucket = max(min_bucket, int(mesh.shape[data_axis]))
+        self.min_bucket = max(1, int(min_bucket))
+        self._is_graph = hasattr(model.conf, "inputs")
+        self._input_shapes = self._model_input_shapes()
+        # [T, F] input convention (InputType.recurrent) => the runtime
+        # array is [B, T, F] and axis 1 is bucketable sequence; a config
+        # without shapes (shapes=None) serves batch-bucketed only, deriving
+        # per-request shapes (warmup then needs no traffic to have flowed)
+        self._seq_input = [len(s) == 2 for s in self._input_shapes] \
+            if self._input_shapes is not None else None
+        self._compiled: Dict[Tuple, Any] = {}
+        self._lock = threading.Lock()
+        self._placed_params_src = None
+        self._placed = None
+        self._placement_src = None
+        self._placement = None
+        self.calls = 0
+        self.hits = 0
+        self.compiles = 0
+        self.padded_rows = 0
+        self.bucket_hits: Dict[Tuple, int] = {}
+        # register with the model so _invalidate_compiled (set_dtype,
+        # topology mutation) reaches EVERY engine serving it — including
+        # ones built directly or via ParallelWrapper.serving_engine, not
+        # just model.inference_engine(); weak so engines can be dropped
+        try:
+            if not hasattr(model, "_serving_engines"):
+                model._serving_engines = weakref.WeakSet()
+            model._serving_engines.add(self)
+        except (AttributeError, TypeError):
+            pass  # models with __slots__ / exotic proxies: opt out
+
+    # ------------------------------------------------------------ model glue
+    def _model_input_shapes(self) -> Optional[List[Tuple[int, ...]]]:
+        conf = self.model.conf
+        if self._is_graph:
+            if set(conf.input_shapes) != set(conf.inputs):
+                return None
+            return [tuple(conf.input_shapes[n]) for n in conf.inputs]
+        if conf.input_shape is None:
+            return None
+        return [tuple(conf.input_shape)]
+
+    def _forward_fn(self):
+        model = self.model
+        if self._is_graph:
+            names = list(model.conf.inputs)
+            outputs = list(model.conf.outputs)
+
+            def fwd(params, state, xs, masks):
+                acts, _, _ = model._forward(
+                    params, dict(zip(names, xs)), state, train=False,
+                    rng=None,
+                    masks={n: m for n, m in zip(names, masks)
+                           if m is not None})
+                return tuple(acts[o] for o in outputs)
+        else:
+            def fwd(params, state, xs, masks):
+                out, _, _ = model._forward(
+                    params, xs[0], state, train=False, rng=None,
+                    mask=masks[0])
+                return (out,)
+        return fwd
+
+    # ----------------------------------------------------------- compilation
+    def _shardings(self, xs_avals, masks_avals):
+        """Mesh placements for the request arrays: (xs, masks) sharding
+        tuples over the data axis, or (None, None) without a mesh."""
+        if self.mesh is None:
+            return None, None
+        data = NamedSharding(self.mesh, P(self.data_axis))
+        xs_sh = tuple(data for _ in xs_avals)
+        masks_sh = tuple(None if m is None else data for m in masks_avals)
+        return xs_sh, masks_sh
+
+    def _params_placement(self):
+        """(fingerprint, params sharding tree, state sharding tree) of the
+        arrays the executables will actually be fed (the mesh-placed trees
+        when a mesh is configured). AOT executables are strict about input
+        shardings, so a placement change — e.g. a ParallelWrapper.fit
+        leaving replicated NamedSharding arrays behind — must key (and
+        lower) its own executable rather than feed the old one.
+        Identity-cached: fit() rebinds the params dict, so the leaf walk
+        only reruns after an update."""
+        params, state = self._place_params()
+        # strong refs + `is` checks, NOT id(): a freed dict's address can
+        # be reused by a later params tree, which would serve stale copies
+        if self._placement_src is not None and \
+                self._placement_src[0] is params and \
+                self._placement_src[1] is state:
+            return self._placement
+        shs = []
+
+        def grab(leaf):
+            sh = getattr(leaf, "sharding", None)
+            shs.append(sh)
+            return sh
+
+        p_sh = jax.tree.map(grab, params)
+        s_sh = jax.tree.map(grab, state)
+        if any(s is None for s in shs):
+            # host numpy leaves: no placement to pin; let jit default
+            placement = ("host", None, None)
+        else:
+            placement = ("|".join(sorted(set(map(str, shs)))), p_sh, s_sh)
+        self._placement_src = (params, state)
+        self._placement = placement
+        return placement
+
+    def _key_of(self, xs_avals, masks_avals, fp) -> Tuple:
+        return (tuple((tuple(a.shape), str(a.dtype)) for a in xs_avals),
+                tuple(None if m is None else tuple(m.shape)
+                      for m in masks_avals), fp)
+
+    def _get_compiled(self, xs_avals, masks_avals, _warmup=False):
+        fp, p_sh, s_sh = self._params_placement()
+        key = self._key_of(xs_avals, masks_avals, fp)
+        with self._lock:
+            exe = self._compiled.get(key)
+            if exe is not None:
+                if not _warmup:
+                    self.hits += 1
+                    self.bucket_hits[key] = self.bucket_hits.get(key, 0) + 1
+                return exe
+            params_avals = jax.eval_shape(lambda: self.model.params)
+            state_avals = jax.eval_shape(lambda: self.model.state)
+            xs_sh, masks_sh = self._shardings(xs_avals, masks_avals)
+            in_sh = None
+            if p_sh is not None:
+                # pin the executable to the params' actual placement (keeps
+                # TP-sharded leaves sharded; replicated stays replicated)
+                in_sh = (p_sh, s_sh, xs_sh, masks_sh)
+            fn = self._forward_fn()
+            jitted = jax.jit(fn) if in_sh is None else \
+                jax.jit(fn, in_shardings=in_sh)
+            exe = jitted.lower(params_avals, state_avals,
+                               tuple(xs_avals), tuple(masks_avals)).compile()
+            self._compiled[key] = exe
+            self.compiles += 1
+            if not _warmup:
+                self.bucket_hits[key] = self.bucket_hits.get(key, 0) + 1
+            return exe
+
+    def warmup(self, buckets: Optional[Sequence[int]] = None,
+               seq_buckets: Optional[Sequence[int]] = None
+               ) -> "InferenceEngine":
+        """Compile every (batch bucket x seq bucket) executable now, via
+        the AOT path — after this, requests whose padded shape lands on a
+        warmed bucket never trigger a compile. ``seq_buckets`` applies to
+        recurrent ([T, F]) inputs; defaults to the configured T when it is
+        static, and is required when T is dynamic (-1)."""
+        if self._input_shapes is None:
+            raise ValueError("model config has no input shapes "
+                             "(input_type(...)); warmup cannot derive "
+                             "avals — serve a request first or set shapes")
+        if not buckets:
+            # default ladder must reach min_bucket even past the 64 ceiling
+            buckets = default_buckets(max(64, self.min_bucket),
+                                      minimum=self.min_bucket)
+        buckets = sorted(set(next_bucket(b, self.min_bucket)
+                             for b in buckets))
+        dt = _dt.resolve(self.model.conf.dtype)
+        dt = dt if np.issubdtype(dt, np.floating) else np.dtype(np.float32)
+        for b in buckets:
+            for t in self._warmup_seq_lens(seq_buckets):
+                xs_avals, masks_avals = [], []
+                for shape, is_seq in zip(self._input_shapes, self._seq_input):
+                    if is_seq:
+                        xs_avals.append(jax.ShapeDtypeStruct(
+                            (b, t, shape[1]), dt))
+                        masks_avals.append(jax.ShapeDtypeStruct(
+                            (b, t), np.float32))
+                    else:
+                        xs_avals.append(jax.ShapeDtypeStruct(
+                            (b,) + shape, dt))
+                        masks_avals.append(None)
+                self._get_compiled(xs_avals, masks_avals, _warmup=True)
+        return self
+
+    def _warmup_seq_lens(self, seq_buckets):
+        if not any(self._seq_input):
+            return [None]
+        if seq_buckets:
+            return sorted(set(next_bucket(t) for t in seq_buckets))
+        ts = [s[0] for s, q in zip(self._input_shapes, self._seq_input) if q]
+        if any(t is None or t <= 0 for t in ts):
+            raise ValueError("model has dynamic sequence length: pass "
+                             "warmup(seq_buckets=[...])")
+        return sorted(set(next_bucket(t) for t in ts))
+
+    # -------------------------------------------------------------- dispatch
+    def output(self, *inputs, lengths=None):
+        """Run inference on a ragged-size request batch.
+
+        ``inputs``: one array per model input, batch-first. ``lengths``:
+        optional per-row true sequence lengths ``[B]`` for recurrent
+        inputs (rows end-padded to a common T by a batcher) — padded
+        steps are masked out of the computation exactly.
+
+        Returns the unpadded output (list when the graph has several)."""
+        xs = [np.asarray(x) for x in inputs]
+        if self._input_shapes is not None and \
+                len(xs) != len(self._input_shapes):
+            raise ValueError(f"model takes {len(self._input_shapes)} "
+                             f"inputs, got {len(xs)}")
+        seq_flags = self._seq_input if self._seq_input is not None \
+            else [False] * len(xs)
+        n = xs[0].shape[0]
+        dt = _dt.resolve(self.model.conf.dtype)
+        b = next_bucket(n, self.min_bucket)
+        with self._lock:  # the engine is shared across serving threads
+            self.calls += 1
+            self.padded_rows += b - n
+        xs_p, masks = [], []
+        seq_lens = []
+        for x, is_seq in zip(xs, seq_flags):
+            if np.issubdtype(np.dtype(x.dtype), np.floating) and \
+                    np.issubdtype(dt, np.floating) and x.dtype != dt:
+                x = x.astype(dt)  # host-side: one executable per net dtype
+            if is_seq:
+                t = x.shape[1]
+                tb = next_bucket(t)
+                ln = np.full((n,), t, np.int64) if lengths is None \
+                    else np.asarray(lengths)
+                mask = (np.arange(tb)[None, :] <
+                        ln[:, None]).astype(np.float32)
+                if tb != t:
+                    x = np.concatenate(
+                        [x, np.zeros((n, tb - t) + x.shape[2:], x.dtype)],
+                        axis=1)
+                seq_lens.append((t, tb))
+                if b != n:
+                    x = np.concatenate(
+                        [x, np.zeros((b - n,) + x.shape[1:], x.dtype)])
+                    mask = np.concatenate(
+                        [mask, np.zeros((b - n, tb), np.float32)])
+                masks.append(mask)
+            else:
+                if b != n:
+                    x = np.concatenate(
+                        [x, np.zeros((b - n,) + x.shape[1:], x.dtype)])
+                masks.append(None)
+                seq_lens.append(None)
+            xs_p.append(x)
+
+        xs_avals = [jax.ShapeDtypeStruct(x.shape, x.dtype) for x in xs_p]
+        masks_avals = [None if m is None else
+                       jax.ShapeDtypeStruct(m.shape, m.dtype) for m in masks]
+        exe = self._get_compiled(xs_avals, masks_avals)
+        params, state = self._place_params()
+        if self.mesh is not None:
+            xs_sh, masks_sh = self._shardings(xs_avals, masks_avals)
+            xs_p = [jax.device_put(x, s) for x, s in zip(xs_p, xs_sh)]
+            masks = [None if m is None else jax.device_put(m, s)
+                     for m, s in zip(masks, masks_sh)]
+        outs = exe(params, state, tuple(xs_p), tuple(masks))
+        res = [self._unpad(np.asarray(o), n, seq_lens) for o in outs]
+        return res if self._is_graph and len(res) > 1 else res[0]
+
+    def _unpad(self, out, n, seq_lens):
+        out = out[:n]
+        # slice the time axis back only for per-timestep outputs whose
+        # dim 1 matches the padded bucket EXACTLY ([B, T_bucket, ...]);
+        # pooled heads ([B, C]) keep their shape. With several seq inputs
+        # of DIFFERENT lengths the output↔input alignment is ambiguous —
+        # return the padded time axis rather than guess and truncate.
+        pairs = {p for p in seq_lens if p is not None}
+        if len(pairs) == 1:
+            t, tb = next(iter(pairs))
+            if t != tb and out.ndim >= 3 and out.shape[1] == tb:
+                out = out[:, :t]
+        return out
+
+    def _place_params(self):
+        """Params/state ready for the executables. With a mesh: leaves
+        already living on THIS mesh keep their sharding (a tensor-parallel
+        leaf stays sharded — replicating it would defeat TP and can OOM);
+        everything else replicates onto it. Re-placed once per params
+        identity (fit() rebinds the dict, so identity tracks updates)."""
+        model = self.model
+        if self.mesh is None:
+            return model.params, model.state
+        src = self._placed_params_src  # strong refs; id() could be reused
+        if src is None or src[0] is not model.params or \
+                src[1] is not model.state:
+            repl = NamedSharding(self.mesh, P())
+
+            def place(leaf):
+                sh = getattr(leaf, "sharding", None)
+                if isinstance(sh, NamedSharding) and sh.mesh == self.mesh:
+                    return leaf
+                return jax.device_put(leaf, repl)
+
+            self._placed = (jax.tree.map(place, model.params),
+                            jax.tree.map(place, model.state))
+            self._placed_params_src = (model.params, model.state)
+        return self._placed
+
+    # ---------------------------------------------------------------- admin
+    def invalidate(self):
+        """Drop every compiled executable (model topology/dtype changed)."""
+        with self._lock:
+            self._compiled.clear()
+            self._placed = None
+            self._placed_params_src = None
+            self._placement = None
+            self._placement_src = None
+            self._input_shapes = self._model_input_shapes()
+            self._seq_input = [len(s) == 2 for s in self._input_shapes] \
+                if self._input_shapes is not None else None
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "calls": self.calls,
+                "hits": self.hits,
+                "compiles": self.compiles,
+                "padded_rows": self.padded_rows,
+                "compiled_buckets": len(self._compiled),
+                "bucket_hits": {
+                    str([s for s, _ in k[0]]): v
+                    for k, v in self.bucket_hits.items()},
+            }

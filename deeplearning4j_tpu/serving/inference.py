@@ -1,172 +1,7 @@
-"""ParallelInference: concurrent request batching over a jitted apply.
+"""Back-compat shim: the serving front moved to ``serving.batcher``
+(``ParallelInference`` futures dispatcher) + ``serving.engine``
+(``InferenceEngine`` bucketed AOT cache). Import from those — or the
+``deeplearning4j_tpu.serving`` package — directly."""
 
-TPU-native equivalent of the reference's inference front (reference:
-``deeplearning4j-parallel-wrapper .../parallelism/ParallelInference.java``
-— INPLACE/SEQUENTIAL/BATCHED modes with per-device model replicas† per
-SURVEY.md §2.6; reference mount was empty, citation upstream-relative,
-unverified).
-
-The reference replicates the model across GPUs and round-robins requests;
-on TPU one compiled program serves everything, so the useful part of the
-contract is the BATCHED mode: many threads call ``output()`` with small
-inputs, a collector thread coalesces them (up to ``batch_limit`` or
-``max_wait_ms``) into ONE padded device batch — turning request traffic
-into MXU-sized work. Pad-to-bucket keeps the number of compiled shapes
-bounded (powers of two), the XLA analog of the reference's per-batch-size
-queues.
-"""
-
-from __future__ import annotations
-
-import queue
-import threading
-from typing import List, Optional
-
-import numpy as np
-
-
-class InferenceMode:
-    SEQUENTIAL = "sequential"
-    BATCHED = "batched"
-
-
-class _Request:
-    __slots__ = ("x", "event", "result", "error")
-
-    def __init__(self, x):
-        self.x = x
-        self.event = threading.Event()
-        self.result = None
-        self.error = None
-
-
-class ParallelInference:
-    """Thread-safe inference front over a model's ``output``.
-
-    Usage::
-
-        pi = ParallelInference(net, mode=InferenceMode.BATCHED,
-                               batch_limit=32, max_wait_ms=5)
-        y = pi.output(x)         # callable from many threads
-        pi.shutdown()
-    """
-
-    def __init__(self, model, mode: str = InferenceMode.BATCHED,
-                 batch_limit: int = 32, max_wait_ms: float = 5.0,
-                 queue_limit: int = 256):
-        if mode not in (InferenceMode.SEQUENTIAL, InferenceMode.BATCHED):
-            raise ValueError(f"unknown inference mode {mode!r}")
-        self.model = model
-        self.mode = mode
-        self.batch_limit = int(batch_limit)
-        self.max_wait = max_wait_ms / 1e3
-        self._q: "queue.Queue[_Request]" = queue.Queue(maxsize=queue_limit)
-        self._lock = threading.Lock()
-        self._shutdown = threading.Event()
-        self._worker: Optional[threading.Thread] = None
-        if mode == InferenceMode.BATCHED:
-            self._worker = threading.Thread(target=self._collector,
-                                            daemon=True)
-            self._worker.start()
-
-    # ---- public -------------------------------------------------------------
-    def output(self, x) -> np.ndarray:
-        if self._shutdown.is_set():
-            raise RuntimeError("ParallelInference is shut down")
-        x = np.asarray(x)
-        in_shape = getattr(self.model.conf, "input_shape", None)
-        if in_shape is not None:
-            if x.ndim == len(in_shape):
-                x = x[None]  # single example convenience
-            if tuple(x.shape[1:]) != tuple(in_shape):
-                # reject HERE, in the offending caller's thread — a bad
-                # shape inside a coalesced batch would fail everyone
-                # sharing the np.concatenate
-                raise ValueError(
-                    f"input shape {tuple(x.shape[1:])} does not match model "
-                    f"input {tuple(in_shape)}")
-        if self.mode == InferenceMode.SEQUENTIAL:
-            with self._lock:
-                return np.asarray(self.model.output(x))
-        req = _Request(x)
-        self._q.put(req)
-        # re-checking wait: shutdown() can win the race between the check
-        # above and the put — the queue drain would then miss this request
-        # and a bare wait() would deadlock its caller
-        while not req.event.wait(timeout=0.2):
-            if self._shutdown.is_set():
-                raise RuntimeError(
-                    "ParallelInference shut down before the request was "
-                    "served")
-        if req.error is not None:
-            raise req.error
-        return req.result
-
-    def shutdown(self):
-        self._shutdown.set()
-        if self._worker:
-            self._worker.join(timeout=5)
-        # fail any request still queued — leaving them un-signaled would
-        # deadlock their callers on event.wait()
-        while True:
-            try:
-                req = self._q.get_nowait()
-            except queue.Empty:
-                break
-            req.error = RuntimeError("ParallelInference shut down before "
-                                     "the request was served")
-            req.event.set()
-
-    # ---- collector ----------------------------------------------------------
-    def _collector(self):
-        while not self._shutdown.is_set():
-            try:
-                first = self._q.get(timeout=0.05)
-            except queue.Empty:
-                continue
-            batch: List[_Request] = [first]
-            total = first.x.shape[0]
-            deadline = _now() + self.max_wait
-            while total < self.batch_limit:
-                remaining = deadline - _now()
-                if remaining <= 0:
-                    break
-                try:
-                    r = self._q.get(timeout=remaining)
-                    batch.append(r)
-                    total += r.x.shape[0]
-                except queue.Empty:
-                    break
-            self._run(batch, total)
-
-    def _run(self, batch: List[_Request], total: int):
-        try:
-            x = np.concatenate([r.x for r in batch], axis=0)
-            padded = _next_bucket(total)
-            if padded != total:  # bounded compiled-shape count
-                pad = np.zeros((padded - total,) + x.shape[1:], x.dtype)
-                x = np.concatenate([x, pad], axis=0)
-            with self._lock:
-                out = np.asarray(self.model.output(x))
-            i = 0
-            for r in batch:
-                n = r.x.shape[0]
-                r.result = out[i:i + n]
-                i += n
-                r.event.set()
-        except Exception as e:  # propagate to every waiter
-            for r in batch:
-                r.error = e
-                r.event.set()
-
-
-def _now() -> float:
-    import time
-    return time.perf_counter()
-
-
-def _next_bucket(n: int) -> int:
-    b = 1
-    while b < n:
-        b <<= 1
-    return b
+from .batcher import InferenceMode, ParallelInference  # noqa: F401
+from .engine import next_bucket as _next_bucket  # noqa: F401  (old name)

@@ -19,7 +19,7 @@ from typing import Optional
 
 import numpy as np
 
-from .inference import InferenceMode, ParallelInference
+from .batcher import InferenceMode, ParallelInference
 
 
 class JsonModelServer:
@@ -55,6 +55,10 @@ class JsonModelServer:
             def do_GET(self):
                 if self.path == "/health":
                     self._send(200, {"status": "ok"})
+                elif self.path == "/stats":
+                    # serving observability: request latency percentiles,
+                    # queue depth, bucket hits / compiles
+                    self._send(200, server.inference.stats())
                 else:
                     self._send(404, {"error": "unknown path"})
 
@@ -72,7 +76,10 @@ class JsonModelServer:
                         server.pre_processor.transform(ds)
                         x = ds.features
                     out = server.inference.output(x)
-                    self._send(200, {"output": np.asarray(out).tolist()})
+                    self._send(200, {"output":
+                                     [np.asarray(o).tolist() for o in out]
+                                     if isinstance(out, list)
+                                     else np.asarray(out).tolist()})
                 except Exception as e:
                     self._send(400, {"error": f"{type(e).__name__}: {e}"})
 

@@ -1,7 +1,7 @@
 """Training observability: stats collection → storage → writers
 (SURVEY.md §5 "Metrics / logging / observability", §2.5 deeplearning4j-ui)."""
 
-from .stats import StatsListener  # noqa: F401
+from .stats import ServingStatsListener, StatsListener  # noqa: F401
 from .storage import (FileStatsStorage, InMemoryStatsStorage,  # noqa: F401
                       RemoteUIStatsStorage, StatsStorage)
 from .tensorboard import TensorBoardStatsWriter  # noqa: F401

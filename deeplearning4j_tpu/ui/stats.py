@@ -173,3 +173,58 @@ class StatsListener(TrainingListener):
         self._prev_params = cur
         self._prev_iteration = iteration
         self.storage.put_record(record)
+
+
+class ServingStatsListener:
+    """Serving-side twin of :class:`StatsListener`: snapshots a
+    ``serving.ParallelInference`` / ``serving.InferenceEngine`` (anything
+    exposing ``stats() -> dict``) into the same ``StatsStorage`` plumbing
+    the training dashboard reads — per-request p50/p99 latency, queue
+    depth, coalesced batch sizes, and bucket-hit vs. compile counters
+    (a compile after warmup is the serving pager signal).
+
+    Pull one record with :meth:`report`, or ``start(interval_sec)`` a
+    daemon thread for a continuous series; records carry
+    ``type="serving"`` so storage consumers can split them from training
+    ``stats`` records.
+    """
+
+    def __init__(self, source, storage: Optional[StatsStorage] = None,
+                 session_id: Optional[str] = None):
+        self.source = source
+        self.storage = storage if storage is not None \
+            else InMemoryStatsStorage()
+        self.session_id = session_id or f"serve-{uuid.uuid4().hex[:8]}"
+        self._thread = None
+        self._stop = None
+
+    def report(self) -> dict:
+        record = {"session": self.session_id, "type": "serving",
+                  "time": time.time()}
+        try:
+            record.update(self.source.stats())
+        except Exception as e:  # stats must never kill serving
+            record["error"] = f"{type(e).__name__}: {e}"
+        self.storage.put_record(record)
+        return record
+
+    def start(self, interval_sec: float = 10.0) -> "ServingStatsListener":
+        import threading
+        if self._thread is not None:
+            return self
+        self._stop = threading.Event()
+
+        def pump():
+            while not self._stop.wait(interval_sec):
+                self.report()
+
+        self._thread = threading.Thread(target=pump, daemon=True,
+                                        name="ServingStatsListener")
+        self._thread.start()
+        return self
+
+    def stop(self):
+        if self._thread is not None:
+            self._stop.set()
+            self._thread.join(timeout=5)
+            self._thread = None

@@ -59,6 +59,44 @@ def test_async_iterator_propagates_errors():
         list(AsyncDataSetIterator(Bad([])))
 
 
+def test_async_iterator_consumer_raise_mid_epoch_rewinds(rng):
+    """Consumer raises mid-epoch while the producer is blocked on a full
+    queue: the base cursor must rewind to consumed-count (no silently
+    skipped prefetched batches) and the producer thread must exit within
+    the join timeout (data/dataset.py stop/rewind path)."""
+    import threading
+    import time
+
+    x = np.arange(40, dtype=np.float32).reshape(40, 1)
+    base = NumpyDataSetIterator(x, x.copy(), batch_size=2)  # 20 batches
+    it = AsyncDataSetIterator(base, queue_size=2)
+    before = {t.ident for t in threading.enumerate()}
+    consumed = 0
+    with pytest.raises(RuntimeError, match="consumer blew up"):
+        for ds in it:
+            consumed += 1
+            if consumed == 3:
+                # let the producer run ahead and block on the full queue,
+                # so the rewind actually has prefetched batches to undo
+                time.sleep(0.3)
+                raise RuntimeError("consumer blew up")
+    # cursor rewound to what was CONSUMED, not what was prefetched:
+    assert it.state()["consumed"] == 3
+    # ...so the next pass resumes at batch 3 (x[6:8]) exactly
+    nxt = next(iter(it))
+    np.testing.assert_array_equal(nxt.features, x[6:8])
+    # the producer thread exited within the join timeout (no leak): every
+    # thread spawned by the aborted pass is gone (the resumed pass above
+    # spawns-and-finishes its own; poll to let it drain too)
+    deadline = time.time() + 5.0
+    while time.time() < deadline:
+        leaked = {t.ident for t in threading.enumerate()} - before
+        if not leaked:
+            break
+        time.sleep(0.05)
+    assert not leaked
+
+
 def test_dataset_split_and_shuffle(rng):
     ds = DataSet(rng.normal(size=(10, 3)), rng.normal(size=(10, 2)))
     a, b = ds.split_test_and_train(7)

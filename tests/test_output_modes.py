@@ -1,0 +1,140 @@
+"""Regressions for the output() train-flag and compiled-cache staleness
+satellites (ISSUE 2): the cached inference function used to hardcode
+train=False — output(x, train=True) silently served eval mode — and the
+cache survived dtype-policy mutations, serving the old trace."""
+
+import numpy as np
+
+from deeplearning4j_tpu.nn.config import InputType, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.graph import ComputationGraph
+from deeplearning4j_tpu.nn.layers.core import (DenseLayer, DropoutLayer,
+                                               OutputLayer)
+from deeplearning4j_tpu.nn.model import MultiLayerNetwork
+from deeplearning4j_tpu.nn.updaters import Adam
+
+RNG = np.random.default_rng(11)
+
+
+def _dropout_net():
+    conf = (NeuralNetConfiguration.builder().seed(0)
+            .updater(Adam(learning_rate=0.01))
+            .input_type(InputType.feed_forward(10))
+            .list(DenseLayer(n_out=32, activation="relu"),
+                  DropoutLayer(rate=0.5),
+                  OutputLayer(n_out=4))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def test_output_train_flag_fires_dropout():
+    """train=True must actually run stochastic layers (the cached jit used
+    to hardcode train=False regardless of the argument)."""
+    net = _dropout_net()
+    x = RNG.normal(size=(16, 10)).astype(np.float32)
+    eval_out = net.output(x)
+    train_out = net.output(x, train=True)
+    # dropout fired: train-mode output differs from eval mode
+    assert np.abs(train_out - eval_out).max() > 1e-6
+    # rng is threaded per call (feed_forward-style): two train calls differ
+    assert np.abs(net.output(x, train=True) - train_out).max() > 1e-6
+    # eval path stays deterministic
+    np.testing.assert_array_equal(net.output(x), eval_out)
+
+
+def test_output_train_flag_graph():
+    conf = (NeuralNetConfiguration.builder().seed(0)
+            .updater(Adam(learning_rate=0.01))
+            .graph_builder()
+            .add_inputs("in")
+            .set_input_types(InputType.feed_forward(10))
+            .add_layer("d", DenseLayer(n_out=32, activation="relu"), "in")
+            .add_layer("drop", DropoutLayer(rate=0.5), "d")
+            .add_layer("out", OutputLayer(n_out=4), "drop")
+            .set_outputs("out").build())
+    g = ComputationGraph(conf).init()
+    x = RNG.normal(size=(16, 10)).astype(np.float32)
+    eval_out = g.output(x)
+    train_out = g.output(x, train=True)
+    assert np.abs(train_out - eval_out).max() > 1e-6
+    assert np.abs(g.output(x, train=True) - train_out).max() > 1e-6
+    np.testing.assert_array_equal(g.output(x), eval_out)
+
+
+def test_set_dtype_invalidates_cached_output():
+    """The compiled-trace cache bakes the conf dtype policy in; set_dtype
+    must drop it (the old trace would silently keep serving fp32)."""
+    net = _dropout_net()
+    x = RNG.normal(size=(4, 10)).astype(np.float32)
+    f32 = net.output(x)
+    compiles_f32 = net.inference_engine().stats()["compiles"]
+    net.set_dtype("BFLOAT16")
+    b16 = net.output(x)
+    # cache was dropped and the new policy actually compiled + served:
+    # same bucket shape, but the bf16 program is a NEW compile, and the
+    # old executables are gone (compiled_buckets restarts at 1)
+    st = net.inference_engine().stats()
+    assert st["compiles"] == compiles_f32 + 1
+    assert st["compiled_buckets"] == 1
+    # bf16 compute differs from the f32 trace (policy really applied)
+    assert np.abs(b16 - f32).max() > 1e-6
+    # masters stay fp32 under the 16-bit policy
+    assert all(a.dtype == np.float32
+               for a in [net.params["0"]["W"], net.params["2"]["W"]])
+
+
+def test_set_dtype_invalidates_graph_and_train_step():
+    conf = (NeuralNetConfiguration.builder().seed(0)
+            .updater(Adam(learning_rate=0.01))
+            .graph_builder()
+            .add_inputs("in")
+            .set_input_types(InputType.feed_forward(10))
+            .add_layer("d", DenseLayer(n_out=8, activation="tanh"), "in")
+            .add_layer("out", OutputLayer(n_out=4), "d")
+            .set_outputs("out").build())
+    g = ComputationGraph(conf).init()
+    x = RNG.normal(size=(4, 10)).astype(np.float32)
+    y = np.eye(4, dtype=np.float32)[RNG.integers(0, 4, 4)]
+    g.fit(x, y, epochs=1)
+    assert g._train_step is not None
+    f32 = g.output(x)
+    g.set_dtype("BFLOAT16")
+    # every compiled trace dropped at the mutation point
+    assert g._train_step is None and g._epoch_fn is None \
+        and g._train_output_fn is None
+    b16 = g.output(x)
+    assert np.abs(b16 - f32).max() > 1e-6
+    g.fit(x, y, epochs=1)  # retrains under the new policy without error
+
+
+def test_set_dtype_drops_rnn_stream_state():
+    """Streaming RNN carry captured under the old dtype policy must not
+    feed a retraced step after set_dtype."""
+    from deeplearning4j_tpu.nn.layers.recurrent import LSTM, RnnOutputLayer
+    conf = (NeuralNetConfiguration.builder().seed(0)
+            .updater(Adam(learning_rate=0.01))
+            .input_type(InputType.recurrent(5))
+            .list(LSTM(n_out=8), RnnOutputLayer(n_out=3))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    x = RNG.normal(size=(2, 4, 5)).astype(np.float32)
+    net.rnn_time_step(x)
+    assert net._rnn_stream
+    net.set_dtype("BFLOAT16")
+    assert net._rnn_stream is None and net._rnn_step_fn is None
+    out = net.rnn_time_step(x)  # fresh carry under the new policy
+    assert out.shape == (2, 4, 3)
+
+
+def test_invalidate_compiled_clears_every_cache():
+    net = _dropout_net()
+    x = RNG.normal(size=(4, 10)).astype(np.float32)
+    y = np.eye(4, dtype=np.float32)[RNG.integers(0, 4, 4)]
+    net.fit(x, y, epochs=1)
+    net.output(x)
+    net.output(x, train=True)
+    assert net._train_step is not None and net._train_output_fn is not None
+    eng = net.inference_engine()
+    assert eng.stats()["compiled_buckets"] >= 1
+    net._invalidate_compiled()
+    assert net._train_step is None and net._train_output_fn is None
+    assert eng.stats()["compiled_buckets"] == 0

@@ -1,0 +1,472 @@
+"""Serving subsystem: InferenceEngine bucketed AOT cache + ParallelInference
+dynamic micro-batching (ISSUE 2 tentpole). Covers bucket math, mask-exact
+unpadding (batch and sequence axes), the zero-post-warmup-recompile
+regression, mesh dispatch, futures semantics, and the stats plumbing."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nn.config import InputType, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.graph import ComputationGraph
+from deeplearning4j_tpu.nn.layers.core import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.layers.recurrent import LSTM, RnnOutputLayer
+from deeplearning4j_tpu.nn.model import MultiLayerNetwork
+from deeplearning4j_tpu.nn.updaters import Adam
+from deeplearning4j_tpu.serving import (InferenceEngine, InferenceMode,
+                                        ParallelInference, default_buckets,
+                                        next_bucket)
+from deeplearning4j_tpu.ui.stats import ServingStatsListener
+from deeplearning4j_tpu.ui.storage import InMemoryStatsStorage
+
+RNG = np.random.default_rng(7)
+
+
+def _mlp():
+    conf = (NeuralNetConfiguration.builder().seed(0)
+            .updater(Adam(learning_rate=0.01))
+            .input_type(InputType.feed_forward(6))
+            .list(DenseLayer(n_out=12, activation="tanh"),
+                  OutputLayer(n_out=3))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _lstm():
+    conf = (NeuralNetConfiguration.builder().seed(0)
+            .updater(Adam(learning_rate=0.01))
+            .input_type(InputType.recurrent(5))
+            .list(LSTM(n_out=8), RnnOutputLayer(n_out=3))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+# ---- bucket math ------------------------------------------------------------
+
+def test_next_bucket_powers_of_two():
+    assert [next_bucket(n) for n in (1, 2, 3, 5, 8, 9, 33)] == \
+        [1, 2, 4, 8, 8, 16, 64]
+    assert next_bucket(3, minimum=8) == 8
+    assert default_buckets(16) == [1, 2, 4, 8, 16]
+    assert default_buckets(16, minimum=4) == [4, 8, 16]
+
+
+# ---- engine: exactness + compile accounting ---------------------------------
+
+def test_engine_matches_unjitted_forward_across_ragged_sizes():
+    net = _mlp()
+    eng = net.inference_engine()
+    for n in (1, 3, 5, 8, 13, 21):
+        x = RNG.normal(size=(n, 6)).astype(np.float32)
+        got = net.output(x)
+        ref = np.asarray(net.feed_forward(x)[-1])
+        assert got.shape == (n, 3)
+        np.testing.assert_allclose(got, ref, rtol=2e-5, atol=1e-6)
+    st = eng.stats()
+    # 6 ragged sizes collapse onto 5 buckets (1,4,8,16,32)
+    assert st["compiled_buckets"] == 5
+    assert st["calls"] == 6
+
+
+def test_engine_zero_recompiles_after_warmup():
+    """The acceptance-criteria regression: after warmup() over the bucket
+    set, NO compile happens across ragged request sizes."""
+    net = _mlp()
+    eng = net.inference_engine()
+    eng.warmup([1, 2, 4, 8, 16, 32])
+    warm = eng.stats()["compiles"]
+    assert warm == 6
+    for n in (1, 2, 3, 5, 7, 9, 13, 17, 25, 31, 32):
+        net.output(RNG.normal(size=(n, 6)).astype(np.float32))
+    st = eng.stats()
+    assert st["compiles"] == warm, f"recompiled under traffic: {st}"
+    assert st["hits"] == 11
+
+
+def test_engine_normalizes_float64_requests():
+    net = _mlp()
+    eng = net.inference_engine()
+    net.output(RNG.normal(size=(4, 6)).astype(np.float32))
+    before = eng.stats()["compiles"]
+    net.output(RNG.normal(size=(4, 6)))  # np default float64
+    assert eng.stats()["compiles"] == before  # same bucket, no new program
+
+
+def test_engine_seq_bucketing_mask_exact_lstm():
+    """Sequence padding must be invisible: padded time steps are masked
+    through the recurrent stack and sliced off."""
+    net = _lstm()
+    eng = net.inference_engine()
+    for n, t in ((2, 3), (3, 7), (1, 13)):
+        x = RNG.normal(size=(n, t, 5)).astype(np.float32)
+        got = net.output(x)
+        ref = np.asarray(net.feed_forward(x)[-1])
+        assert got.shape == ref.shape
+        np.testing.assert_allclose(got, ref, rtol=2e-5, atol=1e-5)
+
+
+def test_engine_seq_warmup_needs_lengths_when_dynamic():
+    net = _lstm()
+    with pytest.raises(ValueError, match="dynamic sequence length"):
+        net.inference_engine().warmup([4])
+    net.inference_engine().warmup([4], seq_buckets=[8])
+    x = RNG.normal(size=(3, 6, 5)).astype(np.float32)  # pads to (4, 8, 5)
+    net.output(x)
+    st = net.inference_engine().stats()
+    assert st["compiles"] == 1 and st["hits"] == 1
+
+
+def test_engine_per_row_lengths():
+    """lengths= masks each row to its true T (the batcher's ragged-T
+    coalescing contract)."""
+    net = _lstm()
+    t_max = 6
+    xs = [RNG.normal(size=(1, t, 5)).astype(np.float32) for t in (3, 6)]
+    refs = [np.asarray(net.feed_forward(x)[-1]) for x in xs]
+    stacked = np.concatenate(
+        [np.concatenate([x, np.zeros((1, t_max - x.shape[1], 5),
+                                     np.float32)], axis=1) for x in xs])
+    out = net.inference_engine().output(stacked, lengths=np.array([3, 6]))
+    np.testing.assert_allclose(out[0, :3], refs[0][0], rtol=2e-5, atol=1e-5)
+    np.testing.assert_allclose(out[1], refs[1][0], rtol=2e-5, atol=1e-5)
+
+
+def test_engine_graph_model():
+    conf = (NeuralNetConfiguration.builder().seed(0)
+            .updater(Adam(learning_rate=0.01))
+            .graph_builder()
+            .add_inputs("in")
+            .set_input_types(InputType.feed_forward(6))
+            .add_layer("d", DenseLayer(n_out=12, activation="tanh"), "in")
+            .add_layer("out", OutputLayer(n_out=3), "d")
+            .set_outputs("out").build())
+    g = ComputationGraph(conf).init()
+    g.inference_engine().warmup([1, 2, 4, 8])
+    for n in (2, 5, 7):
+        x = RNG.normal(size=(n, 6)).astype(np.float32)
+        got = g.output(x)
+        ref = np.asarray(g.feed_forward(x, train=False)["out"])
+        np.testing.assert_allclose(got, ref, rtol=2e-5, atol=1e-6)
+    st = g.inference_engine().stats()
+    assert st["compiles"] == 4  # warmup only: 2,5,7 pad onto 2,8,8
+
+
+def test_engine_mesh_sharded_dispatch():
+    """NamedSharding placement over the 'data' axis of the 8-device test
+    mesh: bucket floor rises to the axis size, results stay exact."""
+    from deeplearning4j_tpu.parallel import make_mesh
+    net = _mlp()
+    eng = InferenceEngine(net, mesh=make_mesh())
+    assert eng.min_bucket == 8
+    eng.warmup([8, 16])
+    for n in (3, 11):
+        x = RNG.normal(size=(n, 6)).astype(np.float32)
+        np.testing.assert_allclose(eng.output(x),
+                                   np.asarray(net.feed_forward(x)[-1]),
+                                   rtol=2e-5, atol=1e-5)
+    assert eng.stats()["compiles"] == 2
+
+
+def test_engine_survives_params_placement_change():
+    """ParallelWrapper.fit leaves replicated NamedSharding params behind;
+    the meshless engine must key the new placement into its cache (AOT
+    executables are sharding-strict) instead of erroring or serving
+    device-0 copies."""
+    from deeplearning4j_tpu.data.dataset import DataSet
+    from deeplearning4j_tpu.parallel import ParallelWrapper
+    net = _mlp()
+    x = RNG.normal(size=(16, 6)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[RNG.integers(0, 3, 16)]
+    before = net.output(x[:4])  # compiles for single-device placement
+    c0 = net.inference_engine().stats()["compiles"]
+    ParallelWrapper(net).fit(DataSet(x, y), epochs=1)
+    after = net.output(x[:4])   # params now NamedSharding-replicated
+    assert net.inference_engine().stats()["compiles"] == c0 + 1
+    assert np.abs(after - before).max() > 1e-7  # trained params served
+    np.testing.assert_allclose(after, np.asarray(net.feed_forward(x[:4])[-1]),
+                               rtol=2e-5, atol=1e-5)
+    net.output(x[:4])  # placement stable -> no further compiles
+    assert net.inference_engine().stats()["compiles"] == c0 + 1
+
+
+def test_parallel_wrapper_serving_engine():
+    """Train data-parallel, serve the same mesh: ParallelWrapper exposes
+    an engine sharded over its 'data' axis."""
+    from deeplearning4j_tpu.data.dataset import DataSet
+    from deeplearning4j_tpu.parallel import ParallelWrapper
+    net = _mlp()
+    pw = ParallelWrapper(net)
+    x = RNG.normal(size=(16, 6)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[RNG.integers(0, 3, 16)]
+    pw.fit(DataSet(x, y), epochs=2)
+    eng = pw.serving_engine()
+    assert eng.min_bucket == 8  # 8-device test mesh
+    got = eng.output(x[:5])
+    np.testing.assert_allclose(got, np.asarray(net.feed_forward(x[:5])[-1]),
+                               rtol=2e-5, atol=1e-5)
+
+
+def test_engine_preserves_tensor_parallel_sharding():
+    """Serving a TP-trained model over the same mesh must NOT gather the
+    model-axis-sharded leaves onto every device (that would defeat TP and
+    can OOM a large model) — they stay sharded, results stay exact."""
+    from jax.sharding import NamedSharding
+    from deeplearning4j_tpu.data.dataset import DataSet
+    from deeplearning4j_tpu.parallel import ParallelWrapper
+    from deeplearning4j_tpu.parallel.data_parallel import make_dp_tp_mesh
+    conf = (NeuralNetConfiguration.builder().seed(0)
+            .updater(Adam(learning_rate=0.01))
+            .input_type(InputType.feed_forward(6))
+            .list(DenseLayer(n_out=16, activation="tanh"),
+                  OutputLayer(n_out=4))  # dims divisible by model axis
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    mesh = make_dp_tp_mesh(4, 2)
+    pw = ParallelWrapper(net, mesh=mesh, model_axis="model")
+    x = RNG.normal(size=(16, 6)).astype(np.float32)
+    y = np.eye(4, dtype=np.float32)[RNG.integers(0, 4, 16)]
+    pw.fit(DataSet(x, y), epochs=1)
+    w = net.params["0"]["W"]
+    assert isinstance(w.sharding, NamedSharding) and \
+        "model" in str(w.sharding.spec)  # TP actually sharded the kernel
+    eng = pw.serving_engine()
+    got = eng.output(x[:5])
+    np.testing.assert_allclose(got, np.asarray(net.feed_forward(x[:5])[-1]),
+                               rtol=2e-5, atol=1e-5)
+    placed_w = eng._place_params()[0]["0"]["W"]
+    assert "model" in str(placed_w.sharding.spec), \
+        "TP leaf was gathered/replicated by the serving engine"
+
+
+def test_engine_params_update_without_recompile():
+    """A fit() step rebinds params; the engine must serve the NEW values
+    from the SAME executable."""
+    from deeplearning4j_tpu.data.dataset import DataSet
+    net = _mlp()
+    x = RNG.normal(size=(8, 6)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[RNG.integers(0, 3, 8)]
+    before = net.output(x)
+    compiles = net.inference_engine().stats()["compiles"]
+    net.fit(DataSet(x, y), epochs=3)
+    after = net.output(x)
+    assert net.inference_engine().stats()["compiles"] == compiles
+    assert np.abs(after - before).max() > 1e-6  # new params actually served
+    np.testing.assert_allclose(after, np.asarray(net.feed_forward(x)[-1]),
+                               rtol=2e-5, atol=1e-5)
+
+
+# ---- ParallelInference ------------------------------------------------------
+
+def test_batcher_coalesces_concurrent_requests():
+    net = _mlp()
+    pi = ParallelInference(net, mode=InferenceMode.BATCHED,
+                           max_batch_size=64, max_wait_ms=20)
+    xs = [RNG.normal(size=(3, 6)).astype(np.float32) for _ in range(16)]
+    refs = [np.asarray(net.feed_forward(x)[-1]) for x in xs]
+    results = [None] * 16
+
+    def call(i):
+        results[i] = pi.output(xs[i])
+
+    threads = [threading.Thread(target=call, args=(i,)) for i in range(16)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    st = pi.stats()
+    pi.shutdown()
+    for got, ref in zip(results, refs):
+        np.testing.assert_allclose(got, ref, rtol=2e-5, atol=1e-5)
+    assert st["requests"] == 16
+    assert st["batches"] < 16  # actually coalesced
+    assert st["latency_ms_p50"] is not None
+    assert st["latency_ms_p99"] >= st["latency_ms_p50"]
+
+
+def test_batcher_futures_api():
+    net = _mlp()
+    with ParallelInference(net, max_batch_size=8, max_wait_ms=5) as pi:
+        xs = [RNG.normal(size=(2, 6)).astype(np.float32) for _ in range(4)]
+        futs = [pi.submit(x) for x in xs]
+        for f, x in zip(futs, xs):
+            np.testing.assert_allclose(
+                f.result(timeout=30), np.asarray(net.feed_forward(x)[-1]),
+                rtol=2e-5, atol=1e-5)
+
+
+def test_batcher_ragged_seq_requests():
+    """Concurrent requests with different T coalesce into one padded call;
+    each caller gets its own T back, mask-exact."""
+    net = _lstm()
+    with ParallelInference(net, max_batch_size=64, max_wait_ms=20) as pi:
+        xs = [RNG.normal(size=(2, t, 5)).astype(np.float32)
+              for t in (3, 5, 9, 4)]
+        refs = [np.asarray(net.feed_forward(x)[-1]) for x in xs]
+        futs = [pi.submit(x) for x in xs]
+        for f, ref in zip(futs, refs):
+            got = f.result(timeout=30)
+            assert got.shape == ref.shape
+            np.testing.assert_allclose(got, ref, rtol=2e-5, atol=1e-4)
+
+
+def test_batcher_oversized_request_chunks_onto_warmed_buckets():
+    """A request larger than max_batch_size must not overshoot the warmed
+    bucket set (compile under traffic): it splits into capped chunks and
+    rejoins."""
+    net = _mlp()
+    with ParallelInference(net, max_batch_size=8, max_wait_ms=2,
+                           warmup=True) as pi:
+        warm = pi.stats()["engine"]["compiles"]
+        x = RNG.normal(size=(21, 6)).astype(np.float32)  # 3 chunks: 8+8+5
+        got = pi.output(x)
+        assert got.shape == (21, 3)
+        np.testing.assert_allclose(got, np.asarray(net.feed_forward(x)[-1]),
+                                   rtol=2e-5, atol=1e-5)
+        assert pi.stats()["engine"]["compiles"] == warm
+
+
+def test_batcher_sequential_mode():
+    net = _mlp()
+    pi = ParallelInference(net, mode=InferenceMode.SEQUENTIAL)
+    x = RNG.normal(size=(4, 6)).astype(np.float32)
+    np.testing.assert_allclose(pi.output(x),
+                               np.asarray(net.feed_forward(x)[-1]),
+                               rtol=2e-5, atol=1e-6)
+    assert pi.stats()["batches"] == 1
+    pi.shutdown()
+
+
+def test_batcher_single_example_and_bad_shape():
+    net = _mlp()
+    with ParallelInference(net, max_wait_ms=2) as pi:
+        one = pi.output(RNG.normal(size=(6,)).astype(np.float32))
+        assert one.shape == (1, 3)
+        with pytest.raises(ValueError, match="does not match"):
+            pi.output(np.zeros((2, 7), np.float32))
+
+
+def test_batcher_legacy_batch_limit_alias():
+    net = _mlp()
+    pi = ParallelInference(net, batch_limit=16, max_wait_ms=2)
+    assert pi.max_batch_size == 16
+    pi.shutdown()
+
+
+def test_batcher_shutdown_fails_pending():
+    net = _mlp()
+    pi = ParallelInference(net, max_wait_ms=1)
+    pi.shutdown()
+    with pytest.raises(RuntimeError, match="shut down"):
+        pi.output(np.zeros((1, 6), np.float32))
+
+
+def test_batcher_sequential_multi_output_graph():
+    """SEQUENTIAL mode must return the list a multi-output graph produces
+    (it used to np.asarray the list, stacking or raising)."""
+    conf = (NeuralNetConfiguration.builder().seed(0)
+            .updater(Adam(learning_rate=0.01))
+            .graph_builder()
+            .add_inputs("in")
+            .set_input_types(InputType.feed_forward(6))
+            .add_layer("d", DenseLayer(n_out=12, activation="tanh"), "in")
+            .add_layer("o1", OutputLayer(n_out=3), "d")
+            .add_layer("o2", OutputLayer(n_out=5), "d")  # different width
+            .set_outputs("o1", "o2").build())
+    g = ComputationGraph(conf).init()
+    x = RNG.normal(size=(4, 6)).astype(np.float32)
+    with ParallelInference(g, mode=InferenceMode.SEQUENTIAL) as pi:
+        out = pi.output(x)
+    assert isinstance(out, list) and len(out) == 2
+    assert out[0].shape == (4, 3) and out[1].shape == (4, 5)
+
+
+def test_set_dtype_invalidates_external_engines():
+    """Engines built OUTSIDE model.inference_engine() (e.g.
+    ParallelWrapper.serving_engine) must also be invalidated at the
+    model's mutation points — they self-register weakly."""
+    from deeplearning4j_tpu.parallel import ParallelWrapper, make_mesh
+    net = _mlp()
+    eng = ParallelWrapper(net, mesh=make_mesh()).serving_engine()
+    x = RNG.normal(size=(4, 6)).astype(np.float32)
+    eng.output(x)
+    assert eng.stats()["compiled_buckets"] == 1
+    net.set_dtype("BFLOAT16")
+    assert eng.stats()["compiled_buckets"] == 0  # stale executables gone
+    eng.output(x)  # recompiles under the new policy without error
+
+
+# ---- observability ----------------------------------------------------------
+
+def test_serving_stats_listener_records():
+    net = _mlp()
+    storage = InMemoryStatsStorage()
+    with ParallelInference(net, max_wait_ms=2) as pi:
+        pi.output(RNG.normal(size=(3, 6)).astype(np.float32))
+        lst = ServingStatsListener(pi, storage=storage)
+        rec = lst.report()
+    assert rec["type"] == "serving"
+    assert rec["requests"] == 1
+    assert rec["engine"]["compiles"] >= 1
+    stored = storage.get_records(lst.session_id)
+    assert len(stored) == 1 and stored[0]["type"] == "serving"
+
+
+def test_json_server_stats_endpoint():
+    import json
+    import urllib.request
+    from deeplearning4j_tpu.serving import JsonModelServer
+    net = _mlp()
+    with JsonModelServer(net) as srv:
+        x = RNG.normal(size=(2, 6)).astype(np.float32)
+        body = json.dumps({"data": x.tolist()}).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{srv.port}/predict", data=body,
+            headers={"Content-Type": "application/json"})
+        out = json.loads(urllib.request.urlopen(req).read())
+        assert np.asarray(out["output"]).shape == (2, 3)
+        st = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/stats").read())
+        assert st["requests"] == 1 and "engine" in st
+
+
+# ---- open-loop load (tier-2) ------------------------------------------------
+
+@pytest.mark.slow
+def test_batcher_open_loop_ragged_load():
+    """Open-loop ragged-size load from many threads: every request served
+    exactly, zero compiles after warmup, sane latency accounting."""
+    net = _mlp()
+    net.inference_engine().warmup([1, 2, 4, 8, 16, 32, 64])
+    warm = net.inference_engine().stats()["compiles"]
+    pi = ParallelInference(net, max_batch_size=64, max_wait_ms=2)
+    sizes = RNG.integers(1, 9, 200)
+    xs = [RNG.normal(size=(int(s), 6)).astype(np.float32) for s in sizes]
+    refs = [np.asarray(net.feed_forward(x)[-1]) for x in xs]
+    results = [None] * len(xs)
+    idx = iter(range(len(xs)))
+    lock = threading.Lock()
+
+    def worker():
+        while True:
+            with lock:
+                i = next(idx, None)
+            if i is None:
+                return
+            results[i] = pi.output(xs[i])
+            time.sleep(0.001)  # open loop: arrivals keep coming
+
+    threads = [threading.Thread(target=worker) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    st = pi.stats()
+    pi.shutdown()
+    for got, ref in zip(results, refs):
+        np.testing.assert_allclose(got, ref, rtol=2e-5, atol=1e-5)
+    assert st["requests"] == 200
+    assert st["engine"]["compiles"] == warm, \
+        f"recompiled under load: {st['engine']}"
+    assert st["latency_ms_p99"] is not None
