@@ -268,6 +268,13 @@ def bench_bert():
 
     rng = np.random.default_rng(0)
     sd = TensorflowFrameworkImporter.import_graph_def(gd, trainable=True)
+    # r8: rewrite the imported batch_matmul->scale->mask-add->softmax->
+    # batch_matmul chains to the fused flash-attention op (ISSUE 3) —
+    # the kernel reaches the flagship bench without touching importer code
+    from deeplearning4j_tpu.autodiff.fusion import fuse_attention
+    from deeplearning4j_tpu.ops import flash_attention as fa
+    fa.reset_counters()
+    fusion_report = fuse_attention(sd)
     hidden = sd._vars[oname]
     pooled = hidden.mean(axis=1)
     w = sd.var("cls_W", rng.normal(0, 0.02, (cfg.hidden_size, 2))
@@ -377,6 +384,9 @@ def bench_bert():
         "final_loss": round(runs16[0][1], 4),
         "params": int(sum(int(np.prod(v.shape))
                           for v in st16["tv"].values())),
+        "attention_sites_fused": fusion_report.matched,
+        "attention_sites_unmatched": fusion_report.unmatched,
+        "attention_dispatch": fa.counters(),
     }
 
 
@@ -500,6 +510,123 @@ def bench_sharded_update():
                        + out.stderr[-400:])
 
 
+def bench_flash_attention():
+    """Flash-attention metric (ISSUE 3): fused Pallas kernel vs the
+    quadratic einsum path, seq-length sweep 128-2048, TRAIN-step shaped
+    work (forward + backward via the kernel's custom VJP), p50/p99 via
+    ``_percentiles``. Headline value = fused speedup at seq 1024.
+
+    On TPU both paths are timed compiled; off-TPU (CPU tier/verify runs)
+    the kernel only exists in Pallas interpret mode, which is a
+    correctness vehicle, not a perf one — the metric is still emitted,
+    recording interpret-mode parity numbers and the dispatch counters so
+    the driver sees the kernel path exercised (value stays null).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.ops import flash_attention as fa
+
+    rng = np.random.default_rng(0)
+    on_tpu = jax.default_backend() == "tpu"
+    fa.reset_counters()
+
+    def qkv(B, H, T, d, dtype):
+        mk = lambda: jnp.asarray(
+            rng.normal(size=(B, H, T, d)) * 0.5, dtype=dtype)
+        mask = np.ones((B, T), np.float32)
+        mask[:, T - T // 8:] = 0.0  # ragged tail: exercise the key-bias path
+        bias = jnp.where(jnp.asarray(mask)[:, None, None, :] > 0, 0.0,
+                         np.float32(np.finfo(np.float32).min))
+        return mk(), mk(), mk(), bias
+
+    if not on_tpu:
+        # interpret-mode parity only (kernel compiled per-shape by the
+        # Pallas interpreter: keep it small and single-shape)
+        B, H, T, d = 2, 4, 256, 64
+        q, k, v, bias = qkv(B, H, T, d, jnp.float32)
+        old = fa.set_mode("force")
+        try:
+            fused = fa.attention(q, k, v, bias)
+            gf = jax.grad(lambda x: jnp.sum(fa.attention(x, k, v, bias)))(q)
+        finally:
+            fa.set_mode(old)
+        ref = fa.reference_attention(q, k, v, bias)
+        gr = jax.grad(
+            lambda x: jnp.sum(fa.reference_attention(x, k, v, bias)))(q)
+        return {
+            "metric": "flash_attention",
+            "value": None,
+            "unit": "x_fused_vs_einsum_step_time_at_seq1024",
+            "note": "CPU bench env: interpret-mode parity only (no kernel "
+                    "timing off-TPU); speedup measured on the real chip",
+            "fwd_max_abs_diff": float(jnp.max(jnp.abs(fused - ref))),
+            "grad_max_abs_diff": float(jnp.max(jnp.abs(gf - gr))),
+            "parity_shape": [B, H, T, d],
+            "dispatch_counters": fa.counters(),
+        }
+
+    B, H, d = 4, 12, 64
+    dtype = jnp.bfloat16
+    rows = []
+
+    def time_fn(fn, *args):
+        # fn forces a host readback each call (block_until_ready is
+        # unreliable on this PJRT plugin — same posture as the other
+        # benches); 12 samples feed min + p50/p99
+        fn(*args)  # compile + settle
+        samples = []
+        for _ in range(12):
+            t0 = time.perf_counter()
+            fn(*args)
+            samples.append(time.perf_counter() - t0)
+        return samples
+
+    for T in (128, 256, 512, 1024, 2048):
+        q, k, v, bias = qkv(B, H, T, d, dtype)
+
+        def train_shaped(path_fn):
+            def loss(q_, k_, v_):
+                return jnp.sum(
+                    path_fn(q_, k_, v_, bias).astype(jnp.float32))
+            g = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+
+            def run(q_, k_, v_):
+                gs = g(q_, k_, v_)
+                return float(jnp.sum(gs[0].astype(jnp.float32)))
+            return run
+
+        fused_fn = train_shaped(fa.flash_attention)
+        ref_fn = train_shaped(fa.reference_attention)
+        t_f = time_fn(fused_fn, q, k, v)
+        t_r = time_fn(ref_fn, q, k, v)
+        f50, f99 = _percentiles([t * 1e3 for t in t_f])
+        r50, r99 = _percentiles([t * 1e3 for t in t_r])
+        rows.append({"seq": T,
+                     "fused_ms_min": round(min(t_f) * 1e3, 3),
+                     "fused_ms_p50": round(f50, 3),
+                     "fused_ms_p99": round(f99, 3),
+                     "einsum_ms_min": round(min(t_r) * 1e3, 3),
+                     "einsum_ms_p50": round(r50, 3),
+                     "einsum_ms_p99": round(r99, 3),
+                     "speedup": round(min(t_r) / min(t_f), 3)})
+
+    # dispatch sanity on the layer entry point (counters in the artifact)
+    q, k, v, bias = qkv(B, H, 1024, d, dtype)
+    fa.attention(q, k, v, bias)
+    by_seq = {r["seq"]: r["speedup"] for r in rows}
+    return {
+        "metric": "flash_attention",
+        "value": by_seq.get(1024),
+        "unit": "x_fused_vs_einsum_step_time_at_seq1024",
+        "model": f"MHA fwd+bwd, B={B} H={H} d={d}, bf16, ragged key mask, "
+                 "custom-VJP flash kernel vs f32-softmax einsum",
+        "sweep": rows,
+        "speedup_at_2048": by_seq.get(2048),
+        "dispatch_counters": fa.counters(),
+    }
+
+
 def bench_parallel_inference():
     """Serving metric (ISSUE 2): open-loop ragged-size synthetic load
     against (a) the naive per-request path — one jitted forward call +
@@ -618,6 +745,14 @@ if __name__ == "__main__":
         lines.append({
             "metric": "sharded_update", "value": None,
             "unit": "x_per_device_updater_bytes_reduction",
+            "error": f"{type(e).__name__}: {e}"[:300]})
+    _emit(lines)
+    try:
+        lines.append(bench_flash_attention())
+    except Exception as e:
+        lines.append({
+            "metric": "flash_attention", "value": None,
+            "unit": "x_fused_vs_einsum_step_time_at_seq1024",
             "error": f"{type(e).__name__}: {e}"[:300]})
     _emit(lines)
     try:

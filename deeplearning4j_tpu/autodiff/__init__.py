@@ -1,7 +1,9 @@
-"""Graph autodiff layer (SameDiff equivalent) — see samediff.py."""
+"""Graph autodiff layer (SameDiff equivalent) — see samediff.py; graph
+rewrite passes (attention fusion) in fusion.py."""
 
 from .samediff import (ARRAY, CONSTANT, PLACEHOLDER, VARIABLE, SameDiff,
                        SDVariable)
+from .fusion import FusionReport, fuse_attention
 
 __all__ = ["SameDiff", "SDVariable", "VARIABLE", "PLACEHOLDER", "CONSTANT",
-           "ARRAY"]
+           "ARRAY", "fuse_attention", "FusionReport"]

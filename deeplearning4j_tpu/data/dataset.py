@@ -65,6 +65,26 @@ class DataSet:
         return self
 
 
+def _device_put_batch(ds: DataSet, sharding=None) -> DataSet:
+    """Shallow-copied DataSet with every array moved to device (onto
+    ``sharding`` when given). jax is imported lazily so the data layer
+    stays importable without it."""
+    import jax
+
+    def put(a):
+        if a is None:
+            return None
+        return jax.device_put(a, sharding) if sharding is not None \
+            else jax.device_put(a)
+
+    out = ds.copy()
+    out.features = put(ds.features)
+    out.labels = put(ds.labels)
+    out.features_mask = put(ds.features_mask)
+    out.labels_mask = put(ds.labels_mask)
+    return out
+
+
 class MultiDataSet:
     """Multi-input/multi-output minibatch (nd4j ``MultiDataSet``†) — the
     ComputationGraph feeding format. Every field is a LIST of arrays (or
@@ -310,11 +330,22 @@ class AsyncDataSetIterator(DataSetIterator):
     the NEXT pass yields zero batches (the remainder) and the pass after
     that yields the following epoch — consumers that count epochs should
     abandon via ``reset()`` when they mean "start over".
+
+    ``device_prefetch=True`` additionally runs ``jax.device_put`` on each
+    batch in the producer thread (onto ``sharding`` when given — e.g. the
+    step's NamedSharding — else the default device), so the H2D transfer
+    overlaps device compute in host-driven ``fit`` loops instead of
+    serializing inside the jitted step's implicit device_put. Values are
+    bit-identical to plain iteration (tested); any pre_processor runs in
+    the producer BEFORE the transfer so it still sees host numpy arrays.
     """
 
-    def __init__(self, base: DataSetIterator, queue_size: int = 4):
+    def __init__(self, base: DataSetIterator, queue_size: int = 4,
+                 device_prefetch: bool = False, sharding=None):
         self._base = base
         self._qsize = queue_size
+        self._device_prefetch = bool(device_prefetch)
+        self._sharding = sharding
         # restorable cursor: the producer thread runs AHEAD of the consumer
         # (queue depth), so the base iterator's own cursor over-reports what
         # the trainer has actually consumed. We snapshot the base state at
@@ -362,6 +393,13 @@ class AsyncDataSetIterator(DataSetIterator):
         def produce():
             try:
                 for ds in self._base:
+                    if self._device_prefetch:
+                        # preprocess on host FIRST (normalizers expect
+                        # numpy), then ship — the copy also protects
+                        # stored batches from in-place transforms
+                        if self.pre_processor is not None:
+                            ds = self._pp(ds.copy())
+                        ds = _device_put_batch(ds, self._sharding)
                     if not put(ds):
                         return
             except BaseException as e:  # propagate into consumer
@@ -391,9 +429,11 @@ class AsyncDataSetIterator(DataSetIterator):
                     continue
                 self._consumed += 1
                 # copy-then-transform: the base may re-yield stored batch
-                # objects (ListDataSetIterator), which must not be mutated
+                # objects (ListDataSetIterator), which must not be mutated.
+                # Under device_prefetch the producer already preprocessed.
                 yield self._pp(item.copy()) \
-                    if self.pre_processor is not None else item
+                    if self.pre_processor is not None \
+                    and not self._device_prefetch else item
         finally:
             if not clean:
                 # consumer abandoned mid-epoch (break / exception / error):

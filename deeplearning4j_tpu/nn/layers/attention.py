@@ -6,11 +6,18 @@ LearnedSelfAttentionLayer,RecurrentAttentionLayer}.java`` and the
 ``AttentionVertex``† per SURVEY.md §2.4/§2.7; reference mount was empty,
 citations upstream-relative, unverified).
 
-All ride ``ops.nnops.dot_product_attention`` (fused scaled-dot-product —
-XLA fuses the softmax chain; the quadratic-attention parity bar of §2.7,
-with ring attention living in parallel/sequence.py as the beyond-parity
-long-context path). Layout [B, T, F]; multi-head reshapes to [B, H, T, hs].
-Per-timestep masks flow as key masks so padded steps get zero weight.
+The multi-head layers (SelfAttentionLayer, LearnedSelfAttentionLayer) ride
+``ops.flash_attention.attention`` — the tiled Pallas flash kernel on TPU
+when the shapes tile (online softmax, scores never leave VMEM), falling
+back to the quadratic einsum reference path elsewhere.
+RecurrentAttentionLayer is a different shape entirely (a scan whose step
+attends with h_{t-1} as the query — one [B, T] score row per step, nothing
+to tile) and keeps its per-step einsum. ALL paths, the recurrent one
+included, upcast scores to f32 before softmax — the kernel's accumulator
+precision, and the bf16 dtype-policy numerics fix. Ring attention lives in
+parallel/sequence.py as the beyond-parity long-context path. Layout
+[B, T, F]; multi-head reshapes to [B, H, T, hs]. Per-timestep masks flow
+as key masks (additive finfo.min bias) so padded steps get zero weight.
 """
 
 from __future__ import annotations
@@ -20,7 +27,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
-from ...ops import nnops
+from ...ops import flash_attention as _fa
 from ...ops.math import precision_for
 from .. import weights as _winit
 from .base import Layer, layer
@@ -37,12 +44,12 @@ def _heads_join(x):
     return x.transpose(0, 2, 1, 3).reshape(B, T, H * hs)
 
 
-def _key_mask(mask, like):
-    """[B, T] keep-mask -> additive attention bias broadcastable to
-    [B, H, Tq, Tk]."""
+def _key_mask(mask):
+    """[B, T] keep-mask -> additive attention bias [B, 1, 1, Tk] (f32 —
+    scores are accumulated in f32 on both attention paths)."""
     if mask is None:
         return None
-    neg = jnp.asarray(jnp.finfo(like.dtype).min, like.dtype)
+    neg = jnp.asarray(jnp.finfo(jnp.float32).min, jnp.float32)
     return jnp.where(mask[:, None, None, :] > 0, 0.0, neg)
 
 
@@ -54,15 +61,7 @@ def _mha(x_q, x_kv, params, n_heads, mask):
     q = _heads_split(proj(x_q, params["Wq"], params.get("bq")), n_heads)
     k = _heads_split(proj(x_kv, params["Wk"], params.get("bk")), n_heads)
     v = _heads_split(proj(x_kv, params["Wv"], params.get("bv")), n_heads)
-    scale = 1.0 / jnp.sqrt(jnp.asarray(q.shape[-1], q.dtype))
-    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k,
-                        precision=precision_for(q, k)) * scale
-    bias = _key_mask(mask, scores)
-    if bias is not None:
-        scores = scores + bias
-    att = jax.nn.softmax(scores, axis=-1)
-    y = jnp.einsum("bhqk,bhkd->bhqd", att, v,
-                   precision=precision_for(att, v))
+    y = _fa.attention(q, k, v, bias=_key_mask(mask))
     y = _heads_join(y)
     return proj(y, params["Wo"], params.get("bo"))
 
@@ -181,19 +180,21 @@ class RecurrentAttentionLayer(Layer):
         act = _act.get(self.activation)
         B, T, F = x.shape
         u = self.n_out
-        neg = jnp.finfo(x.dtype).min
+        neg = jnp.finfo(jnp.float32).min
 
         def step(h, inp):
             x_t, m_t = inp
-            # attention over the whole sequence, query = h_{t-1}
+            # attention over the whole sequence, query = h_{t-1}; scores
+            # and softmax in f32 (same upcast policy as _mha / the kernel)
             q = jnp.dot(h, params["Wa"],
                         precision=precision_for(h, params["Wa"]))  # [B,F]
             scores = jnp.einsum("bf,btf->bt", q, x,
-                                precision=precision_for(q, x))
-            scores = scores / jnp.sqrt(jnp.asarray(F, x.dtype))
+                                precision=precision_for(q, x),
+                                preferred_element_type=jnp.float32)
+            scores = scores / jnp.sqrt(jnp.asarray(F, jnp.float32))
             if mask is not None:
                 scores = jnp.where(mask > 0, scores, neg)
-            w = jax.nn.softmax(scores, axis=-1)
+            w = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
             ctx = jnp.einsum("bt,btf->bf", w, x,
                              precision=precision_for(w, x))
             h_new = act(jnp.dot(x_t, params["Wx"],

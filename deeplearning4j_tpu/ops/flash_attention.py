@@ -1,0 +1,548 @@
+"""Tiled Pallas TPU flash attention + the framework's attention dispatch.
+
+Attention was the one hot path the kernel layer had not touched:
+``nn/layers/attention.py`` materialized the full [B,H,Tq,Tk] score matrix
+through einsum+softmax, and the TF-imported BERT path runs the same
+``batch_matmul -> scale -> mask-add -> softmax -> batch_matmul`` chain
+through ``autodiff/samediff.py``. XLA fuses the softmax *chain* but still
+round-trips the quadratic scores tensor through HBM in both forward and
+backward — the exact fusion the TVM line of work (PAPERS.md) says must be
+done by hand. This module is that hand fusion:
+
+- :func:`flash_attention` — the raw fused op. Online-softmax forward over a
+  (batch*heads, q-blocks, kv-blocks) grid with f32 running max/sum
+  accumulators in VMEM scratch; kv is the innermost ("arbitrary") grid
+  dimension so the scores tile never leaves VMEM. A custom VJP recomputes
+  p = exp(s - m)/l per tile in the backward (two kernels: dq, and dk/dv),
+  saving only the per-row logsumexp — carried as its two pieces (running
+  max m, running sum l) so a finfo.min mask bias can't absorb log(l) —
+  plus the output, for di = sum(o*do). Training steps benefit, not just
+  serving.
+- :func:`reference_attention` — the quadratic einsum path, scores upcast to
+  f32 before softmax (matching the kernel's f32 accumulators; this is also
+  the numerics fix for the layers' bf16 dtype policy).
+- :func:`attention` — the dispatcher the layers and the SameDiff fused op
+  ride: routes to the kernel on TPU (or in Pallas interpret mode when
+  forced, so the CPU tier-1 suite exercises the real kernel code) when the
+  shapes tile and the bias is key-reducible, else falls back to the
+  reference path. Every routing decision bumps a counter
+  (:func:`counters`) so a silent fallback is visible in tests and bench.
+
+Numerics contract (kernel == reference at f32 atol ~1e-5): s = (q . k^T) *
+scale + bias computed in f32; softmax in f32; p cast to the value dtype for
+the p@v matmul with f32 accumulation; output cast back to the input dtype.
+A fully-masked row (all keys at finfo.min bias) degrades to UNIFORM
+attention in both paths — softmax of equal scores — preserving the layer
+contract where masked *steps* are zeroed by the caller, not here.
+
+Divergence (recorded in PARITY.md): the fused path treats ``bias`` as
+non-differentiable (zero cotangent) — bias here is always a mask-derived
+constant (layers' key masks, BERT's extended attention mask). A *learned*
+additive bias must use the reference path (mode "off" or a non-key-
+reducible bias, which falls back automatically).
+
+LSTM-cell precedent and the 1x1-conv negative result live in
+``pallas_kernels.py``; this kernel follows the same dispatch house style
+(``fits_vmem``-like budget guard, loud fallbacks, lax path for training
+parity tests).
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import register
+from ..environment import precision_for
+from .pallas_kernels import _VMEM_BUDGET, available as _tpu_available
+
+_LANES = 128          # TPU lane count: running max/sum ride replicated lanes
+_NEG = float(np.finfo(np.float32).min)
+
+
+# --------------------------------------------------------------------------
+# reference (quadratic) path — f32 softmax, shared by layers and fallbacks
+# --------------------------------------------------------------------------
+
+def reference_attention(q, k, v, bias=None, scale: Optional[float] = None):
+    """Quadratic einsum attention with the kernel's numerics: scores in f32,
+    softmax in f32, p@v accumulated in f32, output in the input dtype.
+
+    q: [..., Tq, d]; k, v: [..., Tk, d]; bias broadcastable to
+    [..., Tq, Tk] (additive, finite large-negative for masking)."""
+    d = q.shape[-1]
+    if scale is None:
+        scale = 1.0 / float(np.sqrt(d))
+    s = jnp.einsum("...qd,...kd->...qk", q, k,
+                   precision=precision_for(q, k),
+                   preferred_element_type=jnp.float32) * scale
+    if bias is not None:
+        s = s + jnp.maximum(bias.astype(jnp.float32), _NEG)
+    p = jax.nn.softmax(s, axis=-1)
+    y = jnp.einsum("...qk,...kd->...qd", p.astype(v.dtype), v,
+                   precision=precision_for(v, v),
+                   preferred_element_type=jnp.float32)
+    return y.astype(q.dtype)
+
+
+# --------------------------------------------------------------------------
+# kernel bodies
+# --------------------------------------------------------------------------
+
+def _lanes(x, n):
+    """[rows, _LANES] lane-replicated stat -> [rows, n] broadcast."""
+    if x.shape[1] == n:
+        return x
+    return jnp.broadcast_to(x[:, :1], (x.shape[0], n))
+
+
+def _scores(q_ref, k_ref, bias_ref, scale):
+    s = jax.lax.dot_general(
+        q_ref[0], k_ref[0], (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale
+    if bias_ref is not None:
+        s = s + bias_ref[...].astype(jnp.float32)  # [1, bk] broadcasts rows
+    return s
+
+
+def _fwd_kernel(*refs, scale, nk, has_bias):
+    if has_bias:
+        (q_ref, k_ref, v_ref, bias_ref, o_ref, m_ref, l_ref,
+         m_scr, l_scr, acc_scr) = refs
+    else:
+        q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, m_scr, l_scr, acc_scr = refs
+        bias_ref = None
+    j = pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[...] = jnp.full(m_scr.shape, -jnp.inf, jnp.float32)
+        l_scr[...] = jnp.zeros(l_scr.shape, jnp.float32)
+        acc_scr[...] = jnp.zeros(acc_scr.shape, jnp.float32)
+
+    s = _scores(q_ref, k_ref, bias_ref, scale)             # [bq, bk] f32
+    m_prev, l_prev = m_scr[...], l_scr[...]                # [bq, LANES]
+    m_curr = jnp.max(s, axis=1, keepdims=True)             # [bq, 1]
+    m_next = jnp.maximum(m_prev, m_curr)                   # [bq, LANES]
+    alpha = jnp.exp(m_prev - m_next)
+    p = jnp.exp(s - _lanes(m_next, s.shape[1]))            # [bq, bk]
+    m_scr[...] = m_next
+    l_scr[...] = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
+    d = acc_scr.shape[1]
+    acc_scr[...] = acc_scr[...] * _lanes(alpha, d) + jax.lax.dot(
+        p.astype(v_ref.dtype), v_ref[0], preferred_element_type=jnp.float32)
+
+    @pl.when(j == nk - 1)
+    def _finish():
+        l_fin = l_scr[...]
+        safe = jnp.where(l_fin == 0.0, 1.0, l_fin)
+        o_ref[0] = (acc_scr[...] / _lanes(safe, d)).astype(o_ref.dtype)
+        # the softmax stats are saved as SEPARATE max + sum (the logsumexp
+        # in two pieces): m + log(l) would absorb log(l) entirely when m is
+        # a finfo.min mask bias (ulp(3e38) >> log l), and the backward's
+        # recomputed p = exp(s - lse) would come out 1 instead of 1/Tk on
+        # fully-masked rows (found by the masked-row gradient parity test)
+        m_ref[0] = m_scr[...]
+        l_ref[0] = safe
+
+
+def _bwd_dq_kernel(*refs, scale, nk, has_bias):
+    if has_bias:
+        (q_ref, k_ref, v_ref, bias_ref, m_ref, l_ref, di_ref, do_ref,
+         dq_ref, dq_scr) = refs
+    else:
+        (q_ref, k_ref, v_ref, m_ref, l_ref, di_ref, do_ref,
+         dq_ref, dq_scr) = refs
+        bias_ref = None
+    j = pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _init():
+        dq_scr[...] = jnp.zeros(dq_scr.shape, jnp.float32)
+
+    s = _scores(q_ref, k_ref, bias_ref, scale)
+    bk = s.shape[1]
+    p = jnp.exp(s - _lanes(m_ref[0], bk)) * _lanes(1.0 / l_ref[0], bk)
+    dp = jax.lax.dot_general(                               # do @ v^T
+        do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    ds = p * (dp - _lanes(di_ref[0], bk)) * scale           # [bq, bk] f32
+    dq_scr[...] += jax.lax.dot(ds.astype(k_ref.dtype), k_ref[0],
+                               preferred_element_type=jnp.float32)
+
+    @pl.when(j == nk - 1)
+    def _finish():
+        dq_ref[0] = dq_scr[...].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(*refs, scale, nq, has_bias):
+    if has_bias:
+        (q_ref, k_ref, v_ref, bias_ref, m_ref, l_ref, di_ref, do_ref,
+         dk_ref, dv_ref, dk_scr, dv_scr) = refs
+    else:
+        (q_ref, k_ref, v_ref, m_ref, l_ref, di_ref, do_ref,
+         dk_ref, dv_ref, dk_scr, dv_scr) = refs
+        bias_ref = None
+    jq = pl.program_id(2)
+
+    @pl.when(jq == 0)
+    def _init():
+        dk_scr[...] = jnp.zeros(dk_scr.shape, jnp.float32)
+        dv_scr[...] = jnp.zeros(dv_scr.shape, jnp.float32)
+
+    s = _scores(q_ref, k_ref, bias_ref, scale)              # [bq, bk]
+    bk = s.shape[1]
+    p = jnp.exp(s - _lanes(m_ref[0], bk)) * _lanes(1.0 / l_ref[0], bk)
+    do = do_ref[0]
+    dv_scr[...] += jax.lax.dot_general(                     # p^T @ do
+        p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    dp = jax.lax.dot_general(                               # do @ v^T
+        do, v_ref[0], (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    ds = p * (dp - _lanes(di_ref[0], bk)) * scale
+    dk_scr[...] += jax.lax.dot_general(                     # ds^T @ q
+        ds.astype(q_ref.dtype), q_ref[0], (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+    @pl.when(jq == nq - 1)
+    def _finish():
+        dk_ref[0] = dk_scr[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
+
+
+# lazily bound so importing this module never requires pallas to load
+pl = None
+
+
+def _load_pallas():
+    global pl
+    if pl is None:
+        from jax.experimental import pallas as _pl
+        pl = _pl
+    from jax.experimental.pallas import tpu as pltpu
+    return pl, pltpu
+
+
+def _compiler_params(pltpu):
+    try:
+        return pltpu.TPUCompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"))
+    except Exception:  # older/newer spelling: let the compiler default
+        return None
+
+
+# --------------------------------------------------------------------------
+# pallas_call wrappers (grid = (B*H, q-blocks, kv-blocks))
+# --------------------------------------------------------------------------
+
+def _fwd_impl(q3, k3, v3, kb, scale, heads, bq, bk, interpret):
+    pl, pltpu = _load_pallas()
+    G, Tq, d = q3.shape
+    Tk = k3.shape[1]
+    nq, nk = Tq // bq, Tk // bk
+    has_bias = kb is not None
+    in_specs = [
+        pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
+        pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
+    ]
+    args = [q3, k3, v3]
+    if has_bias:
+        in_specs.append(
+            pl.BlockSpec((1, bk), lambda b, i, j: (b // heads, j)))
+        args.append(kb)
+    kernel = functools.partial(_fwd_kernel, scale=scale, nk=nk,
+                               has_bias=has_bias)
+    row = pl.BlockSpec((1, bq, _LANES), lambda b, i, j: (b, i, 0))
+    o, m, l = pl.pallas_call(
+        kernel,
+        grid=(G, nq, nk),
+        in_specs=in_specs,
+        out_shape=(jax.ShapeDtypeStruct((G, Tq, d), q3.dtype),
+                   jax.ShapeDtypeStruct((G, Tq, _LANES), jnp.float32),
+                   jax.ShapeDtypeStruct((G, Tq, _LANES), jnp.float32)),
+        out_specs=(pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
+                   row, row),
+        scratch_shapes=[pltpu.VMEM((bq, _LANES), jnp.float32),
+                        pltpu.VMEM((bq, _LANES), jnp.float32),
+                        pltpu.VMEM((bq, d), jnp.float32)],
+        compiler_params=_compiler_params(pltpu),
+        interpret=interpret,
+    )(*args)
+    return o, m, l
+
+
+def _bwd_impl(q3, k3, v3, kb, m, l, di, do, scale, heads, bq, bk, interpret):
+    pl, pltpu = _load_pallas()
+    G, Tq, d = q3.shape
+    Tk = k3.shape[1]
+    nq, nk = Tq // bq, Tk // bk
+    has_bias = kb is not None
+
+    qkv_specs = [
+        pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),   # q by i
+        pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),   # k by j
+        pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),   # v by j
+    ]
+    bias_spec = [pl.BlockSpec((1, bk), lambda b, i, j: (b // heads, j))] \
+        if has_bias else []
+    row_specs = [
+        pl.BlockSpec((1, bq, _LANES), lambda b, i, j: (b, i, 0)),  # m
+        pl.BlockSpec((1, bq, _LANES), lambda b, i, j: (b, i, 0)),  # l
+        pl.BlockSpec((1, bq, _LANES), lambda b, i, j: (b, i, 0)),  # di
+        pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),       # do
+    ]
+    args = [q3, k3, v3] + ([kb] if has_bias else []) + [m, l, di, do]
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, scale=scale, nk=nk,
+                          has_bias=has_bias),
+        grid=(G, nq, nk),
+        in_specs=qkv_specs + bias_spec + row_specs,
+        out_shape=jax.ShapeDtypeStruct((G, Tq, d), q3.dtype),
+        out_specs=pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+        compiler_params=_compiler_params(pltpu),
+        interpret=interpret,
+    )(*args)
+
+    # dk/dv grid: kv-blocks outer, q-blocks inner (the reduction axis)
+    dkv_qkv_specs = [
+        pl.BlockSpec((1, bq, d), lambda b, i, j: (b, j, 0)),   # q by inner j
+        pl.BlockSpec((1, bk, d), lambda b, i, j: (b, i, 0)),   # k by outer i
+        pl.BlockSpec((1, bk, d), lambda b, i, j: (b, i, 0)),   # v by outer i
+    ]
+    dkv_bias_spec = [pl.BlockSpec((1, bk), lambda b, i, j: (b // heads, i))] \
+        if has_bias else []
+    dkv_row_specs = [
+        pl.BlockSpec((1, bq, _LANES), lambda b, i, j: (b, j, 0)),  # m
+        pl.BlockSpec((1, bq, _LANES), lambda b, i, j: (b, j, 0)),  # l
+        pl.BlockSpec((1, bq, _LANES), lambda b, i, j: (b, j, 0)),  # di
+        pl.BlockSpec((1, bq, d), lambda b, i, j: (b, j, 0)),       # do
+    ]
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, scale=scale, nq=nq,
+                          has_bias=has_bias),
+        grid=(G, nk, nq),
+        in_specs=dkv_qkv_specs + dkv_bias_spec + dkv_row_specs,
+        out_shape=(jax.ShapeDtypeStruct((G, Tk, d), k3.dtype),
+                   jax.ShapeDtypeStruct((G, Tk, d), v3.dtype)),
+        out_specs=(pl.BlockSpec((1, bk, d), lambda b, i, j: (b, i, 0)),
+                   pl.BlockSpec((1, bk, d), lambda b, i, j: (b, i, 0))),
+        scratch_shapes=[pltpu.VMEM((bk, d), jnp.float32),
+                        pltpu.VMEM((bk, d), jnp.float32)],
+        compiler_params=_compiler_params(pltpu),
+        interpret=interpret,
+    )(*args)
+    return dq, dk, dv
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+def _flash(q3, k3, v3, kb, scale, heads, bq, bk, interpret):
+    o, _, _ = _fwd_impl(q3, k3, v3, kb, scale, heads, bq, bk, interpret)
+    return o
+
+
+def _flash_fwd(q3, k3, v3, kb, scale, heads, bq, bk, interpret):
+    o, m, l = _fwd_impl(q3, k3, v3, kb, scale, heads, bq, bk, interpret)
+    return o, (q3, k3, v3, kb, o, m, l)
+
+
+def _flash_bwd(scale, heads, bq, bk, interpret, res, do):
+    q3, k3, v3, kb, o, m, l = res
+    di = jnp.sum(o.astype(jnp.float32) * do.astype(jnp.float32),
+                 axis=-1, keepdims=True)
+    di = jnp.broadcast_to(di, m.shape)  # lane-replicated like m/l
+    dq, dk, dv = _bwd_impl(q3, k3, v3, kb, m, l, di, do,
+                           scale, heads, bq, bk, interpret)
+    # bias is mask-derived here: zero cotangent (recorded divergence)
+    dkb = None if kb is None else jnp.zeros_like(kb)
+    return dq, dk, dv, dkb
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+# --------------------------------------------------------------------------
+# public fused op
+# --------------------------------------------------------------------------
+
+def pick_block(t: int, target: int = 128) -> Optional[int]:
+    """Largest power-of-two block <= target that tiles ``t`` (>= 8 so the
+    sublane dimension stays layout-friendly); None when nothing tiles."""
+    b = target
+    while b >= 8:
+        if t % b == 0:
+            return b
+        b //= 2
+    return None
+
+
+def fits_vmem_attention(bq: int, bk: int, d: int, itemsize: int = 4) -> bool:
+    """Per-grid-cell VMEM estimate over the WORST of the three kernels —
+    dispatching commits the backward too, and the dkv kernel holds the
+    largest set (q/k/v/do blocks, four f32 score-sized tiles, dk/dv
+    scratch AND outputs). x2 for pipelining double-buffers."""
+    fwd = ((bq * d + 2 * bk * d) * itemsize           # q, k, v blocks
+           + 2 * bq * bk * 4                          # scores + p (f32)
+           + (2 * bq * _LANES + bq * d) * 4           # m/l/acc scratch
+           + (bq * d + 2 * bq * _LANES) * 4)          # o + m/l out blocks
+    dkv = ((2 * bq * d + 2 * bk * d) * itemsize       # q, do, k, v blocks
+           + 4 * bq * bk * 4                          # s/p/dp/ds (f32)
+           + 3 * bq * _LANES * 4                      # m/l/di row blocks
+           + 2 * bk * d * 4                           # dk/dv scratch
+           + 2 * bk * d * itemsize)                   # dk/dv out blocks
+    return 2 * max(fwd, dkv) < _VMEM_BUDGET
+
+
+def _key_bias(bias, batch, tk):
+    """Reduce an additive bias broadcastable to [B,H,Tq,Tk] down to the
+    per-(batch, key) form [B, Tk] the kernel streams, or None if the bias
+    genuinely varies over heads/queries."""
+    if bias is None:
+        return None
+    if bias.ndim != 4 or bias.shape[1] != 1 or bias.shape[2] != 1:
+        return None
+    if bias.shape[0] not in (1, batch) or bias.shape[3] != tk:
+        return None
+    kb = jnp.broadcast_to(bias[:, 0, 0, :], (batch, tk))
+    return jnp.maximum(kb.astype(jnp.float32), _NEG)
+
+
+def flash_attention(q, k, v, bias=None, scale: Optional[float] = None, *,
+                    block_q: int = 128, block_k: int = 128,
+                    interpret: bool = False):
+    """Fused flash attention: softmax((q.k^T)*scale + bias) @ v.
+
+    q: [B, H, Tq, d]; k, v: [B, H, Tk, d]; bias broadcastable to
+    [B, H, Tq, Tk] with singleton head/query dims (key-mask form — a
+    full per-query bias falls outside this kernel; use the dispatcher,
+    which falls back). Raises ValueError on non-tiling shapes — callers
+    go through :func:`attention` for guarded dispatch.
+    """
+    if q.ndim != 4 or k.ndim != 4 or v.ndim != 4:
+        raise ValueError(f"flash_attention wants [B,H,T,d]; got {q.shape}")
+    B, H, Tq, d = q.shape
+    Tk = k.shape[2]
+    if k.shape != (B, H, Tk, d) or v.shape != (B, H, Tk, d):
+        raise ValueError(f"q/k/v shapes disagree: {q.shape} {k.shape} "
+                         f"{v.shape}")
+    bq = pick_block(Tq, block_q)
+    bk = pick_block(Tk, block_k)
+    if bq is None or bk is None:
+        raise ValueError(f"sequence lengths ({Tq}, {Tk}) do not tile into "
+                         f"({block_q}, {block_k}) blocks")
+    if not fits_vmem_attention(bq, bk, d, np.dtype(q.dtype).itemsize):
+        raise ValueError(f"attention tiles exceed the VMEM budget "
+                         f"(bq={bq}, bk={bk}, d={d})")
+    if scale is None:
+        scale = 1.0 / float(np.sqrt(d))
+    kb = _key_bias(bias, B, Tk)
+    if bias is not None and kb is None:
+        raise ValueError(f"bias shape {bias.shape} is not key-reducible "
+                         "([B,1,1,Tk]); use attention() for fallback")
+    o = _flash(q.reshape(B * H, Tq, d), k.reshape(B * H, Tk, d),
+               v.reshape(B * H, Tk, d), kb, float(scale), H, bq, bk,
+               bool(interpret))
+    return o.reshape(B, H, Tq, d)
+
+
+# --------------------------------------------------------------------------
+# dispatch: mode + counters (zero-silent-fallback observability)
+# --------------------------------------------------------------------------
+
+_COUNTER_KEYS = ("fused", "fallback_mode", "fallback_platform",
+                 "fallback_shape", "fallback_bias", "fallback_dtype",
+                 "fallback_vmem")
+_counters = {k: 0 for k in _COUNTER_KEYS}
+_state = {"mode": os.environ.get("DL4J_TPU_FLASH_ATTENTION", "auto")}
+_FUSABLE_DTYPES = (jnp.float32, jnp.bfloat16, jnp.float16)
+
+
+def mode() -> str:
+    return _state["mode"]
+
+
+def set_mode(m: str) -> str:
+    """"auto" (TPU -> kernel, elsewhere -> reference), "force" (kernel
+    everywhere — Pallas interpret off-TPU; how the CPU tier-1 suite
+    exercises the kernel), "off" (reference everywhere). Returns the
+    previous mode so tests can restore it.
+
+    The mode is consulted at TRACE time: functions already jit-compiled
+    (an engine's cached train step / output fn, a warmed serving
+    executable) keep whichever path was traced into them, with no counter
+    bump on later executions. Flip the mode BEFORE building/tracing, or
+    invalidate the model's compiled cache (``net._invalidate_compiled()``)
+    after flipping."""
+    if m not in ("auto", "force", "off"):
+        raise ValueError(f"flash attention mode {m!r} not in "
+                         "('auto', 'force', 'off')")
+    old = _state["mode"]
+    _state["mode"] = m
+    return old
+
+
+def counters() -> dict:
+    """Dispatch-decision counts. Decisions happen at TRACE time (shapes are
+    static), so under jit each compiled call-site counts once, not once per
+    execution — the right unit for "did the kernel path get taken"."""
+    return dict(_counters)
+
+
+def reset_counters() -> None:
+    for k in _COUNTER_KEYS:
+        _counters[k] = 0
+
+
+def _route(q, k, v, bias) -> Optional[str]:
+    """None = fuse; otherwise the fallback counter key."""
+    if _state["mode"] == "off":
+        return "fallback_mode"
+    if _state["mode"] != "force" and not _tpu_available():
+        return "fallback_platform"
+    if q.ndim != 4 or k.shape != v.shape or q.shape[:2] != k.shape[:2] \
+            or q.shape[-1] != k.shape[-1]:
+        return "fallback_shape"
+    if q.dtype not in _FUSABLE_DTYPES:
+        return "fallback_dtype"
+    bq = pick_block(q.shape[2])
+    bk = pick_block(k.shape[2])
+    if bq is None or bk is None:
+        return "fallback_shape"
+    if bias is not None and _key_bias(bias, q.shape[0], k.shape[2]) is None:
+        return "fallback_bias"
+    if not fits_vmem_attention(bq, bk, q.shape[-1],
+                               np.dtype(q.dtype).itemsize):
+        return "fallback_vmem"
+    return None
+
+
+def attention(q, k, v, bias=None, scale: Optional[float] = None):
+    """Guarded attention dispatch: the flash kernel when the route is clear,
+    the f32-softmax reference path otherwise. Layers and the SameDiff
+    ``attention.fused_sdpa`` op both enter here."""
+    reason = _route(q, k, v, bias)
+    if reason is None:
+        _counters["fused"] += 1
+        return flash_attention(q, k, v, bias, scale,
+                               interpret=not _tpu_available())
+    _counters[reason] += 1
+    return reference_attention(q, k, v, bias, scale)
+
+
+@register("attention.fused_sdpa", category="attention")
+def fused_sdpa(q, k, v, bias=None, scale: float = 1.0):
+    """Fused scaled-dot-product attention graph op: the rewrite target of
+    the SameDiff attention-pattern fusion pass (``autodiff/fusion.py``).
+    Semantics: softmax((q @ k^T) * scale + bias, axis=-1) @ v — exactly the
+    imported ``batch_matmul -> scale -> (mask add) -> softmax ->
+    batch_matmul`` chain it replaces, with the softmax in f32. Dispatches
+    to the flash kernel for [B,H,T,d] operands on TPU."""
+    return attention(q, k, v, bias=bias, scale=float(scale))

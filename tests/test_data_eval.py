@@ -49,6 +49,42 @@ def test_async_iterator_matches_sync(rng):
         np.testing.assert_array_equal(a, b)
 
 
+def test_async_iterator_device_prefetch_bit_identical(rng):
+    """device_prefetch=True yields device-resident arrays that are
+    BIT-identical to plain iteration (ISSUE 3 satellite): the producer
+    thread runs jax.device_put (and any pre_processor, on host, first)."""
+    import jax
+
+    x = rng.normal(size=(50, 3)).astype(np.float32)
+    y = rng.normal(size=(50, 1)).astype(np.float32)
+    fm = (rng.random((50, 3)) > 0.5).astype(np.float32)
+    plain = list(AsyncDataSetIterator(
+        NumpyDataSetIterator(x, y, batch_size=16)))
+    pref = list(AsyncDataSetIterator(
+        NumpyDataSetIterator(x, y, batch_size=16), device_prefetch=True))
+    assert len(plain) == len(pref)
+    for a, b in zip(plain, pref):
+        assert isinstance(b.features, jax.Array)
+        np.testing.assert_array_equal(np.asarray(b.features), a.features)
+        np.testing.assert_array_equal(np.asarray(b.labels), a.labels)
+    # masks ride too, None masks stay None
+    ds = DataSet(x, y, features_mask=fm)
+    got = list(AsyncDataSetIterator(ListDataSetIterator([ds]),
+                                    device_prefetch=True))[0]
+    np.testing.assert_array_equal(np.asarray(got.features_mask), fm)
+    assert got.labels_mask is None
+    # pre_processor runs in the producer exactly once (host side)
+    class Scale:
+        def transform(self, d):
+            d.features = np.asarray(d.features) * 2.0
+    base = ListDataSetIterator([ds])
+    it = AsyncDataSetIterator(base, device_prefetch=True)
+    it.set_pre_processor(Scale())
+    for _ in range(2):  # stored batch must not compound across epochs
+        got = list(it)[0]
+        np.testing.assert_array_equal(np.asarray(got.features), x * 2.0)
+
+
 def test_async_iterator_propagates_errors():
     class Bad(ListDataSetIterator):
         def __iter__(self):

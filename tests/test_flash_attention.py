@@ -1,0 +1,393 @@
+"""Flash attention (ISSUE 3): fused Pallas kernel parity (interpret mode on
+the CPU mesh — the REAL kernel code, per-block online softmax and the
+custom-VJP backward), dispatch guard + zero-silent-fallback counters, the
+attention layers' fused routing, the f32-softmax numerics fix, and the
+SameDiff attention-pattern fusion pass."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu import ops
+from deeplearning4j_tpu.ops import flash_attention as fa
+
+
+@pytest.fixture
+def force_mode():
+    """Route dispatch through the kernel (interpret off-TPU) for the test."""
+    old = fa.set_mode("force")
+    fa.reset_counters()
+    yield
+    fa.set_mode(old)
+
+
+def _qkv(rng, B=2, H=2, Tq=128, Tk=128, d=32, dtype=np.float32):
+    mk = lambda T: jnp.asarray(rng.normal(size=(B, H, T, d)), dtype=dtype)
+    return mk(Tq), mk(Tk), mk(Tk)
+
+
+def _ragged_bias(rng, B, Tk, full_mask_row=True):
+    """Ragged per-row key masks, incl. one fully-masked batch row."""
+    mask = np.ones((B, Tk), np.float32)
+    for b in range(B):
+        mask[b, Tk - 1 - (b * 7) % (Tk // 2):] = 0.0
+    if full_mask_row:
+        mask[0, :] = 0.0
+    return jnp.where(jnp.asarray(mask)[:, None, None, :] > 0, 0.0,
+                     jnp.asarray(np.finfo(np.float32).min))
+
+
+@pytest.mark.parametrize("dtype,tol", [(np.float32, 1e-5),
+                                       ("bfloat16", 2e-2)])
+def test_flash_forward_parity(rng, dtype, tol):
+    """Fused forward == einsum reference across dtypes, ragged key masks
+    incl. a fully-masked batch row, Tq != Tk, head dim != lane width."""
+    q, k, v = _qkv(rng, Tq=128, Tk=256, d=48, dtype=dtype)
+    bias = _ragged_bias(rng, 2, 256)
+    ref = fa.reference_attention(q, k, v, bias)
+    out = fa.flash_attention(q, k, v, bias, interpret=True)
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), np.asarray(ref, np.float32), atol=tol)
+    # no-bias path too
+    np.testing.assert_allclose(
+        np.asarray(fa.flash_attention(q, k, v, interpret=True), np.float32),
+        np.asarray(fa.reference_attention(q, k, v), np.float32), atol=tol)
+    ops.mark_fwd_tested("attention.fused_sdpa")
+
+
+def test_flash_multiblock_online_softmax(rng):
+    """Several q AND kv blocks per row: the running max/sum accumulators do
+    real cross-block corrections (block sizes forced below T)."""
+    q, k, v = _qkv(rng, Tq=64, Tk=64, d=16)
+    ref = fa.reference_attention(q, k, v)
+    out = fa.flash_attention(q, k, v, block_q=16, block_k=16, interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+def test_flash_gradient_parity(rng):
+    """Custom-VJP backward (recompute from saved softmax stats) == autodiff
+    through the reference path, masked rows included, f32 atol 1e-5."""
+    q, k, v = _qkv(rng, Tq=128, Tk=128, d=32)
+    bias = _ragged_bias(rng, 2, 128)
+
+    def loss(path, q, k, v):
+        return jnp.sum(jnp.sin(path(q, k, v, bias)))
+
+    gf = jax.grad(
+        lambda *a: loss(lambda q, k, v, b: fa.flash_attention(
+            q, k, v, b, interpret=True), *a), argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(lambda *a: loss(fa.reference_attention, *a),
+                  argnums=(0, 1, 2))(q, k, v)
+    for got, ref in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   atol=1e-5)
+    ops.mark_grad_tested("attention.fused_sdpa")
+
+
+def test_flash_gradient_parity_bf16(rng):
+    q, k, v = _qkv(rng, Tq=64, Tk=64, d=32, dtype="bfloat16")
+    gf = jax.grad(lambda x: jnp.sum(fa.flash_attention(
+        x, k, v, interpret=True).astype(jnp.float32)))(q)
+    gr = jax.grad(lambda x: jnp.sum(
+        fa.reference_attention(x, k, v).astype(jnp.float32)))(q)
+    np.testing.assert_allclose(np.asarray(gf, np.float32),
+                               np.asarray(gr, np.float32), atol=5e-2)
+
+
+def test_flash_raises_on_non_tiling_and_bad_bias(rng):
+    q, k, v = _qkv(rng, Tq=100, Tk=128, d=16)
+    with pytest.raises(ValueError, match="do not tile"):
+        fa.flash_attention(q, k, v, interpret=True)
+    q, k, v = _qkv(rng, Tq=128, Tk=128, d=16)
+    bad_bias = jnp.zeros((2, 2, 128, 128))  # per-head/query: not reducible
+    with pytest.raises(ValueError, match="key-reducible"):
+        fa.flash_attention(q, k, v, bad_bias, interpret=True)
+
+
+def test_dispatch_fallbacks_and_counters(rng, force_mode):
+    """Every fallback routes to the reference path WITH a counter bump —
+    the zero-silent-fallback contract — and fused output still matches."""
+    # non-power-of-two T -> fallback_shape, output == reference exactly
+    q, k, v = _qkv(rng, Tq=100, Tk=100, d=16)
+    out = fa.attention(q, k, v)
+    np.testing.assert_array_equal(
+        np.asarray(out), np.asarray(fa.reference_attention(q, k, v)))
+    assert fa.counters()["fallback_shape"] == 1
+    # per-query bias -> fallback_bias
+    q, k, v = _qkv(rng, Tq=32, Tk=32, d=16)
+    fa.attention(q, k, v, jnp.zeros((2, 2, 32, 32)))
+    assert fa.counters()["fallback_bias"] == 1
+    # int dtype -> fallback_dtype
+    fa.attention(q.astype(jnp.int32), k.astype(jnp.int32),
+                 v.astype(jnp.int32))
+    assert fa.counters()["fallback_dtype"] == 1
+    # tiling shape under force -> the kernel path, counted
+    before = fa.counters()["fused"]
+    out = fa.attention(q, k, v)
+    assert fa.counters()["fused"] == before + 1
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(fa.reference_attention(q, k, v)),
+        atol=1e-5)
+
+
+def test_dispatch_cpu_auto_falls_back(rng):
+    """auto mode off-TPU: reference path, counted as fallback_platform —
+    and 'off' forces the reference path everywhere."""
+    old = fa.set_mode("auto")
+    fa.reset_counters()
+    try:
+        q, k, v = _qkv(rng, Tq=32, Tk=32, d=16)
+        fa.attention(q, k, v)
+        assert fa.counters()["fallback_platform"] == 1
+        fa.set_mode("off")
+        fa.attention(q, k, v)
+        assert fa.counters()["fallback_mode"] == 1
+    finally:
+        fa.set_mode(old)
+    with pytest.raises(ValueError, match="mode"):
+        fa.set_mode("sometimes")
+
+
+def test_kernel_path_taken_in_tier1(rng, force_mode):
+    """CI guard (ISSUE 3 satellite): the tier-1 suite must exercise the
+    REAL kernel code path (interpret mode) — dispatch counters prove the
+    fused route was taken, not a silent fallback."""
+    from deeplearning4j_tpu.nn.layers.attention import SelfAttentionLayer
+
+    lyr = SelfAttentionLayer(n_out=32, n_heads=2)
+    params, state, _ = lyr.initialize(jax.random.PRNGKey(0), (64, 32),
+                                      jnp.float32)
+    x = jnp.asarray(rng.normal(size=(2, 64, 32)).astype(np.float32))
+    lyr.apply(params, x, state)
+    c = fa.counters()
+    assert c["fused"] >= 1, f"layer did not reach the kernel: {c}"
+    assert sum(v for k, v in c.items() if k.startswith("fallback")) == 0
+
+
+def test_attention_layer_fused_matches_einsum(rng, force_mode):
+    """SelfAttentionLayer routed through the kernel == the einsum path,
+    with the masked-step zero-output contract preserved."""
+    from deeplearning4j_tpu.nn.layers.attention import (
+        LearnedSelfAttentionLayer, SelfAttentionLayer)
+
+    lyr = SelfAttentionLayer(n_out=32, n_heads=4, has_bias=True)
+    params, state, _ = lyr.initialize(jax.random.PRNGKey(1), (64, 32),
+                                      jnp.float32)
+    x = jnp.asarray(rng.normal(size=(3, 64, 32)).astype(np.float32))
+    mask = np.ones((3, 64), np.float32)
+    mask[0, 40:] = 0.0
+    mask[2, 5:] = 0.0
+    mask = jnp.asarray(mask)
+
+    y_fused, _, _ = lyr.apply(params, x, state, mask=mask)
+    assert fa.counters()["fused"] >= 1
+    fa.set_mode("off")
+    y_ref, _, _ = lyr.apply(params, x, state, mask=mask)
+    fa.set_mode("force")
+    np.testing.assert_allclose(np.asarray(y_fused), np.asarray(y_ref),
+                               atol=1e-5)
+    # masked steps emit zeros (DL4J contract)
+    assert np.all(np.asarray(y_fused)[0, 40:] == 0.0)
+    assert np.all(np.asarray(y_fused)[2, 5:] == 0.0)
+
+    # learned queries: tiny Tq does not tile -> guarded fallback, same math
+    lq = LearnedSelfAttentionLayer(n_out=32, n_heads=2, n_queries=3)
+    p2, s2, _ = lq.initialize(jax.random.PRNGKey(2), (64, 32), jnp.float32)
+    y2, _, _ = lq.apply(p2, x, s2, mask=mask)
+    assert fa.counters()["fallback_shape"] >= 1
+    fa.set_mode("off")
+    y2_ref, _, _ = lq.apply(p2, x, s2, mask=mask)
+    fa.set_mode("force")
+    np.testing.assert_allclose(np.asarray(y2), np.asarray(y2_ref),
+                               atol=1e-6)
+
+
+def test_mha_bf16_softmax_upcast_shrinks_f32_gap(rng):
+    """Numerics-fix regression (ISSUE 3 satellite): _mha now upcasts
+    scores to f32 before softmax; under the bf16 policy the gap to the
+    f32 oracle must SHRINK vs the old storage-dtype softmax."""
+    from deeplearning4j_tpu.nn.layers.attention import (_heads_join,
+                                                        _heads_split, _mha)
+
+    B, T, D, Hn = 2, 32, 32, 2
+    x32 = jnp.asarray(rng.normal(size=(B, T, D)).astype(np.float32)) * 3.0
+    params32 = {n: jnp.asarray(rng.normal(size=(D, D)).astype(np.float32))
+                / np.sqrt(D) for n in ("Wq", "Wk", "Wv", "Wo")}
+    oracle = np.asarray(_mha(x32, x32, params32, Hn, None))
+
+    x16 = x32.astype(jnp.bfloat16)
+    params16 = {n: w.astype(jnp.bfloat16) for n, w in params32.items()}
+    new_gap = float(np.max(np.abs(
+        np.asarray(_mha(x16, x16, params16, Hn, None), np.float32) - oracle)))
+
+    def old_mha(x, params):  # the pre-fix path: softmax in storage dtype
+        from deeplearning4j_tpu.ops.math import precision_for
+        q = _heads_split(jnp.dot(x, params["Wq"]), Hn)
+        k = _heads_split(jnp.dot(x, params["Wk"]), Hn)
+        v = _heads_split(jnp.dot(x, params["Wv"]), Hn)
+        scale = 1.0 / jnp.sqrt(jnp.asarray(q.shape[-1], q.dtype))
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                       precision=precision_for(q, k)) * scale
+        att = jax.nn.softmax(s, axis=-1)
+        y = jnp.einsum("bhqk,bhkd->bhqd", att, v,
+                       precision=precision_for(att, v))
+        return jnp.dot(_heads_join(y), params["Wo"])
+
+    old_gap = float(np.max(np.abs(
+        np.asarray(old_mha(x16, params16), np.float32) - oracle)))
+    assert new_gap < old_gap, (new_gap, old_gap)
+
+
+# ---------------------------------------------------------------------------
+# SameDiff fusion pass
+# ---------------------------------------------------------------------------
+
+def _record_attention_chain(sd, name, q, k, v, mask_var, d, eps_add=False,
+                            dropout_identity=False):
+    """Record the exact op chain the TF importer emits for one BERT
+    attention block (modelimport/tensorflow.py mappers)."""
+    dk = sd.constant(f"{name}_dk", np.float32(np.sqrt(d)))
+    scores = sd.call("linalg.mmul", q, k, name=f"{name}_scores",
+                     attrs={"transpose_b": True})
+    scaled = sd.call("math.div", scores, dk, name=f"{name}_scaled")
+    masked = sd.call("math.add", scaled, mask_var, name=f"{name}_masked")
+    if eps_add:  # HF stable_softmax: softmax(x + 1e-9)
+        eps = sd.constant(f"{name}_eps", np.float32(1e-9))
+        masked = sd.call("math.add", masked, eps, name=f"{name}_eps_add")
+    probs = sd.call("act.softmax", masked, name=f"{name}_probs")
+    if dropout_identity:  # frozen-graph dropout imports as identity
+        probs = sd.call("act.identity", probs, name=f"{name}_drop")
+    return sd.call("linalg.mmul", probs, v, name=f"{name}_ctx")
+
+
+def test_fusion_pass_rewrites_imported_chain(rng):
+    """Importer-shaped chain (incl. HF's +eps and the dropout identity):
+    matched-site count asserted, graph outputs unchanged, fused op counted
+    on dispatch, fused graph serializes and trains."""
+    from deeplearning4j_tpu.autodiff import SameDiff, fuse_attention
+
+    B, H, T, d = 2, 2, 16, 8
+    sd = SameDiff()
+    qv = sd.placeholder("q")
+    kv = sd.placeholder("k")
+    vv = sd.placeholder("v")
+    mask = sd.constant("mask", ((rng.random((B, 1, 1, T)) > 0.25)
+                                .astype(np.float32) - 1.0) * 10000.0)
+    c1 = _record_attention_chain(sd, "a", qv, kv, vv, mask, d,
+                                 eps_add=True, dropout_identity=True)
+    c2 = _record_attention_chain(sd, "b", c1, kv, vv, mask, d)
+    out = sd.call("math.mul", c2, sd._lift(2.0), name="out")
+
+    feeds = {n: rng.normal(size=(B, H, T, d)).astype(np.float32)
+             for n in "qkv"}
+    before = sd.output(feeds, ["out"])["out"]
+    rep = fuse_attention(sd)
+    assert rep.matched == 2 and rep.unmatched == 0
+    assert [r.op for r in sd._ops].count("attention.fused_sdpa") == 2
+    assert "a_probs" not in sd._vars and "b_scores" not in sd._vars
+    fa.reset_counters()
+    after = sd.output(feeds, ["out"])["out"]
+    np.testing.assert_allclose(after, before, atol=1e-5)
+    # dispatch was consulted per fused site (reference fallback on CPU auto)
+    c = fa.counters()
+    assert sum(c.values()) >= 2
+
+    # serde round-trip keeps the fused op
+    import tempfile
+    path = tempfile.mktemp(suffix=".zip")
+    sd.save(path)
+    from deeplearning4j_tpu.autodiff import SameDiff as SD2
+    sd2 = SD2.load(path)
+    np.testing.assert_allclose(sd2.output(feeds, ["out"])["out"], after,
+                               atol=0)
+
+    # trains through the fused op (custom VJP / reference autodiff)
+    from deeplearning4j_tpu.nn.updaters import Sgd
+    w = sd.var("w", rng.normal(size=(d, 1)).astype(np.float32))
+    pred = sd.call("linalg.mmul", out, w, name="pred")
+    sd.set_loss(pred.mean())
+    sd.set_updater(Sgd(learning_rate=0.1))
+    h = sd.fit(feeds, epochs=2)
+    assert np.isfinite(h.losses).all()
+
+
+def test_fusion_pass_safety_rules(rng):
+    """Fan-out on an intermediate, a non-scalar scale, or a missing
+    downstream mmul leave the graph UNTOUCHED (counted unmatched where the
+    chain anchored a candidate)."""
+    from deeplearning4j_tpu.autodiff import SameDiff, fuse_attention
+
+    B, H, T, d = 1, 1, 8, 4
+    feeds = {n: np.random.default_rng(0).normal(
+        size=(B, H, T, d)).astype(np.float32) for n in "qkv"}
+
+    # (1) probs consumed twice -> unmatched, graph unchanged
+    sd = SameDiff()
+    q, k, v = (sd.placeholder(n) for n in "qkv")
+    scores = sd.call("linalg.mmul", q, k, attrs={"transpose_b": True})
+    probs = sd.call("act.softmax", scores, name="probs")
+    ctx = sd.call("linalg.mmul", probs, v, name="ctx")
+    sd.call("reduce.sum", probs, name="extra")  # second consumer of probs
+    n_ops = len(sd._ops)
+    rep = fuse_attention(sd)
+    assert rep.matched == 0 and rep.unmatched == 1
+    assert len(sd._ops) == n_ops
+    assert sd.output(feeds, ["ctx"])["ctx"].shape == (B, H, T, d)
+
+    # (2) softmax feeding something that is not a plain mmul: not a site
+    sd = SameDiff()
+    q, k = sd.placeholder("q"), sd.placeholder("k")
+    scores = sd.call("linalg.mmul", q, k, attrs={"transpose_b": True})
+    probs = sd.call("act.softmax", scores)
+    sd.call("reduce.sum", probs, attrs={"axis": -1})
+    rep = fuse_attention(sd)
+    assert rep.matched == 0 and rep.unmatched == 0
+
+    # (3) tensor-valued "scale" operand -> unmatched by the const check
+    sd = SameDiff()
+    q, k, v = (sd.placeholder(n) for n in "qkv")
+    t = sd.constant("t", np.ones((T, T), np.float32))
+    scores = sd.call("linalg.mmul", q, k, attrs={"transpose_b": True})
+    scaled = sd.call("math.mul", scores, t)
+    probs = sd.call("act.softmax", scaled)
+    sd.call("linalg.mmul", probs, v)
+    rep = fuse_attention(sd)
+    assert rep.matched == 0 and rep.unmatched == 1
+
+
+@pytest.mark.slow
+def test_fusion_minibert_graphdef_import():
+    """End-to-end (ISSUE 3 acceptance): freeze a mini-BERT TF graph,
+    import, fuse — matched-site count == n_layers, outputs equal."""
+    tf = pytest.importorskip("tensorflow")
+    transformers = pytest.importorskip("transformers")
+    from tensorflow.python.framework.convert_to_constants import (
+        convert_variables_to_constants_v2)
+
+    from deeplearning4j_tpu.autodiff.fusion import fuse_attention
+    from deeplearning4j_tpu.modelimport.tensorflow import (
+        TensorflowFrameworkImporter)
+
+    cfg = transformers.BertConfig(
+        num_hidden_layers=2, hidden_size=64, num_attention_heads=2,
+        intermediate_size=128, vocab_size=100, max_position_embeddings=64)
+    m = transformers.TFBertModel(cfg)
+
+    @tf.function
+    def f(ids):
+        return m(ids).last_hidden_state
+
+    conc = f.get_concrete_function(tf.TensorSpec([2, 16], tf.int32))
+    frozen = convert_variables_to_constants_v2(conc)
+    iname = frozen.inputs[0].name.split(":")[0]
+    oname = frozen.outputs[0].name.split(":")[0]
+    sd = TensorflowFrameworkImporter.import_graph_def(
+        frozen.graph.as_graph_def())
+    ids = np.random.default_rng(0).integers(0, 100, (2, 16)).astype(np.int32)
+    before = sd.output({iname: ids}, [oname])[oname]
+    rep = fuse_attention(sd)
+    assert rep.matched == 2, (rep.matched, rep.reasons)
+    after = sd.output({iname: ids}, [oname])[oname]
+    np.testing.assert_allclose(after, before, atol=1e-5)

@@ -27,8 +27,9 @@ GRAD_FLOOR = 0.95
 # all marking files are FAST — the floor now asserts on `-m "not slow"`
 # runs too (the einsum/erfc marks moved from the slow TF goldens to
 # fast numpy oracles in test_ops_math.py).
-_MARKING_FILES = {"test_conv3d_capsules.py", "test_m17_breadth.py",
-                  "test_ops.py", "test_ops_math.py", "test_ops_grad_r5.py"}
+_MARKING_FILES = {"test_conv3d_capsules.py", "test_flash_attention.py",
+                  "test_m17_breadth.py", "test_ops.py", "test_ops_math.py",
+                  "test_ops_grad_r5.py"}
 
 
 def test_coverage_floor(request):
