@@ -1,0 +1,135 @@
+"""Workspace-mode rematerialization for imported SameDiff graphs.
+
+The nn engines apply the ``workspace_mode`` activation-checkpoint policy at
+layer/vertex granularity (``nn/memory.py``); an imported ``SameDiff`` graph
+has no layers — just the recorded op list. This module recovers the block
+structure: the topo-sorted op list is segmented into **transformer-block
+chunks** anchored at attention sites — ``attention.fused_sdpa`` ops (the
+post-``fusion.fuse_attention`` spelling) or raw softmax-anchored attention
+chains, recognized by REUSING ``fusion._match_site``'s chain matcher — and
+each segment's replay runs inside ``jax.checkpoint``. A BERT-class import
+then keeps one set of boundary activations per encoder block and
+rematerializes the block interior (QKV projections, scores, FFN
+intermediates) during the backward pass.
+
+Graphs with no attention anchors (plain MLPs, convnets) fall back to
+sqrt-sized uniform chunks — the classic O(sqrt(n)) checkpoint spacing.
+
+Liveness is exact: each segment receives precisely the names it reads that
+were produced earlier (weights included — checkpoint inputs are saved, not
+recomputed, which is correct for parameters) and returns precisely the
+names later segments or the targets read. Everything else is
+rematerialized.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import List, Sequence, Tuple
+
+from ..nn import memory as _memory
+
+#: op names that anchor a transformer block (one anchor ≈ one block)
+ANCHOR_OPS = ("attention.fused_sdpa",)
+
+
+def attention_anchors(sd) -> List[int]:
+    """Indices of ops that anchor a transformer block: fused attention
+    ops, plus raw attention chains recognized by ``fusion._match_site``
+    (the same matcher the fusion pass trusts for rewriting; a stray
+    standalone softmax does NOT cut a block). For a raw chain the anchor
+    is the UPSTREAM scores mmul, not the softmax — cutting at the softmax
+    would park the O(B·H·T²) scores tensor on a checkpoint boundary (saved
+    instead of rematerialized); cutting before the scores mmul keeps the
+    whole quadratic interior inside one segment, so only q/k/v-sized
+    boundaries survive (the same shape the fused anchor saves)."""
+    from .fusion import _match_site
+    from collections import Counter
+
+    anchors = [i for i, r in enumerate(sd._ops) if r.op in ANCHOR_OPS]
+    soft = [i for i, r in enumerate(sd._ops) if r.op == "act.softmax"]
+    if soft:
+        consumers: Counter = Counter()
+        for rec in sd._ops:
+            consumers.update(rec.referenced())
+        producers = {o: r for r in sd._ops for o in r.outputs}
+        idx_of = {id(r): i for i, r in enumerate(sd._ops)}
+        for idx in soft:
+            site, _reason = _match_site(sd, producers, consumers, idx)
+            if site is not None:
+                # earliest chain record == the scores mmul
+                anchors.append(min(idx_of[id(r)] for r in site["remove"]))
+    return sorted(anchors)
+
+
+def segment_bounds(sd, policy) -> List[Tuple[int, int]]:
+    """[(start, end), ...] op-index ranges covering the whole op list.
+    With attention anchors: one segment per ``policy.every`` consecutive
+    anchors, cut at the anchor op (head ops before the first anchor join
+    the first segment; tail ops after the last join the last). Without:
+    uniform sqrt-sized chunks."""
+    n = len(sd._ops)
+    if n == 0:
+        return []
+    anchors = attention_anchors(sd)
+    if anchors:
+        cuts = anchors[policy.every::policy.every]
+        bounds = []
+        prev = 0
+        for c in cuts:
+            bounds.append((prev, c))
+            prev = c
+        bounds.append((prev, n))
+        return bounds
+    size = max(1, math.isqrt(n))
+    return _memory.segment_ranges(n, size)
+
+
+def plan_segments(sd, targets: Sequence[str], policy):
+    """[(ops_slice, in_names, out_names), ...] for a rematerialized replay
+    toward ``targets``: ``in_names`` is what the segment reads from earlier
+    (initial values/feeds or previous segments' outputs), ``out_names``
+    what later segments or the targets read of its products."""
+    ops = sd._ops
+    bounds = segment_bounds(sd, policy)
+    # names available before any op runs: everything with a stored/fed value
+    available = {n for n, v in sd._vars.items() if v.kind != "ARRAY"}
+    # referenced-by-suffix sets, computed right-to-left once
+    needed_after = [set(targets)]  # needed_after[j] = reads of ops[e_j:]
+    for s, e in reversed(bounds):
+        nxt = set(needed_after[0])
+        for rec in ops[s:e]:
+            nxt.update(rec.referenced())
+        needed_after.insert(0, nxt)
+    plan = []
+    for j, (s, e) in enumerate(bounds):
+        seg_ops = ops[s:e]
+        produced = {o for rec in seg_ops for o in rec.outputs}
+        reads = set()
+        for rec in seg_ops:
+            reads.update(rec.referenced())
+        in_names = tuple(sorted((reads - produced) & available))
+        out_names = tuple(sorted(produced & needed_after[j + 1]))
+        plan.append((tuple(seg_ops), in_names, out_names))
+        available |= produced
+    return plan
+
+
+def compute_with_remat(sd, values, feeds, targets: Sequence[str], policy):
+    """Drop-in for ``SameDiff._compute`` on the training path: the same
+    topo-order replay, but each planned segment runs inside
+    ``jax.checkpoint`` under the policy's saveable rule. Returns an env
+    guaranteed to hold ``targets`` (plus every segment-boundary value)."""
+    env = {}
+    env.update(values)
+    env.update(feeds)
+    for seg_ops, in_names, out_names in plan_segments(sd, targets, policy):
+
+        def seg_fn(env_in, _ops=seg_ops, _outs=out_names):
+            e = dict(env_in)
+            sd._exec_ops(list(_ops), e)
+            return {n: e[n] for n in _outs}
+
+        env.update(_memory.checkpoint(seg_fn, policy)(
+            {n: env[n] for n in in_names}))
+    return env

@@ -209,6 +209,10 @@ class SameDiff:
         self._score = float("nan")
         self.train_config: Dict[str, Any] = {}
         self.dtype = "FLOAT"  # "BFLOAT16" = bf16 compute / fp32 masters
+        # activation-checkpoint policy for the compiled fit step
+        # (none | full | dots_saveable | every_<k> — autodiff/remat.py
+        # segments the op list at attention anchors)
+        self.workspace_mode = "none"
 
     # listener-facing Model protocol (Score/Collect/Checkpoint listeners)
     def score(self) -> float:
@@ -564,6 +568,21 @@ class SameDiff:
         self._fn_cache.pop("__fit_step__", None)
         return self
 
+    def set_workspace_mode(self, mode) -> "SameDiff":
+        """Activation-checkpoint policy for the compiled fit step
+        (engine-parity knob — ``nn/memory.py`` policies): the recorded op
+        list is segmented into transformer-block chunks at attention
+        anchors (``autodiff/remat.py``) and each segment replays inside
+        ``jax.checkpoint``, so the backward pass rematerializes block
+        interiors instead of keeping them in HBM. The policy is part of
+        the fit-step cache spec — mutating it retraces. Affects ``fit``
+        only; ``exec``/``output``/``grad`` never remat (no backward pass
+        to trade against)."""
+        from ..nn import memory as _memory
+        self.workspace_mode = _memory.resolve_policy(mode).name
+        self._fn_cache.pop("__fit_step__", None)
+        return self
+
     def set_training_config(self, updater=None, l1: float = 0.0,
                             l2: float = 0.0,
                             gradient_clip_value: Optional[float] = None,
@@ -609,46 +628,63 @@ class SameDiff:
             train, other, {k: jnp.asarray(v) for k, v in feeds.items()})
         return {k: np.asarray(v) for k, v in g.items()}
 
-    def fit(self, feeds_iter, epochs: int = 1, listeners: Optional[List] = None
-            ) -> "History":
-        """Minibatch training. feeds_iter: iterable of feed dicts (or a single
-        dict). Returns a History (loss curve + per-epoch averages — nd4j
-        ``History``†). ``listeners`` (or ones attached via set_listeners)
-        receive the same iteration_done/on_epoch_end callbacks as the nn
-        engines; ``self`` quacks enough like a Model for Score/Collect/
-        Checkpoint listeners (score(), iteration, epoch, save())."""
-        if self.loss_name is None or self.updater is None:
-            raise ValueError("set_loss(...) and set_updater(...) first")
-        feeds_list = [feeds_iter] if isinstance(feeds_iter, dict) else list(feeds_iter)
+    def _fit_loss_fn(self):
+        """The pure training loss ``(train_vals, other_vals, feeds) ->
+        scalar`` the fit step differentiates — factored out so
+        :meth:`memory_report` can account its forward→backward residuals.
+        Applies the ``workspace_mode`` remat policy: the op-list replay is
+        segmented at attention anchors and each segment rematerializes in
+        the backward pass (``autodiff/remat.py``)."""
+        loss_name = self.loss_name
+        tc = dict(self.train_config)
+        from .. import dtypes as _dt
+        from ..nn import memory as _memory
+        mixed = _dt.is_mixed(self.dtype)
+        cdt = _dt.resolve(self.dtype)
+        policy = _memory.resolve_policy(getattr(self, "workspace_mode", None))
+
+        def loss_fn(tv, other_vals, feeds):
+            vals, fd = {**other_vals, **tv}, feeds
+            if mixed:
+                # fp32 masters -> compute-dtype working copies; grads
+                # flow back through the cast into fp32 (engine parity)
+                vals = _dt.cast_floating(vals, cdt)
+                fd = _dt.cast_floating(fd, cdt)
+            if policy.remat:
+                from . import remat as _remat
+                env = _remat.compute_with_remat(self, vals, fd,
+                                                (loss_name,), policy)
+            else:
+                env = self._compute(vals, fd)
+            total = env[loss_name]
+            if mixed:  # regularization/score accumulate in fp32
+                total = jnp.asarray(total, jnp.float32)
+            if tc.get("l1"):
+                total = total + tc["l1"] * sum(
+                    jnp.sum(jnp.abs(v)) for v in tv.values())
+            if tc.get("l2"):
+                total = total + 0.5 * tc["l2"] * sum(
+                    jnp.sum(jnp.square(v)) for v in tv.values())
+            return total
+
+        return loss_fn
+
+    def _make_fit_step(self):
+        """(spec, jitted step fn) for the compiled fit step. The spec keys
+        everything the trace bakes in: loss/updater/train-config, the
+        dtype policy, the workspace_mode remat policy, the Environment's
+        f32 matmul-precision mode, and the VARIABLE set — mutating any of
+        them must retrace instead of silently reusing the old executable
+        (the cache in :meth:`fit` compares specs)."""
         loss_name = self.loss_name
         train_names = [n for n, v in self._vars.items() if v.kind == VARIABLE]
         updater = self.updater
-
         tc = dict(self.train_config)
-        from .. import dtypes as _dt
-        mixed = _dt.is_mixed(self.dtype)
-        cdt = _dt.resolve(self.dtype)
+        loss_fn = self._fit_loss_fn()
 
         def step(train_vals, opt_state, other_vals, step_i, feeds):
-            def loss_fn(tv):
-                vals, fd = {**other_vals, **tv}, feeds
-                if mixed:
-                    # fp32 masters -> compute-dtype working copies; grads
-                    # flow back through the cast into fp32 (engine parity)
-                    vals = _dt.cast_floating(vals, cdt)
-                    fd = _dt.cast_floating(fd, cdt)
-                env = self._compute(vals, fd)
-                total = env[loss_name]
-                if mixed:  # regularization/score accumulate in fp32
-                    total = jnp.asarray(total, jnp.float32)
-                if tc.get("l1"):
-                    total = total + tc["l1"] * sum(
-                        jnp.sum(jnp.abs(v)) for v in tv.values())
-                if tc.get("l2"):
-                    total = total + 0.5 * tc["l2"] * sum(
-                        jnp.sum(jnp.square(v)) for v in tv.values())
-                return total
-            loss, grads = jax.value_and_grad(loss_fn)(train_vals)
+            loss, grads = jax.value_and_grad(
+                lambda tv: loss_fn(tv, other_vals, feeds))(train_vals)
             if tc.get("grad_norm"):
                 from ..nn import gradnorm as _gn
                 # per-VARIABLE grouping: wrap each leaf as its own "layer"
@@ -667,23 +703,43 @@ class SameDiff:
             new_vals = jax.tree.map(lambda p, d: p - d, train_vals, delta)
             return new_vals, new_opt, loss
 
-        # cache ONE compiled step across fit() calls — re-jitting a large
-        # imported graph per call costs seconds (found fine-tuning
-        # BERT-base). Keyed on the updater's CONFIG (hyperparameters are
-        # baked into the trace, so mutating them must retrace), and only the
-        # latest step is kept (old compiled executables for big graphs are
-        # device memory worth releasing).
         import json as _json
+        from .. import environment as _envmod
         spec = ("fit", loss_name,
                 _json.dumps(updater.to_dict(), sort_keys=True, default=str),
                 _json.dumps(self.train_config, sort_keys=True, default=str),
-                str(self.dtype), tuple(train_names))
+                str(self.dtype),
+                str(getattr(self, "workspace_mode", "none")),
+                str(_envmod.Environment.instance().f32_matmul_precision),
+                tuple(train_names))
+        return spec, jax.jit(step, donate_argnums=(0, 1))
+
+    def _fit_step_cached(self):
+        """The cached compiled fit step (built if absent/stale). ONE step
+        is kept across fit() calls — re-jitting a large imported graph per
+        call costs seconds (found fine-tuning BERT-base); old compiled
+        executables for big graphs are device memory worth releasing."""
+        spec, step = self._make_fit_step()
         cached = self._fn_cache.get("__fit_step__")
         if cached is not None and cached[0] == spec:
-            step = cached[1]
-        else:
-            step = jax.jit(step, donate_argnums=(0, 1))
-            self._fn_cache["__fit_step__"] = (spec, step)
+            return cached[1]
+        self._fn_cache["__fit_step__"] = (spec, step)
+        return step
+
+    def fit(self, feeds_iter, epochs: int = 1, listeners: Optional[List] = None
+            ) -> "History":
+        """Minibatch training. feeds_iter: iterable of feed dicts (or a single
+        dict). Returns a History (loss curve + per-epoch averages — nd4j
+        ``History``†). ``listeners`` (or ones attached via set_listeners)
+        receive the same iteration_done/on_epoch_end callbacks as the nn
+        engines; ``self`` quacks enough like a Model for Score/Collect/
+        Checkpoint listeners (score(), iteration, epoch, save())."""
+        if self.loss_name is None or self.updater is None:
+            raise ValueError("set_loss(...) and set_updater(...) first")
+        feeds_list = [feeds_iter] if isinstance(feeds_iter, dict) else list(feeds_iter)
+        train_names = [n for n, v in self._vars.items() if v.kind == VARIABLE]
+        updater = self.updater
+        step = self._fit_step_cached()
         train_vals = {n: self._values[n] for n in train_names}
         other_vals = {n: v for n, v in self._values.items()
                       if n not in train_names}
@@ -737,6 +793,56 @@ class SameDiff:
             ev.eval(labels, out)
         return ev
 
+    # ---------------------------------------------------- memory accounting
+    def memory_report(self, feeds: Dict[str, Any]) -> dict:
+        """Compiled-HBM accounting of the fit step for one example feed
+        dict (arrays OR ``jax.ShapeDtypeStruct``s — only shapes/dtypes are
+        read): AOT lower+compile of the REAL compiled step (nothing
+        executes, nothing allocates) exposing XLA ``memory_analysis()``
+        temp/argument/output bytes, the forward→backward
+        ``activation_bytes`` the workspace_mode remat shrinks, and live
+        device ``memory_stats()``. Engine-parity twin of
+        ``MultiLayerNetwork.memory_report`` (``nn/memory.py``); fields
+        degrade to None on PJRT builds without the API."""
+        if self.loss_name is None or self.updater is None:
+            raise ValueError("set_loss(...) and set_updater(...) first")
+        from ..nn import memory as _memory
+        step = self._fit_step_cached()
+        train_names = [n for n, v in self._vars.items() if v.kind == VARIABLE]
+        tv = {n: self._values[n] for n in train_names}
+        ov = {n: v for n, v in self._values.items() if n not in tv}
+        tv_avals = jax.eval_shape(lambda: tv)
+        ov_avals = jax.eval_shape(lambda: ov)
+        opt_avals = jax.eval_shape(lambda: self.updater.init_state(tv))
+        feeds_avals = {
+            k: (v if isinstance(v, jax.ShapeDtypeStruct) else
+                jax.ShapeDtypeStruct(np.asarray(v).shape,
+                                     np.asarray(v).dtype))
+            for k, v in feeds.items()}
+        batch = next((int(a.shape[0]) for a in feeds_avals.values()
+                      if len(a.shape)), None)
+        report = {
+            "workspace_mode": str(getattr(self, "workspace_mode", "none")),
+            "batch_size": batch,
+            "temp_bytes": None, "argument_bytes": None, "output_bytes": None,
+            "alias_bytes": None, "generated_code_bytes": None,
+            "peak_bytes": None,
+            "residual_bytes": None, "activation_bytes": None,
+            "residual_count": None,
+            "device": _memory.device_memory_stats(),
+        }
+        compiled = step.lower(tv_avals, opt_avals, ov_avals,
+                              jax.ShapeDtypeStruct((), jnp.int32),
+                              feeds_avals).compile()
+        cm = _memory.compiled_memory(compiled)
+        if cm:
+            report.update(cm)
+        rb = _memory.residual_bytes(self._fit_loss_fn(), tv_avals,
+                                    ov_avals, feeds_avals)
+        if rb:
+            report.update(rb)
+        return report
+
     # ------------------------------------------------------------ accessors
     def get_value(self, name: str) -> np.ndarray:
         return np.asarray(self._values[name])
@@ -762,6 +868,7 @@ class SameDiff:
             "loss": self.loss_name,
             "updater": self.updater.to_dict() if self.updater else None,
             "training_config": self.train_config or None,
+            "workspace_mode": self.workspace_mode,
         }, indent=2)
 
     @staticmethod
@@ -780,6 +887,7 @@ class SameDiff:
         if d.get("updater"):
             sd.updater = _upd.Updater.from_dict(d["updater"])
         sd.train_config = d.get("training_config") or {}
+        sd.workspace_mode = d.get("workspace_mode", "none")
         return sd
 
     def save(self, path: str) -> None:

@@ -55,6 +55,54 @@ class CompiledCacheMixin:
         self._invalidate_compiled()
         return self
 
+    def set_workspace_mode(self, mode: str):
+        """Switch the activation-checkpoint policy in place (DL4J
+        ``setCacheMode``/workspace-mode role; see ``nn/memory.py``):
+        ``none`` | ``full`` | ``dots_saveable`` | ``every_<k>``. The remat
+        policy is baked into the compiled train/epoch programs at trace
+        time, so every cached trace is invalidated — mutating the policy
+        RETRACES instead of silently serving the old executable. (A
+        ``ParallelWrapper`` built before the mutation holds its own step;
+        rebuild it the same way as after ``set_dtype``.)"""
+        from . import memory as _memory
+        policy = _memory.resolve_policy(mode)  # validate before mutating
+        self.conf = self._replace_conf_workspace_mode(policy.name)
+        self._invalidate_compiled()
+        return self
+
+    def _replace_conf_workspace_mode(self, mode: str):
+        # same copy-on-write contract as _replace_conf_dtype; both engines'
+        # confs carry a plain `workspace_mode` str field
+        import copy
+        import dataclasses
+        conf = self.conf
+        if dataclasses.is_dataclass(conf):
+            return dataclasses.replace(conf, workspace_mode=mode)
+        conf = copy.copy(conf)
+        conf.workspace_mode = mode
+        return conf
+
+    def memory_report(self, batch_size: int, accum_steps: int = 1,
+                      seq_len=None) -> dict:
+        """Compiled-HBM accounting for THIS model's train step at
+        ``batch_size`` — AOT lower+compile (nothing executes) exposing
+        XLA's ``memory_analysis()`` temp/argument/output bytes, the
+        forward→backward ``activation_bytes`` the workspace_mode remat
+        shrinks, and live ``device.memory_stats()``. See
+        ``nn.memory.memory_report``."""
+        from . import memory as _memory
+        return _memory.memory_report(self, batch_size,
+                                     accum_steps=accum_steps,
+                                     seq_len=seq_len)
+
+    def max_batch(self, bytes_limit=None, **kwargs):
+        """Largest power-of-two batch whose train step fits in
+        ``bytes_limit`` HBM (defaults to the device's live
+        ``bytes_limit``), found by AOT lower+compile — no OOM probing.
+        See ``nn.memory.max_batch``."""
+        from . import memory as _memory
+        return _memory.max_batch(self, bytes_limit, **kwargs)
+
     def inference_engine(self, **kwargs):
         """The model's serving engine (``serving.engine.InferenceEngine``),
         created lazily; ``output()`` routes through it. Pass kwargs (e.g.

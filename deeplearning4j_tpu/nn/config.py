@@ -68,6 +68,9 @@ class MultiLayerConfiguration:
     optimization_algo: str = "SGD"
     solver_iterations: int = 5                       # per-batch solver iters
     max_line_search_iterations: int = 5              # BackTrackLineSearch
+    #: activation-checkpoint policy for the fused train step
+    #: (none | full | dots_saveable | every_<k> — see nn/memory.py)
+    workspace_mode: str = "none"
 
     def to_json(self) -> str:
         d = {
@@ -89,6 +92,7 @@ class MultiLayerConfiguration:
             "optimization_algo": self.optimization_algo,
             "solver_iterations": self.solver_iterations,
             "max_line_search_iterations": self.max_line_search_iterations,
+            "workspace_mode": self.workspace_mode,
             "layers": [l.to_dict() for l in self.layers],
         }
         return json.dumps(d, indent=2)
@@ -114,6 +118,7 @@ class MultiLayerConfiguration:
             optimization_algo=d.get("optimization_algo", "SGD"),
             solver_iterations=d.get("solver_iterations", 5),
             max_line_search_iterations=d.get("max_line_search_iterations", 5),
+            workspace_mode=d.get("workspace_mode", "none"),
         )
 
 
@@ -137,6 +142,7 @@ class NeuralNetConfiguration:
         self._opt_algo = "SGD"
         self._solver_iterations = 5
         self._max_ls_iterations = 5
+        self._workspace_mode = "none"
 
     @staticmethod
     def builder() -> "NeuralNetConfiguration":
@@ -201,6 +207,21 @@ class NeuralNetConfiguration:
         self._tbptt = n
         return self
 
+    def workspace_mode(self, mode: str):
+        """Activation-checkpoint policy for the fused train step (DL4J
+        ``trainingWorkspaceMode``/``cacheMode`` role): ``none`` (cache every
+        activation — default), ``full`` (remat every block), ``dots_saveable``
+        (remat but keep matmul outputs), ``every_<k>`` (remat segments of k
+        blocks). See ``nn/memory.py``."""
+        from . import memory as _memory
+        _memory.resolve_policy(mode)  # validate at build time
+        self._workspace_mode = str(mode).strip().lower()
+        return self
+
+    # DL4J spelling
+    def training_workspace_mode(self, mode: str):
+        return self.workspace_mode(mode)
+
     def constrain_weights(self, *cs):
         """Apply constraints to weight params after every update (DL4J
         ``constrainWeights``)."""
@@ -257,7 +278,8 @@ class NeuralNetConfiguration:
             tbptt_length=self._tbptt, constraints=self._constraints or None,
             optimization_algo=self._opt_algo,
             solver_iterations=self._solver_iterations,
-            max_line_search_iterations=self._max_ls_iterations)
+            max_line_search_iterations=self._max_ls_iterations,
+            workspace_mode=self._workspace_mode)
 
 
 def stamp_tbptt(layer: Layer, tbptt: int) -> Layer:

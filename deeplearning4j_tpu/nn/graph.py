@@ -69,7 +69,8 @@ class ComputationGraphConfiguration:
                  gradient_normalization: Optional[str] = None,
                  gradient_normalization_threshold: float = 1.0,
                  tbptt_length: Optional[int] = None,
-                 constraints: Any = None):
+                 constraints: Any = None,
+                 workspace_mode: str = "none"):
         self.inputs = list(inputs)
         self.outputs = list(outputs)
         self.vertices = list(vertices)  # [(name, vertex, [input names])]
@@ -87,6 +88,9 @@ class ComputationGraphConfiguration:
         self.gradient_normalization_threshold = gradient_normalization_threshold
         self.tbptt_length = tbptt_length
         self.constraints = constraints
+        from . import memory as _memory
+        _memory.resolve_policy(workspace_mode)  # validate at build time
+        self.workspace_mode = str(workspace_mode).strip().lower()
         self._validate()
 
     def _validate(self):
@@ -142,6 +146,7 @@ class ComputationGraphConfiguration:
                 self.gradient_normalization_threshold,
             "tbptt_length": self.tbptt_length,
             "constraints": _constraints.encode_constraints(self.constraints),
+            "workspace_mode": self.workspace_mode,
             "network_inputs": self.inputs,
             "network_outputs": self.outputs,
             "input_shapes": {k: list(v) for k, v in self.input_shapes.items()},
@@ -167,7 +172,8 @@ class ComputationGraphConfiguration:
             gradient_normalization_threshold=d.get(
                 "gradient_normalization_threshold", 1.0),
             tbptt_length=d.get("tbptt_length"),
-            constraints=_constraints.decode_constraints(d.get("constraints")))
+            constraints=_constraints.decode_constraints(d.get("constraints")),
+            workspace_mode=d.get("workspace_mode", "none"))
 
 
 class GraphBuilder:
@@ -232,7 +238,8 @@ class GraphBuilder:
             gradient_normalization_threshold=(
                 b._grad_norm_threshold if b else 1.0),
             tbptt_length=b._tbptt if b else None,
-            constraints=(b._constraints or None) if b else None)
+            constraints=(b._constraints or None) if b else None,
+            workspace_mode=b._workspace_mode if b else "none")
 
 
 class ComputationGraph(_caches.CompiledCacheMixin):
@@ -321,9 +328,16 @@ class ComputationGraph(_caches.CompiledCacheMixin):
 
     # --------------------------------------------------------------- forward
     def _forward(self, params, inputs: Dict[str, jax.Array], state, *,
-                 train, rng, masks: Optional[Dict[str, Any]] = None):
+                 train, rng, masks: Optional[Dict[str, Any]] = None,
+                 remat_policy=None):
         """Pure topo walk. Returns ({vertex: activation}, new_state,
-        {vertex: mask}) for output vertices."""
+        {vertex: mask}) for output vertices.
+
+        ``remat_policy`` (a resolved ``nn.memory.RematPolicy``) wraps the
+        walk in per-segment ``jax.checkpoint`` — only the train-step loss
+        path passes it (the workspace_mode knob); on that path the
+        returned ``acts``/``masks`` dicts hold the network OUTPUT vertices
+        only (the loss consumes nothing else)."""
         dt = _dt.resolve(self.conf.dtype)
         if jnp.issubdtype(dt, jnp.floating):
             inputs = {k: (jnp.asarray(v, dt)
@@ -335,6 +349,10 @@ class ComputationGraph(_caches.CompiledCacheMixin):
             # fp32 masters -> compute-dtype working copy; grads flow back
             # through the cast and land in fp32
             params = _dt.cast_floating(params, dt)
+        if remat_policy is not None and remat_policy.remat:
+            return self._forward_remat(params, inputs, state, train=train,
+                                       rng=rng, masks=masks,
+                                       policy=remat_policy)
         acts: Dict[str, jax.Array] = dict(inputs)
         mks: Dict[str, Any] = dict(masks or {})
         new_state = dict(state)
@@ -352,6 +370,70 @@ class ComputationGraph(_caches.CompiledCacheMixin):
             mks[name] = m
             if s_new:
                 new_state[name] = s_new
+        return acts, new_state, mks
+
+    def _forward_remat(self, params, inputs, state, *, train, rng, masks,
+                       policy):
+        """The same topo walk, segmented into ``policy.every``-vertex
+        chunks each wrapped in ``jax.checkpoint``. The activation dict is
+        pruned to the LIVE set at every segment boundary (names still read
+        by later vertices, or network outputs) — those boundary values are
+        what XLA keeps; everything inside a segment is rematerialized in
+        the backward pass. Skip connections spanning segments ride through
+        as checkpoint pass-through args. The rng stream threads through
+        with the exact split sequence of the plain walk (remat on/off is
+        bit-equivalent, dropout included). ``params``/``inputs`` arrive
+        already cast."""
+        from . import memory as _memory
+        topo = self._topo
+        bounds = _memory.segment_ranges(len(topo), policy.every)
+        # needed_after[j] = names read by any vertex in bounds[j:], plus
+        # the network outputs — ONE right-to-left suffix pass (quadratic
+        # per-segment rescans would bite trace time on imported graphs)
+        needed_after = [set(self.conf.outputs)]
+        for s, e in reversed(bounds):
+            nxt = set(needed_after[-1])
+            for n in topo[s:e]:
+                nxt.update(self._vertex_map[n][1])
+            needed_after.append(nxt)
+        needed_after.reverse()
+        acts: Dict[str, jax.Array] = dict(inputs)
+        mks: Dict[str, Any] = dict(masks or {})
+        new_state = dict(state)
+        for j, (s, e) in enumerate(bounds):
+            seg_names = tuple(topo[s:e])
+            # live set after this segment: anything a later vertex reads,
+            # plus the network outputs
+            live_out = tuple(sorted(
+                (set(acts) | set(seg_names)) & needed_after[j + 1]))
+
+            def seg_fn(seg_params, seg_state, carry_acts, carry_mks, rng,
+                       _names=seg_names, _out=live_out):
+                a = dict(carry_acts)
+                m = dict(carry_mks)
+                ns = {}
+                for name in _names:
+                    v, ins = self._vertex_map[name]
+                    if rng is not None and v.stochastic:
+                        rng, sub = jax.random.split(rng)
+                    else:
+                        sub = None
+                    y, s_new, mk = v.apply(
+                        seg_params.get(name, {}), [a[i] for i in ins],
+                        seg_state.get(name, {}), train=train, rng=sub,
+                        masks=[m.get(i) for i in ins])
+                    a[name] = y
+                    m[name] = mk
+                    if s_new:
+                        ns[name] = s_new
+                return ({n: a[n] for n in _out},
+                        {n: m.get(n) for n in _out}, ns, rng)
+
+            seg_params = {n: params[n] for n in seg_names if n in params}
+            seg_state = {n: state[n] for n in seg_names if n in state}
+            acts, mks, ns, rng = _memory.checkpoint(seg_fn, policy)(
+                seg_params, seg_state, acts, mks, rng)
+            new_state.update(ns)
         return acts, new_state, mks
 
     def _regularization(self, params):
@@ -389,32 +471,30 @@ class ComputationGraph(_caches.CompiledCacheMixin):
         return grads
 
     # ------------------------------------------------------------ train step
-    def _build_train_step(self, accum_steps: int = 1):
-        """Fused pure train step; ``accum_steps=k`` scans the gradient over
-        k microbatches before the single updater application (same contract
-        as ``MultiLayerNetwork._build_train_step`` — see
-        ``nn/microbatch.py``)."""
-        updater = self.conf.updater
+    def _build_loss_fn(self):
+        """The pure training loss ``(params, bn_state, key, xs, ys, fms,
+        lms) -> (loss, new_bn_state)`` the train step differentiates —
+        factored out so ``nn/memory.py`` can account its forward→backward
+        residuals without building a step. Applies the conf's
+        ``workspace_mode`` remat policy to the topo walk."""
         outputs = self.conf.outputs
-        from .layers.wrappers import FrozenLayer
-        from .vertices import LayerVertex
-        from . import microbatch as _micro
-        frozen_keys = frozenset(
-            n for n, v, _ in self.conf.vertices
-            if isinstance(v, LayerVertex) and isinstance(v.layer, FrozenLayer))
         out_layers = self._out_layers
         if set(out_layers) != set(outputs):
             bad = sorted(set(outputs) - set(out_layers))
             raise ValueError(
                 f"output vertices {bad} are not Output/Loss layers; fit() "
                 "needs a loss head on every network output")
+        from . import memory as _memory
+        policy = _memory.resolve_policy(
+            getattr(self.conf, "workspace_mode", None))
 
         def loss_fn(p, bn_state, key, xs, ys, fms, lms):
             inputs = dict(zip(self.conf.inputs, xs))
             masks = {n: m for n, m in zip(self.conf.inputs, fms)
                      if m is not None}
             acts, new_bn, mks = self._forward(
-                p, inputs, bn_state, train=True, rng=key, masks=masks)
+                p, inputs, bn_state, train=True, rng=key, masks=masks,
+                remat_policy=policy)
             total = 0.0
             for o, y, lm in zip(outputs, ys, lms):
                 layer = out_layers[o]
@@ -442,7 +522,22 @@ class ComputationGraph(_caches.CompiledCacheMixin):
                         weights=getattr(layer, "loss_weights", None))
             return total + self._regularization(p), new_bn
 
-        vg_fn = jax.value_and_grad(loss_fn, has_aux=True)
+        return loss_fn
+
+    def _build_train_step(self, accum_steps: int = 1):
+        """Fused pure train step; ``accum_steps=k`` scans the gradient over
+        k microbatches before the single updater application (same contract
+        as ``MultiLayerNetwork._build_train_step`` — see
+        ``nn/microbatch.py``). The conf's ``workspace_mode`` remat policy
+        (``nn/memory.py``) composes with both."""
+        updater = self.conf.updater
+        from .layers.wrappers import FrozenLayer
+        from .vertices import LayerVertex
+        from . import microbatch as _micro
+        frozen_keys = frozenset(
+            n for n, v, _ in self.conf.vertices
+            if isinstance(v, LayerVertex) and isinstance(v.layer, FrozenLayer))
+        vg_fn = jax.value_and_grad(self._build_loss_fn(), has_aux=True)
 
         def step_fn(params, opt_state, bn_state, step, key, xs, ys, fms, lms):
             if accum_steps == 1:

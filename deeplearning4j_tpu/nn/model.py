@@ -137,10 +137,16 @@ class MultiLayerNetwork(_caches.CompiledCacheMixin):
 
     # --------------------------------------------------------------- forward
     def _forward(self, params, x, state, *, train, rng, mask=None,
-                 collect=False):
+                 collect=False, remat_policy=None):
         """Pure layer stack walk. Returns (out, new_state, mask), or
         (acts_list, new_state, mask) with ``collect=True`` (acts_list is
-        [input, layer0_out, ...] — feedForward semantics)."""
+        [input, layer0_out, ...] — feedForward semantics).
+
+        ``remat_policy`` (a resolved ``nn.memory.RematPolicy``) wraps the
+        walk in per-segment ``jax.checkpoint`` so the backward pass
+        recomputes intra-segment activations instead of keeping them —
+        only the train-step loss path passes it (the workspace_mode knob);
+        identical numerics, identical rng stream (tested)."""
         dt = _dt.resolve(self.conf.dtype)
         if jnp.issubdtype(dt, jnp.floating) and \
                 jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating) and \
@@ -150,6 +156,10 @@ class MultiLayerNetwork(_caches.CompiledCacheMixin):
             # fp32 masters -> compute-dtype working copy; grads flow back
             # through the cast and land in fp32
             params = _dt.cast_floating(params, dt)
+        if remat_policy is not None and remat_policy.remat and not collect:
+            return self._forward_remat(params, x, state, train=train,
+                                       rng=rng, mask=mask,
+                                       policy=remat_policy)
         new_state = dict(state)
         acts = [x]
         for i, layer in enumerate(self.layers):
@@ -166,6 +176,46 @@ class MultiLayerNetwork(_caches.CompiledCacheMixin):
             if s_new:
                 new_state[si] = s_new
         return (acts if collect else x), new_state, mask
+
+    def _forward_remat(self, params, x, state, *, train, rng, mask, policy):
+        """The same layer walk, segmented into ``policy.every``-layer
+        chunks each wrapped in ``jax.checkpoint``: XLA keeps only segment
+        boundaries (plus whatever the policy's ``saveable`` rule allows —
+        e.g. matmul outputs under ``dots_saveable``) and rematerializes the
+        rest during the backward pass. The rng stream threads THROUGH the
+        segments with the exact split sequence of the plain walk, so remat
+        on/off is bit-equivalent even with dropout. ``params`` arrive
+        already cast (``_forward`` handles dtype policy before dispatching
+        here)."""
+        from . import memory as _memory
+        new_state = dict(state)
+        for s, e in _memory.segment_ranges(len(self.layers), policy.every):
+            seg = list(range(s, e))
+
+            def seg_fn(seg_params, seg_state, x, mask, rng, _seg=tuple(seg)):
+                ns = {}
+                for i in _seg:
+                    layer = self.layers[i]
+                    si = str(i)
+                    if rng is not None and getattr(layer, "stochastic", True):
+                        rng, sub = jax.random.split(rng)
+                    else:
+                        sub = None
+                    x, s_new, mask = layer.apply(
+                        seg_params.get(si, {}), x, seg_state.get(si, {}),
+                        train=train, rng=sub, mask=mask)
+                    if s_new:
+                        ns[si] = s_new
+                return x, ns, mask, rng
+
+            seg_params = {str(i): params[str(i)] for i in seg
+                          if str(i) in params}
+            seg_state = {str(i): state[str(i)] for i in seg
+                         if str(i) in state}
+            x, ns, mask, rng = _memory.checkpoint(seg_fn, policy)(
+                seg_params, seg_state, x, mask, rng)
+            new_state.update(ns)
+        return x, new_state, mask
 
     def _regularization(self, params):
         """Per-layer l1/l2 on weights (DL4J regularizes W, not b, by default)."""
@@ -202,25 +252,23 @@ class MultiLayerNetwork(_caches.CompiledCacheMixin):
         return grads
 
     # ------------------------------------------------------------- train step
-    def _build_train_step(self, accum_steps: int = 1):
-        """Fused pure train step. ``accum_steps=k`` splits the batch into k
-        microbatches and accumulates the mean gradient via ``lax.scan``
-        before the SINGLE updater application (see ``nn/microbatch.py`` for
-        the exactness contract) — peak activation memory drops to one
-        microbatch, so global batch can grow past HBM."""
-        updater = self.conf.updater
+    def _build_loss_fn(self):
+        """The pure training loss ``(params, bn_state, key, x, y, fmask,
+        lmask) -> (loss, new_bn_state)`` the train step differentiates —
+        factored out so ``nn/memory.py`` can account its forward→backward
+        residuals without building a step. Applies the conf's
+        ``workspace_mode`` remat policy to the forward walk."""
         out_layer = self._out_layer
-
         ol_key = str(len(self.layers) - 1)
         center_loss = hasattr(out_layer, "update_centers")
-        from .layers.wrappers import FrozenLayer
-        from . import microbatch as _micro
-        frozen_keys = frozenset(str(i) for i, l in enumerate(self.layers)
-                                if isinstance(l, FrozenLayer))
+        from . import memory as _memory
+        policy = _memory.resolve_policy(
+            getattr(self.conf, "workspace_mode", None))
 
         def loss_fn(p, bn_state, key, x, y, fmask, lmask):
             out, new_bn, out_mask = self._forward(
-                p, x, bn_state, train=True, rng=key, mask=fmask)
+                p, x, bn_state, train=True, rng=key, mask=fmask,
+                remat_policy=policy)
             # intersect, don't override: an explicit label mask (e.g. the
             # DP pad mask) and the propagated feature mask must BOTH hold
             lm = _loss.combine_masks(lmask, out_mask)
@@ -247,7 +295,23 @@ class MultiLayerNetwork(_caches.CompiledCacheMixin):
                     weights=getattr(out_layer, "loss_weights", None))
             return data_loss + self._regularization(p), new_bn
 
-        vg_fn = jax.value_and_grad(loss_fn, has_aux=True)
+        return loss_fn
+
+    def _build_train_step(self, accum_steps: int = 1):
+        """Fused pure train step. ``accum_steps=k`` splits the batch into k
+        microbatches and accumulates the mean gradient via ``lax.scan``
+        before the SINGLE updater application (see ``nn/microbatch.py`` for
+        the exactness contract) — peak activation memory drops to one
+        microbatch, so global batch can grow past HBM. The conf's
+        ``workspace_mode`` remat policy (``nn/memory.py``) composes: inside
+        each microbatch, intra-segment activations are recomputed in the
+        backward pass instead of cached."""
+        updater = self.conf.updater
+        from .layers.wrappers import FrozenLayer
+        from . import microbatch as _micro
+        frozen_keys = frozenset(str(i) for i, l in enumerate(self.layers)
+                                if isinstance(l, FrozenLayer))
+        vg_fn = jax.value_and_grad(self._build_loss_fn(), has_aux=True)
 
         def step_fn(params, opt_state, bn_state, step, key, x, y, fmask, lmask):
             if accum_steps == 1:

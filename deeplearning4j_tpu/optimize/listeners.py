@@ -55,20 +55,26 @@ class CollectScoresListener(TrainingListener):
 class PerformanceListener(TrainingListener):
     """Throughput tracking: examples/sec, iterations/sec (DL4J
     PerformanceListener), plus optional MFU given a per-example FLOP count —
-    the TPU-era metric the reference lacked (SURVEY.md §5 tracing row)."""
+    the TPU-era metric the reference lacked (SURVEY.md §5 tracing row) —
+    and per-interval device HBM telemetry (PJRT ``memory_stats()``:
+    peak_bytes_in_use/bytes_limit; ``last_memory`` stays None on backends
+    like CPU that don't report them)."""
 
     def __init__(self, frequency: int = 10, batch_size: Optional[int] = None,
                  flops_per_example: Optional[float] = None,
-                 peak_flops: Optional[float] = None, printer: Callable = None):
+                 peak_flops: Optional[float] = None, printer: Callable = None,
+                 collect_memory: bool = True):
         self.frequency = max(1, frequency)
         self.batch_size = batch_size
         self.flops_per_example = flops_per_example
         self.peak_flops = peak_flops or _detect_peak_flops()
+        self.collect_memory = collect_memory
         self._print = printer or (lambda s: log.info(s))
         self._t0 = None
         self._it0 = 0
         self.last_examples_per_sec = float("nan")
         self.last_mfu = float("nan")
+        self.last_memory: Optional[dict] = None
 
     def iteration_done(self, model, iteration, epoch):
         now = time.perf_counter()
@@ -92,6 +98,13 @@ class PerformanceListener(TrainingListener):
                 # 3x fwd flops approximates fwd+bwd
                 self.last_mfu = 3 * self.flops_per_example * eps / self.peak_flops
                 msg += f", MFU {self.last_mfu * 100:.1f}%"
+        if self.collect_memory:
+            from ..nn.memory import device_memory_stats
+            self.last_memory = device_memory_stats()
+            if self.last_memory:
+                msg += (f", hbm peak "
+                        f"{self.last_memory['peak_bytes_in_use'] / 2**30:.2f}"
+                        f"/{self.last_memory['bytes_limit'] / 2**30:.2f} GiB")
         self._print(msg)
         self._t0 = now
         self._it0 = iteration

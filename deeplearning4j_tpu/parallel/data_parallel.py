@@ -215,6 +215,29 @@ class ParallelWrapper:
     def _update_shardings(self, params):
         return self._shardings(params, self._update_spec)
 
+    def _sharding_trees(self):
+        """(repl, data, params, updater-state-slot, opt_state, bn_state,
+        params-structure) sharding trees for the step's carried arguments —
+        the ONE place the opt-state placement rule lives, shared by
+        ``_build`` (out_shardings / per-step placement) and
+        ``memory_report`` (sharded avals for AOT lowering)."""
+        from jax.tree_util import tree_structure
+        repl = NamedSharding(self.mesh, P())
+        data = NamedSharding(self.mesh, P("data"))
+        p_sh = self._param_shardings(self.model.params)
+        upd_sh = self._update_shardings(self.model.params) \
+            if self.shard_update else p_sh
+        p_struct = tree_structure(self.model.params)
+        opt = self.model.updater_state
+        if isinstance(opt, dict):
+            opt_sh = {k: (upd_sh if tree_structure(sub) == p_struct
+                          else jax.tree.map(lambda a: repl, sub))
+                      for k, sub in opt.items()}
+        else:
+            opt_sh = jax.tree.map(lambda a: repl, opt)
+        bn_sh = jax.tree.map(lambda a: repl, self.model.state)
+        return repl, data, p_sh, upd_sh, opt_sh, bn_sh, p_struct
+
     def _build(self):
         mesh = self.mesh
         repl = NamedSharding(mesh, P())
@@ -239,18 +262,7 @@ class ParallelWrapper:
         # weights — no hand-written collectives anywhere.
         pure = self.model._build_train_step(self.accum_steps).__wrapped__
         from jax.tree_util import tree_structure
-        p_sh = self._param_shardings(self.model.params)
-        upd_sh = self._update_shardings(self.model.params) \
-            if self.shard_update else p_sh
-        p_struct = tree_structure(self.model.params)
-        opt = self.model.updater_state
-        if isinstance(opt, dict):
-            opt_sh = {k: (upd_sh if tree_structure(sub) == p_struct
-                          else jax.tree.map(lambda a: repl, sub))
-                      for k, sub in opt.items()}
-        else:
-            opt_sh = jax.tree.map(lambda a: repl, opt)
-        bn_sh = jax.tree.map(lambda a: repl, self.model.state)
+        _, _, p_sh, upd_sh, opt_sh, bn_sh, p_struct = self._sharding_trees()
         step_fn = jax.jit(
             pure, donate_argnums=(0, 1, 2),
             out_shardings=(p_sh, opt_sh, bn_sh, repl),
@@ -303,6 +315,54 @@ class ParallelWrapper:
                     shard_batch(fm), shard_batch(lm))
 
         return step_fn, shard_args
+
+    def memory_report(self, batch_size: int, seq_len=None) -> dict:
+        """Compiled-HBM accounting of THIS wrapper's sharded train step
+        (GSPMD program — the per-device memory_analysis view) at the
+        GLOBAL ``batch_size``, via AOT lower+compile (nothing executes).
+        Same fields as ``model.memory_report`` (``nn/memory.py``); the
+        conf's ``workspace_mode`` remat policy and ``shard_update``/
+        ``accum_steps`` are all baked into the measured program."""
+        from ..nn import memory as _memory
+        m = self.model
+        if not m.params:
+            m.init()
+        if self._step is None:
+            self._step = self._build()
+        step_fn, _ = self._step
+        repl, data, p_sh, _, opt_sh, bn_sh, _ = self._sharding_trees()
+
+        def sds(aval, sh):
+            return jax.ShapeDtypeStruct(aval.shape, aval.dtype, sharding=sh)
+
+        x, y = _memory._batch_avals(m, batch_size, seq_len)
+        x = jax.tree.map(lambda a: sds(a, data), x)
+        y = jax.tree.map(lambda a: sds(a, data), y)
+        fm = (None,) * len(x) if isinstance(x, tuple) else None
+        lm = (None,) * len(y) if isinstance(y, tuple) else None
+        report = {
+            "workspace_mode": str(getattr(m.conf, "workspace_mode", "none")),
+            "batch_size": int(batch_size),
+            "accum_steps": self.accum_steps,
+            "shard_update": self.shard_update,
+            "devices": int(self.mesh.devices.size),
+            "temp_bytes": None, "argument_bytes": None, "output_bytes": None,
+            "alias_bytes": None, "generated_code_bytes": None,
+            "peak_bytes": None,
+            "device": _memory.device_memory_stats(),
+        }
+        compiled = step_fn.lower(
+            jax.tree.map(sds, jax.eval_shape(lambda: m.params), p_sh),
+            jax.tree.map(sds, jax.eval_shape(lambda: m.updater_state),
+                         opt_sh),
+            jax.tree.map(sds, jax.eval_shape(lambda: m.state), bn_sh),
+            jax.ShapeDtypeStruct((), jnp.int32, sharding=repl),
+            sds(jax.eval_shape(lambda: jax.random.PRNGKey(0)), repl),
+            x, y, fm, lm).compile()
+        cm = _memory.compiled_memory(compiled)
+        if cm:
+            report.update(cm)
+        return report
 
     def serving_engine(self, **kwargs):
         """A ``serving.engine.InferenceEngine`` over THIS wrapper's mesh:

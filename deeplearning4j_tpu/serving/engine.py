@@ -196,8 +196,29 @@ class InferenceEngine:
                 tuple(None if m is None else tuple(m.shape)
                       for m in masks_avals), fp)
 
+    def _lower_bucket(self, xs_avals, masks_avals):
+        """AOT-lowered (not yet compiled) program for one bucket, with the
+        SAME sharding pinning as the serving executables — `_get_compiled`
+        compiles these into the cache; `max_batch` compiles them for
+        memory accounting only (identical program, so the per-device
+        `memory_analysis` describes what serving will actually hold)."""
+        _fp, p_sh, s_sh = self._params_placement()
+        params_avals = jax.eval_shape(lambda: self.model.params)
+        state_avals = jax.eval_shape(lambda: self.model.state)
+        xs_sh, masks_sh = self._shardings(xs_avals, masks_avals)
+        in_sh = None
+        if p_sh is not None:
+            # pin the executable to the params' actual placement (keeps
+            # TP-sharded leaves sharded; replicated stays replicated)
+            in_sh = (p_sh, s_sh, xs_sh, masks_sh)
+        fn = self._forward_fn()
+        jitted = jax.jit(fn) if in_sh is None else \
+            jax.jit(fn, in_shardings=in_sh)
+        return jitted.lower(params_avals, state_avals,
+                            tuple(xs_avals), tuple(masks_avals))
+
     def _get_compiled(self, xs_avals, masks_avals, _warmup=False):
-        fp, p_sh, s_sh = self._params_placement()
+        fp = self._params_placement()[0]
         key = self._key_of(xs_avals, masks_avals, fp)
         with self._lock:
             exe = self._compiled.get(key)
@@ -206,60 +227,119 @@ class InferenceEngine:
                     self.hits += 1
                     self.bucket_hits[key] = self.bucket_hits.get(key, 0) + 1
                 return exe
-            params_avals = jax.eval_shape(lambda: self.model.params)
-            state_avals = jax.eval_shape(lambda: self.model.state)
-            xs_sh, masks_sh = self._shardings(xs_avals, masks_avals)
-            in_sh = None
-            if p_sh is not None:
-                # pin the executable to the params' actual placement (keeps
-                # TP-sharded leaves sharded; replicated stays replicated)
-                in_sh = (p_sh, s_sh, xs_sh, masks_sh)
-            fn = self._forward_fn()
-            jitted = jax.jit(fn) if in_sh is None else \
-                jax.jit(fn, in_shardings=in_sh)
-            exe = jitted.lower(params_avals, state_avals,
-                               tuple(xs_avals), tuple(masks_avals)).compile()
+            exe = self._lower_bucket(xs_avals, masks_avals).compile()
             self._compiled[key] = exe
             self.compiles += 1
             if not _warmup:
                 self.bucket_hits[key] = self.bucket_hits.get(key, 0) + 1
             return exe
 
+    def _bucket_avals(self, b: int, t: Optional[int]):
+        """(xs_avals, masks_avals) for one (batch bucket, seq bucket)."""
+        dt = _dt.resolve(self.model.conf.dtype)
+        dt = dt if np.issubdtype(dt, np.floating) else np.dtype(np.float32)
+        xs_avals, masks_avals = [], []
+        for shape, is_seq in zip(self._input_shapes, self._seq_input):
+            if is_seq:
+                xs_avals.append(jax.ShapeDtypeStruct((b, t, shape[1]), dt))
+                masks_avals.append(jax.ShapeDtypeStruct((b, t), np.float32))
+            else:
+                xs_avals.append(jax.ShapeDtypeStruct((b,) + shape, dt))
+                masks_avals.append(None)
+        return xs_avals, masks_avals
+
     def warmup(self, buckets: Optional[Sequence[int]] = None,
-               seq_buckets: Optional[Sequence[int]] = None
-               ) -> "InferenceEngine":
+               seq_buckets: Optional[Sequence[int]] = None,
+               bytes_limit: Optional[int] = None) -> "InferenceEngine":
         """Compile every (batch bucket x seq bucket) executable now, via
         the AOT path — after this, requests whose padded shape lands on a
         warmed bucket never trigger a compile. ``seq_buckets`` applies to
         recurrent ([T, F]) inputs; defaults to the configured T when it is
-        static, and is required when T is dynamic (-1)."""
+        static, and is required when T is dynamic (-1).
+
+        ``buckets="auto"``: autotune the ladder ceiling to the largest
+        bucket whose serving program FITS the device ``bytes_limit``
+        (:meth:`max_batch` — AOT memory accounting, no OOM probing);
+        ``bytes_limit`` overrides the device's own limit (required on
+        backends without ``memory_stats``)."""
         if self._input_shapes is None:
             raise ValueError("model config has no input shapes "
                              "(input_type(...)); warmup cannot derive "
                              "avals — serve a request first or set shapes")
+        if isinstance(buckets, str):
+            if buckets != "auto":
+                raise ValueError(f"unknown warmup bucket spec {buckets!r} "
+                                 "(expected a list of sizes or 'auto')")
+            top = self.max_batch(bytes_limit=bytes_limit,
+                                 seq_buckets=seq_buckets)
+            if top is None:
+                raise ValueError(
+                    "warmup(buckets='auto'): no bucket fits bytes_limit "
+                    "(or this PJRT build exposes no memory_analysis)")
+            buckets = default_buckets(top, minimum=self.min_bucket)
         if not buckets:
             # default ladder must reach min_bucket even past the 64 ceiling
             buckets = default_buckets(max(64, self.min_bucket),
                                       minimum=self.min_bucket)
         buckets = sorted(set(next_bucket(b, self.min_bucket)
                              for b in buckets))
-        dt = _dt.resolve(self.model.conf.dtype)
-        dt = dt if np.issubdtype(dt, np.floating) else np.dtype(np.float32)
         for b in buckets:
             for t in self._warmup_seq_lens(seq_buckets):
-                xs_avals, masks_avals = [], []
-                for shape, is_seq in zip(self._input_shapes, self._seq_input):
-                    if is_seq:
-                        xs_avals.append(jax.ShapeDtypeStruct(
-                            (b, t, shape[1]), dt))
-                        masks_avals.append(jax.ShapeDtypeStruct(
-                            (b, t), np.float32))
-                    else:
-                        xs_avals.append(jax.ShapeDtypeStruct(
-                            (b,) + shape, dt))
-                        masks_avals.append(None)
+                xs_avals, masks_avals = self._bucket_avals(b, t)
                 self._get_compiled(xs_avals, masks_avals, _warmup=True)
         return self
+
+    def max_batch(self, bytes_limit: Optional[int] = None,
+                  seq_buckets: Optional[Sequence[int]] = None,
+                  limit: int = 4096, fraction: float = 1.0
+                  ) -> Optional[int]:
+        """Largest power-of-two batch bucket whose serving program fits in
+        ``bytes_limit`` HBM across every seq bucket, found by AOT
+        lower+compile + ``memory_analysis()`` (``nn/memory.py`` contract —
+        nothing executes, so no OOM probing; probe compiles do NOT enter
+        the executable cache or serving counters). ``bytes_limit`` defaults
+        to the live device limit; pass it explicitly on backends without
+        ``memory_stats``. Returns None when nothing fits or the PJRT build
+        exposes no ``memory_analysis``."""
+        from ..nn import memory as _memory
+        if self._input_shapes is None:
+            raise ValueError("model config has no input shapes "
+                             "(input_type(...)); max_batch cannot derive "
+                             "avals")
+        if bytes_limit is None:
+            dm = _memory.device_memory_stats()
+            if not dm or not dm.get("bytes_limit"):
+                raise ValueError(
+                    "device reports no memory_stats()['bytes_limit'] — "
+                    "pass bytes_limit= explicitly on this backend")
+            bytes_limit = dm["bytes_limit"]
+        budget = int(bytes_limit * fraction)
+
+        def fits(b: int) -> Optional[bool]:
+            for t in self._warmup_seq_lens(seq_buckets):
+                xs_avals, masks_avals = self._bucket_avals(b, t)
+                with self._lock:
+                    # the SAME lowering the serving executables use (mesh
+                    # in_shardings included) — per-device peak, per-device
+                    # bytes_limit
+                    compiled = self._lower_bucket(
+                        xs_avals, masks_avals).compile()
+                cm = _memory.compiled_memory(compiled)
+                if cm is None:
+                    return None
+                if cm["peak_bytes"] > budget:
+                    return False
+            return True
+
+        best = None
+        b = self.min_bucket
+        while b <= limit:
+            ok = fits(b)
+            if ok is None or not ok:
+                return best if ok is not None else None
+            best = b
+            b <<= 1
+        return best
 
     def _warmup_seq_lens(self, seq_buckets):
         if not any(self._seq_input):

@@ -101,18 +101,11 @@ class StatsListener(TrainingListener):
     @staticmethod
     def _device_memory() -> Optional[dict]:
         """Device HBM series (reference dashboard's system-metrics pane;
-        ours reads PJRT memory_stats — not every backend reports them)."""
-        try:
-            import jax
-            d = jax.local_devices()[0]
-            ms = d.memory_stats()
-            if not ms:
-                return None
-            return {"bytes_in_use": int(ms.get("bytes_in_use", 0)),
-                    "peak_bytes_in_use": int(ms.get("peak_bytes_in_use", 0)),
-                    "bytes_limit": int(ms.get("bytes_limit", 0))}
-        except Exception:
-            return None
+        ours reads PJRT memory_stats — not every backend reports them).
+        Shared helper: ``nn.memory.device_memory_stats`` (same fields feed
+        PerformanceListener and the bench artifacts)."""
+        from ..nn.memory import device_memory_stats
+        return device_memory_stats()
 
     def _write_meta(self, model):
         self.storage.put_record({
