@@ -960,6 +960,138 @@ def bench_parallel_inference():
     }
 
 
+def bench_resilience():
+    """ISSUE 5 metric (CPU-capable): (1) steady-state step-time overhead
+    of the divergence sentinel — the guarded step (finite-check +
+    lax.cond + on-device counters) vs the ``sentinel_guard=False``
+    baseline program, interleaved A/B, must report ≈1.00x — and (2)
+    recovery time after an injected mid-epoch kill: the wall-clock cost
+    of the auto-resume restore (model + updater + iterator from the
+    crash-safe checkpoint), plus a bit-equivalence check of the resumed
+    run against an uninterrupted one."""
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.data.dataset import NumpyDataSetIterator
+    from deeplearning4j_tpu.nn.config import (InputType,
+                                              NeuralNetConfiguration)
+    from deeplearning4j_tpu.nn.layers.core import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.model import MultiLayerNetwork
+    from deeplearning4j_tpu.nn.updaters import Adam
+    from deeplearning4j_tpu.parallel.resilience import ResiliencePolicy
+    from deeplearning4j_tpu.runtime import faults, sentinel
+
+    def conf():
+        return (NeuralNetConfiguration.builder().seed(11)
+                .updater(Adam(learning_rate=1e-3))
+                .input_type(InputType.feed_forward(256))
+                .list(DenseLayer(n_out=512, activation="relu"),
+                      DenseLayer(n_out=512, activation="relu"),
+                      OutputLayer(n_out=10, activation="softmax",
+                                  loss="mcxent"))
+                .build())
+
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(256, 256)).astype(np.float32))
+    y = jnp.asarray(np.eye(10, dtype=np.float32)[
+        rng.integers(0, 10, 256)])
+
+    # -- (1) sentinel steady-state overhead, interleaved A/B ----------------
+    guarded = MultiLayerNetwork(conf()).init()
+    base = MultiLayerNetwork(conf()).init()
+    g_step = guarded._build_train_step()
+    b_step = base._build_train_step(sentinel_guard=False)
+    g_args = [guarded.params, guarded.updater_state, guarded.state]
+    b_args = [base.params, base.updater_state, base.state]
+    g_sent = sentinel.init_counters()
+    key = jax.random.PRNGKey(0)
+
+    def g_one(i):
+        nonlocal g_sent
+        out = g_step(*g_args, jnp.int32(i), key, x, y, None, None, g_sent)
+        g_args[:] = out[:3]
+        g_sent = out[3]
+        return out[4]
+
+    def b_one(i):
+        out = b_step(*b_args, jnp.int32(i), key, x, y, None, None)
+        b_args[:] = out[:3]
+        return out[3]
+
+    for i in range(3):  # warmup (compile both)
+        g_one(i).block_until_ready()
+        b_one(i).block_until_ready()
+    gt, bt = [], []
+    for i in range(30):  # interleaved: share thermal/noise conditions
+        t0 = time.perf_counter()
+        g_one(i + 3).block_until_ready()
+        gt.append(time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        b_one(i + 3).block_until_ready()
+        bt.append(time.perf_counter() - t0)
+    g_p50, g_p99 = _percentiles(gt)
+    b_p50, b_p99 = _percentiles(bt)
+    overhead = g_p50 / b_p50 if b_p50 else None
+
+    # -- (2) recovery time after an injected mid-epoch kill -----------------
+    faults.reset()
+    faults.telemetry_reset()
+    xs = np.asarray(x)
+    ys = np.asarray(y)
+    ref = MultiLayerNetwork(conf()).init()
+    ref.fit(NumpyDataSetIterator(xs, ys, batch_size=32, shuffle=True,
+                                 seed=3), epochs=2)
+    net = MultiLayerNetwork(conf()).init()
+    it = NumpyDataSetIterator(xs, ys, batch_size=32, shuffle=True, seed=3)
+    restore_s = {}
+    orig_restore = None
+    try:  # the armed crash must NEVER leak into later benches
+        with tempfile.TemporaryDirectory() as d:
+            pol = ResiliencePolicy(checkpointer=d,
+                                   checkpoint_every_iterations=2,
+                                   max_restarts=2)
+            ck = pol.resolve_checkpointer()
+            orig_restore = ck.restore
+
+            def timed_restore(*a, **kw):
+                t0 = time.perf_counter()
+                out = orig_restore(*a, **kw)
+                restore_s["s"] = time.perf_counter() - t0
+                return out
+
+            ck.restore = timed_restore
+            faults.inject("train.step", error="crash", after=11, times=1)
+            t0 = time.perf_counter()
+            net.fit(it, epochs=2, resilience=pol)
+            total_s = time.perf_counter() - t0
+    finally:
+        faults.clear("train.step")
+    bit_equal = all(
+        bool(np.array_equal(np.asarray(a), np.asarray(b)))
+        for a, b in zip(jax.tree.leaves(ref.params),
+                        jax.tree.leaves(net.params)))
+    tel = faults.telemetry_snapshot()
+    fault_counters = faults.counters()
+    faults.reset()
+    return {
+        "metric": "resilience",
+        "value": round(overhead, 4) if overhead else None,
+        "unit": "x_sentinel_step_time_vs_unguarded",
+        "sentinel_step_ms_p50": round(g_p50 * 1e3, 3),
+        "sentinel_step_ms_p99": round(g_p99 * 1e3, 3),
+        "baseline_step_ms_p50": round(b_p50 * 1e3, 3),
+        "baseline_step_ms_p99": round(b_p99 * 1e3, 3),
+        "recovery_restore_s": round(restore_s.get("s", float("nan")), 4),
+        "recovery_total_fit_s": round(total_s, 3),
+        "resumed_bit_equal_to_uninterrupted": bit_equal,
+        "telemetry": {k: v for k, v in tel.items()
+                      if isinstance(v, (int, float)) or v is None},
+        "fault_counters": fault_counters,
+    }
+
+
 if __name__ == "__main__":
     lines = [bench_resnet()]  # headline first: must not be blocked by BERT
     # emit the headline IMMEDIATELY: if bench_bert dies process-fatally
@@ -997,6 +1129,14 @@ if __name__ == "__main__":
         lines.append({
             "metric": "workspace_remat", "value": None,
             "unit": "pct_activation_bytes_reduction_every4_vs_none",
+            "error": f"{type(e).__name__}: {e}"[:300]})
+    _emit(lines)
+    try:
+        lines.append(bench_resilience())
+    except Exception as e:
+        lines.append({
+            "metric": "resilience", "value": None,
+            "unit": "x_sentinel_step_time_vs_unguarded",
             "error": f"{type(e).__name__}: {e}"[:300]})
     _emit(lines)
     try:

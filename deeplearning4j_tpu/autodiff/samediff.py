@@ -192,8 +192,13 @@ class _Subgraph:
                          [_op_from_dict(od) for od in d["ops"]])
 
 
-class SameDiff:
-    """The graph container + session (nd4j ``SameDiff`` / sessions†)."""
+from ..runtime.sentinel import SentinelCounterMixin as _SentinelCounterMixin
+
+
+class SameDiff(_SentinelCounterMixin):
+    """The graph container + session (nd4j ``SameDiff`` / sessions†).
+    Inherits the divergence-sentinel counter surface
+    (``resilience_counters`` et al.) from the shared mixin."""
 
     def __init__(self):
         self._vars: Dict[str, SDVariable] = {}
@@ -213,6 +218,9 @@ class SameDiff:
         # (none | full | dots_saveable | every_<k> — autodiff/remat.py
         # segments the op list at attention anchors)
         self.workspace_mode = "none"
+        # divergence-sentinel counter tree (runtime/sentinel.py), threaded
+        # through the compiled fit step like the optimizer state
+        self._sentinel = None
 
     # listener-facing Model protocol (Score/Collect/Checkpoint listeners)
     def score(self) -> float:
@@ -682,26 +690,40 @@ class SameDiff:
         tc = dict(self.train_config)
         loss_fn = self._fit_loss_fn()
 
-        def step(train_vals, opt_state, other_vals, step_i, feeds):
+        from ..runtime import sentinel as _sent
+
+        def step(train_vals, opt_state, other_vals, step_i, feeds,
+                 sentinel=None):
             loss, grads = jax.value_and_grad(
                 lambda tv: loss_fn(tv, other_vals, feeds))(train_vals)
-            if tc.get("grad_norm"):
-                from ..nn import gradnorm as _gn
-                # per-VARIABLE grouping: wrap each leaf as its own "layer"
-                grads = {k: v["g"] for k, v in _gn.apply(
-                    tc["grad_norm"], tc["grad_norm_threshold"],
-                    {k: {"g": g} for k, g in grads.items()}).items()}
-            if tc.get("clip_value"):
-                cv = tc["clip_value"]
-                grads = jax.tree.map(lambda g: jnp.clip(g, -cv, cv), grads)
-            if tc.get("clip_l2"):
-                norm = jnp.sqrt(sum(jnp.sum(jnp.square(g))
-                                    for g in jax.tree.leaves(grads)))
-                scale = jnp.minimum(1.0, tc["clip_l2"] / (norm + 1e-12))
-                grads = jax.tree.map(lambda g: g * scale, grads)
-            delta, new_opt = updater.apply(grads, opt_state, train_vals, step_i)
-            new_vals = jax.tree.map(lambda p, d: p - d, train_vals, delta)
-            return new_vals, new_opt, loss
+            from ..nn import gradnorm as _gn
+            # the shared engine clip pipeline; per-VARIABLE grouping means
+            # each leaf is wrapped as its own "layer" for the mode step
+            # (value/L2 clip are tree-shape agnostic, so the wrap is safe)
+            wrapped = {k: {"g": g} for k, g in grads.items()}
+            wrapped, clip_events = _gn.clip_with_events(
+                tc.get("grad_norm"), tc.get("grad_norm_threshold", 1.0),
+                tc.get("clip_value"), tc.get("clip_l2"), wrapped)
+            grads = {k: v["g"] for k, v in wrapped.items()}
+
+            # DIVERGENCE SENTINEL — engine-parity contract (see
+            # MultiLayerNetwork._build_train_step): non-finite loss or
+            # global grad norm skips the weight update inside lax.cond and
+            # bumps the on-device counters; zero host syncs/retraces.
+            ok = _sent.finite_ok(loss, grads)
+
+            def _apply(train_vals, opt_state):
+                delta, new_opt = updater.apply(grads, opt_state, train_vals,
+                                               step_i)
+                return (jax.tree.map(lambda p, d: p - d, train_vals, delta),
+                        new_opt)
+
+            new_vals, new_opt = _sent.guarded_apply(
+                ok, _apply, train_vals, opt_state)
+            if sentinel is None:  # pre-sentinel call signature
+                return new_vals, new_opt, loss
+            return (new_vals, new_opt,
+                    _sent.update_counters(sentinel, ok, clip_events), loss)
 
         import json as _json
         from .. import environment as _envmod
@@ -747,13 +769,25 @@ class SameDiff:
         cbs = list(self._listeners) + list(listeners or [])
         history = History()
         i = self.iteration
+        from ..runtime import faults as _faults
         for _ in range(epochs):
             epoch_losses = []
             for feeds in feeds_list:
                 feeds = {k: jnp.asarray(v) for k, v in feeds.items()}
-                train_vals, opt_state, loss = step(
+                if _faults.enabled():
+                    _faults.trip("train.step")  # crash/preemption site
+                    # float check FIRST: all-int feeds must not consume
+                    # the injection's fire budget without poisoning anything
+                    if any(jnp.issubdtype(v.dtype, jnp.floating)
+                           for v in feeds.values()) and \
+                            _faults.trip("train.nonfinite") is not None:
+                        feeds = {k: jnp.full_like(v, jnp.nan)
+                                 if jnp.issubdtype(v.dtype, jnp.floating)
+                                 else v for k, v in feeds.items()}
+                train_vals, opt_state, self._sentinel, loss = step(
                     train_vals, opt_state, other_vals,
-                    jnp.asarray(i, jnp.int32), feeds)
+                    jnp.asarray(i, jnp.int32), feeds,
+                    self._ensure_sentinel())
                 loss = float(loss)
                 history.losses.append(loss)
                 epoch_losses.append(loss)
@@ -831,9 +865,11 @@ class SameDiff:
             "residual_count": None,
             "device": _memory.device_memory_stats(),
         }
+        from ..runtime import sentinel as _sent
+        # sentinel counters included: accounts the REAL step fit() runs
         compiled = step.lower(tv_avals, opt_avals, ov_avals,
                               jax.ShapeDtypeStruct((), jnp.int32),
-                              feeds_avals).compile()
+                              feeds_avals, _sent.counter_avals()).compile()
         cm = _memory.compiled_memory(compiled)
         if cm:
             report.update(cm)

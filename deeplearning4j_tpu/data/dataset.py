@@ -13,11 +13,14 @@ with device compute via a background thread + bounded queue.
 
 from __future__ import annotations
 
+import logging
 import queue
 import threading
 from typing import Iterator, List, Optional, Sequence
 
 import numpy as np
+
+log = logging.getLogger("deeplearning4j_tpu")
 
 
 class DataSet:
@@ -338,14 +341,27 @@ class AsyncDataSetIterator(DataSetIterator):
     serializing inside the jitted step's implicit device_put. Values are
     bit-identical to plain iteration (tested); any pre_processor runs in
     the producer BEFORE the transfer so it still sees host numpy arrays.
+
+    ``max_bad_records=N`` (ISSUE 5 satellite) is the skip-and-log
+    tolerance: a reader/preprocessor exception on one record/batch is
+    logged and counted (``bad_records``, surfaced via :meth:`stats`)
+    instead of killing the epoch; only the ``N+1``-th failure aborts.
+    After a base-iterator failure the base is RE-ENTERED from its own
+    cursor (the resumable-iterator contract), so a poisoned batch in the
+    middle of a multi-hour epoch costs one batch, not the epoch. The
+    default 0 keeps the historical fail-fast behavior. Fault site:
+    ``data.record``.
     """
 
     def __init__(self, base: DataSetIterator, queue_size: int = 4,
-                 device_prefetch: bool = False, sharding=None):
+                 device_prefetch: bool = False, sharding=None,
+                 max_bad_records: int = 0):
         self._base = base
         self._qsize = queue_size
         self._device_prefetch = bool(device_prefetch)
         self._sharding = sharding
+        self._max_bad = int(max_bad_records)
+        self.bad_records = 0  # cumulative across epochs (stats())
         # restorable cursor: the producer thread runs AHEAD of the consumer
         # (queue depth), so the base iterator's own cursor over-reports what
         # the trainer has actually consumed. We snapshot the base state at
@@ -372,6 +388,11 @@ class AsyncDataSetIterator(DataSetIterator):
         self._start_state = self._base.state()
         self._consumed = self._skip
 
+    def stats(self) -> dict:
+        """Pipeline-health counters (the ``max_bad_records`` ledger)."""
+        return {"bad_records": self.bad_records,
+                "max_bad_records": self._max_bad}
+
     def __iter__(self):
         self._start_state = self._base.state()
         self._consumed = 0
@@ -390,16 +411,59 @@ class AsyncDataSetIterator(DataSetIterator):
                     continue
             return False
 
+        _SKIPPED = object()  # in-stream marker: one base batch was skipped
+
+        def tolerate(e: BaseException) -> bool:
+            """Skip-and-log one bad record/batch; False = over the cap
+            (abort the epoch with the original error)."""
+            if self.bad_records >= self._max_bad:
+                return False
+            self.bad_records += 1
+            log.warning(
+                "AsyncDataSetIterator: skipping bad record/batch %d/%d "
+                "(%s: %s)", self.bad_records, self._max_bad,
+                type(e).__name__, e)
+            return True
+
         def produce():
+            from ..runtime import faults as _faults
             try:
-                for ds in self._base:
-                    if self._device_prefetch:
-                        # preprocess on host FIRST (normalizers expect
-                        # numpy), then ship — the copy also protects
-                        # stored batches from in-place transforms
-                        if self.pre_processor is not None:
-                            ds = self._pp(ds.copy())
-                        ds = _device_put_batch(ds, self._sharding)
+                bit = iter(self._base)
+                while True:
+                    try:
+                        ds = next(bit)
+                    except StopIteration:
+                        break
+                    except BaseException as e:
+                        # the base generator is dead after raising; its
+                        # cursor lives on the iterator OBJECT, so re-enter
+                        # from where it stopped. A cursorless base has
+                        # nothing to resume (the retry would spin on the
+                        # same record), so it fails fast WITHOUT counting
+                        # a skip that never happened.
+                        if not self._base.state() or not tolerate(e):
+                            raise
+                        bit = iter(self._base)
+                        put(_SKIPPED)
+                        continue
+                    try:
+                        if _faults.enabled():
+                            _faults.trip("data.record")  # injectable reader
+                        if self._device_prefetch:
+                            # preprocess on host FIRST (normalizers expect
+                            # numpy), then ship — the copy also protects
+                            # stored batches from in-place transforms
+                            if self.pre_processor is not None:
+                                ds = self._pp(ds.copy())
+                            ds = _device_put_batch(ds, self._sharding)
+                    except BaseException as e:
+                        if not tolerate(e):
+                            raise
+                        # the marker rides the queue IN ORDER so the
+                        # consumer's resume cursor counts the skipped
+                        # batch at its true base position
+                        put(_SKIPPED)
+                        continue
                     if not put(ds):
                         return
             except BaseException as e:  # propagate into consumer
@@ -413,6 +477,14 @@ class AsyncDataSetIterator(DataSetIterator):
         try:
             while True:
                 item = q.get()
+                if item is _SKIPPED:
+                    # a bad batch the producer dropped: it occupied one
+                    # base-cursor position, so the resume accounting must
+                    # count it exactly like a consumed batch
+                    self._consumed += 1
+                    if self._skip > 0:
+                        self._skip -= 1
+                    continue
                 if item is _END:
                     if err:
                         raise err[0]

@@ -10,10 +10,13 @@ site or mutation point gets fixed in exactly one place.
 from __future__ import annotations
 
 from .. import dtypes as _dt
+from ..runtime.sentinel import SentinelCounterMixin
 
 
-class CompiledCacheMixin:
-    """Invalidation + dtype-policy mutation + serving-engine access."""
+class CompiledCacheMixin(SentinelCounterMixin):
+    """Invalidation + dtype-policy mutation + serving-engine access +
+    the divergence-sentinel counter surface (SentinelCounterMixin —
+    shared with SameDiff so the contract cannot drift)."""
 
     # attributes cleared together on invalidation; subclasses extend
     # (MultiLayerNetwork adds the rnn streaming pair)

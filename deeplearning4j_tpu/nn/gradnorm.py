@@ -40,6 +40,68 @@ def _tree_l2(tree) -> jnp.ndarray:
                         for g in jax.tree.leaves(tree)) + 0.0)
 
 
+def clip_engaged(mode: Optional[str], threshold: float, grads) -> jnp.ndarray:
+    """Traced 0/1 int32: did this mode's clip actually ENGAGE on this
+    gradient tree (some norm / element exceeded the threshold)? The
+    divergence sentinel accumulates it as ``clip_events`` telemetry
+    (PerformanceListener / ui.StatsListener). Renormalize* modes rescale
+    unconditionally — no threshold, never an "event" — and mode None is
+    a constant 0 (folded away by XLA)."""
+    if mode is None or mode.startswith("Renormalize"):
+        return jnp.int32(0)
+    t = float(threshold)
+    if mode == "ClipElementWiseAbsoluteValue":
+        return value_clip_engaged(grads, t)
+    if mode == "ClipL2PerLayer":
+        hit = sum((_tree_l2(v) > t).astype(jnp.int32) for v in grads.values())
+        return (hit > 0).astype(jnp.int32)
+    if mode == "ClipL2PerParamType":
+        hit = sum((jnp.sqrt(jnp.sum(jnp.square(g))) > t).astype(jnp.int32)
+                  for g in jax.tree.leaves(grads))
+        return (hit > 0).astype(jnp.int32)
+    validate(mode)
+    return jnp.int32(0)
+
+
+def clip_with_events(mode: Optional[str], threshold: float,
+                     clip_value: Optional[float], clip_l2: Optional[float],
+                     grads):
+    """The full normalize→value-clip→L2-clip pipeline both engines' and
+    SameDiff's train steps run, returning ``(grads, clip_events)`` where
+    clip_events is a traced 0/1 int32 (did ANY clip engage this step).
+    One implementation so the clip/event semantics cannot drift between
+    engines. Works on any gradient pytree."""
+    events = clip_engaged(mode, threshold, grads)
+    grads = apply(mode, threshold, grads)
+    if clip_value:
+        events = jnp.maximum(events, value_clip_engaged(grads, clip_value))
+        grads = jax.tree.map(
+            lambda g: jnp.clip(g, -clip_value, clip_value), grads)
+    if clip_l2:
+        norm = jnp.sqrt(sum(jnp.sum(jnp.square(g))
+                            for g in jax.tree.leaves(grads)))
+        events = jnp.maximum(events, l2_clip_engaged(norm, clip_l2))
+        scale = jnp.minimum(1.0, clip_l2 / (norm + 1e-12))
+        grads = jax.tree.map(lambda g: g * scale, grads)
+    return grads, events
+
+
+def value_clip_engaged(grads, clip_value: float) -> jnp.ndarray:
+    """Traced 0/1 int32: would elementwise value-clipping at
+    ``clip_value`` modify any gradient element? Shared by both engines'
+    ``_clip`` and the SameDiff fit step so the clip_events telemetry
+    semantics live in ONE place."""
+    t = float(clip_value)
+    hit = sum(jnp.sum(jnp.abs(g) > t) for g in jax.tree.leaves(grads))
+    return (hit > 0).astype(jnp.int32)
+
+
+def l2_clip_engaged(norm, clip_l2: float) -> jnp.ndarray:
+    """Traced 0/1 int32: does the (precomputed) global L2 norm exceed the
+    clip threshold? Sibling of :func:`value_clip_engaged`."""
+    return (norm > float(clip_l2)).astype(jnp.int32)
+
+
 def apply(mode: Optional[str], threshold: float,
           grads: Dict[str, Any]) -> Dict[str, Any]:
     """Normalize the gradient tree ``{layer_key: {param: arr}}``."""

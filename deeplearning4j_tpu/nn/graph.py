@@ -457,18 +457,14 @@ class ComputationGraph(_caches.CompiledCacheMixin):
         return total
 
     def _clip(self, grads):
+        """Gradient normalization/clipping; returns ``(grads, clip_events)``
+        — the shared ``gradnorm.clip_with_events`` pipeline (the sentinel
+        accumulates the events as telemetry)."""
         from . import gradnorm as _gn
-        grads = _gn.apply(self.conf.gradient_normalization,
-                          self.conf.gradient_normalization_threshold, grads)
-        cv, cl2 = self.conf.gradient_clip_value, self.conf.gradient_clip_l2
-        if cv:
-            grads = jax.tree.map(lambda g: jnp.clip(g, -cv, cv), grads)
-        if cl2:
-            norm = jnp.sqrt(sum(jnp.sum(jnp.square(g))
-                                for g in jax.tree.leaves(grads)))
-            scale = jnp.minimum(1.0, cl2 / (norm + 1e-12))
-            grads = jax.tree.map(lambda g: g * scale, grads)
-        return grads
+        return _gn.clip_with_events(
+            self.conf.gradient_normalization,
+            self.conf.gradient_normalization_threshold,
+            self.conf.gradient_clip_value, self.conf.gradient_clip_l2, grads)
 
     # ------------------------------------------------------------ train step
     def _build_loss_fn(self):
@@ -524,12 +520,15 @@ class ComputationGraph(_caches.CompiledCacheMixin):
 
         return loss_fn
 
-    def _build_train_step(self, accum_steps: int = 1):
+    def _build_train_step(self, accum_steps: int = 1,
+                          sentinel_guard: bool = True):
         """Fused pure train step; ``accum_steps=k`` scans the gradient over
         k microbatches before the single updater application (same contract
         as ``MultiLayerNetwork._build_train_step`` — see
         ``nn/microbatch.py``). The conf's ``workspace_mode`` remat policy
-        (``nn/memory.py``) composes with both."""
+        (``nn/memory.py``) composes with both. ``sentinel_guard=False``
+        compiles out the divergence sentinel (A/B baseline for bench.py's
+        ``resilience`` metric)."""
         updater = self.conf.updater
         from .layers.wrappers import FrozenLayer
         from .vertices import LayerVertex
@@ -538,8 +537,10 @@ class ComputationGraph(_caches.CompiledCacheMixin):
             n for n, v, _ in self.conf.vertices
             if isinstance(v, LayerVertex) and isinstance(v.layer, FrozenLayer))
         vg_fn = jax.value_and_grad(self._build_loss_fn(), has_aux=True)
+        from ..runtime import sentinel as _sent
 
-        def step_fn(params, opt_state, bn_state, step, key, xs, ys, fms, lms):
+        def step_fn(params, opt_state, bn_state, step, key, xs, ys, fms, lms,
+                    sentinel=None):
             if accum_steps == 1:
                 (loss, new_bn), grads = vg_fn(
                     params, bn_state, key, xs, ys, fms, lms)
@@ -548,18 +549,44 @@ class ComputationGraph(_caches.CompiledCacheMixin):
                     vg_fn, params, bn_state, key, accum_steps,
                     (xs, ys, fms, lms),
                     weight_fn=_micro.multi_output_weight)
-            grads = self._clip(grads)
-            # leaf-wise updater application. The flat-buffer variant
-            # (updaters.apply_fused) measured a LARGE regression here on the
-            # real chip — ResNet-50 bf16: -13 MFU points at batch 128, -7.7
-            # at 256 (DIAG3_r05.json, interleaved A/B) — the ravel/unravel
-            # round-trip defeats XLA's in-place param update through the
-            # scan carry. r4's "perf-neutral" adoption was wrong; reverted.
-            new_params, new_opt = _upd.apply_leafwise(
-                updater, grads, opt_state, params, step)
-            new_params = _constraints.apply_constraints(
-                self.conf.constraints, new_params, skip=frozen_keys)
-            return new_params, new_opt, new_bn, loss
+            grads, clip_events = self._clip(grads)
+
+            def _apply(params, opt_state):
+                # leaf-wise updater application. The flat-buffer variant
+                # (updaters.apply_fused) measured a LARGE regression here on
+                # the real chip — ResNet-50 bf16: -13 MFU points at batch
+                # 128, -7.7 at 256 (DIAG3_r05.json, interleaved A/B) — the
+                # ravel/unravel round-trip defeats XLA's in-place param
+                # update through the scan carry. r4's "perf-neutral"
+                # adoption was wrong; reverted.
+                new_params, new_opt = _upd.apply_leafwise(
+                    updater, grads, opt_state, params, step)
+                new_params = _constraints.apply_constraints(
+                    self.conf.constraints, new_params, skip=frozen_keys)
+                return new_params, new_opt
+
+            if not sentinel_guard:  # A/B baseline (bench resilience metric)
+                new_params, new_opt = _apply(params, opt_state)
+                if sentinel is None:
+                    return new_params, new_opt, new_bn, loss
+                return (new_params, new_opt, new_bn,
+                        _sent.update_counters(sentinel, jnp.bool_(True),
+                                              clip_events), loss)
+
+            # DIVERGENCE SENTINEL — same contract as MultiLayerNetwork._
+            # build_train_step: non-finite loss/grad-norm lax.cond-skips the
+            # updater application and BN commit, bumps on-device counters;
+            # zero host syncs, zero retraces in steady state.
+            ok = _sent.finite_ok(loss, grads)
+            new_params, new_opt = _sent.guarded_apply(
+                ok, _apply, params, opt_state)
+            out_bn = jax.tree.map(
+                lambda new, old: jnp.where(ok, new, old),
+                new_bn, bn_state) if bn_state else new_bn
+            if sentinel is None:  # pre-sentinel call signature (tests/tools)
+                return new_params, new_opt, out_bn, loss
+            return (new_params, new_opt, out_bn,
+                    _sent.update_counters(sentinel, ok, clip_events), loss)
 
         return jax.jit(step_fn, donate_argnums=(0, 1, 2),
                        compiler_options=_env.engine_compiler_options())
@@ -579,22 +606,24 @@ class ComputationGraph(_caches.CompiledCacheMixin):
         """
         step = self._build_train_step().__wrapped__
 
-        def epoch_fn(params, opt_state, bn_state, start_step, key, xs, ys):
+        def epoch_fn(params, opt_state, bn_state, sentinel, start_step, key,
+                     xs, ys):
             # xs/ys: tuples of stacked arrays [n_batches, B, ...] aligned
             # with conf.inputs/outputs. Masks unsupported on this path.
             def body(carry, xy):
-                params, opt_state, bn_state, i = carry
+                params, opt_state, bn_state, sentinel, i = carry
                 bx, by = xy
                 k = jax.random.fold_in(key, i)
-                params, opt_state, bn_state, loss = step(
+                params, opt_state, bn_state, sentinel, loss = step(
                     params, opt_state, bn_state, i, k, bx, by,
-                    (None,) * len(bx), (None,) * len(by))
-                return (params, opt_state, bn_state, i + 1), loss
-            (params, opt_state, bn_state, _), losses = jax.lax.scan(
-                body, (params, opt_state, bn_state, start_step), (xs, ys))
-            return params, opt_state, bn_state, losses
+                    (None,) * len(bx), (None,) * len(by), sentinel)
+                return (params, opt_state, bn_state, sentinel, i + 1), loss
+            (params, opt_state, bn_state, sentinel, _), losses = jax.lax.scan(
+                body, (params, opt_state, bn_state, sentinel, start_step),
+                (xs, ys))
+            return params, opt_state, bn_state, sentinel, losses
 
-        return jax.jit(epoch_fn, donate_argnums=(0, 1, 2),
+        return jax.jit(epoch_fn, donate_argnums=(0, 1, 2, 3),
                        compiler_options=_env.engine_compiler_options())
 
     def fit_on_device(self, features, labels, epochs: int = 1,
@@ -645,8 +674,10 @@ class ComputationGraph(_caches.CompiledCacheMixin):
         history = []
         for _ in range(epochs):
             self._key, sub = jax.random.split(self._key)
-            self.params, self.updater_state, self.state, losses = \
+            (self.params, self.updater_state, self.state, self._sentinel,
+             losses) = \
                 self._epoch_fn(self.params, self.updater_state, self.state,
+                               self._ensure_sentinel(),
                                jnp.int32(self.iteration), sub, xs, ys)
             self.iteration += nb
             self.epoch += 1
@@ -660,13 +691,23 @@ class ComputationGraph(_caches.CompiledCacheMixin):
         self._score = float(out[-1])
         return out
 
-    def fit(self, data, labels=None, epochs: int = 1) -> "ComputationGraph":
+    def fit(self, data, labels=None, epochs: int = 1,
+            resilience=None) -> "ComputationGraph":
         """Accepts MultiDataSetIterator, MultiDataSet, DataSetIterator,
-        DataSet, or (features, labels) arrays."""
+        DataSet, or (features, labels) arrays.
+
+        ``resilience`` (a ``parallel.resilience.ResiliencePolicy``) wraps
+        the epoch loop in the auto-resume driver — same contract as
+        ``MultiLayerNetwork.fit``."""
+        if resilience is not None:
+            from ..parallel.resilience import run_resilient_fit
+            return run_resilient_fit(self, data, labels=labels,
+                                     epochs=epochs, policy=resilience)
         if not self.params and not self.state:
             self.init()
         if self._train_step is None:
             self._train_step = self._build_train_step()
+        from ..runtime import faults as _faults
         it = _as_multi_iterator(data, labels)
 
         for _ in range(epochs):
@@ -674,15 +715,28 @@ class ComputationGraph(_caches.CompiledCacheMixin):
                 self._key, sub = jax.random.split(self._key)
                 xs = tuple(jnp.asarray(f) for f in mds.features)
                 ys = tuple(jnp.asarray(l) for l in mds.labels)
+                if _faults.enabled():
+                    _faults.trip("train.step")  # crash/preemption site
+                    # float check FIRST: all-int inputs must not consume
+                    # the injection's fire budget without poisoning anything
+                    if any(jnp.issubdtype(x.dtype, jnp.floating)
+                           for x in xs) and \
+                            _faults.trip("train.nonfinite") is not None:
+                        xs = tuple(
+                            jnp.full_like(x, jnp.nan)
+                            if jnp.issubdtype(x.dtype, jnp.floating) else x
+                            for x in xs)  # sentinel site
                 fms = tuple(None if m is None else jnp.asarray(m)
                             for m in mds.features_masks)
                 lms = tuple(None if m is None else jnp.asarray(m)
                             for m in mds.labels_masks)
                 step = jnp.asarray(self.iteration, dtype=jnp.int32)
                 self._last_batch = xs  # StatsListener activation sampling
-                self.params, self.updater_state, self.state, loss = \
+                (self.params, self.updater_state, self.state, self._sentinel,
+                 loss) = \
                     self._train_step(self.params, self.updater_state,
-                                     self.state, step, sub, xs, ys, fms, lms)
+                                     self.state, step, sub, xs, ys, fms, lms,
+                                     self._ensure_sentinel())
                 self._score = loss
                 self.iteration += 1
                 for cb in self._listeners:

@@ -300,8 +300,12 @@ def _lower_train_step(model, batch_size: int, accum_steps: int = 1,
     fm = (None,) * len(x) if isinstance(x, tuple) else None
     lm = (None,) * len(y) if isinstance(y, tuple) else None
     step = model._build_train_step(accum_steps)
+    from ..runtime import sentinel as _sent
+    # sentinel counters included: this accounts the REAL fused step the
+    # fit loop runs (divergence sentinel and all)
     return step.lower(params_avals, opt_avals, state_avals,
-                      step_aval, key_aval, x, y, fm, lm).compile()
+                      step_aval, key_aval, x, y, fm, lm,
+                      _sent.counter_avals()).compile()
 
 
 def memory_report(model, batch_size: int, accum_steps: int = 1,

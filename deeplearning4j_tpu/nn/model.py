@@ -238,18 +238,14 @@ class MultiLayerNetwork(_caches.CompiledCacheMixin):
         return total
 
     def _clip(self, grads):
+        """Gradient normalization/clipping; returns ``(grads, clip_events)``
+        — the shared ``gradnorm.clip_with_events`` pipeline (the sentinel
+        accumulates the events as telemetry)."""
         from . import gradnorm as _gn
-        grads = _gn.apply(self.conf.gradient_normalization,
-                          self.conf.gradient_normalization_threshold, grads)
-        cv, cl2 = self.conf.gradient_clip_value, self.conf.gradient_clip_l2
-        if cv:
-            grads = jax.tree.map(lambda g: jnp.clip(g, -cv, cv), grads)
-        if cl2:
-            norm = jnp.sqrt(sum(jnp.sum(jnp.square(g))
-                                for g in jax.tree.leaves(grads)))
-            scale = jnp.minimum(1.0, cl2 / (norm + 1e-12))
-            grads = jax.tree.map(lambda g: g * scale, grads)
-        return grads
+        return _gn.clip_with_events(
+            self.conf.gradient_normalization,
+            self.conf.gradient_normalization_threshold,
+            self.conf.gradient_clip_value, self.conf.gradient_clip_l2, grads)
 
     # ------------------------------------------------------------- train step
     def _build_loss_fn(self):
@@ -297,7 +293,8 @@ class MultiLayerNetwork(_caches.CompiledCacheMixin):
 
         return loss_fn
 
-    def _build_train_step(self, accum_steps: int = 1):
+    def _build_train_step(self, accum_steps: int = 1,
+                          sentinel_guard: bool = True):
         """Fused pure train step. ``accum_steps=k`` splits the batch into k
         microbatches and accumulates the mean gradient via ``lax.scan``
         before the SINGLE updater application (see ``nn/microbatch.py`` for
@@ -305,15 +302,23 @@ class MultiLayerNetwork(_caches.CompiledCacheMixin):
         microbatch, so global batch can grow past HBM. The conf's
         ``workspace_mode`` remat policy (``nn/memory.py``) composes: inside
         each microbatch, intra-segment activations are recomputed in the
-        backward pass instead of cached."""
+        backward pass instead of cached.
+
+        ``sentinel_guard=False`` compiles the step WITHOUT the divergence
+        sentinel's finite-check/cond (the pre-ISSUE-5 program) — the A/B
+        baseline bench.py's ``resilience`` metric measures the sentinel's
+        steady-state overhead against; fit() always builds the guarded
+        step."""
         updater = self.conf.updater
         from .layers.wrappers import FrozenLayer
         from . import microbatch as _micro
+        from ..runtime import sentinel as _sent
         frozen_keys = frozenset(str(i) for i, l in enumerate(self.layers)
                                 if isinstance(l, FrozenLayer))
         vg_fn = jax.value_and_grad(self._build_loss_fn(), has_aux=True)
 
-        def step_fn(params, opt_state, bn_state, step, key, x, y, fmask, lmask):
+        def step_fn(params, opt_state, bn_state, step, key, x, y, fmask,
+                    lmask, sentinel=None):
             if accum_steps == 1:
                 (loss, new_bn), grads = vg_fn(
                     params, bn_state, key, x, y, fmask, lmask)
@@ -323,14 +328,39 @@ class MultiLayerNetwork(_caches.CompiledCacheMixin):
                     (x, y, fmask, lmask),
                     weight_fn=lambda x, y, fm, lm:
                         _micro.label_count_weight(lm))
-            grads = self._clip(grads)
-            # leaf-wise on purpose: apply_fused measured -8..-13 MFU points
-            # on ResNet-50 (see ComputationGraph._build_train_step)
-            new_params, new_opt = _updaters.apply_leafwise(
-                updater, grads, opt_state, params, step)
-            new_params = _constraints.apply_constraints(
-                self.conf.constraints, new_params, skip=frozen_keys)
-            return new_params, new_opt, new_bn, loss
+            grads, clip_events = self._clip(grads)
+
+            def _apply(params, opt_state):
+                new_params, new_opt = _updaters.apply_leafwise(
+                    updater, grads, opt_state, params, step)
+                new_params = _constraints.apply_constraints(
+                    self.conf.constraints, new_params, skip=frozen_keys)
+                return new_params, new_opt
+
+            if not sentinel_guard:  # A/B baseline (bench resilience metric)
+                new_params, new_opt = _apply(params, opt_state)
+                if sentinel is None:
+                    return new_params, new_opt, new_bn, loss
+                return (new_params, new_opt, new_bn,
+                        _sent.update_counters(sentinel, jnp.bool_(True),
+                                              clip_events), loss)
+
+            # DIVERGENCE SENTINEL (runtime/sentinel.py): non-finite loss or
+            # global grad norm -> lax.cond SKIPS the updater application and
+            # the BN-state commit (the bad batch leaves no trace in any
+            # carried state), bumps the on-device counters, and training
+            # continues — no host sync, no retrace, no exception (DL4J
+            # throws on NaN gradients; divergence recorded in PARITY.md).
+            ok = _sent.finite_ok(loss, grads)
+            new_params, new_opt = _sent.guarded_apply(
+                ok, _apply, params, opt_state)
+            out_bn = jax.tree.map(
+                lambda new, old: jnp.where(ok, new, old),
+                new_bn, bn_state) if bn_state else new_bn
+            if sentinel is None:  # pre-sentinel call signature (tests/tools)
+                return new_params, new_opt, out_bn, loss
+            return (new_params, new_opt, out_bn,
+                    _sent.update_counters(sentinel, ok, clip_events), loss)
 
         # donate params/opt/bn buffers: in-place update on device (workspace
         # arenas' moral equivalent, handled by XLA)
@@ -345,19 +375,22 @@ class MultiLayerNetwork(_caches.CompiledCacheMixin):
         batch arity)."""
         step = self._build_train_step().__wrapped__
 
-        def epoch_fn(params, opt_state, bn_state, start_step, key, xs, ys):
+        def epoch_fn(params, opt_state, bn_state, sentinel, start_step, key,
+                     xs, ys):
             def body(carry, xy):
-                params, opt_state, bn_state, i = carry
+                params, opt_state, bn_state, sentinel, i = carry
                 bx, by = xy
                 k = jax.random.fold_in(key, i)
-                params, opt_state, bn_state, loss = step(
-                    params, opt_state, bn_state, i, k, bx, by, None, None)
-                return (params, opt_state, bn_state, i + 1), loss
-            (params, opt_state, bn_state, _), losses = jax.lax.scan(
-                body, (params, opt_state, bn_state, start_step), (xs, ys))
-            return params, opt_state, bn_state, losses
+                params, opt_state, bn_state, sentinel, loss = step(
+                    params, opt_state, bn_state, i, k, bx, by, None, None,
+                    sentinel)
+                return (params, opt_state, bn_state, sentinel, i + 1), loss
+            (params, opt_state, bn_state, sentinel, _), losses = jax.lax.scan(
+                body, (params, opt_state, bn_state, sentinel, start_step),
+                (xs, ys))
+            return params, opt_state, bn_state, sentinel, losses
 
-        return jax.jit(epoch_fn, donate_argnums=(0, 1, 2),
+        return jax.jit(epoch_fn, donate_argnums=(0, 1, 2, 3),
                        compiler_options=_env.engine_compiler_options())
 
     def fit_on_device(self, features, labels, epochs: int = 1,
@@ -399,8 +432,10 @@ class MultiLayerNetwork(_caches.CompiledCacheMixin):
         history = []
         for _ in range(epochs):
             self._key, sub = jax.random.split(self._key)
-            self.params, self.updater_state, self.state, losses = \
+            (self.params, self.updater_state, self.state, self._sentinel,
+             losses) = \
                 self._epoch_fn(self.params, self.updater_state, self.state,
+                               self._ensure_sentinel(),
                                jnp.int32(self.iteration), sub, xs, ys)
             self.iteration += nb
             self.epoch += 1
@@ -412,8 +447,21 @@ class MultiLayerNetwork(_caches.CompiledCacheMixin):
         self._score = float(out[-1])
         return out
 
-    def fit(self, data, labels=None, epochs: int = 1) -> "MultiLayerNetwork":
-        """DL4J fit(): accepts DataSetIterator, DataSet, or (features, labels)."""
+    def fit(self, data, labels=None, epochs: int = 1,
+            resilience=None) -> "MultiLayerNetwork":
+        """DL4J fit(): accepts DataSetIterator, DataSet, or (features, labels).
+
+        ``resilience`` (a ``parallel.resilience.ResiliencePolicy``) wraps
+        the epoch loop in the auto-resume driver: bounded retry-with-backoff
+        on transient runtime failures (device loss / preemption-shaped
+        ``XlaRuntimeError`` / iterator I/O errors) restoring model + updater
+        + iterator state from the policy's crash-safe checkpointer, plus
+        divergence escalation (rollback + LR backoff) after K consecutive
+        sentinel-skipped steps."""
+        if resilience is not None:
+            from ..parallel.resilience import run_resilient_fit
+            return run_resilient_fit(self, data, labels=labels,
+                                     epochs=epochs, policy=resilience)
         if not self.params and not self.state:
             self.init()
         if self._out_layer is None:
@@ -421,6 +469,7 @@ class MultiLayerNetwork(_caches.CompiledCacheMixin):
         algo = getattr(self.conf, "optimization_algo", "SGD") or "SGD"
         if algo.upper() not in ("SGD", "STOCHASTIC_GRADIENT_DESCENT"):
             return self._fit_with_solver(data, labels, epochs)
+        from ..runtime import faults as _faults
         it = _as_iterator(data, labels)
         if self._train_step is None:
             self._train_step = self._build_train_step()
@@ -430,13 +479,22 @@ class MultiLayerNetwork(_caches.CompiledCacheMixin):
                 self._key, sub = jax.random.split(self._key)
                 x = jnp.asarray(ds.features)
                 y = jnp.asarray(ds.labels)
+                if _faults.enabled():
+                    _faults.trip("train.step")  # crash/preemption site
+                    # float check FIRST: a non-float input must not consume
+                    # the injection's fire budget without poisoning anything
+                    if jnp.issubdtype(x.dtype, jnp.floating) and \
+                            _faults.trip("train.nonfinite") is not None:
+                        x = jnp.full_like(x, jnp.nan)  # sentinel site
                 fm = None if ds.features_mask is None else jnp.asarray(ds.features_mask)
                 lm = None if ds.labels_mask is None else jnp.asarray(ds.labels_mask)
                 step = jnp.asarray(self.iteration, dtype=jnp.int32)  # traced, no retrace per step
                 self._last_batch = x  # StatsListener activation sampling
-                self.params, self.updater_state, self.state, loss = \
+                (self.params, self.updater_state, self.state, self._sentinel,
+                 loss) = \
                     self._train_step(self.params, self.updater_state, self.state,
-                                     step, sub, x, y, fm, lm)
+                                     step, sub, x, y, fm, lm,
+                                     self._ensure_sentinel())
                 # keep the loss on device: score() syncs lazily, so the train
                 # loop never blocks on the host (async dispatch back-to-back)
                 self._score = loss

@@ -222,13 +222,37 @@ class MaxScoreIterationTerminationCondition:
 
 
 class InvalidScoreIterationTerminationCondition:
+    """Stop on a NaN/inf training score (DL4J invalid-score termination).
+
+    ``max_bad_steps`` (ISSUE 5 satellite) additionally wires this to the
+    divergence sentinel's bad-step counter: because the sentinel SKIPS
+    non-finite steps instead of letting them poison the params, a
+    diverging run's *score* recovers as soon as one good batch lands —
+    the skipped-step counter is the signal that persists. With
+    ``max_bad_steps=N`` the condition trips once the model's lifetime
+    ``bad_total`` reaches N, even if the current score is finite."""
+
+    wants_model = True  # _IterationConditionListener injects `_model`
+
+    def __init__(self, max_bad_steps: Optional[int] = None):
+        self.max_bad_steps = max_bad_steps
+        self._model = None
+
     def initialize(self):
-        pass
+        self._model = None
 
     def terminate(self, last_score: float) -> bool:
-        return bool(np.isnan(last_score) or np.isinf(last_score))
+        if bool(np.isnan(last_score) or np.isinf(last_score)):
+            return True
+        if self.max_bad_steps is not None and self._model is not None and \
+                hasattr(self._model, "resilience_counters"):
+            return self._model.resilience_counters()["bad_total"] \
+                >= self.max_bad_steps
+        return False
 
     def __str__(self):
+        if self.max_bad_steps is not None:
+            return f"InvalidScore(max_bad_steps={self.max_bad_steps})"
         return "InvalidScore"
 
 
@@ -286,6 +310,8 @@ class _IterationConditionListener:
     def iteration_done(self, model, iteration, epoch):
         score = model.score()
         for c in self.conditions:
+            if getattr(c, "wants_model", False):
+                c._model = model  # sentinel-wired conditions read counters
             if c.terminate(score):
                 raise _IterationStop(c)
 

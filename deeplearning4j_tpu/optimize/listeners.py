@@ -63,18 +63,20 @@ class PerformanceListener(TrainingListener):
     def __init__(self, frequency: int = 10, batch_size: Optional[int] = None,
                  flops_per_example: Optional[float] = None,
                  peak_flops: Optional[float] = None, printer: Callable = None,
-                 collect_memory: bool = True):
+                 collect_memory: bool = True, collect_resilience: bool = True):
         self.frequency = max(1, frequency)
         self.batch_size = batch_size
         self.flops_per_example = flops_per_example
         self.peak_flops = peak_flops or _detect_peak_flops()
         self.collect_memory = collect_memory
+        self.collect_resilience = collect_resilience
         self._print = printer or (lambda s: log.info(s))
         self._t0 = None
         self._it0 = 0
         self.last_examples_per_sec = float("nan")
         self.last_mfu = float("nan")
         self.last_memory: Optional[dict] = None
+        self.last_resilience: Optional[dict] = None
 
     def iteration_done(self, model, iteration, epoch):
         now = time.perf_counter()
@@ -105,6 +107,22 @@ class PerformanceListener(TrainingListener):
                 msg += (f", hbm peak "
                         f"{self.last_memory['peak_bytes_in_use'] / 2**30:.2f}"
                         f"/{self.last_memory['bytes_limit'] / 2**30:.2f} GiB")
+        if self.collect_resilience and hasattr(model, "resilience_counters"):
+            # divergence-sentinel counters (the interval's ONE deliberate
+            # device sync — frequency-gated) + checkpoint/restore telemetry
+            from ..runtime import faults as _faults
+            rc = dict(model.resilience_counters())
+            rc.update(_faults.telemetry_snapshot())
+            self.last_resilience = rc
+            if rc["bad_total"]:
+                msg += f", skipped {rc['bad_total']} non-finite steps"
+            if rc["clip_events"]:
+                msg += f", {rc['clip_events']} clip events"
+            if rc.get("checkpoint_last_save_latency_s") is not None:
+                msg += (f", ckpt save "
+                        f"{rc['checkpoint_last_save_latency_s'] * 1e3:.0f}ms")
+            if rc.get("restore_count"):
+                msg += f", {rc['restore_count']} restores"
         self._print(msg)
         self._t0 = now
         self._it0 = iteration

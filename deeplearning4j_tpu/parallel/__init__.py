@@ -6,3 +6,4 @@ from .launcher import (HostShardedIterator, global_mesh, initialize,  # noqa: F4
                        is_multi_host, make_global_array, process_count,
                        process_index, shutdown)
 from .checkpoint import TrainingCheckpointer  # noqa: F401
+from .resilience import ResiliencePolicy, run_resilient_fit  # noqa: F401

@@ -14,16 +14,52 @@ it owns (OCDBT), which is the multi-host analog of the reference's
 single-file ZIP; the single-host interchange ZIP (``utils/serializer.py``)
 remains the portable format. Rotation (`max_to_keep`) mirrors
 CheckpointListener's keepLast semantics.
+
+Crash safety (ISSUE 5): every save is certified by an atomically-written
+sha256 manifest (tmp + fsync + rename after the orbax commit); restore
+checksum-verifies newest-first and falls back past torn writes to the
+newest VERIFIED checkpoint, raising ``CorruptCheckpoint`` only when
+nothing verifies. ``async_save=True`` snapshots device leaves with an
+enqueued copy and commits on a background thread, so the step loop never
+blocks on a save. Save latency / restore / fallback counts feed
+``runtime.faults`` telemetry (PerformanceListener, ui.StatsListener).
 """
 
 from __future__ import annotations
 
+import hashlib
+import json
+import logging
 import os
-from typing import Optional
+import queue
+import threading
+import time
+from typing import List, Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+from ..runtime import faults as _faults
+from ..runtime.faults import CorruptCheckpoint
+
+log = logging.getLogger("deeplearning4j_tpu")
+
+#: Per-checkpoint checksum manifest (crash-safety layer, ISSUE 5): written
+#: tmp + fsync + rename AFTER the checkpoint commit, so its presence+match
+#: certifies the whole step directory. A checkpoint with no manifest is
+#: "unverified" (pre-ISSUE-5 save or one whose writer died before the
+#: manifest — restore accepts it only as a last resort); a MISMATCH is a
+#: torn write and the checkpoint is skipped.
+MANIFEST = "manifest.sha256.json"
+
+
+def _sha256(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
 
 
 def _config_equivalent(stored_json, live_json) -> bool:
@@ -36,8 +72,14 @@ def _config_equivalent(stored_json, live_json) -> bool:
     if stored_json is None:
         return True  # pre-config-check checkpoint (format v1 early saves)
     a, b = json.loads(stored_json), json.loads(live_json)
-    a.pop("seed", None)
-    b.pop("seed", None)
+    for d in (a, b):
+        d.pop("seed", None)
+        # the resilience policy's LR backoff legitimately mutates the live
+        # updater's learning rate between checkpoint and rollback-restore;
+        # a changed LR is a hyperparameter, not a different architecture
+        if isinstance(d.get("updater"), dict):
+            d["updater"] = dict(d["updater"])
+            d["updater"].pop("learning_rate", None)
     return a == b
 
 
@@ -53,7 +95,8 @@ class TrainingCheckpointer:
         step = ckpt.restore(net, iterator=it)     # after restart; None if none
     """
 
-    def __init__(self, directory: str, max_to_keep: int = 3):
+    def __init__(self, directory: str, max_to_keep: int = 3,
+                 async_save: bool = False):
         import orbax.checkpoint as ocp
 
         self._ocp = ocp
@@ -62,6 +105,24 @@ class TrainingCheckpointer:
             self.directory,
             options=ocp.CheckpointManagerOptions(
                 max_to_keep=max_to_keep, create=True))
+        # crash-safety state (ISSUE 5): ONE long-lived background worker
+        # drains a finalize queue (wait-for-orbax-commit + checksum
+        # manifest; for async_save, the whole host-gather + commit), so
+        # saves never block the step loop and thread count stays bounded.
+        # Concurrent _mngr.save (foreground) vs the worker's
+        # wait_until_finished is safe: orbax's async manager serializes
+        # commits internally (save() itself waits for the previous
+        # commit), and restore() drains the queue before touching _mngr.
+        self.async_save = bool(async_save)
+        self._finalize_q: "queue.Queue" = queue.Queue()
+        self._worker: Optional[threading.Thread] = None
+        self._bg_errors: List[BaseException] = []
+        self.restore_count = 0
+        self.restore_fallbacks = 0
+        self.last_save_latency_s: Optional[float] = None
+        # rolling window (multi-week cadenced runs must not grow a list)
+        from collections import deque
+        self.save_latencies = deque(maxlen=512)
 
     # -- save ---------------------------------------------------------------
     def save(self, model, iterator=None, step: Optional[int] = None,
@@ -113,14 +174,206 @@ class TrainingCheckpointer:
                 "configuration": model.conf.to_json(),
                 "iterator": dict(iterator.state()) if iterator is not None
                 else None,
+                # divergence-sentinel counters ride along so a resumed run
+                # continues the exact telemetry series (and the bench's
+                # recovery metric can diff them); filled below — the async
+                # path must NOT sync them here (host int() would drain the
+                # in-flight steps), so the device counters go into the
+                # copied payload and convert on the background thread
+                "resilience": None,
                 "format": "deeplearning4j_tpu.parallel.checkpoint",
-                "version": 1}
-        self._mngr.save(step, args=ocp.args.Composite(
+                "version": 2}
+        sent = getattr(model, "_sentinel", None)
+        has_counters = hasattr(model, "resilience_counters")
+        t0 = time.perf_counter()
+        if self.async_save and not wait:
+            # ASYNC-SAVE MODE: never blocks the step loop AT ALL. The
+            # device-side jnp.copy snapshots every leaf WITHOUT a host sync
+            # (the copy is enqueued behind the in-flight step), so the fit
+            # loop's buffer donation cannot invalidate what the background
+            # writer reads; the host gather, orbax commit, and manifest all
+            # happen on the finalize worker.
+            tree = jax.tree.map(
+                lambda a: jnp.copy(a) if isinstance(a, jax.Array) else a,
+                tree)
+            sent_copy = jax.tree.map(jnp.copy, sent) if sent else None
+
+            def job():
+                if has_counters:
+                    from ..runtime import sentinel as _sent
+                    meta["resilience"] = _sent.to_host(sent_copy)
+                host = jax.tree.map(
+                    lambda a: np.asarray(a)
+                    if isinstance(a, jax.Array) else a, tree)
+                committed = self._mngr.save(step, args=ocp.args.Composite(
+                    tree=ocp.args.PyTreeSave(host),
+                    meta=ocp.args.JsonSave(meta)))
+                self._mngr.wait_until_finished()
+                self._after_commit(step, t0, committed)
+
+            self._enqueue_finalize(job)
+            return step
+        if has_counters:
+            meta["resilience"] = model.resilience_counters()
+        # orbax's save is async on its side (it snapshots to host before
+        # returning), keeping the historical non-blocking wait=False
+        # contract for in-train-loop callers
+        committed = self._mngr.save(step, args=ocp.args.Composite(
             tree=ocp.args.PyTreeSave(tree),
             meta=ocp.args.JsonSave(meta)))
         if wait:
             self._mngr.wait_until_finished()
+            self._after_commit(step, t0, committed)
+            return step
+
+        # the checksum manifest certifies a COMPLETE commit, so it must
+        # wait for orbax — on the finalize worker, never in the step loop;
+        # a following restore()/wait_until_finished() joins the queue
+        def job():
+            self._mngr.wait_until_finished()
+            self._after_commit(step, t0, committed)
+
+        self._enqueue_finalize(job)
         return step
+
+    def _after_commit(self, step: int, t0: float, committed):
+        """Post-commit gate: a save that orbax SKIPPED (``save()`` returns
+        False when the step already exists — e.g. re-reaching the same
+        iteration after a rollback) must NOT finalize, or the manifest
+        would be rewritten from whatever bytes are on disk, re-certifying
+        a possibly torn/stale checkpoint as verified."""
+        if committed is False:
+            log.warning(
+                "checkpoint step %d already exists; orbax kept the existing "
+                "bytes — manifest left untouched", step)
+            return
+        self._finalize_save(step, t0)
+
+    def _enqueue_finalize(self, job):
+        if self._worker is None or not self._worker.is_alive():
+            self._worker = threading.Thread(
+                target=self._worker_loop, daemon=True,
+                name="TrainingCheckpointer-finalizer")
+            self._worker.start()
+        self._finalize_q.put(job)
+
+    def _worker_loop(self):
+        while True:
+            job = self._finalize_q.get()
+            try:
+                job()
+            except BaseException as e:  # surfaced by wait_until_finished
+                self._bg_errors.append(e)
+            finally:
+                self._finalize_q.task_done()
+
+    def _finalize_save(self, step: int, t0: float):
+        """Post-commit finalize: write the checksum manifest (atomically:
+        tmp file + fsync + rename + directory fsync), then record the
+        durable-save latency. The ``checkpoint.write`` fault site sits
+        AFTER the manifest so an injected torn write produces exactly what
+        a real one does — on-disk bytes that no longer match the manifest
+        — which ``restore()`` must detect and fall back from."""
+        self._write_manifest(step)
+        inj = _faults.trip("checkpoint.write") if _faults.enabled() else None
+        if inj is not None:
+            self._tear(step)
+        latency = time.perf_counter() - t0
+        self.last_save_latency_s = latency
+        self.save_latencies.append(latency)
+        _faults.telemetry_bump("checkpoint_saves")
+        _faults.telemetry_set("checkpoint_last_save_latency_s", latency)
+
+    # -- manifest / verification --------------------------------------------
+    def _step_dir(self, step: int) -> Optional[str]:
+        """The on-disk directory of ``step`` (orbax names it ``<step>`` or
+        ``<prefix>_<step>`` depending on options)."""
+        if not os.path.isdir(self.directory):
+            return None
+        for name in os.listdir(self.directory):
+            p = os.path.join(self.directory, name)
+            if os.path.isdir(p) and (
+                    name == str(step) or name.rsplit("_", 1)[-1] == str(step)):
+                return p
+        return None
+
+    def _write_manifest(self, step: int):
+        d = self._step_dir(step)
+        if d is None:
+            return
+        try:
+            files = {}
+            for root, _, fs in os.walk(d):
+                for f in fs:
+                    if f == MANIFEST or f.endswith(".tmp"):
+                        continue
+                    p = os.path.join(root, f)
+                    files[os.path.relpath(p, d)] = {
+                        "sha256": _sha256(p), "bytes": os.path.getsize(p)}
+            payload = json.dumps({"step": int(step), "files": files},
+                                 sort_keys=True).encode()
+            tmp = os.path.join(d, MANIFEST + ".tmp")
+            with open(tmp, "wb") as fh:
+                fh.write(payload)
+                fh.flush()
+                os.fsync(fh.fileno())
+            os.replace(tmp, os.path.join(d, MANIFEST))
+            dfd = os.open(d, os.O_RDONLY)
+            try:
+                os.fsync(dfd)
+            finally:
+                os.close(dfd)
+        except FileNotFoundError:
+            # the checkpoint was rotated away (max_to_keep) by a NEWER save
+            # while this background finalize was still hashing it — a
+            # deleted checkpoint needs no manifest
+            log.info("checkpoint %d rotated away during manifest finalize",
+                     step)
+
+    def _tear(self, step: int):
+        """Injected torn write: truncate the largest manifest-listed file
+        to half its committed size (what an interrupted writer leaves)."""
+        d = self._step_dir(step)
+        if d is None:
+            return  # rotated away before the injection could tear it
+        mpath = os.path.join(d, MANIFEST)
+        with open(mpath) as fh:
+            files = json.load(fh)["files"]
+        rel = max(files, key=lambda r: files[r]["bytes"])
+        p = os.path.join(d, rel)
+        with open(p, "r+b") as fh:
+            fh.truncate(max(1, files[rel]["bytes"] // 2))
+        log.warning("injected torn write: truncated %s in checkpoint %d",
+                    rel, step)
+
+    def verify(self, step: int) -> Optional[bool]:
+        """Checksum-verify one checkpoint against its manifest. True =
+        verified, False = CORRUPT (missing/short/mismatched file — a torn
+        write), None = no manifest (pre-manifest checkpoint; unknown)."""
+        d = self._step_dir(step)
+        if d is None:
+            return False
+        mpath = os.path.join(d, MANIFEST)
+        if not os.path.exists(mpath):
+            return None
+        try:
+            with open(mpath) as fh:
+                files = json.load(fh)["files"]
+        except (ValueError, KeyError, OSError):
+            return False  # torn manifest
+        for rel, want in files.items():
+            p = os.path.join(d, rel)
+            if not os.path.exists(p) or \
+                    os.path.getsize(p) != want["bytes"] or \
+                    _sha256(p) != want["sha256"]:
+                return False
+        return True
+
+    def verified_steps(self) -> List[int]:
+        """Steps whose manifest verifies, newest first (None-manifest
+        steps excluded)."""
+        return [s for s in sorted(self._mngr.all_steps(), reverse=True)
+                if self.verify(s) is True]
 
     # -- restore ------------------------------------------------------------
     def latest_step(self) -> Optional[int]:
@@ -132,12 +385,62 @@ class TrainingCheckpointer:
         latest). Returns the restored step, or None when no checkpoint
         exists (first launch) — callers can use that as the cold-start
         signal. The model must be built from the same configuration; this is
-        asserted against the stored config JSON."""
+        asserted against the stored config JSON.
+
+        Corruption handling (ISSUE 5): with ``step=None`` the candidate
+        list is walked newest-first and every candidate is checksum-
+        verified against its manifest; a torn write (mismatch) is skipped
+        — counted in ``restore_fallbacks`` — and the newest VERIFIED
+        checkpoint restores instead. Only if every checkpoint fails
+        verification does this raise :class:`CorruptCheckpoint`. An
+        explicitly requested ``step`` raises immediately when corrupt."""
         ocp = self._ocp
+        self.wait_until_finished()  # async saves must commit before we pick
         if step is None:
-            step = self._mngr.latest_step()
-        if step is None:
-            return None
+            steps = sorted(self._mngr.all_steps(), reverse=True)
+            if not steps:
+                return None
+            # newest VERIFIED first; a manifest-less checkpoint (verify()
+            # None — e.g. the writer died between the orbax commit and the
+            # manifest, or a pre-manifest save) is accepted only when NO
+            # verified checkpoint exists at all (last resort); a mismatch
+            # (False) is a torn write and never restores. Lazy walk: the
+            # common case (newest checkpoint intact) hashes exactly one
+            # checkpoint, not all max_to_keep of them.
+            step = first_unverified = None
+            chosen_verdict = True
+            for s in steps:
+                v = self.verify(s)
+                if v is True:
+                    step = s
+                    break
+                if v is None and first_unverified is None:
+                    first_unverified = s
+            if step is None and first_unverified is not None:
+                step, chosen_verdict = first_unverified, None
+            if step is None:
+                raise CorruptCheckpoint(
+                    f"all {len(steps)} checkpoints in {self.directory} "
+                    "failed manifest verification")
+            skipped = steps.index(step)
+            if skipped:
+                log.warning(
+                    "checkpoint(s) %s skipped (torn write or missing "
+                    "manifest); falling back to step %d (verify=%s)",
+                    steps[:skipped], step, chosen_verdict)
+                self.restore_fallbacks += skipped
+                _faults.telemetry_bump("restore_fallbacks", skipped)
+        elif self._step_dir(step) is None:
+            # plain not-found (never saved, or rotated away by max_to_keep)
+            # — NOT a corruption signal; callers must not take disk-repair
+            # recovery actions for a typo'd/rotated step
+            raise ValueError(
+                f"checkpoint step {step} not found in {self.directory} "
+                f"(available: {sorted(self._mngr.all_steps())})")
+        elif self.verify(step) is False:
+            raise CorruptCheckpoint(
+                f"checkpoint {step} in {self.directory} failed manifest "
+                "verification (torn write)")
         try:
             restored = self._mngr.restore(step, args=ocp.args.Composite(
                 tree=ocp.args.PyTreeRestore(),
@@ -191,12 +494,27 @@ class TrainingCheckpointer:
         model.epoch = meta["epoch"]
         if iterator is not None and meta.get("iterator") is not None:
             iterator.set_state(meta["iterator"])
+        rc = meta.get("resilience")
+        if rc is not None and hasattr(model, "resilience_counters"):
+            # resume the sentinel counter series exactly (bit-equivalent
+            # resume includes the telemetry)
+            model._sentinel = {k: jnp.asarray(int(v), jnp.int32)
+                               for k, v in rc.items()}
+        self.restore_count += 1
+        _faults.telemetry_bump("restore_count")
         return step
 
     def wait_until_finished(self):
+        """Block until every in-flight save (orbax commit AND background
+        manifest finalize) is durable; re-raises the first background
+        failure."""
         self._mngr.wait_until_finished()
+        self._finalize_q.join()
+        if self._bg_errors:
+            raise self._bg_errors.pop(0)
 
     def close(self):
+        self.wait_until_finished()
         self._mngr.close()
 
     def __enter__(self):

@@ -262,10 +262,15 @@ class ParallelWrapper:
         # weights — no hand-written collectives anywhere.
         pure = self.model._build_train_step(self.accum_steps).__wrapped__
         from jax.tree_util import tree_structure
+        from ..runtime import sentinel as _sent
         _, _, p_sh, upd_sh, opt_sh, bn_sh, p_struct = self._sharding_trees()
+        # sentinel counters (divergence sentinel, runtime/sentinel.py) ride
+        # along replicated — GSPMD reduces the finite-check across shards
+        # inside the step, so every device agrees on skip-vs-apply
+        sent_sh = {n: repl for n in _sent.COUNTERS}
         step_fn = jax.jit(
             pure, donate_argnums=(0, 1, 2),
-            out_shardings=(p_sh, opt_sh, bn_sh, repl),
+            out_shardings=(p_sh, opt_sh, bn_sh, sent_sh, repl),
             compiler_options=_envmod.engine_compiler_options())
 
         multi_host = jax.process_count() > 1
@@ -292,7 +297,8 @@ class ParallelWrapper:
                 return tuple(shard_batch(a) for a in t)
             return put(t, data)
 
-        def shard_args(params, opt_state, bn_state, step, key, x, y, fm, lm):
+        def shard_args(params, opt_state, bn_state, sentinel, step, key,
+                       x, y, fm, lm):
             # params/opt structure and model_axis are fixed after init, so
             # the build-time sharding trees apply every step (after the
             # first step every put() is a pass-through anyway)
@@ -312,7 +318,8 @@ class ParallelWrapper:
             return (params, opt_state, bn_state,
                     put(step, repl), put(key, repl),
                     shard_batch(x), shard_batch(y),
-                    shard_batch(fm), shard_batch(lm))
+                    shard_batch(fm), shard_batch(lm),
+                    jax.tree.map(lambda a: put(a, repl), sentinel))
 
         return step_fn, shard_args
 
@@ -351,6 +358,7 @@ class ParallelWrapper:
             "peak_bytes": None,
             "device": _memory.device_memory_stats(),
         }
+        from ..runtime import sentinel as _sent
         compiled = step_fn.lower(
             jax.tree.map(sds, jax.eval_shape(lambda: m.params), p_sh),
             jax.tree.map(sds, jax.eval_shape(lambda: m.updater_state),
@@ -358,7 +366,9 @@ class ParallelWrapper:
             jax.tree.map(sds, jax.eval_shape(lambda: m.state), bn_sh),
             jax.ShapeDtypeStruct((), jnp.int32, sharding=repl),
             sds(jax.eval_shape(lambda: jax.random.PRNGKey(0)), repl),
-            x, y, fm, lm).compile()
+            x, y, fm, lm,
+            jax.tree.map(lambda a: sds(a, repl),
+                         _sent.counter_avals())).compile()
         cm = _memory.compiled_memory(compiled)
         if cm:
             report.update(cm)
@@ -376,7 +386,12 @@ class ParallelWrapper:
                              f"mesh has {self.mesh.axis_names}")
         return InferenceEngine(self.model, mesh=self.mesh, **kwargs)
 
-    def fit(self, data, epochs: int = 1):
+    def fit(self, data, epochs: int = 1, resilience=None):
+        if resilience is not None:
+            from .resilience import run_resilient_fit
+            return run_resilient_fit(self, data, epochs=epochs,
+                                     policy=resilience)
+        from ..runtime import faults as _faults
         m = self.model
         if not m.params:
             m.init()
@@ -386,11 +401,23 @@ class ParallelWrapper:
         for _ in range(epochs):
             for batch in self._batches(data):
                 x, y, fm, lm = batch
+                if _faults.enabled():
+                    _faults.trip("train.step")  # crash/preemption site
+                    # float check FIRST: all-int inputs must not consume
+                    # the injection's fire budget without poisoning anything
+                    if any(np.issubdtype(np.asarray(a).dtype, np.floating)
+                           for a in jax.tree.leaves(x)) and \
+                            _faults.trip("train.nonfinite") is not None:
+                        x = jax.tree.map(
+                            lambda a: np.full_like(a, np.nan)
+                            if np.issubdtype(np.asarray(a).dtype, np.floating)
+                            else a, x)  # sentinel site
                 m._key, sub = jax.random.split(m._key)
                 args = shard_args(
-                    m.params, m.updater_state, m.state,
+                    m.params, m.updater_state, m.state, m._ensure_sentinel(),
                     jnp.asarray(m.iteration, jnp.int32), sub, x, y, fm, lm)
-                m.params, m.updater_state, m.state, loss = step_fn(*args)
+                m.params, m.updater_state, m.state, m._sentinel, loss = \
+                    step_fn(*args)
                 m._score = loss
                 m.iteration += 1
                 for cb in m._listeners:

@@ -1,0 +1,250 @@
+"""Resilience policy + auto-resume driver (ISSUE 5 tentpole, layers 1+3).
+
+The host-side half of the fault-tolerance story (the device-side half is
+``runtime/sentinel.py``, fused into every engine's compiled train step):
+
+- :class:`ResiliencePolicy` — the knob bundle ``fit(..., resilience=policy)``
+  takes on both nn engines and the ``ParallelWrapper``.
+- :func:`run_resilient_fit` — wraps the epoch loop in a bounded
+  retry-with-backoff. Transient runtime failures (device loss /
+  preemption-shaped ``XlaRuntimeError`` / iterator I/O errors / injected
+  crashes) restore model + updater + iterator state from the policy's
+  crash-safe :class:`~.checkpoint.TrainingCheckpointer` and continue;
+  divergence escalations (K consecutive sentinel-skipped steps, detected
+  host-side at ``check_every`` cadence) roll back to the last GOOD
+  checkpoint with an optional learning-rate backoff. Because the
+  checkpoint captures params, updater state, BN state, the rng key, the
+  iteration counter AND the data-iterator cursor, a resumed run is
+  step-count-exact and bit-equivalent to an uninterrupted one on CPU
+  (tested in tests/test_resilience.py).
+
+This is the TensorFlow OSDI-2016 recovery contract (user-level
+checkpointing + automatic re-execution on failure) expressed over our
+engines; DL4J's closest analog is Spark-driver fault tolerance, which has
+no single-process equivalent — divergence recorded in PARITY.md.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import time
+from typing import Any, Optional
+
+import jax.numpy as jnp
+
+from ..runtime import faults as _faults
+from ..runtime import sentinel  # noqa: F401  (re-export: policy API surface)
+from ..runtime.faults import DivergenceError
+
+log = logging.getLogger("deeplearning4j_tpu")
+
+
+@dataclasses.dataclass
+class ResiliencePolicy:
+    """What to tolerate, and how hard to try.
+
+    - ``max_consecutive_bad_steps``: escalate to rollback after K
+      consecutive sentinel-skipped (non-finite) steps. 0 disables the
+      escalation (the sentinel still skips and counts).
+    - ``lr_backoff``: learning-rate multiplier applied at each divergence
+      rollback (1.0 = off). Mutates the live updater config and retraces
+      the step — a recovery-path cost, never a steady-state one.
+    - ``max_restarts``: total restore-and-continue budget (crashes and
+      divergence rollbacks combined); exceeding it re-raises.
+    - ``backoff_seconds``: base of the exponential retry backoff
+      (``backoff * 2**(restart-1)`` before each resume; 0 = immediate).
+    - ``checkpointer``: a ``TrainingCheckpointer`` or a directory path
+      (one is created with ``max_to_keep=3``); required — rollback needs
+      somewhere to roll back to.
+    - ``checkpoint_every_iterations``: mid-epoch checkpoint cadence (on
+      top of the always-on epoch-boundary checkpoint). None = epoch
+      boundaries only.
+    - ``check_every``: how often (iterations) the driver reads the
+      bad-step counter. The read is one step LAGGED (it syncs the
+      previous iteration's counter while the current step is in flight),
+      so even the default 1 does not stall the dispatch pipeline;
+      escalation lands one iteration after the crossing, with a final
+      synced check at each epoch boundary.
+    """
+
+    max_consecutive_bad_steps: int = 5
+    lr_backoff: float = 1.0
+    max_restarts: int = 3
+    backoff_seconds: float = 0.0
+    checkpointer: Any = None
+    checkpoint_every_iterations: Optional[int] = None
+    check_every: int = 1
+
+    def resolve_checkpointer(self):
+        from .checkpoint import TrainingCheckpointer
+        if self.checkpointer is None:
+            raise ValueError(
+                "ResiliencePolicy needs a checkpointer (TrainingCheckpointer "
+                "or directory path): auto-resume and divergence rollback "
+                "restore from it")
+        if isinstance(self.checkpointer, str):
+            self.checkpointer = TrainingCheckpointer(self.checkpointer,
+                                                     max_to_keep=3)
+        return self.checkpointer
+
+
+class _ResilienceListener:
+    """Fit-loop hook: mid-epoch/epoch-boundary checkpoints + the
+    divergence check. The check reads the PREVIOUS iteration's counter
+    snapshot (sentinel arrays are immutable per-step values), so the
+    host blocks only on an already-dispatched step — the in-flight step
+    keeps pipelining and the zero-host-sync property of the fused
+    sentinel survives the default ``check_every=1``. Escalation
+    therefore lands one iteration after the threshold crossing; the
+    epoch boundary does a final synced check so a streak ending exactly
+    at the last step cannot escape. Checked BEFORE the checkpoint
+    cadence so a diverging run never checkpoints its way past K."""
+
+    def __init__(self, policy: ResiliencePolicy, ckpt, model, iterator):
+        self.policy = policy
+        self.ckpt = ckpt
+        self.model = model
+        self.iterator = iterator
+        self._lagged = None  # previous step's bad_consec device scalar
+
+    def _escalate(self, bad, iteration):
+        if bad >= self.policy.max_consecutive_bad_steps:
+            raise DivergenceError(
+                f"{bad} consecutive non-finite steps by iteration "
+                f"{iteration} (threshold "
+                f"{self.policy.max_consecutive_bad_steps})")
+
+    def iteration_done(self, model, iteration, epoch):
+        p = self.policy
+        if p.max_consecutive_bad_steps:
+            prev, self._lagged = self._lagged, (
+                self.model._sentinel["bad_consec"]
+                if self.model._sentinel else None)
+            if prev is not None and iteration % p.check_every == 0:
+                self._escalate(int(prev), iteration)
+        if p.checkpoint_every_iterations and \
+                iteration % p.checkpoint_every_iterations == 0:
+            self.ckpt.save(self.model, iterator=self.iterator)
+
+    def on_epoch_end(self, model):
+        if self.policy.max_consecutive_bad_steps:
+            self._escalate(
+                self.model.resilience_counters()["bad_consec"],
+                self.model.iteration)
+        self.ckpt.save(self.model, iterator=self.iterator)
+
+
+def _scale_learning_rate(model, factor: float) -> Optional[float]:
+    """Divergence LR backoff: scale the live updater's scalar learning
+    rate and invalidate the compiled step (the LR is baked into the
+    trace). Schedule-valued learning rates are left alone (scaling a
+    schedule object is not well-defined) — returns the new LR or None."""
+    upd = getattr(model.conf, "updater", None)
+    lr = getattr(upd, "learning_rate", None)
+    if upd is None or not isinstance(lr, (int, float)):
+        log.warning("lr_backoff skipped: updater has no scalar learning "
+                    "rate (schedule or solver path)")
+        return None
+    upd.learning_rate = float(lr) * factor
+    model._invalidate_compiled()
+    return upd.learning_rate
+
+
+def run_resilient_fit(fit_target, data, labels=None, epochs: int = 1,
+                      policy: Optional[ResiliencePolicy] = None):
+    """The auto-resume epoch-loop wrapper behind ``fit(...,
+    resilience=policy)``. ``fit_target`` is a MultiLayerNetwork /
+    ComputationGraph, or a ParallelWrapper (whose inner model carries the
+    state). Every recovery action is counted (faults telemetry: no silent
+    fallbacks) and bounded by ``policy.max_restarts``."""
+    policy = policy or ResiliencePolicy()
+    ckpt = policy.resolve_checkpointer()
+    model = getattr(fit_target, "model", fit_target)  # wrapper -> engine
+
+    # normalize the data to ONE stateful iterator whose cursor the
+    # checkpointer captures; the engines accept it directly
+    from ..nn.graph import ComputationGraph, _as_multi_iterator
+    from ..nn.model import _as_iterator
+    if isinstance(model, ComputationGraph):
+        it = _as_multi_iterator(data, labels)
+    else:
+        it = _as_iterator(data, labels)
+
+    if not model.params and not model.state:
+        model.init()
+    target_epoch = model.epoch + int(epochs)
+    latest = ckpt.latest_step()
+    if latest is None:
+        # a base to roll back to even if the FIRST step diverges/crashes
+        ckpt.save(model, iterator=it, wait=True)
+    elif model.iteration == 0:
+        # JOB-RESTART CONTINUATION: the directory holds a previous run's
+        # checkpoints and this model is fresh — restoring stale state on
+        # the first transient failure would silently discard this run, so
+        # resume the previous run NOW instead (the preempted-job restart
+        # semantics auto-resume exists for). A fresh run needs a fresh
+        # checkpoint directory.
+        step = ckpt.restore(model, iterator=it)
+        log.warning(
+            "resilient fit: checkpoint directory %s already holds a run — "
+            "resumed it at step %s (epoch %d, iteration %d); use a fresh "
+            "directory to start over", ckpt.directory, step, model.epoch,
+            model.iteration)
+    elif int(model.iteration) not in set(ckpt._mngr.all_steps()):
+        # mid-lineage entry (model trained/restored outside the driver):
+        # checkpoint the CURRENT state so rollback never leaves this run
+        ckpt.save(model, iterator=it, wait=True)
+
+    listener = _ResilienceListener(policy, ckpt, model, it)
+    model.add_listener(listener)
+    restarts = 0
+    try:
+        while model.epoch < target_epoch:
+            try:
+                fit_target.fit(it, epochs=1)
+            except DivergenceError as e:
+                restarts += 1
+                if restarts > policy.max_restarts:
+                    raise
+                log.warning("divergence escalation (%s); rolling back to "
+                            "last good checkpoint (restart %d/%d)",
+                            e, restarts, policy.max_restarts)
+                step = ckpt.restore(model, iterator=it)
+                listener._lagged = None  # pre-rollback snapshot is stale
+                if policy.lr_backoff != 1.0:
+                    new_lr = _scale_learning_rate(model, policy.lr_backoff)
+                    if new_lr is not None:
+                        log.warning("learning rate backed off to %g", new_lr)
+                        if fit_target is not model:
+                            fit_target._step = None  # wrapper's own trace
+                # a restored bad_consec must not instantly re-escalate
+                model._sentinel = dict(model._ensure_sentinel(),
+                                       bad_consec=jnp.zeros((), jnp.int32))
+                _faults.telemetry_bump("divergence_rollbacks")
+                _sleep(policy, restarts)
+                log.warning("rolled back to checkpoint step %s", step)
+            except Exception as e:
+                if not _faults.is_transient(e):
+                    raise
+                restarts += 1
+                if restarts > policy.max_restarts:
+                    raise
+                log.warning("transient failure (%s: %s); restoring and "
+                            "resuming (restart %d/%d)", type(e).__name__, e,
+                            restarts, policy.max_restarts)
+                step = ckpt.restore(model, iterator=it)
+                listener._lagged = None  # pre-crash snapshot is stale
+                _faults.telemetry_bump("auto_resumes")
+                _sleep(policy, restarts)
+                log.warning("resumed from checkpoint step %s", step)
+    finally:
+        if listener in model._listeners:
+            model._listeners.remove(listener)
+        ckpt.wait_until_finished()
+    return fit_target
+
+
+def _sleep(policy: ResiliencePolicy, restart: int):
+    if policy.backoff_seconds:
+        time.sleep(policy.backoff_seconds * (2 ** (restart - 1)))

@@ -1,0 +1,9 @@
+"""Runtime resilience plumbing: deterministic fault injection and the
+failure-taxonomy exceptions every recovery path routes through
+(ISSUE 5 tentpole; TensorFlow's OSDI-2016 fault-tolerance design treats
+user-level checkpointing + automatic re-execution as the core mechanism —
+this package makes every such path injectable and therefore testable on
+CPU). Deliberately lightweight: stdlib-only at import time so the nn/
+serving/datavec layers can import it without cycles or heavy deps."""
+
+from . import faults  # noqa: F401

@@ -2,6 +2,8 @@
 JsonModelServer, re-expressed for TPU as a bucketed AOT engine plus a
 dynamic micro-batching dispatcher)."""
 
+from ..runtime.faults import (DeadlineExceeded, QueueFull,  # noqa: F401
+                              ShutdownError)
 from .engine import InferenceEngine, default_buckets, next_bucket  # noqa: F401
-from .batcher import InferenceMode, ParallelInference  # noqa: F401
+from .batcher import HealthState, InferenceMode, ParallelInference  # noqa: F401
 from .server import JsonModelServer  # noqa: F401

@@ -24,6 +24,18 @@ mesh option — the coalesced batch is placed over the ``'data'`` axis via
 Observability: per-request p50/p99 latency, queue depth, coalesced batch
 sizes, and the engine's bucket-hit/compile counters, via :meth:`stats`
 (pumped into the ui/stats storage by ``ui.stats.ServingStatsListener``).
+
+Graceful degradation (ISSUE 5 tentpole, layer 4): per-request deadlines
+(an expired request fails fast with ``DeadlineExceeded`` BEFORE dispatch
+— its device slot goes to a request that can still meet its SLO), a
+queue-depth load-shedding threshold (``QueueFull`` rejection in the
+caller's thread instead of unbounded linger), ONE retry on transient
+executor errors, and a health state machine —
+``HEALTHY``/``DEGRADED``/``SHEDDING`` — surfaced through :meth:`health`,
+:meth:`stats`, ``ui.ServingStatsListener`` and ``JsonModelServer``'s
+``GET /healthz``. Every degradation path is counted (shed /
+deadline_expired / retries — zero silent fallbacks) and injectable via
+``runtime/faults.py`` (``serving.dispatch``, ``serving.slow``).
 """
 
 from __future__ import annotations
@@ -37,6 +49,8 @@ from typing import List, Optional
 
 import numpy as np
 
+from ..runtime import faults as _faults
+from ..runtime.faults import DeadlineExceeded, QueueFull, ShutdownError
 from .engine import InferenceEngine, next_bucket
 
 
@@ -45,14 +59,25 @@ class InferenceMode:
     BATCHED = "batched"
 
 
-class _Request:
-    __slots__ = ("x", "length", "future", "t_enqueue")
+class HealthState:
+    HEALTHY = "HEALTHY"
+    DEGRADED = "DEGRADED"
+    SHEDDING = "SHEDDING"
 
-    def __init__(self, x, length):
+
+class _Request:
+    __slots__ = ("x", "length", "future", "t_enqueue", "deadline")
+
+    def __init__(self, x, length, deadline=None):
         self.x = x
         self.length = length          # true seq length (seq models)
         self.future: Future = Future()
         self.t_enqueue = time.perf_counter()
+        self.deadline = deadline      # absolute perf_counter time or None
+
+    def expired(self, now=None) -> bool:
+        return self.deadline is not None and \
+            (now if now is not None else time.perf_counter()) > self.deadline
 
 
 class ParallelInference:
@@ -76,7 +101,11 @@ class ParallelInference:
                  queue_limit: int = 256, mesh=None,
                  engine: Optional[InferenceEngine] = None,
                  warmup: bool = False,
-                 batch_limit: Optional[int] = None):
+                 batch_limit: Optional[int] = None,
+                 deadline_ms: Optional[float] = None,
+                 shed_queue_depth: Optional[int] = None,
+                 retry_transient: bool = True,
+                 health_window_s: float = 5.0):
         if mode not in (InferenceMode.SEQUENTIAL, InferenceMode.BATCHED):
             raise ValueError(f"unknown inference mode {mode!r}")
         if batch_limit is not None:  # deprecated alias
@@ -85,6 +114,16 @@ class ParallelInference:
         self.mode = mode
         self.max_batch_size = int(max_batch_size)
         self.max_wait = max_wait_ms / 1e3
+        # graceful degradation knobs (ISSUE 5): default deadline applied to
+        # every request unless submit() overrides; load shedding kicks in
+        # at shed_queue_depth queued requests (None = never shed — the
+        # queue_limit bound still blocks); one retry on transient executor
+        # errors; health window for the DEGRADED/SHEDDING decay.
+        self.deadline_ms = deadline_ms
+        self.shed_queue_depth = None if shed_queue_depth is None \
+            else int(shed_queue_depth)
+        self.retry_transient = bool(retry_transient)
+        self.health_window = float(health_window_s)
         if engine is None:
             # default: share the model's engine, so net.output() and the
             # batcher hit the same warmed bucket cache; a mesh needs its
@@ -112,6 +151,14 @@ class ParallelInference:
         self.requests = 0
         self.batches = 0
         self.failures = 0
+        # degradation counters (every fault path counted — no silent
+        # fallbacks) + the recent-event window behind health()
+        self.shed = 0
+        self.deadline_expired = 0
+        self.retries = 0
+        self._events = deque(maxlen=1024)      # (t, kind) kind in
+        #                                        {shed, failure, retry,
+        #                                         deadline}
         if mode == InferenceMode.BATCHED:
             self._worker = threading.Thread(
                 target=self._dispatcher, daemon=True,
@@ -119,41 +166,75 @@ class ParallelInference:
             self._worker.start()
 
     # ---- public ------------------------------------------------------------
-    def submit(self, x) -> Future:
+    def submit(self, x, deadline_ms: Optional[float] = None) -> Future:
         """Enqueue one request; resolves to the unpadded output rows.
         Requests larger than ``max_batch_size`` are split into capped
-        chunks (each lands on a warmed bucket) and rejoined."""
+        chunks (each lands on a warmed bucket) and rejoined.
+
+        ``deadline_ms`` (default: the constructor's ``deadline_ms``): if
+        the request is still queued when its deadline passes, it fails
+        fast with :class:`DeadlineExceeded` — never dispatched, so device
+        time goes to requests that can still meet their SLO. When the
+        queue is at ``shed_queue_depth``, this raises :class:`QueueFull`
+        in the caller's thread immediately (load shedding)."""
         if self._shutdown.is_set():
-            raise RuntimeError("ParallelInference is shut down")
+            raise ShutdownError("ParallelInference is shut down")
         x = self._validate(np.asarray(x))
+        dl = self.deadline_ms if deadline_ms is None else deadline_ms
+        deadline = None if dl is None else time.perf_counter() + dl / 1e3
         with self._lock:
             self.requests += 1
         if self.mode == InferenceMode.SEQUENTIAL:
-            req = self._make_request(x)
+            req = self._make_request(x, deadline)
             try:
+                if req.expired():
+                    raise DeadlineExceeded(
+                        "request deadline expired before dispatch")
                 # dispatch lock only — stats() must not block behind a
                 # device call
                 with self._dispatch_lock:
-                    out = self.engine.output(x)
+                    if req.expired():
+                        raise DeadlineExceeded(
+                            "request deadline expired before dispatch")
+                    out = self._call_engine(x)
                 with self._lock:
                     self.batches += 1
                     self._batch_sizes.append(x.shape[0])
                 req.future.set_result(
                     [np.asarray(o) for o in out] if isinstance(out, list)
                     else np.asarray(out))
+            except DeadlineExceeded as e:
+                with self._lock:
+                    self.deadline_expired += 1
+                self._note("deadline")
+                req.future.set_exception(e)
             except Exception as e:
                 with self._lock:
                     self.failures += 1
+                self._note("failure")
                 req.future.set_exception(e)
             finally:
                 self._record_latency(req)
             return req.future
+        if self.shed_queue_depth is not None and \
+                self._q.qsize() >= self.shed_queue_depth:
+            # LOAD SHEDDING: reject in the caller's thread, before the
+            # queue — a fast, counted failure instead of unbounded linger.
+            # Checked BEFORE chunking so oversized requests (the heaviest
+            # traffic) cannot evade the overload protection.
+            with self._lock:
+                self.shed += 1
+            self._note("shed")
+            raise QueueFull(
+                f"serving queue depth {self._q.qsize()} at/above shedding "
+                f"threshold {self.shed_queue_depth}")
         if x.shape[0] > self.max_batch_size:
-            return self._submit_chunked(x)
-        return self._enqueue(self._make_request(x))
+            return self._submit_chunked(x, deadline)
+        return self._enqueue(self._make_request(x, deadline))
 
-    def _make_request(self, x) -> _Request:
-        return _Request(x, x.shape[1] if self._seq and x.ndim >= 2 else None)
+    def _make_request(self, x, deadline=None) -> _Request:
+        return _Request(x, x.shape[1] if self._seq and x.ndim >= 2 else None,
+                        deadline)
 
     def _enqueue(self, req: _Request) -> Future:
         self._q.put(req)
@@ -161,16 +242,16 @@ class ParallelInference:
         # and joined the dispatcher — fail the future here rather than
         # strand a submit() caller forever
         if self._shutdown.is_set() and not req.future.done():
-            req.future.set_exception(RuntimeError(
+            req.future.set_exception(ShutdownError(
                 "ParallelInference shut down before the request was served"))
         return req.future
 
-    def _submit_chunked(self, x) -> Future:
+    def _submit_chunked(self, x, deadline=None) -> Future:
         """Split an oversized request into <= max_batch_size chunks (each
         pads onto a warmed bucket — no compile under traffic) and resolve
         one parent future with the rejoined rows."""
         m = self.max_batch_size
-        subs = [self._make_request(x[i:i + m])
+        subs = [self._make_request(x[i:i + m], deadline)
                 for i in range(0, x.shape[0], m)]
         parent: Future = Future()
         state = {"left": len(subs)}
@@ -201,34 +282,65 @@ class ParallelInference:
             self._enqueue(s)
         return parent
 
-    def output(self, x) -> np.ndarray:
+    def output(self, x, deadline_ms: Optional[float] = None) -> np.ndarray:
         """Blocking convenience over :meth:`submit`; re-checks shutdown so
         a racing ``shutdown()`` cannot strand the caller."""
-        fut = self.submit(x)
+        fut = self.submit(x, deadline_ms=deadline_ms)
         while True:
             try:
                 return fut.result(timeout=0.2)
             except _FutTimeout:
                 if self._shutdown.is_set() and not fut.done():
-                    raise RuntimeError(
+                    raise ShutdownError(
                         "ParallelInference shut down before the request "
                         "was served") from None
 
     def queue_depth(self) -> int:
         return self._q.qsize()
 
+    def _note(self, kind: str):
+        """Record a degradation event for the health window (deque append
+        is atomic under the GIL; readers snapshot)."""
+        self._events.append((time.perf_counter(), kind))
+
+    def health(self) -> str:
+        """The serving health state machine:
+
+        - ``SHEDDING`` — the queue is at/above the shedding threshold, or
+          a request was shed within the health window (clients should
+          back off / be rerouted).
+        - ``DEGRADED`` — recent failures, transient-error retries, or
+          deadline expiries, but requests are being accepted.
+        - ``HEALTHY`` — none of the above.
+        """
+        now = time.perf_counter()
+        recent = {k for t, k in list(self._events)
+                  if now - t <= self.health_window}
+        if "shed" in recent or (
+                self.shed_queue_depth is not None
+                and self._q.qsize() >= self.shed_queue_depth):
+            return HealthState.SHEDDING
+        if recent & {"failure", "retry", "deadline"}:
+            return HealthState.DEGRADED
+        return HealthState.HEALTHY
+
     def stats(self) -> dict:
         """Serving health snapshot: request latency percentiles (ms),
-        queue depth, coalesced batch sizes, and the engine's bucket-hit /
-        compile counters."""
+        queue depth, coalesced batch sizes, the degradation counters +
+        health state, and the engine's bucket-hit / compile counters."""
+        health = self.health()
         with self._lock:
             lats = np.asarray(self._latencies, dtype=np.float64)
             sizes = np.asarray(self._batch_sizes, dtype=np.float64)
             out = {
                 "mode": self.mode,
+                "health": health,
                 "requests": self.requests,
                 "batches": self.batches,
                 "failures": self.failures,
+                "shed": self.shed,
+                "deadline_expired": self.deadline_expired,
+                "retries": self.retries,
                 "queue_depth": self._q.qsize(),
                 "latency_ms_p50": _pct(lats, 50),
                 "latency_ms_p99": _pct(lats, 99),
@@ -239,18 +351,19 @@ class ParallelInference:
         return out
 
     def shutdown(self):
+        """Stop the dispatcher and FAIL every queued/in-flight future with
+        :class:`ShutdownError` — an unresolved future strands its caller
+        forever, which is worse than a clean error."""
         self._shutdown.set()
         if self._worker:
             self._worker.join(timeout=5)
-        # fail anything still queued — an unresolved future strands its
-        # caller in output()
         while True:
             try:
                 req = self._q.get_nowait()
             except queue.Empty:
                 break
             if not req.future.done():
-                req.future.set_exception(RuntimeError(
+                req.future.set_exception(ShutdownError(
                     "ParallelInference shut down before the request "
                     "was served"))
 
@@ -282,6 +395,45 @@ class ParallelInference:
         with self._lock:
             self._latencies.append(time.perf_counter() - req.t_enqueue)
 
+    def _expire(self, req: _Request, now=None) -> bool:
+        """Deadline fail-fast: an expired request never reaches the device
+        — its future fails with DeadlineExceeded and the slot goes to a
+        request that can still make its SLO."""
+        if not req.expired(now):
+            return False
+        with self._lock:
+            self.deadline_expired += 1
+        self._note("deadline")
+        if not req.future.done():
+            req.future.set_exception(DeadlineExceeded(
+                "request deadline expired before dispatch"))
+        self._record_latency(req)
+        return True
+
+    def _call_engine(self, x, lengths=None):
+        """The engine dispatch with the transient-retry contract: ONE
+        retry on a transient executor failure (counted; second failure
+        propagates). Fault sites: ``serving.slow`` (injected latency —
+        the overload scenario) and ``serving.dispatch`` (injected
+        executor error — the retry scenario)."""
+        attempt = 0
+        while True:
+            try:
+                if _faults.enabled():
+                    _faults.trip("serving.slow")
+                    _faults.trip("serving.dispatch")
+                return self.engine.output(x, lengths=lengths) \
+                    if lengths is not None else self.engine.output(x)
+            except Exception as e:
+                if attempt == 0 and self.retry_transient and \
+                        _faults.is_transient(e):
+                    attempt = 1
+                    with self._lock:
+                        self.retries += 1
+                    self._note("retry")
+                    continue
+                raise
+
     def _dispatcher(self):
         pending: Optional[_Request] = None  # carry-over, never overshoot
         while not self._shutdown.is_set():
@@ -292,6 +444,8 @@ class ParallelInference:
                     first = self._q.get(timeout=0.05)
                 except queue.Empty:
                     continue
+            if self._expire(first):
+                continue
             batch: List[_Request] = [first]
             total = first.x.shape[0]
             deadline = time.perf_counter() + self.max_wait
@@ -303,6 +457,8 @@ class ParallelInference:
                     r = self._q.get(timeout=remaining)
                 except queue.Empty:
                     break
+                if self._expire(r):
+                    continue
                 if total + r.x.shape[0] > self.max_batch_size:
                     # would overshoot the cap (and the warmed bucket set):
                     # lead the NEXT batch with it instead
@@ -312,7 +468,7 @@ class ParallelInference:
                 total += r.x.shape[0]
             self._run(batch, total)
         if pending is not None:  # don't strand a carried request
-            pending.future.set_exception(RuntimeError(
+            pending.future.set_exception(ShutdownError(
                 "ParallelInference shut down before the request was served"))
         # queued-request drain happens in shutdown() (this thread exits first)
 
@@ -332,10 +488,10 @@ class ParallelInference:
                     xs.append(x)
                     lengths.extend([t] * r.x.shape[0])
                 x = np.concatenate(xs, axis=0)
-                out = self.engine.output(x, lengths=np.asarray(lengths))
+                out = self._call_engine(x, lengths=np.asarray(lengths))
             else:
                 x = np.concatenate([r.x for r in batch], axis=0)
-                out = self.engine.output(x)
+                out = self._call_engine(x)
             outs = out if isinstance(out, list) else [out]
             i = 0
             done_t = time.perf_counter()
@@ -357,6 +513,7 @@ class ParallelInference:
             with self._lock:
                 self.failures += len(batch)
                 self._latencies.extend(done_t - r.t_enqueue for r in batch)
+            self._note("failure")
             for r in batch:
                 if not r.future.done():
                     r.future.set_exception(e)

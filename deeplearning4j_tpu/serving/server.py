@@ -19,17 +19,24 @@ from typing import Optional
 
 import numpy as np
 
-from .batcher import InferenceMode, ParallelInference
+from ..runtime.faults import DeadlineExceeded, QueueFull, ShutdownError
+from .batcher import HealthState, InferenceMode, ParallelInference
 
 
 class JsonModelServer:
     """POST /predict {"data": [...]} -> {"output": [...]};
-    GET /health -> {"status": "ok"}."""
+    GET /health -> {"status": "ok"} (liveness);
+    GET /healthz -> the serving health state machine (readiness):
+    200 {"status": "HEALTHY"|"DEGRADED", ...} or 503 when SHEDDING —
+    load balancers route away while the queue drains. Degradation errors
+    map to real status codes: QueueFull -> 429, DeadlineExceeded -> 504,
+    ShutdownError -> 503 (a generic bad request stays 400)."""
 
     def __init__(self, model, port: int = 0, host: str = "127.0.0.1",
                  mode: str = InferenceMode.BATCHED,
-                 pre_processor=None):
-        self.inference = ParallelInference(model, mode=mode)
+                 pre_processor=None, **inference_kwargs):
+        self.inference = ParallelInference(model, mode=mode,
+                                           **inference_kwargs)
         self.pre_processor = pre_processor
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
@@ -55,6 +62,16 @@ class JsonModelServer:
             def do_GET(self):
                 if self.path == "/health":
                     self._send(200, {"status": "ok"})
+                elif self.path == "/healthz":
+                    pi = server.inference
+                    h = pi.health()
+                    self._send(503 if h == HealthState.SHEDDING else 200,
+                               {"status": h,
+                                "queue_depth": pi.queue_depth(),
+                                "shed": pi.shed,
+                                "deadline_expired": pi.deadline_expired,
+                                "retries": pi.retries,
+                                "failures": pi.failures})
                 elif self.path == "/stats":
                     # serving observability: request latency percentiles,
                     # queue depth, bucket hits / compiles
@@ -80,6 +97,12 @@ class JsonModelServer:
                                      [np.asarray(o).tolist() for o in out]
                                      if isinstance(out, list)
                                      else np.asarray(out).tolist()})
+                except QueueFull as e:
+                    self._send(429, {"error": f"{type(e).__name__}: {e}"})
+                except DeadlineExceeded as e:
+                    self._send(504, {"error": f"{type(e).__name__}: {e}"})
+                except ShutdownError as e:
+                    self._send(503, {"error": f"{type(e).__name__}: {e}"})
                 except Exception as e:
                     self._send(400, {"error": f"{type(e).__name__}: {e}"})
 

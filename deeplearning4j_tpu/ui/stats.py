@@ -163,6 +163,17 @@ class StatsListener(TrainingListener):
         dm = self._device_memory()
         if dm:
             record["device_memory"] = dm
+        if hasattr(model, "resilience_counters"):
+            # resilience series for the dashboard: skipped-step totals,
+            # clip events (divergence sentinel) + checkpoint save latency
+            # and restore counts (runtime/faults telemetry)
+            try:
+                from ..runtime import faults as _faults
+                rc = dict(model.resilience_counters())
+                rc.update(_faults.telemetry_snapshot())
+                record["resilience"] = rc
+            except Exception:
+                pass  # stats must never kill training
         self._prev_params = cur
         self._prev_iteration = iteration
         self.storage.put_record(record)
