@@ -83,12 +83,24 @@ def _emit(lines):
     ``parsed`` field takes the last JSON line, and round 4 lost the ResNet
     number to exactly that (BERT printed last + tail truncation). Also mirror
     every line to ``LOCAL_ARTIFACT`` so no truncation can eat a metric
-    again."""
+    again. The artifact (not stdout — it can be large) additionally embeds
+    a compact MetricsRegistry snapshot (ISSUE 6): every counter/histogram
+    the benches drove, so a metric regression can be traced to e.g. a
+    silent recompile without re-running."""
     order = sorted(lines, key=lambda d: d.get("metric") ==
                    "resnet50_train_mfu_pct")
     try:
+        from deeplearning4j_tpu.runtime import telemetry as _telemetry
+        artifact = order + [{
+            "metric": "telemetry_registry_snapshot",
+            "snapshot": _telemetry.snapshot(compact=True),
+            "compile_events": _telemetry.compile_events()[-200:],
+        }]
+    except Exception:
+        artifact = order
+    try:
         with open(LOCAL_ARTIFACT, "w") as f:
-            json.dump(order, f, indent=1)
+            json.dump(artifact, f, indent=1, default=str)
     except OSError:
         pass
     for line in order:
@@ -951,9 +963,14 @@ def bench_parallel_inference():
         "batched_requests_per_sec": round(n_requests / batched_wall, 1),
         "naive_examples_per_sec": round(total_examples / naive_wall, 1),
         "batched_examples_per_sec": round(total_examples / batched_wall, 1),
-        "request_latency_p50_ms": round(st["latency_ms_p50"], 2),
-        "request_latency_p99_ms": round(st["latency_ms_p99"], 2),
-        "coalesced_rows_mean": round(st["batch_rows_mean"], 1),
+        # None under DL4J_TPU_TELEMETRY=off: latency reservoirs are
+        # kill-switched timing instrumentation (documented to go quiet)
+        "request_latency_p50_ms": None if st["latency_ms_p50"] is None
+        else round(st["latency_ms_p50"], 2),
+        "request_latency_p99_ms": None if st["latency_ms_p99"] is None
+        else round(st["latency_ms_p99"], 2),
+        "coalesced_rows_mean": None if st["batch_rows_mean"] is None
+        else round(st["batch_rows_mean"], 1),
         "device_batches": st["batches"],
         "post_warmup_compiles": post_warmup_compiles,
         "warmup_compiles": warm_compiles,
@@ -1092,6 +1109,94 @@ def bench_resilience():
     }
 
 
+def bench_telemetry_overhead():
+    """ISSUE 6 metric (CPU-capable): steady-state fit-loop step time with
+    the MetricsRegistry recording (phase histograms, StepTraceAnnotation,
+    counters) vs ``DL4J_TPU_TELEMETRY=off`` — the same interleaved-A/B
+    pattern as the r10 ``resilience`` sentinel overhead. Acceptance:
+    <=1.02x. Both arms run the SAME compiled step (telemetry is entirely
+    host-side), so the ratio isolates the instrumentation cost."""
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.data.dataset import NumpyDataSetIterator
+    from deeplearning4j_tpu.nn.config import (InputType,
+                                              NeuralNetConfiguration)
+    from deeplearning4j_tpu.nn.layers.core import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.model import MultiLayerNetwork
+    from deeplearning4j_tpu.nn.updaters import Adam
+    from deeplearning4j_tpu.runtime import telemetry
+
+    def conf():
+        return (NeuralNetConfiguration.builder().seed(7)
+                .updater(Adam(learning_rate=1e-3))
+                .input_type(InputType.feed_forward(256))
+                .list(DenseLayer(n_out=512, activation="relu"),
+                      DenseLayer(n_out=512, activation="relu"),
+                      OutputLayer(n_out=10, activation="softmax",
+                                  loss="mcxent"))
+                .build())
+
+    rng = np.random.default_rng(0)
+    xs = rng.normal(size=(512, 256)).astype(np.float32)
+    ys = np.eye(10, dtype=np.float32)[rng.integers(0, 10, 512)]
+    net = MultiLayerNetwork(conf()).init()
+
+    def chain():
+        """One epoch over 16 batches of 32 through the REAL fit loop (the
+        instrumented path); returns seconds per step with the loss synced
+        so async dispatch cannot flatter either arm."""
+        it = NumpyDataSetIterator(xs, ys, batch_size=32)
+        t0 = time.perf_counter()
+        net.fit(it, epochs=1)
+        float(jnp.asarray(net._score))  # force the chain
+        return (time.perf_counter() - t0) / 16
+
+    for _ in range(3):  # warmup: compile + settle caches/allocator
+        chain()
+    prev = telemetry.set_enabled(True)
+    on_s, off_s, ratios = [], [], []
+    try:
+        # FENCED estimator: off on off on ... off — every ON chain is
+        # ratioed against the MEAN of its two neighboring OFF chains,
+        # which cancels linear throughput drift exactly (the plain
+        # alternating-pairs estimator read 0.94–1.07 on the NULL A/B of
+        # this multi-tenant container; the fence reads 0.98–1.01 null
+        # where the real instrumentation cost is ~13us on a ~5ms step).
+        # Three fences pool 48 drift-cancelled ratios so the median's
+        # standard error (~1.25*sigma/sqrt(n), sigma≈2.5% per ratio)
+        # lands near 0.45% — the 1.02 bar is then >3 SE away from the
+        # measured ~1.00, instead of one unlucky 16-ratio fence breaching
+        # it on pure container noise. Headline = pooled median.
+        for _ in range(3):
+            seq = []
+            for i in range(33):
+                telemetry.set_enabled(bool(i % 2))
+                seq.append(chain())
+            on_s += seq[1::2]
+            off_s += seq[0::2]
+            ratios += [seq[i] / ((seq[i - 1] + seq[i + 1]) / 2)
+                       for i in range(1, len(seq) - 1, 2)]
+    finally:
+        telemetry.set_enabled(prev)
+    on_p50, on_p99 = _percentiles(on_s)
+    off_p50, off_p99 = _percentiles(off_s)
+    ratios.sort()
+    ratio = ratios[len(ratios) // 2] if ratios else None
+    return {
+        "metric": "telemetry_overhead",
+        "value": round(ratio, 4) if ratio else None,
+        "unit": "x_step_time_telemetry_on_vs_off",
+        "ratio_min_over_min": round(min(on_s) / min(off_s), 4),
+        "on_step_ms_min": round(min(on_s) * 1e3, 3),
+        "on_step_ms_p50": round(on_p50 * 1e3, 3),
+        "on_step_ms_p99": round(on_p99 * 1e3, 3),
+        "off_step_ms_min": round(min(off_s) * 1e3, 3),
+        "off_step_ms_p50": round(off_p50 * 1e3, 3),
+        "off_step_ms_p99": round(off_p99 * 1e3, 3),
+        "registered_metrics": len(telemetry.registry.names()),
+    }
+
+
 if __name__ == "__main__":
     lines = [bench_resnet()]  # headline first: must not be blocked by BERT
     # emit the headline IMMEDIATELY: if bench_bert dies process-fatally
@@ -1137,6 +1242,14 @@ if __name__ == "__main__":
         lines.append({
             "metric": "resilience", "value": None,
             "unit": "x_sentinel_step_time_vs_unguarded",
+            "error": f"{type(e).__name__}: {e}"[:300]})
+    _emit(lines)
+    try:
+        lines.append(bench_telemetry_overhead())
+    except Exception as e:
+        lines.append({
+            "metric": "telemetry_overhead", "value": None,
+            "unit": "x_step_time_telemetry_on_vs_off",
             "error": f"{type(e).__name__}: {e}"[:300]})
     _emit(lines)
     try:

@@ -736,16 +736,38 @@ class SameDiff(_SentinelCounterMixin):
                 tuple(train_names))
         return spec, jax.jit(step, donate_argnums=(0, 1))
 
+    #: spec tuple positions -> retrace-tracker cause (see _make_fit_step
+    #: for the tuple layout); anything else is a generic config change
+    _SPEC_CAUSES = {4: "dtype_policy", 5: "workspace_mode", 6: "precision"}
+
     def _fit_step_cached(self):
         """The cached compiled fit step (built if absent/stale). ONE step
         is kept across fit() calls — re-jitting a large imported graph per
         call costs seconds (found fine-tuning BERT-base); old compiled
-        executables for big graphs are device memory worth releasing."""
+        executables for big graphs are device memory worth releasing.
+        Every rebuild reports to the retrace tracker with the spec field
+        that changed as its cause — a silent retrace of a BERT-sized
+        import is exactly what ISSUE 6 makes visible."""
         spec, step = self._make_fit_step()
         cached = self._fn_cache.get("__fit_step__")
         if cached is not None and cached[0] == spec:
             return cached[1]
+        from ..runtime import telemetry as _tel
+        # the mutators (set_dtype/set_workspace_mode/...) pop the cache to
+        # release the old executable's device memory, so the cause diff
+        # runs against the last-built spec kept separately
+        prev_spec = getattr(self, "_last_fit_spec", None)
+        if prev_spec is None:
+            cause = "first_build"
+        else:
+            changed = [i for i, (a, b) in enumerate(zip(prev_spec, spec))
+                       if a != b]
+            cause = next((self._SPEC_CAUSES[i] for i in changed
+                          if i in self._SPEC_CAUSES), "config_change")
+        _tel.record_compile("samediff.fit_step", cause,
+                            loss=str(spec[1]))
         self._fn_cache["__fit_step__"] = (spec, step)
+        self._last_fit_spec = spec
         return step
 
     def fit(self, feeds_iter, epochs: int = 1, listeners: Optional[List] = None

@@ -20,7 +20,15 @@ from typing import Iterator, List, Optional, Sequence
 
 import numpy as np
 
+from ..runtime import telemetry as _tel
+
 log = logging.getLogger("deeplearning4j_tpu")
+
+#: skip-and-log tolerance ledger (ISSUE 6): process-wide registry twin of
+#: the per-iterator ``bad_records`` attribute, so pipeline health scrapes
+#: through ``GET /metrics`` alongside everything else
+_M_BAD_RECORDS = _tel.counter(
+    "data.bad_records", "records/batches skipped by max_bad_records")
 
 
 class DataSet:
@@ -419,6 +427,7 @@ class AsyncDataSetIterator(DataSetIterator):
             if self.bad_records >= self._max_bad:
                 return False
             self.bad_records += 1
+            _M_BAD_RECORDS.inc()
             log.warning(
                 "AsyncDataSetIterator: skipping bad record/batch %d/%d "
                 "(%s: %s)", self.bad_records, self._max_bad,

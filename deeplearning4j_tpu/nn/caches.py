@@ -9,8 +9,38 @@ site or mutation point gets fixed in exactly one place.
 
 from __future__ import annotations
 
+import time
+
 from .. import dtypes as _dt
+from ..runtime import telemetry as _tel
 from ..runtime.sentinel import SentinelCounterMixin
+
+
+class _TimedDispatch:
+    """Times one async step dispatch into a bound histogram and wraps it
+    in the ``StepTraceAnnotation`` (device traces carry step numbers).
+    Tiny hand-rolled context manager: this runs every fit-loop step."""
+
+    __slots__ = ("h", "tel", "ann", "t1")
+
+    def __init__(self, h_step, tel: bool, iteration: int):
+        self.h = h_step
+        self.tel = tel
+        self.ann = _tel.step_annotation(iteration)
+
+    def __enter__(self):
+        self.t1 = time.perf_counter() if self.tel else 0.0
+        self.ann.__enter__()
+        return self
+
+    def __exit__(self, *exc):
+        r = self.ann.__exit__(*exc)
+        if self.tel:
+            # dispatch time (the step is async): a growing value here
+            # means the host loop, not the device, is the bottleneck —
+            # the complementary signal to data_wait
+            self.h.observe(time.perf_counter() - self.t1)
+        return r
 
 
 class CompiledCacheMixin(SentinelCounterMixin):
@@ -22,6 +52,19 @@ class CompiledCacheMixin(SentinelCounterMixin):
     # (MultiLayerNetwork adds the rnn streaming pair)
     _cache_attrs = ("_train_step", "_train_output_fn", "_epoch_fn")
 
+    #: why the NEXT compiled-fn build is happening (retrace tracker,
+    #: ISSUE 6): set by _invalidate_compiled, consumed by the build sites
+    #: so every recompile event carries its cause.
+    _retrace_cause = None
+
+    #: cache attr -> invalidation cause for every cache that existed when
+    #: _invalidate_compiled fired, so SIBLING rebuilds are attributed too
+    #: (lazily created instance dict; the class attr stays None)
+    _stale_build_causes = None
+
+    # telemetry_label (model=<id> registry label) is inherited from
+    # SentinelCounterMixin so SameDiff shares the same contract
+
     def _replace_conf_dtype(self, dtype: str):
         """Return a conf carrying ``dtype`` WITHOUT mutating the current
         one in place — confs may be shared across nets, and a sibling's
@@ -29,18 +72,55 @@ class CompiledCacheMixin(SentinelCounterMixin):
         invalidation."""
         raise NotImplementedError
 
-    def _invalidate_compiled(self):
+    def _invalidate_compiled(self, cause: str = "invalidate"):
         """Drop every cached compiled function. MUST be called at any
         mutation that a live trace baked in — layer topology or the conf
         dtype policy (param *values* are traced arguments and need no
         invalidation; param avals retrace plain jits automatically, but
-        the AOT serving engine and conf-dependent closures do not)."""
+        the AOT serving engine and conf-dependent closures do not).
+        ``cause`` feeds the retrace tracker: the rebuild of EVERY cache
+        that existed at invalidation time records a compile event with
+        this cause (same contract as the serving engine's per-bucket
+        stale map)."""
+        if self._stale_build_causes is None:
+            self._stale_build_causes = {}
+        # refresh pending entries too: a cache invalidated twice before
+        # its rebuild is attributed to the most recent mutation
+        for a in self._stale_build_causes:
+            self._stale_build_causes[a] = cause
         for a in self._cache_attrs:
+            if getattr(self, a, None) is not None:
+                self._stale_build_causes[a] = cause
             setattr(self, a, None)
+        self._retrace_cause = cause
         # every engine serving this model (the lazily-built default AND
         # externally constructed ones — engines self-register weakly)
         for eng in list(getattr(self, "_serving_engines", ())):
-            eng.invalidate()
+            eng.invalidate(cause=cause)
+
+    def _consume_retrace_cause(self, cache_attr: str = None) -> str:
+        """The cause for a compile event at a build site. A site that
+        names its ``cache_attr`` reads the per-cache stale map first, so
+        a sibling cache rebuilt AFTER another already consumed the
+        one-shot armed cause (e.g. ``_epoch_fn`` rebuilt on the next
+        ``fit_on_device`` long after ``set_dtype`` rebuilt
+        ``_train_step``) is still attributed to the invalidation rather
+        than reading as a ``first_build``. Falls back to the one-shot
+        armed cause, else ``first_build``."""
+        if cache_attr is not None and self._stale_build_causes:
+            stale = self._stale_build_causes.pop(cache_attr, None)
+            if stale is not None:
+                self._retrace_cause = None
+                return stale
+        c = self._retrace_cause or "first_build"
+        self._retrace_cause = None
+        return c
+
+    def _record_build(self, site: str, cache_attr: str = None,
+                      **detail) -> None:
+        """Report one compiled-fn (re)build to the retrace tracker."""
+        _tel.record_compile(site, self._consume_retrace_cause(cache_attr),
+                            model=type(self).__name__, **detail)
 
     def set_dtype(self, dtype: str):
         """Switch the network dtype policy in place (DL4J
@@ -55,7 +135,7 @@ class CompiledCacheMixin(SentinelCounterMixin):
         self.state = _dt.cast_floating(self.state, pdt)
         if self.updater_state:
             self.updater_state = _dt.cast_floating(self.updater_state, pdt)
-        self._invalidate_compiled()
+        self._invalidate_compiled(cause="dtype_policy")
         return self
 
     def set_workspace_mode(self, mode: str):
@@ -70,7 +150,7 @@ class CompiledCacheMixin(SentinelCounterMixin):
         from . import memory as _memory
         policy = _memory.resolve_policy(mode)  # validate before mutating
         self.conf = self._replace_conf_workspace_mode(policy.name)
-        self._invalidate_compiled()
+        self._invalidate_compiled(cause="workspace_mode")
         return self
 
     def _replace_conf_workspace_mode(self, mode: str):
@@ -118,3 +198,39 @@ class CompiledCacheMixin(SentinelCounterMixin):
                              "inference_engine() without kwargs, or build "
                              "an InferenceEngine directly")
         return self._inference_engine
+
+    # ---------------------------------------------------- phase tracing
+    # step-phase tracing (ISSUE 6), shared by both engines' fit loops so
+    # the timing semantics cannot drift between MLN and CG: data-wait vs
+    # step-dispatch durations per iteration, plus a StepTraceAnnotation
+    # so device traces (ui/profiler.py) line up with step numbers. One
+    # enabled() read per batch; disabled telemetry skips every clock.
+
+    def _phase_clocks(self):
+        """(data_wait, step) bound histograms labeled ``model=<id>``."""
+        return (_tel.histogram("train.phase.data_wait_s")
+                .labeled(model=self.telemetry_label),
+                _tel.histogram("train.phase.step_s")
+                .labeled(model=self.telemetry_label))
+
+    @staticmethod
+    def _timed_batches(it, h_wait):
+        """Yield ``(batch, tel)`` from ``it``, recording the data-wait of
+        each ``next()`` into ``h_wait``; ``tel`` is the enabled() flag
+        sampled for that batch (reuse it for the step clock)."""
+        src = iter(it)
+        while True:
+            tel = _tel.enabled()
+            t0 = time.perf_counter() if tel else 0.0
+            try:
+                ds = next(src)
+            except StopIteration:
+                return
+            if tel:
+                h_wait.observe(time.perf_counter() - t0)
+            yield ds, tel
+
+    def _timed_dispatch(self, tel, h_step):
+        """Context manager for ONE train-step dispatch: step annotation +
+        dispatch-time histogram (see ``_TimedDispatch``)."""
+        return _TimedDispatch(h_step, tel, self.iteration)

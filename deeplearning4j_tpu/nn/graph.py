@@ -306,7 +306,7 @@ class ComputationGraph(_caches.CompiledCacheMixin):
         self._shapes = shapes
         self.updater_state = self.conf.updater.init_state(params) \
             if self.conf.updater else {}
-        self._invalidate_compiled()
+        self._invalidate_compiled(cause="init")
         return self
 
     def num_params(self) -> int:
@@ -671,6 +671,7 @@ class ComputationGraph(_caches.CompiledCacheMixin):
         ys = tuple(stack(l, False) for l in labs)
         if self._epoch_fn is None:
             self._epoch_fn = self._build_epoch_fn()
+            self._record_build("train.epoch_fn", cache_attr="_epoch_fn")
         history = []
         for _ in range(epochs):
             self._key, sub = jax.random.split(self._key)
@@ -707,11 +708,15 @@ class ComputationGraph(_caches.CompiledCacheMixin):
             self.init()
         if self._train_step is None:
             self._train_step = self._build_train_step()
+            self._record_build("train.step", cache_attr="_train_step")
         from ..runtime import faults as _faults
         it = _as_multi_iterator(data, labels)
+        # step-phase tracing (ISSUE 6): shared scaffold on
+        # CompiledCacheMixin — see caches.py _phase_clocks/_timed_batches
+        _h_wait, _h_step = self._phase_clocks()
 
         for _ in range(epochs):
-            for mds in it:
+            for mds, tel in self._timed_batches(it, _h_wait):
                 self._key, sub = jax.random.split(self._key)
                 xs = tuple(jnp.asarray(f) for f in mds.features)
                 ys = tuple(jnp.asarray(l) for l in mds.labels)
@@ -732,11 +737,12 @@ class ComputationGraph(_caches.CompiledCacheMixin):
                             for m in mds.labels_masks)
                 step = jnp.asarray(self.iteration, dtype=jnp.int32)
                 self._last_batch = xs  # StatsListener activation sampling
-                (self.params, self.updater_state, self.state, self._sentinel,
-                 loss) = \
-                    self._train_step(self.params, self.updater_state,
-                                     self.state, step, sub, xs, ys, fms, lms,
-                                     self._ensure_sentinel())
+                with self._timed_dispatch(tel, _h_step):
+                    (self.params, self.updater_state, self.state,
+                     self._sentinel, loss) = \
+                        self._train_step(self.params, self.updater_state,
+                                         self.state, step, sub, xs, ys, fms,
+                                         lms, self._ensure_sentinel())
                 self._score = loss
                 self.iteration += 1
                 for cb in self._listeners:
@@ -784,6 +790,8 @@ class ComputationGraph(_caches.CompiledCacheMixin):
                 return tuple(acts[o] for o in outputs)
 
             fn = self._train_output_fn = jax.jit(fwd)
+            self._record_build("train.output_fn",
+                               cache_attr="_train_output_fn")
         xs = tuple(jnp.asarray(x) for x in inputs)
         self._key, sub = jax.random.split(self._key)
         outs = [np.asarray(o) for o in

@@ -129,7 +129,7 @@ class MultiLayerNetwork(_caches.CompiledCacheMixin):
         self.updater_state = self.conf.updater.init_state(params) \
             if self.conf.updater else {}
         self._solver = None
-        self._invalidate_compiled()
+        self._invalidate_compiled(cause="init")
         return self
 
     def num_params(self) -> int:
@@ -429,6 +429,7 @@ class MultiLayerNetwork(_caches.CompiledCacheMixin):
         ys = stack(y, False)
         if getattr(self, "_epoch_fn", None) is None:
             self._epoch_fn = self._build_epoch_fn()
+            self._record_build("train.epoch_fn", cache_attr="_epoch_fn")
         history = []
         for _ in range(epochs):
             self._key, sub = jax.random.split(self._key)
@@ -473,9 +474,13 @@ class MultiLayerNetwork(_caches.CompiledCacheMixin):
         it = _as_iterator(data, labels)
         if self._train_step is None:
             self._train_step = self._build_train_step()
+            self._record_build("train.step", cache_attr="_train_step")
+        # step-phase tracing (ISSUE 6): shared scaffold on
+        # CompiledCacheMixin — see caches.py _phase_clocks/_timed_batches
+        _h_wait, _h_step = self._phase_clocks()
 
         for _ in range(epochs):
-            for ds in it:
+            for ds, tel in self._timed_batches(it, _h_wait):
                 self._key, sub = jax.random.split(self._key)
                 x = jnp.asarray(ds.features)
                 y = jnp.asarray(ds.labels)
@@ -490,11 +495,12 @@ class MultiLayerNetwork(_caches.CompiledCacheMixin):
                 lm = None if ds.labels_mask is None else jnp.asarray(ds.labels_mask)
                 step = jnp.asarray(self.iteration, dtype=jnp.int32)  # traced, no retrace per step
                 self._last_batch = x  # StatsListener activation sampling
-                (self.params, self.updater_state, self.state, self._sentinel,
-                 loss) = \
-                    self._train_step(self.params, self.updater_state, self.state,
-                                     step, sub, x, y, fm, lm,
-                                     self._ensure_sentinel())
+                with self._timed_dispatch(tel, _h_step):
+                    (self.params, self.updater_state, self.state,
+                     self._sentinel, loss) = \
+                        self._train_step(self.params, self.updater_state,
+                                         self.state, step, sub, x, y, fm, lm,
+                                         self._ensure_sentinel())
                 # keep the loss on device: score() syncs lazily, so the train
                 # loop never blocks on the host (async dispatch back-to-back)
                 self._score = loss
@@ -564,6 +570,8 @@ class MultiLayerNetwork(_caches.CompiledCacheMixin):
             fn = self._train_output_fn = jax.jit(
                 lambda params, state, x, rng: self._forward(
                     params, x, state, train=True, rng=rng)[0])
+            self._record_build("train.output_fn",
+                               cache_attr="_train_output_fn")
         self._key, sub = jax.random.split(self._key)
         return np.asarray(fn(self.params, self.state, jnp.asarray(x), sub))
 
@@ -584,6 +592,8 @@ class MultiLayerNetwork(_caches.CompiledCacheMixin):
             self._rnn_stream = {}
         if self._rnn_step_fn is None:
             self._rnn_step_fn = self._build_rnn_step()
+            self._record_build("train.rnn_step_fn",
+                               cache_attr="_rnn_step_fn")
         out, self._rnn_stream = self._rnn_step_fn(
             self.params, self.state, x, self._rnn_stream)
         out = np.asarray(out)

@@ -460,7 +460,15 @@ def flash_attention(q, k, v, bias=None, scale: Optional[float] = None, *,
 _COUNTER_KEYS = ("fused", "fallback_mode", "fallback_platform",
                  "fallback_shape", "fallback_bias", "fallback_dtype",
                  "fallback_vmem")
-_counters = {k: 0 for k in _COUNTER_KEYS}
+# dispatch decisions live in the process-wide MetricsRegistry (ISSUE 6):
+# one counter, labeled by decision, so `GET /metrics` exposes the
+# fused-vs-fallback mix; counters()/reset_counters() below are the
+# pre-registry views tier-1 asserts against.
+from ..runtime import telemetry as _tel  # noqa: E402  (stdlib-only import)
+
+_DISPATCH = _tel.counter(
+    "flash_attention.dispatch",
+    "attention dispatch decisions at trace time (fused vs fallback_*)")
 _state = {"mode": os.environ.get("DL4J_TPU_FLASH_ATTENTION", "auto")}
 _FUSABLE_DTYPES = (jnp.float32, jnp.bfloat16, jnp.float16)
 
@@ -492,13 +500,13 @@ def set_mode(m: str) -> str:
 def counters() -> dict:
     """Dispatch-decision counts. Decisions happen at TRACE time (shapes are
     static), so under jit each compiled call-site counts once, not once per
-    execution — the right unit for "did the kernel path get taken"."""
-    return dict(_counters)
+    execution — the right unit for "did the kernel path get taken". A view
+    over the registry's ``flash_attention.dispatch{decision=}`` counter."""
+    return {k: int(_DISPATCH.value(decision=k)) for k in _COUNTER_KEYS}
 
 
 def reset_counters() -> None:
-    for k in _COUNTER_KEYS:
-        _counters[k] = 0
+    _DISPATCH.zero()
 
 
 def _route(q, k, v, bias) -> Optional[str]:
@@ -530,10 +538,10 @@ def attention(q, k, v, bias=None, scale: Optional[float] = None):
     ``attention.fused_sdpa`` op both enter here."""
     reason = _route(q, k, v, bias)
     if reason is None:
-        _counters["fused"] += 1
+        _DISPATCH.inc(decision="fused")
         return flash_attention(q, k, v, bias, scale,
                                interpret=not _tpu_available())
-    _counters[reason] += 1
+    _DISPATCH.inc(decision=reason)
     return reference_attention(q, k, v, bias, scale)
 
 

@@ -63,13 +63,15 @@ class PerformanceListener(TrainingListener):
     def __init__(self, frequency: int = 10, batch_size: Optional[int] = None,
                  flops_per_example: Optional[float] = None,
                  peak_flops: Optional[float] = None, printer: Callable = None,
-                 collect_memory: bool = True, collect_resilience: bool = True):
+                 collect_memory: bool = True, collect_resilience: bool = True,
+                 collect_phases: bool = True):
         self.frequency = max(1, frequency)
         self.batch_size = batch_size
         self.flops_per_example = flops_per_example
         self.peak_flops = peak_flops or _detect_peak_flops()
         self.collect_memory = collect_memory
         self.collect_resilience = collect_resilience
+        self.collect_phases = collect_phases
         self._print = printer or (lambda s: log.info(s))
         self._t0 = None
         self._it0 = 0
@@ -77,6 +79,7 @@ class PerformanceListener(TrainingListener):
         self.last_mfu = float("nan")
         self.last_memory: Optional[dict] = None
         self.last_resilience: Optional[dict] = None
+        self.last_phases: Optional[dict] = None
 
     def iteration_done(self, model, iteration, epoch):
         now = time.perf_counter()
@@ -107,6 +110,28 @@ class PerformanceListener(TrainingListener):
                 msg += (f", hbm peak "
                         f"{self.last_memory['peak_bytes_in_use'] / 2**30:.2f}"
                         f"/{self.last_memory['bytes_limit'] / 2**30:.2f} GiB")
+        if self.collect_phases:
+            # step-phase split over THIS interval (ISSUE 6): the fit loops
+            # record data-wait and step-dispatch durations into the
+            # registry; windowing by the interval keeps the numbers
+            # current instead of lifetime
+            from ..runtime import telemetry as _tel
+            lbl = getattr(model, "telemetry_label", None)
+            mlabels = {} if lbl is None else {"model": lbl}
+            wait = _tel.histogram("train.phase.data_wait_s") \
+                .hist_snapshot(window=dt, **mlabels)
+            disp = _tel.histogram("train.phase.step_s") \
+                .hist_snapshot(window=dt, **mlabels)
+            self.last_phases = {
+                "data_wait_ms_p50": None if wait["p50"] is None
+                else wait["p50"] * 1e3,
+                "step_dispatch_ms_p50": None if disp["p50"] is None
+                else disp["p50"] * 1e3,
+                "data_wait_count": wait["count"],
+            }
+            if wait["p50"] is not None and disp["p50"] is not None:
+                msg += (f", wait/dispatch p50 {wait['p50'] * 1e3:.1f}/"
+                        f"{disp['p50'] * 1e3:.1f}ms")
         if self.collect_resilience and hasattr(model, "resilience_counters"):
             # divergence-sentinel counters (the interval's ONE deliberate
             # device sync — frequency-gated) + checkpoint/restore telemetry
@@ -131,7 +156,23 @@ class PerformanceListener(TrainingListener):
 def _detect_peak_flops() -> Optional[float]:
     """Peak BF16 FLOPs of device 0, for MFU. (v5e's widely-quoted 394
     TOPS figure is INT8; bf16 peak is 197 TFLOPs — using 394 halves every
-    reported MFU.)"""
+    reported MFU.)
+
+    ``DL4J_TPU_PEAK_FLOPS`` (ISSUE 6 satellite) overrides the detection —
+    unknown devices (CI CPUs, new TPU generations before the table grows
+    a row) used to silently yield ``MFU=None``; with the override set,
+    MFU telemetry keeps flowing everywhere PerformanceListener runs."""
+    env = os.environ.get("DL4J_TPU_PEAK_FLOPS")
+    if env:
+        try:
+            v = float(env)
+            if v > 0:
+                return v
+            log.warning("DL4J_TPU_PEAK_FLOPS=%r is not positive; ignored",
+                        env)
+        except ValueError:
+            log.warning("DL4J_TPU_PEAK_FLOPS=%r is not a number; ignored",
+                        env)
     try:
         import jax
         d = jax.devices()[0]

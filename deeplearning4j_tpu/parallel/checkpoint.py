@@ -28,12 +28,14 @@ blocks on a save. Save latency / restore / fallback counts feed
 from __future__ import annotations
 
 import hashlib
+import itertools
 import json
 import logging
 import os
 import queue
 import threading
 import time
+import weakref
 from typing import List, Optional
 
 import jax
@@ -41,9 +43,24 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..runtime import faults as _faults
+from ..runtime import telemetry as _tel
 from ..runtime.faults import CorruptCheckpoint
 
 log = logging.getLogger("deeplearning4j_tpu")
+
+# durable-save / restore latency distributions (ISSUE 6): registry
+# histograms so bench artifacts and `GET /metrics` see checkpoint cost;
+# the per-instance `last_save_latency_s` / `save_latencies` attributes
+# stay as the historical accessors
+_H_SAVE = _tel.histogram("checkpoint.save_latency_s",
+                         "save()->durable (manifest fsync'd) latency")
+_H_RESTORE = _tel.histogram("checkpoint.restore_s",
+                            "restore() wall time (verified walk included)")
+#: cells are labeled ckpt=<id> per TrainingCheckpointer (two models
+#: checkpointing in one process must not blend their latency p99s; a
+#: weakref finalizer reclaims a churned instance's cells, same rule as
+#: engine=/pi=/model= elsewhere)
+_ckpt_ids = itertools.count()
 
 #: Per-checkpoint checksum manifest (crash-safety layer, ISSUE 5): written
 #: tmp + fsync + rename AFTER the checkpoint commit, so its presence+match
@@ -123,6 +140,10 @@ class TrainingCheckpointer:
         # rolling window (multi-week cadenced runs must not grow a list)
         from collections import deque
         self.save_latencies = deque(maxlen=512)
+        self._id = str(next(_ckpt_ids))
+        weakref.finalize(self, _tel.registry.discard_cells, ckpt=self._id)
+        self._h_save = _H_SAVE.labeled(ckpt=self._id)
+        self._h_restore = _H_RESTORE.labeled(ckpt=self._id)
 
     # -- save ---------------------------------------------------------------
     def save(self, model, iterator=None, step: Optional[int] = None,
@@ -281,6 +302,7 @@ class TrainingCheckpointer:
         latency = time.perf_counter() - t0
         self.last_save_latency_s = latency
         self.save_latencies.append(latency)
+        self._h_save.observe(latency)
         _faults.telemetry_bump("checkpoint_saves")
         _faults.telemetry_set("checkpoint_last_save_latency_s", latency)
 
@@ -395,6 +417,7 @@ class TrainingCheckpointer:
         verification does this raise :class:`CorruptCheckpoint`. An
         explicitly requested ``step`` raises immediately when corrupt."""
         ocp = self._ocp
+        t_restore0 = time.perf_counter()
         self.wait_until_finished()  # async saves must commit before we pick
         if step is None:
             steps = sorted(self._mngr.all_steps(), reverse=True)
@@ -501,6 +524,7 @@ class TrainingCheckpointer:
             model._sentinel = {k: jnp.asarray(int(v), jnp.int32)
                                for k, v in rc.items()}
         self.restore_count += 1
+        self._h_restore.observe(time.perf_counter() - t_restore0)
         _faults.telemetry_bump("restore_count")
         return step
 

@@ -397,6 +397,11 @@ class ParallelWrapper:
             m.init()
         if self._step is None:
             self._step = self._build()
+            from ..runtime import telemetry as _tel
+            cause = m._consume_retrace_cause() \
+                if hasattr(m, "_consume_retrace_cause") else "first_build"
+            _tel.record_compile("parallel.step", cause,
+                                shard_update=self.shard_update)
         step_fn, shard_args = self._step
         for _ in range(epochs):
             for batch in self._batches(data):

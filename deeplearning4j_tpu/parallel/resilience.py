@@ -125,7 +125,17 @@ class _ResilienceListener:
                 self._escalate(int(prev), iteration)
         if p.checkpoint_every_iterations and \
                 iteration % p.checkpoint_every_iterations == 0:
+            from ..runtime import telemetry as _tel
+            t0 = time.perf_counter()
             self.ckpt.save(self.model, iterator=self.iterator)
+            # the step-loop-visible checkpoint cost (the enqueue side of
+            # an async save; durable latency is checkpoint.save_latency_s)
+            _h = _tel.histogram("train.phase.checkpoint_s")
+            lbl = getattr(self.model, "telemetry_label", None)
+            if lbl is not None:
+                _h.observe(time.perf_counter() - t0, model=lbl)
+            else:
+                _h.observe(time.perf_counter() - t0)
 
     def on_epoch_end(self, model):
         if self.policy.max_consecutive_bad_steps:
@@ -147,7 +157,7 @@ def _scale_learning_rate(model, factor: float) -> Optional[float]:
                     "rate (schedule or solver path)")
         return None
     upd.learning_rate = float(lr) * factor
-    model._invalidate_compiled()
+    model._invalidate_compiled(cause="lr_backoff")
     return upd.learning_rate
 
 

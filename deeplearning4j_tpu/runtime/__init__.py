@@ -6,4 +6,5 @@ this package makes every such path injectable and therefore testable on
 CPU). Deliberately lightweight: stdlib-only at import time so the nn/
 serving/datavec layers can import it without cycles or heavy deps."""
 
+from . import telemetry  # noqa: F401  (imported first: faults builds on it)
 from . import faults  # noqa: F401

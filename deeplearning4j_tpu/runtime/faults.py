@@ -41,6 +41,8 @@ import threading
 import time
 from typing import Dict, Optional
 
+from . import telemetry as _tel
+
 log = logging.getLogger("deeplearning4j_tpu")
 
 
@@ -164,8 +166,12 @@ class Injection:
 
 _lock = threading.Lock()
 _active: Dict[str, Injection] = {}
-_calls: Dict[str, int] = {}
-_fired: Dict[str, int] = {}
+# per-site calls/fired live in the process-wide MetricsRegistry (ISSUE 6);
+# counters() below is the pre-registry view over them
+_CALLS = _tel.counter("faults.calls",
+                      "trip() evaluations per fault site")
+_FIRED = _tel.counter("faults.fired",
+                      "injections fired per fault site")
 _ledger: set = set()       # sites ever fired this process; reset() keeps it
 
 
@@ -197,12 +203,16 @@ def trip(site: str) -> Optional[Injection]:
     if site not in SITES:
         raise ValueError(f"trip() at unregistered fault site {site!r}")
     with _lock:
-        _calls[site] = _calls.get(site, 0) + 1
         inj = _active.get(site)
         fire = inj is not None and inj.should_fire()
         if fire:
-            _fired[site] = _fired.get(site, 0) + 1
             _ledger.add(site)
+    # calls+fired move as ONE unit vs a concurrent reset(): a reset
+    # landing mid-trip can never zero calls but keep fired (fired>calls)
+    with _tel.registry.locked():
+        _CALLS.inc(site=site)
+        if fire:
+            _FIRED.inc(site=site)
     if not fire:
         return None
     log.warning("fault injection fired at %r (%d/%s)", site, inj.fired,
@@ -215,10 +225,18 @@ def trip(site: str) -> Optional[Injection]:
 
 
 def counters() -> dict:
-    """Per-site ``{site: {"calls": n, "fired": m}}`` since the last reset."""
-    with _lock:
-        return {s: {"calls": _calls.get(s, 0), "fired": _fired.get(s, 0)}
-                for s in sorted(set(_calls) | set(_fired))}
+    """Per-site ``{site: {"calls": n, "fired": m}}`` since the last reset.
+    A view over the MetricsRegistry (``faults.calls`` / ``faults.fired``,
+    labeled by site) — same shape as the pre-registry dicts."""
+    with _tel.registry.locked():  # one consistent read: fired <= calls
+        calls = {k[0][1]: int(v) for k, v in _CALLS.series().items()}
+        fired = {k[0][1]: int(v) for k, v in _FIRED.series().items()}
+    # Metric.zero keeps cells at 0; drop them so counters() is {} right
+    # after reset() (the pre-registry "since the last reset" contract —
+    # consumers enumerate the keys to see which sites were exercised)
+    return {s: {"calls": calls.get(s, 0), "fired": fired.get(s, 0)}
+            for s in sorted(set(calls) | set(fired))
+            if calls.get(s, 0) or fired.get(s, 0)}
 
 
 def coverage_report() -> dict:
@@ -235,16 +253,18 @@ def reset() -> None:
     ledger survives (it accumulates across the whole test session)."""
     with _lock:
         _active.clear()
-        _calls.clear()
-        _fired.clear()
+    with _tel.registry.locked():  # pairs with trip()'s atomic inc unit
+        _CALLS.zero()
+        _FIRED.zero()
 
 
 # -------------------------------------------------------------- telemetry
 #: Cross-cutting resilience telemetry, written by the checkpointer and the
 #: resilient fit driver, read by PerformanceListener / ui.StatsListener /
-#: bench.py. A plain dict (snapshot via telemetry_snapshot) — the writers
-#: live in different layers and this is the one import they share.
-_telemetry_lock = threading.Lock()
+#: bench.py. Since ISSUE 6 the storage is the process-wide MetricsRegistry
+#: (``resilience.*`` counters/gauges); the bump/set/snapshot API is the
+#: historical view over it, so every pre-existing caller keeps working and
+#: the values scrape through ``GET /metrics``.
 _TELEMETRY_ZERO = {
     "checkpoint_saves": 0,
     "checkpoint_last_save_latency_s": None,
@@ -253,28 +273,66 @@ _TELEMETRY_ZERO = {
     "auto_resumes": 0,
     "divergence_rollbacks": 0,
 }
-_telemetry = dict(_TELEMETRY_ZERO)
+#: keys with a None zero are gauges (last-observed value), the rest are
+#: monotonic counters
+_TELEMETRY_GAUGES = {k for k, z in _TELEMETRY_ZERO.items() if z is None}
+for _k in _TELEMETRY_ZERO:
+    (_tel.gauge if _k in _TELEMETRY_GAUGES else _tel.counter)(
+        f"resilience.{_k}")
 
 
+def _telemetry_metric(key: str, gauge: bool):
+    name = f"resilience.{key}"
+    m = _tel.registry.get(name)
+    if m is not None:  # declared (pre-known or first write): keep its kind
+        return m
+    return (_tel.gauge if gauge else _tel.counter)(name)
+
+
+# The pre-registry dict accepted any key from either API (bump was
+# ``d[k] += n``, set was ``d[k] = v``). The registry splits keys into
+# counters and gauges on first write — so a key that crosses APIs keeps
+# the old contract instead of raising TypeError on kind mismatch.
 def telemetry_bump(key: str, n: int = 1) -> None:
-    with _telemetry_lock:
-        _telemetry[key] = (_telemetry.get(key) or 0) + n
+    m = _telemetry_metric(key, gauge=False)
+    if m.kind == _tel.GAUGE:  # first written via telemetry_set
+        with _tel.registry.locked():  # atomic read-modify-write
+            m.set((m.value(default=0) or 0) + n)
+    else:
+        m.inc(n)
 
 
 def telemetry_set(key: str, value) -> None:
-    with _telemetry_lock:
-        _telemetry[key] = value
+    m = _telemetry_metric(key, gauge=True)
+    if m.kind == _tel.COUNTER:  # first written via telemetry_bump
+        with _tel.registry.locked():  # no reader sees the transient zero
+            m.zero()
+            if value:
+                m.inc(value)
+    else:
+        m.set(value)
 
 
 def telemetry_snapshot() -> dict:
-    with _telemetry_lock:
-        return dict(_telemetry)
+    out = {}
+    for name in _tel.registry.names():
+        if not name.startswith("resilience."):
+            continue
+        m = _tel.registry.get(name)
+        key = name[len("resilience."):]
+        if m.kind == _tel.GAUGE:
+            out[key] = m.value(default=None)
+        else:
+            out[key] = int(m.total())
+    for k, z in _TELEMETRY_ZERO.items():
+        out.setdefault(k, z)
+    return out
 
 
 def telemetry_reset() -> None:
-    with _telemetry_lock:
-        _telemetry.clear()
-        _telemetry.update(_TELEMETRY_ZERO)
+    for name in _tel.registry.names():
+        if name.startswith("resilience."):
+            _tel.registry.get(name).zero()
 
 
 # ------------------------------------------------------------- env config

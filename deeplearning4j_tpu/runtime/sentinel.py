@@ -24,8 +24,22 @@ resilience.py`` re-exports it as part of the policy API.
 
 from __future__ import annotations
 
+import itertools
+import weakref
+
 import jax
 import jax.numpy as jnp
+
+from . import telemetry as _tel
+
+_model_ids = itertools.count()
+
+#: registry mirrors of the on-device counters (gauges: last-synced value).
+#: Written ONLY at the deliberate resilience_counters() sync point — the
+#: fused step itself never touches the host, and neither does telemetry.
+_GAUGES = {n: _tel.gauge(f"sentinel.{n}",
+                         "divergence-sentinel counter (last host sync)")
+           for n in ("bad_total", "bad_consec", "clip_events")}
 
 #: Counter slots carried through the step (a dict pytree of int32 scalars):
 #: - bad_total:   lifetime count of skipped (non-finite) steps
@@ -100,6 +114,21 @@ class SentinelCounterMixin:
 
     _sentinel = None
 
+    _tel_label = None
+
+    @property
+    def telemetry_label(self) -> str:
+        """Stable per-model registry label (``model=<n>``) so per-model
+        cells (phase histograms, sentinel gauges) from concurrent models
+        don't blend or overwrite each other. Lazily assigned; a finalizer
+        drops the cells when the model is collected so churn cannot grow
+        the registry (or ``/metrics``) unboundedly."""
+        if self._tel_label is None:
+            self._tel_label = str(next(_model_ids))
+            weakref.finalize(self, _tel.registry.discard_cells,
+                             model=self._tel_label)
+        return self._tel_label
+
     def _ensure_sentinel(self):
         if self._sentinel is None:
             self._sentinel = init_counters()
@@ -111,8 +140,18 @@ class SentinelCounterMixin:
         point — the fused step itself never touches the host; call this
         at whatever cadence the caller can afford (the resilience policy
         reads a one-step-lagged counter so the check overlaps the
-        in-flight step)."""
-        return to_host(self._sentinel)
+        in-flight step). Each sync also mirrors the values into the
+        MetricsRegistry (``sentinel.*`` gauges) so they scrape through
+        ``GET /metrics`` at whatever cadence the last reader chose."""
+        c = to_host(self._sentinel)
+        # gauges carry model=<id>: concurrent models syncing into one
+        # unlabeled cell would overwrite each other, and a scrape could
+        # show a healthy model's zeros while the other skips every step
+        lbl = self.telemetry_label
+        for n, g in _GAUGES.items():
+            if n in c:
+                g.set(c[n], model=lbl)
+        return c
 
     def reset_resilience_counters(self):
         """Zero the sentinel counters (after a rollback the consecutive-

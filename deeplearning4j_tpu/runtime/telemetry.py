@@ -1,0 +1,831 @@
+"""Unified telemetry: the process-wide MetricsRegistry (ISSUE 6 tentpole).
+
+Five subsystems grew five private counter dicts — flash-attention
+dispatch (`ops/flash_attention.py`), serving bucket/compile/shed counters
+(`serving/engine.py`, `serving/batcher.py`), sentinel resilience counters
+(`runtime/sentinel.py`), fault telemetry (`runtime/faults.py`), and
+checkpoint save/restore latency (`parallel/checkpoint.py`) — with no
+single way to scrape, correlate, or alert on them. TensorFlow's
+production design (PAPERS.md, 1605.08695) treats run-time monitoring of
+kernels, queues and servables as a first-class subsystem; this module is
+that layer. Every pre-existing accessor (``flash_attention.counters()``,
+``engine.stats()``, ``pi.stats()``, ``faults.telemetry_snapshot()``…)
+stays callable and is now a *view* over this registry.
+
+Four pieces:
+
+- **MetricsRegistry** — thread-safe counters, gauges, and bounded
+  timestamped-reservoir histograms (p50/p99 over lifetime or any recent
+  window), namespaced ``subsystem.name`` with optional labels (the
+  Prometheus client model: one :class:`Metric` per name, cells per label
+  set). Per-instance surfaces (each ``InferenceEngine``…) use an
+  auto-assigned instance label so the process-wide registry can still
+  serve per-instance ``stats()``.
+- **Span API** — ``with telemetry.span("serving.dispatch"):`` records a
+  duration histogram under the span name and emits a structured event
+  carrying trace/span/parent correlation ids (contextvar-propagated, so
+  nested spans across threads correlate when the context flows).
+- **Retrace tracker** — :func:`record_compile` is called by every
+  lower+compile site (engine train-step builds, the serving engine's AOT
+  bucket cache, the SameDiff fit-step spec cache) with its *cause*
+  (``warmup`` / ``new_bucket`` / ``dtype_policy`` / ``workspace_mode`` /
+  ``params_placement`` / ``first_build`` …). Steady-state training must
+  show zero post-warmup events (regression-tested); before this tracker
+  a silent retrace was invisible until the step time doubled.
+- **Export** — ``prometheus_text()`` (text exposition served by
+  ``JsonModelServer GET /metrics``), ``event_log(path)`` (JSONL sink for
+  spans + compile events), and ``snapshot()`` (embedded in every bench.py
+  artifact).
+
+Kill switch: ``DL4J_TPU_TELEMETRY=off`` (or :func:`set_enabled`) gates
+the *timing* instrumentation — histogram observes, spans, step
+annotations, the phase clocks in the fit/serving loops — which is what
+the bench's ``telemetry_overhead`` metric A/Bs. Counters and gauges
+ALWAYS record: they are functional accounting (fault-injection ledgers,
+serving counters, compile counts) that product code and tests read, and
+each costs one dict add. Latency-derived surfaces (``stats()``
+percentiles, ``degraded_p99_ms`` health) go quiet when disabled —
+documented, deliberate. stdlib-only at import time so every layer can
+import this module without cycles (same contract as ``faults.py``).
+
+Coverage floor: metrics registered at import time land in a ledger
+(:func:`coverage_report`); ``tests/test_zz_coverage_floor.py`` asserts
+every one of them is exercised by at least one tier-1 test — a metric
+nobody can trip in a test is a metric nobody has ever read.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import itertools
+import json
+import os
+import re
+import threading
+import time
+from collections import deque
+from typing import Dict, Iterator, List, Optional, Tuple
+
+__all__ = [
+    "MetricsRegistry", "registry", "counter", "gauge", "histogram",
+    "enabled", "set_enabled", "span", "current_span", "event_log",
+    "emit_event", "record_compile", "compile_events",
+    "reset_compile_events", "step_annotation", "prometheus_text",
+    "snapshot", "coverage_report",
+]
+
+COUNTER = "counter"
+GAUGE = "gauge"
+HISTOGRAM = "histogram"
+
+#: Reservoir bound per histogram cell — matches the pre-registry
+#: ``ParallelInference._latencies`` deque so windowed percentiles keep the
+#: same fidelity the lifetime ones had.
+RESERVOIR = 4096
+
+
+def _label_key(labels: dict) -> Tuple:
+    return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+
+
+class _HistCell:
+    """One bounded reservoir of (monotonic-time, value) samples plus
+    lifetime count/sum (the reservoir is bounded; count/sum are not)."""
+
+    __slots__ = ("samples", "count", "sum")
+
+    def __init__(self, maxlen: int = RESERVOIR):
+        self.samples: deque = deque(maxlen=maxlen)
+        self.count = 0
+        self.sum = 0.0
+
+    def observe(self, value: float, now: float):
+        self.samples.append((now, float(value)))
+        self.count += 1
+        self.sum += float(value)
+
+    def values(self, window: Optional[float], now: float) -> List[float]:
+        if window is None:
+            return [v for _, v in self.samples]
+        cut = now - float(window)
+        return [v for t, v in self.samples if t >= cut]
+
+
+def _percentile(vals: List[float], q: float) -> Optional[float]:
+    return _percentile_sorted(sorted(vals), q)
+
+
+def _percentile_sorted(s: List[float], q: float) -> Optional[float]:
+    """``_percentile`` over an ALREADY-sorted list — export paths that
+    need several quantiles of the same reservoir sort once and call
+    this, instead of re-sorting per quantile."""
+    if not s:
+        return None
+    if len(s) == 1:
+        return s[0]
+    pos = (len(s) - 1) * q / 100.0
+    lo = int(pos)
+    hi = min(lo + 1, len(s) - 1)
+    frac = pos - lo
+    return s[lo] * (1 - frac) + s[hi] * frac
+
+
+class Metric:
+    """One named metric; cells per label set. Obtain via
+    ``registry.counter/gauge/histogram`` — never construct directly."""
+
+    def __init__(self, reg: "MetricsRegistry", name: str, kind: str,
+                 help: str = ""):
+        self._reg = reg
+        self.name = name
+        self.kind = kind
+        self.help = help
+        self._cells: Dict[Tuple, object] = {}
+
+    # -- write side ---------------------------------------------------------
+    # counters and gauges are FUNCTIONAL accounting (fault-injection
+    # ledgers, serving health inputs, compile counts — surfaces product
+    # code and tests read) and always record: one dict add under a lock.
+    # The DL4J_TPU_TELEMETRY=off kill switch gates only the *timing*
+    # instrumentation (histogram observes, spans, step annotations) —
+    # the per-step hot-path cost the telemetry_overhead bench A/Bs.
+    def inc(self, n: float = 1, **labels) -> None:
+        if self.kind != COUNTER:
+            raise TypeError(f"{self.name} is a {self.kind}, not a counter")
+        reg = self._reg
+        key = _label_key(labels)
+        with reg._lock:
+            self._cells[key] = self._cells.get(key, 0) + n
+            reg._touched.add(self.name)
+
+    def set(self, value, **labels) -> None:
+        if self.kind != GAUGE:
+            raise TypeError(f"{self.name} is a {self.kind}, not a gauge")
+        reg = self._reg
+        key = _label_key(labels)
+        with reg._lock:
+            self._cells[key] = value
+            reg._touched.add(self.name)
+
+    def observe(self, value: float, **labels) -> None:
+        if self.kind != HISTOGRAM:
+            raise TypeError(f"{self.name} is a {self.kind}, not a histogram")
+        reg = self._reg
+        if not reg._enabled:
+            return
+        key = _label_key(labels)
+        now = time.monotonic()
+        with reg._lock:
+            cell = self._cells.get(key)
+            if cell is None:
+                cell = self._cells[key] = _HistCell()
+            cell.observe(value, now)
+            reg._touched.add(self.name)
+
+    # -- read side ----------------------------------------------------------
+    def value(self, default=0, **labels):
+        """Counter/gauge value for one label set (``default`` when the
+        cell was never written — counters read naturally as 0)."""
+        with self._reg._lock:
+            v = self._cells.get(_label_key(labels), _MISSING)
+        return default if v is _MISSING else v
+
+    def total(self) -> float:
+        """Sum over every cell (counters; process-wide aggregate of all
+        instance labels)."""
+        with self._reg._lock:
+            return sum(v for v in self._cells.values()
+                       if isinstance(v, (int, float)))
+
+    def series(self) -> Dict[Tuple, object]:
+        with self._reg._lock:
+            return dict(self._cells)
+
+    def hist_series(self) -> Dict[Tuple, Tuple[int, float, List[float]]]:
+        """Materialized ``{label_key: (count, sum, [values])}`` for a
+        histogram, copied under the lock. Export paths (snapshot /
+        prometheus_text) must use this rather than iterating the live
+        ``_HistCell.samples`` deques from ``series()`` — a concurrent
+        ``observe()`` appending mid-iteration raises ``RuntimeError:
+        deque mutated during iteration`` and fails the scrape."""
+        with self._reg._lock:
+            return {k: (c.count, c.sum, [v for _, v in c.samples])
+                    for k, c in self._cells.items()}
+
+    def values_list(self, window: Optional[float] = None, **labels
+                    ) -> List[float]:
+        """Histogram raw sample values (optionally only the last
+        ``window`` seconds)."""
+        now = time.monotonic()
+        with self._reg._lock:
+            cell = self._cells.get(_label_key(labels))
+            return cell.values(window, now) if cell is not None else []
+
+    def percentile(self, q: float, window: Optional[float] = None,
+                   **labels) -> Optional[float]:
+        return _percentile(self.values_list(window, **labels), q)
+
+    def hist_snapshot(self, window: Optional[float] = None, **labels
+                      ) -> dict:
+        """{count, sum, p50, p99, mean, max} for one histogram cell.
+        ``window`` restricts the reservoir to the last N seconds (count/
+        sum stay lifetime when window is None, else windowed)."""
+        now = time.monotonic()
+        with self._reg._lock:
+            cell = self._cells.get(_label_key(labels))
+            if cell is None:
+                return {"count": 0, "sum": 0.0, "p50": None, "p99": None,
+                        "mean": None, "max": None}
+            vals = cell.values(window, now)
+            count = cell.count if window is None else len(vals)
+            reservoir_sum = float(sum(vals))
+            total = cell.sum if window is None else reservoir_sum
+        vals.sort()
+        return {"count": count, "sum": total,
+                "p50": _percentile_sorted(vals, 50),
+                "p99": _percentile_sorted(vals, 99),
+                "mean": (reservoir_sum / len(vals)) if vals else None,
+                "max": vals[-1] if vals else None}
+
+    def labeled(self, **labels) -> "BoundMetric":
+        return BoundMetric(self, labels)
+
+    def zero(self, **labels) -> None:
+        """Reset cells to their zero state (all cells when no labels are
+        given). Declarations and the coverage ledger survive — this backs
+        the pre-registry per-subsystem ``reset_counters()`` helpers."""
+        with self._reg._lock:
+            keys = [_label_key(labels)] if labels else list(self._cells)
+            for k in keys:
+                if k not in self._cells:
+                    continue
+                if self.kind == COUNTER:
+                    self._cells[k] = 0
+                elif self.kind == GAUGE:
+                    del self._cells[k]
+                else:
+                    self._cells[k] = _HistCell()
+
+
+_MISSING = object()
+
+
+class BoundMetric:
+    """A metric with labels pre-bound (what per-instance owners hold, so
+    the hot path does one attribute call). The label KEY is computed once
+    here — per-step write paths (fit-loop phase histograms, serving
+    dispatch) skip the per-call dict build + sort of the kwargs path."""
+
+    __slots__ = ("metric", "labels", "_key")
+
+    def __init__(self, metric: Metric, labels: dict):
+        self.metric = metric
+        self.labels = dict(labels)
+        self._key = _label_key(self.labels)
+
+    def inc(self, n: float = 1) -> None:
+        m = self.metric
+        if m.kind != COUNTER:
+            raise TypeError(f"{m.name} is a {m.kind}, not a counter")
+        reg = m._reg
+        with reg._lock:
+            m._cells[self._key] = m._cells.get(self._key, 0) + n
+            reg._touched.add(m.name)
+
+    def set(self, value) -> None:
+        m = self.metric
+        if m.kind != GAUGE:
+            raise TypeError(f"{m.name} is a {m.kind}, not a gauge")
+        reg = m._reg
+        with reg._lock:
+            m._cells[self._key] = value
+            reg._touched.add(m.name)
+
+    def observe(self, value: float) -> None:
+        m = self.metric
+        if m.kind != HISTOGRAM:
+            raise TypeError(f"{m.name} is a {m.kind}, not a histogram")
+        reg = m._reg
+        if not reg._enabled:
+            return
+        now = time.monotonic()
+        with reg._lock:
+            cell = m._cells.get(self._key)
+            if cell is None:
+                cell = m._cells[self._key] = _HistCell()
+            cell.observe(value, now)
+            reg._touched.add(m.name)
+
+    def observe_many(self, values) -> None:
+        """Histogram-observe a batch of values in ONE lock round with one
+        shared timestamp — dispatcher hot paths record a coalesced
+        batch's per-request latencies without taking the registry lock
+        per request."""
+        m = self.metric
+        if m.kind != HISTOGRAM:
+            raise TypeError(f"{m.name} is a {m.kind}, not a histogram")
+        reg = m._reg
+        if not reg._enabled or not values:
+            return
+        now = time.monotonic()
+        with reg._lock:
+            cell = m._cells.get(self._key)
+            if cell is None:
+                cell = m._cells[self._key] = _HistCell()
+            for v in values:
+                cell.observe(v, now)
+            reg._touched.add(m.name)
+
+    def value(self, default=0):
+        return self.metric.value(default, **self.labels)
+
+    def values_list(self, window: Optional[float] = None) -> List[float]:
+        return self.metric.values_list(window, **self.labels)
+
+    def percentile(self, q: float, window: Optional[float] = None):
+        return self.metric.percentile(q, window, **self.labels)
+
+    def hist_snapshot(self, window: Optional[float] = None) -> dict:
+        return self.metric.hist_snapshot(window, **self.labels)
+
+
+class MetricsRegistry:
+    """Process-wide metric store. ``counter/gauge/histogram`` declare (or
+    fetch) a metric by ``subsystem.name``; re-declaring with a different
+    kind is an error (two subsystems colliding on a name is a bug worth
+    failing loudly on)."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._metrics: Dict[str, Metric] = {}
+        self._touched: set = set()     # process-lifetime; reset() keeps it
+        self._enabled = os.environ.get(
+            "DL4J_TPU_TELEMETRY", "on").lower() not in ("off", "0", "false")
+
+    # -- declaration --------------------------------------------------------
+    def _declare(self, name: str, kind: str, help: str) -> Metric:
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = self._metrics[name] = Metric(self, name, kind, help)
+            elif m.kind != kind:
+                raise ValueError(
+                    f"metric {name!r} already registered as {m.kind}, "
+                    f"cannot re-register as {kind}")
+            return m
+
+    def counter(self, name: str, help: str = "") -> Metric:
+        return self._declare(name, COUNTER, help)
+
+    def gauge(self, name: str, help: str = "") -> Metric:
+        return self._declare(name, GAUGE, help)
+
+    def histogram(self, name: str, help: str = "") -> Metric:
+        return self._declare(name, HISTOGRAM, help)
+
+    def get(self, name: str) -> Optional[Metric]:
+        with self._lock:
+            return self._metrics.get(name)
+
+    def names(self) -> List[str]:
+        with self._lock:
+            return sorted(self._metrics)
+
+    # -- enable/disable -----------------------------------------------------
+    def set_enabled(self, on: bool) -> bool:
+        """Flip recording globally; returns the previous state (the bench
+        A/B and tests restore it)."""
+        old = self._enabled
+        self._enabled = bool(on)
+        return old
+
+    @property
+    def is_enabled(self) -> bool:
+        return self._enabled
+
+    # -- maintenance --------------------------------------------------------
+    def reset(self) -> None:
+        """Zero every cell. Declarations and the touched ledger survive
+        (the ledger accumulates across a whole test session, like the
+        fault-site ledger)."""
+        with self._lock:
+            for m in self._metrics.values():
+                m.zero()
+
+    def locked(self):
+        """The registry's reentrant lock, for callers that need a
+        multi-op read-modify-write (e.g. a cross-kind compat shim) or a
+        consistent read across several metrics to be atomic — inner
+        inc/set/value calls re-acquire it safely."""
+        return self._lock
+
+    def discard_cells(self, **labels) -> int:
+        """Remove every cell (across all metrics) whose label set contains
+        ALL the given ``key=value`` pairs. Per-instance owners (serving
+        engines, inference fronts) register a ``weakref.finalize`` calling
+        this with their instance label, so a long-running process that
+        churns models does not grow the registry — and ``/metrics`` —
+        without bound. Returns the number of cells dropped."""
+        want = set(_label_key(labels))
+        n = 0
+        with self._lock:
+            for m in self._metrics.values():
+                for k in [k for k in m._cells if want <= set(k)]:
+                    del m._cells[k]
+                    n += 1
+        return n
+
+    def coverage_report(self) -> dict:
+        """The telemetry floor's input: ``untouched`` lists registered
+        metrics no test (or production path under test) ever wrote."""
+        with self._lock:
+            registered = sorted(self._metrics)
+            touched = sorted(self._touched & set(self._metrics))
+        return {"registered": registered, "touched": touched,
+                "untouched": sorted(set(registered) - set(touched))}
+
+    # -- export -------------------------------------------------------------
+    def snapshot(self, compact: bool = False) -> dict:
+        """JSON-safe dump of every metric. ``compact=True`` (bench
+        artifacts) aggregates counters across label sets and reduces
+        histograms to count/p50/p99."""
+        out = {}
+        with self._lock:
+            metrics = dict(self._metrics)
+        for name, m in sorted(metrics.items()):
+            if m.kind == HISTOGRAM:
+                if compact:
+                    # aggregate all cells into one distribution
+                    vals, count, total = [], 0, 0.0
+                    for c, s, vs in m.hist_series().values():
+                        vals.extend(vs)
+                        count += c
+                        total += s
+                    vals.sort()
+                    out[name] = {"kind": m.kind, "count": count,
+                                 "sum": total,
+                                 "p50": _percentile_sorted(vals, 50),
+                                 "p99": _percentile_sorted(vals, 99)}
+                else:
+                    series = {}
+                    for k, (c, s, vs) in m.hist_series().items():
+                        vs.sort()
+                        series[json.dumps(dict(k))] = {
+                            "count": c, "sum": s,
+                            "p50": _percentile_sorted(vs, 50),
+                            "p99": _percentile_sorted(vs, 99)}
+                    out[name] = {"kind": m.kind, "series": series}
+            else:
+                if compact:
+                    out[name] = {"kind": m.kind, "total": m.total()} \
+                        if m.kind == COUNTER else \
+                        {"kind": m.kind,
+                         "series": {json.dumps(dict(k)): v
+                                    for k, v in m.series().items()}}
+                else:
+                    out[name] = {"kind": m.kind,
+                                 "series": {json.dumps(dict(k)): v
+                                            for k, v in m.series().items()}}
+        return out
+
+    def prometheus_text(self) -> str:
+        """Prometheus text exposition (format 0.0.4). Counters export with
+        the ``_total`` convention; histograms export as summaries
+        (``quantile`` label + ``_count``/``_sum``); gauges with a None
+        value are skipped (unset)."""
+        lines: List[str] = []
+        with self._lock:
+            metrics = dict(self._metrics)
+        for name, m in sorted(metrics.items()):
+            pname = _prom_name(name)
+            if m.kind == COUNTER:
+                pname += "_total"
+                series = m.series()
+                lines.append(f"# HELP {pname} {_prom_help(m)}")
+                lines.append(f"# TYPE {pname} counter")
+                if not series:
+                    lines.append(f"{pname} 0")
+                for k, v in sorted(series.items()):
+                    lines.append(f"{pname}{_prom_labels(k)} {_prom_val(v)}")
+            elif m.kind == GAUGE:
+                series = m.series()
+                lines.append(f"# HELP {pname} {_prom_help(m)}")
+                lines.append(f"# TYPE {pname} gauge")
+                for k, v in sorted(series.items()):
+                    if v is None:
+                        continue
+                    if isinstance(v, bool):
+                        v = int(v)
+                    if not isinstance(v, (int, float)):
+                        continue  # string gauges are not exposition-legal
+                    lines.append(f"{pname}{_prom_labels(k)} {_prom_val(v)}")
+            else:
+                lines.append(f"# HELP {pname} {_prom_help(m)}")
+                lines.append(f"# TYPE {pname} summary")
+                for k, (count, total, vals) in sorted(
+                        m.hist_series().items()):
+                    vals.sort()
+                    for q, qs in ((50, "0.5"), (99, "0.99")):
+                        pv = _percentile_sorted(vals, q)
+                        if pv is None:
+                            continue
+                        lines.append(
+                            f"{pname}{_prom_labels(k + (('quantile', qs),))}"
+                            f" {_prom_val(pv)}")
+                    lines.append(
+                        f"{pname}_count{_prom_labels(k)} {count}")
+                    lines.append(
+                        f"{pname}_sum{_prom_labels(k)} {_prom_val(total)}")
+        return "\n".join(lines) + "\n"
+
+
+def _prom_name(name: str) -> str:
+    return "dl4j_" + re.sub(r"[^a-zA-Z0-9_:]", "_", name)
+
+
+def _prom_help(m: Metric) -> str:
+    return (m.help or m.name).replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def _prom_labels(key: Tuple) -> str:
+    if not key:
+        return ""
+    parts = []
+    for k, v in key:
+        v = str(v).replace("\\", "\\\\").replace('"', '\\"') \
+            .replace("\n", "\\n")
+        parts.append(f'{re.sub(r"[^a-zA-Z0-9_]", "_", str(k))}="{v}"')
+    return "{" + ",".join(parts) + "}"
+
+
+def _prom_val(v) -> str:
+    f = float(v)
+    if f != f:
+        return "NaN"  # exposition-format literal; int(f) would raise
+    if f in (float("inf"), float("-inf")):
+        return "+Inf" if f > 0 else "-Inf"
+    return str(int(f)) if f == int(f) and abs(f) < 1e15 else repr(f)
+
+
+#: THE process-wide registry (the "single MetricsRegistry" of ISSUE 6).
+registry = MetricsRegistry()
+
+
+def counter(name: str, help: str = "") -> Metric:
+    return registry.counter(name, help)
+
+
+def gauge(name: str, help: str = "") -> Metric:
+    return registry.gauge(name, help)
+
+
+def histogram(name: str, help: str = "") -> Metric:
+    return registry.histogram(name, help)
+
+
+def enabled() -> bool:
+    """Hot loops guard their instrumentation on this — one bool read."""
+    return registry._enabled
+
+
+def set_enabled(on: bool) -> bool:
+    return registry.set_enabled(on)
+
+
+def prometheus_text() -> str:
+    return registry.prometheus_text()
+
+
+def snapshot(compact: bool = False) -> dict:
+    return registry.snapshot(compact=compact)
+
+
+def coverage_report() -> dict:
+    return registry.coverage_report()
+
+
+# ---------------------------------------------------------------- span API
+class Span:
+    """One timed region. ``trace_id`` groups a whole request/step tree;
+    ``parent_id`` is the enclosing span (None at the root)."""
+
+    __slots__ = ("name", "trace_id", "span_id", "parent_id", "attrs",
+                 "labels", "t0", "duration_s")
+
+    def __init__(self, name, trace_id, span_id, parent_id, attrs,
+                 labels=None):
+        self.name = name
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self.parent_id = parent_id
+        self.attrs = attrs
+        self.labels = labels
+        self.t0 = time.perf_counter()
+        self.duration_s: Optional[float] = None
+
+
+_span_ids = itertools.count(1)
+_current_span: contextvars.ContextVar[Optional[Span]] = \
+    contextvars.ContextVar("dl4j_tpu_span", default=None)
+
+
+def current_span() -> Optional[Span]:
+    return _current_span.get()
+
+
+class _SpanCtx:
+    __slots__ = ("span", "_token")
+
+    def __init__(self, span: Span):
+        self.span = span
+        self._token = None
+
+    def __enter__(self) -> Span:
+        self._token = _current_span.set(self.span)
+        return self.span
+
+    def __exit__(self, *exc):
+        sp = self.span
+        sp.duration_s = time.perf_counter() - sp.t0
+        _current_span.reset(self._token)
+        if registry._enabled:
+            registry.histogram(sp.name).observe(sp.duration_s,
+                                                **(sp.labels or {}))
+            emit_event({"type": "span", "name": sp.name,
+                        "trace": sp.trace_id, "span": sp.span_id,
+                        "parent": sp.parent_id, "duration_s": sp.duration_s,
+                        **(sp.labels or {}), **sp.attrs})
+        return False
+
+
+class _NullSpanCtx:
+    span = None
+
+    def __enter__(self):
+        return None
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL_SPAN = _NullSpanCtx()
+
+
+def span(name: str, labels: Optional[dict] = None, **attrs):
+    """``with telemetry.span("serving.dispatch", rows=n):`` — times the
+    region into the ``name`` duration histogram and emits a correlated
+    event. ``labels`` (LOW-cardinality only: instance ids, modes) become
+    the histogram cell's labels so distinct instances don't blend into
+    one p99; free-form ``attrs`` (row counts, shapes) go to the event
+    log only. Nested spans inherit the trace id and point at their
+    parent; a root span starts a fresh trace. Disabled telemetry returns
+    a no-op context (the body still runs; nothing is recorded)."""
+    if not registry._enabled:
+        return _NULL_SPAN
+    parent = _current_span.get()
+    sid = next(_span_ids)
+    trace = parent.trace_id if parent is not None else sid
+    return _SpanCtx(Span(name, trace, sid,
+                         parent.span_id if parent is not None else None,
+                         attrs, labels))
+
+
+_step_annotation_cls = None  # resolved on first use; False = unavailable
+
+
+def step_annotation(step_num: int, name: str = "train"):
+    """``jax.profiler.StepTraceAnnotation`` for one training step (or a
+    no-op when telemetry is off / jax is unavailable): device traces
+    captured by ``ui.profiler.ProfilingListener`` then carry the step
+    number, so trace timelines line up with the step-phase histograms.
+    The class lookup resolves once — this runs on every fit-loop step."""
+    global _step_annotation_cls
+    if not registry._enabled:
+        return _NULL_SPAN
+    cls = _step_annotation_cls
+    if cls is None:
+        try:
+            import jax
+            cls = _step_annotation_cls = jax.profiler.StepTraceAnnotation
+        except Exception:
+            cls = _step_annotation_cls = False
+    if cls is False:
+        return _NULL_SPAN
+    try:
+        return cls(name, step_num=step_num)
+    except Exception:
+        return _NULL_SPAN
+
+
+# ------------------------------------------------------------- event log
+_event_lock = threading.Lock()
+_event_sink = None          # open file object, or None
+
+
+class _EventLog:
+    """Handle returned by :func:`event_log` (context-manager friendly).
+    ``close()`` only closes the sink this handle opened — if the process
+    has since re-pointed the event log elsewhere, a stale handle (or a
+    ``with`` block wrapping the re-point) must not kill the new sink."""
+
+    def __init__(self, path: str, sink):
+        self.path = path
+        self._sink = sink
+
+    def close(self):
+        global _event_sink
+        with _event_lock:
+            if _event_sink is not self._sink:
+                return  # re-pointed since; not ours to close
+            _event_sink.close()
+            _event_sink = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def event_log(path: Optional[str]) -> Optional[_EventLog]:
+    """Start appending structured JSONL events (spans, compile events) to
+    ``path``; ``event_log(None)`` (or ``.close()``) stops. One sink per
+    process — re-pointing closes the previous file."""
+    global _event_sink
+    with _event_lock:
+        if _event_sink is not None:
+            _event_sink.close()
+            _event_sink = None
+        if path is None:
+            return None
+        _event_sink = open(path, "a", encoding="utf-8")
+        sink = _event_sink
+    return _EventLog(path, sink)
+
+
+def close_event_log():
+    event_log(None)
+
+
+def emit_event(event: dict) -> None:
+    """Append one event to the JSONL sink (no-op without a sink). Adds a
+    wall-clock ``t`` so offline consumers can align multiple processes."""
+    sink = _event_sink
+    if sink is None:
+        return
+    rec = {"t": time.time(), **event}
+    line = json.dumps(rec, default=str)
+    with _event_lock:
+        if _event_sink is not sink:  # closed/re-pointed while we serialized
+            return
+        _event_sink.write(line + "\n")
+        _event_sink.flush()
+
+
+# -------------------------------------------------------- retrace tracker
+#: Compile causes every site reports through record_compile(). Not
+#: enforced as a closed set — but keep to these names where they apply so
+#: dashboards can aggregate across sites.
+COMPILE_CAUSES = ("first_build", "warmup", "new_bucket", "dtype_policy",
+                  "workspace_mode", "params_placement", "init",
+                  "invalidate", "config_change", "precision", "probe",
+                  "lr_backoff")
+
+_compile_counter = counter(
+    "compile.events",
+    "lower+compile events by site and cause (retrace tracker); "
+    "steady-state training must show zero after warmup")
+_compiles_lock = threading.Lock()
+_compile_log: deque = deque(maxlen=1024)
+
+
+def record_compile(site: str, cause: str, **detail) -> None:
+    """Record one lower+compile event. ``site`` is the compiling cache
+    (``train.step``, ``serving.engine``, ``samediff.fit_step`` …);
+    ``cause`` says *why* the program wasn't already cached. Every event
+    counts into ``compile.events{site=,cause=}``, lands in the bounded
+    in-memory log (:func:`compile_events`), and goes to the JSONL event
+    sink. Always records (compiles are rare and functional — never a hot
+    path), so the retrace tracker keeps working under
+    ``DL4J_TPU_TELEMETRY=off``."""
+    _compile_counter.inc(site=site, cause=cause)
+    ev = {"type": "compile", "site": site, "cause": cause, **detail}
+    with _compiles_lock:
+        _compile_log.append(ev)
+    emit_event(ev)
+
+
+def compile_events(site: Optional[str] = None) -> List[dict]:
+    """The in-memory compile-event log (most recent 1024), optionally
+    filtered by site. For zero-compile steady-state assertions, delta the
+    ``compile.events`` counter total instead of ``len()`` of this log —
+    once the bounded log saturates, an append evicts the oldest entry and
+    ``len()`` stops growing even though a compile happened."""
+    with _compiles_lock:
+        evs = list(_compile_log)
+    return [e for e in evs if site is None or e["site"] == site]
+
+
+def reset_compile_events() -> None:
+    with _compiles_lock:
+        _compile_log.clear()

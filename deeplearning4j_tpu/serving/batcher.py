@@ -40,9 +40,11 @@ deadline_expired / retries — zero silent fallbacks) and injectable via
 
 from __future__ import annotations
 
+import itertools
 import queue
 import threading
 import time
+import weakref
 from collections import deque
 from concurrent.futures import Future, TimeoutError as _FutTimeout
 from typing import List, Optional
@@ -50,8 +52,32 @@ from typing import List, Optional
 import numpy as np
 
 from ..runtime import faults as _faults
+from ..runtime import telemetry as _tel
 from ..runtime.faults import DeadlineExceeded, QueueFull, ShutdownError
 from .engine import InferenceEngine, next_bucket
+
+# per-front counters/reservoirs live in the process-wide MetricsRegistry
+# (ISSUE 6), labeled by a monotonically assigned instance id; the
+# attribute names pre-registry callers used (pi.requests, pi.shed, ...)
+# survive as properties, and stats() is a view with optional windowing
+_M_REQUESTS = _tel.counter("serving.requests", "requests submitted")
+_M_BATCHES = _tel.counter("serving.batches", "coalesced engine dispatches")
+_M_FAILURES = _tel.counter("serving.failures", "failed requests")
+_M_SHED = _tel.counter("serving.shed", "load-shed (QueueFull) rejections")
+_M_DEADLINE = _tel.counter("serving.deadline_expired",
+                           "requests expired before dispatch")
+_M_RETRIES = _tel.counter("serving.retries", "transient dispatch retries")
+_H_LATENCY = _tel.histogram(
+    "serving.request_latency_s",
+    "submit->resolve latency per request (timestamped reservoir: "
+    "stats(window=...) reads only the recent samples)")
+_H_ROWS = _tel.histogram("serving.batch_rows",
+                         "rows per coalesced engine call")
+_H_QUEUE = _tel.histogram("serving.phase.queue_s",
+                          "enqueue->dequeue wait per dispatched request")
+_H_COALESCE = _tel.histogram("serving.phase.coalesce_s",
+                             "first-dequeue->dispatch linger per batch")
+_pi_ids = itertools.count()
 
 
 class InferenceMode:
@@ -66,13 +92,15 @@ class HealthState:
 
 
 class _Request:
-    __slots__ = ("x", "length", "future", "t_enqueue", "deadline")
+    __slots__ = ("x", "length", "future", "t_enqueue", "t_dequeue",
+                 "deadline")
 
     def __init__(self, x, length, deadline=None):
         self.x = x
         self.length = length          # true seq length (seq models)
         self.future: Future = Future()
         self.t_enqueue = time.perf_counter()
+        self.t_dequeue = None         # stamped by the dispatcher's get()
         self.deadline = deadline      # absolute perf_counter time or None
 
     def expired(self, now=None) -> bool:
@@ -105,7 +133,8 @@ class ParallelInference:
                  deadline_ms: Optional[float] = None,
                  shed_queue_depth: Optional[int] = None,
                  retry_transient: bool = True,
-                 health_window_s: float = 5.0):
+                 health_window_s: float = 5.0,
+                 degraded_p99_ms: Optional[float] = None):
         if mode not in (InferenceMode.SEQUENTIAL, InferenceMode.BATCHED):
             raise ValueError(f"unknown inference mode {mode!r}")
         if batch_limit is not None:  # deprecated alias
@@ -124,6 +153,10 @@ class ParallelInference:
             else int(shed_queue_depth)
         self.retry_transient = bool(retry_transient)
         self.health_window = float(health_window_s)
+        # ISSUE 6 satellite: health reacts to RECENT latency — p99 over
+        # the health window above this threshold reports DEGRADED even
+        # with no hard failures (None = latency never degrades health)
+        self.degraded_p99_ms = degraded_p99_ms
         if engine is None:
             # default: share the model's engine, so net.output() and the
             # batcher hit the same warmed bucket cache; a mesh needs its
@@ -141,21 +174,26 @@ class ParallelInference:
                 next_bucket(self.max_batch_size, engine.min_bucket),
                 minimum=engine.min_bucket))
         self._q: "queue.Queue[_Request]" = queue.Queue(maxsize=queue_limit)
-        self._lock = threading.Lock()           # counters / latency deques
         self._dispatch_lock = threading.Lock()  # SEQUENTIAL execution
         self._shutdown = threading.Event()
         self._worker: Optional[threading.Thread] = None
-        # -- observability (lock-protected) --
-        self._latencies = deque(maxlen=4096)   # seconds, per request
-        self._batch_sizes = deque(maxlen=4096)  # rows per coalesced call
-        self.requests = 0
-        self.batches = 0
-        self.failures = 0
-        # degradation counters (every fault path counted — no silent
-        # fallbacks) + the recent-event window behind health()
-        self.shed = 0
-        self.deadline_expired = 0
-        self.retries = 0
+        # -- observability: registry cells labeled by instance (ISSUE 6);
+        # latency/batch-rows are timestamped reservoirs so stats(window=)
+        # can report percentiles over only the recent samples; a finalizer
+        # drops the cells when this front is collected (bounded registry) --
+        self._id = str(next(_pi_ids))
+        weakref.finalize(self, _tel.registry.discard_cells, pi=self._id)
+        self._m_requests = _M_REQUESTS.labeled(pi=self._id)
+        self._m_batches = _M_BATCHES.labeled(pi=self._id)
+        self._m_failures = _M_FAILURES.labeled(pi=self._id)
+        self._m_shed = _M_SHED.labeled(pi=self._id)
+        self._m_deadline = _M_DEADLINE.labeled(pi=self._id)
+        self._m_retries = _M_RETRIES.labeled(pi=self._id)
+        self._h_latency = _H_LATENCY.labeled(pi=self._id)
+        self._h_rows = _H_ROWS.labeled(pi=self._id)
+        self._h_queue = _H_QUEUE.labeled(pi=self._id)
+        self._h_coalesce = _H_COALESCE.labeled(pi=self._id)
+        # degradation events: the recent-event window behind health()
         self._events = deque(maxlen=1024)      # (t, kind) kind in
         #                                        {shed, failure, retry,
         #                                         deadline}
@@ -182,8 +220,7 @@ class ParallelInference:
         x = self._validate(np.asarray(x))
         dl = self.deadline_ms if deadline_ms is None else deadline_ms
         deadline = None if dl is None else time.perf_counter() + dl / 1e3
-        with self._lock:
-            self.requests += 1
+        self._m_requests.inc()
         if self.mode == InferenceMode.SEQUENTIAL:
             req = self._make_request(x, deadline)
             try:
@@ -196,21 +233,22 @@ class ParallelInference:
                     if req.expired():
                         raise DeadlineExceeded(
                             "request deadline expired before dispatch")
-                    out = self._call_engine(x)
-                with self._lock:
-                    self.batches += 1
-                    self._batch_sizes.append(x.shape[0])
+                    with _tel.span("serving.dispatch",
+                                   labels={"pi": self._id,
+                                           "mode": str(self.mode)},
+                                   rows=int(x.shape[0])):
+                        out = self._call_engine(x)
+                self._m_batches.inc()
+                self._h_rows.observe(x.shape[0])
                 req.future.set_result(
                     [np.asarray(o) for o in out] if isinstance(out, list)
                     else np.asarray(out))
             except DeadlineExceeded as e:
-                with self._lock:
-                    self.deadline_expired += 1
+                self._m_deadline.inc()
                 self._note("deadline")
                 req.future.set_exception(e)
             except Exception as e:
-                with self._lock:
-                    self.failures += 1
+                self._m_failures.inc()
                 self._note("failure")
                 req.future.set_exception(e)
             finally:
@@ -222,8 +260,7 @@ class ParallelInference:
             # queue — a fast, counted failure instead of unbounded linger.
             # Checked BEFORE chunking so oversized requests (the heaviest
             # traffic) cannot evade the overload protection.
-            with self._lock:
-                self.shed += 1
+            self._m_shed.inc()
             self._note("shed")
             raise QueueFull(
                 f"serving queue depth {self._q.qsize()} at/above shedding "
@@ -310,9 +347,15 @@ class ParallelInference:
           a request was shed within the health window (clients should
           back off / be rerouted).
         - ``DEGRADED`` — recent failures, transient-error retries, or
-          deadline expiries, but requests are being accepted.
+          deadline expiries — or, with ``degraded_p99_ms`` set, a recent
+          (health-window) latency p99 above the threshold — but requests
+          are being accepted.
         - ``HEALTHY`` — none of the above.
-        """
+
+        All inputs are *recent*: the event deque and the latency
+        reservoir are both read over ``health_window_s``, so a latency
+        spike an hour ago cannot pin the state (ISSUE 6 satellite —
+        the pre-registry percentiles were lifetime-of-process)."""
         now = time.perf_counter()
         recent = {k for t, k in list(self._events)
                   if now - t <= self.health_window}
@@ -322,31 +365,69 @@ class ParallelInference:
             return HealthState.SHEDDING
         if recent & {"failure", "retry", "deadline"}:
             return HealthState.DEGRADED
+        if self.degraded_p99_ms is not None:
+            p99 = self._h_latency.percentile(99, window=self.health_window)
+            if p99 is not None and p99 * 1e3 > self.degraded_p99_ms:
+                return HealthState.DEGRADED
         return HealthState.HEALTHY
 
-    def stats(self) -> dict:
+    # legacy counter attributes — views over the registry cells
+    @property
+    def requests(self) -> int:
+        return int(self._m_requests.value())
+
+    @property
+    def batches(self) -> int:
+        return int(self._m_batches.value())
+
+    @property
+    def failures(self) -> int:
+        return int(self._m_failures.value())
+
+    @property
+    def shed(self) -> int:
+        return int(self._m_shed.value())
+
+    @property
+    def deadline_expired(self) -> int:
+        return int(self._m_deadline.value())
+
+    @property
+    def retries(self) -> int:
+        return int(self._m_retries.value())
+
+    def stats(self, window: Optional[float] = None) -> dict:
         """Serving health snapshot: request latency percentiles (ms),
         queue depth, coalesced batch sizes, the degradation counters +
-        health state, and the engine's bucket-hit / compile counters."""
+        health state, and the engine's bucket-hit / compile counters.
+
+        ``window`` (seconds): restrict the latency/batch-size
+        percentiles to samples observed in the last N seconds, so a
+        DEGRADED/SHEDDING operator view reacts to *recent* behaviour
+        instead of the process lifetime (the counters stay lifetime —
+        they are monotonic by contract)."""
         health = self.health()
-        with self._lock:
-            lats = np.asarray(self._latencies, dtype=np.float64)
-            sizes = np.asarray(self._batch_sizes, dtype=np.float64)
-            out = {
-                "mode": self.mode,
-                "health": health,
-                "requests": self.requests,
-                "batches": self.batches,
-                "failures": self.failures,
-                "shed": self.shed,
-                "deadline_expired": self.deadline_expired,
-                "retries": self.retries,
-                "queue_depth": self._q.qsize(),
-                "latency_ms_p50": _pct(lats, 50),
-                "latency_ms_p99": _pct(lats, 99),
-                "batch_rows_mean": float(sizes.mean()) if sizes.size else None,
-                "batch_rows_max": int(sizes.max()) if sizes.size else None,
-            }
+        lat = self._h_latency.hist_snapshot(window=window)
+        rows = self._h_rows.hist_snapshot(window=window)
+        out = {
+            "mode": self.mode,
+            "health": health,
+            "requests": self.requests,
+            "batches": self.batches,
+            "failures": self.failures,
+            "shed": self.shed,
+            "deadline_expired": self.deadline_expired,
+            "retries": self.retries,
+            "queue_depth": self._q.qsize(),
+            "window_s": window,
+            "latency_ms_p50": None if lat["p50"] is None
+            else lat["p50"] * 1e3,
+            "latency_ms_p99": None if lat["p99"] is None
+            else lat["p99"] * 1e3,
+            "batch_rows_mean": rows["mean"],
+            "batch_rows_max": None if rows["max"] is None
+            else int(rows["max"]),
+        }
         out["engine"] = self.engine.stats()
         return out
 
@@ -392,8 +473,7 @@ class ParallelInference:
         return x
 
     def _record_latency(self, req: _Request):
-        with self._lock:
-            self._latencies.append(time.perf_counter() - req.t_enqueue)
+        self._h_latency.observe(time.perf_counter() - req.t_enqueue)
 
     def _expire(self, req: _Request, now=None) -> bool:
         """Deadline fail-fast: an expired request never reaches the device
@@ -401,8 +481,7 @@ class ParallelInference:
         request that can still make its SLO."""
         if not req.expired(now):
             return False
-        with self._lock:
-            self.deadline_expired += 1
+        self._m_deadline.inc()
         self._note("deadline")
         if not req.future.done():
             req.future.set_exception(DeadlineExceeded(
@@ -428,8 +507,7 @@ class ParallelInference:
                 if attempt == 0 and self.retry_transient and \
                         _faults.is_transient(e):
                     attempt = 1
-                    with self._lock:
-                        self.retries += 1
+                    self._m_retries.inc()
                     self._note("retry")
                     continue
                 raise
@@ -444,11 +522,13 @@ class ParallelInference:
                     first = self._q.get(timeout=0.05)
                 except queue.Empty:
                     continue
+                first.t_dequeue = time.perf_counter()
             if self._expire(first):
                 continue
             batch: List[_Request] = [first]
             total = first.x.shape[0]
-            deadline = time.perf_counter() + self.max_wait
+            t_first = time.perf_counter()
+            deadline = t_first + self.max_wait
             while total < self.max_batch_size:
                 remaining = deadline - time.perf_counter()
                 if remaining <= 0:
@@ -457,6 +537,7 @@ class ParallelInference:
                     r = self._q.get(timeout=remaining)
                 except queue.Empty:
                     break
+                r.t_dequeue = time.perf_counter()
                 if self._expire(r):
                     continue
                 if total + r.x.shape[0] > self.max_batch_size:
@@ -466,6 +547,14 @@ class ParallelInference:
                     break
                 batch.append(r)
                 total += r.x.shape[0]
+            if _tel.enabled():
+                # request-lifecycle phases: time queued (per request,
+                # enqueue->its own dequeue — the coalesce linger belongs
+                # to coalesce_s, not here) and the linger this batch paid
+                now = time.perf_counter()
+                self._h_queue.observe_many(
+                    [r.t_dequeue - r.t_enqueue for r in batch])
+                self._h_coalesce.observe(now - t_first)
             self._run(batch, total)
         if pending is not None:  # don't strand a carried request
             pending.future.set_exception(ShutdownError(
@@ -474,24 +563,11 @@ class ParallelInference:
 
     def _run(self, batch: List[_Request], total: int):
         try:
-            lengths = None
-            if self._seq:
-                # ragged T: end-pad every request to the coalesced max;
-                # the engine masks the pad steps out exactly
-                t_max = max(r.x.shape[1] for r in batch)
-                xs, lengths = [], []
-                for r in batch:
-                    t = r.x.shape[1]
-                    x = r.x if t == t_max else np.concatenate(
-                        [r.x, np.zeros((r.x.shape[0], t_max - t)
-                                       + r.x.shape[2:], r.x.dtype)], axis=1)
-                    xs.append(x)
-                    lengths.extend([t] * r.x.shape[0])
-                x = np.concatenate(xs, axis=0)
-                out = self._call_engine(x, lengths=np.asarray(lengths))
-            else:
-                x = np.concatenate([r.x for r in batch], axis=0)
-                out = self._call_engine(x)
+            with _tel.span("serving.dispatch",
+                           labels={"pi": self._id,
+                                   "mode": str(self.mode)},
+                           rows=int(total), requests=len(batch)):
+                out = self._run_engine(batch)
             outs = out if isinstance(out, list) else [out]
             i = 0
             done_t = time.perf_counter()
@@ -504,20 +580,35 @@ class ParallelInference:
                 i += n
                 if not r.future.done():  # a shutdown race may have failed it
                     r.future.set_result(rows if len(rows) > 1 else rows[0])
-            with self._lock:  # one lock round per coalesced batch
-                self.batches += 1
-                self._batch_sizes.append(total)
-                self._latencies.extend(done_t - r.t_enqueue for r in batch)
+            self._m_batches.inc()
+            self._h_rows.observe(total)
+            self._h_latency.observe_many(
+                [done_t - r.t_enqueue for r in batch])
         except Exception as e:  # propagate to every waiter
             done_t = time.perf_counter()
-            with self._lock:
-                self.failures += len(batch)
-                self._latencies.extend(done_t - r.t_enqueue for r in batch)
+            self._m_failures.inc(len(batch))
+            self._h_latency.observe_many(
+                [done_t - r.t_enqueue for r in batch])
             self._note("failure")
             for r in batch:
                 if not r.future.done():
                     r.future.set_exception(e)
 
-
-def _pct(a: np.ndarray, q: float) -> Optional[float]:
-    return float(np.percentile(a, q) * 1e3) if a.size else None
+    def _run_engine(self, batch: List[_Request]):
+        """Coalesce one batch's arrays and dispatch the engine call."""
+        if self._seq:
+            # ragged T: end-pad every request to the coalesced max;
+            # the engine masks the pad steps out exactly
+            t_max = max(r.x.shape[1] for r in batch)
+            xs, lengths = [], []
+            for r in batch:
+                t = r.x.shape[1]
+                x = r.x if t == t_max else np.concatenate(
+                    [r.x, np.zeros((r.x.shape[0], t_max - t)
+                                   + r.x.shape[2:], r.x.dtype)], axis=1)
+                xs.append(x)
+                lengths.extend([t] * r.x.shape[0])
+            x = np.concatenate(xs, axis=0)
+            return self._call_engine(x, lengths=np.asarray(lengths))
+        x = np.concatenate([r.x for r in batch], axis=0)
+        return self._call_engine(x)

@@ -29,7 +29,9 @@ Works for both engines: ``MultiLayerNetwork`` (single input) and
 
 from __future__ import annotations
 
+import itertools
 import threading
+import time
 import weakref
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
@@ -39,6 +41,27 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from .. import dtypes as _dt
+from ..runtime import telemetry as _tel
+
+# per-engine counters live in the process-wide MetricsRegistry (ISSUE 6),
+# labeled by a monotonically assigned engine id so stats() keeps its
+# per-instance semantics while `GET /metrics` scrapes every engine at once
+_M_CALLS = _tel.counter("serving.engine.calls", "output() requests")
+_M_HITS = _tel.counter("serving.engine.hits", "warm-bucket executable hits")
+_M_COMPILES = _tel.counter("serving.engine.compiles",
+                           "AOT bucket compiles (after warmup: a bug)")
+_M_PADDED = _tel.counter("serving.engine.padded_rows",
+                         "pad rows added by bucket rounding")
+_M_BUCKET_HITS = _tel.counter("serving.engine.bucket_hits",
+                              "executable hits per bucket shape")
+# request-lifecycle phases inside the engine: pad -> execute -> unpad
+_H_PAD = _tel.histogram("serving.phase.pad_s",
+                        "host-side bucket padding time per engine call")
+_H_EXEC = _tel.histogram("serving.phase.execute_s",
+                         "device executable time per engine call")
+_H_UNPAD = _tel.histogram("serving.phase.unpad_s",
+                          "host-side unpad time per engine call")
+_engine_ids = itertools.count()
 
 
 def next_bucket(n: int, minimum: int = 1) -> int:
@@ -93,16 +116,43 @@ class InferenceEngine:
         self._seq_input = [len(s) == 2 for s in self._input_shapes] \
             if self._input_shapes is not None else None
         self._compiled: Dict[Tuple, Any] = {}
+        # bound bucket-hit cells, one per compiled key: the warm-hit path
+        # runs per request, so the label string + sorted label key are
+        # built once at compile time, not per call
+        self._hit_cells: Dict[Tuple, Any] = {}
         self._lock = threading.Lock()
         self._placed_params_src = None
         self._placed = None
         self._placement_src = None
         self._placement = None
-        self.calls = 0
-        self.hits = 0
-        self.compiles = 0
-        self.padded_rows = 0
-        self.bucket_hits: Dict[Tuple, int] = {}
+        # counters are registry cells labeled by engine id (ISSUE 6); the
+        # legacy attribute names survive as read-only properties below,
+        # and a finalizer drops the cells when the engine is collected so
+        # model churn cannot grow the registry (and /metrics) unboundedly
+        self._id = str(next(_engine_ids))
+        weakref.finalize(self, _tel.registry.discard_cells, engine=self._id)
+        self._m_calls = _M_CALLS.labeled(engine=self._id)
+        self._m_hits = _M_HITS.labeled(engine=self._id)
+        self._m_compiles = _M_COMPILES.labeled(engine=self._id)
+        self._m_padded = _M_PADDED.labeled(engine=self._id)
+        # phase histograms carry engine= too: in a multi-engine process
+        # (lazy default engine + ParallelWrapper.serving_engine(), or a
+        # multi-model service) unlabeled cells would blend every engine's
+        # pad/execute/unpad distribution into one unusable p99
+        self._h_pad = _H_PAD.labeled(engine=self._id)
+        self._h_exec = _H_EXEC.labeled(engine=self._id)
+        self._h_unpad = _H_UNPAD.labeled(engine=self._id)
+        # retrace tracker: why the next compile is happening (armed by
+        # invalidate(cause=...), consumed by _get_compiled) + the aval
+        # keys ever compiled, so a re-compile of a known bucket shape
+        # under a new params placement is attributed to the placement
+        self._invalidate_cause: Optional[str] = None
+        self._known_avals: set = set()
+        # aval keys that were warmed when invalidate(cause=) fired -> that
+        # cause, so EVERY stale bucket's rebuild is attributed to the
+        # invalidation (the one-shot _invalidate_cause alone would tag the
+        # first rebuild and leave the rest reading as mystery new_buckets)
+        self._stale_causes: Dict[Tuple, str] = {}
         # register with the model so _invalidate_compiled (set_dtype,
         # topology mutation) reaches EVERY engine serving it — including
         # ones built directly or via ParallelWrapper.serving_engine, not
@@ -217,6 +267,20 @@ class InferenceEngine:
         return jitted.lower(params_avals, state_avals,
                             tuple(xs_avals), tuple(masks_avals))
 
+    @staticmethod
+    def _bucket_label(key: Tuple) -> str:
+        return str([s for s, _ in key[0]])
+
+    def _hit_cell(self, key: Tuple):
+        """Bound ``serving.engine.bucket_hits`` cell for one compiled key
+        (created on first use, cleared with ``_compiled``). Call under
+        ``self._lock``."""
+        cell = self._hit_cells.get(key)
+        if cell is None:
+            cell = self._hit_cells[key] = _M_BUCKET_HITS.labeled(
+                engine=self._id, bucket=self._bucket_label(key))
+        return cell
+
     def _get_compiled(self, xs_avals, masks_avals, _warmup=False):
         fp = self._params_placement()[0]
         key = self._key_of(xs_avals, masks_avals, fp)
@@ -224,14 +288,37 @@ class InferenceEngine:
             exe = self._compiled.get(key)
             if exe is not None:
                 if not _warmup:
-                    self.hits += 1
-                    self.bucket_hits[key] = self.bucket_hits.get(key, 0) + 1
+                    self._m_hits.inc()
+                    self._hit_cell(key).inc()
                 return exe
+            # retrace tracker (ISSUE 6): attribute this lower+compile.
+            # Priority: an armed invalidation cause (dtype_policy /
+            # workspace_mode / ... — consumed once), else warmup, else a
+            # known bucket shape re-compiling under a different params
+            # placement, else a genuinely new bucket.
+            aval_key = key[:2]
+            stale = self._stale_causes.pop(aval_key, None)
+            if stale is not None:
+                cause = stale
+                # the invalidation is now attributed; a later never-seen
+                # shape is a genuine new_bucket, not this invalidation
+                self._invalidate_cause = None
+            elif self._invalidate_cause is not None:
+                cause, self._invalidate_cause = self._invalidate_cause, None
+            elif _warmup:
+                cause = "warmup"
+            elif aval_key in self._known_avals:
+                cause = "params_placement"
+            else:
+                cause = "new_bucket"
+            self._known_avals.add(aval_key)
             exe = self._lower_bucket(xs_avals, masks_avals).compile()
             self._compiled[key] = exe
-            self.compiles += 1
+            self._m_compiles.inc()
+            _tel.record_compile("serving.engine", cause, engine=self._id,
+                                bucket=self._bucket_label(key))
             if not _warmup:
-                self.bucket_hits[key] = self.bucket_hits.get(key, 0) + 1
+                self._hit_cell(key).inc()
             return exe
 
     def _bucket_avals(self, b: int, t: Optional[int]):
@@ -324,6 +411,12 @@ class InferenceEngine:
                     # bytes_limit
                     compiled = self._lower_bucket(
                         xs_avals, masks_avals).compile()
+                    # probes never enter the executable cache or serving
+                    # counters, but the retrace tracker still sees every
+                    # lower+compile so XLA compile time stays explainable
+                    _tel.record_compile("serving.engine", "probe",
+                                        engine=self._id,
+                                        bucket=f"[{b}]", seq=t)
                 cm = _memory.compiled_memory(compiled)
                 if cm is None:
                     return None
@@ -372,9 +465,11 @@ class InferenceEngine:
         n = xs[0].shape[0]
         dt = _dt.resolve(self.model.conf.dtype)
         b = next_bucket(n, self.min_bucket)
-        with self._lock:  # the engine is shared across serving threads
-            self.calls += 1
-            self.padded_rows += b - n
+        self._m_calls.inc()
+        if b != n:
+            self._m_padded.inc(b - n)
+        tel = _tel.enabled()
+        t0 = time.perf_counter() if tel else 0.0
         xs_p, masks = [], []
         seq_lens = []
         for x, is_seq in zip(xs, seq_flags):
@@ -410,7 +505,14 @@ class InferenceEngine:
         xs_avals = [jax.ShapeDtypeStruct(x.shape, x.dtype) for x in xs_p]
         masks_avals = [None if m is None else
                        jax.ShapeDtypeStruct(m.shape, m.dtype) for m in masks]
+        if tel:  # request-lifecycle phases: pad -> execute -> unpad.
+            # pad ends BEFORE the executable lookup: a cold-bucket AOT
+            # compile must read as a compile event, not as seconds of
+            # "host padding" in this histogram
+            self._h_pad.observe(time.perf_counter() - t0)
         exe = self._get_compiled(xs_avals, masks_avals)
+        if tel:
+            t1 = time.perf_counter()
         params, state = self._place_params()
         if self.mesh is not None:
             xs_sh, masks_sh = self._shardings(xs_avals, masks_avals)
@@ -418,7 +520,14 @@ class InferenceEngine:
             masks = [None if m is None else jax.device_put(m, s)
                      for m, s in zip(masks, masks_sh)]
         outs = exe(params, state, tuple(xs_p), tuple(masks))
+        if tel:
+            t2 = time.perf_counter()
+            # np.asarray below syncs anyway; the execute phase measures
+            # placement + dispatch (the transfer sync lands in unpad)
+            self._h_exec.observe(t2 - t1)
         res = [self._unpad(np.asarray(o), n, seq_lens) for o in outs]
+        if tel:
+            self._h_unpad.observe(time.perf_counter() - t2)
         return res if self._is_graph and len(res) > 1 else res[0]
 
     def _unpad(self, out, n, seq_lens):
@@ -461,27 +570,66 @@ class InferenceEngine:
         return self._placed
 
     # ---------------------------------------------------------------- admin
-    def invalidate(self):
-        """Drop every compiled executable (model topology/dtype changed)."""
+    def invalidate(self, cause: str = "invalidate"):
+        """Drop every compiled executable (model topology/dtype changed).
+        ``cause`` (``dtype_policy`` / ``workspace_mode`` / ``init`` …)
+        arms the retrace tracker: the rebuild of EVERY bucket that was
+        warmed at invalidation time — and the next compile even for a
+        never-seen shape — is attributed to this invalidation instead of
+        reading as a mystery ``new_bucket``."""
         with self._lock:
             self._compiled.clear()
+            self._hit_cells.clear()
             self._placed = None
             self._placed_params_src = None
             self._placement = None
             self._placement_src = None
+            self._invalidate_cause = cause
+            # refresh EVERY pending stale entry too: a bucket invalidated
+            # twice before its rebuild is attributed to the most recent
+            # mutation, not the first one
+            for ak in list(self._stale_causes) + list(self._known_avals):
+                self._stale_causes[ak] = cause
+            self._known_avals.clear()
             self._input_shapes = self._model_input_shapes()
             self._seq_input = [len(s) == 2 for s in self._input_shapes] \
                 if self._input_shapes is not None else None
 
+    # legacy counter attributes — views over the registry cells so every
+    # pre-ISSUE-6 caller (tests, bench, ui listeners) keeps working
+    @property
+    def calls(self) -> int:
+        return int(self._m_calls.value())
+
+    @property
+    def hits(self) -> int:
+        return int(self._m_hits.value())
+
+    @property
+    def compiles(self) -> int:
+        return int(self._m_compiles.value())
+
+    @property
+    def padded_rows(self) -> int:
+        return int(self._m_padded.value())
+
+    @property
+    def bucket_hits(self) -> Dict[str, int]:
+        out = {}
+        for k, v in _M_BUCKET_HITS.series().items():
+            labels = dict(k)
+            if labels.get("engine") == self._id:
+                out[labels["bucket"]] = int(v)
+        return out
+
     def stats(self) -> dict:
         with self._lock:
-            return {
-                "calls": self.calls,
-                "hits": self.hits,
-                "compiles": self.compiles,
-                "padded_rows": self.padded_rows,
-                "compiled_buckets": len(self._compiled),
-                "bucket_hits": {
-                    str([s for s, _ in k[0]]): v
-                    for k, v in self.bucket_hits.items()},
-            }
+            buckets = len(self._compiled)
+        return {
+            "calls": self.calls,
+            "hits": self.hits,
+            "compiles": self.compiles,
+            "padded_rows": self.padded_rows,
+            "compiled_buckets": buckets,
+            "bucket_hits": self.bucket_hits,
+        }

@@ -30,7 +30,11 @@ class JsonModelServer:
     200 {"status": "HEALTHY"|"DEGRADED", ...} or 503 when SHEDDING —
     load balancers route away while the queue drains. Degradation errors
     map to real status codes: QueueFull -> 429, DeadlineExceeded -> 504,
-    ShutdownError -> 503 (a generic bad request stays 400)."""
+    ShutdownError -> 503 (a generic bad request stays 400);
+    GET /metrics -> the process-wide MetricsRegistry in Prometheus text
+    exposition (ISSUE 6): serving counters/latency summaries, engine
+    bucket/compile counters, flash-attention dispatch, resilience
+    telemetry, retrace-tracker events — one scrape endpoint for the lot."""
 
     def __init__(self, model, port: int = 0, host: str = "127.0.0.1",
                  mode: str = InferenceMode.BATCHED,
@@ -76,6 +80,17 @@ class JsonModelServer:
                     # serving observability: request latency percentiles,
                     # queue depth, bucket hits / compiles
                     self._send(200, server.inference.stats())
+                elif self.path == "/metrics":
+                    # Prometheus text exposition of the whole registry
+                    from ..runtime import telemetry as _telemetry
+                    body = _telemetry.prometheus_text().encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type",
+                                     "text/plain; version=0.0.4; "
+                                     "charset=utf-8")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
                 else:
                     self._send(404, {"error": "unknown path"})
 

@@ -7,6 +7,12 @@ SURVEY.md §5 "Tracing / profiling"): ``jax.profiler`` captures device-level
 traces (TensorBoard/perfetto xplane format — strictly more detail than the
 reference's op timers, since it sees XLA fusion and HBM transfers).
 PerformanceListener (throughput/MFU) stays in optimize/listeners.py.
+
+Step alignment (ISSUE 6): both nn engines wrap every dispatched train step
+in ``jax.profiler.StepTraceAnnotation("train", step_num=...)`` (via
+``runtime.telemetry.step_annotation``), so the traces this listener
+captures carry step numbers that line up with the registry's
+``train.phase.*`` histograms and the listener pipeline's iteration counts.
 """
 
 from __future__ import annotations
@@ -21,46 +27,166 @@ class ProfilingListener(TrainingListener):
     """Capture a device trace for iterations [start, start+steps).
 
     The trace lands in ``logdir/plugins/profile/...`` — open with
-    TensorBoard's profile plugin or ui.perfetto.dev. One capture per
-    training run (the reference's ProfilingListener wrote one Chrome-trace
-    file per session the same way).
+    TensorBoard's profile plugin or ui.perfetto.dev.
+
+    ``every_n_iterations`` (ISSUE 6 satellite) re-arms the capture: a new
+    window starts every N iterations after the previous one *completes*
+    (each lands in its own timestamped subdir, as ``jax.profiler`` does
+    per ``start_trace``), so a multi-hour run gets periodic traces
+    instead of one from warmup. Default None keeps the historical
+    one-capture-per-run contract.
+
+    Leak fix (same satellite): a capture window left open when training
+    ends no longer dangles until interpreter exit — ``on_epoch_end``
+    closes an active window when ``stop_on_epoch_end`` (default True),
+    and ``stop()`` stays registered via atexit for non-epoch exits.
+    NOTE the behavior change this implies: with the default, a window
+    that would have spanned an epoch boundary is truncated there (a
+    warning is logged with the captured step count). Pass
+    ``stop_on_epoch_end=False`` to restore the pre-ISSUE-6
+    window-spans-epochs behavior, accepting that an abandoned run leaks
+    the window until atexit.
     """
 
-    def __init__(self, logdir: str, start_iteration: int = 3, steps: int = 3):
+    def __init__(self, logdir: str, start_iteration: int = 3, steps: int = 3,
+                 every_n_iterations: Optional[int] = None,
+                 stop_on_epoch_end: bool = True):
         self.logdir = logdir
         self.start = int(start_iteration)
         self.steps = int(steps)
+        self.every_n = None if every_n_iterations is None \
+            else max(1, int(every_n_iterations))
+        self.stop_on_epoch_end = bool(stop_on_epoch_end)
+        self.captures = 0            # completed (full-length) windows
+        self.truncated_captures = 0  # windows closed early (epoch/train end)
         self._active = False
         self._done = False
+        self._rearmed = False      # one retry for a truncated one-shot
+        self._next_start = self.start
+        self._stop_at = None
+        self._window_start = None  # iteration the active window opened at
+        self._last_iteration = 0
+        self._atexit_registered = False
+        self._atexit_close = None
 
     def iteration_done(self, model, iteration, epoch):
         import jax
 
+        self._last_iteration = iteration
         if self._done:
             return
-        if not self._active and iteration >= self.start:
+        if not self._active and iteration >= self._next_start:
             os.makedirs(self.logdir, exist_ok=True)
             jax.profiler.start_trace(self.logdir)
             self._active = True
-            import atexit
-            atexit.register(self.stop)  # never leave a trace open
+            if not self._atexit_registered:
+                import atexit
+                import weakref
+
+                # weakly, so the atexit hook never pins the listener:
+                # a churned listener stays collectable (its __del__
+                # closes any open window), while one alive at exit still
+                # gets its trace closed
+                ref = weakref.ref(self)
+
+                def _close_at_exit():
+                    lst = ref()
+                    if lst is not None:
+                        lst.stop()
+
+                atexit.register(_close_at_exit)
+                self._atexit_registered = True
+                self._atexit_close = _close_at_exit
             self._stop_at = iteration + self.steps
+            self._window_start = iteration
             return
         if self._active and iteration >= self._stop_at:
-            # the global iteration counter runs THROUGH epoch boundaries, so
-            # a capture window may span epochs — only the step count ends it
-            jax.block_until_ready(jax.tree.leaves(model.params))
-            jax.profiler.stop_trace()
-            self._active = False
-            self._done = True
+            # a capture window may span epochs (the global iteration
+            # counter runs through them) — only the step count ends it;
+            # stop() classifies it as full (got >= steps here) and
+            # handles the one-shot latch / every_n re-arm
+            self._sync(model)
+            self.stop()
+
+    def on_epoch_end(self, model):
+        """Close an active window at an epoch boundary (training commonly
+        *ends* at one — the pre-ISSUE-6 leak left the trace open until
+        interpreter exit, corrupting the capture)."""
+        if self.stop_on_epoch_end and self._active:
+            # drain async-dispatched steps before closing, same as the
+            # in-loop close — else the epoch's last steps are cut out of
+            # the very capture this close path exists to salvage
+            self._sync(model)
+            got = self.stop()  # stop() classifies full vs truncated
+            truncated = got is not None and got < self.steps
+            if truncated:
+                import logging
+                logging.getLogger("deeplearning4j_tpu").warning(
+                    "ProfilingListener: capture window truncated at epoch "
+                    "end after %d/%d steps (pass stop_on_epoch_end=False "
+                    "to let windows span epochs)", got, self.steps)
+            if truncated and self.every_n is None and not self._rearmed:
+                # a truncated one-shot hasn't really captured: re-arm for
+                # the next epoch rather than latching _done on a window
+                # that may hold zero steps. ONE retry only — with epochs
+                # shorter than the window every close truncates, and an
+                # unbounded re-arm would turn a one-shot into a
+                # capture-per-epoch loop
+                self._rearmed = True
+                self._done = False
+                self._next_start = self._last_iteration + 1
+
+    @staticmethod
+    def _sync(model):
+        """Drain async-dispatched device work before stop_trace. Models
+        without ``.params`` (SameDiff drives the same listener contract)
+        just close unsynced — a shorter trace, never a crash."""
+        params = getattr(model, "params", None)
+        if params is not None:
+            import jax
+            jax.block_until_ready(jax.tree.leaves(params))
 
     def stop(self):
-        """Finalize an open capture (training ended mid-window)."""
+        """Finalize an open capture (training ended mid-window). The ONE
+        place that classifies a window full vs truncated (``captures`` /
+        ``truncated_captures``); returns the step count the window got,
+        or None when no window was open. With ``every_n_iterations`` the
+        listener re-arms for the next window — scheduled ``every_n``
+        past the last seen iteration, so an epoch-boundary close cannot
+        trigger an immediate back-to-back re-capture; a one-shot
+        listener is done."""
         if self._active:
             import jax
             jax.profiler.stop_trace()
             self._active = False
-            self._done = True
+            if self._atexit_registered:
+                # the hook only needs to outlive an OPEN window; dropping
+                # it here keeps the atexit table bounded under listener
+                # churn (a later window re-registers)
+                import atexit
+                try:
+                    atexit.unregister(self._atexit_close)
+                except Exception:
+                    pass
+                self._atexit_registered = False
+                self._atexit_close = None
+            got = self._last_iteration - (self._window_start or 0)
+            if got >= self.steps:
+                self.captures += 1
+            else:
+                self.truncated_captures += 1
+            if self.every_n is None:
+                self._done = True
+            else:
+                self._next_start = self._last_iteration + self.every_n
+            return got
+        return None
+
+    def __del__(self):
+        try:
+            self.stop()
+        except Exception:
+            pass  # interpreter teardown: jax may already be gone
 
 
 def annotate(name: str):

@@ -438,9 +438,16 @@ def test_serving_engine_max_batch_and_auto_warmup():
             jax.eval_shape(lambda: net.state),
             tuple(xs), tuple(ms)).compile())
     limit = cm["peak_bytes"] + 1
+    from deeplearning4j_tpu.runtime import telemetry as _tel
+    probes_before = _tel.counter("compile.events").value(
+        site="serving.engine", cause="probe")
     assert eng.max_batch(bytes_limit=limit) == 16
     st = eng.stats()
     assert st["compiles"] == 0 and st["compiled_buckets"] == 0
+    # probes bypass serving counters but the retrace tracker still sees
+    # every lower+compile (cause="probe") so compile time stays explainable
+    assert _tel.counter("compile.events").value(
+        site="serving.engine", cause="probe") > probes_before
     eng.warmup(buckets="auto", bytes_limit=limit)
     assert eng.stats()["compiled_buckets"] == 5  # 1,2,4,8,16
     out = eng.output(np.zeros((5, 8), np.float32))
