@@ -90,11 +90,16 @@ def _emit(lines):
     order = sorted(lines, key=lambda d: d.get("metric") ==
                    "resnet50_train_mfu_pct")
     try:
+        from deeplearning4j_tpu.ops import autotune as _autotune
         from deeplearning4j_tpu.runtime import telemetry as _telemetry
         artifact = order + [{
             "metric": "telemetry_registry_snapshot",
             "snapshot": _telemetry.snapshot(compact=True),
             "compile_events": _telemetry.compile_events()[-200:],
+            # ISSUE 7 satellite: the autotune cache behind any kernel
+            # metric is part of the record — a speedup claim without the
+            # blocks that produced it is not reproducible
+            "autotune_cache": _autotune.cache_snapshot(),
         }]
     except Exception:
         artifact = order
@@ -340,6 +345,85 @@ def _bert_memory_autotune(freeze, cfg, base_batch, seqlen,
     return out
 
 
+def _bert_phase_audit(sd, feeds, rounds=5):
+    """Per-phase bf16-vs-f32 attribution (ISSUE 7 satellite): the fit
+    step's three phases — fwd (loss only), fwd+bwd (``value_and_grad``),
+    updater (apply on fixed gradients) — are timed as separate jitted
+    programs per precision config, INTERLEAVED (the only valid comparison
+    on this fair-share chip). bwd is attributed as vg - fwd. The ratios
+    make the headline ``bf16_speedup_vs_f32`` arbitrable: a bf16 loss
+    confined to the updater phase is cast/layout thrash around the f32
+    masters, one confined to fwd is kernel/fusion coverage, etc."""
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.autodiff.samediff import VARIABLE
+
+    train_names = [n for n, v in sd._vars.items() if v.kind == VARIABLE]
+
+    def build(dtype):
+        # both configs run the Environment's default matmul-precision
+        # policy — the audit attributes the headline bf16-vs-DEFAULT-f32
+        # ratio (the true-f32/HIGHEST baseline is the main bench's job)
+        sd.set_dtype(dtype)
+        loss_fn = sd._fit_loss_fn()
+        fwd = jax.jit(loss_fn)
+        vg = jax.jit(lambda tv, ov, fd: jax.value_and_grad(
+            lambda t: loss_fn(t, ov, fd))(tv))
+        updater = sd.updater
+        upd = jax.jit(lambda g, opt, tv: updater.apply(
+            g, opt, tv, jnp.int32(0)))
+        tv = {n: jnp.copy(sd._values[n]) for n in train_names}
+        ov = {n: v for n, v in sd._values.items() if n not in tv}
+        fd = {k: jnp.asarray(v) for k, v in feeds[0].items()}
+        opt = updater.init_state(tv)
+        # warm all three (compile + settle)
+        float(fwd(tv, ov, fd))
+        _, grads = vg(tv, ov, fd)
+        float(jnp.sum(jax.tree.leaves(grads)[0].astype(jnp.float32)))
+        delta, _ = upd(grads, opt, tv)
+        float(jnp.sum(jax.tree.leaves(delta)[0].astype(jnp.float32)))
+
+        def t_fwd():
+            return float(fwd(tv, ov, fd))
+
+        def t_vg():
+            loss, g = vg(tv, ov, fd)
+            return float(loss)
+
+        def t_upd():
+            d_, _ = upd(grads, opt, tv)
+            return float(jnp.sum(jax.tree.leaves(d_)[0]
+                                 .astype(jnp.float32)))
+        return {"fwd": t_fwd, "vg": t_vg, "updater": t_upd}
+
+    configs = {"f32": build("FLOAT"), "bf16": build("BFLOAT16")}
+    times = {c: {p: [] for p in ("fwd", "vg", "updater")} for c in configs}
+    for _ in range(rounds):  # interleaved: contention hits both alike
+        for c, runners in configs.items():
+            for p, fn in runners.items():
+                t0 = time.perf_counter()
+                fn()  # each runner forces its own host readback
+                times[c][p].append(time.perf_counter() - t0)
+    out = {}
+    best = {c: {p: min(v) for p, v in ph.items()}
+            for c, ph in times.items()}
+    for c in configs:
+        out[f"{c}_fwd_ms"] = round(best[c]["fwd"] * 1e3, 3)
+        out[f"{c}_bwd_ms_attributed"] = round(
+            (best[c]["vg"] - best[c]["fwd"]) * 1e3, 3)
+        out[f"{c}_updater_ms"] = round(best[c]["updater"] * 1e3, 3)
+    out["bf16_vs_f32"] = {
+        "fwd": round(best["f32"]["fwd"] / best["bf16"]["fwd"], 3),
+        "bwd": round(
+            max(best["f32"]["vg"] - best["f32"]["fwd"], 1e-9)
+            / max(best["bf16"]["vg"] - best["bf16"]["fwd"], 1e-9), 3),
+        "updater": round(best["f32"]["updater"]
+                         / best["bf16"]["updater"], 3),
+    }
+    return out
+
+
 def bench_bert():
     """Second driver-visible metric (round-4): BERT-base fine-tune
     throughput through the TF-import path (BASELINE.md row 4 — 'trains;
@@ -378,6 +462,7 @@ def bench_bert():
     import jax.numpy as jnp
 
     from deeplearning4j_tpu import environment as _envmod
+    from deeplearning4j_tpu.ops import autotune as at
     from deeplearning4j_tpu.ops import flash_attention as fa
 
     batch, seqlen = 32, 128
@@ -386,6 +471,18 @@ def bench_bert():
     gd, iname, oname = freeze(batch, seqlen)
     rng = np.random.default_rng(0)
     sd, fusion_report = _bert_sd(gd, iname, oname, cfg, rng)
+
+    # ISSUE 7: warm the block-shape autotune cache for the fused attention
+    # sites' shapes BEFORE any timed chain — on TPU the sweeps compile
+    # here (cause="autotune" in the retrace tracker) and the timed window
+    # then traces the SWEPT blocks with zero further compiles; on CPU this
+    # seeds the target-128 defaults (no sweeps — the tier-1 guard)
+    head_d = cfg.hidden_size // cfg.num_attention_heads
+    try:
+        at.warmup([(seqlen, seqlen, head_d, jnp.bfloat16, True),
+                   (seqlen, seqlen, head_d, jnp.float32, True)])
+    except Exception:
+        pass  # an autotune failure must never take the headline down
 
     nsteps = 4  # distinct batches per chain link
     feeds = []
@@ -467,6 +564,14 @@ def bench_bert():
     # of the headline timing configs only
     dispatch_counters = fa.counters()
 
+    # per-phase bf16-vs-f32 attribution (ISSUE 7 satellite): fresh jitted
+    # fwd / fwd+bwd / updater programs, interleaved — makes the headline
+    # ratio arbitrable by phase in the artifact
+    try:
+        phase_audit = _bert_phase_audit(sd, feeds)
+    except Exception as e:
+        phase_audit = {"error": f"{type(e).__name__}: {e}"[:300]}
+
     # tentpole: workspace-mode memory accounting + max-batch autotune,
     # then measured throughput at the autotuned batch with remat on
     try:
@@ -544,6 +649,15 @@ def bench_bert():
         # renamed from r5's bf16_speedup_vs_f32: this baseline ALREADY runs
         # single-pass bf16 MXU matmuls, so ~1.0 is expected, not noise
         "bf16_speedup_vs_default_f32": round(dt32 / dt, 3),
+        # ISSUE 7 acceptance headline, restored under its original name and
+        # held to the HARDER baseline (default-f32 matmuls are already
+        # bf16 MXU passes — any win here is pure storage/cast efficiency,
+        # which is exactly what the r12 audit fixes target); the per-phase
+        # attribution lives in phase_audit/bf16_phase_ratios
+        "bf16_speedup_vs_f32": round(dt32 / dt, 3),
+        "bf16_phase_ratios": phase_audit.get("bf16_vs_f32"),
+        "phase_audit": phase_audit,
+        "autotune_counters": at.counters(),
         "true_f32_examples_per_sec": round(batch / dt32h, 1),
         "true_f32_step_time_ms": round(dt32h * 1e3, 2),
         "true_f32_precision": "fp32 storage; matmul precision forced "
@@ -607,9 +721,9 @@ def _sharded_update_measure():
     y = np.eye(d, dtype=np.float32)[rng.integers(0, d, batch)]
     ds = DataSet(x, y)
 
-    def run(shard):
+    def run(shard, overlap=False):
         net = build()
-        pw = ParallelWrapper(net, shard_update=shard)
+        pw = ParallelWrapper(net, shard_update=shard, overlap_grads=overlap)
         pw.fit(ds, epochs=2)      # compile + settle
         float(net.score())        # force (block_until_ready unreliable here)
         # 4 chains of 5 steps: min keeps the least-contended estimate (the
@@ -626,8 +740,18 @@ def _sharded_update_measure():
     bytes_r = _opt_bytes_per_device(net_r.updater_state)
     net_s, dt_s, steps_s = run(True)
     bytes_s = _opt_bytes_per_device(net_s.updater_state)
+    # ISSUE 7: collective/compute overlap A/B for the sharded update —
+    # same arithmetic (bit-equivalence tested), per-bucket early
+    # reduce-scatter + issue-order chaining vs the plain GSPMD placement
+    net_o, dt_o, steps_o = run(True, overlap=True)
+    from deeplearning4j_tpu.runtime import telemetry as _telemetry
+    # per-model labeled cells: the overlap run's count is the max across
+    # the gauge's series (the other runs' cells read 0)
+    n_buckets = int(max(_telemetry.registry.get(
+        "parallel.overlap.buckets").series().values() or [0]))
     p50_r, p99_r = _percentiles([t * 1e3 for t in steps_r])
     p50_s, p99_s = _percentiles([t * 1e3 for t in steps_s])
+    p50_o, p99_o = _percentiles([t * 1e3 for t in steps_o])
 
     return {
         "metric": "sharded_update",
@@ -645,6 +769,15 @@ def _sharded_update_measure():
         "step_time_p50_ms_sharded": round(p50_s, 2),
         "step_time_p99_ms_sharded": round(p99_s, 2),
         "sharded_step_speedup": round(dt_r / dt_s, 3),
+        # overlap-on-vs-off for the sharded update (ISSUE 7 acceptance):
+        # > 1.0 = the bucketed early-scatter path is faster; on the CPU
+        # virtual mesh the collectives are memcpys and ~1.0 is expected —
+        # the field exists so the real-chip driver run measures it
+        "step_time_ms_sharded_overlap": round(dt_o * 1e3, 2),
+        "step_time_p50_ms_sharded_overlap": round(p50_o, 2),
+        "step_time_p99_ms_sharded_overlap": round(p99_o, 2),
+        "overlap_step_ratio": round(dt_s / dt_o, 3),
+        "overlap_buckets": n_buckets,
         "batch": batch,
     }
 
@@ -697,11 +830,14 @@ def bench_flash_attention():
     import jax
     import jax.numpy as jnp
 
+    from deeplearning4j_tpu.ops import autotune as at
     from deeplearning4j_tpu.ops import flash_attention as fa
+    from deeplearning4j_tpu.runtime import telemetry as tel
 
     rng = np.random.default_rng(0)
     on_tpu = jax.default_backend() == "tpu"
     fa.reset_counters()
+    at.reset_counters()
 
     def qkv(B, H, T, d, dtype):
         mk = lambda: jnp.asarray(
@@ -736,6 +872,11 @@ def bench_flash_attention():
             "grad_max_abs_diff": float(jnp.max(jnp.abs(gf - gr))),
             "parity_shape": [B, H, T, d],
             "dispatch_counters": fa.counters(),
+            # CPU runs seed target-128 defaults and NEVER sweep (the
+            # tier-1 guard contract); the autotuned speedup column is a
+            # real-chip quantity
+            "autotuned_speedup_vs_default": None,
+            "autotune_counters": at.counters(),
         }
 
     B, H, d = 4, 12, 64
@@ -783,8 +924,40 @@ def bench_flash_attention():
                      "einsum_ms_p99": round(r99, 3),
                      "speedup": round(min(t_r) / min(t_f), 3)})
 
-    # dispatch sanity on the layer entry point (counters in the artifact)
-    q, k, v, bias = qkv(B, H, 1024, d, dtype)
+    # ---- block-shape autotune A/B (ISSUE 7 tentpole): sweep the headline
+    # shape, then time the swept blocks against the classic 128-target
+    # defaults — the sweep compiles are attributed cause="autotune" in the
+    # retrace tracker, and the timed window after it must be compile-free
+    # (the warm-cache steady-state acceptance criterion)
+    T_at = 1024
+    entry = at.sweep(T_at, T_at, d, dtype, True)
+    tuned_bq, tuned_bk = entry["blocks"]
+    q, k, v, bias = qkv(B, H, T_at, d, dtype)
+
+    def blocked(bq, bk, bias_):
+        def loss(q_, k_, v_):
+            return jnp.sum(fa.flash_attention(
+                q_, k_, v_, bias_, block_q=bq,
+                block_k=bk).astype(jnp.float32))
+        g = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+
+        def run(q_, k_, v_):
+            gs = g(q_, k_, v_)
+            return float(jnp.sum(gs[0].astype(jnp.float32)))
+        return run
+
+    tuned_fn = blocked(tuned_bq, tuned_bk, bias)
+    default_fn = blocked(128, 128, bias)
+    tuned_fn(q, k, v)    # compile before the zero-compile window
+    default_fn(q, k, v)
+    compiles_before = tel.registry.get("compile.events").total()
+    t_tuned = time_fn(tuned_fn, q, k, v)
+    t_default = time_fn(default_fn, q, k, v)
+    post_warmup_compiles = \
+        tel.registry.get("compile.events").total() - compiles_before
+
+    # dispatch sanity on the layer entry point (counters in the artifact) —
+    # the warm cache now routes the dispatcher through the SWEPT blocks
     fa.attention(q, k, v, bias)
     by_seq = {r["seq"]: r["speedup"] for r in rows}
     return {
@@ -795,6 +968,13 @@ def bench_flash_attention():
                  "custom-VJP flash kernel vs f32-softmax einsum",
         "sweep": rows,
         "speedup_at_2048": by_seq.get(2048),
+        "autotuned_blocks": [tuned_bq, tuned_bk],
+        "autotuned_step_ms_min": round(min(t_tuned) * 1e3, 3),
+        "default_step_ms_min": round(min(t_default) * 1e3, 3),
+        "autotuned_speedup_vs_default": round(min(t_default)
+                                              / min(t_tuned), 3),
+        "autotune_counters": at.counters(),
+        "post_warmup_compile_events": int(post_warmup_compiles),
         "dispatch_counters": fa.counters(),
     }
 

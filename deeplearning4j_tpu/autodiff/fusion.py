@@ -16,7 +16,10 @@ flash_attention.py``) — the tiled Pallas flash kernel on TPU, the
 f32-softmax einsum reference elsewhere — so the imported model gets the
 kernel without touching importer code. The scale and the optional mask-add
 may appear in either order (HF TFBert divides then adds; other exports
-flip it); both are optional.
+flip it); both are optional. The scale may also live UPSTREAM of the
+scores mmul as a scalar div/mul of q (the PyTorch->ONNX export shape,
+``q/sqrt(d) @ k^T`` — r12): it is absorbed into the fused op's scale, so
+the q-sized elementwise op leaves the graph too.
 
 Safety rules (a site is skipped, and counted unmatched, unless ALL hold):
 - every intermediate (scores / scaled / masked / probs) has exactly ONE
@@ -155,6 +158,28 @@ def _match_site(sd, producers, consumers, soft_idx):
         return None, "scores mmul transpose flags are not (False, True)"
     chain.append(scores)
 
+    # pre-scaled query (r12 coverage gap): PyTorch->ONNX and some TF
+    # exports scale q BEFORE the scores mmul (q/sqrt(d) @ k^T) instead of
+    # scaling the scores. Absorb a single-consumer scalar div/mul feeding
+    # the mmul's LEFT input into the fused op's scale — without this the
+    # site still fused but left the q-sized elementwise op (a full
+    # [B,H,T,d] HBM round-trip) in the graph.
+    q_name = scores.inputs[0]
+    if scale == 1.0:
+        qrec = producers.get(q_name)
+        if qrec is not None and qrec.op in ("math.mul", "math.div") \
+                and len(qrec.outputs) == 1 and consumers[q_name] == 1:
+            a, b = qrec.inputs
+            c = _scalar_const(sd, b)
+            if c is None and qrec.op == "math.mul":
+                c = _scalar_const(sd, a)
+                if c is not None:
+                    a = b
+            if c is not None:
+                scale = c if qrec.op == "math.mul" else 1.0 / c
+                q_name = a
+                chain.append(qrec)
+
     # single-consumer + not-the-loss safety net over every intermediate
     for rec in chain:
         out = rec.output
@@ -169,9 +194,9 @@ def _match_site(sd, producers, consumers, soft_idx):
     if len(ctx.outputs) != 1:
         return None, "context mmul is not single-output"
     return {
-        "remove": chain,           # softmax, [add], [scale], scores mmul
-        "ctx": ctx,
-        "q": scores.inputs[0], "k": scores.inputs[1], "v": ctx.inputs[1],
+        "remove": chain,       # softmax, [add], [scale], scores mmul,
+        "ctx": ctx,            # [pre-scale of q]
+        "q": q_name, "k": scores.inputs[1], "v": ctx.inputs[1],
         "bias": bias_name, "scale": float(scale), "out": ctx.output,
     }, None
 

@@ -456,6 +456,16 @@ class ComputationGraph(_caches.CompiledCacheMixin):
                 total = total + 0.5 * l2 * jnp.sum(jnp.square(w))
         return total
 
+    def _uses_regularization(self) -> bool:
+        """Any l1/l2 penalty configured? Gates the mixed-precision cast
+        hoist in ``_build_train_step`` (see MultiLayerNetwork's twin)."""
+        if self.conf.l1 or self.conf.l2:
+            return True
+        return any((getattr(v.layer, "l1", 0.0) or
+                    getattr(v.layer, "l2", 0.0))
+                   for _, v, _ in self.conf.vertices
+                   if isinstance(v, LayerVertex))
+
     def _clip(self, grads):
         """Gradient normalization/clipping; returns ``(grads, clip_events)``
         — the shared ``gradnorm.clip_with_events`` pipeline (the sentinel
@@ -521,14 +531,19 @@ class ComputationGraph(_caches.CompiledCacheMixin):
         return loss_fn
 
     def _build_train_step(self, accum_steps: int = 1,
-                          sentinel_guard: bool = True):
+                          sentinel_guard: bool = True, grad_transform=None):
         """Fused pure train step; ``accum_steps=k`` scans the gradient over
         k microbatches before the single updater application (same contract
         as ``MultiLayerNetwork._build_train_step`` — see
         ``nn/microbatch.py``). The conf's ``workspace_mode`` remat policy
         (``nn/memory.py``) composes with both. ``sentinel_guard=False``
         compiles out the divergence sentinel (A/B baseline for bench.py's
-        ``resilience`` metric)."""
+        ``resilience`` metric). ``grad_transform`` and the r12 mixed-
+        precision cast hoist follow the MultiLayerNetwork twin's contract
+        (see its docstring): the transform is value-identity scheduling
+        structure applied BEFORE clip/sentinel; the hoist casts fp32
+        masters to the compute dtype once per step instead of once per
+        microbatch (bit-equivalent, gated on no l1/l2)."""
         updater = self.conf.updater
         from .layers.wrappers import FrozenLayer
         from .vertices import LayerVertex
@@ -537,6 +552,10 @@ class ComputationGraph(_caches.CompiledCacheMixin):
             n for n, v, _ in self.conf.vertices
             if isinstance(v, LayerVertex) and isinstance(v.layer, FrozenLayer))
         vg_fn = jax.value_and_grad(self._build_loss_fn(), has_aux=True)
+        cast_hoist = (accum_steps > 1 and _dt.is_mixed(self.conf.dtype)
+                      and not self._uses_regularization())
+        cdt = _dt.resolve(self.conf.dtype)
+        pdt = _dt.param_dtype(self.conf.dtype)
         from ..runtime import sentinel as _sent
 
         def step_fn(params, opt_state, bn_state, step, key, xs, ys, fms, lms,
@@ -545,10 +564,16 @@ class ComputationGraph(_caches.CompiledCacheMixin):
                 (loss, new_bn), grads = vg_fn(
                     params, bn_state, key, xs, ys, fms, lms)
             else:
+                vg_params = _dt.cast_floating(params, cdt) if cast_hoist \
+                    else params
                 (loss, new_bn), grads = _micro.accumulate_gradients(
-                    vg_fn, params, bn_state, key, accum_steps,
+                    vg_fn, vg_params, bn_state, key, accum_steps,
                     (xs, ys, fms, lms),
                     weight_fn=_micro.multi_output_weight)
+                if cast_hoist:
+                    grads = _dt.cast_floating(grads, pdt)
+            if grad_transform is not None:
+                grads = grad_transform(grads)
             grads, clip_events = self._clip(grads)
 
             def _apply(params, opt_state):

@@ -84,7 +84,19 @@ def accumulate_gradients(value_and_grad_fn, params, bn_state, key, k: int,
     weighted mean; an all-masked microbatch (weight 0) contributes nothing.
     """
     micro = split_microbatches(batch, k)
-    zeros = jax.tree.map(jnp.zeros_like, params)
+
+    def _acc_zero(a):
+        # gradient accumulation always carries full mantissa: a 16-bit
+        # params tree (the engines' hoisted mixed-precision path casts
+        # masters to the compute dtype BEFORE the scan) still accumulates
+        # its per-microbatch grads into an f32 accumulator — the bf16/f16
+        # grads promote exactly on add, reproducing what the per-microbatch
+        # cast-backward produced when the cast lived inside the scan
+        if a.dtype in (jnp.bfloat16, jnp.float16):
+            return jnp.zeros(a.shape, jnp.float32)
+        return jnp.zeros_like(a)
+
+    zeros = jax.tree.map(_acc_zero, params)
 
     def body(carry, xs):
         g_acc, l_acc, w_acc, bn = carry

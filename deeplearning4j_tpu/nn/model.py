@@ -293,8 +293,19 @@ class MultiLayerNetwork(_caches.CompiledCacheMixin):
 
         return loss_fn
 
+    def _uses_regularization(self) -> bool:
+        """Any l1/l2 penalty configured (conf-level or per-layer)? Gates
+        the mixed-precision cast hoist in ``_build_train_step`` — the
+        regularization term reads the params the loss fn is handed, so the
+        hoist (which hands it compute-dtype copies) only applies when the
+        term is identically zero."""
+        if self.conf.l1 or self.conf.l2:
+            return True
+        return any((getattr(l, "l1", 0.0) or getattr(l, "l2", 0.0))
+                   for l in self.layers)
+
     def _build_train_step(self, accum_steps: int = 1,
-                          sentinel_guard: bool = True):
+                          sentinel_guard: bool = True, grad_transform=None):
         """Fused pure train step. ``accum_steps=k`` splits the batch into k
         microbatches and accumulates the mean gradient via ``lax.scan``
         before the SINGLE updater application (see ``nn/microbatch.py`` for
@@ -308,7 +319,23 @@ class MultiLayerNetwork(_caches.CompiledCacheMixin):
         sentinel's finite-check/cond (the pre-ISSUE-5 program) — the A/B
         baseline bench.py's ``resilience`` metric measures the sentinel's
         steady-state overhead against; fit() always builds the guarded
-        step."""
+        step.
+
+        ``grad_transform`` (value-identity, e.g. the collective-overlap
+        sharding pins from ``parallel/overlap.py``) is applied to the raw
+        gradients BEFORE clipping/sentinel — the earliest point the full
+        tree exists, so a sharding constraint there moves the gradient
+        collectives ahead of the global-norm joins.
+
+        bf16 audit fix (r12): under a 16-bit dtype policy with
+        ``accum_steps>1`` and no l1/l2 term, the fp32-master -> compute-
+        dtype cast is HOISTED out of the microbatch scan — the masters are
+        cast once per step and the scan body's ``cast_floating`` becomes an
+        identity, instead of re-materializing a compute-dtype copy of every
+        parameter k times per step. Gradients come back in the compute
+        dtype and promote exactly into the f32 scan accumulator (the same
+        values the per-microbatch cast-backward produced), then cast to the
+        master dtype before clipping — bit-equivalent (tested)."""
         updater = self.conf.updater
         from .layers.wrappers import FrozenLayer
         from . import microbatch as _micro
@@ -316,6 +343,10 @@ class MultiLayerNetwork(_caches.CompiledCacheMixin):
         frozen_keys = frozenset(str(i) for i, l in enumerate(self.layers)
                                 if isinstance(l, FrozenLayer))
         vg_fn = jax.value_and_grad(self._build_loss_fn(), has_aux=True)
+        cast_hoist = (accum_steps > 1 and _dt.is_mixed(self.conf.dtype)
+                      and not self._uses_regularization())
+        cdt = _dt.resolve(self.conf.dtype)
+        pdt = _dt.param_dtype(self.conf.dtype)
 
         def step_fn(params, opt_state, bn_state, step, key, x, y, fmask,
                     lmask, sentinel=None):
@@ -323,11 +354,17 @@ class MultiLayerNetwork(_caches.CompiledCacheMixin):
                 (loss, new_bn), grads = vg_fn(
                     params, bn_state, key, x, y, fmask, lmask)
             else:
+                vg_params = _dt.cast_floating(params, cdt) if cast_hoist \
+                    else params
                 (loss, new_bn), grads = _micro.accumulate_gradients(
-                    vg_fn, params, bn_state, key, accum_steps,
+                    vg_fn, vg_params, bn_state, key, accum_steps,
                     (x, y, fmask, lmask),
                     weight_fn=lambda x, y, fm, lm:
                         _micro.label_count_weight(lm))
+                if cast_hoist:
+                    grads = _dt.cast_floating(grads, pdt)
+            if grad_transform is not None:
+                grads = grad_transform(grads)
             grads, clip_events = self._clip(grads)
 
             def _apply(params, opt_state):

@@ -374,13 +374,18 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
 # --------------------------------------------------------------------------
 
 def pick_block(t: int, target: int = 128) -> Optional[int]:
-    """Largest power-of-two block <= target that tiles ``t`` (>= 8 so the
-    sublane dimension stays layout-friendly); None when nothing tiles."""
-    b = target
+    """Largest block <= target that divides ``t`` and is a multiple of 8
+    (layout-friendly sublanes); None when nothing tiles.
+
+    r12: any multiple-of-8 divisor qualifies, not only power-of-two tiles —
+    odd sequence lengths like 24, 120 or 384 now tile (fewer dispatcher
+    ``fallback_shape`` exits) instead of demanding a power-of-two factor."""
+    b = min(int(target), int(t))
+    b -= b % 8
     while b >= 8:
         if t % b == 0:
             return b
-        b //= 2
+        b -= 8
     return None
 
 
@@ -416,7 +421,8 @@ def _key_bias(bias, batch, tk):
 
 
 def flash_attention(q, k, v, bias=None, scale: Optional[float] = None, *,
-                    block_q: int = 128, block_k: int = 128,
+                    block_q: Optional[int] = None,
+                    block_k: Optional[int] = None,
                     interpret: bool = False):
     """Fused flash attention: softmax((q.k^T)*scale + bias) @ v.
 
@@ -425,6 +431,13 @@ def flash_attention(q, k, v, bias=None, scale: Optional[float] = None, *,
     full per-query bias falls outside this kernel; use the dispatcher,
     which falls back). Raises ValueError on non-tiling shapes — callers
     go through :func:`attention` for guarded dispatch.
+
+    ``block_q``/``block_k``: explicit TARGET tile sizes (the largest
+    divisor block <= target is used, the pre-r12 contract). The default
+    ``None`` consults the block-shape autotuner (``ops/autotune.py``):
+    swept blocks when the cache is warm for this (Tq, Tk, d, dtype, bias)
+    key, else the classic 128-target defaults (seeded, never swept, when
+    the operands are tracers or the backend is not TPU).
     """
     if q.ndim != 4 or k.ndim != 4 or v.ndim != 4:
         raise ValueError(f"flash_attention wants [B,H,T,d]; got {q.shape}")
@@ -433,11 +446,23 @@ def flash_attention(q, k, v, bias=None, scale: Optional[float] = None, *,
     if k.shape != (B, H, Tk, d) or v.shape != (B, H, Tk, d):
         raise ValueError(f"q/k/v shapes disagree: {q.shape} {k.shape} "
                          f"{v.shape}")
-    bq = pick_block(Tq, block_q)
-    bk = pick_block(Tk, block_k)
+    if block_q is None and block_k is None:
+        from . import autotune as _autotune
+        tuned = _autotune.get_blocks(
+            Tq, Tk, d, q.dtype, bias is not None,
+            concrete=not isinstance(q, jax.core.Tracer))
+        bq, bk = tuned if tuned is not None else (None, None)
+        # belt over the autotuner's own validation: blocks that do not
+        # tile would silently truncate the grid (Tq // bq); a poisoned
+        # entry falls back to the target-128 defaults, never garbage
+        if bq is not None and (Tq % bq or Tk % bk):
+            bq, bk = pick_block(Tq), pick_block(Tk)
+    else:
+        bq = pick_block(Tq, block_q or 128)
+        bk = pick_block(Tk, block_k or 128)
     if bq is None or bk is None:
         raise ValueError(f"sequence lengths ({Tq}, {Tk}) do not tile into "
-                         f"({block_q}, {block_k}) blocks")
+                         f"({block_q or 128}, {block_k or 128}) blocks")
     if not fits_vmem_attention(bq, bk, d, np.dtype(q.dtype).itemsize):
         raise ValueError(f"attention tiles exceed the VMEM budget "
                          f"(bq={bq}, bk={bk}, d={d})")

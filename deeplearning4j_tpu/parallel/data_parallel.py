@@ -96,11 +96,25 @@ class ParallelWrapper:
     so a ragged tail whose padding lands unevenly across microbatches
     (even entire all-pad microbatches) still reproduces the unpadded step
     exactly (tested).
+
+    ``overlap_grads=True`` (requires ``shard_update=True``): gradient
+    leaves are bucketed by size in reverse layer order and each bucket is
+    pinned to the ZeRO-1 update sharding at gradient-production time
+    (``parallel/overlap.py``) — the reduce-scatter of early (deep-layer)
+    buckets is issued while backward compute of earlier layers is still in
+    flight, instead of all collectives waiting behind the clip/sentinel
+    global-norm joins at the updater boundary. Pure scheduling structure
+    (sharding constraints + ordering barriers): bit-equivalent to the
+    unoverlapped path, composes with ``accum_steps`` and ``model_axis``
+    (tested). ``overlap_bucket_mb`` caps the per-bucket payload (default
+    4 MiB — the DDP bucketing sweet-spot neighborhood).
     """
 
     def __init__(self, model, mesh: Optional[Mesh] = None,
                  model_axis: Optional[str] = None,
-                 shard_update: bool = False, accum_steps: int = 1):
+                 shard_update: bool = False, accum_steps: int = 1,
+                 overlap_grads: bool = False,
+                 overlap_bucket_mb: float = None):
         # model: MultiLayerNetwork or ComputationGraph (duck-typed: both
         # expose params/updater_state/state/_build_train_step with the same
         # pytree layout; only the batch-argument arity differs)
@@ -137,10 +151,45 @@ class ParallelWrapper:
                 raise ValueError(
                     f"shard_update requires an elementwise updater; "
                     f"{type(upd).__name__} is not")
+        from . import overlap as _overlap
+        if overlap_grads and not self.shard_update:
+            # the collectives the overlap chunks/pipelines ARE the ZeRO-1
+            # reduce-scatter/all-gather; the replicated path's one grad
+            # all-reduce has no per-bucket shard layout to pin
+            raise ValueError("overlap_grads=True requires shard_update=True "
+                             "(it pipelines the ZeRO-1 collectives)")
+        self.overlap_grads = bool(overlap_grads)
+        self.overlap_bucket_bytes = int(
+            (overlap_bucket_mb or _overlap.DEFAULT_BUCKET_MB) * (1 << 20))
+        self._pending_step_cause = None
         self._step = None
         self._dense_key_cache = None
         from ..nn.graph import ComputationGraph
         self._is_graph = isinstance(model, ComputationGraph)
+
+    def set_overlap(self, on: bool, bucket_mb: Optional[float] = None
+                    ) -> "ParallelWrapper":
+        """Toggle the gradient-collective overlap (``parallel/overlap.py``)
+        in place. The bucketing/sharding pins are baked into the compiled
+        step, so a change drops the cached step and the rebuild is
+        attributed ``cause="overlap"`` in the retrace tracker."""
+        on = bool(on)
+        if on and not self.shard_update:
+            raise ValueError("overlap_grads=True requires shard_update=True")
+        changed = on != self.overlap_grads
+        if bucket_mb is not None:
+            nb = int(float(bucket_mb) * (1 << 20))
+            if nb != self.overlap_bucket_bytes:
+                self.overlap_bucket_bytes = nb
+                # the bucket size is only baked into OVERLAP steps — a
+                # change while overlap stays off must not retrace the
+                # (bucket-free) program
+                changed = changed or on
+        self.overlap_grads = on
+        if changed and self._step is not None:
+            self._step = None
+            self._pending_step_cause = "overlap"
+        return self
 
     def _dense_keys(self) -> set:
         """Top-level param keys (layer index / vertex name) whose layer is
@@ -260,7 +309,29 @@ class ParallelWrapper:
         # layout, the m/v/delta arithmetic runs on each device's 1/N
         # share, and the params pin forces the all-gather of the fresh
         # weights — no hand-written collectives anywhere.
-        pure = self.model._build_train_step(self.accum_steps).__wrapped__
+        # overlap_grads (ISSUE 7): bucket the gradient leaves (reverse
+        # layer order, size-capped) and pin each bucket to the ZeRO-1
+        # update sharding AT GRAD TIME — GSPMD then emits per-bucket
+        # reduce-scatters before the clip/sentinel global-norm joins, where
+        # the latency-hiding scheduler can run them under the remaining
+        # backward compute. Value-identity: bit-equivalent to overlap off.
+        grad_transform = None
+        from . import overlap as _overlap
+        n_buckets = 0
+        if self.overlap_grads:
+            buckets = _overlap.make_buckets(self.model.params,
+                                            self.overlap_bucket_bytes)
+            grad_transform = _overlap.overlap_transform(
+                buckets, self._update_shardings(self.model.params))
+            n_buckets = len(buckets)
+        # per-model labeled cell (anti-blending rule; 0 = overlap off for
+        # THIS wrapper's current step) — the model's telemetry_label
+        # finalizer discards it with the rest of the model= cells
+        _overlap.BUCKETS_GAUGE.labeled(
+            model=getattr(self.model, "telemetry_label",
+                          type(self.model).__name__)).set(n_buckets)
+        pure = self.model._build_train_step(
+            self.accum_steps, grad_transform=grad_transform).__wrapped__
         from jax.tree_util import tree_structure
         from ..runtime import sentinel as _sent
         _, _, p_sh, upd_sh, opt_sh, bn_sh, p_struct = self._sharding_trees()
@@ -398,10 +469,13 @@ class ParallelWrapper:
         if self._step is None:
             self._step = self._build()
             from ..runtime import telemetry as _tel
-            cause = m._consume_retrace_cause() \
-                if hasattr(m, "_consume_retrace_cause") else "first_build"
+            cause = self._pending_step_cause or (
+                m._consume_retrace_cause()
+                if hasattr(m, "_consume_retrace_cause") else "first_build")
+            self._pending_step_cause = None
             _tel.record_compile("parallel.step", cause,
-                                shard_update=self.shard_update)
+                                shard_update=self.shard_update,
+                                overlap=self.overlap_grads)
         step_fn, shard_args = self._step
         for _ in range(epochs):
             for batch in self._batches(data):

@@ -1,0 +1,133 @@
+"""Gradient-bucket collective overlap for the ZeRO-1 sharded update.
+
+``ParallelWrapper(shard_update=True)`` lets GSPMD place the gradient
+reduce-scatter wherever the partitioner likes along the grad -> clip ->
+sentinel -> updater chain — in practice at the updater boundary, AFTER the
+global grad-norm joins (clip + divergence sentinel each reduce over the
+WHOLE gradient tree), i.e. after every gradient of every layer exists.
+Nothing can overlap with a collective that is not issued until the backward
+pass is completely done. The TensorFlow system design (PAPERS.md,
+1605.08695) names the fix: issue communication as its inputs become ready
+and let the scheduler run it under the remaining compute.
+
+This module restructures the step's dataflow to make that legal:
+
+- **Bucketing** (:func:`make_buckets`): parameter leaves are grouped into
+  size-capped buckets in REVERSE layer order — backward produces the LAST
+  layer's gradients first, so the first bucket's collective can be issued
+  while earlier layers' backward compute is still in flight. Size capping
+  keeps each chunk big enough to amortize collective launch overhead and
+  small enough to pipeline (the DDP/DeepSpeed bucketing recipe).
+- **Early scatter** (:func:`overlap_transform`): each bucket's gradient
+  leaves are pinned to the ZeRO-1 update sharding with
+  ``with_sharding_constraint`` at gradient-production time — GSPMD then
+  emits the reduce-scatter THERE, before the global-norm joins (which it
+  rewrites to reduce over the shards), instead of at the updater boundary.
+- **Issue-order chaining**: consecutive buckets are threaded through
+  ``lax.optimization_barrier`` so bucket *i*'s scatter is scheduled before
+  bucket *i+1*'s — collectives drain the ICI link in gradient-availability
+  order instead of racing, while compute (never passed through a barrier)
+  flows freely around them. The XLA latency-hiding scheduler
+  (``environment.engine_compiler_options``) does the actual overlap.
+
+Everything here is scheduling structure: sharding constraints and barriers
+are value-identity, so ``overlap_grads=True`` is bit-equivalent to the
+unoverlapped path (tested, including ``accum_steps`` and tensor-parallel
+``model_axis`` composition).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+import jax
+import numpy as np
+
+from ..runtime import telemetry as _tel
+
+#: default bucket size cap — the DDP sweet spot neighborhood; override per
+#: wrapper with ``overlap_bucket_mb=``
+DEFAULT_BUCKET_MB = 4.0
+
+#: gradient buckets baked into a wrapper's compiled step, labeled
+#: ``model=<id>`` (the wrapper's model's telemetry label — same
+#: anti-blending rule as the engine/pi/model cells, cleaned by the same
+#: weakref finalizer); 0 = that wrapper's current step runs overlap-free.
+#: Written by ``ParallelWrapper._build``, not here — the transform itself
+#: is a pure function.
+BUCKETS_GAUGE = _tel.gauge(
+    "parallel.overlap.buckets",
+    "gradient buckets in a ParallelWrapper's compiled step, by model= "
+    "label (0 = that wrapper's step runs overlap-free)")
+
+
+def _flatten_paths(tree) -> List[Tuple[Tuple[str, ...], object]]:
+    """[(path, leaf)] with the same stringified path names the wrapper's
+    sharding trees use, in the pytree's own (layer/topo) order."""
+    from jax.tree_util import tree_flatten_with_path
+    flat, _ = tree_flatten_with_path(tree)
+    return [(tuple(str(getattr(k, "key", k)) for k in path), leaf)
+            for path, leaf in flat]
+
+
+def make_buckets(params, bucket_bytes: int) -> List[List[Tuple[str, ...]]]:
+    """Partition the parameter-leaf paths into size-capped buckets in
+    reverse top-level (layer/vertex) order. Every leaf lands in exactly one
+    bucket; a leaf bigger than the cap gets its own bucket."""
+    if bucket_bytes <= 0:
+        raise ValueError(f"bucket_bytes must be positive, got {bucket_bytes}")
+    flat = _flatten_paths(params)
+    # group by top-level key, preserving the dict's construction order
+    # (layer index for MultiLayerNetwork, topo order for ComputationGraph)
+    groups: Dict[str, List] = {}
+    for path, leaf in flat:
+        groups.setdefault(path[0] if path else "", []).append((path, leaf))
+    buckets: List[List[Tuple[str, ...]]] = []
+    cur: List[Tuple[str, ...]] = []
+    cur_bytes = 0
+    for key in reversed(list(groups)):
+        for path, leaf in groups[key]:
+            nbytes = int(np.prod(leaf.shape)) * np.dtype(leaf.dtype).itemsize
+            if cur and cur_bytes + nbytes > bucket_bytes:
+                buckets.append(cur)
+                cur, cur_bytes = [], 0
+            cur.append(path)
+            cur_bytes += nbytes
+    if cur:
+        buckets.append(cur)
+    return buckets
+
+
+def overlap_transform(buckets: List[List[Tuple[str, ...]]],
+                      shardings) -> "callable":
+    """The ``grad_transform`` the engines apply right after gradient
+    production (BEFORE clip/sentinel): per bucket, pin every leaf to its
+    ZeRO-1 update sharding (forcing the reduce-scatter at grad time), and
+    chain consecutive buckets through ``optimization_barrier`` so the
+    collectives issue in bucket order. Values pass through untouched."""
+    shard_by_path = dict(_flatten_paths(shardings))
+
+    def transform(grads):
+        flat = dict(_flatten_paths(grads))
+        prev: List[Tuple[str, ...]] = []
+        for bucket in buckets:
+            vals = [flat[p] for p in bucket]
+            if prev:
+                sealed = jax.lax.optimization_barrier(
+                    tuple(flat[p] for p in prev) + tuple(vals))
+                for p, v in zip(prev, sealed[:len(prev)]):
+                    flat[p] = v
+                vals = list(sealed[len(prev):])
+            for p, v in zip(bucket, vals):
+                sh = shard_by_path.get(p)
+                flat[p] = v if sh is None else \
+                    jax.lax.with_sharding_constraint(v, sh)
+            prev = bucket
+        # rebuild the tree in the original structure
+        from jax.tree_util import tree_flatten_with_path, tree_unflatten
+        paths_leaves, treedef = tree_flatten_with_path(grads)
+        keys = [tuple(str(getattr(k, "key", k)) for k in path)
+                for path, _ in paths_leaves]
+        return tree_unflatten(treedef, [flat[k] for k in keys])
+
+    return transform

@@ -313,6 +313,59 @@ def test_fusion_pass_rewrites_imported_chain(rng):
     assert np.isfinite(h.losses).all()
 
 
+def test_fusion_pass_prescaled_query_chain(rng):
+    """Coverage-gap regression (r12): the PyTorch->ONNX export shape
+    scales q BEFORE the scores mmul (q/sqrt(d) @ k^T). The pre-scale is
+    absorbed into the fused op's scale and its q-sized elementwise op
+    leaves the graph; outputs unchanged. A fan-out on the scaled q keeps
+    the pre-scale un-absorbed (site still fuses with scale=1)."""
+    from deeplearning4j_tpu.autodiff import SameDiff, fuse_attention
+
+    B, H, T, d = 2, 2, 16, 8
+    feeds = {n: rng.normal(size=(B, H, T, d)).astype(np.float32)
+             for n in "qkv"}
+
+    sd = SameDiff()
+    q, k, v = (sd.placeholder(n) for n in "qkv")
+    dk = sd.constant("dk", np.float32(np.sqrt(d)))
+    q_scaled = sd.call("math.div", q, dk, name="q_scaled")
+    scores = sd.call("linalg.mmul", q_scaled, k, name="scores",
+                     attrs={"transpose_b": True})
+    probs = sd.call("act.softmax", scores, name="probs")
+    sd.call("linalg.mmul", probs, v, name="ctx")
+    before = sd.output(feeds, ["ctx"])["ctx"]
+    rep = fuse_attention(sd)
+    assert rep.matched == 1 and rep.unmatched == 0
+    assert "q_scaled" not in sd._vars  # the pre-scale op is gone
+    fused = [r for r in sd._ops if r.op == "attention.fused_sdpa"]
+    assert len(fused) == 1
+    assert fused[0].attrs["scale"] == pytest.approx(1.0 / np.sqrt(d))
+    assert fused[0].inputs[0] == "q"   # raw q feeds the fused op
+    np.testing.assert_allclose(sd.output(feeds, ["ctx"])["ctx"], before,
+                               atol=1e-5)
+
+    # fan-out on the scaled q: the pre-scale must stay (it has another
+    # consumer), the site fuses with scale 1.0 over the scaled input
+    sd = SameDiff()
+    q, k, v = (sd.placeholder(n) for n in "qkv")
+    dk = sd.constant("dk", np.float32(np.sqrt(d)))
+    q_scaled = sd.call("math.div", q, dk, name="q_scaled")
+    scores = sd.call("linalg.mmul", q_scaled, k, name="scores",
+                     attrs={"transpose_b": True})
+    probs = sd.call("act.softmax", scores, name="probs")
+    sd.call("linalg.mmul", probs, v, name="ctx")
+    sd.call("reduce.sum", q_scaled, name="aux")  # second consumer
+    before = sd.output(feeds, ["ctx"])["ctx"]
+    rep = fuse_attention(sd)
+    assert rep.matched == 1
+    assert "q_scaled" in sd._vars
+    fused = [r for r in sd._ops if r.op == "attention.fused_sdpa"]
+    assert fused[0].attrs["scale"] == 1.0
+    assert fused[0].inputs[0] == "q_scaled"
+    np.testing.assert_allclose(sd.output(feeds, ["ctx"])["ctx"], before,
+                               atol=1e-5)
+
+
 def test_fusion_pass_safety_rules(rng):
     """Fan-out on an intermediate, a non-scalar scale, or a missing
     downstream mmul leave the graph UNTOUCHED (counted unmatched where the

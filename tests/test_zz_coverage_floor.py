@@ -79,11 +79,13 @@ def test_telemetry_metric_floor(request):
     ship silently while dashboards flatline."""
     collected = {item.fspath.basename for item in request.session.items}
     # every file whose tests write part of the registered metric set:
-    # telemetry itself, resilience (faults.*/resilience.*), and serving
-    # (shed/deadline/retry/failure counters) — a chunked run missing any
-    # of them would flag metrics that are fine in full-suite runs
+    # telemetry itself, resilience (faults.*/resilience.*), serving
+    # (shed/deadline/retry/failure counters), and autotune/overlap
+    # (flash_attention.autotune, parallel.overlap.buckets) — a chunked run
+    # missing any of them would flag metrics that are fine in full-suite
+    # runs
     needed = {"test_telemetry.py", "test_resilience.py",
-              "test_serving_engine.py"}
+              "test_serving_engine.py", "test_autotune_overlap.py"}
     missing = needed - collected
     if missing:
         pytest.skip(f"chunked run (telemetry-ledger-marking files not "
