@@ -1157,6 +1157,158 @@ def bench_parallel_inference():
     }
 
 
+def bench_generative_serving():
+    """Generative serving metric (ISSUE 8, CPU-capable): autoregressive
+    generation throughput for (a) the NAIVE full-recompute loop — every
+    token re-runs the whole prefix through one jitted forward (the only
+    generation the pre-ISSUE-8 stack could express: O(T^2) attention work
+    per sequence), batched in lockstep and pre-warmed per sequence bucket
+    so the timed window pays zero compiles — versus (b) the KV-cache
+    continuous-batching decode path: ``GenerativeEngine`` prefill once
+    per request + one O(T) decode step per token through
+    ``ContinuousBatcher``. Reports tokens/sec, per-output-token p50/p99,
+    decode dispatch + autotune counters, and the post-warmup compile
+    event count (acceptance: ZERO in the timed window, >= 5x tokens/sec
+    at batch >= 4)."""
+    import jax
+
+    from deeplearning4j_tpu.nn.config import (InputType,
+                                              NeuralNetConfiguration)
+    from deeplearning4j_tpu.nn.layers.attention import SelfAttentionLayer
+    from deeplearning4j_tpu.nn.layers.core import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.model import MultiLayerNetwork
+    from deeplearning4j_tpu.ops import autotune as _autotune
+    from deeplearning4j_tpu.ops import flash_attention as _fa
+    from deeplearning4j_tpu.runtime import telemetry as _tel
+    from deeplearning4j_tpu.serving import ContinuousBatcher
+
+    V, B, gen_tokens, max_cache = 256, 8, 48, 128
+    conf = (NeuralNetConfiguration.builder().seed(0)
+            .input_type(InputType.recurrent(V, 32))
+            .list(SelfAttentionLayer(n_out=V, n_heads=4),
+                  DenseLayer(n_out=512, activation="relu"),
+                  DenseLayer(n_out=V, activation="identity"),
+                  SelfAttentionLayer(n_out=V, n_heads=4),
+                  DenseLayer(n_out=512, activation="relu"),
+                  DenseLayer(n_out=V, activation="identity"),
+                  SelfAttentionLayer(n_out=V, n_heads=4),
+                  OutputLayer(n_out=V, activation="softmax"))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    rng = np.random.default_rng(0)
+    plens = rng.integers(56, 65, B)
+    prompts = [np.eye(V, dtype=np.float32)[rng.integers(0, V, int(p))]
+               for p in plens]
+    total_tokens = B * gen_tokens
+
+    # ---- naive full-recompute generation, lockstep batch, bucketed T
+    full = jax.jit(lambda p, s, x, pl, ln: net._full_context(
+        p, x, s, pl, ln))
+    max_total = int(plens.max()) + gen_tokens
+    buckets = []
+    b = 32
+    while b < max_total * 2:
+        if b >= int(plens.max()):
+            buckets.append(b)
+        if b >= max_total:
+            break
+        b <<= 1
+    for tb in buckets:  # pre-warm every bucket outside the timed window
+        np.asarray(full(net.params, net.state,
+                        np.zeros((B, tb, V), np.float32),
+                        plens, plens))
+    def naive_run():
+        seq = np.zeros((B, buckets[-1], V), np.float32)
+        for i, p in enumerate(prompts):
+            seq[i, :len(p)] = p
+        lengths = plens.copy()
+        step_times = []
+        t0 = time.perf_counter()
+        for _ in range(gen_tokens):
+            tb = next(x for x in buckets if x >= int(lengths.max()))
+            ts = time.perf_counter()
+            y = np.asarray(full(net.params, net.state, seq[:, :tb],
+                                plens, lengths))
+            step_times.append(time.perf_counter() - ts)
+            toks = np.argmax(y[np.arange(B), lengths - 1], axis=-1)
+            seq[np.arange(B), lengths] = np.eye(V, dtype=np.float32)[toks]
+            lengths = lengths + 1
+        return time.perf_counter() - t0, step_times
+
+
+
+    # ---- KV-cache continuous batching (dispatch decisions are counted
+    # at TRACE time, so the counters reset BEFORE warmup compiles)
+    _fa.reset_counters()
+    ev0_probe = int(_tel.registry.get("compile.events").total())
+    cb = ContinuousBatcher(net, slots=B, max_cache_len=max_cache,
+                           min_cache_len=max_cache,
+                           max_new_tokens=gen_tokens)
+    warm_compiles = cb.engine.compiles
+    ev0 = int(_tel.registry.get("compile.events").total())
+
+    def cb_run():
+        t0 = time.perf_counter()
+        handles = [cb.submit(prompt=prompts[i]) for i in range(B)]
+        for h in handles:
+            h.result(timeout=600)
+        return time.perf_counter() - t0
+
+    # INTERLEAVED pairs, median-of-ratios headline: this container's CPU
+    # throughput drifts ~1.5x across minutes (the telemetry bench
+    # measured 0.94-1.07 NULL A/B inside one window), so timing the two
+    # paths in separate windows would randomize the ratio — adjacent
+    # naive/kv-cache runs see the same weather and their ratio is stable
+    pairs = []
+    for _ in range(3):
+        nw, sts = naive_run()
+        cw = cb_run()
+        pairs.append((nw, cw, sts))
+    ratios = sorted(nw / cw for nw, cw, _ in pairs)
+    ratio = ratios[len(ratios) // 2]
+    naive_wall, _, step_times = min(pairs, key=lambda p: p[0])
+    cb_wall = min(cw for _, cw, _ in pairs)
+    naive_p50, naive_p99 = _percentiles(step_times)
+    ev1 = int(_tel.registry.get("compile.events").total())
+    tpot = cb.engine._h_decode.values_list()  # per decode iteration ==
+    #                                            per output token per slot
+    tpot_p50, tpot_p99 = _percentiles(tpot)
+    st = cb.stats()
+    cb.shutdown()
+
+    return {
+        "metric": "generative_serving",
+        "value": round(ratio, 2),
+        "unit": "x_tokens_per_sec_kv_cache_vs_full_recompute",
+        "pair_ratios": [round(r, 2) for r in ratios],
+        "model": f"3x self-attention({V}, 4 heads) + MLP, vocab {V}, "
+                 f"batch {B}, prompts {int(plens.min())}..{int(plens.max())}, "
+                 f"{gen_tokens} tokens/request",
+        "tokens": total_tokens,
+        "naive_tokens_per_sec": round(total_tokens / naive_wall, 1),
+        "kv_cache_tokens_per_sec": round(total_tokens / cb_wall, 1),
+        "naive_step_p50_ms": None if naive_p50 is None
+        else round(naive_p50 * 1e3, 2),
+        "naive_step_p99_ms": None if naive_p99 is None
+        else round(naive_p99 * 1e3, 2),
+        # time-per-output-token: one decode iteration advances every
+        # active slot by one token
+        "tpot_p50_ms": None if tpot_p50 is None
+        else round(tpot_p50 * 1e3, 2),
+        "tpot_p99_ms": None if tpot_p99 is None
+        else round(tpot_p99 * 1e3, 2),
+        "slots": st["slots"],
+        "tokens_generated": st["tokens_generated"],
+        "warmup_compiles": warm_compiles,
+        "warmup_compile_events": int(ev0 - ev0_probe),
+        # acceptance: the timed window pays ZERO compiles
+        "post_warmup_compile_events": int(ev1 - ev0),
+        "decode_dispatch_counters": {
+            k: v for k, v in _fa.counters().items() if v},
+        "autotune_counters": _autotune.counters(),
+    }
+
+
 def bench_resilience():
     """ISSUE 5 metric (CPU-capable): (1) steady-state step-time overhead
     of the divergence sentinel — the guarded step (finite-check +
@@ -1414,6 +1566,14 @@ if __name__ == "__main__":
         lines.append({
             "metric": "workspace_remat", "value": None,
             "unit": "pct_activation_bytes_reduction_every4_vs_none",
+            "error": f"{type(e).__name__}: {e}"[:300]})
+    _emit(lines)
+    try:
+        lines.append(bench_generative_serving())
+    except Exception as e:
+        lines.append({
+            "metric": "generative_serving", "value": None,
+            "unit": "x_tokens_per_sec_kv_cache_vs_full_recompute",
             "error": f"{type(e).__name__}: {e}"[:300]})
     _emit(lines)
     try:

@@ -1,0 +1,201 @@
+"""SameDiff KV-cache decode rewrite (ISSUE 8 tentpole, layer 2b).
+
+``fusion.fuse_attention`` turns an imported transformer's raw attention
+chains into ``attention.fused_sdpa`` sites. This pass is the NEXT rewrite
+in the same style: it clones the graph and swaps every fused site for
+``attention.cached_sdpa`` (``ops/flash_attention.py``) — the one-token
+decode op that appends this step's (k, v) projection into per-site HBM
+cache placeholders and attends the single query over the valid prefix —
+so a SameDiff-imported transformer accepts/returns per-layer
+``(k, v, length)`` cache state without touching importer code:
+
+- **prefill**: runs the ORIGINAL graph once over the (padded) prompt and
+  harvests each fused site's ``k``/``v`` intermediates as extra output
+  targets — the prompt's cache rows come out of the same one-shot flash
+  kernel executable that computes the prompt logits (no separate
+  prefill program to maintain).
+- **decode_step**: runs the REWRITTEN graph on sequence-length-1 feeds;
+  each cached site consumes ``<site>__k_cache`` / ``<site>__v_cache``
+  placeholders plus the shared ``__cache_lengths__`` and emits the
+  updated caches as additional outputs, threading the state functionally
+  through the replay.
+
+Constraints (checked/raised loudly, recorded in PARITY.md):
+
+- the graph must already be fused (run ``fusion.fuse_attention`` first);
+- every non-attention op between input and output must be
+  shape-polymorphic over the sequence axis (dense/layernorm/gelu chains
+  are; hardcoded-T reshapes and positional-embedding adds are not — the
+  importer-shaped head-split reshapes that carry a static T constant
+  need ``-1`` in that position);
+- the fused site's mask bias (if any) is DROPPED in the decode replay:
+  cache validity is governed by ``__cache_lengths__``, which subsumes
+  the prompt key mask.
+
+Semantics match the engine path: prefix-LM (prompt bidirectional over
+itself, generated tokens causal), so N-step decode equals the full-prefix
+recompute within dtype tolerance (parity-tested).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from .samediff import ARRAY, PLACEHOLDER, SameDiff, _OpRecord
+
+#: the shared per-row valid-length placeholder of the decode replay
+LENGTHS = "__cache_lengths__"
+
+
+@dataclasses.dataclass
+class _Site:
+    """One rewritten attention site."""
+    name: str          # the fused op's output name (kept by the rewrite)
+    q: str
+    k: str
+    v: str
+    scale: float
+    k_cache: str       # decode-graph placeholder names
+    v_cache: str
+    k_out: str         # decode-graph cache output names
+    v_out: str
+
+
+class DecodeGraph:
+    """A SameDiff graph pair ready for KV-cached generation.
+
+    ``prefill(feeds, lengths, cache_len)`` -> ``(out, caches)`` and
+    ``decode_step(feeds, caches, lengths)`` -> ``(out, caches')`` —
+    caches are ``{site: {"k": [B,H,C,d], "v": [B,H,C,d]}}`` numpy
+    arrays, the same (k, v, length) threading contract as the layer
+    stack's decode walk."""
+
+    def __init__(self, base: SameDiff, decode: SameDiff,
+                 sites: List[_Site], output: str):
+        self.base = base
+        self.decode = decode
+        self.sites = sites
+        self.output = output
+
+    def site_names(self) -> List[str]:
+        return [s.name for s in self.sites]
+
+    def prefill(self, feeds: Dict, lengths, cache_len: int):
+        """One pass of the ORIGINAL (fused) graph over the prompt feeds;
+        returns ``(out, caches)`` with each site's prompt k/v bucketed
+        into zero-padded ``cache_len`` rows. ``lengths`` [B] true prompt
+        lengths (rows past a row's length carry garbage the decode-side
+        length bias masks)."""
+        targets = [self.output]
+        for s in self.sites:
+            targets += [s.k, s.v]
+        res = self.base.output(feeds, targets)
+        lengths = np.asarray(lengths)
+        caches = {}
+        for s in self.sites:
+            k, v = res[s.k], res[s.v]
+            if k.ndim != 4:
+                raise ValueError(
+                    f"site {s.name!r}: cached decode needs [B,H,T,d] "
+                    f"k/v projections, got {k.shape}")
+            t = k.shape[2]
+            if t > cache_len:
+                raise ValueError(f"prompt length {t} exceeds cache_len "
+                                 f"{cache_len}")
+            pad = [(0, 0), (0, 0), (0, cache_len - t), (0, 0)]
+            caches[s.name] = {"k": np.pad(np.asarray(k), pad),
+                              "v": np.pad(np.asarray(v), pad)}
+        return res[self.output], caches
+
+    def decode_step(self, feeds: Dict, caches: Dict, lengths):
+        """One token through the REWRITTEN graph: ``feeds`` are the
+        sequence-length-1 placeholder feeds; returns
+        ``(out, new_caches)``. The caller advances ``lengths`` by one
+        afterwards (same contract as the layer walk)."""
+        full = dict(feeds)
+        full[LENGTHS] = np.asarray(lengths, np.int32)
+        # overflow guard: cached_sdpa's insert CLAMPS an out-of-range
+        # position (XLA slice semantics) — without this host-side check a
+        # full cache would silently overwrite its last row every step
+        for s in self.sites:
+            c = caches[s.name]["k"].shape[2]
+            if int(np.max(full[LENGTHS])) >= c:
+                raise ValueError(
+                    f"cache full at site {s.name!r} (lengths "
+                    f"{int(np.max(full[LENGTHS]))} >= cache_len {c}): "
+                    "re-bucket by zero-padding the caches along axis 2 "
+                    "before the next decode_step")
+        for s in self.sites:
+            full[s.k_cache] = caches[s.name]["k"]
+            full[s.v_cache] = caches[s.name]["v"]
+        targets = [self.output]
+        for s in self.sites:
+            targets += [s.k_out, s.v_out]
+        res = self.decode.output(full, targets)
+        new_caches = {s.name: {"k": res[s.k_out], "v": res[s.v_out]}
+                      for s in self.sites}
+        return res[self.output], new_caches
+
+    def generate(self, prompt_feeds: Dict, lengths, cache_len: int,
+                 steps: int, next_feeds):
+        """Greedy convenience driver: prefill then ``steps`` decode
+        iterations. ``next_feeds(out, step)`` maps the last step's output
+        to the next one-token feeds dict. Yields each step's output."""
+        out, caches = self.prefill(prompt_feeds, lengths, cache_len)
+        lengths = np.asarray(lengths).copy()
+        for i in range(steps):
+            feeds = next_feeds(out, i)
+            out, caches = self.decode_step(feeds, caches, lengths)
+            lengths = lengths + 1
+            yield out
+
+
+def rewrite_for_decode(sd: SameDiff,
+                       output: Optional[str] = None) -> DecodeGraph:
+    """Build the decode twin of a fused SameDiff graph.
+
+    The original graph is untouched (it stays the prefill program); the
+    clone gets every top-level ``attention.fused_sdpa`` record replaced
+    by ``attention.cached_sdpa`` with per-site cache placeholders and the
+    shared ``__cache_lengths__``. Raises when the graph has no fused
+    sites (run ``fusion.fuse_attention(sd)`` first — this pass rides on
+    its safety checks) or when a site sits inside a control-flow
+    subgraph (not rewritable record-by-record)."""
+    fused_idx = [i for i, r in enumerate(sd._ops)
+                 if r.op == "attention.fused_sdpa"]
+    if not fused_idx:
+        raise ValueError(
+            "graph has no attention.fused_sdpa sites; run "
+            "autodiff.fusion.fuse_attention(sd) before rewrite_for_decode")
+    if output is None:
+        if sd.loss_name:
+            output = sd.loss_name
+        else:
+            raise ValueError("pass output=<variable name> (graph has no "
+                             "loss to default to)")
+    dec = SameDiff.from_json(sd.to_json())
+    dec._values = dict(sd._values)
+    dec._register(LENGTHS, PLACEHOLDER)
+    sites: List[_Site] = []
+    for idx in fused_idx:
+        rec = dec._ops[idx]
+        q, k, v = rec.inputs[:3]   # optional 4th input (mask bias) is
+        #                            dropped: lengths subsume the key mask
+        o = rec.output
+        kc, vc = f"{o}__k_cache", f"{o}__v_cache"
+        ko, vo = f"{o}__k_cache_out", f"{o}__v_cache_out"
+        dec._register(kc, PLACEHOLDER)
+        dec._register(vc, PLACEHOLDER)
+        dec._register(ko, ARRAY)
+        dec._register(vo, ARRAY)
+        scale = float(rec.attrs.get("scale", 1.0))
+        dec._ops[idx] = _OpRecord(
+            "attention.cached_sdpa", [q, k, v, kc, vc, LENGTHS],
+            [o, ko, vo], {"scale": scale})
+        sites.append(_Site(name=o, q=q, k=k, v=v, scale=scale,
+                           k_cache=kc, v_cache=vc, k_out=ko, v_out=vo))
+    dec._fn_cache.clear()
+    return DecodeGraph(sd, dec, sites, output)

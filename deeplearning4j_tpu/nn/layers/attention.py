@@ -53,17 +53,31 @@ def _key_mask(mask):
     return jnp.where(mask[:, None, None, :] > 0, 0.0, neg)
 
 
-def _mha(x_q, x_kv, params, n_heads, mask):
-    def proj(x, w, b=None):
-        y = jnp.dot(x, w, precision=precision_for(x, w))
-        return y if b is None else y + b
+def _proj(x, w, b=None):
+    y = jnp.dot(x, w, precision=precision_for(x, w))
+    return y if b is None else y + b
 
-    q = _heads_split(proj(x_q, params["Wq"], params.get("bq")), n_heads)
-    k = _heads_split(proj(x_kv, params["Wk"], params.get("bk")), n_heads)
-    v = _heads_split(proj(x_kv, params["Wv"], params.get("bv")), n_heads)
+
+def _qkv(x_q, x_kv, params, n_heads):
+    q = _heads_split(_proj(x_q, params["Wq"], params.get("bq")), n_heads)
+    k = _heads_split(_proj(x_kv, params["Wk"], params.get("bk")), n_heads)
+    v = _heads_split(_proj(x_kv, params["Wv"], params.get("bv")), n_heads)
+    return q, k, v
+
+
+def _mha(x_q, x_kv, params, n_heads, mask):
+    q, k, v = _qkv(x_q, x_kv, params, n_heads)
     y = _fa.attention(q, k, v, bias=_key_mask(mask))
-    y = _heads_join(y)
-    return proj(y, params["Wo"], params.get("bo"))
+    return _proj(_heads_join(y), params["Wo"], params.get("bo"))
+
+
+def _kv_cache_spec(params, n_heads, batch, cache_len, dtype):
+    proj = params["Wk"].shape[1]
+    hs = proj // n_heads
+    shp = (batch, n_heads, cache_len, hs)
+    import jax as _jax
+    return {"k": _jax.ShapeDtypeStruct(shp, dtype),
+            "v": _jax.ShapeDtypeStruct(shp, dtype)}
 
 
 @layer("self_attention")
@@ -111,6 +125,46 @@ class SelfAttentionLayer(Layer):
             y = y * mask[..., None]  # masked steps emit zeros (DL4J contract)
         return y, state, mask
 
+    # -- autoregressive decode (KV cache, ISSUE 8) --------------------------
+    # Prefix-LM semantics: the PROMPT attends bidirectionally over itself
+    # (prefill = the existing flash kernel with the prompt key mask —
+    # prompt k/v never see generated tokens, so they cache exactly), and
+    # every generated token attends over everything before it plus itself.
+    # The equivalent one-shot mask is ``prefix_lm_bias`` below; the parity
+    # suite asserts N-step decode == full-prefix recompute under it.
+    def decode_cache_spec(self, params, batch, cache_len, dtype):
+        return _kv_cache_spec(params, self.n_heads, batch, cache_len, dtype)
+
+    def prefill(self, params, x, state, *, cache, lengths, mask=None):
+        q, k, v = _qkv(x, x, params, self.n_heads)
+        y = _fa.attention(q, k, v, bias=_key_mask(mask))
+        y = _proj(_heads_join(y), params["Wo"], params.get("bo"))
+        if mask is not None:
+            y = y * mask[..., None]
+        T = x.shape[1]
+        # bucket-padded prompt rows land in the cache too; the decode-side
+        # length bias masks them, so no per-row slicing is needed here
+        cache = {"k": cache["k"].at[:, :, :T].set(k.astype(cache["k"].dtype)),
+                 "v": cache["v"].at[:, :, :T].set(v.astype(cache["v"].dtype))}
+        return y, cache
+
+    def decode_step(self, params, x, state, *, cache, lengths, write=None):
+        q, k_new, v_new = _qkv(x, x, params, self.n_heads)
+        kc = _fa.cache_insert(cache["k"], k_new, lengths, write)
+        vc = _fa.cache_insert(cache["v"], v_new, lengths, write)
+        y = _fa.decode_dispatch(q, kc, vc, jnp.asarray(lengths) + 1)
+        return _proj(_heads_join(y), params["Wo"], params.get("bo")), \
+            {"k": kc, "v": vc}
+
+    def full_context(self, params, x, state, *, bias, key_bias):
+        """The naive full-recompute path (bench baseline / parity oracle):
+        explicit [B, 1, T, T] additive ``bias`` (prefix-LM mask) through
+        the reference einsum — a per-query bias is not key-reducible, so
+        the dispatcher counts it as ``fallback_bias`` by design."""
+        q, k, v = _qkv(x, x, params, self.n_heads)
+        y = _fa.attention(q, k, v, bias=bias)
+        return _proj(_heads_join(y), params["Wo"], params.get("bo"))
+
 
 @layer("learned_self_attention")
 class LearnedSelfAttentionLayer(Layer):
@@ -147,6 +201,47 @@ class LearnedSelfAttentionLayer(Layer):
         q = jnp.broadcast_to(params["Q"][None], (B,) + params["Q"].shape)
         y = _mha(q, x, params, self.n_heads, mask)
         return y, state, None  # fixed n_queries steps: no time mask anymore
+
+    # -- autoregressive decode: the query bank re-attends over the growing
+    # cache each step (a sequence summarizer refreshed per token). The
+    # learned queries are not sequence positions, so only key VALIDITY
+    # masks apply — never the prefix-LM triangle.
+    def decode_cache_spec(self, params, batch, cache_len, dtype):
+        return _kv_cache_spec(params, self.n_heads, batch, cache_len, dtype)
+
+    def prefill(self, params, x, state, *, cache, lengths, mask=None):
+        B = x.shape[0]
+        xq = jnp.broadcast_to(params["Q"][None], (B,) + params["Q"].shape)
+        q = _heads_split(_proj(xq, params["Wq"]), self.n_heads)
+        k = _heads_split(_proj(x, params["Wk"]), self.n_heads)
+        v = _heads_split(_proj(x, params["Wv"]), self.n_heads)
+        y = _fa.attention(q, k, v, bias=_key_mask(mask))
+        y = _proj(_heads_join(y), params["Wo"])
+        T = x.shape[1]
+        cache = {"k": cache["k"].at[:, :, :T].set(k.astype(cache["k"].dtype)),
+                 "v": cache["v"].at[:, :, :T].set(v.astype(cache["v"].dtype))}
+        return y, cache
+
+    def decode_step(self, params, x, state, *, cache, lengths, write=None):
+        B = x.shape[0]
+        xq = jnp.broadcast_to(params["Q"][None], (B,) + params["Q"].shape)
+        q = _heads_split(_proj(xq, params["Wq"]), self.n_heads)
+        k_new = _heads_split(_proj(x, params["Wk"]), self.n_heads)
+        v_new = _heads_split(_proj(x, params["Wv"]), self.n_heads)
+        kc = _fa.cache_insert(cache["k"], k_new, lengths, write)
+        vc = _fa.cache_insert(cache["v"], v_new, lengths, write)
+        # n_queries > 1 rows: decode_dispatch routes to the reference path
+        y = _fa.decode_dispatch(q, kc, vc, jnp.asarray(lengths) + 1)
+        return _proj(_heads_join(y), params["Wo"]), {"k": kc, "v": vc}
+
+    def full_context(self, params, x, state, *, bias, key_bias):
+        B = x.shape[0]
+        xq = jnp.broadcast_to(params["Q"][None], (B,) + params["Q"].shape)
+        q = _heads_split(_proj(xq, params["Wq"]), self.n_heads)
+        k = _heads_split(_proj(x, params["Wk"]), self.n_heads)
+        v = _heads_split(_proj(x, params["Wv"]), self.n_heads)
+        y = _fa.attention(q, k, v, bias=key_bias)
+        return _proj(_heads_join(y), params["Wo"])
 
 
 @layer("recurrent_attention")

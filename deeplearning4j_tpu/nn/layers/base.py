@@ -46,6 +46,15 @@ class Layer:
     kind = "base"
     name: Optional[str] = None
 
+    #: True when ``apply``'s output at time step t depends ONLY on the
+    #: input at time step t (dense/activation/output heads) — such layers
+    #: run unchanged on a [B, 1, F] slice in the autoregressive decode
+    #: walk. Layers with temporal state either carry a KV cache
+    #: (``decode_cache_spec`` returns a spec) or cannot decode at all
+    #: (recurrent/conv stacks — the walk raises). Conservative default:
+    #: False, so a new layer must opt in explicitly.
+    decode_pointwise = False
+
     @property
     def stochastic(self):
         """Whether ``apply`` consumes the per-layer PRNG key. The engines
@@ -69,6 +78,40 @@ class Layer:
     def apply(self, params, x, state, *, train=False, rng=None, mask=None):
         """-> (y, new_state, out_mask)"""
         raise NotImplementedError
+
+    # -- autoregressive decode protocol (KV-cache serving, ISSUE 8) ---------
+    def decode_cache_spec(self, params, batch, cache_len, dtype):
+        """Per-layer decode cache spec: a dict of
+        ``jax.ShapeDtypeStruct``s (e.g. ``{"k": ..., "v": ...}`` for
+        attention), or None when the layer carries no KV state. Derived
+        from ``params`` so no extra shape plumbing is needed."""
+        return None
+
+    def prefill(self, params, x, state, *, cache, lengths, mask=None):
+        """Prompt-phase forward: fill ``cache`` from the (end-padded,
+        ``lengths``-ragged) prompt ``x`` [B, T, F] and return
+        ``(y, new_cache)``. Default (cache-less layers): plain inference
+        ``apply`` with the prompt key mask."""
+        y, _, _ = self.apply(params, x, state, train=False, rng=None,
+                             mask=mask)
+        return y, cache
+
+    def decode_step(self, params, x, state, *, cache, lengths, write=None):
+        """One-token decode: ``x`` [B, 1, F] is the step's input slice,
+        ``lengths`` [B] the tokens already cached; ``write`` [B]
+        optionally gates which rows' caches this token actually enters
+        (the continuous batcher's inactive slots pass 0). Returns
+        ``(y, new_cache)``. Default: time-pointwise layers re-run
+        ``apply`` on the slice; anything else cannot decode."""
+        if not self.decode_pointwise:
+            raise ValueError(
+                f"layer kind {self.kind!r} cannot run in the "
+                "autoregressive decode walk: it is neither time-pointwise "
+                "nor KV-cached (set decode_pointwise=True or implement "
+                "decode_cache_spec/prefill/decode_step)")
+        y, _, _ = self.apply(params, x, state, train=False, rng=None,
+                             mask=None)
+        return y, cache
 
     # -- shared helpers ------------------------------------------------------
     def has_params(self) -> bool:

@@ -32,6 +32,7 @@ def _split(rng):
 @layer("dense")
 class DenseLayer(Layer):
     """Fully connected layer (DL4J DenseLayer). W:[nIn,nOut] b:[nOut]."""
+    decode_pointwise = True  # y_t depends only on x_t: safe in decode walks
     n_out: int = 0
     n_in: Optional[int] = None  # inferred from input_shape when None
     activation: str = "identity"
@@ -55,6 +56,7 @@ class DenseLayer(Layer):
 
 @layer("activation")
 class ActivationLayer(Layer):
+    decode_pointwise = True
     activation: str = "relu"
     # parameter for parameterized activations (leakyrelu slope, elu alpha,
     # thresholdedrelu theta); None = the activation's own default
@@ -76,6 +78,7 @@ class DropoutLayer(Layer):
     """DL4J DropoutLayer. NOTE: DL4J's config value is the RETAIN probability
     p; ours is the DROP rate (documented divergence — clearer and matches
     every modern framework). Import frontends convert."""
+    decode_pointwise = True  # inference identity
     rate: float = 0.5
     name: Optional[str] = None
 
@@ -220,6 +223,7 @@ class EmbeddingLayer(Layer):
 @layer("elementwise_mult")
 class ElementWiseMultiplicationLayer(Layer):
     """DL4J ElementWiseMultiplicationLayer: y = act(x * w + b), w,b:[nIn]."""
+    decode_pointwise = True
     activation: str = "identity"
     weight_init: str = "ones"
     name: Optional[str] = None
@@ -263,6 +267,7 @@ class _BaseOutput:
 @layer("output")
 class OutputLayer(Layer, _BaseOutput):
     """DenseLayer + loss head (DL4J OutputLayer)."""
+    decode_pointwise = True
     n_out: int = 0
     n_in: Optional[int] = None
     loss: str = "mcxent"
@@ -291,6 +296,7 @@ class OutputLayer(Layer, _BaseOutput):
 @layer("loss")
 class LossLayer(Layer, _BaseOutput):
     """Loss head with no params (DL4J LossLayer)."""
+    decode_pointwise = True
     loss: str = "mse"
     activation: str = "identity"
     loss_weights: Optional[Tuple[float, ...]] = None

@@ -676,6 +676,140 @@ class MultiLayerNetwork(_caches.CompiledCacheMixin):
         # fine, there are at most two patterns
         return jax.jit(step)
 
+    # -------------------------------------- autoregressive decode (ISSUE 8)
+    # Pure prefill / one-token decode walks over the layer stack, threading
+    # per-layer (k, v) KV caches + shared per-row lengths. Semantics:
+    # prefix-LM — the prompt attends bidirectionally over itself (prefill =
+    # ONE pass of the existing flash kernel), every generated token attends
+    # over everything before it plus itself. ``serving.engine
+    # .GenerativeEngine`` AOT-compiles these per (slot x cache-length x
+    # prompt-length) bucket; the parity suite asserts N-step decode ==
+    # :meth:`_full_context` recompute.
+    def _decode_layer_plan(self, params):
+        """(layer, 'cache'|'pointwise') per layer; raises for layers that
+        can do neither — the decode walk must be exact, not best-effort."""
+        plan = []
+        for i, layer in enumerate(self.layers):
+            p = params.get(str(i), {})
+            if layer.decode_cache_spec(p, 1, 8, jnp.float32) is not None:
+                plan.append((layer, "cache"))
+            elif getattr(layer, "decode_pointwise", False):
+                plan.append((layer, "pointwise"))
+            else:
+                raise ValueError(
+                    f"layer {i} ({layer.kind!r}) cannot run in the "
+                    "autoregressive decode walk (neither KV-cached nor "
+                    "time-pointwise)")
+        return plan
+
+    def decode_cache_spec(self, batch: int, cache_len: int) -> dict:
+        """{layer_index: {"k": aval, "v": aval}} for the KV-cached layers
+        (compute dtype — what the decode executables actually hold)."""
+        dt = _dt.resolve(self.conf.dtype)
+        spec = {}
+        for i, layer in enumerate(self.layers):
+            s = layer.decode_cache_spec(self.params.get(str(i), {}),
+                                        batch, cache_len, dt)
+            if s is not None:
+                spec[str(i)] = s
+        if not spec:
+            raise ValueError("model has no KV-cached layers; nothing to "
+                             "decode incrementally")
+        return spec
+
+    def init_decode_cache(self, batch: int, cache_len: int) -> dict:
+        """Zero-initialized decode cache pytree for one slot batch."""
+        return jax.tree.map(lambda a: jnp.zeros(a.shape, a.dtype),
+                            self.decode_cache_spec(batch, cache_len))
+
+    def _decode_cast(self, params, x):
+        dt = _dt.resolve(self.conf.dtype)
+        if jnp.issubdtype(dt, jnp.floating) and \
+                jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating) and \
+                jnp.asarray(x).dtype != dt:
+            x = jnp.asarray(x, dt)
+        if _dt.is_mixed(self.conf.dtype):
+            params = _dt.cast_floating(params, dt)
+        return params, x
+
+    def _prefill(self, params, x, state, caches, lengths):
+        """Prompt phase: ``x`` [B, T, F] end-padded, ``lengths`` [B] true
+        prompt lengths. Fills the per-layer caches (positions [0, T) —
+        rows past a row's length are masked by the decode-side length
+        bias) and returns (y [B, T, out], new_caches)."""
+        params, x = self._decode_cast(params, x)
+        T = x.shape[1]
+        lengths = jnp.asarray(lengths)
+        mask = (jnp.arange(T)[None, :] <
+                lengths[:, None]).astype(jnp.float32)
+        new_caches = {}
+        for i, (layer, kind) in enumerate(self._decode_layer_plan(params)):
+            si = str(i)
+            p = params.get(si, {})
+            s = state.get(si, {})
+            if kind == "cache":
+                x, c = layer.prefill(p, x, s, cache=caches[si],
+                                     lengths=lengths, mask=mask)
+                new_caches[si] = c
+            else:
+                x, _, _ = layer.apply(p, x, s, train=False, rng=None,
+                                      mask=mask)
+        return x, new_caches
+
+    def _decode_step(self, params, x, state, caches, lengths, write=None):
+        """One-token decode: ``x`` [B, 1, F], ``lengths`` [B] = tokens
+        already cached BEFORE this token. Appends this token's k/v at
+        position ``lengths`` (rows with ``write == 0`` keep their caches
+        bit-identical — inactive serving slots) and returns
+        (y [B, 1, out], new_caches). The caller advances ``lengths`` by
+        one afterwards."""
+        params, x = self._decode_cast(params, x)
+        lengths = jnp.asarray(lengths)
+        new_caches = {}
+        for i, (layer, kind) in enumerate(self._decode_layer_plan(params)):
+            si = str(i)
+            p = params.get(si, {})
+            s = state.get(si, {})
+            if kind == "cache":
+                x, c = layer.decode_step(p, x, s, cache=caches[si],
+                                         lengths=lengths, write=write)
+                new_caches[si] = c
+            else:
+                x, c = layer.decode_step(p, x, s, cache=None,
+                                         lengths=lengths)
+        return x, new_caches
+
+    def _full_context(self, params, x, state, prompt_lengths, lengths):
+        """The naive full-recompute oracle (and the bench baseline): one
+        quadratic forward over the whole running sequence under the
+        prefix-LM mask — position j is visible to position i iff
+        ``j < prompt_len`` (bidirectional prompt) or ``j <= i`` (causal
+        generation), and j is within the row's ``lengths``. Equals the
+        incremental prefill+decode path within dtype tolerance."""
+        params, x = self._decode_cast(params, x)
+        T = x.shape[1]
+        prompt_lengths = jnp.asarray(prompt_lengths)
+        lengths = jnp.asarray(lengths)
+        ii = jnp.arange(T)[:, None]
+        jj = jnp.arange(T)[None, :]
+        allowed = ((jj < prompt_lengths[:, None, None]) | (jj <= ii)) \
+            & (jj < lengths[:, None, None])
+        neg = jnp.asarray(jnp.finfo(jnp.float32).min, jnp.float32)
+        bias = jnp.where(allowed[:, None], 0.0, neg)        # [B,1,T,T]
+        key_bias = jnp.where(jnp.arange(T)[None, None, None, :] <
+                             lengths[:, None, None, None], 0.0, neg)
+        for i, (layer, kind) in enumerate(self._decode_layer_plan(params)):
+            si = str(i)
+            p = params.get(si, {})
+            s = state.get(si, {})
+            if kind == "cache":
+                x = layer.full_context(p, x, s, bias=bias,
+                                       key_bias=key_bias)
+            else:
+                x, _, _ = layer.apply(p, x, s, train=False, rng=None,
+                                      mask=None)
+        return x
+
     def score(self, ds: Optional[DataSet] = None) -> float:
         """Loss value; with no argument, the score of the last fit batch.
         Includes the l1/l2 regularization penalty, matching the fit-loop

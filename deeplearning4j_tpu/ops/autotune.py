@@ -95,8 +95,14 @@ def reset_counters() -> None:
 
 
 # ----------------------------------------------------------------- keys
-def cache_key(tq: int, tk: int, d: int, dtype, has_bias: bool) -> tuple:
-    return (int(tq), int(tk), int(d), str(np.dtype(dtype)), bool(has_bias))
+def cache_key(tq: int, tk: int, d: int, dtype, has_bias: bool,
+              decode: bool = False) -> tuple:
+    """``decode=True`` keys the single-query decode kernel's tiling
+    (block_q pinned to 1; only the cache-axis block is tuned) separately
+    from the one-shot kernel — the same (Tq=1, Tk) shape prefers very
+    different schedules when the query side is a single row."""
+    base = (int(tq), int(tk), int(d), str(np.dtype(dtype)), bool(has_bias))
+    return base + ("decode",) if decode else base
 
 
 def axis_blocks(t: int, cap: int = MAX_BLOCK,
@@ -113,23 +119,27 @@ def axis_blocks(t: int, cap: int = MAX_BLOCK,
     return out
 
 
-def candidates(tq: int, tk: int, d: int,
-               itemsize: int = 4) -> List[Tuple[int, int]]:
+def candidates(tq: int, tk: int, d: int, itemsize: int = 4,
+               decode: bool = False) -> List[Tuple[int, int]]:
     """VMEM-feasible (block_q, block_k) candidates for one key — the cross
     product of the per-axis divisor blocks filtered through the kernel's
-    ``fits_vmem_attention`` budget (every candidate is dispatchable)."""
+    ``fits_vmem_attention`` budget (every candidate is dispatchable).
+    Decode keys pin ``block_q = 1`` (the kernel runs one query row) and
+    enumerate only the cache-axis blocks."""
     from . import flash_attention as _fa
     out = []
-    for bq in axis_blocks(tq):
+    q_blocks = [1] if decode else axis_blocks(tq)
+    for bq in q_blocks:
         for bk in axis_blocks(tk):
             if _fa.fits_vmem_attention(bq, bk, d, itemsize):
                 out.append((bq, bk))
     return out
 
 
-def _default_blocks(tq: int, tk: int) -> Optional[Tuple[int, int]]:
+def _default_blocks(tq: int, tk: int,
+                    decode: bool = False) -> Optional[Tuple[int, int]]:
     from . import flash_attention as _fa
-    bq = _fa.pick_block(tq)
+    bq = 1 if decode else _fa.pick_block(tq)
     bk = _fa.pick_block(tk)
     if bq is None or bk is None:
         return None
@@ -155,32 +165,34 @@ def _ensure_loaded() -> None:
             pass  # a corrupt cache file must never block dispatch
 
 
-def lookup(tq, tk, d, dtype, has_bias) -> Optional[dict]:
+def lookup(tq, tk, d, dtype, has_bias,
+           decode: bool = False) -> Optional[dict]:
     """The cache entry for a key, or None (no counter bump)."""
     with _lock:
         _ensure_loaded()
-        e = _cache.get(cache_key(tq, tk, d, dtype, has_bias))
+        e = _cache.get(cache_key(tq, tk, d, dtype, has_bias, decode))
         return dict(e) if e else None
 
 
-def _valid_blocks(blocks, tq, tk, d, dtype) -> bool:
+def _valid_blocks(blocks, tq, tk, d, dtype, decode: bool = False) -> bool:
     """A cache entry's blocks must be usable for ITS key: multiple-of-8
-    divisors within the VMEM budget. Guards against stale/hand-edited disk
-    caches — an invalid pair would silently truncate the kernel grid
-    (``Tq // bq``) and produce wrong attention output."""
+    divisors within the VMEM budget (decode keys: ``block_q`` exactly 1 —
+    the single-row grid). Guards against stale/hand-edited disk caches —
+    an invalid pair would silently truncate the kernel grid (``Tq // bq``)
+    and produce wrong attention output."""
     from . import flash_attention as _fa
     try:
         bq, bk = int(blocks[0]), int(blocks[1])
     except (TypeError, ValueError, IndexError):
         return False
-    return (bq >= 8 and bk >= 8 and bq % 8 == 0 and bk % 8 == 0
-            and tq % bq == 0 and tk % bk == 0
+    q_ok = bq == 1 if decode else (bq >= 8 and bq % 8 == 0 and tq % bq == 0)
+    return (q_ok and bk >= 8 and bk % 8 == 0 and tk % bk == 0
             and _fa.fits_vmem_attention(bq, bk, d,
                                         np.dtype(dtype).itemsize))
 
 
-def get_blocks(tq, tk, d, dtype, has_bias, *,
-               concrete: bool = False) -> Optional[Tuple[int, int]]:
+def get_blocks(tq, tk, d, dtype, has_bias, *, concrete: bool = False,
+               decode: bool = False) -> Optional[Tuple[int, int]]:
     """(block_q, block_k) for one attention shape key.
 
     A SWEPT cache hit returns the stored blocks. A miss (or a
@@ -193,15 +205,15 @@ def get_blocks(tq, tk, d, dtype, has_bias, *,
     mid-trace, so warm the cache first (``warmup``/``sweep``/disk cache)
     to tune traced programs. Returns None when nothing tiles (caller
     falls back). Invalid entries (corrupt/stale disk cache) are dropped,
-    never served."""
-    key = cache_key(tq, tk, d, dtype, has_bias)
+    never served. ``decode=True`` keys the single-query decode kernel."""
+    key = cache_key(tq, tk, d, dtype, has_bias, decode)
     can_sweep = (concrete and _state["mode"] == "auto"
                  and jax.default_backend() == "tpu")
     with _lock:
         _ensure_loaded()
         e = _cache.get(key)
         if e is not None and not _valid_blocks(e.get("blocks"),
-                                               tq, tk, d, dtype):
+                                               tq, tk, d, dtype, decode):
             del _cache[key]
             e = None
         # only a REAL timing sweep is authoritative on TPU: default seeds
@@ -212,9 +224,9 @@ def get_blocks(tq, tk, d, dtype, has_bias, *,
             _EVENTS.inc(event="hit")
             return tuple(e["blocks"])
     if can_sweep:
-        e = sweep(tq, tk, d, dtype, has_bias)
+        e = sweep(tq, tk, d, dtype, has_bias, decode=decode)
         return tuple(e["blocks"]) if e else None
-    default = _default_blocks(tq, tk)
+    default = _default_blocks(tq, tk, decode)
     if default is None:
         return None
     with _lock:
@@ -224,16 +236,27 @@ def get_blocks(tq, tk, d, dtype, has_bias, *,
     return default
 
 
+def _norm_shape(shape) -> tuple:
+    """Normalize a warmup/seed shape spec: 5-tuples are one-shot keys,
+    6-tuples carry a trailing decode flag."""
+    if len(shape) == 5:
+        return tuple(shape) + (False,)
+    tq, tk, d, dtype, has_bias, decode = shape
+    return (tq, tk, d, dtype, has_bias, bool(decode))
+
+
 def seed_defaults(shapes) -> None:
     """Pre-seed target-128 defaults for an iterable of
-    ``(Tq, Tk, head_dim, dtype, has_bias)`` keys (no sweeps — the CPU/CI
-    posture; on TPU use :func:`warmup`)."""
-    for tq, tk, d, dtype, has_bias in shapes:
-        get_blocks(tq, tk, d, dtype, has_bias, concrete=False)
+    ``(Tq, Tk, head_dim, dtype, has_bias[, decode])`` keys (no sweeps —
+    the CPU/CI posture; on TPU use :func:`warmup`)."""
+    for shape in shapes:
+        tq, tk, d, dtype, has_bias, decode = _norm_shape(shape)
+        get_blocks(tq, tk, d, dtype, has_bias, concrete=False,
+                   decode=decode)
 
 
 def warmup(shapes, *, interpret: bool = False) -> dict:
-    """Sweep every unswept key in ``shapes`` (same 5-tuples as
+    """Sweep every unswept key in ``shapes`` (same tuples as
     :func:`seed_defaults`) — the serving-warmup analogue: pay every sweep
     before traffic/timing so steady state stays zero-compile. Keys whose
     cache entry is only a default SEED (e.g. left by an earlier traced
@@ -247,14 +270,17 @@ def warmup(shapes, *, interpret: bool = False) -> dict:
     # "sweep" only for another interpret warmup (its timings tune nothing
     # on a real chip — a TPU warmup re-sweeps it, per sweep()'s contract)
     done_sources = ("sweep", "sweep_interpret") if interpret else ("sweep",)
-    for tq, tk, d, dtype, has_bias in shapes:
-        e = lookup(tq, tk, d, dtype, has_bias)
+    for shape in shapes:
+        tq, tk, d, dtype, has_bias, decode = _norm_shape(shape)
+        e = lookup(tq, tk, d, dtype, has_bias, decode)
         if can_sweep and (e is None or
                           e.get("source") not in done_sources):
-            out[cache_key(tq, tk, d, dtype, has_bias)] = \
-                sweep(tq, tk, d, dtype, has_bias, interpret=interpret)
+            out[cache_key(tq, tk, d, dtype, has_bias, decode)] = \
+                sweep(tq, tk, d, dtype, has_bias, interpret=interpret,
+                      decode=decode)
         else:
-            get_blocks(tq, tk, d, dtype, has_bias, concrete=False)
+            get_blocks(tq, tk, d, dtype, has_bias, concrete=False,
+                       decode=decode)
     return out
 
 
@@ -295,11 +321,14 @@ def load(path: Optional[str] = None, merge: bool = True) -> int:
         if not merge:
             _cache.clear()
         for ent in snap.get("entries", []):
-            key = tuple(ent["key"][:3]) + (str(ent["key"][3]),
-                                           bool(ent["key"][4]))
-            key = (int(key[0]), int(key[1]), int(key[2]), key[3], key[4])
+            raw = ent["key"]
+            key = (int(raw[0]), int(raw[1]), int(raw[2]), str(raw[3]),
+                   bool(raw[4]))
+            decode = len(raw) > 5 and raw[5] == "decode"
+            if decode:
+                key = key + ("decode",)
             if not _valid_blocks(ent.get("blocks"), key[0], key[1],
-                                 key[2], key[3]):
+                                 key[2], key[3], decode):
                 continue  # stale/hand-edited entry: never serve it
             cur = _cache.get(key)
             if cur is not None and cur.get("source") != "default" \
@@ -325,10 +354,11 @@ _SWEEP_GRID_ROWS = 16  # synthetic B*H: enough grid rows to fill the chip's
 
 
 def _time_candidate(tq, tk, d, dtype, has_bias, bq, bk, interpret,
-                    repeats: int) -> float:
+                    repeats: int, decode: bool = False) -> float:
     """Seconds (min over repeats) for one fwd+bwd at (bq, bk) on synthetic
-    operands. The compile is reported to the retrace tracker BEFORE the
-    first call so a hung compile is still visible in compile_events()."""
+    operands — forward-only for ``decode`` keys (decode never trains).
+    The compile is reported to the retrace tracker BEFORE the first call
+    so a hung compile is still visible in compile_events()."""
     from . import flash_attention as _fa
     rng = np.random.default_rng(0)
     heads = 4
@@ -345,13 +375,28 @@ def _time_candidate(tq, tk, d, dtype, has_bias, bq, bk, interpret,
         kb = jnp.where(jnp.asarray(mask) > 0, 0.0,
                        np.float32(np.finfo(np.float32).min))
 
-    def loss(q_, k_, v_):
-        o = _fa._flash(q_, k_, v_, kb, scale, heads, bq, bk, interpret)
-        return jnp.sum(o.astype(jnp.float32))
+    if decode:
+        # the serving decode hot path: single-query forward, ragged cache
+        # occupancy as the key bias (the same program decode_attention runs)
+        lengths = jnp.asarray(
+            rng.integers(max(1, tk // 2), tk + 1, size=(batch,)), jnp.int32)
+        kbd = _fa.length_bias(lengths, tk)
 
-    fn = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+        def fwd(q_, k_, v_):
+            o, _, _ = _fa._fwd_impl(q_, k_, v_, kbd, scale, heads,
+                                    bq, bk, interpret)
+            return (o,)  # tuple like grad's output: run() reads gs[0]
+
+        fn = jax.jit(fwd)
+    else:
+        def loss(q_, k_, v_):
+            o = _fa._flash(q_, k_, v_, kb, scale, heads, bq, bk, interpret)
+            return jnp.sum(o.astype(jnp.float32))
+
+        fn = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
     _tel.record_compile("flash_attention.autotune", "autotune",
-                        blocks=[int(bq), int(bk)], tq=int(tq), tk=int(tk))
+                        blocks=[int(bq), int(bk)], tq=int(tq), tk=int(tk),
+                        decode=bool(decode))
     _EVENTS.inc(event="sweep_candidate")
 
     def run():
@@ -368,25 +413,27 @@ def _time_candidate(tq, tk, d, dtype, has_bias, bq, bk, interpret,
 
 
 def sweep(tq, tk, d, dtype, has_bias, *, interpret: bool = False,
-          repeats: int = 3) -> Optional[dict]:
+          repeats: int = 3, decode: bool = False) -> Optional[dict]:
     """Measure every candidate block shape for one key and cache the
     winner. TPU-only unless ``interpret=True`` (the slow-marked test path:
     exercises the sweep machinery through the Pallas interpreter, whose
     "timings" tune nothing — the entry is tagged so a real chip re-sweeps).
-    Returns the cache entry, or None when nothing tiles."""
+    ``decode=True`` sweeps the single-query decode kernel (forward only,
+    block_q pinned to 1). Returns the cache entry, or None when nothing
+    tiles."""
     if not interpret and jax.default_backend() != "tpu":
         raise RuntimeError(
             "autotune.sweep() timings are only meaningful on TPU; CPU runs "
             "use pre-seeded defaults (pass interpret=True to exercise the "
             "sweep machinery through the Pallas interpreter in tests)")
     itemsize = np.dtype(dtype).itemsize
-    cands = candidates(tq, tk, d, itemsize)
+    cands = candidates(tq, tk, d, itemsize, decode=decode)
     if not cands:
         return None
     timings = []
     for bq, bk in cands:
         dt = _time_candidate(tq, tk, d, dtype, has_bias, bq, bk,
-                             interpret, repeats)
+                             interpret, repeats, decode=decode)
         timings.append({"blocks": [int(bq), int(bk)],
                         "us": round(dt * 1e6, 2)})
     best = min(timings, key=lambda t: t["us"])
@@ -397,7 +444,7 @@ def sweep(tq, tk, d, dtype, has_bias, *, interpret: bool = False,
         "candidates": timings,
         "backend": jax.default_backend(),
     }
-    key = cache_key(tq, tk, d, dtype, has_bias)
+    key = cache_key(tq, tk, d, dtype, has_bias, decode)
     with _lock:
         _cache[key] = entry
     _EVENTS.inc(event="sweep")

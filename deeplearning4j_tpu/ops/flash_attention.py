@@ -479,12 +479,115 @@ def flash_attention(q, k, v, bias=None, scale: Optional[float] = None, *,
 
 
 # --------------------------------------------------------------------------
+# autoregressive decode: one new-token query over a bucketed KV cache
+# --------------------------------------------------------------------------
+
+def length_bias(lengths, cache_len: int):
+    """Per-row valid-length mask in the kernel's key-bias form: ``[B, C]``
+    f32, zero where ``position < length`` and finfo.min elsewhere — exactly
+    the ``kb`` the forward kernel streams, so ragged cache occupancy stays
+    exact without materializing a [B,H,1,C] mask."""
+    lengths = jnp.asarray(lengths)
+    pos = jax.lax.broadcasted_iota(jnp.int32, (1, cache_len), 1)
+    return jnp.where(pos < lengths[:, None].astype(jnp.int32),
+                     jnp.float32(0.0), jnp.float32(_NEG))
+
+
+def reference_decode_attention(q, k, v, lengths, scale=None):
+    """Quadratic reference for single-step decode: ``q`` [B, H, 1, d]
+    attends over the cache [B, H, C, d], positions >= ``lengths[b]``
+    masked out. Shares :func:`reference_attention`'s f32 numerics."""
+    C = k.shape[2]
+    bias = length_bias(lengths, C)[:, None, None, :]
+    return reference_attention(q, k, v, bias=bias, scale=scale)
+
+
+def decode_attention(q, k, v, lengths, scale=None, *,
+                     block_k: Optional[int] = None,
+                     interpret: bool = False):
+    """Fused single-query decode: the flash forward kernel at ``bq=1``
+    (forward only — decode is inference; no VJP needed) streaming the
+    cache in ``block_k`` tiles with the per-row length mask as the key
+    bias. ``q`` [B, H, 1, d]; ``k``/``v`` [B, H, C, d] (the HBM cache at
+    its power-of-two bucket length); ``lengths`` [B] — the number of
+    valid cache entries per row, the just-appended token included.
+
+    ``block_k=None`` consults the autotuner under its ``decode=True``
+    cache key (``ops/autotune.py``); explicit ints keep the target-block
+    semantics. Raises ValueError on non-tiling shapes — serving goes
+    through :func:`decode_dispatch` for guarded dispatch."""
+    if q.ndim != 4 or q.shape[2] != 1:
+        raise ValueError(f"decode_attention wants q [B,H,1,d]; got {q.shape}")
+    B, H, _, d = q.shape
+    C = k.shape[2]
+    if k.shape != (B, H, C, d) or v.shape != (B, H, C, d):
+        raise ValueError(f"q/cache shapes disagree: {q.shape} {k.shape} "
+                         f"{v.shape}")
+    if block_k is None:
+        from . import autotune as _autotune
+        tuned = _autotune.get_blocks(
+            1, C, d, q.dtype, True, decode=True,
+            concrete=not isinstance(q, jax.core.Tracer))
+        bk = tuned[1] if tuned is not None else None
+        if bk is not None and C % bk:
+            bk = pick_block(C)  # belt: a poisoned entry must not truncate
+    else:
+        bk = pick_block(C, block_k)
+    if bk is None:
+        raise ValueError(f"cache length {C} does not tile into decode "
+                         "blocks; bucket the cache to a power of two")
+    if not fits_vmem_attention(1, bk, d, np.dtype(q.dtype).itemsize):
+        raise ValueError(f"decode tiles exceed the VMEM budget "
+                         f"(bk={bk}, d={d})")
+    if scale is None:
+        scale = 1.0 / float(np.sqrt(d))
+    kb = length_bias(lengths, C)
+    o, _, _ = _fwd_impl(q.reshape(B * H, 1, d), k.reshape(B * H, C, d),
+                        v.reshape(B * H, C, d), kb, float(scale), H, 1, bk,
+                        bool(interpret))
+    return o.reshape(B, H, 1, d)
+
+
+def cache_insert(cache, new, lengths, write=None):
+    """Append one token's K or V rows into a bucketed cache: ``cache``
+    [B, H, C, d], ``new`` [B, H, 1, d], written at position ``lengths[b]``
+    per row via a vmapped ``dynamic_update_slice`` — O(B*H*d) bytes
+    touched instead of a one-hot select over the whole cache, and with
+    donated buffers (the serving decode executables) XLA updates the HBM
+    cache in place.
+
+    ``write`` [B] (optional 0/1): rows with ``write == 0`` keep their
+    cache bit-identical — the token's value at the target position is
+    replaced by a gather of what is already there, so a full-cache
+    select is never needed (the continuous batcher's inactive slots).
+    Out-of-range ``lengths`` clamp (XLA slice semantics) and the gathered
+    old value makes the clamped write a no-op, so a freed slot's stale
+    length can never corrupt a neighbour."""
+    lengths = jnp.asarray(lengths).astype(jnp.int32)
+    new = new.astype(cache.dtype)
+    if write is not None:
+        old = jax.vmap(
+            lambda c, l: jax.lax.dynamic_slice(
+                c, (0, l, 0), (c.shape[0], 1, c.shape[2])))(cache, lengths)
+        keep = jnp.asarray(write).astype(bool)[:, None, None, None]
+        new = jnp.where(keep, new, old)
+    return jax.vmap(
+        lambda c, n, l: jax.lax.dynamic_update_slice(c, n, (0, l, 0)))(
+        cache, new, lengths)
+
+
+# --------------------------------------------------------------------------
 # dispatch: mode + counters (zero-silent-fallback observability)
 # --------------------------------------------------------------------------
 
 _COUNTER_KEYS = ("fused", "fallback_mode", "fallback_platform",
                  "fallback_shape", "fallback_bias", "fallback_dtype",
-                 "fallback_vmem")
+                 "fallback_vmem",
+                 # decode decisions ride the same registry counter so the
+                 # serving dispatch mix shows up on the same /metrics family
+                 "decode_fused", "decode_fallback_mode",
+                 "decode_fallback_platform", "decode_fallback_shape",
+                 "decode_fallback_dtype", "decode_fallback_vmem")
 # dispatch decisions live in the process-wide MetricsRegistry (ISSUE 6):
 # one counter, labeled by decision, so `GET /metrics` exposes the
 # fused-vs-fallback mix; counters()/reset_counters() below are the
@@ -570,6 +673,48 @@ def attention(q, k, v, bias=None, scale: Optional[float] = None):
     return reference_attention(q, k, v, bias, scale)
 
 
+def _route_decode(q, k, v) -> Optional[str]:
+    """None = fuse the decode kernel; otherwise the fallback counter key
+    (every key prefixed ``decode_`` so the serving mix is separable from
+    the one-shot dispatch on the same registry counter)."""
+    if _state["mode"] == "off":
+        return "decode_fallback_mode"
+    if _state["mode"] != "force" and not _tpu_available():
+        return "decode_fallback_platform"
+    if q.ndim != 4 or q.shape[2] != 1 or k.shape != v.shape or \
+            q.shape[:2] != k.shape[:2] or q.shape[-1] != k.shape[-1]:
+        return "decode_fallback_shape"
+    if q.dtype not in _FUSABLE_DTYPES:
+        return "decode_fallback_dtype"
+    bk = pick_block(k.shape[2])
+    if bk is None:
+        return "decode_fallback_shape"
+    if not fits_vmem_attention(1, bk, q.shape[-1],
+                               np.dtype(q.dtype).itemsize):
+        return "decode_fallback_vmem"
+    return None
+
+
+def decode_dispatch(q, k, v, lengths, scale=None):
+    """Guarded decode dispatch: the single-query flash kernel when the
+    route is clear, the f32-softmax reference otherwise. The KV-cache
+    layers and the SameDiff ``attention.cached_sdpa`` op both enter here.
+    ``q`` with Tq > 1 (e.g. LearnedSelfAttention's query bank) always
+    takes the reference path — the decode kernel is a single-row grid."""
+    if q.ndim == 4 and q.shape[2] == 1:
+        reason = _route_decode(q, k, v)
+    else:
+        reason = "decode_fallback_shape"
+    if reason is None:
+        _DISPATCH.inc(decision="decode_fused")
+        return decode_attention(q, k, v, lengths, scale,
+                                interpret=not _tpu_available())
+    _DISPATCH.inc(decision=reason)
+    C = k.shape[2]
+    bias = length_bias(lengths, C)[:, None, None, :]
+    return reference_attention(q, k, v, bias=bias, scale=scale)
+
+
 @register("attention.fused_sdpa", category="attention")
 def fused_sdpa(q, k, v, bias=None, scale: float = 1.0):
     """Fused scaled-dot-product attention graph op: the rewrite target of
@@ -579,3 +724,30 @@ def fused_sdpa(q, k, v, bias=None, scale: float = 1.0):
     batch_matmul`` chain it replaces, with the softmax in f32. Dispatches
     to the flash kernel for [B,H,T,d] operands on TPU."""
     return attention(q, k, v, bias=bias, scale=float(scale))
+
+
+@register("attention.cached_sdpa", category="attention",
+          differentiable=False)
+def cached_sdpa(q, k_new, v_new, k_cache, v_cache, lengths,
+                scale: float = 1.0):
+    """KV-cached decode-step attention graph op: the rewrite target of the
+    SameDiff decode pass (``autodiff/decode.py``), replacing an
+    ``attention.fused_sdpa`` site in the one-token decode replay.
+
+    ``q``/``k_new``/``v_new``: this step's projections, [B, H, 1, d];
+    ``k_cache``/``v_cache``: [B, H, C, d] HBM cache at its bucket length;
+    ``lengths``: [B] valid entries per row BEFORE this token. Appends
+    (k_new, v_new) at position ``lengths``, attends the query over the
+    ``lengths + 1`` valid entries, and returns
+    ``(y, k_cache', v_cache')`` so the cache state threads through the
+    graph replay. Inference-only (no VJP — decode never trains).
+
+    The CALLER must keep ``lengths < C``: an out-of-range position
+    clamps (XLA slice semantics) and would overwrite the last cache row
+    — ``autodiff.decode.DecodeGraph.decode_step`` raises host-side when
+    the cache is full, and the serving batcher grows the bucket first."""
+    lengths = jnp.asarray(lengths)
+    kc = cache_insert(k_cache, k_new, lengths)
+    vc = cache_insert(v_cache, v_new, lengths)
+    y = decode_dispatch(q, kc, vc, lengths + 1, scale=float(scale))
+    return y, kc, vc

@@ -121,6 +121,7 @@ SITES = frozenset({
     "data.record",        # reader error on one record/batch (skip-and-log)
     "serving.dispatch",   # transient executor failure (retried once)
     "serving.slow",       # injected dispatch latency (overload -> shedding)
+    "serving.decode",     # continuous-batching decode iteration failure
 })
 
 

@@ -1,9 +1,13 @@
 """Inference serving (SURVEY.md §2.5/§2.6: ParallelInference +
 JsonModelServer, re-expressed for TPU as a bucketed AOT engine plus a
-dynamic micro-batching dispatcher)."""
+dynamic micro-batching dispatcher; ISSUE 8 adds the generative decode
+hot path — KV-cache prefill/decode executables and token-boundary
+continuous batching with streaming)."""
 
 from ..runtime.faults import (DeadlineExceeded, QueueFull,  # noqa: F401
                               ShutdownError)
-from .engine import InferenceEngine, default_buckets, next_bucket  # noqa: F401
-from .batcher import HealthState, InferenceMode, ParallelInference  # noqa: F401
+from .engine import (DecodeState, GenerativeEngine,  # noqa: F401
+                     InferenceEngine, default_buckets, next_bucket)
+from .batcher import (ContinuousBatcher, GenerationHandle,  # noqa: F401
+                      HealthState, InferenceMode, ParallelInference)
 from .server import JsonModelServer  # noqa: F401

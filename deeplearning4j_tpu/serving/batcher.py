@@ -47,7 +47,7 @@ import time
 import weakref
 from collections import deque
 from concurrent.futures import Future, TimeoutError as _FutTimeout
-from typing import List, Optional
+from typing import List, Optional, Sequence
 
 import numpy as np
 
@@ -77,6 +77,12 @@ _H_QUEUE = _tel.histogram("serving.phase.queue_s",
                           "enqueue->dequeue wait per dispatched request")
 _H_COALESCE = _tel.histogram("serving.phase.coalesce_s",
                              "first-dequeue->dispatch linger per batch")
+# continuous-batching decode (ISSUE 8): how many of the warmed slots hold
+# an in-flight generation right now, per front
+_G_SLOTS = _tel.gauge("serving.slots_active",
+                      "occupied decode slots in the continuous batcher")
+_M_TOKENS = _tel.counter("serving.tokens_generated",
+                         "tokens emitted by the continuous batcher")
 _pi_ids = itertools.count()
 
 
@@ -612,3 +618,436 @@ class ParallelInference:
             return self._call_engine(x, lengths=np.asarray(lengths))
         x = np.concatenate([r.x for r in batch], axis=0)
         return self._call_engine(x)
+
+
+# ===========================================================================
+# Continuous batching for autoregressive decode (ISSUE 8 tentpole, layer 3)
+# ===========================================================================
+
+class GenerationHandle:
+    """Per-request view of an in-flight generation: a ``Future`` resolving
+    to ``{"tokens": [ids], "logits": last-step logits}``, plus a streaming
+    iterator (:meth:`tokens`) that yields token ids as each decode
+    iteration lands — the per-token partial results ``JsonModelServer``'s
+    ``/generate`` endpoint streams out."""
+
+    def __init__(self):
+        self.future: Future = Future()
+        self._stream: "queue.Queue" = queue.Queue()
+
+    def _emit(self, index: int, token: int):
+        self._stream.put((index, int(token)))
+
+    def _finish(self, err: Optional[BaseException] = None):
+        self._stream.put(None)
+        if err is not None and not self.future.done():
+            self.future.set_exception(err)
+
+    def tokens(self, timeout: Optional[float] = None):
+        """Yield generated token ids in order as they are produced."""
+        while True:
+            item = self._stream.get(timeout=timeout)
+            if item is None:
+                # surface a terminal failure to the streaming consumer too
+                err = self.future.exception() if self.future.done() else None
+                if err is not None:
+                    raise err
+                return
+            yield item[1]
+
+    def result(self, timeout: Optional[float] = None) -> dict:
+        return self.future.result(timeout=timeout)
+
+
+class _GenRequest:
+    __slots__ = ("x", "plen", "max_new", "eos_id", "handle", "t_enqueue",
+                 "deadline", "t_admitted", "tokens", "emitted")
+
+    def __init__(self, x, plen, max_new, eos_id, deadline):
+        self.x = x                    # [T, F] prompt features (host)
+        self.plen = int(plen)
+        self.max_new = int(max_new)
+        self.eos_id = eos_id
+        self.handle = GenerationHandle()
+        self.t_enqueue = time.perf_counter()
+        self.deadline = deadline      # absolute admission deadline or None
+        self.t_admitted = None
+        self.tokens: List[int] = []
+        self.emitted = 0
+
+    def expired(self, now=None) -> bool:
+        return self.deadline is not None and \
+            (now if now is not None else time.perf_counter()) > self.deadline
+
+
+class ContinuousBatcher:
+    """Token-boundary continuous batching over a
+    :class:`~..serving.engine.GenerativeEngine` slot set.
+
+    Requests JOIN the in-flight decode batch when a slot frees up (their
+    prompt prefills between decode iterations — ``prefill_per_iter``
+    bounds the admission stall decode pays per iteration) and LEAVE the
+    moment they finish (``max_new_tokens`` reached or ``eos_id``
+    sampled), without perturbing the other slots' state: slot rows are
+    independent by construction, which the join/leave parity test
+    asserts bit-exactly. The pre-ISSUE-8 dispatcher could only coalesce
+    once and let a decode batch drain to one request; here the batch
+    refills every token boundary.
+
+    **Deadline semantics (decided + documented, ISSUE 8 satellite):**
+    ``deadline_ms`` bounds ENQUEUE -> ADMISSION — a request still queued
+    when it expires fails fast with ``DeadlineExceeded`` and never
+    prefills. At admission the clock RESTARTS: an admitted multi-token
+    generation is never killed mid-flight by the admission deadline
+    (deadline = per-request-admission, not per-token — the
+    ``ParallelInference`` one-shot front keeps its whole-request
+    enqueue->dispatch deadline; both are regression-tested).
+
+    ``shed_queue_depth`` sheds in the caller's thread with ``QueueFull``
+    exactly like the one-shot front. The ``serving.decode`` fault site
+    makes the decode-iteration failure path deterministic in tier-1.
+    """
+
+    def __init__(self, model, slots: int = 4, max_cache_len: int = 256,
+                 min_cache_len: int = 16,
+                 prompt_buckets: Optional[Sequence[int]] = None,
+                 max_new_tokens: int = 32,
+                 queue_limit: int = 256,
+                 deadline_ms: Optional[float] = None,
+                 shed_queue_depth: Optional[int] = None,
+                 prefill_per_iter: int = 1,
+                 eos_id: Optional[int] = None,
+                 token_to_features=None,
+                 sample_fn=None,
+                 engine: Optional["GenerativeEngine"] = None,
+                 warmup: bool = True):
+        from .engine import GenerativeEngine
+        self.model = model
+        self.engine = engine if engine is not None \
+            else GenerativeEngine(model, slots=slots)
+        self.slots = self.engine.slots
+        self.max_cache_len = next_bucket(max_cache_len)
+        self.min_cache_len = next_bucket(min_cache_len)
+        self.max_new_tokens = int(max_new_tokens)
+        self.deadline_ms = deadline_ms
+        self.shed_queue_depth = None if shed_queue_depth is None \
+            else int(shed_queue_depth)
+        self.prefill_per_iter = max(1, int(prefill_per_iter))
+        self.eos_id = eos_id
+        self._f = self.engine._feature_dim()
+        self.token_to_features = token_to_features or self._one_hot
+        self.sample_fn = sample_fn or (lambda logits: int(np.argmax(logits)))
+        if warmup:
+            cb, b = [], self.min_cache_len
+            while b <= self.max_cache_len:
+                cb.append(b)
+                b <<= 1
+            pb = list(prompt_buckets) if prompt_buckets else cb
+            self.engine.warmup(cb, pb)
+        # live decode state + host mirrors (worker-thread-only)
+        self._state = self.engine.new_state(self.min_cache_len)
+        self._slot_req: List[Optional[_GenRequest]] = [None] * self.slots
+        self._lengths = np.zeros((self.slots,), np.int64)
+        self._x_t = np.zeros((self.slots, 1, self._f), np.float32)
+        self._q: "queue.Queue[_GenRequest]" = queue.Queue(maxsize=queue_limit)
+        self._shutdown = threading.Event()
+        # observability: same registry families as the one-shot front,
+        # its own pi= instance id, plus the slot-occupancy gauge
+        self._id = str(next(_pi_ids))
+        weakref.finalize(self, _tel.registry.discard_cells, pi=self._id)
+        self._m_requests = _M_REQUESTS.labeled(pi=self._id)
+        self._m_failures = _M_FAILURES.labeled(pi=self._id)
+        self._m_shed = _M_SHED.labeled(pi=self._id)
+        self._m_deadline = _M_DEADLINE.labeled(pi=self._id)
+        self._m_retries = _M_RETRIES.labeled(pi=self._id)
+        self._m_tokens = _M_TOKENS.labeled(pi=self._id)
+        self._h_latency = _H_LATENCY.labeled(pi=self._id)
+        self._g_slots = _G_SLOTS.labeled(pi=self._id)
+        self._g_slots.set(0)
+        # r10 degradation state machine, same recent-event window as the
+        # one-shot front
+        self.health_window = 5.0
+        self._events = deque(maxlen=1024)
+        self._worker = threading.Thread(target=self._loop, daemon=True,
+                                        name="ContinuousBatcher-decode")
+        self._worker.start()
+
+    def _note(self, kind: str):
+        self._events.append((time.perf_counter(), kind))
+
+    def health(self) -> str:
+        """HEALTHY / DEGRADED / SHEDDING over the recent event window —
+        the r10 serving state machine applied to the generative front."""
+        now = time.perf_counter()
+        recent = {k for t, k in list(self._events)
+                  if now - t <= self.health_window}
+        if "shed" in recent or (
+                self.shed_queue_depth is not None
+                and self._q.qsize() >= self.shed_queue_depth):
+            return HealthState.SHEDDING
+        if recent & {"failure", "retry", "deadline"}:
+            return HealthState.DEGRADED
+        return HealthState.HEALTHY
+
+    # ---- public ------------------------------------------------------------
+    def _one_hot(self, token: int) -> np.ndarray:
+        f = np.zeros((self._f,), np.float32)
+        f[int(token) % self._f] = 1.0
+        return f
+
+    def submit(self, prompt=None, tokens=None, plen: Optional[int] = None,
+               max_new_tokens: Optional[int] = None,
+               deadline_ms: Optional[float] = None,
+               eos_id: Optional[int] = None) -> GenerationHandle:
+        """Enqueue one generation. ``prompt``: [T, F] feature array (or
+        ``tokens``: a list of ids run through ``token_to_features``).
+        Returns a :class:`GenerationHandle` immediately; tokens stream as
+        they decode."""
+        if self._shutdown.is_set():
+            raise ShutdownError("ContinuousBatcher is shut down")
+        if tokens is not None:
+            prompt = np.stack([self.token_to_features(t) for t in tokens])
+        prompt = np.asarray(prompt, np.float32)
+        if prompt.ndim == 3 and prompt.shape[0] == 1:
+            prompt = prompt[0]
+        if prompt.ndim != 2 or prompt.shape[1] != self._f:
+            raise ValueError(f"prompt must be [T, {self._f}] features; got "
+                             f"{prompt.shape}")
+        plen = int(plen) if plen is not None else prompt.shape[0]
+        max_new = int(max_new_tokens) if max_new_tokens is not None \
+            else self.max_new_tokens
+        if next_bucket(plen + max_new) > self.max_cache_len:
+            raise ValueError(
+                f"prompt ({plen}) + max_new_tokens ({max_new}) exceeds "
+                f"max_cache_len {self.max_cache_len}")
+        if self.shed_queue_depth is not None and \
+                self._q.qsize() >= self.shed_queue_depth:
+            self._m_shed.inc()
+            self._note("shed")
+            raise QueueFull(
+                f"generation queue depth {self._q.qsize()} at/above "
+                f"shedding threshold {self.shed_queue_depth}")
+        dl = self.deadline_ms if deadline_ms is None else deadline_ms
+        deadline = None if dl is None else time.perf_counter() + dl / 1e3
+        req = _GenRequest(prompt, plen, max_new,
+                          self.eos_id if eos_id is None else eos_id,
+                          deadline)
+        self._m_requests.inc()
+        self._q.put(req)
+        if self._shutdown.is_set() and not req.handle.future.done():
+            req.handle.future.set_exception(ShutdownError(
+                "ContinuousBatcher shut down before the request was served"))
+            req.handle._finish()
+        return req.handle
+
+    def generate(self, prompt=None, tokens=None, **kw) -> dict:
+        """Blocking convenience over :meth:`submit`."""
+        return self.submit(prompt=prompt, tokens=tokens, **kw).result()
+
+    def active_slots(self) -> int:
+        return sum(1 for r in self._slot_req if r is not None)
+
+    def queue_depth(self) -> int:
+        return self._q.qsize()
+
+    def stats(self) -> dict:
+        return {
+            "slots": self.slots,
+            "health": self.health(),
+            "slots_active": int(self._g_slots.value()),
+            "queue_depth": self._q.qsize(),
+            "requests": int(self._m_requests.value()),
+            "tokens_generated": int(self._m_tokens.value()),
+            "failures": int(self._m_failures.value()),
+            "shed": int(self._m_shed.value()),
+            "deadline_expired": int(self._m_deadline.value()),
+            "retries": int(self._m_retries.value()),
+            "cache_len": self._state.cache_len,
+            "engine": self.engine.stats(),
+        }
+
+    def shutdown(self):
+        self._shutdown.set()
+        if self._worker:
+            self._worker.join(timeout=10)
+        err = ShutdownError(
+            "ContinuousBatcher shut down before the request was served")
+        while True:
+            try:
+                req = self._q.get_nowait()
+            except queue.Empty:
+                break
+            if not req.handle.future.done():
+                req.handle.future.set_exception(err)
+            req.handle._stream.put(None)
+        for i, req in enumerate(self._slot_req):
+            if req is not None and not req.handle.future.done():
+                req.handle.future.set_exception(err)
+                req.handle._stream.put(None)
+            self._slot_req[i] = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.shutdown()
+
+    # ---- worker internals (single thread owns _state and the mirrors) -----
+    def _free_slot(self) -> Optional[int]:
+        for i, r in enumerate(self._slot_req):
+            if r is None:
+                return i
+        return None
+
+    def _loop(self):
+        while not self._shutdown.is_set():
+            try:
+                admitted = self._admit()
+                if any(r is not None for r in self._slot_req):
+                    self._decode_iter()
+                elif not admitted:
+                    time.sleep(0.002)  # idle: no queue, no active slots
+            except Exception as e:
+                # LAST-RESORT guard: a user-supplied sample_fn /
+                # token_to_features or an unexpected engine error must
+                # not kill the decode thread and strand every future
+                self._fail_active(e)
+
+    def _fail_active(self, e: BaseException):
+        """Fail every in-flight request with ``e``, rebuild the decode
+        state from scratch (the decode executable DONATES the cache
+        buffers, so after a failed dispatch they may be consumed — with
+        every slot freed, fresh zeros are the correct state), and keep
+        the worker alive for subsequent traffic."""
+        live = [i for i, r in enumerate(self._slot_req) if r is not None]
+        self._m_failures.inc(max(1, len(live)))
+        self._note("failure")
+        for i in live:
+            req = self._slot_req[i]
+            if not req.handle.future.done():
+                req.handle.future.set_exception(e)
+            req.handle._stream.put(None)
+            self._slot_req[i] = None
+        self._lengths[:] = 0
+        self._x_t[:] = 0.0
+        self._state = self.engine.new_state(self.min_cache_len)
+        self._g_slots.set(self.active_slots())
+
+    def _admit(self) -> int:
+        """Prefill up to ``prefill_per_iter`` queued requests into free
+        slots — the admission work interleaved between decode iterations
+        so joins happen at token boundaries."""
+        n = 0
+        while n < self.prefill_per_iter:
+            slot = self._free_slot()
+            if slot is None:
+                break
+            try:
+                req = self._q.get(timeout=0.02 if n == 0 and
+                                  not any(r is not None
+                                          for r in self._slot_req) else 0.0)
+            except queue.Empty:
+                break
+            # ISSUE 8 satellite (decided semantics): the admission
+            # deadline is checked HERE, against the enqueue-time clock; a
+            # request that makes it into a slot restarts its clock — the
+            # generation itself is never expired mid-flight
+            if req.expired():
+                self._m_deadline.inc()
+                self._note("deadline")
+                req.handle.future.set_exception(DeadlineExceeded(
+                    "generation request expired before admission"))
+                req.handle._stream.put(None)
+                continue
+            try:
+                self._prefill(req, slot)
+                n += 1
+            except Exception as e:
+                self._m_failures.inc()
+                self._note("failure")
+                if not req.handle.future.done():
+                    req.handle.future.set_exception(e)
+                req.handle._stream.put(None)
+                if self._slot_req[slot] is req:
+                    # a post-assignment failure (e.g. a raising
+                    # sample_fn in _emit_token) must not leave a zombie
+                    # slot decoding a dead request
+                    self._slot_req[slot] = None
+                    self._lengths[slot] = 0
+                    self._x_t[slot] = 0.0
+        self._g_slots.set(self.active_slots())
+        return n
+
+    def _prefill(self, req: _GenRequest, slot: int):
+        need_c = next_bucket(max(req.plen + 1, next_bucket(req.x.shape[0])))
+        if need_c > self._state.cache_len:
+            self._state = self.engine.grow(self._state, need_c)
+        req.t_admitted = time.perf_counter()
+        self._state, logits = self.engine.prefill(
+            self._state, req.x, req.plen, slot)
+        self._slot_req[slot] = req
+        self._lengths[slot] = req.plen
+        self._emit_token(slot, logits)
+
+    def _emit_token(self, slot: int, logits: np.ndarray):
+        """Sample, stream, and either finish the slot's request or queue
+        the token as the slot's next decode input."""
+        req = self._slot_req[slot]
+        tok = self.sample_fn(logits)
+        req.tokens.append(tok)
+        req.emitted += 1
+        self._m_tokens.inc()
+        req.handle._emit(req.emitted - 1, tok)
+        done = req.emitted >= req.max_new or \
+            (req.eos_id is not None and tok == req.eos_id)
+        if done:
+            # submit->resolve, the family's documented unit (the one-shot
+            # front observes at resolution too — dashboards can compare)
+            self._h_latency.observe(time.perf_counter() - req.t_enqueue)
+            if not req.handle.future.done():
+                req.handle.future.set_result(
+                    {"tokens": list(req.tokens), "logits": logits})
+            req.handle._stream.put(None)
+            self._slot_req[slot] = None
+            self._lengths[slot] = 0
+            self._x_t[slot] = 0.0
+        else:
+            self._x_t[slot, 0] = self.token_to_features(tok)
+
+    def _decode_iter(self):
+        active = np.array([1 if r is not None else 0
+                           for r in self._slot_req], np.int32)
+        live = [i for i in range(self.slots) if active[i]]
+        # cache insert lands at position lengths: grow before any active
+        # slot would write past the bucket
+        if int(self._lengths[live].max()) >= self._state.cache_len:
+            self._state = self.engine.grow(
+                self._state, self._state.cache_len + 1)
+        try:
+            # the transient retry only covers PRE-dispatch failures (the
+            # fault-injection trip): once engine.decode dispatches, the
+            # donated cache buffers are consumed and a re-dispatch with
+            # the same state is impossible — executor failures fall
+            # through to _fail_active's fresh-state recovery instead
+            attempt = 0
+            while _faults.enabled():
+                try:
+                    _faults.trip("serving.decode")
+                    break
+                except Exception as e:
+                    if attempt == 0 and _faults.is_transient(e):
+                        attempt = 1
+                        self._m_retries.inc()
+                        self._note("retry")
+                        continue
+                    raise
+            state, logits = self.engine.decode(
+                self._state, self._x_t, active)
+        except Exception as e:
+            self._fail_active(e)
+            return
+        self._state = state
+        self._lengths[live] += 1
+        for i in live:
+            self._emit_token(i, logits[i])
+        self._g_slots.set(self.active_slots())

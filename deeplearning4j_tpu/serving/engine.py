@@ -61,6 +61,12 @@ _H_EXEC = _tel.histogram("serving.phase.execute_s",
                          "device executable time per engine call")
 _H_UNPAD = _tel.histogram("serving.phase.unpad_s",
                           "host-side unpad time per engine call")
+# generative decode phases (ISSUE 8): prompt prefill per admitted request,
+# one decode iteration over the whole slot batch
+_H_PREFILL = _tel.histogram("serving.phase.prefill_s",
+                            "prompt prefill time per admitted request")
+_H_DECODE = _tel.histogram("serving.phase.decode_step_s",
+                           "one decode iteration over the slot batch")
 _engine_ids = itertools.count()
 
 
@@ -633,3 +639,294 @@ class InferenceEngine:
             "compiled_buckets": buckets,
             "bucket_hits": self.bucket_hits,
         }
+
+
+class DecodeState:
+    """The live state of one in-flight decode batch: per-layer KV caches
+    at the current cache-length bucket, plus per-slot valid lengths.
+    Owned by the continuous batcher; every engine call is functional
+    (state in, state out) so a failed dispatch never half-mutates it."""
+
+    __slots__ = ("caches", "lengths", "cache_len")
+
+    def __init__(self, caches, lengths, cache_len: int):
+        self.caches = caches          # {layer: {"k": [S,H,C,d], "v": ...}}
+        self.lengths = lengths        # [S] int32 device array
+        self.cache_len = int(cache_len)
+
+
+class GenerativeEngine:
+    """Bucketed AOT-compiled autoregressive decode for one model
+    (ISSUE 8 tentpole, layer 2): the generative sibling of
+    :class:`InferenceEngine`, compiled per (slot-batch bucket x
+    cache-length bucket x prompt-length bucket).
+
+    - ``slots``: the decode batch capacity — every decode executable runs
+      the full slot batch, so join/leave at token boundaries never
+      changes a compiled shape (the continuous-batching contract).
+    - ``prefill``: one admitted request's prompt fills its slot's cache
+      rows via the one-shot flash kernel (prefix-LM: the prompt attends
+      bidirectionally over itself) and returns the last valid position's
+      logits — the first generated token's distribution.
+    - ``decode``: one token for every slot in ONE executable call;
+      inactive slots compute masked garbage that the active-mask keeps
+      out of the persistent state (row independence is what lets
+      requests join/leave without perturbing neighbours).
+    - cache growth: crossing a power-of-two cache boundary re-buckets by
+      host-side zero-padding (``grow``) — no compile, so a warmed bucket
+      ladder keeps the steady state at zero post-warmup compiles.
+
+    Counters/phases ride the same registry families as the one-shot
+    engine (``serving.engine.*`` labeled ``engine=<id>``), plus
+    ``serving.phase.prefill_s`` / ``serving.phase.decode_step_s``.
+    """
+
+    def __init__(self, model, slots: int = 8):
+        self.model = model
+        self.slots = int(slots)
+        self._compiled: Dict[Tuple, Any] = {}
+        self._lock = threading.Lock()
+        self._invalidate_cause: Optional[str] = None
+        self._known: set = set()
+        self._id = str(next(_engine_ids))
+        weakref.finalize(self, _tel.registry.discard_cells, engine=self._id)
+        self._m_calls = _M_CALLS.labeled(engine=self._id)
+        self._m_hits = _M_HITS.labeled(engine=self._id)
+        self._m_compiles = _M_COMPILES.labeled(engine=self._id)
+        self._h_prefill = _H_PREFILL.labeled(engine=self._id)
+        self._h_decode = _H_DECODE.labeled(engine=self._id)
+        try:
+            if not hasattr(model, "_serving_engines"):
+                model._serving_engines = weakref.WeakSet()
+            model._serving_engines.add(self)
+        except (AttributeError, TypeError):
+            pass
+        # trace-time sanity: an un-decodable stack should fail at
+        # construction, not at the first warmup compile
+        model.decode_cache_spec(1, 8)
+
+    # ---------------------------------------------------------- state blobs
+    def new_state(self, cache_len: int) -> DecodeState:
+        """Fresh zeroed decode state at the given cache bucket."""
+        c = next_bucket(cache_len)
+        caches = self.model.init_decode_cache(self.slots, c)
+        return DecodeState(caches, jnp.zeros((self.slots,), jnp.int32), c)
+
+    def grow(self, state: DecodeState, cache_len: int) -> DecodeState:
+        """Re-bucket the caches to a larger power-of-two length by
+        HOST-side zero padding (``np.pad`` + device_put — no trace, no
+        compile event; growth happens O(log T) times per sequence).
+        Existing entries are preserved exactly (bit-parity tested)."""
+        c2 = next_bucket(cache_len)
+        if c2 <= state.cache_len:
+            return state
+        pad = c2 - state.cache_len
+
+        def grow_leaf(a):
+            h = np.asarray(a)
+            return jax.device_put(
+                np.pad(h, [(0, 0), (0, 0), (0, pad), (0, 0)]))
+
+        return DecodeState(jax.tree.map(grow_leaf, state.caches),
+                           state.lengths, c2)
+
+    # ----------------------------------------------------------- compilation
+    def _params_avals(self):
+        return (jax.eval_shape(lambda: self.model.params),
+                jax.eval_shape(lambda: self.model.state))
+
+    def _feature_dim(self) -> int:
+        shapes = self.model.conf.input_shape
+        if shapes is None or len(shapes) != 2:
+            raise ValueError("generative serving needs a recurrent "
+                             "([T, F]) input_type on the model config")
+        return int(shapes[1])
+
+    def _get_compiled(self, key: Tuple, build, _warmup=False):
+        with self._lock:
+            exe = self._compiled.get(key)
+            if exe is not None:
+                if not _warmup:
+                    self._m_hits.inc()
+                return exe
+            if self._invalidate_cause is not None:
+                cause, self._invalidate_cause = self._invalidate_cause, None
+            elif _warmup:
+                cause = "warmup"
+            else:
+                cause = "new_bucket"
+            exe = build().compile()
+            self._compiled[key] = exe
+            self._known.add(key)
+            self._m_compiles.inc()
+            _tel.record_compile("serving.engine", cause, engine=self._id,
+                                bucket=str(list(key)))
+            return exe
+
+    def _prefill_exe(self, tp: int, c: int, _warmup=False):
+        model = self.model
+        S = self.slots
+        f = self._feature_dim()
+        dt = _dt.resolve(model.conf.dtype)
+
+        def fn(params, mstate, caches, lengths, x, plen, slot):
+            mini = jax.tree.map(
+                lambda a: jnp.zeros(a.shape, a.dtype),
+                model.decode_cache_spec(1, c))
+            y, mini = model._prefill(params, x, mstate, mini, plen[None])
+            d = y.shape[-1]
+            logits = jax.lax.dynamic_slice(
+                y, (0, plen - 1, 0), (1, 1, d))[0, 0]
+            caches = jax.tree.map(
+                lambda cc, m: jax.lax.dynamic_update_slice(
+                    cc, m.astype(cc.dtype), (slot, 0, 0, 0)),
+                caches, mini)
+            lengths = jax.lax.dynamic_update_slice(
+                lengths, plen[None].astype(lengths.dtype), (slot,))
+            return caches, lengths, logits
+
+        def build():
+            p_avals, s_avals = self._params_avals()
+            cache_avals = model.decode_cache_spec(S, c)
+            return jax.jit(fn).lower(
+                p_avals, s_avals, cache_avals,
+                jax.ShapeDtypeStruct((S,), jnp.int32),
+                jax.ShapeDtypeStruct((1, tp, f), dt),
+                jax.ShapeDtypeStruct((), jnp.int32),
+                jax.ShapeDtypeStruct((), jnp.int32))
+
+        return self._get_compiled(("prefill", tp, c), build, _warmup)
+
+    def _decode_exe(self, c: int, _warmup=False):
+        model = self.model
+        S = self.slots
+        f = self._feature_dim()
+        dt = _dt.resolve(model.conf.dtype)
+
+        def fn(params, mstate, caches, lengths, x_t, active):
+            # the active mask gates the cache WRITE inside cache_insert
+            # (an O(slots*d) gathered no-op for inactive rows) — no
+            # full-cache select pass; inactive rows' logits are garbage
+            # the batcher never reads
+            y, caches = model._decode_step(params, x_t, mstate, caches,
+                                           lengths, write=active)
+            lengths = lengths + active.astype(lengths.dtype)
+            return caches, lengths, y[:, 0]
+
+        def build():
+            p_avals, s_avals = self._params_avals()
+            cache_avals = model.decode_cache_spec(S, c)
+            # the caches are DONATED: XLA aliases the in/out buffers so
+            # the per-token hot path updates the HBM cache in place
+            # instead of copying O(slots x C) bytes every iteration
+            # (~40% of CPU decode-step time at C=128). The caller must
+            # treat the passed DecodeState as consumed — the batcher
+            # rebuilds fresh state if a decode dispatch ever throws.
+            return jax.jit(fn, donate_argnums=(2,)).lower(
+                p_avals, s_avals, cache_avals,
+                jax.ShapeDtypeStruct((S,), jnp.int32),
+                jax.ShapeDtypeStruct((S, 1, f), dt),
+                jax.ShapeDtypeStruct((S,), jnp.int32))
+
+        return self._get_compiled(("decode", c), build, _warmup)
+
+    def warmup(self, cache_buckets: Sequence[int],
+               prompt_buckets: Sequence[int]) -> "GenerativeEngine":
+        """Compile every (prompt bucket x cache bucket) prefill and every
+        cache-bucket decode executable outside traffic. After this, a
+        generation whose prompt and total length stay within the warmed
+        ladders never compiles (asserted by the bench/tier-1 suite)."""
+        cs = sorted(set(next_bucket(c) for c in cache_buckets))
+        tps = sorted(set(next_bucket(t) for t in prompt_buckets))
+        for c in cs:
+            self._decode_exe(c, _warmup=True)
+            for tp in tps:
+                if tp <= c:
+                    self._prefill_exe(tp, c, _warmup=True)
+        return self
+
+    # -------------------------------------------------------------- dispatch
+    def prefill(self, state: DecodeState, x, plen: int, slot: int):
+        """Fill ``slot`` from one request's prompt. ``x``: [T, F] or
+        [1, T, F] (host array; end-padded to the prompt bucket here);
+        ``plen``: the true prompt length. Returns
+        ``(state', logits [V])`` — the logits sample the FIRST generated
+        token."""
+        x = np.asarray(x)
+        if x.ndim == 2:
+            x = x[None]
+        dt = _dt.resolve(self.model.conf.dtype)
+        if np.issubdtype(x.dtype, np.floating) and x.dtype != dt:
+            x = x.astype(dt)
+        # pad to the smallest WARMED prompt bucket for this cache bucket
+        # (a 3-token prompt lands on the warmed 16-bucket instead of
+        # compiling a cold 4-bucket under traffic); next_bucket only when
+        # nothing warmed fits
+        with self._lock:
+            warmed = sorted(k[1] for k in self._compiled
+                            if k[0] == "prefill" and k[2] == state.cache_len
+                            and k[1] >= x.shape[1])
+        tp = warmed[0] if warmed else next_bucket(x.shape[1])
+        if tp != x.shape[1]:
+            x = np.concatenate(
+                [x, np.zeros((1, tp - x.shape[1]) + x.shape[2:], x.dtype)],
+                axis=1)
+        if tp > state.cache_len:
+            raise ValueError(f"prompt bucket {tp} exceeds the cache bucket "
+                             f"{state.cache_len}; grow() first")
+        self._m_calls.inc()
+        exe = self._prefill_exe(tp, state.cache_len)
+        tel = _tel.enabled()
+        t0 = time.perf_counter() if tel else 0.0
+        caches, lengths, logits = exe(
+            self.model.params, self.model.state, state.caches,
+            state.lengths, x, np.int32(plen), np.int32(slot))
+        logits = np.asarray(logits)
+        if tel:
+            self._h_prefill.observe(time.perf_counter() - t0)
+        return DecodeState(caches, lengths, state.cache_len), logits
+
+    def decode(self, state: DecodeState, x_t, active):
+        """One token for every slot: ``x_t`` [S, 1, F] (inactive rows are
+        ignored), ``active`` [S] 0/1. Returns ``(state', logits [S, V])``
+        — inactive rows' logits are garbage by contract."""
+        x_t = np.asarray(x_t)
+        dt = _dt.resolve(self.model.conf.dtype)
+        if np.issubdtype(x_t.dtype, np.floating) and x_t.dtype != dt:
+            x_t = x_t.astype(dt)
+        self._m_calls.inc()
+        exe = self._decode_exe(state.cache_len)
+        tel = _tel.enabled()
+        t0 = time.perf_counter() if tel else 0.0
+        caches, lengths, logits = exe(
+            self.model.params, self.model.state, state.caches,
+            state.lengths, x_t, np.asarray(active, np.int32))
+        logits = np.asarray(logits)
+        if tel:
+            self._h_decode.observe(time.perf_counter() - t0)
+        return DecodeState(caches, lengths, state.cache_len), logits
+
+    # ---------------------------------------------------------------- admin
+    def invalidate(self, cause: str = "invalidate"):
+        with self._lock:
+            self._compiled.clear()
+            self._invalidate_cause = cause
+
+    @property
+    def calls(self) -> int:
+        return int(self._m_calls.value())
+
+    @property
+    def hits(self) -> int:
+        return int(self._m_hits.value())
+
+    @property
+    def compiles(self) -> int:
+        return int(self._m_compiles.value())
+
+    def stats(self) -> dict:
+        with self._lock:
+            buckets = len(self._compiled)
+        return {"calls": self.calls, "hits": self.hits,
+                "compiles": self.compiles, "compiled_buckets": buckets,
+                "slots": self.slots}

@@ -38,9 +38,17 @@ class JsonModelServer:
 
     def __init__(self, model, port: int = 0, host: str = "127.0.0.1",
                  mode: str = InferenceMode.BATCHED,
-                 pre_processor=None, **inference_kwargs):
+                 pre_processor=None, generate=None, **inference_kwargs):
         self.inference = ParallelInference(model, mode=mode,
                                            **inference_kwargs)
+        # ISSUE 8: generative serving front. ``generate`` is a kwargs dict
+        # for ContinuousBatcher (slots/max_cache_len/...); when set, POST
+        # /generate streams per-token partial results (NDJSON lines, one
+        # per decode iteration) or returns the full token list
+        self.generator = None
+        if generate is not None:
+            from .batcher import ContinuousBatcher
+            self.generator = ContinuousBatcher(model, **dict(generate))
         self.pre_processor = pre_processor
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
@@ -95,6 +103,9 @@ class JsonModelServer:
                     self._send(404, {"error": "unknown path"})
 
             def do_POST(self):
+                if self.path == "/generate":
+                    self._generate()
+                    return
                 if self.path != "/predict":
                     self._send(404, {"error": "unknown path"})
                     return
@@ -121,6 +132,67 @@ class JsonModelServer:
                 except Exception as e:
                     self._send(400, {"error": f"{type(e).__name__}: {e}"})
 
+            def _generate(self):
+                """POST /generate {"prompt": [[...]] | "tokens": [ids],
+                "max_new_tokens": n, "stream": bool} — continuous-batching
+                autoregressive decode. ``stream=true`` writes one NDJSON
+                line per generated token as each decode iteration lands
+                (partial results at token boundaries), then a final
+                ``{"done": true, "tokens": [...]}`` line; non-streaming
+                returns one JSON body."""
+                if server.generator is None:
+                    self._send(404, {"error": "server was built without "
+                                     "generate= support"})
+                    return
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                    req = json.loads(self.rfile.read(n) or b"{}")
+                    kw = {}
+                    if req.get("max_new_tokens") is not None:
+                        kw["max_new_tokens"] = int(req["max_new_tokens"])
+                    if req.get("deadline_ms") is not None:
+                        kw["deadline_ms"] = float(req["deadline_ms"])
+                    if "tokens" in req:
+                        handle = server.generator.submit(
+                            tokens=[int(t) for t in req["tokens"]], **kw)
+                    else:
+                        handle = server.generator.submit(
+                            prompt=np.asarray(req["prompt"], np.float32),
+                            **kw)
+                    if not req.get("stream"):
+                        res = handle.result()
+                        self._send(200, {"tokens": res["tokens"]})
+                        return
+                    # stream NDJSON per token; HTTP/1.0 close-delimited
+                    self.send_response(200)
+                    self.send_header("Content-Type",
+                                     "application/x-ndjson")
+                    self.end_headers()
+                    try:  # headers are out: failures become an error LINE
+                        i = 0
+                        for tok in handle.tokens():
+                            self.wfile.write(json.dumps(
+                                {"index": i, "token": int(tok)}
+                            ).encode() + b"\n")
+                            self.wfile.flush()
+                            i += 1
+                        res = handle.result()
+                        self.wfile.write(json.dumps(
+                            {"done": True, "tokens": res["tokens"]}
+                        ).encode() + b"\n")
+                    except Exception as e:
+                        self.wfile.write(json.dumps(
+                            {"error": f"{type(e).__name__}: {e}"}
+                        ).encode() + b"\n")
+                except QueueFull as e:
+                    self._send(429, {"error": f"{type(e).__name__}: {e}"})
+                except DeadlineExceeded as e:
+                    self._send(504, {"error": f"{type(e).__name__}: {e}"})
+                except ShutdownError as e:
+                    self._send(503, {"error": f"{type(e).__name__}: {e}"})
+                except Exception as e:
+                    self._send(400, {"error": f"{type(e).__name__}: {e}"})
+
         self._httpd = ThreadingHTTPServer((self.host, self.port), Handler)
         self.port = self._httpd.server_address[1]
         self._thread = threading.Thread(target=self._httpd.serve_forever,
@@ -133,6 +205,8 @@ class JsonModelServer:
             self._httpd.shutdown()
             self._httpd.server_close()
         self.inference.shutdown()
+        if self.generator is not None:
+            self.generator.shutdown()
 
     def __enter__(self):
         self.start()
