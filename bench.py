@@ -1309,6 +1309,155 @@ def bench_generative_serving():
     }
 
 
+def bench_quantized_serving():
+    """ISSUE 9 metric (CPU-capable): int8 post-training quantized serving
+    vs the bf16 engine at MATCHED buckets. Three measured claims, none
+    asserted blind:
+
+    - throughput + p99: interleaved bf16/int8 request-loop pairs,
+      median-of-ratios (same container-drift posture as the r13
+      generative bench). On TPU the int8 MXU passes are the speed story;
+      on CPU the honest win is capacity, reported next.
+    - serveable-batch capacity: ``InferenceEngine.max_batch`` under one
+      fixed ``bytes_limit`` for both engines — the r9 HBM accounting's
+      "quantized weights ~double the batch" as a measured delta (int8
+      weights halve the argument bytes the AOT ``memory_analysis``
+      reports). Skip-guarded on PJRT builds without the API.
+    - accuracy delta: the eval-stack gate (top-1 agreement vs the bf16
+      engine — label-free serving parity), must pass the configured
+      bound; plus ZERO compile events in the timed window.
+    """
+    from deeplearning4j_tpu.eval.quantization import accuracy_delta_gate
+    from deeplearning4j_tpu.nn.config import (InputType,
+                                              NeuralNetConfiguration)
+    from deeplearning4j_tpu.nn.layers.core import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.model import MultiLayerNetwork
+    from deeplearning4j_tpu.nn.updaters import Adam
+    from deeplearning4j_tpu.runtime import telemetry as _tel
+    from deeplearning4j_tpu.serving.engine import InferenceEngine
+
+    feat, width, n_requests, req_b = 256, 1024, 120, 32
+    conf = (NeuralNetConfiguration.builder().seed(0)
+            .updater(Adam(learning_rate=1e-3))
+            .data_type("BFLOAT16")
+            .input_type(InputType.feed_forward(feat))
+            .list(DenseLayer(n_out=width, activation="relu"),
+                  DenseLayer(n_out=width, activation="relu"),
+                  DenseLayer(n_out=width, activation="relu"),
+                  OutputLayer(n_out=16))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    rng = np.random.default_rng(0)
+    reqs = [rng.normal(size=(req_b, feat)).astype(np.float32)
+            for _ in range(n_requests)]
+
+    base = InferenceEngine(net).warmup([req_b])
+    quant = InferenceEngine(net, quantize="int8").warmup([req_b])
+    ev0 = int(_tel.registry.get("compile.events").total())
+
+    def run(eng):
+        lats = []
+        t0 = time.perf_counter()
+        for x in reqs:
+            ts = time.perf_counter()
+            np.asarray(eng.output(x))
+            lats.append(time.perf_counter() - ts)
+        return time.perf_counter() - t0, lats
+
+    # interleaved pairs, median-of-ratios: adjacent runs see the same
+    # container weather, so the ratio is stable where absolute walls
+    # drift ~1.5x between windows
+    pairs = []
+    for _ in range(3):
+        bw, bl = run(base)
+        qw, ql = run(quant)
+        pairs.append((bw, qw, bl, ql))
+    ratios = sorted(bw / qw for bw, qw, _, _ in pairs)
+    ratio = ratios[len(ratios) // 2]
+    _, _, base_lats, quant_lats = min(pairs, key=lambda p: p[1])
+    b_p50, b_p99 = _percentiles(base_lats)
+    q_p50, q_p99 = _percentiles(quant_lats)
+    post_warmup_events = int(
+        _tel.registry.get("compile.events").total()) - ev0
+
+    # capacity win under one fixed budget (probe compiles are cause=probe;
+    # run AFTER the timed window so they cannot pollute the zero-compile
+    # claim). The budget self-calibrates to the bf16 engine's own peak at
+    # the request bucket (+5%): the bf16 ladder tops out near req_b and
+    # the int8 delta under the SAME budget is the r9-accounting capacity
+    # claim as a measured number.
+    mem_base = base.memory_report(req_b)
+    mem_quant = quant.memory_report(req_b)
+    budget = None if mem_base["peak_bytes"] is None \
+        else int(mem_base["peak_bytes"] * 1.05)
+    mb_base = mb_quant = None
+    if budget is not None:
+        try:
+            mb_base = base.max_batch(bytes_limit=budget, limit=1024)
+            mb_quant = quant.max_batch(bytes_limit=budget, limit=1024)
+        except ValueError:
+            pass
+
+    gate = accuracy_delta_gate(base.output, quant.output, reqs[:8],
+                               max_delta=0.02, raise_on_fail=False)
+
+    # headline: TPU = throughput (native int8 MXU passes); CPU = the
+    # measured serveable-batch delta (the acceptance's "equivalent
+    # measured HBM/batch-capacity win" — int8 matmul is not a CPU speed
+    # path and pretending otherwise would be dishonest)
+    import jax as _jax
+    capacity_ratio = None if not (mb_base and mb_quant) \
+        else round(mb_quant / mb_base, 2)
+    if _jax.default_backend() == "tpu" or capacity_ratio is None:
+        headline, unit = round(ratio, 3), "x_throughput_int8_vs_bf16_engine"
+    else:
+        headline = capacity_ratio
+        unit = "x_max_batch_int8_vs_bf16_at_fixed_bytes_limit"
+
+    return {
+        "metric": "quantized_serving",
+        "value": headline,
+        "unit": unit,
+        "throughput_ratio_int8_vs_bf16": round(ratio, 3),
+        "pair_ratios": [round(r, 3) for r in ratios],
+        "model": f"MLP {feat}-{width}x3-16 BFLOAT16, batch {req_b}, "
+                 f"{n_requests} requests",
+        "bf16_requests_per_sec": round(n_requests / min(
+            bw for bw, _, _, _ in pairs), 1),
+        "int8_requests_per_sec": round(n_requests / min(
+            qw for _, qw, _, _ in pairs), 1),
+        "bf16_latency_p50_ms": None if b_p50 is None
+        else round(b_p50 * 1e3, 2),
+        "bf16_latency_p99_ms": None if b_p99 is None
+        else round(b_p99 * 1e3, 2),
+        "int8_latency_p50_ms": None if q_p50 is None
+        else round(q_p50 * 1e3, 2),
+        "int8_latency_p99_ms": None if q_p99 is None
+        else round(q_p99 * 1e3, 2),
+        # accuracy is GATED, not asserted: delta = top-1 disagreement
+        "accuracy_delta": round(gate.delta, 5),
+        "accuracy_gate_max_delta": gate.max_delta,
+        "accuracy_gate_passed": gate.passed,
+        # acceptance: zero compiles in the timed window
+        "post_warmup_compile_events": post_warmup_events,
+        # the r9-accounting capacity claim, measured (None without
+        # memory_analysis on this PJRT build)
+        "max_batch_bf16": mb_base,
+        "max_batch_int8": mb_quant,
+        "max_batch_ratio": capacity_ratio,
+        "max_batch_bytes_limit": budget,
+        "params_bytes_f32_masters": mem_base["params_bytes"],
+        "params_bytes_int8": mem_quant["params_bytes"],
+        "argument_bytes_bf16": mem_base["argument_bytes"],
+        "argument_bytes_int8": mem_quant["argument_bytes"],
+        "quantized_sites": quant.stats().get("quantized_sites"),
+        "quantize_dispatch_counters": {
+            k: v for k, v in __import__(
+                "deeplearning4j_tpu.ops.quantize",
+                fromlist=["counters"]).counters().items() if v},
+    }
+
+
 def bench_resilience():
     """ISSUE 5 metric (CPU-capable): (1) steady-state step-time overhead
     of the divergence sentinel — the guarded step (finite-check +
@@ -1574,6 +1723,14 @@ if __name__ == "__main__":
         lines.append({
             "metric": "generative_serving", "value": None,
             "unit": "x_tokens_per_sec_kv_cache_vs_full_recompute",
+            "error": f"{type(e).__name__}: {e}"[:300]})
+    _emit(lines)
+    try:
+        lines.append(bench_quantized_serving())
+    except Exception as e:
+        lines.append({
+            "metric": "quantized_serving", "value": None,
+            "unit": "x_throughput_int8_vs_bf16_engine",
             "error": f"{type(e).__name__}: {e}"[:300]})
     _emit(lines)
     try:

@@ -1,0 +1,184 @@
+"""SameDiff graph-rewrite pass: post-training int8 weight quantization
+(ISSUE 9 tentpole, imported-graph layer).
+
+``fusion.fuse_attention`` rewrites imported attention chains;
+``decode.rewrite_for_decode`` swaps fused sites for cached ones. This
+pass is the third rewrite in the same splice-by-record-identity style:
+every ``linalg.mmul`` record whose RIGHT operand is a stored 2-D weight
+(VARIABLE or CONSTANT — the dense projections of an imported
+transformer) is swapped for one ``quantize.int8_mmul`` record
+(``ops/quantize.py``): the weight becomes an int8 CONSTANT with a f32
+per-output-channel scale constant beside it, and the activation
+quantizes dynamically inside the compiled graph. The record's OUTPUT
+name is kept, so every downstream consumer — fused attention sites
+included — is untouched.
+
+Safety rules (a candidate site is skipped, and counted, unless ALL
+hold; same posture as the fusion pass):
+
+- the weight has a stored value, is 2-D, and is NOT fed per call
+  (placeholders quantize dynamically already — nothing to pre-bake);
+- the mmul carries no transpose flags (imported dense layers are plain
+  ``x @ W``; a transposed weight would need its own channel-axis
+  bookkeeping — recorded as a skip reason, not guessed at);
+- the weight is consumed ONLY by mmul records that this pass rewrites
+  (a weight also read elsewhere — e.g. a tied embedding — keeps its
+  f32 value; quantizing one consumer would fork the two views).
+
+The original f32 value is dropped from the value store when the last
+consumer is rewritten — that is the HBM win (the int8 + scale pair is
+~4x smaller). The rewrite is a DEPLOY-time transform: ``fit()`` through
+a quantized site raises (``quantize.int8_mmul`` is registered
+non-differentiable — rounding has no useful gradient), mirroring
+TF-Serving's engine-level quantized-deploy posture (PAPERS.md,
+1605.08695). Every decision bumps
+``quantize.rewrite{decision=matched|skipped_<reason>}`` so ``GET
+/stats``/``/metrics`` expose the per-site rewrite mix.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from collections import Counter
+from typing import List
+
+import numpy as np
+
+from ..ops import quantize as _q
+from .samediff import ARRAY, CONSTANT, SameDiff, VARIABLE, _OpRecord
+
+
+@dataclasses.dataclass
+class QuantizeGraphReport:
+    """matched = mmul sites swapped for ``quantize.int8_mmul``;
+    skipped = candidate sites left f32, with reasons; ``bytes_f32`` /
+    ``bytes_q`` = value-store weight bytes before/after (the serveable-
+    batch accounting)."""
+    matched: int = 0
+    skipped: int = 0
+    sites: List[str] = dataclasses.field(default_factory=list)
+    reasons: List[str] = dataclasses.field(default_factory=list)
+    bytes_f32: int = 0
+    bytes_q: int = 0
+
+    @property
+    def bytes_saved(self) -> int:
+        return max(0, self.bytes_f32 - self.bytes_q)
+
+    def __str__(self):
+        return (f"weight quantization: {self.matched} mmul sites -> int8 "
+                f"({self.bytes_f32} -> {self.bytes_q} weight bytes), "
+                f"{self.skipped} skipped")
+
+
+def _skip(report: QuantizeGraphReport, rec: _OpRecord, slug: str,
+          reason: str):
+    """``slug`` is the short counter label
+    (``quantize.rewrite{decision=skipped_<slug>}`` — distinct per skip
+    class so the /metrics mix separates a tied embedding from a rank-3
+    tensor); ``reason`` is the human-readable report line."""
+    report.skipped += 1
+    report.reasons.append(f"{rec.output}: {reason}")
+    _q._REWRITE.inc(decision="skipped_" + slug)
+
+
+def quantize_weights(sd: SameDiff, min_elements: int = 1
+                     ) -> QuantizeGraphReport:
+    """Rewrite every safe stored-weight ``linalg.mmul`` in ``sd`` to one
+    ``quantize.int8_mmul`` op, in place. ``min_elements`` skips tiny
+    weights where the int8 + scale pair saves nothing. Returns a
+    :class:`QuantizeGraphReport`. Run AFTER ``fuse_attention`` (the
+    fused sites' q/k/v projections are exactly the mmuls this pass
+    wants; order is not load-bearing, but fusing first keeps the
+    attention chain intact for its own rewrite)."""
+    report = QuantizeGraphReport()
+    consumers: Counter = Counter()
+    for rec in sd._ops:
+        consumers.update(rec.referenced())
+
+    # one pass to decide; weights shared by several plain mmuls are
+    # quantized once and every consumer site swaps
+    sites = []          # (record, weight_name)
+    per_weight = {}     # weight_name -> [records]
+    for rec in sd._ops:
+        if rec.op != "linalg.mmul":
+            continue
+        if len(rec.inputs) != 2:
+            continue
+        w_name = rec.inputs[1]
+        var = sd._vars.get(w_name)
+        if var is None or var.kind not in (VARIABLE, CONSTANT):
+            # activation @ activation (attention scores/context) or a
+            # per-call placeholder feed: not a stored-weight site
+            continue
+        val = sd._values.get(w_name)
+        if val is None:
+            _skip(report, rec, "no_value", "weight has no stored value")
+            continue
+        val = np.asarray(val)
+        if val.ndim != 2:
+            _skip(report, rec, "rank", f"weight rank {val.ndim} != 2")
+            continue
+        if val.size < int(min_elements):
+            _skip(report, rec, "min_elements",
+                  f"weight below min_elements ({val.size})")
+            continue
+        if not np.issubdtype(val.dtype, np.floating):
+            _skip(report, rec, "dtype",
+                  f"weight dtype {val.dtype} not floating")
+            continue
+        if rec.attrs.get("transpose_a") or rec.attrs.get("transpose_b"):
+            _skip(report, rec, "transpose", "transpose flags set")
+            continue
+        sites.append((rec, w_name))
+        per_weight.setdefault(w_name, []).append(rec)
+
+    # a weight read by anything OTHER than its rewritten mmuls keeps its
+    # f32 value (tied embeddings, norm-sharing exports)
+    blocked = set()
+    for w_name, recs in per_weight.items():
+        if consumers[w_name] != len(recs):
+            blocked.add(w_name)
+            for rec in recs:
+                _skip(report, rec, "shared_weight",
+                      f"weight {w_name!r} has "
+                      f"{consumers[w_name] - len(recs)} non-mmul consumers")
+    sites = [(rec, w) for rec, w in sites if w not in blocked]
+    if not sites:
+        return report
+
+    quantized = {}  # weight_name -> (q_name, scale_name)
+    replace = {}    # id(old record) -> new record
+    for rec, w_name in sites:
+        if w_name not in quantized:
+            val = np.asarray(sd._values[w_name])
+            report.bytes_f32 += val.nbytes
+            qt = _q.quantize_per_channel(val, axis=1)
+            q_name, s_name = f"{w_name}__q", f"{w_name}__scale"
+            sd._register(q_name, CONSTANT, tuple(qt.q.shape))
+            sd._register(s_name, CONSTANT, tuple(qt.scale.shape))
+            sd._values[q_name] = qt.q
+            sd._values[s_name] = qt.scale
+            report.bytes_q += qt.nbytes
+            quantized[w_name] = (q_name, s_name)
+        q_name, s_name = quantized[w_name]
+        # splice by record identity, keeping the mmul's output name so
+        # downstream consumers (and output()/serving callers) see no
+        # graph-surface change; all replacements are known up front, so
+        # the op list rebuilds ONCE (not once per site)
+        replace[id(rec)] = _OpRecord(
+            "quantize.int8_mmul", [rec.inputs[0], q_name, s_name],
+            rec.output, {})
+        report.matched += 1
+        report.sites.append(rec.output)
+        _q._REWRITE.inc(decision="matched")
+    sd._ops = [replace.get(id(r), r) for r in sd._ops]
+
+    # the f32 originals are dead now: drop the VALUES (the HBM win) but
+    # keep the variable entries as value-less markers — ``get_value``
+    # raising KeyError tells a caller the weight was quantized away
+    for w_name in quantized:
+        sd._values.pop(w_name, None)
+        sd._vars[w_name].kind = ARRAY
+    sd._fn_cache.clear()
+    return report

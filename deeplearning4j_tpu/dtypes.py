@@ -113,18 +113,30 @@ def param_dtype(dtype) -> np.dtype:
 
 def cast_floating(tree, dtype):
     """Cast every floating-point leaf of a pytree to `dtype` (ints/bools
-    untouched). Identity for leaves already in `dtype`."""
+    untouched). Identity for leaves already in `dtype`.
+
+    Quantized weights (``ops.quantize.QuantizedTensor``, duck-typed via
+    the ``__quantized_tensor__`` marker so this module needs no ops
+    import) pass through WHOLE: their int8 values are not floating, and
+    casting their f32 scales to a 16-bit compute dtype would permanently
+    degrade dequantization accuracy — the int8 kernels upcast the scale
+    themselves."""
     import jax
 
     d = np.dtype(dtype)
 
+    def _is_quantized(n):
+        return getattr(n, "__quantized_tensor__", False)
+
     def _cast(a):
+        if _is_quantized(a):
+            return a
         if hasattr(a, "dtype") and jnp.issubdtype(a.dtype, jnp.floating) \
                 and a.dtype != d:
             return a.astype(d)
         return a
 
-    return jax.tree.map(_cast, tree)
+    return jax.tree.map(_cast, tree, is_leaf=_is_quantized)
 
 
 def upcast_16(a):

@@ -1,3 +1,5 @@
 from .binary import EvaluationBinary, EvaluationCalibration  # noqa: F401
 from .evaluation import Evaluation, RegressionEvaluation  # noqa: F401
+from .quantization import (GateResult, QuantizationGateError,  # noqa: F401
+                           accuracy_delta_gate, quantization_gate)
 from .roc import ROC, ROCBinary, ROCMultiClass  # noqa: F401

@@ -1,0 +1,145 @@
+"""Quantization accuracy-delta gate (ISSUE 9: gated, not asserted).
+
+Post-training int8 quantization is a numerics change; the serving stack
+must MEASURE what it costs before a quantized engine takes traffic. This
+module is the eval-stack gate the golden-harness tests (and the
+``quantized_serving`` bench) drive:
+
+- with labels: both engines are scored through the standard
+  :class:`~..eval.evaluation.Evaluation` accumulator and the gate is the
+  ACCURACY delta (baseline − quantized);
+- without labels: the gate is the top-1 DISAGREEMENT rate between the
+  two engines (serving parity — the deploy-time question "does the
+  quantized engine answer the same?").
+
+``check()``/:func:`quantization_gate` never silently pass: the measured
+delta lands in the ``serving.quantize.gate_delta`` gauge, a failure
+bumps ``serving.quantize.gate_failures``, and a failing gate raises
+:class:`QuantizationGateError` unless the caller opts into inspecting
+the result (``raise_on_fail=False``). A deliberately-broken-scales
+engine MUST trip this gate — regression-tested.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Sequence
+
+import numpy as np
+
+from ..runtime import telemetry as _tel
+
+_G_DELTA = _tel.gauge(
+    "serving.quantize.gate_delta",
+    "last measured accuracy delta (baseline - quantized); disagreement "
+    "rate when the gate ran label-free")
+_M_FAILURES = _tel.counter(
+    "serving.quantize.gate_failures",
+    "accuracy-delta gate failures (delta above the configured bound)")
+
+
+class QuantizationGateError(AssertionError):
+    """The quantized engine's accuracy delta exceeded the gate."""
+
+
+class GateResult:
+    """What the gate measured. ``delta`` is accuracy_baseline −
+    accuracy_quantized when labels were given, else the top-1
+    disagreement rate; ``passed`` is ``delta <= max_delta``;
+    ``cell_labels`` are the registry labels the gate cells were written
+    under (read back via ``gate_delta.value(**result.cell_labels)``)."""
+
+    def __init__(self, delta: float, max_delta: float, n: int,
+                 accuracy_baseline: Optional[float] = None,
+                 accuracy_quantized: Optional[float] = None,
+                 cell_labels: Optional[dict] = None):
+        self.delta = float(delta)
+        self.max_delta = float(max_delta)
+        self.examples = int(n)
+        self.accuracy_baseline = accuracy_baseline
+        self.accuracy_quantized = accuracy_quantized
+        self.cell_labels = dict(cell_labels or {})
+
+    @property
+    def passed(self) -> bool:
+        return self.delta <= self.max_delta
+
+    def __repr__(self):
+        verdict = "PASS" if self.passed else "FAIL"
+        return (f"GateResult({verdict}: delta={self.delta:.4f} vs "
+                f"max {self.max_delta:.4f} over {self.examples} examples)")
+
+
+def accuracy_delta_gate(predict_baseline: Callable, predict_quantized:
+                        Callable, batches: Sequence, labels:
+                        Optional[Sequence] = None, max_delta: float = 0.01,
+                        raise_on_fail: bool = True,
+                        cell_labels: Optional[dict] = None) -> GateResult:
+    """The generic gate: run both predictors over ``batches`` (each a
+    features array; predictors return class scores ``[B, ..., C]``) and
+    compare. Engine-agnostic on purpose — the MLN/CG serving engines and
+    a rewritten SameDiff graph all gate through this one code path.
+    ``cell_labels`` (e.g. ``{"engine": id}``) label the gate's registry
+    cells per the anti-blending rule, so concurrent gates for different
+    engines cannot overwrite each other's delta."""
+    from .evaluation import Evaluation
+    ev_b, ev_q = Evaluation(), Evaluation()
+    agree = total = 0
+    for i, x in enumerate(batches):
+        out_b = np.asarray(predict_baseline(x))
+        out_q = np.asarray(predict_quantized(x))
+        top_b = np.argmax(out_b, axis=-1)
+        top_q = np.argmax(out_q, axis=-1)
+        agree += int(np.sum(top_b == top_q))
+        total += int(top_b.size)
+        if labels is not None:
+            y = np.asarray(labels[i])
+            if y.ndim == out_b.ndim - 1:  # index labels -> one-hot
+                y = np.eye(out_b.shape[-1], dtype=np.float32)[
+                    y.astype(int)]
+            ev_b.eval(y, out_b)
+            ev_q.eval(y, out_q)
+    cl = dict(cell_labels or {})
+    if labels is not None:
+        acc_b, acc_q = ev_b.accuracy(), ev_q.accuracy()
+        delta = acc_b - acc_q
+        result = GateResult(delta, max_delta, total,
+                            accuracy_baseline=acc_b,
+                            accuracy_quantized=acc_q, cell_labels=cl)
+    else:
+        delta = 1.0 - (agree / total if total else 1.0)
+        result = GateResult(delta, max_delta, total, cell_labels=cl)
+    _G_DELTA.set(result.delta, **cl)
+    if not result.passed:
+        _M_FAILURES.inc(**cl)
+        if raise_on_fail:
+            raise QuantizationGateError(
+                f"quantized accuracy delta {result.delta:.4f} exceeds the "
+                f"gate {max_delta:.4f} ({result.examples} examples)")
+    return result
+
+
+def quantization_gate(model, features, labels=None, max_delta: float = 0.01,
+                      buckets: Optional[Sequence[int]] = None,
+                      raise_on_fail: bool = True) -> GateResult:
+    """Gate one model's int8 serving engine against its f32 engine
+    (``InferenceEngine(quantize="int8")`` vs the plain engine, both
+    AOT-warmed on the same buckets — matched serving conditions, the
+    same comparison the ``quantized_serving`` bench reports).
+    ``features``: one array or a list of batch arrays; ``labels``
+    optional (accuracy delta) else top-1 agreement."""
+    from ..serving.engine import InferenceEngine, next_bucket
+    batches = features if isinstance(features, (list, tuple)) \
+        else [features]
+    if labels is not None and not isinstance(labels, (list, tuple)):
+        labels = [labels]
+    if buckets is None:
+        buckets = sorted({next_bucket(np.asarray(b).shape[0])
+                          for b in batches})
+    base = InferenceEngine(model).warmup(buckets)
+    quant = InferenceEngine(model, quantize="int8").warmup(buckets)
+    # cells labeled by the quantized engine (anti-blending rule — its
+    # weakref finalizer also drops them with the rest of engine=<id>)
+    return accuracy_delta_gate(base.output, quant.output, batches,
+                               labels=labels, max_delta=max_delta,
+                               raise_on_fail=raise_on_fail,
+                               cell_labels={"engine": quant._id})

@@ -829,6 +829,19 @@ class ComputationGraph(_caches.CompiledCacheMixin):
             return [np.argmax(o, axis=-1) for o in out]
         return np.argmax(out, axis=-1)
 
+    def quantize_params(self, mode: str = "int8") -> dict:
+        """Post-training per-channel int8 quantization of the opted-in
+        layer-vertex weights (ISSUE 9): the vertex-walk twin of
+        ``MultiLayerNetwork.quantize_params`` — returns a NEW params
+        tree with every ``quantize_spec``-marked weight replaced by a
+        ``QuantizedTensor``; merge/norm/embedding vertices stay f32 and
+        the model's own params are untouched."""
+        if mode != "int8":
+            raise ValueError(f"unknown quantization mode {mode!r} "
+                             "(expected 'int8')")
+        from ..ops import quantize as _q
+        return _q.quantize_model_params(self)[0]
+
     def score(self, data=None) -> float:
         """Loss of the last fit batch, or of the given (Multi)DataSet;
         includes the regularization term on both paths."""

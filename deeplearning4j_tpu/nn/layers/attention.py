@@ -28,6 +28,7 @@ import jax
 import jax.numpy as jnp
 
 from ...ops import flash_attention as _fa
+from ...ops import quantize as _q
 from ...ops.math import precision_for
 from .. import weights as _winit
 from .base import Layer, layer
@@ -54,8 +55,16 @@ def _key_mask(mask):
 
 
 def _proj(x, w, b=None):
-    y = jnp.dot(x, w, precision=precision_for(x, w))
-    return y if b is None else y + b
+    # every q/k/v/output projection routes through qdot: plain dot for
+    # f32 weights, the fused int8 kernel for a quantized params tree
+    # (serving, ISSUE 9) — one dispatch rule, shared with the dense
+    # layers, so the two paths cannot drift
+    return _q.qdot(x, w, b)
+
+
+#: the four projection weights every multi-head layer quantizes
+#: (per-output-channel, axis 1); learned queries / biases stay f32
+_MHA_QUANT_SPEC = {"Wq": 1, "Wk": 1, "Wv": 1, "Wo": 1}
 
 
 def _qkv(x_q, x_kv, params, n_heads):
@@ -71,13 +80,62 @@ def _mha(x_q, x_kv, params, n_heads, mask):
     return _proj(_heads_join(y), params["Wo"], params.get("bo"))
 
 
-def _kv_cache_spec(params, n_heads, batch, cache_len, dtype):
+def _kv_cache_spec(params, n_heads, batch, cache_len, dtype,
+                   kv_quant=False):
     proj = params["Wk"].shape[1]
     hs = proj // n_heads
     shp = (batch, n_heads, cache_len, hs)
     import jax as _jax
+    if kv_quant:
+        # int8 values + per-row f32 scales beside them (ISSUE 9): the
+        # scale buckets are [B, H, C, 1] so cache_insert appends them
+        # with the exact machinery the value buckets use
+        return {"k": _jax.ShapeDtypeStruct(shp, jnp.int8),
+                "v": _jax.ShapeDtypeStruct(shp, jnp.int8),
+                "k_scale": _jax.ShapeDtypeStruct(shp[:3] + (1,),
+                                                 jnp.float32),
+                "v_scale": _jax.ShapeDtypeStruct(shp[:3] + (1,),
+                                                 jnp.float32)}
     return {"k": _jax.ShapeDtypeStruct(shp, dtype),
             "v": _jax.ShapeDtypeStruct(shp, dtype)}
+
+
+def _cache_fill_prompt(cache, k, v):
+    """Write prompt k/v projections into cache positions [0, T) —
+    quantizing per row when the cache is int8 (``k_scale`` present)."""
+    T = k.shape[2]
+    if "k_scale" in cache:
+        kq, ks = _q.quantize_rows(k)
+        vq, vs = _q.quantize_rows(v)
+        return {"k": cache["k"].at[:, :, :T].set(kq),
+                "v": cache["v"].at[:, :, :T].set(vq),
+                "k_scale": cache["k_scale"].at[:, :, :T].set(ks),
+                "v_scale": cache["v_scale"].at[:, :, :T].set(vs)}
+    return {"k": cache["k"].at[:, :, :T].set(k.astype(cache["k"].dtype)),
+            "v": cache["v"].at[:, :, :T].set(v.astype(cache["v"].dtype))}
+
+
+def _cache_append(cache, k_new, v_new, lengths, write):
+    """Append one token's k/v into the cache (int8-aware), returning
+    ``(new_cache, k_full, v_full)`` with the full cache dequantized to
+    the step's compute dtype for the attention kernel. The per-row
+    quantize/insert is row-local, so write-gated inactive slots stay
+    bit-identical under quantization too (continuous-batching
+    contract)."""
+    if "k_scale" in cache:
+        kq, ks = _q.quantize_rows(k_new)
+        vq, vs = _q.quantize_rows(v_new)
+        kc = _fa.cache_insert(cache["k"], kq, lengths, write)
+        vc = _fa.cache_insert(cache["v"], vq, lengths, write)
+        ksc = _fa.cache_insert(cache["k_scale"], ks, lengths, write)
+        vsc = _fa.cache_insert(cache["v_scale"], vs, lengths, write)
+        dt = k_new.dtype
+        return ({"k": kc, "v": vc, "k_scale": ksc, "v_scale": vsc},
+                _q.dequantize_rows(kc, ksc, dt),
+                _q.dequantize_rows(vc, vsc, dt))
+    kc = _fa.cache_insert(cache["k"], k_new, lengths, write)
+    vc = _fa.cache_insert(cache["v"], v_new, lengths, write)
+    return {"k": kc, "v": vc}, kc, vc
 
 
 @layer("self_attention")
@@ -87,6 +145,7 @@ class SelfAttentionLayer(Layer):
     input feature dim at init (the Keras MultiHeadAttention default);
     ``has_bias`` adds per-projection biases (Keras MHA use_bias — DL4J's
     layer is bias-free, the default)."""
+    quantizable = True
     n_out: int = 0
     n_heads: int = 1
     head_size: Optional[int] = None
@@ -132,8 +191,13 @@ class SelfAttentionLayer(Layer):
     # every generated token attends over everything before it plus itself.
     # The equivalent one-shot mask is ``prefix_lm_bias`` below; the parity
     # suite asserts N-step decode == full-prefix recompute under it.
-    def decode_cache_spec(self, params, batch, cache_len, dtype):
-        return _kv_cache_spec(params, self.n_heads, batch, cache_len, dtype)
+    def quantize_spec(self, params):
+        return dict(_MHA_QUANT_SPEC)
+
+    def decode_cache_spec(self, params, batch, cache_len, dtype,
+                          kv_quant=False):
+        return _kv_cache_spec(params, self.n_heads, batch, cache_len,
+                              dtype, kv_quant)
 
     def prefill(self, params, x, state, *, cache, lengths, mask=None):
         q, k, v = _qkv(x, x, params, self.n_heads)
@@ -141,20 +205,16 @@ class SelfAttentionLayer(Layer):
         y = _proj(_heads_join(y), params["Wo"], params.get("bo"))
         if mask is not None:
             y = y * mask[..., None]
-        T = x.shape[1]
         # bucket-padded prompt rows land in the cache too; the decode-side
         # length bias masks them, so no per-row slicing is needed here
-        cache = {"k": cache["k"].at[:, :, :T].set(k.astype(cache["k"].dtype)),
-                 "v": cache["v"].at[:, :, :T].set(v.astype(cache["v"].dtype))}
+        cache = _cache_fill_prompt(cache, k, v)
         return y, cache
 
     def decode_step(self, params, x, state, *, cache, lengths, write=None):
         q, k_new, v_new = _qkv(x, x, params, self.n_heads)
-        kc = _fa.cache_insert(cache["k"], k_new, lengths, write)
-        vc = _fa.cache_insert(cache["v"], v_new, lengths, write)
-        y = _fa.decode_dispatch(q, kc, vc, jnp.asarray(lengths) + 1)
-        return _proj(_heads_join(y), params["Wo"], params.get("bo")), \
-            {"k": kc, "v": vc}
+        cache, kf, vf = _cache_append(cache, k_new, v_new, lengths, write)
+        y = _fa.decode_dispatch(q, kf, vf, jnp.asarray(lengths) + 1)
+        return _proj(_heads_join(y), params["Wo"], params.get("bo")), cache
 
     def full_context(self, params, x, state, *, bias, key_bias):
         """The naive full-recompute path (bench baseline / parity oracle):
@@ -171,6 +231,7 @@ class LearnedSelfAttentionLayer(Layer):
     """DL4J LearnedSelfAttentionLayer: n_queries LEARNED query vectors
     attend over the sequence -> fixed-size [B, n_queries, n_out] output
     (a sequence-summarizer; mask-aware)."""
+    quantizable = True
     n_out: int = 0
     n_heads: int = 1
     n_queries: int = 1
@@ -206,8 +267,13 @@ class LearnedSelfAttentionLayer(Layer):
     # cache each step (a sequence summarizer refreshed per token). The
     # learned queries are not sequence positions, so only key VALIDITY
     # masks apply — never the prefix-LM triangle.
-    def decode_cache_spec(self, params, batch, cache_len, dtype):
-        return _kv_cache_spec(params, self.n_heads, batch, cache_len, dtype)
+    def quantize_spec(self, params):
+        return dict(_MHA_QUANT_SPEC)  # learned queries Q stay f32
+
+    def decode_cache_spec(self, params, batch, cache_len, dtype,
+                          kv_quant=False):
+        return _kv_cache_spec(params, self.n_heads, batch, cache_len,
+                              dtype, kv_quant)
 
     def prefill(self, params, x, state, *, cache, lengths, mask=None):
         B = x.shape[0]
@@ -217,9 +283,7 @@ class LearnedSelfAttentionLayer(Layer):
         v = _heads_split(_proj(x, params["Wv"]), self.n_heads)
         y = _fa.attention(q, k, v, bias=_key_mask(mask))
         y = _proj(_heads_join(y), params["Wo"])
-        T = x.shape[1]
-        cache = {"k": cache["k"].at[:, :, :T].set(k.astype(cache["k"].dtype)),
-                 "v": cache["v"].at[:, :, :T].set(v.astype(cache["v"].dtype))}
+        cache = _cache_fill_prompt(cache, k, v)
         return y, cache
 
     def decode_step(self, params, x, state, *, cache, lengths, write=None):
@@ -228,11 +292,10 @@ class LearnedSelfAttentionLayer(Layer):
         q = _heads_split(_proj(xq, params["Wq"]), self.n_heads)
         k_new = _heads_split(_proj(x, params["Wk"]), self.n_heads)
         v_new = _heads_split(_proj(x, params["Wv"]), self.n_heads)
-        kc = _fa.cache_insert(cache["k"], k_new, lengths, write)
-        vc = _fa.cache_insert(cache["v"], v_new, lengths, write)
+        cache, kf, vf = _cache_append(cache, k_new, v_new, lengths, write)
         # n_queries > 1 rows: decode_dispatch routes to the reference path
-        y = _fa.decode_dispatch(q, kc, vc, jnp.asarray(lengths) + 1)
-        return _proj(_heads_join(y), params["Wo"]), {"k": kc, "v": vc}
+        y = _fa.decode_dispatch(q, kf, vf, jnp.asarray(lengths) + 1)
+        return _proj(_heads_join(y), params["Wo"]), cache
 
     def full_context(self, params, x, state, *, bias, key_bias):
         B = x.shape[0]

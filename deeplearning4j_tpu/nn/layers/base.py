@@ -79,12 +79,33 @@ class Layer:
         """-> (y, new_state, out_mask)"""
         raise NotImplementedError
 
+    # -- post-training quantization protocol (int8 serving, ISSUE 9) --------
+    #: ``decode_pointwise``-style opt-in mark: True when the layer's
+    #: matmul/conv weights may be quantized to per-channel int8 for
+    #: serving (dense / conv / attention projections). Conservative
+    #: default: False — norms, embeddings and recurrent cells stay f32
+    #: unless a layer opts in explicitly.
+    quantizable = False
+
+    def quantize_spec(self, params):
+        """``{param_name: output_channel_axis}`` for the weights the
+        post-training quantization walk (``ops/quantize.py``) should
+        turn into :class:`~...ops.quantize.QuantizedTensor`. Empty dict
+        = the layer stays f32. Only consulted when ``quantizable`` is
+        True — a subclass sets ``quantizable = False`` to opt back out
+        without overriding this. Derived from ``params`` so wrappers
+        can delegate."""
+        return {}
+
     # -- autoregressive decode protocol (KV-cache serving, ISSUE 8) ---------
-    def decode_cache_spec(self, params, batch, cache_len, dtype):
+    def decode_cache_spec(self, params, batch, cache_len, dtype,
+                          kv_quant: bool = False):
         """Per-layer decode cache spec: a dict of
         ``jax.ShapeDtypeStruct``s (e.g. ``{"k": ..., "v": ...}`` for
         attention), or None when the layer carries no KV state. Derived
-        from ``params`` so no extra shape plumbing is needed."""
+        from ``params`` so no extra shape plumbing is needed.
+        ``kv_quant``: int8 cache values with per-row f32 scales stored
+        beside them (ISSUE 9 — halves cache HBM)."""
         return None
 
     def prefill(self, params, x, state, *, cache, lengths, mask=None):

@@ -40,6 +40,7 @@ def _conv_out(size, k, s, p, mode):
 @layer("conv2d")
 class ConvolutionLayer(Layer):
     """DL4J ConvolutionLayer (2D). W: [nOut, nIn, kH, kW] (OIHW)."""
+    quantizable = True  # int8 serving: per-output-channel W (ISSUE 9)
     n_out: int = 0
     kernel: Tuple[int, int] = (3, 3)
     stride: Tuple[int, int] = (1, 1)
@@ -84,10 +85,20 @@ class ConvolutionLayer(Layer):
                    _conv_out(wd, ke_w, sw, pw, self.mode), self.n_out)
         return params, {}, out
 
+    def quantize_spec(self, params):
+        return {"W": 0}  # OIHW: one scale per output channel
+
     def apply(self, params, x, state, *, train=False, rng=None, mask=None):
-        y = nnops.conv2d(x, params["W"], params.get("b"), stride=self.stride,
-                         padding=self.padding, dilation=self.dilation,
-                         mode=self.mode, data_format=self.data_format)
+        w = params["W"]
+        from ...ops import quantize as _q
+        if isinstance(w, _q.QuantizedTensor):  # int8 serving (ISSUE 9)
+            y = _q.int8_conv(x, w, params.get("b"), stride=self.stride,
+                             padding=self.padding, dilation=self.dilation,
+                             mode=self.mode, data_format=self.data_format)
+        else:
+            y = nnops.conv2d(x, w, params.get("b"), stride=self.stride,
+                             padding=self.padding, dilation=self.dilation,
+                             mode=self.mode, data_format=self.data_format)
         return _act.get(self.activation)(y), state, mask
 
 

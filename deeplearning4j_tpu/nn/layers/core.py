@@ -17,10 +17,10 @@ from typing import Any, Optional, Tuple
 import jax
 import jax.numpy as jnp
 
-from ...environment import precision_for
 from ...ops import activations as _act
 from ...ops import losses as _loss
 from ...ops import nnops
+from ...ops.quantize import qdot
 from .. import weights as _winit
 from .base import Layer, layer
 
@@ -33,6 +33,7 @@ def _split(rng):
 class DenseLayer(Layer):
     """Fully connected layer (DL4J DenseLayer). W:[nIn,nOut] b:[nOut]."""
     decode_pointwise = True  # y_t depends only on x_t: safe in decode walks
+    quantizable = True       # int8 serving: per-output-channel W (ISSUE 9)
     n_out: int = 0
     n_in: Optional[int] = None  # inferred from input_shape when None
     activation: str = "identity"
@@ -49,8 +50,13 @@ class DenseLayer(Layer):
         b = jnp.full((self.n_out,), self.bias_init, dtype)
         return {"W": w, "b": b}, {}, input_shape[:-1] + (self.n_out,)
 
+    def quantize_spec(self, params):
+        return {"W": 1}  # [nIn, nOut]: one scale per output channel
+
     def apply(self, params, x, state, *, train=False, rng=None, mask=None):
-        y = jnp.dot(x, params["W"], precision=precision_for(x, params["W"])) + params["b"]
+        # qdot == jnp.dot for f32 weights; a QuantizedTensor W (serving)
+        # routes through the fused int8 kernel (ops/quantize.py)
+        y = qdot(x, params["W"], params["b"])
         return _act.get(self.activation)(y), state, mask
 
 
@@ -268,6 +274,7 @@ class _BaseOutput:
 class OutputLayer(Layer, _BaseOutput):
     """DenseLayer + loss head (DL4J OutputLayer)."""
     decode_pointwise = True
+    quantizable = True
     n_out: int = 0
     n_in: Optional[int] = None
     loss: str = "mcxent"
@@ -286,8 +293,11 @@ class OutputLayer(Layer, _BaseOutput):
         return ({"W": w, "b": jnp.full((self.n_out,), self.bias_init, dtype)},
                 {}, input_shape[:-1] + (self.n_out,))
 
+    def quantize_spec(self, params):
+        return {"W": 1}
+
     def apply(self, params, x, state, *, train=False, rng=None, mask=None):
-        logits = jnp.dot(x, params["W"], precision=precision_for(x, params["W"])) + params["b"]
+        logits = qdot(x, params["W"], params["b"])
         if train:
             return logits, state, mask  # loss consumes logits (fused path)
         return _act.get(self.activation)(logits), state, mask

@@ -616,6 +616,22 @@ class MultiLayerNetwork(_caches.CompiledCacheMixin):
         """Class indices (DL4J ``predict()``)."""
         return np.argmax(self.output(x), axis=-1)
 
+    def quantize_params(self, mode: str = "int8") -> dict:
+        """Post-training per-channel int8 quantization of the opted-in
+        matmul/conv weights (ISSUE 9): a layer walk mirroring the
+        decode/remat pattern — every layer whose ``quantize_spec`` names
+        weights gets them replaced by ``ops.quantize.QuantizedTensor``;
+        norms, biases and embeddings stay f32. Returns a NEW params tree
+        (the model's own f32 params are untouched — training and f32
+        serving keep working); the serving engines call this at warmup
+        (``InferenceEngine(quantize="int8")``) so every AOT bucket
+        executable compiles the quantized graph."""
+        if mode != "int8":
+            raise ValueError(f"unknown quantization mode {mode!r} "
+                             "(expected 'int8')")
+        from ..ops import quantize as _q
+        return _q.quantize_model_params(self)[0]
+
     # ----------------------------------------------------- rnnTimeStep state
     def rnn_time_step(self, x):
         """Stateful streaming inference (DL4J ``rnnTimeStep()``): feed
@@ -702,14 +718,18 @@ class MultiLayerNetwork(_caches.CompiledCacheMixin):
                     "time-pointwise)")
         return plan
 
-    def decode_cache_spec(self, batch: int, cache_len: int) -> dict:
+    def decode_cache_spec(self, batch: int, cache_len: int,
+                          kv_quant: bool = False) -> dict:
         """{layer_index: {"k": aval, "v": aval}} for the KV-cached layers
-        (compute dtype — what the decode executables actually hold)."""
+        (compute dtype — what the decode executables actually hold).
+        ``kv_quant`` (ISSUE 9): int8 cache values with per-row f32
+        scales stored beside them — halves the cache HBM per slot."""
         dt = _dt.resolve(self.conf.dtype)
         spec = {}
         for i, layer in enumerate(self.layers):
             s = layer.decode_cache_spec(self.params.get(str(i), {}),
-                                        batch, cache_len, dt)
+                                        batch, cache_len, dt,
+                                        kv_quant=kv_quant)
             if s is not None:
                 spec[str(i)] = s
         if not spec:
@@ -717,10 +737,12 @@ class MultiLayerNetwork(_caches.CompiledCacheMixin):
                              "decode incrementally")
         return spec
 
-    def init_decode_cache(self, batch: int, cache_len: int) -> dict:
+    def init_decode_cache(self, batch: int, cache_len: int,
+                          kv_quant: bool = False) -> dict:
         """Zero-initialized decode cache pytree for one slot batch."""
         return jax.tree.map(lambda a: jnp.zeros(a.shape, a.dtype),
-                            self.decode_cache_spec(batch, cache_len))
+                            self.decode_cache_spec(batch, cache_len,
+                                                   kv_quant=kv_quant))
 
     def _decode_cast(self, params, x):
         dt = _dt.resolve(self.conf.dtype)

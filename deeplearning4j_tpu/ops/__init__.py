@@ -91,3 +91,4 @@ from . import nnops  # noqa: E402,F401
 from . import random  # noqa: E402,F401
 from . import reduce  # noqa: E402,F401
 from . import flash_attention  # noqa: E402,F401  (attention.fused_sdpa)
+from . import quantize  # noqa: E402,F401  (quantize.int8_mmul)

@@ -1,0 +1,470 @@
+"""Int8 post-training quantization primitives (ISSUE 9 tentpole, layer 1).
+
+Production serving is memory-bound: the hot path streams weights (and,
+for generative decode, the KV cache) out of HBM every request. Symmetric
+int8 weights halve that traffic and roughly double the serveable batch
+per the r9 HBM accounting, and on TPU an int8 x int8 -> int32 contraction
+is a NATIVE MXU pass (``jax.lax.dot_general`` with int8 operands and
+``preferred_element_type=jnp.int32`` lowers to it — the same contract the
+conv path uses). This module is the primitive set the rest of the stack
+rides:
+
+- :func:`quantize_per_channel` / :class:`QuantizedTensor` — per-channel
+  symmetric int8 weight quantization with f32 scales (one scale per
+  OUTPUT channel; zero-point-free, range ±127 so negation is closed).
+  ``QuantizedTensor`` is a registered pytree, so a quantized params tree
+  flows through ``jax.eval_shape`` / ``device_put`` / the serving
+  engines' placement walks like any other params tree.
+- :func:`quantize_dynamic` / :func:`quantize_per_example` — dynamic
+  activation scales computed inside the compiled graph per call (no
+  calibration dataset; the TF-Serving posture of quantization as a
+  deploy-time engine transform, not a training-time concern). The fused
+  kernels use the PER-EXAMPLE variant: under coalesced serving a
+  per-tensor scale would couple co-batched requests (one request's
+  outlier crushes its neighbours' resolution); per-example scales keep
+  each row's answer independent of its batch neighbours
+  (batch-invariance, regression-tested).
+- :func:`int8_matmul` / :func:`int8_conv` — the fused kernels: quantize
+  the activation, contract in int8 with an int32 accumulator, and
+  dequantize INTO the accumulator epilogue (one multiply by
+  ``x_scale * w_scale[channel]``). Integer arithmetic is exact, so the
+  ``dot_general`` path and the einsum reference path are BIT-identical
+  — that is the CPU-deterministic parity contract tier-1 asserts
+  without an MXU (``impl`` knob / ``DL4J_TPU_QUANT_IMPL``).
+- :func:`quantize_rows` / :func:`dequantize_rows` — per-row (per slot,
+  head, position) int8 KV-cache quantization for the generative decode
+  path: scales stored beside the ``(k, v, length)`` buckets, shaped
+  ``[B, H, C, 1]`` so ``flash_attention.cache_insert`` appends them with
+  the same machinery as the values.
+
+Env pins: ``DL4J_TPU_QUANT`` (``int8`` | ``off`` — ``off`` makes every
+engine-level ``quantize="int8"`` request serve f32, counted as a
+fallback, the CI kill switch) and ``DL4J_TPU_QUANT_IMPL``
+(``dot`` | ``einsum``). Every routing decision bumps
+``quantize.dispatch{decision=}`` — zero silent fallbacks, same registry
+posture as ``flash_attention.dispatch``.
+
+Divergence (recorded in PARITY.md): DL4J/nd4j quantization
+(``INDArray`` half/quarter-precision compression) was a training-side
+storage codec; there is no DL4J int8 *serving* path, and dynamic
+activation scales have no reference equivalent at all.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import register
+from ..runtime import telemetry as _tel
+
+#: symmetric range: ±127 (not -128) so q == -q is representable and the
+#: scale math stays zero-point-free
+QMAX = 127.0
+#: scale floor for all-zero channels/tensors: dequantizes to exact zeros
+#: without a divide-by-zero in the quantize direction
+_EPS = 1e-12
+
+_DISPATCH = _tel.counter(
+    "quantize.dispatch",
+    "int8 kernel dispatch decisions at trace time (dot vs einsum)")
+_REWRITE = _tel.counter(
+    "quantize.rewrite",
+    "SameDiff weight-quantization rewrite decisions per site "
+    "(matched vs skipped_<reason>)")
+
+_state = {
+    "mode": os.environ.get("DL4J_TPU_QUANT", "int8"),
+    "impl": os.environ.get("DL4J_TPU_QUANT_IMPL", "dot"),
+}
+
+
+def mode() -> str:
+    """``int8`` (quantization honored when an engine asks for it) or
+    ``off`` (the ``DL4J_TPU_QUANT=off`` CI pin: every engine-level
+    quantize request serves f32 instead, counted as a fallback)."""
+    return _state["mode"]
+
+
+def set_mode(m: str) -> str:
+    if m not in ("int8", "off"):
+        raise ValueError(f"quantize mode {m!r} not in ('int8', 'off')")
+    old = _state["mode"]
+    _state["mode"] = m
+    return old
+
+
+def impl() -> str:
+    return _state["impl"]
+
+
+def set_impl(i: str) -> str:
+    """``dot`` (``lax.dot_general`` — the native int8 MXU lowering) or
+    ``einsum`` (the reference spelling). Integer arithmetic is exact, so
+    the two are bit-identical — the parity test's lever. Consulted at
+    TRACE time (same caveat as ``flash_attention.set_mode``)."""
+    if i not in ("dot", "einsum"):
+        raise ValueError(f"quantize impl {i!r} not in ('dot', 'einsum')")
+    old = _state["impl"]
+    _state["impl"] = i
+    return old
+
+
+# --------------------------------------------------------------------------
+# quantized weight container
+# --------------------------------------------------------------------------
+
+@jax.tree_util.register_pytree_node_class
+class QuantizedTensor:
+    """Per-channel symmetric int8 weight: ``q`` int8 with the original
+    shape, ``scale`` f32 ``[channels]`` along ``axis`` (the OUTPUT
+    channel axis). A pytree node, so quantized params trees flow through
+    ``eval_shape``/``device_put``/placement walks unchanged; ``axis`` is
+    static aux data (part of the tree structure, never traced)."""
+
+    __slots__ = ("q", "scale", "axis")
+
+    #: duck-type marker for dtype-policy tree walks: ``cast_floating``
+    #: must leave a quantized leaf alone (the int8 values are not
+    #: floating, and casting the f32 scales to a 16-bit compute dtype
+    #: would permanently degrade dequantization accuracy)
+    __quantized_tensor__ = True
+
+    def __init__(self, q, scale, axis: int):
+        self.q = q
+        self.scale = scale
+        self.axis = int(axis)
+
+    def tree_flatten(self):
+        return (self.q, self.scale), self.axis
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(children[0], children[1], aux)
+
+    @property
+    def shape(self):
+        return self.q.shape
+
+    @property
+    def dtype(self):
+        return self.q.dtype
+
+    @property
+    def nbytes(self) -> int:
+        return _nbytes(self.q) + _nbytes(self.scale)
+
+    def _bcast_scale(self):
+        shape = [1] * len(self.q.shape)
+        shape[self.axis] = self.q.shape[self.axis]
+        # f32 regardless of what a dtype-policy tree cast did to the
+        # stored copy: the epilogue multiply is the accuracy-critical op
+        return jnp.asarray(self.scale, jnp.float32).reshape(shape)
+
+    def dequantize(self, dtype=jnp.float32):
+        return (self.q.astype(jnp.float32) * self._bcast_scale()).astype(
+            dtype)
+
+    def __repr__(self):
+        return (f"QuantizedTensor(int8 {tuple(self.q.shape)}, "
+                f"axis={self.axis})")
+
+
+def _nbytes(a) -> int:
+    return int(np.prod(a.shape)) * np.dtype(a.dtype).itemsize
+
+
+def quantize_per_channel(w, axis: int) -> QuantizedTensor:
+    """Symmetric per-channel int8 quantization of a weight: one f32
+    scale per slice along ``axis`` (``absmax / 127``), values rounded
+    half-to-even and clipped to ±127. All-zero channels get a unit scale
+    (dequantize to exact zeros)."""
+    w = jnp.asarray(w)
+    axis = axis % w.ndim
+    reduce_axes = tuple(i for i in range(w.ndim) if i != axis)
+    w32 = w.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(w32), axis=reduce_axes)            # [channels]
+    scale = jnp.where(amax <= _EPS, 1.0, amax / QMAX)
+    shape = [1] * w.ndim
+    shape[axis] = w.shape[axis]
+    q = jnp.clip(jnp.round(w32 / scale.reshape(shape)), -QMAX, QMAX)
+    return QuantizedTensor(q.astype(jnp.int8), scale, axis)
+
+
+def quantize_dynamic(x) -> Tuple[jax.Array, jax.Array]:
+    """Dynamic per-tensor symmetric activation quantization: returns
+    ``(q int8, scale f32 scalar)`` computed from this call's absmax —
+    inside the compiled graph, so serving needs no calibration pass and
+    out-of-distribution requests cannot fall outside a frozen range."""
+    x32 = jnp.asarray(x).astype(jnp.float32)
+    amax = jnp.max(jnp.abs(x32))
+    scale = jnp.where(amax <= _EPS, 1.0, amax / QMAX)
+    q = jnp.clip(jnp.round(x32 / scale), -QMAX, QMAX).astype(jnp.int8)
+    return q, scale
+
+
+def quantize_per_example(x) -> Tuple[jax.Array, jax.Array]:
+    """Dynamic PER-EXAMPLE activation quantization: one scale per
+    leading-axis row (``scale`` f32 shaped ``[B, 1, ..., 1]``). This is
+    what the fused kernels use — under coalesced serving, a per-tensor
+    scale would couple co-batched requests (one request's outlier
+    activation crushes its neighbours' int8 resolution, so the same
+    request could answer differently depending on who it was batched
+    with); per-example scales keep every row's quantization a function
+    of that row alone (batch-invariance, regression-tested)."""
+    x32 = jnp.asarray(x).astype(jnp.float32)
+    axes = tuple(range(1, x32.ndim))
+    amax = jnp.max(jnp.abs(x32), axis=axes, keepdims=True)
+    scale = jnp.where(amax <= _EPS, 1.0, amax / QMAX)
+    q = jnp.clip(jnp.round(x32 / scale), -QMAX, QMAX).astype(jnp.int8)
+    return q, scale
+
+
+# --------------------------------------------------------------------------
+# fused int8 kernels (dequantize in the accumulator epilogue)
+# --------------------------------------------------------------------------
+
+def _int8_contract(xq, wq):
+    """int8 x int8 -> int32 over (x's last dim, w's first dim). The
+    ``dot`` impl is the native-MXU lowering; ``einsum`` is the reference
+    spelling — integer arithmetic, so bit-identical (parity-tested)."""
+    if _state["impl"] == "einsum":
+        _DISPATCH.inc(decision="einsum")
+        return jnp.einsum("...k,ko->...o", xq, wq,
+                          preferred_element_type=jnp.int32)
+    _DISPATCH.inc(decision="dot")
+    return jax.lax.dot_general(
+        xq, wq, (((xq.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32)
+
+
+def int8_matmul(x, wq, w_scale, bias=None, out_dtype=None):
+    """Fused quantized matmul: dynamic-quantize ``x`` with PER-EXAMPLE
+    scales (batch-invariant under request coalescing — see
+    :func:`quantize_per_example`), contract in int8 with an int32
+    accumulator, dequantize in the epilogue by
+    ``x_scale[row] * w_scale[out_channel]``. ``wq`` int8 ``[in, out]``,
+    ``w_scale`` f32 ``[out]``; output in ``x``'s (floating) dtype."""
+    x = jnp.asarray(x)
+    out_dtype = out_dtype or (x.dtype if jnp.issubdtype(x.dtype,
+                                                        jnp.floating)
+                              else jnp.float32)
+    if x.ndim >= 2:
+        # scale [B, 1, ..., 1]: constant over the contracted last axis,
+        # broadcasts over the accumulator's [..., out] unchanged
+        xq, xs = quantize_per_example(x)
+    else:  # 1-D x: the leading axis IS the contraction — per-tensor
+        xq, xs = quantize_dynamic(x)
+    acc = _int8_contract(xq, jnp.asarray(wq))
+    y = acc.astype(jnp.float32) * xs * jnp.asarray(w_scale, jnp.float32)
+    if bias is not None:
+        y = y + jnp.asarray(bias, jnp.float32)
+    return y.astype(out_dtype)
+
+
+def qdot(x, w, bias=None):
+    """``jnp.dot(x, w) + bias`` that routes :class:`QuantizedTensor`
+    weights through :func:`int8_matmul` — the one call site the dense /
+    output / attention-projection layers use, so a quantized params tree
+    changes the kernel without touching layer code paths."""
+    if isinstance(w, QuantizedTensor):
+        if w.axis != w.q.ndim - 1:
+            raise ValueError(
+                f"qdot needs output-channel-last quantization (axis="
+                f"{w.q.ndim - 1}); got axis={w.axis}")
+        return int8_matmul(x, w.q, jnp.asarray(w.scale, jnp.float32), bias)
+    from ..environment import precision_for
+    y = jnp.dot(x, w, precision=precision_for(x, w))
+    return y if bias is None else y + bias
+
+
+def int8_conv(x, w: QuantizedTensor, b=None, stride=(1, 1), padding=0,
+              dilation=(1, 1), mode="truncate", data_format="NCHW",
+              groups: int = 1):
+    """Fused quantized 2D convolution (OIHW weights quantized per OUTPUT
+    channel, ``axis=0``): dynamic-quantize ``x`` per example (batch-
+    invariant — see :func:`quantize_per_example`), integer conv with an
+    int32 accumulator (``preferred_element_type`` — the native int8 MXU
+    conv pass on TPU), dequantize per output channel in the epilogue."""
+    from .nnops import _conv_dnums, _conv_padding, _pair
+    if w.axis != 0:
+        raise ValueError(f"int8_conv wants per-output-channel (axis=0) "
+                         f"quantization; got axis={w.axis}")
+    x = jnp.asarray(x)
+    out_dtype = x.dtype if jnp.issubdtype(x.dtype, jnp.floating) \
+        else jnp.float32
+    stride, dilation = _pair(stride), _pair(dilation)
+    kh, kw = w.q.shape[2], w.q.shape[3]
+    io_layout, _, out_layout = _conv_dnums(data_format)
+    dn = jax.lax.conv_dimension_numbers(x.shape, w.q.shape,
+                                        (io_layout, "OIHW", out_layout))
+    pad = _conv_padding(mode, padding, (kh, kw), stride, dilation)
+    xq, xs = quantize_per_example(x)  # [N,1,1,1]: per-row decoupling
+    _DISPATCH.inc(decision="conv")
+    acc = jax.lax.conv_general_dilated(
+        xq, w.q, window_strides=stride, padding=pad,
+        rhs_dilation=dilation, dimension_numbers=dn,
+        feature_group_count=groups, preferred_element_type=jnp.int32)
+    ws = jnp.asarray(w.scale, jnp.float32)
+    chan = ws.reshape((1, -1, 1, 1) if data_format == "NCHW"
+                      else (1, 1, 1, -1))
+    y = acc.astype(jnp.float32) * (xs * chan)
+    if b is not None:
+        y = y + (jnp.asarray(b, jnp.float32).reshape(1, -1, 1, 1)
+                 if data_format == "NCHW"
+                 else jnp.asarray(b, jnp.float32).reshape(1, 1, 1, -1))
+    return y.astype(out_dtype)
+
+
+# --------------------------------------------------------------------------
+# int8 KV cache (generative decode): per-row scales beside the buckets
+# --------------------------------------------------------------------------
+
+def quantize_rows(x) -> Tuple[jax.Array, jax.Array]:
+    """Per-row KV quantization: ``x`` ``[B, H, T, d]`` -> ``(q int8,
+    scale f32 [B, H, T, 1])`` — one scale per (slot, head, position), so
+    every appended token quantizes against its OWN range (a loud outlier
+    token cannot crush the whole cache's resolution) and the scale
+    tensor appends through ``flash_attention.cache_insert`` exactly like
+    a ``d=1`` value cache."""
+    x32 = jnp.asarray(x).astype(jnp.float32)
+    amax = jnp.max(jnp.abs(x32), axis=-1, keepdims=True)
+    scale = jnp.where(amax <= _EPS, 1.0, amax / QMAX)
+    q = jnp.clip(jnp.round(x32 / scale), -QMAX, QMAX).astype(jnp.int8)
+    return q, scale
+
+
+def dequantize_rows(q, scale, dtype):
+    """Inverse of :func:`quantize_rows`: int8 cache + ``[B, H, C, 1]``
+    scales -> the compute-dtype cache the decode kernel streams."""
+    return (q.astype(jnp.float32) * jnp.asarray(scale, jnp.float32)) \
+        .astype(dtype)
+
+
+# --------------------------------------------------------------------------
+# params-tree walk (shared by MLN / CG quantize_params and the engines)
+# --------------------------------------------------------------------------
+
+class QuantizeReport:
+    """What a params-tree (or graph) quantization pass did: ``sites`` =
+    weights quantized, ``skipped`` = candidate records left f32 (with
+    reasons), plus the byte accounting behind the serveable-batch
+    claim."""
+
+    def __init__(self):
+        self.sites = 0
+        self.skipped = 0
+        self.reasons = []
+        self.bytes_f32 = 0
+        self.bytes_q = 0
+
+    @property
+    def bytes_saved(self) -> int:
+        return max(0, self.bytes_f32 - self.bytes_q)
+
+    def __str__(self):
+        return (f"quantize: {self.sites} weights -> int8 "
+                f"({self.bytes_f32} -> {self.bytes_q} bytes), "
+                f"{self.skipped} skipped")
+
+
+def quantize_layer_params(layer, params, report: Optional[QuantizeReport]
+                          = None) -> dict:
+    """Quantize one layer's weights per its ``quantize_spec`` (the
+    ``decode_pointwise``-style opt-in mark on ``nn/layers/base.py``):
+    the named leaves become :class:`QuantizedTensor`; everything else
+    (biases, norms, embeddings, learned queries) stays f32. The
+    ``quantizable`` class flag gates the spec, so a subclass can opt
+    back OUT (``quantizable = False``) without overriding the method."""
+    if not params or not getattr(layer, "quantizable", False):
+        return params
+    spec = layer.quantize_spec(params)
+    if not spec:
+        return params
+    new = dict(params)
+    for name, axis in spec.items():
+        w = new.get(name)
+        if w is None or isinstance(w, QuantizedTensor):
+            continue
+        qt = quantize_per_channel(w, axis)
+        if report is not None:
+            report.sites += 1
+            report.bytes_f32 += _nbytes(w)
+            report.bytes_q += qt.nbytes
+        new[name] = qt
+    return new
+
+
+def quantize_model_params(model) -> Tuple[dict, QuantizeReport]:
+    """Layer-walk post-training quantization for MultiLayerNetwork and
+    ComputationGraph (the decode/remat walk pattern): returns a NEW
+    params tree with every opted-in weight quantized — the model's own
+    f32 params are untouched, so training and f32 serving continue to
+    work on the same instance."""
+    report = QuantizeReport()
+    out = {}
+    if hasattr(model.conf, "inputs"):              # ComputationGraph
+        from ..nn.vertices import LayerVertex
+        for name, (v, _ins) in model._vertex_map.items():
+            p = model.params.get(name)
+            if p is None:
+                continue
+            lyr = v.layer if isinstance(v, LayerVertex) else None
+            out[name] = quantize_layer_params(lyr, p, report) \
+                if lyr is not None else p
+    else:                                          # MultiLayerNetwork
+        for i, layer in enumerate(model.layers):
+            si = str(i)
+            p = model.params.get(si)
+            if p is None:
+                continue
+            out[si] = quantize_layer_params(layer, p, report)
+    return out, report
+
+
+def quantized_bytes(tree) -> Tuple[int, int]:
+    """(total_bytes, quantized_bytes) of a params tree — the HBM
+    accounting ``memory_report``/``max_batch`` and the serving stats
+    surface report."""
+    total = q = 0
+    for leaf in jax.tree.leaves(tree):
+        n = _nbytes(leaf)
+        total += n
+        if np.dtype(leaf.dtype) == np.dtype(np.int8):
+            q += n
+    return total, q
+
+
+# --------------------------------------------------------------------------
+# graph op (the SameDiff rewrite target — autodiff/quantize.py)
+# --------------------------------------------------------------------------
+
+@register("quantize.int8_mmul", category="quantize", differentiable=False)
+def int8_mmul(x, wq, w_scale):
+    """Quantized-weight matmul graph op: the rewrite target of the
+    SameDiff weight-quantization pass (``autodiff/quantize.py``),
+    replacing a ``linalg.mmul`` whose right operand was a stored 2-D
+    weight. ``wq`` int8 ``[in, out]`` constant, ``w_scale`` f32
+    ``[out]``; the activation quantizes dynamically per call.
+    Inference-only (rounding has no useful gradient — deploy-time
+    transform, recorded in PARITY.md)."""
+    return int8_matmul(x, wq, w_scale)
+
+
+def counters() -> dict:
+    """Dispatch-decision counts (trace-time, like
+    ``flash_attention.counters``)."""
+    return {k[0][1]: int(v) for k, v in _DISPATCH.series().items()}
+
+
+def rewrite_counters() -> dict:
+    return {k[0][1]: int(v) for k, v in _REWRITE.series().items()}
+
+
+def reset_counters() -> None:
+    _DISPATCH.zero()
+    _REWRITE.zero()

@@ -122,6 +122,7 @@ SITES = frozenset({
     "serving.dispatch",   # transient executor failure (retried once)
     "serving.slow",       # injected dispatch latency (overload -> shedding)
     "serving.decode",     # continuous-batching decode iteration failure
+    "serving.quantize",   # weight quantization failure -> f32 fallback
 })
 
 

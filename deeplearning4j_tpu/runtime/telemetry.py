@@ -789,7 +789,7 @@ def emit_event(event: dict) -> None:
 COMPILE_CAUSES = ("first_build", "warmup", "new_bucket", "dtype_policy",
                   "workspace_mode", "params_placement", "init",
                   "invalidate", "config_change", "precision", "probe",
-                  "lr_backoff", "autotune", "overlap")
+                  "lr_backoff", "autotune", "overlap", "quantize")
 
 _compile_counter = counter(
     "compile.events",

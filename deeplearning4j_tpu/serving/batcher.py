@@ -140,7 +140,8 @@ class ParallelInference:
                  shed_queue_depth: Optional[int] = None,
                  retry_transient: bool = True,
                  health_window_s: float = 5.0,
-                 degraded_p99_ms: Optional[float] = None):
+                 degraded_p99_ms: Optional[float] = None,
+                 quantize: Optional[str] = None):
         if mode not in (InferenceMode.SEQUENTIAL, InferenceMode.BATCHED):
             raise ValueError(f"unknown inference mode {mode!r}")
         if batch_limit is not None:  # deprecated alias
@@ -163,12 +164,20 @@ class ParallelInference:
         # the health window above this threshold reports DEGRADED even
         # with no hard failures (None = latency never degrades health)
         self.degraded_p99_ms = degraded_p99_ms
+        if engine is not None and quantize is not None:
+            # a silently-dropped quantize kwarg would serve f32 while
+            # the deploy config believes it is int8 — fail loudly
+            raise ValueError("pass quantize= on the engine you build "
+                             "(InferenceEngine(model, quantize=...)), "
+                             "not alongside engine=")
         if engine is None:
             # default: share the model's engine, so net.output() and the
-            # batcher hit the same warmed bucket cache; a mesh needs its
-            # own engine (sharded executables)
-            engine = InferenceEngine(model, mesh=mesh) if mesh is not None \
-                else model.inference_engine()
+            # batcher hit the same warmed bucket cache; a mesh or a
+            # quantize request needs its own engine (its executables are
+            # compiled over different params avals/shardings)
+            engine = (InferenceEngine(model, mesh=mesh, quantize=quantize)
+                      if mesh is not None or quantize is not None
+                      else model.inference_engine())
         self.engine = engine
         self._seq = any(engine._seq_input or ())
         if warmup:
@@ -720,11 +729,23 @@ class ContinuousBatcher:
                  token_to_features=None,
                  sample_fn=None,
                  engine: Optional["GenerativeEngine"] = None,
-                 warmup: bool = True):
+                 warmup: bool = True,
+                 quantize: Optional[str] = None,
+                 kv_cache: Optional[str] = None):
         from .engine import GenerativeEngine
         self.model = model
+        # ISSUE 9: quantize="int8" (weights) / kv_cache="int8" (per-row
+        # quantized KV buckets — half the cache HBM per slot) flow to the
+        # engine; with an explicit engine= the caller configures it there
+        # (passing both would silently serve the engine's config)
+        if engine is not None and (quantize is not None
+                                   or kv_cache is not None):
+            raise ValueError("pass quantize=/kv_cache= on the engine you "
+                             "build (GenerativeEngine(model, ...)), not "
+                             "alongside engine=")
         self.engine = engine if engine is not None \
-            else GenerativeEngine(model, slots=slots)
+            else GenerativeEngine(model, slots=slots, quantize=quantize,
+                                  kv_cache=kv_cache)
         self.slots = self.engine.slots
         self.max_cache_len = next_bucket(max_cache_len)
         self.min_cache_len = next_bucket(min_cache_len)

@@ -30,6 +30,7 @@ Works for both engines: ``MultiLayerNetwork`` (single input) and
 from __future__ import annotations
 
 import itertools
+import logging
 import threading
 import time
 import weakref
@@ -41,7 +42,11 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from .. import dtypes as _dt
+from ..ops import quantize as _q
+from ..runtime import faults as _faults
 from ..runtime import telemetry as _tel
+
+log = logging.getLogger("deeplearning4j_tpu")
 
 # per-engine counters live in the process-wide MetricsRegistry (ISSUE 6),
 # labeled by a monotonically assigned engine id so stats() keeps its
@@ -67,6 +72,24 @@ _H_PREFILL = _tel.histogram("serving.phase.prefill_s",
                             "prompt prefill time per admitted request")
 _H_DECODE = _tel.histogram("serving.phase.decode_step_s",
                            "one decode iteration over the slot batch")
+# int8 post-training quantization (ISSUE 9): calibration/dequant telemetry,
+# labeled engine= like every per-instance serving cell (anti-blending rule)
+_G_Q_SITES = _tel.gauge("serving.quantize.sites",
+                        "weights quantized to int8 in the serving params")
+_G_Q_WBYTES = _tel.gauge("serving.quantize.weight_bytes",
+                         "serving params bytes after quantization")
+_G_Q_SAVED = _tel.gauge("serving.quantize.bytes_saved",
+                        "params bytes saved by int8 quantization")
+_M_Q_REQUANT = _tel.counter(
+    "serving.quantize.requantizations",
+    "weight requantizations after a params update (no recompile: the "
+    "quantized avals are identical)")
+_M_Q_FALLBACK = _tel.counter(
+    "serving.quantize.fallbacks",
+    "quantize requests served f32 instead (env pin or quantization "
+    "failure — the engine degrades, it does not die)")
+_G_Q_KV = _tel.gauge("serving.quantize.kv_bytes",
+                     "decode KV-cache bytes at the current bucket")
 _engine_ids = itertools.count()
 
 
@@ -87,7 +110,124 @@ def default_buckets(max_batch: int = 64, minimum: int = 1) -> List[int]:
     return out
 
 
-class InferenceEngine:
+class _QuantizedParamsMixin:
+    """Quantize-on-warmup params source shared by both serving engines
+    (ISSUE 9). ``quantize="int8"`` makes :meth:`_serving_params` hand the
+    executables a per-channel int8 params tree instead of the model's
+    f32 one — quantized ONCE per params identity (warmup pays it; a
+    ``fit()`` rebinding the params requantizes host-side with identical
+    avals, so zero post-warmup compiles survive the transform). The
+    ``DL4J_TPU_QUANT=off`` env pin and any quantization failure (fault
+    site ``serving.quantize``) degrade to f32 serving, sticky + counted
+    — a quantizer bug must not flap executable shapes or kill serving."""
+
+    def _init_quantize(self, quantize: Optional[str]):
+        if quantize not in (None, "int8"):
+            raise ValueError(f"unknown quantize mode {quantize!r} "
+                             "(expected None or 'int8')")
+        self.quantize = quantize
+        self._qparams = None
+        self._qparams_src = None
+        self._q_report = None
+        self._q_disabled: Optional[str] = None   # sticky fallback reason
+
+    def _quantize_active(self) -> bool:
+        return self.quantize is not None and self._q_disabled is None
+
+    def _serving_params(self):
+        """The params tree the executables are compiled over and fed:
+        the model's own tree, or its quantized twin (identity-cached on
+        ``model.params`` — ``fit()`` rebinds the dict, so the cache
+        tracks updates exactly like ``_place_params``)."""
+        if self.quantize is None or self._q_disabled is not None:
+            return self.model.params
+        src = self.model.params
+        if self._qparams_src is src:
+            return self._qparams
+        if _q.mode() == "off" and self._qparams is None:
+            # CI kill switch, evaluated BEFORE anything compiled: serve
+            # f32, counted, sticky (a pin is a process constant — no
+            # shape flapping). Once an engine HAS warmed quantized, the
+            # executables' avals are int8+scale, so a later mode flip
+            # does not stop requantization — handing them f32 params
+            # would be a signature mismatch, and serving stale weights
+            # after a fit() would be silently wrong; use
+            # set_quantize(None) + re-warm to actually leave int8.
+            self._q_disabled = "env_off"
+            self._m_q_fallback.inc()
+            log.warning("DL4J_TPU_QUANT=off: engine quantize=%r request "
+                        "serves f32", self.quantize)
+            return self.model.params
+        try:
+            if _faults.enabled():
+                _faults.trip("serving.quantize")
+            qparams, report = _q.quantize_model_params(self.model)
+        except Exception as e:
+            self._m_q_fallback.inc()
+            if self._qparams is not None:
+                # a REquantization failed after warmup: keep serving the
+                # previous quantized tree (stale scales beat feeding f32
+                # avals to executables compiled for int8). The failed
+                # source is cached so a persistent failure does not
+                # re-walk + re-warn on EVERY request — the next params
+                # rebind (a new identity) retries
+                log.warning("weight requantization failed (%s: %s); "
+                            "serving the previous quantized params",
+                            type(e).__name__, e)
+                self._qparams_src = src
+                return self._qparams
+            # degrade, don't die: f32 serving with the failure counted;
+            # sticky so the executable avals never flap mid-traffic
+            self._q_disabled = "error"
+            log.warning("weight quantization failed (%s: %s); serving "
+                        "f32", type(e).__name__, e)
+            return self.model.params
+        if self._qparams_src is not None:
+            self._m_q_requant.inc()   # params updated -> fresh scales
+        self._qparams = qparams
+        self._qparams_src = src
+        self._q_report = report
+        self._g_q_sites.set(report.sites)
+        total, _qb = _q.quantized_bytes(qparams)
+        self._g_q_wbytes.set(total)
+        self._g_q_saved.set(report.bytes_saved)
+        return qparams
+
+    def _bind_quantize_cells(self):
+        self._m_q_requant = _M_Q_REQUANT.labeled(engine=self._id)
+        self._m_q_fallback = _M_Q_FALLBACK.labeled(engine=self._id)
+        self._g_q_sites = _G_Q_SITES.labeled(engine=self._id)
+        self._g_q_wbytes = _G_Q_WBYTES.labeled(engine=self._id)
+        self._g_q_saved = _G_Q_SAVED.labeled(engine=self._id)
+
+    def set_quantize(self, quantize: Optional[str]):
+        """Flip the engine's quantization mode. Every warmed executable
+        compiled the other params dtype, so the bucket cache is
+        invalidated with cause ``quantize`` — the retrace tracker
+        attributes the rebuilds instead of showing mystery
+        ``new_bucket`` events. Re-warm before traffic."""
+        if quantize not in (None, "int8"):
+            raise ValueError(f"unknown quantize mode {quantize!r} "
+                             "(expected None or 'int8')")
+        self.quantize = quantize
+        self._qparams = None
+        self._qparams_src = None
+        self._q_report = None
+        self._q_disabled = None
+        self.invalidate(cause="quantize")
+        return self
+
+    def _quantize_stats(self) -> dict:
+        out = {"quantize": self.quantize or "off"}
+        if self._q_disabled is not None:
+            out["quantize_fallback"] = self._q_disabled
+        if self._q_report is not None:
+            out["quantized_sites"] = self._q_report.sites
+            out["quantized_bytes_saved"] = self._q_report.bytes_saved
+        return out
+
+
+class InferenceEngine(_QuantizedParamsMixin):
     """Bucketed AOT-compiled ``output()`` for one model.
 
     Usage::
@@ -100,10 +240,18 @@ class InferenceEngine:
     ``mesh``: a ``jax.sharding.Mesh`` with a ``'data'`` axis — the padded
     batch is placed over it (bucket floor rises to the axis size so every
     device holds equal rows); params/state replicate.
+
+    ``quantize="int8"`` (ISSUE 9): post-training per-channel int8 weight
+    quantization applied ONCE at warmup — every bucket executable
+    compiles the quantized graph (int8 MXU matmul/conv passes, ~half the
+    weight HBM), requests quantize their activations dynamically inside
+    the program, and a later ``fit()`` requantizes host-side without a
+    single new compile. Accuracy is gated, not assumed:
+    ``eval.quantization.quantization_gate`` compares the two engines.
     """
 
     def __init__(self, model, mesh=None, data_axis: str = "data",
-                 min_bucket: int = 1):
+                 min_bucket: int = 1, quantize: Optional[str] = None):
         self.model = model
         self.mesh = mesh
         self.data_axis = data_axis
@@ -137,6 +285,8 @@ class InferenceEngine:
         # model churn cannot grow the registry (and /metrics) unboundedly
         self._id = str(next(_engine_ids))
         weakref.finalize(self, _tel.registry.discard_cells, engine=self._id)
+        self._init_quantize(quantize)
+        self._bind_quantize_cells()
         self._m_calls = _M_CALLS.labeled(engine=self._id)
         self._m_hits = _M_HITS.labeled(engine=self._id)
         self._m_compiles = _M_COMPILES.labeled(engine=self._id)
@@ -221,7 +371,8 @@ class InferenceEngine:
         leaving replicated NamedSharding arrays behind — must key (and
         lower) its own executable rather than feed the old one.
         Identity-cached: fit() rebinds the params dict, so the leaf walk
-        only reruns after an update."""
+        only reruns after an update. Quantized serving fingerprints the
+        quantized tree (its avals are what the executables see)."""
         params, state = self._place_params()
         # strong refs + `is` checks, NOT id(): a freed dict's address can
         # be reused by a later params tree, which would serve stale copies
@@ -259,7 +410,14 @@ class InferenceEngine:
         memory accounting only (identical program, so the per-device
         `memory_analysis` describes what serving will actually hold)."""
         _fp, p_sh, s_sh = self._params_placement()
-        params_avals = jax.eval_shape(lambda: self.model.params)
+        # quantized serving compiles over the quantized tree's avals
+        # (int8 weights + f32 scales) — memory_analysis therefore
+        # reports the REAL argument bytes, which is what max_batch's
+        # "quantized weights ~double the serveable batch" delta measures.
+        # Materialized OUTSIDE eval_shape: tracing the quantize walk
+        # would cache tracer arrays in the params source.
+        serving_params = self._serving_params()
+        params_avals = jax.eval_shape(lambda: serving_params)
         state_avals = jax.eval_shape(lambda: self.model.state)
         xs_sh, masks_sh = self._shardings(xs_avals, masks_avals)
         in_sh = None
@@ -558,7 +716,7 @@ class InferenceEngine:
         identity (fit() rebinds the dict, so identity tracks updates)."""
         model = self.model
         if self.mesh is None:
-            return model.params, model.state
+            return self._serving_params(), model.state
         src = self._placed_params_src  # strong refs; id() could be reused
         if src is None or src[0] is not model.params or \
                 src[1] is not model.state:
@@ -570,7 +728,7 @@ class InferenceEngine:
                     return leaf
                 return jax.device_put(leaf, repl)
 
-            self._placed = (jax.tree.map(place, model.params),
+            self._placed = (jax.tree.map(place, self._serving_params()),
                             jax.tree.map(place, model.state))
             self._placed_params_src = (model.params, model.state)
         return self._placed
@@ -628,10 +786,38 @@ class InferenceEngine:
                 out[labels["bucket"]] = int(v)
         return out
 
+    def memory_report(self, bucket: int, seq_buckets=None) -> dict:
+        """Compiled-HBM accounting of ONE serving bucket program (AOT
+        lower+compile, nothing executes — ``nn/memory.py`` contract):
+        ``memory_analysis`` fields plus the params-bytes split, so the
+        quantized-vs-f32 weight and argument deltas are measured numbers
+        (ISSUE 9 satellite). Probe compiles bypass the serving counters
+        but still reach the retrace tracker (cause=``probe``)."""
+        from ..nn import memory as _memory
+        b = next_bucket(int(bucket), self.min_bucket)
+        t = self._warmup_seq_lens(seq_buckets)[0]
+        xs_avals, masks_avals = self._bucket_avals(b, t)
+        with self._lock:
+            compiled = self._lower_bucket(xs_avals, masks_avals).compile()
+            _tel.record_compile("serving.engine", "probe",
+                                engine=self._id, bucket=f"[{b}]")
+        params = self._serving_params()
+        total, qbytes = _q.quantized_bytes(params)
+        report = {"bucket": b, "seq_len": t,
+                  "quantize": self.quantize or "off",
+                  "params_bytes": total,
+                  "quantized_weight_bytes": qbytes,
+                  "temp_bytes": None, "argument_bytes": None,
+                  "output_bytes": None, "peak_bytes": None}
+        cm = _memory.compiled_memory(compiled)
+        if cm:
+            report.update(cm)
+        return report
+
     def stats(self) -> dict:
         with self._lock:
             buckets = len(self._compiled)
-        return {
+        out = {
             "calls": self.calls,
             "hits": self.hits,
             "compiles": self.compiles,
@@ -639,6 +825,8 @@ class InferenceEngine:
             "compiled_buckets": buckets,
             "bucket_hits": self.bucket_hits,
         }
+        out.update(self._quantize_stats())
+        return out
 
 
 class DecodeState:
@@ -655,7 +843,7 @@ class DecodeState:
         self.cache_len = int(cache_len)
 
 
-class GenerativeEngine:
+class GenerativeEngine(_QuantizedParamsMixin):
     """Bucketed AOT-compiled autoregressive decode for one model
     (ISSUE 8 tentpole, layer 2): the generative sibling of
     :class:`InferenceEngine`, compiled per (slot-batch bucket x
@@ -679,17 +867,34 @@ class GenerativeEngine:
     Counters/phases ride the same registry families as the one-shot
     engine (``serving.engine.*`` labeled ``engine=<id>``), plus
     ``serving.phase.prefill_s`` / ``serving.phase.decode_step_s``.
+
+    ISSUE 9: ``quantize="int8"`` compiles every prefill/decode
+    executable over the per-channel int8 params tree (quantized once at
+    warmup, same contract as the one-shot engine); ``kv_cache="int8"``
+    stores the KV buckets as int8 with per-row f32 scales beside them
+    (``cache_insert`` quantizes on append) — half the cache HBM per
+    slot, which composes with continuous batching to roughly double
+    decode slot capacity per the r9 accounting.
     """
 
-    def __init__(self, model, slots: int = 8):
+    def __init__(self, model, slots: int = 8,
+                 quantize: Optional[str] = None,
+                 kv_cache: Optional[str] = None):
         self.model = model
         self.slots = int(slots)
+        if kv_cache not in (None, "int8"):
+            raise ValueError(f"unknown kv_cache mode {kv_cache!r} "
+                             "(expected None or 'int8')")
+        self.kv_cache = kv_cache
         self._compiled: Dict[Tuple, Any] = {}
         self._lock = threading.Lock()
         self._invalidate_cause: Optional[str] = None
         self._known: set = set()
         self._id = str(next(_engine_ids))
         weakref.finalize(self, _tel.registry.discard_cells, engine=self._id)
+        self._init_quantize(quantize)
+        self._bind_quantize_cells()
+        self._g_q_kv = _G_Q_KV.labeled(engine=self._id)
         self._m_calls = _M_CALLS.labeled(engine=self._id)
         self._m_hits = _M_HITS.labeled(engine=self._id)
         self._m_compiles = _M_COMPILES.labeled(engine=self._id)
@@ -701,15 +906,37 @@ class GenerativeEngine:
             model._serving_engines.add(self)
         except (AttributeError, TypeError):
             pass
+        # the env pin disables KV quantization along with the weights —
+        # one switch kills the whole int8 surface for CI. Frozen at
+        # construction: the cache avals are baked into every executable,
+        # so a mid-life mode flip must not flap them.
+        self._kv_quant = kv_cache == "int8" and _q.mode() != "off"
+        if kv_cache == "int8" and not self._kv_quant:
+            self._m_q_fallback.inc()
+            log.warning("DL4J_TPU_QUANT=off: kv_cache='int8' request "
+                        "serves float caches")
         # trace-time sanity: an un-decodable stack should fail at
         # construction, not at the first warmup compile
-        model.decode_cache_spec(1, 8)
+        model.decode_cache_spec(1, 8, kv_quant=self._kv_quant)
 
     # ---------------------------------------------------------- state blobs
+    def cache_bytes(self, cache_len: int) -> int:
+        """Decode-cache bytes at one bucket for the full slot batch —
+        the quantity ``kv_cache="int8"`` halves (the measured basis of
+        the "~2x decode slot capacity" claim; surfaced per state via the
+        ``serving.quantize.kv_bytes`` gauge)."""
+        c = next_bucket(cache_len)
+        spec = self.model.decode_cache_spec(self.slots, c,
+                                            kv_quant=self._kv_quant)
+        return sum(int(np.prod(a.shape)) * np.dtype(a.dtype).itemsize
+                   for a in jax.tree.leaves(spec))
+
     def new_state(self, cache_len: int) -> DecodeState:
         """Fresh zeroed decode state at the given cache bucket."""
         c = next_bucket(cache_len)
-        caches = self.model.init_decode_cache(self.slots, c)
+        caches = self.model.init_decode_cache(self.slots, c,
+                                              kv_quant=self._kv_quant)
+        self._g_q_kv.set(self.cache_bytes(c))
         return DecodeState(caches, jnp.zeros((self.slots,), jnp.int32), c)
 
     def grow(self, state: DecodeState, cache_len: int) -> DecodeState:
@@ -723,16 +950,24 @@ class GenerativeEngine:
         pad = c2 - state.cache_len
 
         def grow_leaf(a):
+            # every cache leaf is [S, H, C, d] with C on axis 2 — the
+            # int8 value buckets AND their [S, H, C, 1] scale buckets
             h = np.asarray(a)
             return jax.device_put(
                 np.pad(h, [(0, 0), (0, 0), (0, pad), (0, 0)]))
 
+        self._g_q_kv.set(self.cache_bytes(c2))
         return DecodeState(jax.tree.map(grow_leaf, state.caches),
                            state.lengths, c2)
 
     # ----------------------------------------------------------- compilation
     def _params_avals(self):
-        return (jax.eval_shape(lambda: self.model.params),
+        # quantized serving: the executables are compiled over (and fed)
+        # the int8 params tree — same contract as the one-shot engine.
+        # Materialized OUTSIDE eval_shape (tracing the quantize walk
+        # would cache tracer arrays in the params source).
+        serving_params = self._serving_params()
+        return (jax.eval_shape(lambda: serving_params),
                 jax.eval_shape(lambda: self.model.state))
 
     def _feature_dim(self) -> int:
@@ -769,10 +1004,12 @@ class GenerativeEngine:
         f = self._feature_dim()
         dt = _dt.resolve(model.conf.dtype)
 
+        kv_quant = self._kv_quant
+
         def fn(params, mstate, caches, lengths, x, plen, slot):
             mini = jax.tree.map(
                 lambda a: jnp.zeros(a.shape, a.dtype),
-                model.decode_cache_spec(1, c))
+                model.decode_cache_spec(1, c, kv_quant=kv_quant))
             y, mini = model._prefill(params, x, mstate, mini, plen[None])
             d = y.shape[-1]
             logits = jax.lax.dynamic_slice(
@@ -787,7 +1024,7 @@ class GenerativeEngine:
 
         def build():
             p_avals, s_avals = self._params_avals()
-            cache_avals = model.decode_cache_spec(S, c)
+            cache_avals = model.decode_cache_spec(S, c, kv_quant=kv_quant)
             return jax.jit(fn).lower(
                 p_avals, s_avals, cache_avals,
                 jax.ShapeDtypeStruct((S,), jnp.int32),
@@ -802,6 +1039,7 @@ class GenerativeEngine:
         S = self.slots
         f = self._feature_dim()
         dt = _dt.resolve(model.conf.dtype)
+        kv_quant = self._kv_quant
 
         def fn(params, mstate, caches, lengths, x_t, active):
             # the active mask gates the cache WRITE inside cache_insert
@@ -815,7 +1053,7 @@ class GenerativeEngine:
 
         def build():
             p_avals, s_avals = self._params_avals()
-            cache_avals = model.decode_cache_spec(S, c)
+            cache_avals = model.decode_cache_spec(S, c, kv_quant=kv_quant)
             # the caches are DONATED: XLA aliases the in/out buffers so
             # the per-token hot path updates the HBM cache in place
             # instead of copying O(slots x C) bytes every iteration
@@ -879,7 +1117,7 @@ class GenerativeEngine:
         tel = _tel.enabled()
         t0 = time.perf_counter() if tel else 0.0
         caches, lengths, logits = exe(
-            self.model.params, self.model.state, state.caches,
+            self._serving_params(), self.model.state, state.caches,
             state.lengths, x, np.int32(plen), np.int32(slot))
         logits = np.asarray(logits)
         if tel:
@@ -899,7 +1137,7 @@ class GenerativeEngine:
         tel = _tel.enabled()
         t0 = time.perf_counter() if tel else 0.0
         caches, lengths, logits = exe(
-            self.model.params, self.model.state, state.caches,
+            self._serving_params(), self.model.state, state.caches,
             state.lengths, x_t, np.asarray(active, np.int32))
         logits = np.asarray(logits)
         if tel:
@@ -927,6 +1165,9 @@ class GenerativeEngine:
     def stats(self) -> dict:
         with self._lock:
             buckets = len(self._compiled)
-        return {"calls": self.calls, "hits": self.hits,
-                "compiles": self.compiles, "compiled_buckets": buckets,
-                "slots": self.slots}
+        out = {"calls": self.calls, "hits": self.hits,
+               "compiles": self.compiles, "compiled_buckets": buckets,
+               "slots": self.slots,
+               "kv_cache": self.kv_cache if self._kv_quant else "off"}
+        out.update(self._quantize_stats())
+        return out

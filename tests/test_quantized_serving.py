@@ -1,0 +1,628 @@
+"""Int8 post-training quantized serving (ISSUE 9).
+
+Covers the whole transform stack: the ``ops/quantize.py`` primitive set
+(per-channel weights, dynamic activation scales, fused int8 matmul/conv
+with the dot-vs-einsum bit-parity contract), the MLN/CG layer-walk
+``quantize_params`` pass, the SameDiff ``quantize_weights`` rewrite, the
+quantize-on-warmup serving engines (zero post-warmup compiles, cause
+attribution, env pin + fault fallback), the int8 KV-cache decode path
+(full-recompute parity + join/leave neighbour bit-parity), and the
+eval-stack accuracy-delta gate — including the deliberately-broken-scales
+case that must trip it.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.config import InputType, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.layers.attention import SelfAttentionLayer
+from deeplearning4j_tpu.nn.layers.conv import ConvolutionLayer
+from deeplearning4j_tpu.nn.layers.core import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.model import MultiLayerNetwork
+from deeplearning4j_tpu.nn.updaters import Adam
+from deeplearning4j_tpu.ops import quantize as q
+from deeplearning4j_tpu.runtime import faults, telemetry as tel
+from deeplearning4j_tpu.serving.engine import GenerativeEngine, \
+    InferenceEngine
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+def _mlp(feat=8, seed=0):
+    conf = (NeuralNetConfiguration.builder().seed(seed)
+            .updater(Adam(learning_rate=1e-3))
+            .input_type(InputType.feed_forward(feat))
+            .list(DenseLayer(n_out=32, activation="relu"),
+                  DenseLayer(n_out=32, activation="tanh"),
+                  OutputLayer(n_out=5))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _attn_net(V=32, T=16, heads=2, seed=0):
+    conf = (NeuralNetConfiguration.builder().seed(seed)
+            .input_type(InputType.recurrent(V, T))
+            .list(SelfAttentionLayer(n_out=V, n_heads=heads),
+                  DenseLayer(n_out=64, activation="relu"),
+                  DenseLayer(n_out=V, activation="identity"),
+                  OutputLayer(n_out=V, activation="softmax"))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+# ---------------------------------------------------------------- primitives
+
+def test_per_channel_roundtrip_and_zero_channel(rng):
+    w = rng.normal(size=(24, 12)).astype(np.float32)
+    w[:, 3] = 0.0  # an all-zero channel must not divide by zero
+    qt = q.quantize_per_channel(w, 1)
+    assert qt.q.dtype == jnp.int8 and qt.scale.shape == (12,)
+    deq = np.asarray(qt.dequantize())
+    # symmetric int8: per-channel error bounded by scale/2 = amax/254
+    amax = np.abs(w).max(axis=0)
+    assert np.all(np.abs(deq - w) <= np.maximum(amax / 254, 1e-7) + 1e-7)
+    assert np.all(deq[:, 3] == 0.0)
+
+
+def test_dynamic_activation_scale(rng):
+    x = rng.normal(size=(4, 16)).astype(np.float32) * 10
+    xq, xs = q.quantize_dynamic(x)
+    assert xq.dtype == jnp.int8
+    err = np.abs(np.asarray(xq, np.float32) * float(xs) - x)
+    assert err.max() <= float(xs) / 2 + 1e-6
+    zq, zs = q.quantize_dynamic(np.zeros((3, 3), np.float32))
+    assert float(zs) == 1.0 and np.all(np.asarray(zq) == 0)
+
+
+def test_int8_matmul_accuracy_and_impl_bit_parity(rng):
+    x = rng.normal(size=(6, 64)).astype(np.float32)
+    w = rng.normal(size=(64, 32)).astype(np.float32)
+    qt = q.quantize_per_channel(w, 1)
+    q.reset_counters()
+    y_dot = np.asarray(q.int8_matmul(x, qt.q, qt.scale))
+    old = q.set_impl("einsum")
+    try:
+        y_ein = np.asarray(q.int8_matmul(x, qt.q, qt.scale))
+    finally:
+        q.set_impl(old)
+    # integer arithmetic: the two spellings are BIT-identical — the
+    # CPU-deterministic reference-path contract (no MXU needed)
+    assert np.array_equal(y_dot, y_ein)
+    ref = x @ w
+    assert np.abs(y_dot - ref).max() / np.abs(ref).max() < 0.03
+    counts = q.counters()
+    assert counts.get("dot", 0) >= 1 and counts.get("einsum", 0) >= 1
+
+
+def test_per_example_scales_are_batch_invariant(rng):
+    """A request's int8 answer must not depend on its batch neighbours:
+    per-example activation scales keep row 0 BIT-identical whether it is
+    served alone or coalesced with an outlier request whose activations
+    are 1000x larger (a per-tensor scale would crush row 0's resolution
+    — the serving-coupling bug the review caught)."""
+    x0 = rng.normal(size=(1, 32)).astype(np.float32)
+    outlier = rng.normal(size=(3, 32)).astype(np.float32) * 1000.0
+    w = rng.normal(size=(32, 8)).astype(np.float32)
+    qt = q.quantize_per_channel(w, 1)
+    alone = np.asarray(q.int8_matmul(x0, qt.q, qt.scale))
+    batched = np.asarray(q.int8_matmul(
+        np.concatenate([x0, outlier]), qt.q, qt.scale))
+    assert np.array_equal(alone[0], batched[0])
+    # conv path too (per-example over C,H,W)
+    xc0 = rng.normal(size=(1, 3, 6, 6)).astype(np.float32)
+    xco = rng.normal(size=(2, 3, 6, 6)).astype(np.float32) * 1000.0
+    wc = q.quantize_per_channel(
+        rng.normal(size=(4, 3, 3, 3)).astype(np.float32), 0)
+    c_alone = np.asarray(q.int8_conv(xc0, wc))
+    c_batched = np.asarray(q.int8_conv(np.concatenate([xc0, xco]), wc))
+    assert np.array_equal(c_alone[0], c_batched[0])
+
+
+def test_qdot_routes_and_validates(rng):
+    x = rng.normal(size=(3, 8)).astype(np.float32)
+    w = rng.normal(size=(8, 4)).astype(np.float32)
+    qt = q.quantize_per_channel(w, 1)
+    assert np.array_equal(np.asarray(q.qdot(x, qt)),
+                          np.asarray(q.int8_matmul(x, qt.q, qt.scale)))
+    # f32 weights: plain dot (bit-equal to the pre-quantize layer path)
+    assert np.allclose(np.asarray(q.qdot(x, w)), x @ w, atol=1e-6)
+    with pytest.raises(ValueError, match="output-channel-last"):
+        q.qdot(x, q.quantize_per_channel(w, 0))
+
+
+def test_int8_conv_matches_f32_conv(rng):
+    from deeplearning4j_tpu.ops import nnops
+    x = rng.normal(size=(2, 3, 10, 10)).astype(np.float32)
+    w = rng.normal(size=(5, 3, 3, 3)).astype(np.float32)
+    b = rng.normal(size=(5,)).astype(np.float32)
+    qt = q.quantize_per_channel(w, 0)
+    y_q = np.asarray(q.int8_conv(x, qt, b, stride=(1, 1)))
+    y_f = np.asarray(nnops.conv2d(x, w, b, stride=(1, 1)))
+    assert y_q.shape == y_f.shape
+    assert np.abs(y_q - y_f).max() / np.abs(y_f).max() < 0.05
+
+
+def test_quantized_tensor_is_a_pytree(rng):
+    qt = q.quantize_per_channel(rng.normal(size=(8, 4)).astype(np.float32),
+                                1)
+    leaves = jax.tree.leaves({"W": qt, "b": np.zeros(4)})
+    assert len(leaves) == 3  # q, scale, b
+    avals = jax.eval_shape(lambda: qt)
+    assert isinstance(avals, q.QuantizedTensor)
+    assert avals.q.dtype == jnp.int8
+
+
+# ------------------------------------------------------------- layer walks
+
+def test_quantize_params_walk_mln(rng):
+    net = _mlp()
+    qp = net.quantize_params()
+    for si in qp:
+        assert isinstance(qp[si]["W"], q.QuantizedTensor)
+        assert qp[si]["b"].dtype == jnp.float32  # biases stay f32
+    # the model's own params are untouched (training keeps working)
+    assert all(not isinstance(l, q.QuantizedTensor)
+               for l in jax.tree.leaves(net.params))
+    x = rng.normal(size=(3, 8)).astype(np.float32)
+    y_f = np.asarray(net._forward(net.params, jnp.asarray(x), net.state,
+                                  train=False, rng=None)[0])
+    y_q = np.asarray(net._forward(qp, jnp.asarray(x), net.state,
+                                  train=False, rng=None)[0])
+    assert np.abs(y_q - y_f).max() < 0.05
+
+
+def test_quantize_params_skips_unmarked_layers(rng):
+    from deeplearning4j_tpu.nn.layers.core import EmbeddingLayer
+    conf = (NeuralNetConfiguration.builder().seed(0)
+            .input_type(InputType.feed_forward(1))
+            .list(EmbeddingLayer(n_in=16, n_out=8),
+                  OutputLayer(n_out=3))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    qp = net.quantize_params()
+    # embeddings stay f32 (lookup tables are gather, not matmul)
+    assert not isinstance(qp["0"]["W"], q.QuantizedTensor)
+    assert isinstance(qp["1"]["W"], q.QuantizedTensor)
+
+
+def test_quantize_params_walk_cg_conv(rng):
+    from deeplearning4j_tpu.nn.graph import ComputationGraph, GraphBuilder
+    conf = (GraphBuilder()
+            .add_inputs("in").set_input_types((3, 8, 8))
+            .layer("conv", ConvolutionLayer(n_out=4, kernel=(3, 3),
+                                            activation="relu"), "in")
+            .layer("flat",
+                   __import__("deeplearning4j_tpu.nn.layers.core",
+                              fromlist=["FlattenLayer"]).FlattenLayer(),
+                   "conv")
+            .layer("out", OutputLayer(n_out=5), "flat")
+            .set_outputs("out").build())
+    net = ComputationGraph(conf).init()
+    qp = net.quantize_params()
+    assert isinstance(qp["conv"]["W"], q.QuantizedTensor)
+    assert qp["conv"]["W"].axis == 0  # OIHW: per-output-channel
+    assert isinstance(qp["out"]["W"], q.QuantizedTensor)
+    x = rng.normal(size=(2, 3, 8, 8)).astype(np.float32)
+    acts_f, _, _ = net._forward(net.params, {"in": jnp.asarray(x)},
+                                net.state, train=False, rng=None)
+    acts_q, _, _ = net._forward(qp, {"in": jnp.asarray(x)}, net.state,
+                                train=False, rng=None)
+    y_f, y_q = np.asarray(acts_f["out"]), np.asarray(acts_q["out"])
+    assert np.abs(y_q - y_f).max() < 0.1
+
+
+def test_mixed_precision_policy_keeps_scales_f32(rng):
+    """Under a BFLOAT16 dtype policy, `_forward`'s cast_floating must
+    leave QuantizedTensor leaves whole: a bf16-rounded scale would
+    permanently degrade dequantization (review-caught). The quantized
+    engine output must therefore be IDENTICAL whether the model policy
+    is FLOAT or BFLOAT16-with-f32-masters, up to the activation cast."""
+    from deeplearning4j_tpu import dtypes as dt
+    net = _mlp()
+    qp = net.quantize_params()
+    cast = dt.cast_floating(qp, jnp.bfloat16)
+    for si in cast:
+        assert cast[si]["W"].scale.dtype == jnp.float32
+        assert cast[si]["W"].q.dtype == jnp.int8
+        assert cast[si]["b"].dtype == jnp.bfloat16  # plain leaves cast
+
+
+# --------------------------------------------------------- serving engines
+
+def test_engine_quantize_on_warmup_zero_postwarmup_compiles(rng):
+    net = _mlp()
+    eng = InferenceEngine(net, quantize="int8")
+    eng.warmup([1, 2, 4, 8])
+    ev0 = int(tel.registry.get("compile.events").total())
+    c0 = eng.compiles
+    x = rng.normal(size=(5, 8)).astype(np.float32)
+    y_q = np.asarray(eng.output(x))
+    assert eng.compiles == c0
+    assert int(tel.registry.get("compile.events").total()) == ev0
+    base = InferenceEngine(net).warmup([8])
+    y_f = np.asarray(base.output(x))
+    assert np.abs(y_q - y_f).max() < 0.05
+    st = eng.stats()
+    assert st["quantize"] == "int8" and st["quantized_sites"] == 3
+    assert st["quantized_bytes_saved"] > 0
+    assert base.stats()["quantize"] == "off"
+
+
+def test_engine_requantizes_after_fit_without_compiles(rng):
+    from deeplearning4j_tpu.data.dataset import DataSet
+    net = _mlp()
+    eng = InferenceEngine(net, quantize="int8").warmup([4])
+    x = rng.normal(size=(4, 8)).astype(np.float32)
+    y0 = np.asarray(eng.output(x))
+    c0 = eng.compiles
+    r0 = int(eng._m_q_requant.value())
+    xs = rng.normal(size=(16, 8)).astype(np.float32)
+    ys = np.eye(5, dtype=np.float32)[rng.integers(0, 5, 16)]
+    net.fit(DataSet(xs, ys), epochs=1)
+    y1 = np.asarray(eng.output(x))
+    # params changed -> fresh scales, same avals -> ZERO new compiles
+    assert eng.compiles == c0
+    assert int(eng._m_q_requant.value()) == r0 + 1
+    assert not np.array_equal(y0, y1)  # the update is actually served
+
+
+def test_set_quantize_records_cause_and_requires_rewarm(rng):
+    net = _mlp()
+    eng = InferenceEngine(net).warmup([4])
+    tel.reset_compile_events()
+    eng.set_quantize("int8")
+    eng.warmup([4])
+    evs = tel.compile_events("serving.engine")
+    assert any(e["cause"] == "quantize" for e in evs), evs
+    x = rng.normal(size=(3, 8)).astype(np.float32)
+    c0 = eng.compiles
+    eng.output(x)
+    assert eng.compiles == c0
+
+
+def test_engine_memory_report_accounts_quantized_bytes(rng):
+    net = _mlp()
+    base = InferenceEngine(net).memory_report(8)
+    quant = InferenceEngine(net, quantize="int8").memory_report(8)
+    assert quant["quantize"] == "int8"
+    assert quant["quantized_weight_bytes"] > 0
+    assert quant["params_bytes"] < base["params_bytes"]
+    # memory_analysis may be absent on this PJRT build — skip-guard
+    if base["argument_bytes"] is not None:
+        assert quant["argument_bytes"] < base["argument_bytes"]
+
+
+def test_env_pin_off_serves_f32(rng):
+    old = q.set_mode("off")
+    try:
+        net = _mlp()
+        eng = InferenceEngine(net, quantize="int8").warmup([4])
+        x = rng.normal(size=(3, 8)).astype(np.float32)
+        y = np.asarray(eng.output(x))
+        base = InferenceEngine(net).warmup([4])
+        # f32 fallback is BIT-equal to the plain engine
+        assert np.array_equal(y, np.asarray(base.output(x)))
+        assert int(eng._m_q_fallback.value()) == 1
+        assert eng.stats()["quantize_fallback"] == "env_off"
+    finally:
+        q.set_mode(old)
+
+
+def test_quantize_fault_falls_back_to_f32(rng):
+    net = _mlp()
+    faults.inject("serving.quantize", error="crash", times=1)
+    eng = InferenceEngine(net, quantize="int8").warmup([4])
+    x = rng.normal(size=(3, 8)).astype(np.float32)
+    y = np.asarray(eng.output(x))
+    base = InferenceEngine(net).warmup([4])
+    assert np.array_equal(y, np.asarray(base.output(x)))
+    assert int(eng._m_q_fallback.value()) == 1
+    assert eng.stats()["quantize_fallback"] == "error"
+    assert faults.counters()["serving.quantize"]["fired"] == 1
+    # sticky: the next call must NOT retry and flap the executable avals
+    eng.output(x)
+    assert int(eng._m_q_fallback.value()) == 1
+
+
+def test_parallel_inference_quantize_stats_flow(rng):
+    from deeplearning4j_tpu.serving import ParallelInference
+    net = _mlp()
+    pi = ParallelInference(net, quantize="int8", max_batch_size=8,
+                           max_wait_ms=1, warmup=True)
+    try:
+        x = rng.normal(size=(3, 8)).astype(np.float32)
+        pi.output(x)
+        st = pi.stats()
+        # GET /stats surface: the engine's quantization mode rides along
+        assert st["engine"]["quantize"] == "int8"
+        assert st["engine"]["quantized_sites"] == 3
+        # ...and through ServingStatsListener into StatsStorage
+        from deeplearning4j_tpu.ui.stats import ServingStatsListener
+        rec = ServingStatsListener(pi).report()
+        assert rec["engine"]["quantize"] == "int8"
+    finally:
+        pi.shutdown()
+
+
+# --------------------------------------------------- int8 KV-cache decode
+
+def test_int8_kv_decode_matches_full_recompute(rng):
+    """The r13 N-step-decode-vs-full-recompute parity suite, int8 KV
+    edition: greedy tokens must MATCH the f32 oracle and the raw outputs
+    stay within the documented quantization tolerance (max rel err <=
+    0.05 — per-row symmetric int8 on k/v, error ~1/254 per entry)."""
+    V = 32
+    net = _attn_net(V=V)
+    eng = GenerativeEngine(net, slots=2, kv_cache="int8")
+    eng.warmup([16], [8])
+    st = eng.new_state(16)
+    prompt = rng.normal(size=(5, V)).astype(np.float32)
+    st, logits = eng.prefill(st, prompt, 5, 0)
+    toks = [int(np.argmax(logits))]
+    outs = [logits]
+    x_t = np.zeros((2, 1, V), np.float32)
+    for _ in range(6):
+        x_t[0, 0] = np.eye(V, dtype=np.float32)[toks[-1]]
+        st, lg = eng.decode(st, x_t, np.array([1, 0], np.int32))
+        toks.append(int(np.argmax(lg[0])))
+        outs.append(lg[0])
+    # f32 full-recompute oracle, greedy lockstep
+    full = jax.jit(lambda p, s, x, pl, ln: net._full_context(p, x, s, pl,
+                                                             ln))
+    seq = np.zeros((1, 16, V), np.float32)
+    seq[0, :5] = prompt
+    lens = np.array([5])
+    for i in range(7):
+        y = np.asarray(full(net.params, net.state, seq, np.array([5]),
+                            lens))
+        row = y[0, lens[0] - 1]
+        t = int(np.argmax(row))
+        assert t == toks[i]
+        err = np.abs(np.asarray(outs[i]) - row).max()
+        assert err / max(np.abs(row).max(), 1e-6) <= 0.05
+        seq[0, lens[0]] = np.eye(V, dtype=np.float32)[t]
+        lens = lens + 1
+    assert int(eng._g_q_kv.value()) == eng.cache_bytes(16)
+
+
+def test_int8_kv_cache_bytes_halved():
+    net = _attn_net()
+    q8 = GenerativeEngine(net, slots=4, kv_cache="int8")
+    f32 = GenerativeEngine(net, slots=4)
+    # int8 values + per-row f32 scales: < half the f32 cache (the
+    # "~2x decode slot capacity" accounting, measured not claimed)
+    assert q8.cache_bytes(64) * 2 < f32.cache_bytes(64)
+    assert q8.stats()["kv_cache"] == "int8"
+    assert f32.stats()["kv_cache"] == "off"
+
+
+def test_int8_kv_write_gating_keeps_inactive_rows_bit_identical(rng):
+    net = _attn_net()
+    eng = GenerativeEngine(net, slots=2, kv_cache="int8")
+    eng.warmup([16], [8])
+    st = eng.new_state(16)
+    p0 = rng.normal(size=(5, 32)).astype(np.float32)
+    st, _ = eng.prefill(st, p0, 5, 0)
+    snap = jax.tree.map(lambda a: np.asarray(a).copy(), st.caches)
+    x_t = np.zeros((2, 1, 32), np.float32)
+    x_t[1, 0] = 1.0
+    # slot 0 inactive: its int8 values AND scale rows must not move
+    st, _ = eng.decode(st, x_t, np.array([0, 1], np.int32))
+    for si, c in st.caches.items():
+        for key in c:
+            assert np.array_equal(np.asarray(c[key])[0], snap[si][key][0])
+
+
+def test_int8_kv_join_leave_neighbour_bit_parity(rng):
+    """A slot's tokens are bit-identical whether or not another request
+    joins mid-generation — row independence survives quantization (the
+    r13 continuous-batching contract)."""
+    from deeplearning4j_tpu.serving import ContinuousBatcher
+    V = 32
+    net = _attn_net(V=V)
+    prompt_a = np.eye(V, dtype=np.float32)[rng.integers(0, V, 6)]
+    prompt_b = np.eye(V, dtype=np.float32)[rng.integers(0, V, 4)]
+
+    def run(submit_b):
+        cb = ContinuousBatcher(net, slots=2, max_cache_len=32,
+                               min_cache_len=32, max_new_tokens=8,
+                               kv_cache="int8")
+        try:
+            ha = cb.submit(prompt=prompt_a)
+            hb = cb.submit(prompt=prompt_b) if submit_b else None
+            res = ha.result(timeout=120)["tokens"]
+            if hb is not None:
+                hb.result(timeout=120)
+            return res
+        finally:
+            cb.shutdown()
+
+    assert run(False) == run(True)
+
+
+def test_generative_quantized_weights_and_kv_end_to_end(rng):
+    from deeplearning4j_tpu.serving import ContinuousBatcher
+    V = 32
+    net = _attn_net(V=V)
+    cb = ContinuousBatcher(net, slots=2, max_cache_len=32,
+                           min_cache_len=32, max_new_tokens=6,
+                           quantize="int8", kv_cache="int8")
+    try:
+        ev0 = int(tel.registry.get("compile.events").total())
+        h = cb.submit(prompt=np.eye(V, dtype=np.float32)[
+            rng.integers(0, V, 5)])
+        toks = h.result(timeout=120)["tokens"]
+        assert len(toks) == 6
+        assert int(tel.registry.get("compile.events").total()) == ev0
+        st = cb.stats()
+        assert st["engine"]["quantize"] == "int8"
+        assert st["engine"]["kv_cache"] == "int8"
+    finally:
+        cb.shutdown()
+
+
+# ------------------------------------------------------- SameDiff rewrite
+
+def _sd_mlp(rng, feat=8, hidden=16, classes=4):
+    from deeplearning4j_tpu.autodiff.samediff import SameDiff
+    sd = SameDiff.create()
+    x = sd.placeholder("x", (None, feat))
+    w1 = sd.var("w1", rng.normal(size=(feat, hidden)).astype(np.float32))
+    b1 = sd.var("b1", np.zeros(hidden, np.float32))
+    h = sd.relu(sd.mmul(x, w1) + b1, name="h")
+    w2 = sd.var("w2",
+                rng.normal(size=(hidden, classes)).astype(np.float32))
+    sd.softmax(sd.mmul(h, w2), name="out")
+    return sd
+
+
+def test_samediff_quantize_rewrite(rng):
+    from deeplearning4j_tpu.autodiff.quantize import quantize_weights
+    sd = _sd_mlp(rng)
+    feeds = {"x": rng.normal(size=(3, 8)).astype(np.float32)}
+    y0 = sd.output(feeds, ["out"])["out"]
+    rep = quantize_weights(sd)
+    assert rep.matched == 2 and rep.skipped == 0
+    assert rep.bytes_saved > 0
+    ops = [r.op for r in sd._ops]
+    assert ops.count("quantize.int8_mmul") == 2
+    assert "linalg.mmul" not in ops
+    y1 = sd.output(feeds, ["out"])["out"]
+    assert np.abs(y1 - y0).max() < 0.05
+    import deeplearning4j_tpu.ops as ops
+    ops.mark_fwd_tested("quantize.int8_mmul")  # grad: non-differentiable
+    # the f32 weight VALUES are gone (the HBM win); the int8+scale pair
+    # took their place
+    assert "w1" not in sd._values and "w1__q" in sd._values
+    assert sd._values["w1__q"].dtype == jnp.int8
+    assert q.rewrite_counters().get("matched", 0) >= 2
+
+
+def test_samediff_rewrite_skips_shared_and_transposed(rng):
+    from deeplearning4j_tpu.autodiff.quantize import quantize_weights
+    from deeplearning4j_tpu.autodiff.samediff import SameDiff
+    sd = SameDiff.create()
+    x = sd.placeholder("x", (None, 8))
+    w = sd.var("w", rng.normal(size=(8, 8)).astype(np.float32))
+    h = sd.mmul(x, w, name="h")
+    sd.call("math.add", h, w, name="out")  # w also read elsewhere: tied
+    rep = quantize_weights(sd)
+    assert rep.matched == 0 and rep.skipped == 1
+    assert "non-mmul consumers" in rep.reasons[0]
+    sd2 = SameDiff.create()
+    x2 = sd2.placeholder("x", (None, 8))
+    w2 = sd2.var("w2", rng.normal(size=(8, 8)).astype(np.float32))
+    sd2.call("linalg.mmul", x2, w2, name="o", transpose_b=True)
+    rep2 = quantize_weights(sd2)
+    assert rep2.matched == 0 and rep2.skipped == 1
+    assert "transpose" in rep2.reasons[0]
+
+
+def test_samediff_rewrite_serde_roundtrip(rng, tmp_path):
+    from deeplearning4j_tpu.autodiff.quantize import quantize_weights
+    from deeplearning4j_tpu.autodiff.samediff import SameDiff
+    sd = _sd_mlp(rng)
+    quantize_weights(sd)
+    feeds = {"x": rng.normal(size=(2, 8)).astype(np.float32)}
+    y0 = sd.output(feeds, ["out"])["out"]
+    path = str(tmp_path / "quantized.sdz")
+    sd.save(path)
+    sd2 = SameDiff.load(path)
+    assert np.array_equal(sd2.output(feeds, ["out"])["out"], y0)
+
+
+# ------------------------------------------------------ accuracy-delta gate
+
+def _golden_lenet():
+    """The golden-harness LeNet (tests/golden_harness.py model family)
+    trained a couple of steps so the gate measures a REAL model, not
+    random init."""
+    from deeplearning4j_tpu.data.dataset import DataSet
+    from deeplearning4j_tpu.models.lenet import lenet
+    rng = np.random.default_rng(20260730)
+    net = lenet(seed=777, updater=Adam(learning_rate=1e-3))
+    x = rng.normal(size=(16, 1, 28, 28)).astype(np.float32)
+    y = np.eye(10, dtype=np.float32)[rng.integers(0, 10, 16)]
+    net.fit(DataSet(x, y), epochs=2)
+    return net, rng
+
+
+def test_gate_passes_on_golden_mln():
+    from deeplearning4j_tpu.eval.quantization import quantization_gate
+    net, rng = _golden_lenet()
+    x = rng.normal(size=(8, 1, 28, 28)).astype(np.float32)
+    labels = rng.integers(0, 10, 8)
+    res = quantization_gate(net, x, labels=labels, max_delta=0.25)
+    assert res.passed
+    assert res.accuracy_baseline is not None
+    # cells are labeled by the quantized engine (anti-blending rule)
+    assert res.cell_labels.get("engine") is not None
+    assert float(tel.registry.get("serving.quantize.gate_delta")
+                 .value(**res.cell_labels)) == res.delta
+
+
+def test_gate_passes_on_cg_and_samediff(rng):
+    from deeplearning4j_tpu.autodiff.quantize import quantize_weights
+    from deeplearning4j_tpu.autodiff.samediff import SameDiff
+    from deeplearning4j_tpu.eval.quantization import accuracy_delta_gate, \
+        quantization_gate
+    from deeplearning4j_tpu.nn.graph import ComputationGraph, GraphBuilder
+    conf = (GraphBuilder()
+            .add_inputs("in").set_input_types((8,))
+            .layer("d", DenseLayer(n_out=16, activation="relu"), "in")
+            .layer("out", OutputLayer(n_out=4), "d")
+            .set_outputs("out").build())
+    cg = ComputationGraph(conf).init()
+    x = rng.normal(size=(4, 8)).astype(np.float32)
+    res = quantization_gate(cg, x, max_delta=0.25)
+    assert res.passed
+    # imported-graph flavor: original vs rewritten SameDiff clone
+    sd = _sd_mlp(rng)
+    qsd = SameDiff.from_json(sd.to_json())
+    qsd._values = dict(sd._values)
+    quantize_weights(qsd)
+    batches = [rng.normal(size=(4, 8)).astype(np.float32)
+               for _ in range(3)]
+    res2 = accuracy_delta_gate(
+        lambda b: sd.output({"x": b}, ["out"])["out"],
+        lambda b: qsd.output({"x": b}, ["out"])["out"],
+        batches, max_delta=0.25)
+    assert res2.passed
+
+
+def test_gate_trips_on_broken_scales(rng):
+    """Deliberately corrupt the quantized scales: the gate MUST fail —
+    a gate that cannot catch a broken quantizer gates nothing."""
+    from deeplearning4j_tpu.eval.quantization import QuantizationGateError, \
+        accuracy_delta_gate
+    net = _mlp()
+    qp = net.quantize_params()
+    broken = {si: {k: (q.QuantizedTensor(v.q, v.scale * 40.0, v.axis)
+                       if isinstance(v, q.QuantizedTensor) else v)
+                   for k, v in p.items()}
+              for si, p in qp.items()}
+    fwd = jax.jit(lambda p, x: net._forward(p, x, net.state, train=False,
+                                            rng=None)[0])
+    batches = [rng.normal(size=(8, 8)).astype(np.float32)
+               for _ in range(4)]
+    fails0 = int(tel.registry.get(
+        "serving.quantize.gate_failures").total())
+    with pytest.raises(QuantizationGateError):
+        accuracy_delta_gate(lambda b: fwd(net.params, b),
+                            lambda b: fwd(broken, b),
+                            batches, max_delta=0.05)
+    assert int(tel.registry.get(
+        "serving.quantize.gate_failures").total()) == fails0 + 1
+    res = accuracy_delta_gate(lambda b: fwd(net.params, b),
+                              lambda b: fwd(broken, b),
+                              batches, max_delta=0.05,
+                              raise_on_fail=False)
+    assert not res.passed

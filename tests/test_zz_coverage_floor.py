@@ -29,7 +29,7 @@ GRAD_FLOOR = 0.95
 # fast numpy oracles in test_ops_math.py).
 _MARKING_FILES = {"test_conv3d_capsules.py", "test_flash_attention.py",
                   "test_m17_breadth.py", "test_ops.py", "test_ops_math.py",
-                  "test_ops_grad_r5.py"}
+                  "test_ops_grad_r5.py", "test_quantized_serving.py"}
 
 
 def test_workspace_policy_coverage_floor(request):
@@ -58,9 +58,17 @@ def test_fault_site_coverage_floor(request):
     criterion). The ledger accumulates across the session and survives
     per-test faults.reset()."""
     collected = {item.fspath.basename for item in request.session.items}
-    if "test_resilience.py" not in collected:
-        pytest.skip("chunked run (test_resilience.py not collected); "
-                    "the fault-site floor is checked in full-suite runs")
+    # every file that fires part of the registered site set (the
+    # telemetry floor's `needed` pattern): resilience fires the train/
+    # checkpoint/data/one-shot-serving sites, generative decode fires
+    # serving.decode, quantized serving fires serving.quantize
+    needed = {"test_resilience.py", "test_generative_decode.py",
+              "test_quantized_serving.py"}
+    missing = needed - collected
+    if missing:
+        pytest.skip(f"chunked run (fault-firing files not collected: "
+                    f"{sorted(missing)}); the fault-site floor is "
+                    "checked in full-suite runs")
     from deeplearning4j_tpu.runtime import faults
     rep = faults.coverage_report()
     if not rep["fired"]:
@@ -88,7 +96,10 @@ def test_telemetry_metric_floor(request):
               "test_serving_engine.py", "test_autotune_overlap.py",
               # generative decode (ISSUE 8): serving.phase.prefill_s /
               # decode_step_s, serving.slots_active, tokens_generated
-              "test_generative_decode.py"}
+              "test_generative_decode.py",
+              # int8 quantized serving (ISSUE 9): quantize.dispatch /
+              # rewrite, serving.quantize.* cells, gate delta/failures
+              "test_quantized_serving.py"}
     missing = needed - collected
     if missing:
         pytest.skip(f"chunked run (telemetry-ledger-marking files not "
