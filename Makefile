@@ -1,0 +1,34 @@
+# Developer/CI entry points. The test suite itself is plain pytest (see
+# ROADMAP.md "Tier-1 verify" for the canonical command).
+
+PY ?= python
+
+.PHONY: test test-fast multihost-sim multihost-smoke bench
+
+# fast (tier-1) suite — what CI gates on
+test-fast:
+	env JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow' \
+		-p no:cacheprovider
+
+# everything, including the slow multi-process / import-corpus tests
+test:
+	env JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -p no:cacheprovider
+
+# ISSUE 10: full 2-process pod simulation (real subprocesses joined by
+# jax.distributed over loopback) — ZeRO-1 + hierarchical-overlap on the
+# 2-D pod mesh, 1-vs-2-host scaling, host-loss resume bit-equality,
+# 2->1 topology restore. Writes MULTICHIP_LOCAL_r07.json.
+multihost-sim:
+	$(PY) -m deeplearning4j_tpu.parallel.multihost_sim \
+		--outdir /tmp/dl4j_tpu_multihost_sim \
+		--artifact MULTICHIP_LOCAL_r07.json
+
+# the tier-1 smoke slice of the same harness: spawn the 2-process pod,
+# train 2 steps, shut down cleanly
+multihost-smoke:
+	$(PY) -c "from deeplearning4j_tpu.parallel.multihost_sim import \
+run_smoke; import json, tempfile; \
+print(json.dumps(run_smoke(tempfile.mkdtemp())))"
+
+bench:
+	$(PY) bench.py

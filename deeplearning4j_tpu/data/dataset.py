@@ -79,12 +79,29 @@ class DataSet:
 def _device_put_batch(ds: DataSet, sharding=None) -> DataSet:
     """Shallow-copied DataSet with every array moved to device (onto
     ``sharding`` when given). jax is imported lazily so the data layer
-    stays importable without it."""
+    stays importable without it.
+
+    Multi-host (ISSUE 10): when ``sharding`` spans processes (a pod
+    mesh's batch sharding), this host's local batch is its SHARD of the
+    global array — assembled with ``make_array_from_process_local_data``
+    (``device_put`` of host-local numpy onto a non-addressable sharding
+    is not defined). The HostShardedIterator → AsyncDataSetIterator(
+    device_prefetch=True, sharding=...) composition therefore ships each
+    host's slice to its own devices in the producer thread, and the
+    training step receives ready-made global arrays."""
     import jax
+
+    multiprocess = sharding is not None and any(
+        getattr(d, "process_index", 0) != jax.process_index()
+        for d in sharding.device_set)
 
     def put(a):
         if a is None:
             return None
+        if multiprocess:
+            import numpy as _np
+            return jax.make_array_from_process_local_data(
+                sharding, _np.asarray(a))
         return jax.device_put(a, sharding) if sharding is not None \
             else jax.device_put(a)
 

@@ -207,11 +207,15 @@ class CompiledCacheMixin(SentinelCounterMixin):
     # enabled() read per batch; disabled telemetry skips every clock.
 
     def _phase_clocks(self):
-        """(data_wait, step) bound histograms labeled ``model=<id>``."""
+        """(data_wait, step) bound histograms labeled ``model=<id>`` —
+        plus ``host=<process_index>`` on a multi-host run, so a pod-level
+        scrape/merge never blends the hosts' step-time distributions
+        (ISSUE 10 satellite; single-process cells stay unlabeled)."""
+        host = _tel.host_labels()
         return (_tel.histogram("train.phase.data_wait_s")
-                .labeled(model=self.telemetry_label),
+                .labeled(model=self.telemetry_label, **host),
                 _tel.histogram("train.phase.step_s")
-                .labeled(model=self.telemetry_label))
+                .labeled(model=self.telemetry_label, **host))
 
     @staticmethod
     def _timed_batches(it, h_wait):

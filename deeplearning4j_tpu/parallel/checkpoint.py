@@ -71,6 +71,14 @@ _ckpt_ids = itertools.count()
 MANIFEST = "manifest.sha256.json"
 
 
+def _primary_host() -> bool:
+    """Process 0 is the single manifest writer on a pod (and the only
+    process in a single-host run). A seam, so tests can simulate a
+    non-primary host without confusing orbax's own process_index view."""
+    import jax
+    return jax.process_index() == 0
+
+
 def _sha256(path: str) -> str:
     h = hashlib.sha256()
     with open(path, "rb") as f:
@@ -118,6 +126,7 @@ class TrainingCheckpointer:
 
         self._ocp = ocp
         self.directory = os.path.abspath(directory)
+        self._max_to_keep = max_to_keep
         self._mngr = ocp.CheckpointManager(
             self.directory,
             options=ocp.CheckpointManagerOptions(
@@ -142,8 +151,11 @@ class TrainingCheckpointer:
         self.save_latencies = deque(maxlen=512)
         self._id = str(next(_ckpt_ids))
         weakref.finalize(self, _tel.registry.discard_cells, ckpt=self._id)
-        self._h_save = _H_SAVE.labeled(ckpt=self._id)
-        self._h_restore = _H_RESTORE.labeled(ckpt=self._id)
+        # host=<process_index> rides along on pods so a pod-level scrape/
+        # merge can't blend per-host save latencies (ISSUE 10 satellite)
+        self._h_save = _H_SAVE.labeled(ckpt=self._id, **_tel.host_labels())
+        self._h_restore = _H_RESTORE.labeled(ckpt=self._id,
+                                             **_tel.host_labels())
 
     # -- save ---------------------------------------------------------------
     def save(self, model, iterator=None, step: Optional[int] = None,
@@ -294,9 +306,21 @@ class TrainingCheckpointer:
         durable-save latency. The ``checkpoint.write`` fault site sits
         AFTER the manifest so an injected torn write produces exactly what
         a real one does — on-disk bytes that no longer match the manifest
-        — which ``restore()`` must detect and fall back from."""
-        self._write_manifest(step)
-        inj = _faults.trip("checkpoint.write") if _faults.enabled() else None
+        — which ``restore()`` must detect and fall back from.
+
+        Multi-host (ISSUE 10): every host commits its own addressable
+        shards through orbax (whose finalize barrier has already passed by
+        the time ``wait_until_finished`` returned here), but the manifest
+        has exactly ONE writer — process 0 — hashing the complete step
+        directory on the shared filesystem. N racing writers could
+        interleave tmp-renames or certify a directory another host was
+        still materializing; a single writer after the collective commit
+        certifies the whole checkpoint or nothing."""
+        primary = _primary_host()
+        if primary:
+            self._write_manifest(step)
+        inj = (_faults.trip("checkpoint.write")
+               if primary and _faults.enabled() else None)
         if inj is not None:
             self._tear(step)
         latency = time.perf_counter() - t0
@@ -536,6 +560,47 @@ class TrainingCheckpointer:
         self._finalize_q.join()
         if self._bg_errors:
             raise self._bg_errors.pop(0)
+
+    def quiesce(self) -> List[BaseException]:
+        """Best-effort drain for RECOVERY paths (whole-host loss): wait
+        for in-flight saves but SWALLOW background failures instead of
+        raising — a lost host cancels orbax's cross-host commit barrier
+        mid-save, which is expected collateral, and the recovery restore
+        walks manifest-VERIFIED checkpoints regardless (a save whose
+        barrier died never got a manifest, so it can't restore). Returns
+        the swallowed exceptions for logging."""
+        swallowed: List[BaseException] = []
+        try:
+            self._mngr.wait_until_finished()
+        except Exception as e:
+            swallowed.append(e)
+        self._finalize_q.join()  # worker catches into _bg_errors
+        swallowed.extend(self._bg_errors)
+        self._bg_errors.clear()
+        for e in swallowed:
+            log.warning("checkpoint quiesce swallowed %s: %s",
+                        type(e).__name__, e)
+        return swallowed
+
+    def reopen(self) -> None:
+        """Rebuild the orbax manager in place — REQUIRED after
+        ``launcher.reinitialize()``: orbax's async checkpointer captures
+        the distributed coordination client's barrier function at
+        construction, so a manager that outlives the client would sync
+        every later save against a dead service (observed: CANCELLED
+        WaitAtBarrierAsync). Pending saves are quiesced first; on-disk
+        state is untouched."""
+        ocp = self._ocp
+        self.quiesce()
+        try:
+            self._mngr.close()
+        except Exception as e:  # dead-client close is best-effort
+            log.warning("checkpoint reopen: old manager close failed "
+                        "(%s: %s)", type(e).__name__, e)
+        self._mngr = ocp.CheckpointManager(
+            self.directory,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=self._max_to_keep, create=True))
 
     def close(self):
         self.wait_until_finished()

@@ -114,7 +114,8 @@ class ParallelWrapper:
                  model_axis: Optional[str] = None,
                  shard_update: bool = False, accum_steps: int = 1,
                  overlap_grads: bool = False,
-                 overlap_bucket_mb: float = None):
+                 overlap_bucket_mb: float = None,
+                 dcn_hosts: Optional[int] = None):
         # model: MultiLayerNetwork or ComputationGraph (duck-typed: both
         # expose params/updater_state/state/_build_train_step with the same
         # pytree layout; only the batch-argument arity differs)
@@ -161,6 +162,13 @@ class ParallelWrapper:
         self.overlap_grads = bool(overlap_grads)
         self.overlap_bucket_bytes = int(
             (overlap_bucket_mb or _overlap.DEFAULT_BUCKET_MB) * (1 << 20))
+        # dcn_hosts: DCN-group count along the data axis for the
+        # hierarchical gradient collectives (ISSUE 10). None = auto-detect
+        # from device process membership (a real pod mesh built by
+        # launcher.pod_mesh); an explicit int simulates the hierarchy on a
+        # single process's virtual devices (tests / bench) or overrides
+        # detection on exotic topologies.
+        self.dcn_hosts = dcn_hosts
         self._pending_step_cause = None
         self._step = None
         self._dense_key_cache = None
@@ -317,19 +325,32 @@ class ParallelWrapper:
         # backward compute. Value-identity: bit-equivalent to overlap off.
         grad_transform = None
         from . import overlap as _overlap
+        from ..runtime import telemetry as _tel
         n_buckets = 0
         if self.overlap_grads:
             buckets = _overlap.make_buckets(self.model.params,
                                             self.overlap_bucket_bytes)
+            upd_shardings = self._update_shardings(self.model.params)
+            # multi-host (ISSUE 10): two-stage intra-host/DCN pins per
+            # bucket, DCN-heavy buckets on their own issue chain so the
+            # slow hops start as early as their grads exist without
+            # gating the light reduce-scatters; on a single host
+            # hierarchy is None and this is the flat r12 path
+            hierarchy = _overlap.host_hierarchy(self.mesh, self.dcn_hosts)
+            chains = _overlap.split_dcn_chains(buckets, upd_shardings) \
+                if hierarchy is not None else None
             grad_transform = _overlap.overlap_transform(
-                buckets, self._update_shardings(self.model.params))
+                buckets, upd_shardings, hierarchy=hierarchy, chains=chains)
             n_buckets = len(buckets)
         # per-model labeled cell (anti-blending rule; 0 = overlap off for
         # THIS wrapper's current step) — the model's telemetry_label
-        # finalizer discards it with the rest of the model= cells
+        # finalizer discards it with the rest of the model= cells. On a
+        # pod the cell additionally carries host=<process_index> so a
+        # pod-wide scrape/merge keeps hosts apart (ISSUE 10 satellite).
         _overlap.BUCKETS_GAUGE.labeled(
             model=getattr(self.model, "telemetry_label",
-                          type(self.model).__name__)).set(n_buckets)
+                          type(self.model).__name__),
+            **_tel.host_labels()).set(n_buckets)
         pure = self.model._build_train_step(
             self.accum_steps, grad_transform=grad_transform).__wrapped__
         from jax.tree_util import tree_structure
@@ -347,26 +368,42 @@ class ParallelWrapper:
         multi_host = jax.process_count() > 1
 
         def put(t, sharding):
-            """Place one array with the given sharding. Multi-host: the
-            local numpy value is this host's shard (batch axis) or the
-            replicated value (params/state), assembled into a global array
-            via make_array_from_process_local_data; arrays already carrying
-            the target sharding (step outputs fed back in) pass through."""
+            """Place one FULL-VALUE array (params / opt state / BN state /
+            sentinel — every host holds the entire logical value) onto
+            ``sharding``. Multi-host: each host materializes only its
+            addressable shards via ``make_array_from_callback`` slicing
+            the full local value. NOT ``make_array_from_process_local_
+            data`` — that API's contract is "local value = this host's
+            shard", which for a ZeRO-1 opt-state leaf sharded over the
+            pod-wide data axis would concatenate the hosts' (identical)
+            full copies into a double-width global (observed: a (6,16)
+            Adam slot became (6,32)). Arrays already carrying the target
+            sharding (step outputs fed back in) pass through."""
             if isinstance(t, jax.Array) and t.sharding == sharding:
                 return t
             if multi_host:
-                return jax.make_array_from_process_local_data(
-                    sharding, np.asarray(t))
+                arr = np.asarray(t)
+                return jax.make_array_from_callback(
+                    arr.shape, sharding, lambda idx: arr[idx])
             return jax.device_put(t, sharding)
 
         def shard_batch(t):
             """Batch-sharded placement for one array, a tuple of arrays
-            (multi-input/-output graphs), or None (absent mask)."""
+            (multi-input/-output graphs), or None (absent mask).
+            Multi-host semantics differ from :func:`put`: the host-local
+            batch (HostShardedIterator) IS this host's contiguous SHARD of
+            the global batch, so ``make_array_from_process_local_data``
+            reassembles the global array in host order."""
             if t is None:
                 return None
             if isinstance(t, tuple):
                 return tuple(shard_batch(a) for a in t)
-            return put(t, data)
+            if isinstance(t, jax.Array) and t.sharding == data:
+                return t
+            if multi_host:
+                return jax.make_array_from_process_local_data(
+                    data, np.asarray(t))
+            return jax.device_put(t, data)
 
         def shard_args(params, opt_state, bn_state, sentinel, step, key,
                        x, y, fm, lm):
@@ -445,6 +482,38 @@ class ParallelWrapper:
             report.update(cm)
         return report
 
+    def on_host_loss(self) -> None:
+        """Post-``launcher.reinitialize()`` repair (ISSUE 10): the old
+        mesh's device objects belong to the torn-down backend client, so
+        rebuild the mesh over the FRESH ``jax.devices()`` with the same
+        shape/axes (host-major grouping preserved via ``pod_mesh``'s
+        rule), and drop every compiled program that baked the dead
+        devices in — the wrapper step and the model's own caches. The
+        rebuild is attributed ``cause="host_loss"`` in the retrace
+        tracker. Model STATE is not touched here: arrays from the old
+        client are dead, and ``run_resilient_fit`` restores them from the
+        checkpoint right after."""
+        from . import launcher as _launcher
+        shape = self.mesh.devices.shape
+        if self.mesh.axis_names not in (("data",), ("data", "model")):
+            raise RuntimeError(
+                f"on_host_loss cannot rebuild a mesh with axes "
+                f"{self.mesh.axis_names}; rebuild it yourself and assign "
+                "wrapper.mesh before resuming")
+        model_ax = shape[1] if len(shape) > 1 else 1
+        rebuilt = _launcher.pod_mesh(model=model_ax)
+        if rebuilt.devices.shape != shape:
+            raise RuntimeError(
+                f"post-host-loss topology changed: mesh was {shape}, "
+                f"fresh devices give {rebuilt.devices.shape}; restore onto "
+                "the new topology explicitly (TrainingCheckpointer restore "
+                "is topology-independent)")
+        self.mesh = rebuilt
+        self._step = None
+        self._pending_step_cause = "host_loss"
+        if hasattr(self.model, "_invalidate_compiled"):
+            self.model._invalidate_compiled(cause="host_loss")
+
     def serving_engine(self, **kwargs):
         """A ``serving.engine.InferenceEngine`` over THIS wrapper's mesh:
         train data-parallel, then serve the same slice — coalesced request
@@ -477,11 +546,21 @@ class ParallelWrapper:
                                 shard_update=self.shard_update,
                                 overlap=self.overlap_grads)
         step_fn, shard_args = self._step
+        # step-phase tracing (shared CompiledCacheMixin scaffold, ISSUE 6):
+        # pod fits get the same train.phase.data_wait_s/step_s cells as the
+        # engine fit loops — labeled model= AND host= (ISSUE 10), so a
+        # pod-wide scrape shows every host's step-time distribution apart
+        h_wait, h_step = m._phase_clocks()
         for _ in range(epochs):
-            for batch in self._batches(data):
+            for batch, tel in m._timed_batches(self._batches(data), h_wait):
                 x, y, fm, lm = batch
                 if _faults.enabled():
                     _faults.trip("train.step")  # crash/preemption site
+                    # whole-host-loss site (ISSUE 10): deterministic
+                    # injections fire on every process at the same step
+                    # (SPMD), raising HostLoss — run_resilient_fit routes
+                    # it through launcher.reinitialize() + restore
+                    _faults.trip("parallel.host_loss")
                     # float check FIRST: all-int inputs must not consume
                     # the injection's fire budget without poisoning anything
                     if any(np.issubdtype(np.asarray(a).dtype, np.floating)
@@ -495,8 +574,9 @@ class ParallelWrapper:
                 args = shard_args(
                     m.params, m.updater_state, m.state, m._ensure_sentinel(),
                     jnp.asarray(m.iteration, jnp.int32), sub, x, y, fm, lm)
-                m.params, m.updater_state, m.state, m._sentinel, loss = \
-                    step_fn(*args)
+                with m._timed_dispatch(tel, h_step):
+                    m.params, m.updater_state, m.state, m._sentinel, loss = \
+                        step_fn(*args)
                 m._score = loss
                 m.iteration += 1
                 for cb in m._listeners:
@@ -506,18 +586,57 @@ class ParallelWrapper:
                 cb.on_epoch_end(m)
         return m
 
+    def _pad_granularity(self) -> int:
+        """Rows the per-host batch must divide into: this host's extent of
+        the DATA axis (batches shard over 'data' only — the model axis
+        replicates them, so padding to ``devices.size`` on a 2-D mesh
+        over-padded) times ``accum_steps`` for the microbatch split."""
+        data_size = self.mesh.shape.get("data", self.mesh.devices.size)
+        return max(1, data_size // jax.process_count()) * self.accum_steps
+
+    def _passthrough_batch(self, t, n: int):
+        """Pre-placed device batches (AsyncDataSetIterator
+        ``device_prefetch`` with a multi-host/global sharding) bypass the
+        host-side pad path — a non-addressable global array can neither be
+        np.asarray'd nor padded here. Their batch dim must already divide
+        the GLOBAL data extent."""
+        arrs = t if isinstance(t, tuple) else (t,)
+        g = n * jax.process_count()
+        for a in arrs:
+            if a is not None and a.shape[0] % g:
+                raise ValueError(
+                    f"pre-placed device batch of {a.shape[0]} rows does not "
+                    f"divide the global data extent {g}; size (or pre-pad) "
+                    "device-prefetched batches to a multiple — host-side "
+                    "pad-and-mask only applies to numpy batches")
+        return t
+
     def _batches(self, data):
         """Yield (x, y, fm, lm) step arguments — arrays for the sequential
         engine, tuples-of-arrays for the graph engine — ragged tails padded
-        to the device count and masked. Multi-host: batches are HOST-LOCAL
-        shards (see launcher.HostShardedIterator), so the pad granularity is
-        the per-host device count, keeping every host's shard equal-sized.
-        With ``accum_steps=k`` the granularity is ``devices * k`` so the
-        microbatch split stays equal-sized."""
-        n = (self.mesh.devices.size // jax.process_count()) * self.accum_steps
+        to the data-axis extent and masked. Multi-host: batches are
+        HOST-LOCAL shards (see launcher.HostShardedIterator), so the pad
+        granularity is the per-host share of the data axis, keeping every
+        host's shard equal-sized. With ``accum_steps=k`` the granularity
+        multiplies by ``k`` so the microbatch split stays equal-sized.
+        Already-global jax.Arrays (multi-host device prefetch) pass
+        through untouched."""
+        n = self._pad_granularity()
+
+        def is_device_batch(a):
+            first = a[0] if isinstance(a, tuple) else a
+            return isinstance(first, jax.Array) and \
+                not first.is_fully_addressable
+
         if self._is_graph:
             from ..nn.graph import _as_multi_iterator
             for mds in _as_multi_iterator(data):
+                if any(is_device_batch(a) for a in mds.features
+                       if a is not None):
+                    yield (self._passthrough_batch(tuple(mds.features), n),
+                           self._passthrough_batch(tuple(mds.labels), n),
+                           tuple(mds.features_masks), tuple(mds.labels_masks))
+                    continue
                 fs = [np.asarray(a) for a in mds.features]
                 ls = [np.asarray(a) for a in mds.labels]
                 fms = [None if a is None else np.asarray(a)
@@ -532,6 +651,11 @@ class ParallelWrapper:
         else:
             it: DataSetIterator = _as_iterator(data)
             for ds in it:
+                if is_device_batch(ds.features):
+                    yield (self._passthrough_batch(ds.features, n),
+                           self._passthrough_batch(ds.labels, n),
+                           ds.features_mask, ds.labels_mask)
+                    continue
                 x = np.asarray(ds.features)
                 y = np.asarray(ds.labels)
                 fm = None if ds.features_mask is None else np.asarray(ds.features_mask)

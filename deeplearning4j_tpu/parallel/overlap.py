@@ -30,6 +30,35 @@ This module restructures the step's dataflow to make that legal:
   flows freely around them. The XLA latency-hiding scheduler
   (``environment.engine_compiler_options``) does the actual overlap.
 
+**Multi-host hierarchy** (ISSUE 10): on a pod, the data axis spans two
+very different interconnects — ICI within a host, DCN between hosts, an
+order of magnitude slower. :class:`HostHierarchy` re-views the pod mesh's
+host-major data axis as ``('dcn', 'ici')`` and the transform pins each
+bucket in two stages: first to the **intra-host** scatter layout (shard
+over ``ici``, replicated over ``dcn`` — GSPMD emits the fast within-host
+reduce-scatter plus the cross-host combine of the already-1/local-sized
+shards), then to the final ZeRO-1 layout over the full data axis (a local
+slice — no further traffic). The DCN hop therefore moves ``1/local``
+of the gradient bytes and is issued per-bucket as its gradients appear,
+instead of one monolithic end-of-backward collective.
+:func:`split_dcn_chains` additionally puts the DCN-heaviest buckets
+(leaves whose update could not be sharded — their gradient needs a full
+all-reduce, 2x the reduce-scatter's DCN bytes) on their own independent
+barrier chain, so the slowest hops issue at the earliest point their
+gradients exist and overlap with the remaining backward compute —
+without ever gating the light buckets' reduce-scatters behind a heavy
+bucket produced late in the backward pass.
+
+Numerics contract of the hierarchy: the bucket ORDERING is value-identity,
+but the two-stage pin changes the reduction *decomposition* (within-host
+reduce, then cross-host combine, instead of one flat reduce-scatter) — a
+different summation tree, so results match the flat schedule to float
+rounding (~1 ulp per reduction level), not bit-for-bit. That is the same
+trade every real hierarchical collective makes. Any FIXED configuration
+remains fully deterministic (same program, same reduction tree every
+step), which is what checkpoints/resume bit-equality relies on — and is
+tested.
+
 Everything here is scheduling structure: sharding constraints and barriers
 are value-identity, so ``overlap_grads=True`` is bit-equivalent to the
 unoverlapped path (tested, including ``accum_steps`` and tensor-parallel
@@ -38,10 +67,11 @@ unoverlapped path (tested, including ``accum_steps`` and tensor-parallel
 
 from __future__ import annotations
 
-from typing import Dict, List, Tuple
+from typing import Dict, List, Optional, Tuple
 
 import jax
 import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..runtime import telemetry as _tel
 
@@ -98,31 +128,164 @@ def make_buckets(params, bucket_bytes: int) -> List[List[Tuple[str, ...]]]:
     return buckets
 
 
+class HostHierarchy:
+    """``('dcn', 'ici'[, <model axis>])`` view of a pod mesh whose data
+    axis is host-major (``launcher.pod_mesh``): ``dcn`` indexes hosts,
+    ``ici`` the within-host extent of the data axis. Built once per
+    compiled step; :meth:`split` maps a final ZeRO-1 update sharding to
+    its (intra-host, full) two-stage pin targets."""
+
+    def __init__(self, mesh: Mesh, hosts: int):
+        devs = mesh.devices
+        data = devs.shape[0]
+        if hosts < 2 or data % hosts:
+            raise ValueError(f"data axis {data} does not split over "
+                             f"{hosts} hosts")
+        self.hosts = int(hosts)
+        self.local = data // hosts
+        shape = (hosts, self.local) + devs.shape[1:]
+        names = ("dcn", "ici") + tuple(mesh.axis_names[1:])
+        self.mesh = Mesh(devs.reshape(shape), names)
+
+    def _map_spec(self, spec: P, data_to):
+        out = []
+        for ax in spec:
+            if ax == "data":
+                out.append(data_to)
+            else:
+                out.append(ax)
+        return P(*out)
+
+    def split(self, sharding: NamedSharding):
+        """(intra, full) pins for one leaf. ``intra`` shards the leaf's
+        ZeRO dimension over ``ici`` only (replicated over ``dcn`` — the
+        within-host reduce-scatter happens here); ``full`` shards it over
+        ``('dcn', 'ici')`` == the original data axis (a no-traffic local
+        slice after ``intra``). Leaves whose update was never sharded
+        (no ``'data'`` in the spec) return ``(None, None)`` — they take
+        the plain single-stage pin."""
+        spec = sharding.spec
+        if "data" not in tuple(spec):
+            return None, None
+        return (NamedSharding(self.mesh, self._map_spec(spec, "ici")),
+                NamedSharding(self.mesh,
+                              self._map_spec(spec, ("dcn", "ici"))))
+
+
+def host_hierarchy(mesh: Mesh, dcn_hosts: Optional[int] = None
+                   ) -> Optional[HostHierarchy]:
+    """The mesh's host hierarchy, or None when it has none (single host,
+    or a data axis too small to split). ``dcn_hosts`` overrides the
+    process-membership detection — the single-process simulation knob
+    (virtual hosts over virtual CPU devices) and the escape hatch for
+    exotic topologies. Auto-detection VALIDATES host-majorness: a mesh
+    whose data-axis blocks interleave processes is not DCN-aware (use
+    ``launcher.pod_mesh``) and pinning an 'intra-host' sharding over it
+    would put the fast stage on the slow wire."""
+    devs = mesh.devices
+    data = devs.shape[0]
+    if dcn_hosts is None:
+        procs = [getattr(d, "process_index", 0) for d in devs.flat]
+        hosts = len(set(procs))
+        if hosts <= 1 or data % hosts:
+            return None
+        # host-major check: every contiguous data-axis block must belong
+        # to exactly one process
+        per = data // hosts
+        row_major = devs.reshape(data, -1)
+        for b in range(hosts):
+            block = {getattr(d, "process_index", 0)
+                     for d in row_major[b * per:(b + 1) * per].flat}
+            if len(block) != 1:
+                raise ValueError(
+                    "mesh data axis is not host-major (block %d spans "
+                    "processes %s); build the mesh with launcher.pod_mesh "
+                    "so intra-host collectives stay on ICI" % (b,
+                                                               sorted(block)))
+        return HostHierarchy(mesh, hosts)
+    if dcn_hosts <= 1:
+        return None
+    return HostHierarchy(mesh, dcn_hosts)
+
+
+def split_dcn_chains(buckets: List[List[Tuple[str, ...]]],
+                     shardings) -> List[List[List[Tuple[str, ...]]]]:
+    """Split the (reverse-layer-ordered) buckets into INDEPENDENT barrier
+    chains: DCN-heavy buckets — any leaf whose update sharding has no
+    ``'data'`` axis, i.e. its gradient needs a full all-reduce (2x a
+    reduce-scatter's DCN bytes) — in one chain, the rest in another,
+    each preserving production order. Two chains rather than a reordered
+    single chain on purpose: a barrier chain orders collective ISSUE, so
+    hoisting a heavy bucket to the front of ONE chain would gate every
+    light bucket's reduce-scatter behind the heavy bucket's data
+    dependency — if that heavy leaf lives in an input-side layer its
+    gradient is produced LAST, and the whole pipeline would serialize to
+    end-of-backward. Separate chains let each class issue as early as
+    its own gradients exist: the slow DCN all-reduces start at first
+    opportunity without ever blocking the light reduce-scatters."""
+    shard_by_path = dict(_flatten_paths(shardings))
+
+    def heavy(bucket) -> bool:
+        for p in bucket:
+            sh = shard_by_path.get(p)
+            if sh is None or "data" not in tuple(sh.spec):
+                return True
+        return False
+
+    chains = [[b for b in buckets if heavy(b)],
+              [b for b in buckets if not heavy(b)]]
+    return [c for c in chains if c]
+
+
 def overlap_transform(buckets: List[List[Tuple[str, ...]]],
-                      shardings) -> "callable":
+                      shardings,
+                      hierarchy: Optional[HostHierarchy] = None,
+                      chains: Optional[List[List[List[Tuple[str, ...]]]]]
+                      = None) -> "callable":
     """The ``grad_transform`` the engines apply right after gradient
     production (BEFORE clip/sentinel): per bucket, pin every leaf to its
     ZeRO-1 update sharding (forcing the reduce-scatter at grad time), and
     chain consecutive buckets through ``optimization_barrier`` so the
-    collectives issue in bucket order. Values pass through untouched."""
+    collectives issue in bucket order. With a ``hierarchy`` (multi-host
+    pod mesh) each sharded leaf is pinned in two stages — intra-host
+    (``ici``) scatter first, then the full data-axis layout — so the
+    cross-host DCN hop carries 1/local-sized shards (see module doc).
+    ``chains`` (from :func:`split_dcn_chains`) partitions the buckets
+    into INDEPENDENT barrier chains — issue order is constrained within
+    a chain, never across chains. Default: one chain of all buckets.
+    Values pass through untouched."""
+    if chains is None:
+        chains = [buckets]
     shard_by_path = dict(_flatten_paths(shardings))
+
+    def pin(v, sh):
+        if sh is None:
+            return v
+        if hierarchy is not None:
+            intra, full = hierarchy.split(sh)
+            if intra is not None:
+                # stage 1: within-host reduce-scatter (+ cross-host
+                # combine of the scattered shards); stage 2: local slice
+                # to the final ZeRO-1 layout. Value-identity both times.
+                v = jax.lax.with_sharding_constraint(v, intra)
+                return jax.lax.with_sharding_constraint(v, full)
+        return jax.lax.with_sharding_constraint(v, sh)
 
     def transform(grads):
         flat = dict(_flatten_paths(grads))
-        prev: List[Tuple[str, ...]] = []
-        for bucket in buckets:
-            vals = [flat[p] for p in bucket]
-            if prev:
-                sealed = jax.lax.optimization_barrier(
-                    tuple(flat[p] for p in prev) + tuple(vals))
-                for p, v in zip(prev, sealed[:len(prev)]):
-                    flat[p] = v
-                vals = list(sealed[len(prev):])
-            for p, v in zip(bucket, vals):
-                sh = shard_by_path.get(p)
-                flat[p] = v if sh is None else \
-                    jax.lax.with_sharding_constraint(v, sh)
-            prev = bucket
+        for chain in chains:
+            prev: List[Tuple[str, ...]] = []
+            for bucket in chain:
+                vals = [flat[p] for p in bucket]
+                if prev:
+                    sealed = jax.lax.optimization_barrier(
+                        tuple(flat[p] for p in prev) + tuple(vals))
+                    for p, v in zip(prev, sealed[:len(prev)]):
+                        flat[p] = v
+                    vals = list(sealed[len(prev):])
+                for p, v in zip(bucket, vals):
+                    flat[p] = pin(v, shard_by_path.get(p))
+                prev = bucket
         # rebuild the tree in the original structure
         from jax.tree_util import tree_flatten_with_path, tree_unflatten
         paths_leaves, treedef = tree_flatten_with_path(grads)

@@ -132,10 +132,11 @@ class _ResilienceListener:
             # an async save; durable latency is checkpoint.save_latency_s)
             _h = _tel.histogram("train.phase.checkpoint_s")
             lbl = getattr(self.model, "telemetry_label", None)
+            host = _tel.host_labels()  # pod anti-blending (ISSUE 10)
             if lbl is not None:
-                _h.observe(time.perf_counter() - t0, model=lbl)
+                _h.observe(time.perf_counter() - t0, model=lbl, **host)
             else:
-                _h.observe(time.perf_counter() - t0)
+                _h.observe(time.perf_counter() - t0, **host)
 
     def on_epoch_end(self, model):
         if self.policy.max_consecutive_bad_steps:
@@ -243,6 +244,27 @@ def run_resilient_fit(fit_target, data, labels=None, epochs: int = 1,
                 log.warning("transient failure (%s: %s); restoring and "
                             "resuming (restart %d/%d)", type(e).__name__, e,
                             restarts, policy.max_restarts)
+                if isinstance(e, _faults.HostLoss):
+                    # whole-host loss (ISSUE 10): the pod's control plane
+                    # is gone, not just this step — rebuild it BEFORE the
+                    # restore. reinitialize() cycles jax.distributed (a
+                    # barrier: every surviving process re-joins here) and
+                    # invalidates all live arrays; on_host_loss() re-derives
+                    # the wrapper's mesh over the fresh devices and drops
+                    # the compiled step. Single-process runs skip the cycle
+                    # (False) — restore alone suffices. The checkpoint
+                    # restore right below then rebuilds model state, so the
+                    # resumed run is bit-equal to an uninterrupted one.
+                    from . import launcher as _launcher
+                    ckpt.quiesce()  # drain saves BEFORE the client dies
+                    cycled = _launcher.reinitialize()
+                    if cycled:
+                        # orbax captured the old coordination client's
+                        # barrier fn at manager construction — rebuild it
+                        ckpt.reopen()
+                        if hasattr(fit_target, "on_host_loss"):
+                            fit_target.on_host_loss()
+                    _faults.telemetry_bump("host_loss_recoveries")
                 step = ckpt.restore(model, iterator=it)
                 listener._lagged = None  # pre-crash snapshot is stale
                 _faults.telemetry_bump("auto_resumes")

@@ -61,6 +61,17 @@ class InjectedIOError(FaultError, OSError):
     """Reader/storage I/O failure (bad record, lost mount)."""
 
 
+class HostLoss(InjectedCrash):
+    """A whole host dropped out of the pod (machine death / preemption of
+    one worker). Unlike a plain :class:`InjectedCrash`, recovery needs the
+    *control plane* rebuilt, not just a checkpoint restore: the surviving
+    job re-runs ``launcher.reinitialize()`` (shutdown + ``jax.distributed``
+    re-init — every live jax.Array dies with the old client) before the
+    restore. ``run_resilient_fit`` routes this subtype through that path
+    (ISSUE 10); it stays transient (subclass) so the restart budget and
+    backoff apply unchanged."""
+
+
 class TornWrite(FaultError):
     """A checkpoint write that was interrupted mid-flight."""
 
@@ -93,6 +104,8 @@ _ERROR_KINDS = {
     "crash": lambda site: InjectedCrash(f"injected crash at {site!r}"),
     "io": lambda site: InjectedIOError(f"injected I/O error at {site!r}"),
     "torn": lambda site: TornWrite(f"injected torn write at {site!r}"),
+    "host_loss": lambda site: HostLoss(
+        f"injected whole-host loss at {site!r}"),
 }
 
 
@@ -123,6 +136,7 @@ SITES = frozenset({
     "serving.slow",       # injected dispatch latency (overload -> shedding)
     "serving.decode",     # continuous-batching decode iteration failure
     "serving.quantize",   # weight quantization failure -> f32 fallback
+    "parallel.host_loss",  # whole host drops out of the pod (reinit+restore)
 })
 
 
@@ -274,6 +288,7 @@ _TELEMETRY_ZERO = {
     "restore_fallbacks": 0,
     "auto_resumes": 0,
     "divergence_rollbacks": 0,
+    "host_loss_recoveries": 0,
 }
 #: keys with a None zero are gauges (last-observed value), the rest are
 #: monotonic counters

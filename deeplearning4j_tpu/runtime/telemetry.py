@@ -782,6 +782,34 @@ def emit_event(event: dict) -> None:
         _event_sink.flush()
 
 
+# ------------------------------------------------------- host identity
+#: Pod anti-blending (ISSUE 10 satellite): on a multi-host run every
+#: process keeps its OWN registry, but a pod-level scrape (or an artifact
+#: that merges per-host registries) must be able to tell the hosts apart —
+#: so host-scoped surfaces (``train.phase.*``, ``parallel.overlap.buckets``,
+#: checkpoint latency) add a ``host=<process_index>`` label cell.
+#: Single-process runs keep their historical unlabeled cells (host_labels()
+#: is {}), so nothing changes off-pod. ``parallel/launcher.py`` calls
+#: :func:`set_host` right after ``jax.distributed`` comes up; tests
+#: simulate a pod by setting it directly.
+_host = {"index": 0, "count": 1}
+
+
+def set_host(index: int, count: int) -> None:
+    """Declare this process's pod coordinates (process_index, process
+    count). ``count <= 1`` returns labeling to the single-process mode."""
+    _host["index"] = int(index)
+    _host["count"] = int(count)
+
+
+def host_labels() -> dict:
+    """``{"host": "<process_index>"}`` on a multi-host run, else ``{}`` —
+    splat into ``labeled()`` calls for host-scoped cells."""
+    if _host["count"] > 1:
+        return {"host": str(_host["index"])}
+    return {}
+
+
 # -------------------------------------------------------- retrace tracker
 #: Compile causes every site reports through record_compile(). Not
 #: enforced as a closed set — but keep to these names where they apply so
@@ -789,7 +817,8 @@ def emit_event(event: dict) -> None:
 COMPILE_CAUSES = ("first_build", "warmup", "new_bucket", "dtype_policy",
                   "workspace_mode", "params_placement", "init",
                   "invalidate", "config_change", "precision", "probe",
-                  "lr_backoff", "autotune", "overlap", "quantize")
+                  "lr_backoff", "autotune", "overlap", "quantize",
+                  "host_loss")
 
 _compile_counter = counter(
     "compile.events",

@@ -61,9 +61,10 @@ def test_fault_site_coverage_floor(request):
     # every file that fires part of the registered site set (the
     # telemetry floor's `needed` pattern): resilience fires the train/
     # checkpoint/data/one-shot-serving sites, generative decode fires
-    # serving.decode, quantized serving fires serving.quantize
+    # serving.decode, quantized serving fires serving.quantize, the pod
+    # suite fires parallel.host_loss (ISSUE 10)
     needed = {"test_resilience.py", "test_generative_decode.py",
-              "test_quantized_serving.py"}
+              "test_quantized_serving.py", "test_multihost_pod.py"}
     missing = needed - collected
     if missing:
         pytest.skip(f"chunked run (fault-firing files not collected: "
@@ -99,7 +100,10 @@ def test_telemetry_metric_floor(request):
               "test_generative_decode.py",
               # int8 quantized serving (ISSUE 9): quantize.dispatch /
               # rewrite, serving.quantize.* cells, gate delta/failures
-              "test_quantized_serving.py"}
+              "test_quantized_serving.py",
+              # pod-scale multi-host (ISSUE 10): the only writer of
+              # resilience.host_loss_recoveries
+              "test_multihost_pod.py"}
     missing = needed - collected
     if missing:
         pytest.skip(f"chunked run (telemetry-ledger-marking files not "
