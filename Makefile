@@ -3,7 +3,7 @@
 
 PY ?= python
 
-.PHONY: test test-fast multihost-sim multihost-smoke bench
+.PHONY: test test-fast multihost-sim multihost-smoke bench bench-generative
 
 # fast (tier-1) suite — what CI gates on
 test-fast:
@@ -32,3 +32,10 @@ print(json.dumps(run_smoke(tempfile.mkdtemp())))"
 
 bench:
 	$(PY) bench.py
+
+# ISSUE 12: the generative-serving metric standalone — paged-vs-
+# contiguous A/B (concurrent streams/GB, prefix hit rate, CoW forks),
+# speculative accept-rate, zero post-warmup compiles. CPU-capable.
+bench-generative:
+	env JAX_PLATFORMS=cpu $(PY) -c "import json, bench; \
+print(json.dumps(bench.bench_generative_serving(), indent=1))"

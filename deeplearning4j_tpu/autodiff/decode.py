@@ -48,6 +48,8 @@ from .samediff import ARRAY, PLACEHOLDER, SameDiff, _OpRecord
 
 #: the shared per-row valid-length placeholder of the decode replay
 LENGTHS = "__cache_lengths__"
+#: the shared page-table placeholder of the PAGED decode replay (ISSUE 12)
+PAGE_TABLE = "__page_table__"
 
 
 @dataclasses.dataclass
@@ -74,11 +76,14 @@ class DecodeGraph:
     stack's decode walk."""
 
     def __init__(self, base: SameDiff, decode: SameDiff,
-                 sites: List[_Site], output: str):
+                 sites: List[_Site], output: str,
+                 paged: bool = False, page_size: int = 16):
         self.base = base
         self.decode = decode
         self.sites = sites
         self.output = output
+        self.paged = bool(paged)
+        self.page_size = int(page_size)
 
     def site_names(self) -> List[str]:
         return [s.name for s in self.sites]
@@ -88,13 +93,21 @@ class DecodeGraph:
         returns ``(out, caches)`` with each site's prompt k/v bucketed
         into zero-padded ``cache_len`` rows. ``lengths`` [B] true prompt
         lengths (rows past a row's length carry garbage the decode-side
-        length bias masks)."""
+        length bias masks).
+
+        Paged graphs (ISSUE 12): each site's cache is instead a
+        ``[n_pages*page_size, H, d]`` token-row pool; rows are mapped
+        through a linear per-row page table (page 0 reserved) stored in
+        ``caches["__page_table__"]`` — the demonstration allocator; a
+        serving deployment owns the real refcounted one
+        (``serving.kv_pool.PagedKVPool``)."""
         targets = [self.output]
         for s in self.sites:
             targets += [s.k, s.v]
         res = self.base.output(feeds, targets)
         lengths = np.asarray(lengths)
         caches = {}
+        page_table = None
         for s in self.sites:
             k, v = res[s.k], res[s.v]
             if k.ndim != 4:
@@ -105,10 +118,38 @@ class DecodeGraph:
             if t > cache_len:
                 raise ValueError(f"prompt length {t} exceeds cache_len "
                                  f"{cache_len}")
-            pad = [(0, 0), (0, 0), (0, cache_len - t), (0, 0)]
-            caches[s.name] = {"k": np.pad(np.asarray(k), pad),
-                              "v": np.pad(np.asarray(v), pad)}
+            if self.paged:
+                P = self.page_size
+                if cache_len % P:
+                    raise ValueError(f"cache_len {cache_len} is not a "
+                                     f"multiple of page_size {P}")
+                B, H, _, d = k.shape
+                mp = cache_len // P
+                if page_table is None:
+                    page_table = (1 + np.arange(B * mp, dtype=np.int32)
+                                  ).reshape(B, mp)
+                rows_total = (1 + B * mp) * P
+                pool_k = np.zeros((rows_total, H, d), np.asarray(k).dtype)
+                pool_v = np.zeros_like(pool_k)
+                pos = np.arange(t)
+                for b in range(B):
+                    rows = page_table[b, pos // P] * P + pos % P
+                    pool_k[rows] = np.asarray(k)[b].transpose(1, 0, 2)
+                    pool_v[rows] = np.asarray(v)[b].transpose(1, 0, 2)
+                caches[s.name] = {"k": pool_k, "v": pool_v}
+            else:
+                pad = [(0, 0), (0, 0), (0, cache_len - t), (0, 0)]
+                caches[s.name] = {"k": np.pad(np.asarray(k), pad),
+                                  "v": np.pad(np.asarray(v), pad)}
+        if self.paged:
+            caches[PAGE_TABLE] = page_table
         return res[self.output], caches
+
+    def _cache_len(self, caches: Dict) -> int:
+        if self.paged:
+            return caches[PAGE_TABLE].shape[1] * self.page_size
+        return next(iter(
+            c["k"].shape[2] for n, c in caches.items() if n != PAGE_TABLE))
 
     def decode_step(self, feeds: Dict, caches: Dict, lengths):
         """One token through the REWRITTEN graph: ``feeds`` are the
@@ -120,14 +161,14 @@ class DecodeGraph:
         # overflow guard: cached_sdpa's insert CLAMPS an out-of-range
         # position (XLA slice semantics) — without this host-side check a
         # full cache would silently overwrite its last row every step
-        for s in self.sites:
-            c = caches[s.name]["k"].shape[2]
-            if int(np.max(full[LENGTHS])) >= c:
-                raise ValueError(
-                    f"cache full at site {s.name!r} (lengths "
-                    f"{int(np.max(full[LENGTHS]))} >= cache_len {c}): "
-                    "re-bucket by zero-padding the caches along axis 2 "
-                    "before the next decode_step")
+        c = self._cache_len(caches)
+        if int(np.max(full[LENGTHS])) >= c:
+            raise ValueError(
+                f"cache full (lengths {int(np.max(full[LENGTHS]))} >= "
+                f"cache_len {c}): re-bucket (contiguous: zero-pad axis 2; "
+                "paged: widen the page table) before the next decode_step")
+        if self.paged:
+            full[PAGE_TABLE] = np.asarray(caches[PAGE_TABLE], np.int32)
         for s in self.sites:
             full[s.k_cache] = caches[s.name]["k"]
             full[s.v_cache] = caches[s.name]["v"]
@@ -137,6 +178,8 @@ class DecodeGraph:
         res = self.decode.output(full, targets)
         new_caches = {s.name: {"k": res[s.k_out], "v": res[s.v_out]}
                       for s in self.sites}
+        if self.paged:
+            new_caches[PAGE_TABLE] = caches[PAGE_TABLE]
         return res[self.output], new_caches
 
     def generate(self, prompt_feeds: Dict, lengths, cache_len: int,
@@ -153,14 +196,17 @@ class DecodeGraph:
             yield out
 
 
-def rewrite_for_decode(sd: SameDiff,
-                       output: Optional[str] = None) -> DecodeGraph:
+def rewrite_for_decode(sd: SameDiff, output: Optional[str] = None,
+                       paged: bool = False,
+                       page_size: int = 16) -> DecodeGraph:
     """Build the decode twin of a fused SameDiff graph.
 
     The original graph is untouched (it stays the prefill program); the
     clone gets every top-level ``attention.fused_sdpa`` record replaced
-    by ``attention.cached_sdpa`` with per-site cache placeholders and the
-    shared ``__cache_lengths__``. Raises when the graph has no fused
+    by ``attention.cached_sdpa`` — or, with ``paged=True`` (ISSUE 12),
+    by ``attention.paged_sdpa`` consuming per-site token-row POOLS plus
+    the shared ``__page_table__`` — with per-site cache placeholders and
+    the shared ``__cache_lengths__``. Raises when the graph has no fused
     sites (run ``fusion.fuse_attention(sd)`` first — this pass rides on
     its safety checks) or when a site sits inside a control-flow
     subgraph (not rewritable record-by-record)."""
@@ -179,23 +225,34 @@ def rewrite_for_decode(sd: SameDiff,
     dec = SameDiff.from_json(sd.to_json())
     dec._values = dict(sd._values)
     dec._register(LENGTHS, PLACEHOLDER)
+    if paged:
+        dec._register(PAGE_TABLE, PLACEHOLDER)
     sites: List[_Site] = []
     for idx in fused_idx:
         rec = dec._ops[idx]
         q, k, v = rec.inputs[:3]   # optional 4th input (mask bias) is
         #                            dropped: lengths subsume the key mask
         o = rec.output
-        kc, vc = f"{o}__k_cache", f"{o}__v_cache"
-        ko, vo = f"{o}__k_cache_out", f"{o}__v_cache_out"
+        suffix = "pool" if paged else "cache"
+        kc, vc = f"{o}__k_{suffix}", f"{o}__v_{suffix}"
+        ko, vo = f"{o}__k_{suffix}_out", f"{o}__v_{suffix}_out"
         dec._register(kc, PLACEHOLDER)
         dec._register(vc, PLACEHOLDER)
         dec._register(ko, ARRAY)
         dec._register(vo, ARRAY)
         scale = float(rec.attrs.get("scale", 1.0))
-        dec._ops[idx] = _OpRecord(
-            "attention.cached_sdpa", [q, k, v, kc, vc, LENGTHS],
-            [o, ko, vo], {"scale": scale})
+        if paged:
+            dec._ops[idx] = _OpRecord(
+                "attention.paged_sdpa",
+                [q, k, v, kc, vc, PAGE_TABLE, LENGTHS],
+                [o, ko, vo],
+                {"scale": scale, "page_size": int(page_size)})
+        else:
+            dec._ops[idx] = _OpRecord(
+                "attention.cached_sdpa", [q, k, v, kc, vc, LENGTHS],
+                [o, ko, vo], {"scale": scale})
         sites.append(_Site(name=o, q=q, k=k, v=v, scale=scale,
                            k_cache=kc, v_cache=vc, k_out=ko, v_out=vo))
     dec._fn_cache.clear()
-    return DecodeGraph(sd, dec, sites, output)
+    return DecodeGraph(sd, dec, sites, output, paged=paged,
+                       page_size=page_size)

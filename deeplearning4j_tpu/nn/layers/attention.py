@@ -138,6 +138,43 @@ def _cache_append(cache, k_new, v_new, lengths, write):
     return {"k": kc, "v": vc}, kc, vc
 
 
+def _paged_cache_append(cache, k_new, v_new, lengths, write,
+                        page_table, page_size):
+    """Paged twin of :func:`_cache_append` (ISSUE 12): scatter the
+    window's k/v token rows into the [NP, H, d] pool leaves through the
+    page table, then gather the full per-slot [B, H, C, d] caches for
+    the attention kernel. Int8 pools carry per-row scales as d=1 page
+    payloads — quantize/insert stays row-local, so write-gated inactive
+    slots and copy-on-write forks stay bit-identical under quantization
+    too."""
+    if "k_scale" in cache:
+        kq, ks = _q.quantize_rows(k_new)
+        vq, vs = _q.quantize_rows(v_new)
+        kc = _fa.paged_insert(cache["k"], kq, lengths, page_table,
+                              page_size, write)
+        vc = _fa.paged_insert(cache["v"], vq, lengths, page_table,
+                              page_size, write)
+        ksc = _fa.paged_insert(cache["k_scale"], ks, lengths, page_table,
+                               page_size, write)
+        vsc = _fa.paged_insert(cache["v_scale"], vs, lengths, page_table,
+                               page_size, write)
+        dt = k_new.dtype
+        kf = _q.dequantize_rows(_fa.paged_gather(kc, page_table, page_size),
+                                _fa.paged_gather(ksc, page_table, page_size),
+                                dt)
+        vf = _q.dequantize_rows(_fa.paged_gather(vc, page_table, page_size),
+                                _fa.paged_gather(vsc, page_table, page_size),
+                                dt)
+        return ({"k": kc, "v": vc, "k_scale": ksc, "v_scale": vsc}, kf, vf)
+    kc = _fa.paged_insert(cache["k"], k_new, lengths, page_table,
+                          page_size, write)
+    vc = _fa.paged_insert(cache["v"], v_new, lengths, page_table,
+                          page_size, write)
+    return ({"k": kc, "v": vc},
+            _fa.paged_gather(kc, page_table, page_size),
+            _fa.paged_gather(vc, page_table, page_size))
+
+
 @layer("self_attention")
 class SelfAttentionLayer(Layer):
     """DL4J SelfAttentionLayer: multi-head scaled-dot self-attention with
@@ -210,10 +247,26 @@ class SelfAttentionLayer(Layer):
         cache = _cache_fill_prompt(cache, k, v)
         return y, cache
 
-    def decode_step(self, params, x, state, *, cache, lengths, write=None):
+    def decode_step(self, params, x, state, *, cache, lengths, write=None,
+                    page_table=None, page_size=0):
+        """One decode window: ``x`` [B, Tq, F] — Tq = 1 for plain decode,
+        Tq = k for a speculative verify (window-causal: generated token i
+        sees the prefix plus draft tokens <= i). ``page_table``/``page_size``
+        switch the cache to the paged pool form (ISSUE 12)."""
         q, k_new, v_new = _qkv(x, x, params, self.n_heads)
-        cache, kf, vf = _cache_append(cache, k_new, v_new, lengths, write)
-        y = _fa.decode_dispatch(q, kf, vf, jnp.asarray(lengths) + 1)
+        if page_table is not None:
+            cache, kf, vf = _paged_cache_append(
+                cache, k_new, v_new, lengths, write, page_table, page_size)
+        else:
+            cache, kf, vf = _cache_append(cache, k_new, v_new, lengths,
+                                          write)
+        if x.shape[1] == 1:
+            y = _fa.decode_dispatch(q, kf, vf, jnp.asarray(lengths) + 1,
+                                    page=page_size)
+        else:
+            y = _fa.decode_multiquery_dispatch(q, kf, vf,
+                                               jnp.asarray(lengths),
+                                               page=page_size)
         return _proj(_heads_join(y), params["Wo"], params.get("bo")), cache
 
     def full_context(self, params, x, state, *, bias, key_bias):
@@ -286,15 +339,33 @@ class LearnedSelfAttentionLayer(Layer):
         cache = _cache_fill_prompt(cache, k, v)
         return y, cache
 
-    def decode_step(self, params, x, state, *, cache, lengths, write=None):
+    def decode_step(self, params, x, state, *, cache, lengths, write=None,
+                    page_table=None, page_size=0):
+        if x.shape[1] != 1:
+            # the learned query bank summarizes the sequence — a k-token
+            # verify window has no per-token output to thread downstream,
+            # so speculative verification refuses loudly at trace time
+            raise ValueError(
+                "learned_self_attention cannot verify a multi-token "
+                "window (its output is a query-bank summary, not "
+                "per-token); use a self-attention stack for speculative "
+                "decoding")
         B = x.shape[0]
         xq = jnp.broadcast_to(params["Q"][None], (B,) + params["Q"].shape)
         q = _heads_split(_proj(xq, params["Wq"]), self.n_heads)
         k_new = _heads_split(_proj(x, params["Wk"]), self.n_heads)
         v_new = _heads_split(_proj(x, params["Wv"]), self.n_heads)
-        cache, kf, vf = _cache_append(cache, k_new, v_new, lengths, write)
+        if page_table is not None:
+            cache, kf, vf = _paged_cache_append(
+                cache, k_new, v_new, lengths, write, page_table, page_size)
+        else:
+            cache, kf, vf = _cache_append(cache, k_new, v_new, lengths,
+                                          write)
         # n_queries > 1 rows: decode_dispatch routes to the reference path
-        y = _fa.decode_dispatch(q, kf, vf, jnp.asarray(lengths) + 1)
+        # (counted decode_fallback_multiquery — uniform visibility, not
+        # the verify window's causal mask)
+        y = _fa.decode_dispatch(q, kf, vf, jnp.asarray(lengths) + 1,
+                                page=page_size)
         return _proj(_heads_join(y), params["Wo"]), cache
 
     def full_context(self, params, x, state, *, bias, key_bias):

@@ -744,6 +744,28 @@ class MultiLayerNetwork(_caches.CompiledCacheMixin):
                             self.decode_cache_spec(batch, cache_len,
                                                    kv_quant=kv_quant))
 
+    def paged_cache_spec(self, n_pages: int, page_size: int,
+                         kv_quant: bool = False) -> dict:
+        """Paged-pool twin of :meth:`decode_cache_spec` (ISSUE 12):
+        ``{layer_index: {"k": [n_pages*page_size, H, d] aval, ...}}`` —
+        each KV-cached layer's cache as a pool of token rows owned by the
+        serving page allocator instead of per-slot contiguous buckets.
+        Int8 pools carry their per-row f32 scales as d=1 page payloads."""
+        base = self.decode_cache_spec(1, 1, kv_quant=kv_quant)
+        rows = int(n_pages) * int(page_size)
+        return {si: {name: jax.ShapeDtypeStruct(
+                        (rows, a.shape[1], a.shape[3]), a.dtype)
+                     for name, a in leaves.items()}
+                for si, leaves in base.items()}
+
+    def init_paged_cache(self, n_pages: int, page_size: int,
+                         kv_quant: bool = False) -> dict:
+        """Zero-initialized paged KV pool pytree (page 0 = the reserved
+        zero page the allocator points unallocated table entries at)."""
+        return jax.tree.map(lambda a: jnp.zeros(a.shape, a.dtype),
+                            self.paged_cache_spec(n_pages, page_size,
+                                                  kv_quant=kv_quant))
+
     def _decode_cast(self, params, x):
         dt = _dt.resolve(self.conf.dtype)
         if jnp.issubdtype(dt, jnp.floating) and \
@@ -778,13 +800,18 @@ class MultiLayerNetwork(_caches.CompiledCacheMixin):
                                       mask=mask)
         return x, new_caches
 
-    def _decode_step(self, params, x, state, caches, lengths, write=None):
-        """One-token decode: ``x`` [B, 1, F], ``lengths`` [B] = tokens
-        already cached BEFORE this token. Appends this token's k/v at
-        position ``lengths`` (rows with ``write == 0`` keep their caches
-        bit-identical — inactive serving slots) and returns
-        (y [B, 1, out], new_caches). The caller advances ``lengths`` by
-        one afterwards."""
+    def _decode_step(self, params, x, state, caches, lengths, write=None,
+                     page_table=None, page_size=0):
+        """One decode window: ``x`` [B, Tq, F] (Tq = 1 for plain decode,
+        Tq = k for a speculative verify window — window-causal inside the
+        attention layers), ``lengths`` [B] = tokens already cached BEFORE
+        this window. Appends the window's k/v at positions ``lengths``
+        onward (rows with ``write == 0`` keep their caches bit-identical
+        — inactive serving slots) and returns (y [B, Tq, out],
+        new_caches). The caller advances ``lengths`` afterwards.
+        ``page_table``/``page_size`` (ISSUE 12): the caches are paged
+        pools and the per-slot page table rides through the cached
+        layers as gather/scatter indices."""
         params, x = self._decode_cast(params, x)
         lengths = jnp.asarray(lengths)
         new_caches = {}
@@ -794,7 +821,9 @@ class MultiLayerNetwork(_caches.CompiledCacheMixin):
             s = state.get(si, {})
             if kind == "cache":
                 x, c = layer.decode_step(p, x, s, cache=caches[si],
-                                         lengths=lengths, write=write)
+                                         lengths=lengths, write=write,
+                                         page_table=page_table,
+                                         page_size=page_size)
                 new_caches[si] = c
             else:
                 x, c = layer.decode_step(p, x, s, cache=None,

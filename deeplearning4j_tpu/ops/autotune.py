@@ -96,13 +96,22 @@ def reset_counters() -> None:
 
 # ----------------------------------------------------------------- keys
 def cache_key(tq: int, tk: int, d: int, dtype, has_bias: bool,
-              decode: bool = False) -> tuple:
-    """``decode=True`` keys the single-query decode kernel's tiling
-    (block_q pinned to 1; only the cache-axis block is tuned) separately
-    from the one-shot kernel — the same (Tq=1, Tk) shape prefers very
-    different schedules when the query side is a single row."""
+              decode: bool = False, page: int = 0) -> tuple:
+    """``decode=True`` keys the decode kernel's tiling (block_q pinned to
+    Tq — 1 for single-query decode, k for the speculative multi-query
+    verify; only the cache-axis block is tuned) separately from the
+    one-shot kernel — the same (Tq, Tk) shape prefers very different
+    schedules when the query side is a handful of rows. ``page`` (paged
+    KV serving, ISSUE 12): the cache is a page-table gather at this page
+    granularity, so the winning cache-axis block differs from a
+    contiguous cache of the same length — page size is part of the key
+    (``page0`` = contiguous)."""
     base = (int(tq), int(tk), int(d), str(np.dtype(dtype)), bool(has_bias))
-    return base + ("decode",) if decode else base
+    if decode:
+        base = base + ("decode",)
+    if page:
+        base = base + (f"page{int(page)}",)
+    return base
 
 
 def axis_blocks(t: int, cap: int = MAX_BLOCK,
@@ -128,7 +137,9 @@ def candidates(tq: int, tk: int, d: int, itemsize: int = 4,
     enumerate only the cache-axis blocks."""
     from . import flash_attention as _fa
     out = []
-    q_blocks = [1] if decode else axis_blocks(tq)
+    # decode keys pin the query block to the whole (small) query window:
+    # 1 for single-query decode, k for the speculative Tq=k verify
+    q_blocks = [int(tq)] if decode else axis_blocks(tq)
     for bq in q_blocks:
         for bk in axis_blocks(tk):
             if _fa.fits_vmem_attention(bq, bk, d, itemsize):
@@ -139,7 +150,7 @@ def candidates(tq: int, tk: int, d: int, itemsize: int = 4,
 def _default_blocks(tq: int, tk: int,
                     decode: bool = False) -> Optional[Tuple[int, int]]:
     from . import flash_attention as _fa
-    bq = 1 if decode else _fa.pick_block(tq)
+    bq = int(tq) if decode else _fa.pick_block(tq)
     bk = _fa.pick_block(tk)
     if bq is None or bk is None:
         return None
@@ -166,33 +177,36 @@ def _ensure_loaded() -> None:
 
 
 def lookup(tq, tk, d, dtype, has_bias,
-           decode: bool = False) -> Optional[dict]:
+           decode: bool = False, page: int = 0) -> Optional[dict]:
     """The cache entry for a key, or None (no counter bump)."""
     with _lock:
         _ensure_loaded()
-        e = _cache.get(cache_key(tq, tk, d, dtype, has_bias, decode))
+        e = _cache.get(cache_key(tq, tk, d, dtype, has_bias, decode, page))
         return dict(e) if e else None
 
 
 def _valid_blocks(blocks, tq, tk, d, dtype, decode: bool = False) -> bool:
     """A cache entry's blocks must be usable for ITS key: multiple-of-8
-    divisors within the VMEM budget (decode keys: ``block_q`` exactly 1 —
-    the single-row grid). Guards against stale/hand-edited disk caches —
-    an invalid pair would silently truncate the kernel grid (``Tq // bq``)
-    and produce wrong attention output."""
+    divisors within the VMEM budget (decode keys: ``block_q`` exactly the
+    query-window size — the whole small-Tq grid row). Guards against
+    stale/hand-edited disk caches — an invalid pair would silently
+    truncate the kernel grid (``Tq // bq``) and produce wrong attention
+    output."""
     from . import flash_attention as _fa
     try:
         bq, bk = int(blocks[0]), int(blocks[1])
     except (TypeError, ValueError, IndexError):
         return False
-    q_ok = bq == 1 if decode else (bq >= 8 and bq % 8 == 0 and tq % bq == 0)
+    q_ok = bq == int(tq) if decode \
+        else (bq >= 8 and bq % 8 == 0 and tq % bq == 0)
     return (q_ok and bk >= 8 and bk % 8 == 0 and tk % bk == 0
             and _fa.fits_vmem_attention(bq, bk, d,
                                         np.dtype(dtype).itemsize))
 
 
 def get_blocks(tq, tk, d, dtype, has_bias, *, concrete: bool = False,
-               decode: bool = False) -> Optional[Tuple[int, int]]:
+               decode: bool = False, page: int = 0
+               ) -> Optional[Tuple[int, int]]:
     """(block_q, block_k) for one attention shape key.
 
     A SWEPT cache hit returns the stored blocks. A miss (or a
@@ -205,8 +219,10 @@ def get_blocks(tq, tk, d, dtype, has_bias, *, concrete: bool = False,
     mid-trace, so warm the cache first (``warmup``/``sweep``/disk cache)
     to tune traced programs. Returns None when nothing tiles (caller
     falls back). Invalid entries (corrupt/stale disk cache) are dropped,
-    never served. ``decode=True`` keys the single-query decode kernel."""
-    key = cache_key(tq, tk, d, dtype, has_bias, decode)
+    never served. ``decode=True`` keys the decode kernels (``tq`` = the
+    query window: 1 or the speculative k); ``page`` keys the paged-KV
+    gather granularity separately from a contiguous cache."""
+    key = cache_key(tq, tk, d, dtype, has_bias, decode, page)
     can_sweep = (concrete and _state["mode"] == "auto"
                  and jax.default_backend() == "tpu")
     with _lock:
@@ -224,7 +240,7 @@ def get_blocks(tq, tk, d, dtype, has_bias, *, concrete: bool = False,
             _EVENTS.inc(event="hit")
             return tuple(e["blocks"])
     if can_sweep:
-        e = sweep(tq, tk, d, dtype, has_bias, decode=decode)
+        e = sweep(tq, tk, d, dtype, has_bias, decode=decode, page=page)
         return tuple(e["blocks"]) if e else None
     default = _default_blocks(tq, tk, decode)
     if default is None:
@@ -322,11 +338,12 @@ def load(path: Optional[str] = None, merge: bool = True) -> int:
             _cache.clear()
         for ent in snap.get("entries", []):
             raw = ent["key"]
-            key = (int(raw[0]), int(raw[1]), int(raw[2]), str(raw[3]),
-                   bool(raw[4]))
-            decode = len(raw) > 5 and raw[5] == "decode"
-            if decode:
-                key = key + ("decode",)
+            tail = [str(x) for x in raw[5:]]
+            decode = "decode" in tail
+            page = next((int(t[4:]) for t in tail
+                         if t.startswith("page") and t[4:].isdigit()), 0)
+            key = cache_key(int(raw[0]), int(raw[1]), int(raw[2]),
+                            str(raw[3]), bool(raw[4]), decode, page)
             if not _valid_blocks(ent.get("blocks"), key[0], key[1],
                                  key[2], key[3], decode):
                 continue  # stale/hand-edited entry: never serve it
@@ -376,16 +393,28 @@ def _time_candidate(tq, tk, d, dtype, has_bias, bq, bk, interpret,
                        np.float32(np.finfo(np.float32).min))
 
     if decode:
-        # the serving decode hot path: single-query forward, ragged cache
-        # occupancy as the key bias (the same program decode_attention runs)
-        lengths = jnp.asarray(
-            rng.integers(max(1, tk // 2), tk + 1, size=(batch,)), jnp.int32)
-        kbd = _fa.length_bias(lengths, tk)
+        # the serving decode hot path: single/multi-query forward, ragged
+        # cache occupancy as the mask (the same program decode_attention /
+        # decode_multiquery_attention runs; tq > 1 = speculative verify)
+        lo = max(1, min(tk // 2, max(1, tk - tq)))
+        hi = max(lo + 1, tk - tq + 2)
+        lengths = jnp.asarray(rng.integers(lo, hi, size=(batch,)), jnp.int32)
 
-        def fwd(q_, k_, v_):
-            o, _, _ = _fa._fwd_impl(q_, k_, v_, kbd, scale, heads,
-                                    bq, bk, interpret)
-            return (o,)  # tuple like grad's output: run() reads gs[0]
+        if tq > 1:
+            lens2 = jnp.broadcast_to(lengths[:, None], (batch, _fa._LANES)
+                                     ).astype(jnp.int32)
+
+            def fwd(q_, k_, v_):
+                o = _fa._mq_impl(q_, k_, v_, lens2, scale, heads,
+                                 bk, interpret)
+                return (o,)
+        else:
+            kbd = _fa.length_bias(lengths, tk)
+
+            def fwd(q_, k_, v_):
+                o, _, _ = _fa._fwd_impl(q_, k_, v_, kbd, scale, heads,
+                                        bq, bk, interpret)
+                return (o,)  # tuple like grad's output: run() reads gs[0]
 
         fn = jax.jit(fwd)
     else:
@@ -413,7 +442,8 @@ def _time_candidate(tq, tk, d, dtype, has_bias, bq, bk, interpret,
 
 
 def sweep(tq, tk, d, dtype, has_bias, *, interpret: bool = False,
-          repeats: int = 3, decode: bool = False) -> Optional[dict]:
+          repeats: int = 3, decode: bool = False,
+          page: int = 0) -> Optional[dict]:
     """Measure every candidate block shape for one key and cache the
     winner. TPU-only unless ``interpret=True`` (the slow-marked test path:
     exercises the sweep machinery through the Pallas interpreter, whose
@@ -444,7 +474,7 @@ def sweep(tq, tk, d, dtype, has_bias, *, interpret: bool = False,
         "candidates": timings,
         "backend": jax.default_backend(),
     }
-    key = cache_key(tq, tk, d, dtype, has_bias, decode)
+    key = cache_key(tq, tk, d, dtype, has_bias, decode, page)
     with _lock:
         _cache[key] = entry
     _EVENTS.inc(event="sweep")

@@ -216,6 +216,47 @@ def _bwd_dkv_kernel(*refs, scale, nq, has_bias):
         dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
 
 
+def _mq_decode_kernel(q_ref, k_ref, v_ref, len_ref, o_ref,
+                      m_scr, l_scr, acc_scr, *, scale, nk, bk):
+    """Multi-query decode forward (speculative verify, ISSUE 12): the
+    whole Tq=k query window rides one grid row, streaming the cache in
+    ``bk`` tiles. The mask is computed INSIDE the kernel from the per-row
+    valid length: query i (global position ``l + i``) may attend cache
+    columns ``< l + 1 + i`` — a per-(query, key) causal window that is
+    not key-reducible, so it cannot ride the fwd kernel's [B, Tk] bias."""
+    j = pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[...] = jnp.full(m_scr.shape, -jnp.inf, jnp.float32)
+        l_scr[...] = jnp.zeros(l_scr.shape, jnp.float32)
+        acc_scr[...] = jnp.zeros(acc_scr.shape, jnp.float32)
+
+    s = jax.lax.dot_general(
+        q_ref[0], k_ref[0], (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale          # [bq, bk] f32
+    ln = len_ref[0, 0]                                       # int32 scalar
+    col = j * bk + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    row = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+    s = jnp.where(col < ln + 1 + row, s, _NEG)
+    m_prev, l_prev = m_scr[...], l_scr[...]
+    m_curr = jnp.max(s, axis=1, keepdims=True)
+    m_next = jnp.maximum(m_prev, m_curr)
+    alpha = jnp.exp(m_prev - m_next)
+    p = jnp.exp(s - _lanes(m_next, s.shape[1]))
+    m_scr[...] = m_next
+    l_scr[...] = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
+    d = acc_scr.shape[1]
+    acc_scr[...] = acc_scr[...] * _lanes(alpha, d) + jax.lax.dot(
+        p.astype(v_ref.dtype), v_ref[0], preferred_element_type=jnp.float32)
+
+    @pl.when(j == nk - 1)
+    def _finish():
+        l_fin = l_scr[...]
+        safe = jnp.where(l_fin == 0.0, 1.0, l_fin)
+        o_ref[0] = (acc_scr[...] / _lanes(safe, d)).astype(o_ref.dtype)
+
+
 # lazily bound so importing this module never requires pallas to load
 pl = None
 
@@ -341,6 +382,35 @@ def _bwd_impl(q3, k3, v3, kb, m, l, di, do, scale, heads, bq, bk, interpret):
         interpret=interpret,
     )(*args)
     return dq, dk, dv
+
+
+def _mq_impl(q3, k3, v3, lens2, scale, heads, bk, interpret):
+    """pallas_call wrapper for the Tq=k multi-query decode kernel: the
+    whole query window is one block (bq = Tq), the cache streams in
+    ``bk`` tiles, ``lens2`` is the lane-replicated [B, LANES] int32
+    valid-length array (forward only — verify never trains)."""
+    pl, pltpu = _load_pallas()
+    G, Tq, d = q3.shape
+    Tk = k3.shape[1]
+    nk = Tk // bk
+    kernel = functools.partial(_mq_decode_kernel, scale=scale, nk=nk, bk=bk)
+    return pl.pallas_call(
+        kernel,
+        grid=(G, 1, nk),
+        in_specs=[
+            pl.BlockSpec((1, Tq, d), lambda b, i, j: (b, 0, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, _LANES), lambda b, i, j: (b // heads, 0)),
+        ],
+        out_shape=jax.ShapeDtypeStruct((G, Tq, d), q3.dtype),
+        out_specs=pl.BlockSpec((1, Tq, d), lambda b, i, j: (b, 0, 0)),
+        scratch_shapes=[pltpu.VMEM((Tq, _LANES), jnp.float32),
+                        pltpu.VMEM((Tq, _LANES), jnp.float32),
+                        pltpu.VMEM((Tq, d), jnp.float32)],
+        compiler_params=_compiler_params(pltpu),
+        interpret=interpret,
+    )(q3, k3, v3, lens2)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
@@ -504,7 +574,7 @@ def reference_decode_attention(q, k, v, lengths, scale=None):
 
 def decode_attention(q, k, v, lengths, scale=None, *,
                      block_k: Optional[int] = None,
-                     interpret: bool = False):
+                     interpret: bool = False, page: int = 0):
     """Fused single-query decode: the flash forward kernel at ``bq=1``
     (forward only — decode is inference; no VJP needed) streaming the
     cache in ``block_k`` tiles with the per-row length mask as the key
@@ -526,7 +596,7 @@ def decode_attention(q, k, v, lengths, scale=None, *,
     if block_k is None:
         from . import autotune as _autotune
         tuned = _autotune.get_blocks(
-            1, C, d, q.dtype, True, decode=True,
+            1, C, d, q.dtype, True, decode=True, page=page,
             concrete=not isinstance(q, jax.core.Tracer))
         bk = tuned[1] if tuned is not None else None
         if bk is not None and C % bk:
@@ -549,31 +619,155 @@ def decode_attention(q, k, v, lengths, scale=None, *,
 
 
 def cache_insert(cache, new, lengths, write=None):
-    """Append one token's K or V rows into a bucketed cache: ``cache``
-    [B, H, C, d], ``new`` [B, H, 1, d], written at position ``lengths[b]``
-    per row via a vmapped ``dynamic_update_slice`` — O(B*H*d) bytes
-    touched instead of a one-hot select over the whole cache, and with
-    donated buffers (the serving decode executables) XLA updates the HBM
-    cache in place.
+    """Append one token window's K or V rows into a bucketed cache:
+    ``cache`` [B, H, C, d], ``new`` [B, H, k, d] (k = 1 for plain decode,
+    k > 1 for a speculative verify window), written at positions
+    ``lengths[b] .. lengths[b]+k-1`` per row via a vmapped
+    ``dynamic_update_slice`` — O(B*H*k*d) bytes touched instead of a
+    one-hot select over the whole cache, and with donated buffers (the
+    serving decode executables) XLA updates the HBM cache in place.
 
     ``write`` [B] (optional 0/1): rows with ``write == 0`` keep their
-    cache bit-identical — the token's value at the target position is
+    cache bit-identical — the window's values at the target positions are
     replaced by a gather of what is already there, so a full-cache
     select is never needed (the continuous batcher's inactive slots).
     Out-of-range ``lengths`` clamp (XLA slice semantics) and the gathered
-    old value makes the clamped write a no-op, so a freed slot's stale
+    old values make the clamped write a no-op, so a freed slot's stale
     length can never corrupt a neighbour."""
     lengths = jnp.asarray(lengths).astype(jnp.int32)
     new = new.astype(cache.dtype)
     if write is not None:
+        kw = new.shape[2]
         old = jax.vmap(
             lambda c, l: jax.lax.dynamic_slice(
-                c, (0, l, 0), (c.shape[0], 1, c.shape[2])))(cache, lengths)
+                c, (0, l, 0), (c.shape[0], kw, c.shape[2])))(cache, lengths)
         keep = jnp.asarray(write).astype(bool)[:, None, None, None]
         new = jnp.where(keep, new, old)
     return jax.vmap(
         lambda c, n, l: jax.lax.dynamic_update_slice(c, n, (0, l, 0)))(
         cache, new, lengths)
+
+
+# --------------------------------------------------------------------------
+# paged KV cache: page-table gather/scatter over a token-row pool (ISSUE 12)
+# --------------------------------------------------------------------------
+# The pool stores one layer's K or V cache as [n_pages * page_size, H, d]
+# token rows; a host-side page table [S, MP] maps each slot's logical page
+# j to a physical page id. Shapes stay static (the serving zero-compile
+# contract): the gathered per-slot cache is always [S, H, MP*page_size, d]
+# and the usual length bias masks the unoccupied tail, so ragged occupancy
+# and partially-filled pages stay exact. Page id 0 is reserved as the
+# zero page: unallocated table entries point there, and write-gated rows
+# scatter back the value they gathered, so a freed/inactive slot can never
+# corrupt a page another slot (or the prefix registry) still references.
+
+def paged_positions(page_table, positions, page_size: int):
+    """Physical token rows for logical positions: ``page_table`` [S, MP]
+    int32, ``positions`` [S, k] -> [S, k] int32. Out-of-table positions
+    clamp to the last page entry (XLA gather semantics) — callers gate
+    those writes, mirroring ``cache_insert``'s stale-length contract."""
+    P = int(page_size)
+    positions = jnp.asarray(positions).astype(jnp.int32)
+    pi = jnp.clip(positions // P, 0, page_table.shape[1] - 1)
+    page = jnp.take_along_axis(page_table, pi, axis=1)
+    return page * P + positions % P
+
+
+def paged_gather(pool, page_table, page_size: int):
+    """Materialize per-slot caches from the pool: ``pool``
+    [NP, H, d] token rows, ``page_table`` [S, MP] -> [S, H, MP*P, d] —
+    the gather-indices form the ISSUE 12 tentpole threads through
+    ``decode_attention``/``cached_sdpa``. The gather is a temp (the
+    attention kernel reads every valid row anyway); only the POOL is
+    persistent HBM, which is what paging shrinks."""
+    P = int(page_size)
+    S, MP = page_table.shape
+    idx = (page_table[:, :, None].astype(jnp.int32) * P
+           + jnp.arange(P, dtype=jnp.int32)[None, None, :]).reshape(S, MP * P)
+    return jnp.transpose(pool[idx], (0, 2, 1, 3))
+
+
+def paged_insert(pool, new, lengths, page_table, page_size: int, write=None):
+    """Append k tokens' K or V rows into the paged pool: ``new``
+    [S, H, k, d] written at logical positions ``lengths[s] + i`` through
+    the page table. ``write`` [S] gates rows exactly like
+    :func:`cache_insert` (gated rows scatter back the old value — a
+    no-op even on the clamped/zero page). The scatter touches O(S*k*H*d)
+    bytes; with donated pool buffers XLA updates the pool in place."""
+    lengths = jnp.asarray(lengths).astype(jnp.int32)
+    new = jnp.asarray(new)
+    S, H, k, d = new.shape
+    pos = lengths[:, None] + jnp.arange(k, dtype=jnp.int32)[None, :]
+    rows = paged_positions(page_table, pos, page_size).reshape(S * k)
+    upd = jnp.transpose(new, (0, 2, 1, 3)).reshape(S * k, H, d) \
+        .astype(pool.dtype)
+    if write is not None:
+        keep = jnp.repeat(jnp.asarray(write).astype(bool), k)[:, None, None]
+        upd = jnp.where(keep, upd, pool[rows])
+    return pool.at[rows].set(upd)
+
+
+# --------------------------------------------------------------------------
+# multi-query decode: verify k speculated tokens in ONE step (ISSUE 12)
+# --------------------------------------------------------------------------
+
+def reference_decode_multiquery(q, k, v, lengths, scale=None):
+    """Quadratic reference for the speculative Tq=k verify window: query
+    i sits at global position ``lengths[b] + i`` and attends cache
+    columns ``< lengths[b] + 1 + i`` (its own just-appended token
+    included) — causal WITHIN the window, full visibility of the prefix.
+    Shares :func:`reference_attention`'s f32 numerics."""
+    C = k.shape[2]
+    Tq = q.shape[2]
+    lengths = jnp.asarray(lengths).astype(jnp.int32)
+    col = jnp.arange(C, dtype=jnp.int32)[None, None, :]
+    row = jnp.arange(Tq, dtype=jnp.int32)[None, :, None]
+    valid = col < lengths[:, None, None] + 1 + row
+    bias = jnp.where(valid, jnp.float32(0.0), jnp.float32(_NEG))[:, None]
+    return reference_attention(q, k, v, bias=bias, scale=scale)
+
+
+def decode_multiquery_attention(q, k, v, lengths, scale=None, *,
+                                block_k: Optional[int] = None,
+                                interpret: bool = False, page: int = 0):
+    """Fused multi-query decode: the window-causal kernel at ``bq = Tq=k``
+    (forward only — verification is inference) streaming the cache in
+    ``block_k`` tiles with the per-row base length driving the in-kernel
+    causal mask. ``q`` [B, H, k, d]; ``k``/``v`` [B, H, C, d];
+    ``lengths`` [B] = valid cache entries BEFORE the k-token window (the
+    window's own rows already appended at ``lengths .. lengths+k-1``)."""
+    if q.ndim != 4 or q.shape[2] < 1:
+        raise ValueError(f"decode_multiquery wants q [B,H,k,d]; got "
+                         f"{q.shape}")
+    B, H, Tq, d = q.shape
+    C = k.shape[2]
+    if k.shape != (B, H, C, d) or v.shape != (B, H, C, d):
+        raise ValueError(f"q/cache shapes disagree: {q.shape} {k.shape} "
+                         f"{v.shape}")
+    if block_k is None:
+        from . import autotune as _autotune
+        tuned = _autotune.get_blocks(
+            Tq, C, d, q.dtype, True, decode=True, page=page,
+            concrete=not isinstance(q, jax.core.Tracer))
+        bk = tuned[1] if tuned is not None else None
+        if bk is not None and C % bk:
+            bk = pick_block(C)
+    else:
+        bk = pick_block(C, block_k)
+    if bk is None:
+        raise ValueError(f"cache length {C} does not tile into decode "
+                         "blocks; bucket the cache to a power of two")
+    if not fits_vmem_attention(Tq, bk, d, np.dtype(q.dtype).itemsize):
+        raise ValueError(f"multi-query decode tiles exceed the VMEM "
+                         f"budget (Tq={Tq}, bk={bk}, d={d})")
+    if scale is None:
+        scale = 1.0 / float(np.sqrt(d))
+    lens2 = jnp.broadcast_to(
+        jnp.asarray(lengths).astype(jnp.int32)[:, None], (B, _LANES))
+    o = _mq_impl(q.reshape(B * H, Tq, d), k.reshape(B * H, C, d),
+                 v.reshape(B * H, C, d), lens2, float(scale), H, bk,
+                 bool(interpret))
+    return o.reshape(B, H, Tq, d)
 
 
 # --------------------------------------------------------------------------
@@ -587,7 +781,15 @@ _COUNTER_KEYS = ("fused", "fallback_mode", "fallback_platform",
                  # serving dispatch mix shows up on the same /metrics family
                  "decode_fused", "decode_fallback_mode",
                  "decode_fallback_platform", "decode_fallback_shape",
-                 "decode_fallback_dtype", "decode_fallback_vmem")
+                 "decode_fallback_dtype", "decode_fallback_vmem",
+                 # ISSUE 12: Tq>1 decisions split out of the one
+                 # decode_fallback_shape slug — a query-bank reference
+                 # route (by design) is distinguishable from the
+                 # speculative verify either taking its fused Tq=k path
+                 # (decode_multiquery) or silently losing it
+                 # (decode_multiquery_fallback)
+                 "decode_fallback_multiquery", "decode_multiquery",
+                 "decode_multiquery_fallback")
 # dispatch decisions live in the process-wide MetricsRegistry (ISSUE 6):
 # one counter, labeled by decision, so `GET /metrics` exposes the
 # fused-vs-fallback mix; counters()/reset_counters() below are the
@@ -695,24 +897,70 @@ def _route_decode(q, k, v) -> Optional[str]:
     return None
 
 
-def decode_dispatch(q, k, v, lengths, scale=None):
+def decode_dispatch(q, k, v, lengths, scale=None, page: int = 0):
     """Guarded decode dispatch: the single-query flash kernel when the
     route is clear, the f32-softmax reference otherwise. The KV-cache
     layers and the SameDiff ``attention.cached_sdpa`` op both enter here.
-    ``q`` with Tq > 1 (e.g. LearnedSelfAttention's query bank) always
-    takes the reference path — the decode kernel is a single-row grid."""
+    ``q`` with Tq > 1 (e.g. LearnedSelfAttention's query bank — uniform
+    visibility over the valid cache, NOT the speculative verify's causal
+    window) takes the reference path, counted under its own
+    ``decode_fallback_multiquery`` slug (ISSUE 12 satellite) so it never
+    blends with genuine shape failures or the verify path's decisions."""
     if q.ndim == 4 and q.shape[2] == 1:
         reason = _route_decode(q, k, v)
+    elif q.ndim == 4 and q.shape[2] > 1:
+        reason = "decode_fallback_multiquery"
     else:
         reason = "decode_fallback_shape"
     if reason is None:
         _DISPATCH.inc(decision="decode_fused")
-        return decode_attention(q, k, v, lengths, scale,
+        return decode_attention(q, k, v, lengths, scale, page=page,
                                 interpret=not _tpu_available())
     _DISPATCH.inc(decision=reason)
     C = k.shape[2]
     bias = length_bias(lengths, C)[:, None, None, :]
     return reference_attention(q, k, v, bias=bias, scale=scale)
+
+
+def _route_multiquery(q, k, v) -> Optional[str]:
+    """None = fuse the Tq=k window-causal verify kernel; otherwise the
+    single ``decode_multiquery_fallback`` slug — the signal the ISSUE 12
+    satellite asks for: speculative verify silently losing its fused
+    path is one visible number on ``/metrics``."""
+    if _state["mode"] == "off":
+        return "decode_multiquery_fallback"
+    if _state["mode"] != "force" and not _tpu_available():
+        return "decode_multiquery_fallback"
+    if q.ndim != 4 or q.shape[2] < 1 or k.shape != v.shape or \
+            q.shape[:2] != k.shape[:2] or q.shape[-1] != k.shape[-1]:
+        return "decode_multiquery_fallback"
+    if q.dtype not in _FUSABLE_DTYPES:
+        return "decode_multiquery_fallback"
+    bk = pick_block(k.shape[2])
+    if bk is None:
+        return "decode_multiquery_fallback"
+    if not fits_vmem_attention(q.shape[2], bk, q.shape[-1],
+                               np.dtype(q.dtype).itemsize):
+        return "decode_multiquery_fallback"
+    return None
+
+
+def decode_multiquery_dispatch(q, k, v, lengths, scale=None, page: int = 0):
+    """Guarded multi-query decode dispatch (speculative verify, ISSUE
+    12): the window-causal Tq=k kernel when the route is clear, the
+    reference path with an explicit per-query bias otherwise. ``lengths``
+    [B] counts valid cache entries BEFORE the k-token window. Every
+    decision is counted (``decode_multiquery`` vs
+    ``decode_multiquery_fallback``) — the tier-1 dispatch asserts and
+    ``/metrics`` both see a verify that lost its fused path."""
+    reason = _route_multiquery(q, k, v)
+    if reason is None:
+        _DISPATCH.inc(decision="decode_multiquery")
+        return decode_multiquery_attention(q, k, v, lengths, scale,
+                                           page=page,
+                                           interpret=not _tpu_available())
+    _DISPATCH.inc(decision=reason)
+    return reference_decode_multiquery(q, k, v, lengths, scale=scale)
 
 
 @register("attention.fused_sdpa", category="attention")
@@ -751,3 +999,35 @@ def cached_sdpa(q, k_new, v_new, k_cache, v_cache, lengths,
     vc = cache_insert(v_cache, v_new, lengths)
     y = decode_dispatch(q, kc, vc, lengths + 1, scale=float(scale))
     return y, kc, vc
+
+
+@register("attention.paged_sdpa", category="attention",
+          differentiable=False)
+def paged_sdpa(q, k_new, v_new, k_pool, v_pool, page_table, lengths,
+               scale: float = 1.0, page_size: int = 16):
+    """Paged-KV decode-step attention graph op (ISSUE 12): the paged twin
+    of ``attention.cached_sdpa``, the rewrite target of
+    ``autodiff.decode.rewrite_for_decode(..., paged=True)``.
+
+    ``q``/``k_new``/``v_new``: this step's projections, [B, H, Tq, d]
+    (Tq = 1 for plain decode, k for a speculative verify window);
+    ``k_pool``/``v_pool``: [n_pages*page_size, H, d] token-row pools;
+    ``page_table``: [B, MP] int32 physical page ids; ``lengths``: [B]
+    valid entries per row BEFORE this window. Appends the window's rows
+    through the page table, attends (single-query length-masked, or
+    window-causal for Tq > 1), and returns ``(y, k_pool', v_pool')``.
+    The CALLER keeps ``lengths + Tq <= MP*page_size`` and forks shared
+    pages first (copy-on-write lives host-side in the pool allocator)."""
+    lengths = jnp.asarray(lengths)
+    kp = paged_insert(k_pool, k_new, lengths, page_table, page_size)
+    vp = paged_insert(v_pool, v_new, lengths, page_table, page_size)
+    kf = paged_gather(kp, page_table, page_size)
+    vf = paged_gather(vp, page_table, page_size)
+    if q.shape[2] == 1:
+        y = decode_dispatch(q, kf, vf, lengths + 1, scale=float(scale),
+                            page=int(page_size))
+    else:
+        y = decode_multiquery_dispatch(q, kf, vf, lengths,
+                                       scale=float(scale),
+                                       page=int(page_size))
+    return y, kp, vp

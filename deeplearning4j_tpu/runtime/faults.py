@@ -136,6 +136,7 @@ SITES = frozenset({
     "serving.slow",       # injected dispatch latency (overload -> shedding)
     "serving.decode",     # continuous-batching decode iteration failure
     "serving.quantize",   # weight quantization failure -> f32 fallback
+    "serving.page_pool",  # paged-KV page allocation failure / pressure
     "parallel.host_loss",  # whole host drops out of the pod (reinit+restore)
 })
 

@@ -2,12 +2,16 @@
 JsonModelServer, re-expressed for TPU as a bucketed AOT engine plus a
 dynamic micro-batching dispatcher; ISSUE 8 adds the generative decode
 hot path — KV-cache prefill/decode executables and token-boundary
-continuous batching with streaming)."""
+continuous batching with streaming; ISSUE 12 adds the paged KV pool —
+fixed-size HBM pages + host page tables, copy-on-write prefix sharing,
+and draft/verify speculative decoding)."""
 
 from ..runtime.faults import (DeadlineExceeded, QueueFull,  # noqa: F401
                               ShutdownError)
 from .engine import (DecodeState, GenerativeEngine,  # noqa: F401
-                     InferenceEngine, default_buckets, next_bucket)
+                     InferenceEngine, PagedDecodeState,
+                     PagedGenerativeEngine, default_buckets, next_bucket)
+from .kv_pool import PagedKVPool, PoolExhausted  # noqa: F401
 from .batcher import (ContinuousBatcher, GenerationHandle,  # noqa: F401
                       HealthState, InferenceMode, ParallelInference)
 from .server import JsonModelServer  # noqa: F401

@@ -40,6 +40,7 @@ deadline_expired / retries — zero silent fallbacks) and injectable via
 
 from __future__ import annotations
 
+import hashlib
 import itertools
 import queue
 import threading
@@ -49,6 +50,7 @@ from collections import deque
 from concurrent.futures import Future, TimeoutError as _FutTimeout
 from typing import List, Optional, Sequence
 
+import jax.numpy as jnp
 import numpy as np
 
 from ..runtime import faults as _faults
@@ -83,6 +85,15 @@ _G_SLOTS = _tel.gauge("serving.slots_active",
                       "occupied decode slots in the continuous batcher")
 _M_TOKENS = _tel.counter("serving.tokens_generated",
                          "tokens emitted by the continuous batcher")
+# speculative decoding (ISSUE 12): draft-propose / target-verify loop
+_M_PROPOSED = _tel.counter("serving.speculative.proposed",
+                           "draft tokens proposed per active slot")
+_M_ACCEPTED = _tel.counter("serving.speculative.accepted",
+                           "draft tokens the target verify accepted")
+_H_ACCEPT = _tel.histogram(
+    "serving.speculative.accept_rate",
+    "accepted/k per verify window per active slot — THE draft-quality "
+    "signal (emitted tokens per target step = accepted + 1)")
 _pi_ids = itertools.count()
 
 
@@ -731,24 +742,58 @@ class ContinuousBatcher:
                  engine: Optional["GenerativeEngine"] = None,
                  warmup: bool = True,
                  quantize: Optional[str] = None,
-                 kv_cache: Optional[str] = None):
-        from .engine import GenerativeEngine
+                 kv_cache: Optional[str] = None,
+                 paged: bool = False,
+                 page_size: int = 16,
+                 pages: Optional[int] = None,
+                 prefix_cache: bool = True,
+                 draft_model=None,
+                 speculate_k: int = 4):
+        from .engine import GenerativeEngine, PagedGenerativeEngine
         self.model = model
         # ISSUE 9: quantize="int8" (weights) / kv_cache="int8" (per-row
         # quantized KV buckets — half the cache HBM per slot) flow to the
         # engine; with an explicit engine= the caller configures it there
         # (passing both would silently serve the engine's config)
         if engine is not None and (quantize is not None
-                                   or kv_cache is not None):
-            raise ValueError("pass quantize=/kv_cache= on the engine you "
-                             "build (GenerativeEngine(model, ...)), not "
-                             "alongside engine=")
-        self.engine = engine if engine is not None \
-            else GenerativeEngine(model, slots=slots, quantize=quantize,
-                                  kv_cache=kv_cache)
-        self.slots = self.engine.slots
+                                   or kv_cache is not None
+                                   or paged or pages is not None):
+            raise ValueError("pass quantize=/kv_cache=/paged config on "
+                             "the engine you build (GenerativeEngine / "
+                             "PagedGenerativeEngine), not alongside "
+                             "engine=")
         self.max_cache_len = next_bucket(max_cache_len)
         self.min_cache_len = next_bucket(min_cache_len)
+        if engine is None:
+            if paged:
+                # ISSUE 12: fixed-size HBM pages + host page tables; the
+                # default pool can hold every slot at its FULL bucket (no
+                # pressure) — capacity-constrained deployments size
+                # ``pages`` down and lean on sharing/eviction
+                psz = next_bucket(page_size)
+                mp = max(1, self.max_cache_len // psz)
+                n_pages = int(pages) if pages is not None \
+                    else 1 + int(slots) * mp
+                engine = PagedGenerativeEngine(
+                    model, slots=slots, pages=n_pages, page_size=psz,
+                    max_cache_len=self.max_cache_len, quantize=quantize,
+                    kv_cache=kv_cache)
+            else:
+                engine = GenerativeEngine(model, slots=slots,
+                                          quantize=quantize,
+                                          kv_cache=kv_cache)
+        self.engine = engine
+        self.paged = isinstance(engine, PagedGenerativeEngine)
+        if self.paged and self.max_cache_len > engine.max_cache_len:
+            # an explicitly built engine caps the page table; admitting
+            # prompts the table cannot hold would overflow map_pages and
+            # leak the allocated pages — reject the config loudly
+            raise ValueError(
+                f"batcher max_cache_len {self.max_cache_len} exceeds the "
+                f"paged engine's max_cache_len {engine.max_cache_len}; "
+                "size the engine (or the batcher bound) to match")
+        self.prefix_cache = bool(prefix_cache) and self.paged
+        self.slots = self.engine.slots
         self.max_new_tokens = int(max_new_tokens)
         self.deadline_ms = deadline_ms
         self.shed_queue_depth = None if shed_queue_depth is None \
@@ -758,18 +803,50 @@ class ContinuousBatcher:
         self._f = self.engine._feature_dim()
         self.token_to_features = token_to_features or self._one_hot
         self.sample_fn = sample_fn or (lambda logits: int(np.argmax(logits)))
+        # speculative decoding (ISSUE 12): a small draft engine proposes
+        # k tokens; the target verifies all k in ONE bucketed Tq=k step
+        self.speculate_k = int(speculate_k)
+        self.draft = None
+        if draft_model is not None:
+            if not self.paged:
+                raise ValueError("speculative decoding rides the paged "
+                                 "engine's verify executable; pass "
+                                 "paged=True (or a PagedGenerativeEngine)")
+            if sample_fn is not None:
+                raise ValueError("speculative decoding verifies GREEDY "
+                                 "tokens; a custom sample_fn cannot be "
+                                 "teacher-forced — drop one of the two")
+            if self.speculate_k < 2:
+                raise ValueError("speculate_k must be >= 2 (k=1 is plain "
+                                 "decode)")
+            self.draft = GenerativeEngine(draft_model, slots=self.slots)
+            if self.draft._feature_dim() != self._f:
+                raise ValueError(
+                    f"draft model feature dim {self.draft._feature_dim()} "
+                    f"!= target {self._f}: the draft must share the "
+                    "token feature space")
         if warmup:
             cb, b = [], self.min_cache_len
             while b <= self.max_cache_len:
                 cb.append(b)
                 b <<= 1
             pb = list(prompt_buckets) if prompt_buckets else cb
-            self.engine.warmup(cb, pb)
+            if self.paged:
+                self.engine.warmup(
+                    cb, pb, speculate=(self.speculate_k,)
+                    if self.draft is not None else ())
+            else:
+                self.engine.warmup(cb, pb)
+            if self.draft is not None:
+                self.draft.warmup(cb, pb)
         # live decode state + host mirrors (worker-thread-only)
         self._state = self.engine.new_state(self.min_cache_len)
         self._slot_req: List[Optional[_GenRequest]] = [None] * self.slots
         self._lengths = np.zeros((self.slots,), np.int64)
         self._x_t = np.zeros((self.slots, 1, self._f), np.float32)
+        if self.draft is not None:
+            self._dstate = self.draft.new_state(self.min_cache_len)
+            self._dlengths = np.zeros((self.slots,), np.int64)
         self._q: "queue.Queue[_GenRequest]" = queue.Queue(maxsize=queue_limit)
         self._shutdown = threading.Event()
         # observability: same registry families as the one-shot front,
@@ -785,6 +862,9 @@ class ContinuousBatcher:
         self._h_latency = _H_LATENCY.labeled(pi=self._id)
         self._g_slots = _G_SLOTS.labeled(pi=self._id)
         self._g_slots.set(0)
+        self._m_proposed = _M_PROPOSED.labeled(pi=self._id)
+        self._m_accepted = _M_ACCEPTED.labeled(pi=self._id)
+        self._h_accept = _H_ACCEPT.labeled(pi=self._id)
         # r10 degradation state machine, same recent-event window as the
         # one-shot front
         self.health_window = 5.0
@@ -837,10 +917,15 @@ class ContinuousBatcher:
         plen = int(plen) if plen is not None else prompt.shape[0]
         max_new = int(max_new_tokens) if max_new_tokens is not None \
             else self.max_new_tokens
-        if next_bucket(plen + max_new) > self.max_cache_len:
+        # speculative verify windows cache up to k-1 rejected rows past
+        # the live sequence — reserve that slack at admission so the
+        # host-side overflow guard can never trip mid-generation
+        slack = self.speculate_k if self.draft is not None else 0
+        if next_bucket(plen + max_new + slack) > self.max_cache_len:
             raise ValueError(
-                f"prompt ({plen}) + max_new_tokens ({max_new}) exceeds "
-                f"max_cache_len {self.max_cache_len}")
+                f"prompt ({plen}) + max_new_tokens ({max_new})"
+                + (f" + speculative slack ({slack})" if slack else "")
+                + f" exceeds max_cache_len {self.max_cache_len}")
         if self.shed_queue_depth is not None and \
                 self._q.qsize() >= self.shed_queue_depth:
             self._m_shed.inc()
@@ -872,7 +957,7 @@ class ContinuousBatcher:
         return self._q.qsize()
 
     def stats(self) -> dict:
-        return {
+        out = {
             "slots": self.slots,
             "health": self.health(),
             "slots_active": int(self._g_slots.value()),
@@ -886,6 +971,21 @@ class ContinuousBatcher:
             "cache_len": self._state.cache_len,
             "engine": self.engine.stats(),
         }
+        if self.paged:
+            # page-pool occupancy/free + prefix hit counters, per engine
+            # (labeled engine= in the registry; surfaced here for
+            # GET /stats and ServingStatsListener — ISSUE 12 satellite)
+            out["page_pool"] = self.engine.pool.stats()
+        if self.draft is not None:
+            prop = int(self._m_proposed.value())
+            acc = int(self._m_accepted.value())
+            out["speculative"] = {
+                "k": self.speculate_k,
+                "proposed": prop,
+                "accepted": acc,
+                "accept_rate": (acc / prop) if prop else None,
+            }
+        return out
 
     def shutdown(self):
         self._shutdown.set()
@@ -951,7 +1051,18 @@ class ContinuousBatcher:
             self._slot_req[i] = None
         self._lengths[:] = 0
         self._x_t[:] = 0.0
+        if self.paged:
+            # reclaim every mapped page AND forget registered prefixes:
+            # the pool device buffers were donated into the failed
+            # dispatch, so a later prefix hit would map zeroed pages
+            for s in range(self.slots):
+                self.engine.pool.release(
+                    self.engine.release_slot(self._state, s))
+            self.engine.pool.clear_prefixes()
         self._state = self.engine.new_state(self.min_cache_len)
+        if self.draft is not None:
+            self._dstate = self.draft.new_state(self.min_cache_len)
+            self._dlengths[:] = 0
         self._g_slots.set(self.active_slots())
 
     def _admit(self) -> int:
@@ -989,13 +1100,13 @@ class ContinuousBatcher:
                 if not req.handle.future.done():
                     req.handle.future.set_exception(e)
                 req.handle._stream.put(None)
+                # a mid-admission failure (page-pool exhaustion, a
+                # raising sample_fn in _emit_token, ...) must not leave
+                # a zombie slot decoding a dead request — or leak the
+                # pages already mapped into its table row
                 if self._slot_req[slot] is req:
-                    # a post-assignment failure (e.g. a raising
-                    # sample_fn in _emit_token) must not leave a zombie
-                    # slot decoding a dead request
                     self._slot_req[slot] = None
-                    self._lengths[slot] = 0
-                    self._x_t[slot] = 0.0
+                self._reset_slot(slot)
         self._g_slots.set(self.active_slots())
         return n
 
@@ -1004,17 +1115,91 @@ class ContinuousBatcher:
         if need_c > self._state.cache_len:
             self._state = self.engine.grow(self._state, need_c)
         req.t_admitted = time.perf_counter()
-        self._state, logits = self.engine.prefill(
-            self._state, req.x, req.plen, slot)
+        if self.paged:
+            logits = self._paged_admit(req, slot)
+        else:
+            self._state, logits = self.engine.prefill(
+                self._state, req.x, req.plen, slot)
+        if self.draft is not None:
+            # the draft's (small, contiguous) caches always prefill —
+            # they are private per slot, never shared
+            if need_c > self._dstate.cache_len:
+                self._dstate = self.draft.grow(self._dstate, need_c)
+            self._dstate, _ = self.draft.prefill(
+                self._dstate, req.x, req.plen, slot)
+            self._dlengths[slot] = req.plen
         self._slot_req[slot] = req
         self._lengths[slot] = req.plen
         self._emit_token(slot, logits)
 
+    def _paged_admit(self, req: _GenRequest, slot: int) -> np.ndarray:
+        """Paged admission with prefix sharing (ISSUE 12): hash the full
+        prompt; a registry hit maps the SAME physical pages into this
+        slot (refcounted — the prompt was prefilled ONCE, fleet-wide)
+        and reuses the recorded logits; a miss allocates pages, prefills,
+        and registers. A shared page forks only on first write
+        (copy-on-write in ``prepare_write``).
+
+        The key is the FULL prompt, not a per-page token chunk: the
+        stack's prefix-LM semantics make the prompt attend
+        bidirectionally over itself, so deep-layer k/v for a shared
+        token prefix DIFFER under different suffixes — only identical
+        prompts may share pages (divergence recorded in PARITY.md)."""
+        P = self.engine.page_size
+        n_pages = -(-req.plen // P)
+        key = None
+        if self.prefix_cache:
+            h = hashlib.blake2b(digest_size=16)
+            h.update(np.int64(req.plen).tobytes())
+            h.update(np.ascontiguousarray(req.x[:req.plen],
+                                          dtype=np.float32).tobytes())
+            key = h.hexdigest()
+            hit = self.engine.pool.lookup_prefix(key)
+            if hit is not None:
+                self.engine.map_pages(self._state, slot, hit.pages)
+                self._state.lengths[slot] = req.plen
+                return hit.logits.copy()
+        pages = self.engine.pool.alloc(n_pages)
+        try:
+            self.engine.map_pages(self._state, slot, pages)
+            self._state, logits = self.engine.prefill(
+                self._state, req.x, req.plen, slot)
+        except BaseException:
+            # reclaim the WHOLE allocation exactly once: clear the table
+            # row first so the caller's _reset_slot sweep cannot release
+            # the mapped subset a second time (a double release would
+            # put duplicate ids on the free list)
+            self._state.page_table[slot, :] = 0
+            self.engine.pool.release(pages)
+            raise
+        if key is not None:
+            self.engine.pool.register_prefix(key, pages, req.plen, logits)
+        return logits
+
+    def _reset_slot(self, slot: int):
+        """Reclaim one slot's host mirrors and (paged) its pages/draft
+        length — shared between normal leave, admission failure, and the
+        fail-active sweep."""
+        self._lengths[slot] = 0
+        self._x_t[slot] = 0.0
+        if self.paged:
+            self.engine.pool.release(
+                self.engine.release_slot(self._state, slot))
+        if self.draft is not None:
+            self._dlengths[slot] = 0
+
     def _emit_token(self, slot: int, logits: np.ndarray):
         """Sample, stream, and either finish the slot's request or queue
         the token as the slot's next decode input."""
-        req = self._slot_req[slot]
         tok = self.sample_fn(logits)
+        self._emit_known(slot, tok, logits)
+
+    def _emit_known(self, slot: int, tok: int, logits: np.ndarray) -> bool:
+        """Emit one decided token (sampled, or a verified/corrected
+        speculative token). Returns True when the request finished and
+        the slot was reclaimed."""
+        req = self._slot_req[slot]
+        tok = int(tok)
         req.tokens.append(tok)
         req.emitted += 1
         self._m_tokens.inc()
@@ -1030,10 +1215,10 @@ class ContinuousBatcher:
                     {"tokens": list(req.tokens), "logits": logits})
             req.handle._stream.put(None)
             self._slot_req[slot] = None
-            self._lengths[slot] = 0
-            self._x_t[slot] = 0.0
+            self._reset_slot(slot)
         else:
             self._x_t[slot, 0] = self.token_to_features(tok)
+        return done
 
     def _decode_iter(self):
         active = np.array([1 if r is not None else 0
@@ -1062,6 +1247,18 @@ class ContinuousBatcher:
                         self._note("retry")
                         continue
                     raise
+            if self.draft is not None:
+                self._speculative_iter(active, live)
+                self._g_slots.set(self.active_slots())
+                return
+            if self.paged:
+                # copy-on-write: every active slot's write position must
+                # land on an exclusively-owned page BEFORE dispatch
+                pairs = []
+                for s in live:
+                    pairs += self.engine.prepare_write(self._state, s, 1)
+                if pairs:
+                    self._state = self.engine.fork(self._state, pairs)
             state, logits = self.engine.decode(
                 self._state, self._x_t, active)
         except Exception as e:
@@ -1072,3 +1269,74 @@ class ContinuousBatcher:
         for i in live:
             self._emit_token(i, logits[i])
         self._g_slots.set(self.active_slots())
+
+    def _speculative_iter(self, active, live):
+        """Draft-propose / target-verify (ISSUE 12): the draft engine
+        decodes k cheap single-token steps; the target verifies all k in
+        ONE bucketed Tq=k step through the fused multi-query path.
+        Greedy teacher-forcing makes the emitted stream equal the
+        target's own greedy decode: accepted draft tokens matched the
+        target argmax given exactly the accepted prefix, and the first
+        mismatch emits the target's correction. Accept/reject rollback
+        is a host-side lengths truncation — the rejected rows' pages
+        stay mapped and are simply overwritten by the next window.
+        Raises on dispatch failure (the caller routes to _fail_active)."""
+        from .engine import DecodeState
+        k = self.speculate_k
+        S = self.slots
+        need = int(self._lengths[live].max()) + k
+        if need > self._state.cache_len:
+            self._state = self.engine.grow(self._state, need)
+        if need > self._dstate.cache_len:
+            self._dstate = self.draft.grow(self._dstate, need)
+        # 1) draft proposes k tokens (its lengths mirror is host-owned so
+        # the post-verify rollback can truncate it)
+        dstate = DecodeState(self._dstate.caches,
+                             jnp.asarray(self._dlengths.astype(np.int32)),
+                             self._dstate.cache_len)
+        props = np.zeros((S, k), np.int64)
+        x_d = self._x_t.copy()
+        for j in range(k):
+            dstate, dlg = self.draft.decode(dstate, x_d, active)
+            for s in live:
+                t = int(np.argmax(dlg[s]))
+                props[s, j] = t
+                x_d[s, 0] = self.token_to_features(t)
+        self._dstate = dstate
+        self._m_proposed.inc(k * len(live))
+        # 2) target verifies the window [pending, d_1 .. d_{k-1}]
+        x_seq = np.zeros((S, k, self._f), np.float32)
+        x_seq[:, 0] = self._x_t[:, 0]
+        for s in live:
+            for i in range(1, k):
+                x_seq[s, i] = self.token_to_features(int(props[s, i - 1]))
+        pairs = []
+        for s in live:
+            pairs += self.engine.prepare_write(self._state, s, k)
+        if pairs:
+            self._state = self.engine.fork(self._state, pairs)
+        self._state, vlg = self.engine.verify(self._state, x_seq, active)
+        # 3) accept while draft == target argmax; first mismatch emits
+        # the target's correction; rollback = lengths truncation
+        for s in live:
+            g = np.argmax(vlg[s], axis=-1)
+            accepted = 0
+            emitted = []
+            for i in range(k):
+                emitted.append(int(g[i]))
+                if int(props[s, i]) != int(g[i]):
+                    break
+                accepted += 1
+            self._m_accepted.inc(accepted)
+            self._h_accept.observe(accepted / k)
+            l0 = int(self._lengths[s])
+            done = False
+            for j, tok in enumerate(emitted):
+                done = self._emit_known(s, tok, vlg[s, j])
+                if done:
+                    break
+            if not done:
+                new_l = l0 + len(emitted)
+                self._lengths[s] = new_l
+                self._state.lengths[s] = new_l
+                self._dlengths[s] = new_l

@@ -1171,3 +1171,370 @@ class GenerativeEngine(_QuantizedParamsMixin):
                "kv_cache": self.kv_cache if self._kv_quant else "off"}
         out.update(self._quantize_stats())
         return out
+
+
+class PagedDecodeState:
+    """Live state of one paged decode batch (ISSUE 12): the device-side
+    per-layer page POOLS, plus host-side per-slot lengths and the page
+    table. The page table and lengths are plain numpy owned by the one
+    decode worker thread; every engine call uploads the (mp-bucketed)
+    table as a small int32 argument, so growth is a host array write —
+    zero device copies."""
+
+    __slots__ = ("caches", "lengths", "page_table", "mp", "page_size")
+
+    def __init__(self, caches, lengths, page_table, mp: int,
+                 page_size: int):
+        self.caches = caches            # {layer: {"k": [NP,H,d], ...}}
+        self.lengths = lengths          # np [S] int64 (host)
+        self.page_table = page_table    # np [S, MP] int32 (host)
+        self.mp = int(mp)               # current page-table width bucket
+        self.page_size = int(page_size)
+
+    @property
+    def cache_len(self) -> int:
+        """The logical cache bucket the decode executables see
+        (``mp * page_size``) — the same contract as DecodeState."""
+        return self.mp * self.page_size
+
+
+class PagedGenerativeEngine(GenerativeEngine):
+    """Paged-pool generative engine (ISSUE 12 tentpole): the slot caches
+    become fixed-size HBM pages owned by a :class:`~.kv_pool.PagedKVPool`
+    allocator, threaded through ``decode_attention`` as gather indices.
+
+    - ``new_state()`` builds ONE pool of ``pages`` physical pages per
+      layer (page 0 reserved as the zero page) — persistent KV HBM is
+      the pool, not slots x max-bucket, so ragged occupancy and shared
+      prefixes stop costing rounded-up private buckets.
+    - ``prefill`` scatters the prompt's mini-cache rows through the
+      slot's page-table rows (write-gated past the true prompt length);
+      ``decode``/``verify`` run the layer walk with the page table as an
+      argument — one executable per (window, table-width bucket), so
+      join/leave/grow/fork never compile post-warmup.
+    - ``grow()`` is a page-table width-bucket bump: a host int32 array
+      re-slice, ZERO device copies (vs the contiguous engine's
+      O(slots x C) host re-bucket).
+    - ``verify(state, x_seq, active)`` is speculative decoding's target
+      step: k tokens per slot through the fused Tq=k window-causal
+      kernel (``decode_multiquery_dispatch``); accept/reject rollback is
+      a host-side lengths truncation by the caller.
+    - copy-on-write: the CALLER (batcher) asks :meth:`prepare_write`
+      before dispatch; shared pages fork through one AOT page-copy
+      executable (:meth:`fork`).
+    """
+
+    def __init__(self, model, slots: int = 8, pages: int = 64,
+                 page_size: int = 16, max_cache_len: int = 256,
+                 quantize: Optional[str] = None,
+                 kv_cache: Optional[str] = None):
+        from .kv_pool import PagedKVPool
+        super().__init__(model, slots=slots, quantize=quantize,
+                         kv_cache=kv_cache)
+        self.page_size = next_bucket(page_size)
+        self.max_cache_len = next_bucket(max_cache_len)
+        if self.max_cache_len < self.page_size:
+            self.max_cache_len = self.page_size
+        self.max_pages_per_slot = self.max_cache_len // self.page_size
+        self.pages = int(pages)
+        self.pool = PagedKVPool(self.pages, self.page_size,
+                                engine_id=self._id)
+
+    # ---------------------------------------------------------- state blobs
+    def _pool_spec(self):
+        return self.model.paged_cache_spec(self.pages, self.page_size,
+                                           kv_quant=self._kv_quant)
+
+    def pool_bytes(self) -> int:
+        """Total device bytes of the paged KV pool — the FIXED number the
+        concurrent-streams-per-GB accounting divides into (contiguous
+        slots each cost their full bucket; paged streams cost only their
+        allocated pages)."""
+        return sum(int(np.prod(a.shape)) * np.dtype(a.dtype).itemsize
+                   for a in jax.tree.leaves(self._pool_spec()))
+
+    def bytes_per_token(self) -> int:
+        return self.pool_bytes() // (self.pages * self.page_size)
+
+    def new_state(self, cache_len: int = 0) -> PagedDecodeState:
+        """Fresh zeroed pool + empty page table. ``cache_len`` picks the
+        initial page-table width bucket (defaults to one page)."""
+        caches = jax.tree.map(lambda a: jnp.zeros(a.shape, a.dtype),
+                              self._pool_spec())
+        mp = self._mp_bucket(cache_len)
+        self._g_q_kv.set(self.pool_bytes())
+        return PagedDecodeState(
+            caches, np.zeros((self.slots,), np.int64),
+            np.zeros((self.slots, self.max_pages_per_slot), np.int32),
+            mp, self.page_size)
+
+    def _mp_bucket(self, cache_len: int) -> int:
+        c = next_bucket(max(int(cache_len), 1))
+        mp = max(1, c // self.page_size)
+        return min(next_bucket(mp), self.max_pages_per_slot)
+
+    def grow(self, state: PagedDecodeState,
+             cache_len: int) -> PagedDecodeState:
+        """Page-table append: widen the table-width bucket the decode
+        executables see. Host-only (the full-width numpy table already
+        exists) — zero device copies, zero compiles when the bucket is
+        warmed."""
+        mp2 = self._mp_bucket(cache_len)
+        if mp2 <= state.mp:
+            return state
+        return PagedDecodeState(state.caches, state.lengths,
+                                state.page_table, mp2, state.page_size)
+
+    # ------------------------------------------------- page-table plumbing
+    def map_pages(self, state: PagedDecodeState, slot: int,
+                  pages: Sequence[int]) -> None:
+        """Install a slot's (freshly allocated or prefix-shared) pages
+        into its page-table row, starting at logical page 0."""
+        for j, p in enumerate(pages):
+            state.page_table[slot, j] = int(p)
+
+    def slot_pages(self, state: PagedDecodeState, slot: int) -> list:
+        return [int(p) for p in state.page_table[slot] if p]
+
+    def release_slot(self, state: PagedDecodeState, slot: int) -> list:
+        """Clear a leaving slot's table row + length; returns the page
+        ids for the caller to ``pool.release`` (shared pages survive
+        through their other references)."""
+        pages = self.slot_pages(state, slot)
+        state.page_table[slot, :] = 0
+        state.lengths[slot] = 0
+        return pages
+
+    def prepare_write(self, state: PagedDecodeState, slot: int,
+                      n_tokens: int) -> list:
+        """Make positions ``[lengths[slot], +n_tokens)`` exclusively
+        writable: allocate missing pages, and mark shared pages for a
+        copy-on-write fork (refcount > 1 — the prefix registry or a
+        sibling stream still reads them). Returns ``(src, dst)`` page
+        pairs for ONE batched :meth:`fork` call. Raises host-side on
+        cache overflow (the clamped-scatter alternative would silently
+        overwrite the last page)."""
+        l = int(state.lengths[slot])
+        P = self.page_size
+        j_last = (l + int(n_tokens) - 1) // P
+        if j_last >= self.max_pages_per_slot:
+            raise ValueError(
+                f"slot {slot} write of {n_tokens} at length {l} exceeds "
+                f"max_cache_len {self.max_cache_len}")
+        forks = []
+        for j in range(l // P, j_last + 1):
+            page = int(state.page_table[slot, j])
+            if page == 0:
+                state.page_table[slot, j] = self.pool.alloc(1)[0]
+            elif self.pool.shared(page):
+                fresh = self.pool.alloc(1)[0]
+                forks.append((page, fresh))
+                state.page_table[slot, j] = fresh
+                self.pool.release([page])
+                self.pool.note_fork()
+        return forks
+
+    # ----------------------------------------------------------- compilation
+    def _pprefill_exe(self, tp: int, _warmup=False):
+        model = self.model
+        f = self._feature_dim()
+        dt = _dt.resolve(model.conf.dtype)
+        kv_quant = self._kv_quant
+
+        def fn(params, mstate, pool, x, plen, rows):
+            mini = jax.tree.map(
+                lambda a: jnp.zeros(a.shape, a.dtype),
+                model.decode_cache_spec(1, tp, kv_quant=kv_quant))
+            y, mini = model._prefill(params, x, mstate, mini, plen[None])
+            d = y.shape[-1]
+            logits = jax.lax.dynamic_slice(
+                y, (0, plen - 1, 0), (1, 1, d))[0, 0]
+            # bucket-pad rows (pos >= plen) are write-gated: they may
+            # point at the zero page or a shared partial page, and
+            # scattering garbage there would corrupt other references
+            gate = jnp.arange(tp) < plen
+
+            def scatter(pool_leaf, mini_leaf):
+                upd = jnp.transpose(mini_leaf[0], (1, 0, 2)) \
+                    .astype(pool_leaf.dtype)              # [tp, H, d]
+                upd = jnp.where(gate[:, None, None], upd, pool_leaf[rows])
+                return pool_leaf.at[rows].set(upd)
+
+            pool = jax.tree.map(scatter, pool, mini)
+            return pool, logits
+
+        def build():
+            p_avals, s_avals = self._params_avals()
+            pool_avals = self._pool_spec()
+            return jax.jit(fn, donate_argnums=(2,)).lower(
+                p_avals, s_avals, pool_avals,
+                jax.ShapeDtypeStruct((1, tp, f), dt),
+                jax.ShapeDtypeStruct((), jnp.int32),
+                jax.ShapeDtypeStruct((tp,), jnp.int32))
+
+        return self._get_compiled(("pprefill", tp), build, _warmup)
+
+    def _pdecode_exe(self, kq: int, mp: int, _warmup=False):
+        model = self.model
+        S = self.slots
+        f = self._feature_dim()
+        dt = _dt.resolve(model.conf.dtype)
+        P = self.page_size
+
+        def fn(params, mstate, pool, pt, lengths, x_t, active):
+            y, pool = model._decode_step(params, x_t, mstate, pool,
+                                         lengths, write=active,
+                                         page_table=pt, page_size=P)
+            return pool, y
+
+        def build():
+            p_avals, s_avals = self._params_avals()
+            pool_avals = self._pool_spec()
+            return jax.jit(fn, donate_argnums=(2,)).lower(
+                p_avals, s_avals, pool_avals,
+                jax.ShapeDtypeStruct((S, mp), jnp.int32),
+                jax.ShapeDtypeStruct((S,), jnp.int32),
+                jax.ShapeDtypeStruct((S, kq, f), dt),
+                jax.ShapeDtypeStruct((S,), jnp.int32))
+
+        return self._get_compiled(("pdecode", kq, mp), build, _warmup)
+
+    def _pfork_exe(self, _warmup=False):
+        S = self.slots
+        P = self.page_size
+
+        def fn(pool, src, dst):
+            offs = jnp.arange(P, dtype=jnp.int32)[None, :]
+            rows_s = (src[:, None] * P + offs).reshape(-1)
+            rows_d = (dst[:, None] * P + offs).reshape(-1)
+            return jax.tree.map(
+                lambda leaf: leaf.at[rows_d].set(leaf[rows_s]), pool)
+
+        def build():
+            pool_avals = self._pool_spec()
+            return jax.jit(fn, donate_argnums=(0,)).lower(
+                pool_avals,
+                jax.ShapeDtypeStruct((S,), jnp.int32),
+                jax.ShapeDtypeStruct((S,), jnp.int32))
+
+        return self._get_compiled(("pfork",), build, _warmup)
+
+    def warmup(self, cache_buckets: Sequence[int],
+               prompt_buckets: Sequence[int],
+               speculate: Sequence[int] = ()) -> "PagedGenerativeEngine":
+        """Compile every (table-width bucket) decode executable — plus a
+        Tq=k verify per ``speculate`` window — every prompt-bucket
+        prefill, and the page-fork copy, outside traffic."""
+        mps = sorted({self._mp_bucket(c) for c in cache_buckets})
+        tps = sorted({next_bucket(t) for t in prompt_buckets})
+        for mp in mps:
+            self._pdecode_exe(1, mp, _warmup=True)
+            for kq in speculate:
+                if int(kq) > 1:
+                    self._pdecode_exe(int(kq), mp, _warmup=True)
+        for tp in tps:
+            self._pprefill_exe(tp, _warmup=True)
+        self._pfork_exe(_warmup=True)
+        return self
+
+    # -------------------------------------------------------------- dispatch
+    def prefill(self, state: PagedDecodeState, x, plen: int, slot: int):
+        """Fill ``slot``'s pages from one request's prompt. The slot's
+        page-table row must already cover ``ceil(plen / page_size)``
+        pages (the batcher allocates at admission). Returns
+        ``(state', logits [V])``."""
+        x = np.asarray(x)
+        if x.ndim == 2:
+            x = x[None]
+        dt = _dt.resolve(self.model.conf.dtype)
+        if np.issubdtype(x.dtype, np.floating) and x.dtype != dt:
+            x = x.astype(dt)
+        with self._lock:
+            warmed = sorted(k[1] for k in self._compiled
+                            if k[0] == "pprefill" and k[1] >= x.shape[1])
+        tp = warmed[0] if warmed else next_bucket(x.shape[1])
+        if tp != x.shape[1]:
+            x = np.concatenate(
+                [x, np.zeros((1, tp - x.shape[1]) + x.shape[2:], x.dtype)],
+                axis=1)
+        self._m_calls.inc()
+        exe = self._pprefill_exe(tp)
+        P = self.page_size
+        pos = np.arange(tp)
+        pages = state.page_table[slot, np.minimum(
+            pos // P, self.max_pages_per_slot - 1)].astype(np.int64)
+        rows = np.where(pages > 0, pages * P + pos % P, 0).astype(np.int32)
+        tel = _tel.enabled()
+        t0 = time.perf_counter() if tel else 0.0
+        caches, logits = exe(self._serving_params(), self.model.state,
+                             state.caches, x, np.int32(plen), rows)
+        logits = np.asarray(logits)
+        if tel:
+            self._h_prefill.observe(time.perf_counter() - t0)
+        state.lengths[slot] = int(plen)
+        return PagedDecodeState(caches, state.lengths, state.page_table,
+                                state.mp, state.page_size), logits
+
+    def _dispatch_window(self, state: PagedDecodeState, x, active, kq: int):
+        x = np.asarray(x)
+        dt = _dt.resolve(self.model.conf.dtype)
+        if np.issubdtype(x.dtype, np.floating) and x.dtype != dt:
+            x = x.astype(dt)
+        self._m_calls.inc()
+        exe = self._pdecode_exe(kq, state.mp)
+        pt = np.ascontiguousarray(state.page_table[:, :state.mp],
+                                  dtype=np.int32)
+        tel = _tel.enabled()
+        t0 = time.perf_counter() if tel else 0.0
+        caches, y = exe(self._serving_params(), self.model.state,
+                        state.caches, pt,
+                        state.lengths.astype(np.int32), x,
+                        np.asarray(active, np.int32))
+        y = np.asarray(y)
+        if tel:
+            self._h_decode.observe(time.perf_counter() - t0)
+        return PagedDecodeState(caches, state.lengths, state.page_table,
+                                state.mp, state.page_size), y
+
+    def decode(self, state: PagedDecodeState, x_t, active):
+        """One token for every slot (paged). Advances ``lengths`` for
+        active rows host-side; returns ``(state', logits [S, V])``."""
+        state, y = self._dispatch_window(state, x_t, active, 1)
+        state.lengths += np.asarray(active, np.int64)
+        return state, y[:, 0]
+
+    def verify(self, state: PagedDecodeState, x_seq, active):
+        """Speculative verify: ``x_seq`` [S, k, F] (the pending token
+        followed by k-1 draft tokens) in ONE bucketed step through the
+        fused Tq=k path. ``lengths`` are NOT advanced — the caller
+        truncates them to the accepted count (the paged rollback), which
+        also invalidates the rejected tokens' cache rows. Returns
+        ``(state', logits [S, k, V])``."""
+        return self._dispatch_window(state, x_seq, active,
+                                     int(np.asarray(x_seq).shape[1]))
+
+    def fork(self, state: PagedDecodeState, pairs) -> PagedDecodeState:
+        """Copy-on-write page copies: one batched executable call per
+        ``slots``-sized chunk of (src, dst) pairs (padding entries copy
+        the zero page onto itself — a no-op)."""
+        if not pairs:
+            return state
+        exe = self._pfork_exe()
+        caches = state.caches
+        S = self.slots
+        for i in range(0, len(pairs), S):
+            chunk = pairs[i:i + S]
+            src = np.zeros((S,), np.int32)
+            dst = np.zeros((S,), np.int32)
+            for j, (s_pg, d_pg) in enumerate(chunk):
+                src[j], dst[j] = s_pg, d_pg
+            caches = exe(caches, src, dst)
+        return PagedDecodeState(caches, state.lengths, state.page_table,
+                                state.mp, state.page_size)
+
+    # ---------------------------------------------------------------- admin
+    def stats(self) -> dict:
+        out = super().stats()
+        out["paged"] = self.pool.stats()
+        out["pool_bytes"] = self.pool_bytes()
+        return out

@@ -1,0 +1,244 @@
+"""Paged KV-cache pool: host-side page allocator + copy-on-write prefix
+registry (ISSUE 12 tentpole, layer 1).
+
+The r13/r14 generative stack held one contiguous power-of-two KV bucket
+per slot, so HBM — not compute — was the concurrency ceiling, and a
+fleet-wide system prompt was prefilled and cached once per stream. This
+module is the bookkeeping half of the fix: the device holds ONE pool of
+fixed-size pages per layer ([n_pages * page_size, H, d] token rows —
+``nn.model.paged_cache_spec``); everything that decides WHICH page holds
+WHAT lives here, in plain host Python:
+
+- **allocator**: a free list over page ids with per-page reference
+  counts. Page 0 is reserved as the zero page (unallocated page-table
+  entries point there; write-gated scatters are no-ops against it).
+- **prefix registry**: admitted prompts register their pages under a
+  content key (the full prompt's digest — see the prefix-LM caveat in
+  the engine/PARITY notes); an identical later prompt maps the SAME
+  physical pages into its slot (refcounted) and reuses the recorded
+  prefill logits, so the fleet-wide system prompt is prefilled once.
+- **copy-on-write**: a shared page (refcount > 1 — other streams or the
+  registry still reference it) is never written; the engine forks it
+  (device page copy) on first write and the table entry swings to the
+  private copy. This module only answers ``shared(page)`` and counts the
+  fork.
+- **eviction under pressure**: when the free list runs dry, registry
+  entries are dropped LRU-first (their pages return to the pool once no
+  live stream references them) — the serving system degrades (prefix
+  hit rate drops, counted) instead of dying; only a pool where every
+  page is pinned by a LIVE stream raises :class:`PoolExhausted`. The
+  ``serving.page_pool`` fault site makes the failure path deterministic
+  in tier-1.
+
+Thread-safety: one decode worker owns admission/release; ``stats()`` may
+be read from any thread — all state mutates under one lock.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..runtime import faults as _faults
+from ..runtime import telemetry as _tel
+
+_G_TOTAL = _tel.gauge("serving.page_pool.pages_total",
+                      "allocatable pages in the paged KV pool")
+_G_FREE = _tel.gauge("serving.page_pool.pages_free",
+                     "pages on the free list right now")
+_M_PREFIX_HITS = _tel.counter(
+    "serving.page_pool.prefix_hits",
+    "admissions that mapped a registered prompt's pages (prefilled once)")
+_M_PREFIX_MISSES = _tel.counter(
+    "serving.page_pool.prefix_misses",
+    "admissions that prefilled and registered fresh pages")
+_M_EVICTIONS = _tel.counter(
+    "serving.page_pool.evictions",
+    "prefix-registry entries dropped under allocation pressure")
+_M_FORKS = _tel.counter(
+    "serving.page_pool.forks",
+    "copy-on-write page forks (first write to a shared page)")
+
+
+class PoolExhausted(RuntimeError):
+    """Every page is pinned by a live stream: admission must shed. The
+    batcher maps this to the same counted-failure path as QueueFull —
+    degradation, never corruption."""
+
+
+class _PrefixEntry:
+    __slots__ = ("pages", "plen", "logits")
+
+    def __init__(self, pages: List[int], plen: int, logits: np.ndarray):
+        self.pages = list(pages)
+        self.plen = int(plen)
+        self.logits = np.asarray(logits).copy()
+
+
+class PagedKVPool:
+    """Host bookkeeping for one engine's device page pool.
+
+    ``n_pages`` counts ALL physical pages including the reserved zero
+    page, matching the device pool built from
+    ``model.paged_cache_spec(n_pages, page_size)`` — so ``n_pages - 1``
+    pages are allocatable.
+    """
+
+    def __init__(self, n_pages: int, page_size: int,
+                 engine_id: str = "0"):
+        if n_pages < 2:
+            raise ValueError("paged pool needs >= 2 pages (page 0 is the "
+                             "reserved zero page)")
+        self.n_pages = int(n_pages)
+        self.page_size = int(page_size)
+        self._lock = threading.RLock()
+        self._free: List[int] = list(range(self.n_pages - 1, 0, -1))
+        self._ref = np.zeros(self.n_pages, np.int64)
+        self._prefix: "OrderedDict[str, _PrefixEntry]" = OrderedDict()
+        self.pages_peak = 0
+        self._g_total = _G_TOTAL.labeled(engine=engine_id)
+        self._g_free = _G_FREE.labeled(engine=engine_id)
+        self._m_hits = _M_PREFIX_HITS.labeled(engine=engine_id)
+        self._m_misses = _M_PREFIX_MISSES.labeled(engine=engine_id)
+        self._m_evict = _M_EVICTIONS.labeled(engine=engine_id)
+        self._m_forks = _M_FORKS.labeled(engine=engine_id)
+        self._g_total.set(self.n_pages - 1)
+        self._g_free.set(len(self._free))
+
+    # ----------------------------------------------------------- allocator
+    def _note_free(self):
+        self._g_free.set(len(self._free))
+        in_use = (self.n_pages - 1) - len(self._free)
+        if in_use > self.pages_peak:
+            self.pages_peak = in_use
+
+    def alloc(self, n: int = 1) -> List[int]:
+        """``n`` fresh pages (refcount 1 each). Under pressure, evicts
+        prefix-registry entries LRU-first; raises :class:`PoolExhausted`
+        only when live streams pin everything. All-or-nothing: a failed
+        alloc consumes no pages. Fault site ``serving.page_pool``."""
+        if _faults.enabled():
+            _faults.trip("serving.page_pool")
+        with self._lock:
+            while len(self._free) < n and self._evict_one():
+                pass
+            if len(self._free) < n:
+                raise PoolExhausted(
+                    f"paged KV pool exhausted: need {n} pages, "
+                    f"{len(self._free)} free of {self.n_pages - 1} "
+                    "(every page pinned by live streams)")
+            out = [self._free.pop() for _ in range(n)]
+            for p in out:
+                self._ref[p] = 1
+            self._note_free()
+            return out
+
+    def retain(self, pages: Sequence[int]) -> None:
+        with self._lock:
+            for p in pages:
+                if p:
+                    self._ref[p] += 1
+
+    def _unref_locked(self, pages: Sequence[int]) -> None:
+        """Drop one reference per page (caller holds the lock); a page
+        at refcount 0 returns to the free list."""
+        for p in pages:
+            if not p:
+                continue
+            self._ref[p] -= 1
+            if self._ref[p] <= 0:
+                self._ref[p] = 0
+                self._free.append(int(p))
+        self._note_free()
+
+    def release(self, pages: Sequence[int]) -> None:
+        """Drop one reference per page; a page at refcount 0 returns to
+        the free list (registered prefix pages stay alive through the
+        registry's own reference)."""
+        with self._lock:
+            self._unref_locked(pages)
+
+    def shared(self, page: int) -> bool:
+        """True when writing this page would be visible to another
+        reference (another stream or the prefix registry) — the
+        copy-on-write trigger."""
+        with self._lock:
+            return bool(page) and self._ref[page] > 1
+
+    def note_fork(self) -> None:
+        self._m_forks.inc()
+
+    # ------------------------------------------------------ prefix registry
+    def lookup_prefix(self, key: str) -> Optional[_PrefixEntry]:
+        """Map a registered prompt: bumps every page's refcount for the
+        new stream, refreshes LRU recency, and counts the hit. Returns
+        None (counted miss) when the key is unknown."""
+        with self._lock:
+            e = self._prefix.get(key)
+            if e is None:
+                self._m_misses.inc()
+                return None
+            self._prefix.move_to_end(key)
+            for p in e.pages:
+                self._ref[p] += 1
+            self._m_hits.inc()
+            return e
+
+    def register_prefix(self, key: str, pages: Sequence[int], plen: int,
+                        logits) -> None:
+        """Record a freshly prefilled prompt's pages + logits. The
+        registry holds its OWN reference on each page, so the prefix
+        outlives the stream that paid the prefill."""
+        with self._lock:
+            if key in self._prefix:
+                return
+            e = _PrefixEntry(list(pages), plen, logits)
+            for p in e.pages:
+                self._ref[p] += 1
+            self._prefix[key] = e
+
+    def _evict_one(self) -> bool:
+        """Drop the least-recently-used registry entry (caller holds the
+        lock). Returns False when the registry is empty."""
+        if not self._prefix:
+            return False
+        _key, e = self._prefix.popitem(last=False)
+        self._m_evict.inc()
+        self._unref_locked(e.pages)
+        return True
+
+    def clear_prefixes(self) -> None:
+        """Forget every registered prefix (decode-state rebuild after a
+        failed dispatch: the device pool is re-zeroed, so registered
+        pages no longer hold their contents)."""
+        with self._lock:
+            while self._prefix:
+                _key, e = self._prefix.popitem(last=False)
+                self._unref_locked(e.pages)
+
+    # ----------------------------------------------------------------- view
+    def pages_free(self) -> int:
+        with self._lock:
+            return len(self._free)
+
+    def pages_in_use(self) -> int:
+        with self._lock:
+            return (self.n_pages - 1) - len(self._free)
+
+    def stats(self) -> Dict:
+        with self._lock:
+            return {
+                "page_size": self.page_size,
+                "pages_total": self.n_pages - 1,
+                "pages_free": len(self._free),
+                "pages_in_use": (self.n_pages - 1) - len(self._free),
+                "pages_peak": self.pages_peak,
+                "prefix_entries": len(self._prefix),
+                "prefix_hits": int(self._m_hits.value()),
+                "prefix_misses": int(self._m_misses.value()),
+                "evictions": int(self._m_evict.value()),
+                "forks": int(self._m_forks.value()),
+            }

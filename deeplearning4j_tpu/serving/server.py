@@ -86,8 +86,13 @@ class JsonModelServer:
                                 "failures": pi.failures})
                 elif self.path == "/stats":
                     # serving observability: request latency percentiles,
-                    # queue depth, bucket hits / compiles
-                    self._send(200, server.inference.stats())
+                    # queue depth, bucket hits / compiles; with a
+                    # generative front, the page-pool occupancy / prefix
+                    # hits / speculative accept-rate ride along (ISSUE 12)
+                    st = dict(server.inference.stats())
+                    if server.generator is not None:
+                        st["generator"] = server.generator.stats()
+                    self._send(200, st)
                 elif self.path == "/metrics":
                     # Prometheus text exposition of the whole registry
                     from ..runtime import telemetry as _telemetry

@@ -154,9 +154,13 @@ def test_decode_dispatch_fallback_counters(rng):
         fa.decode_dispatch(qi, k.astype(jnp.int32), v.astype(jnp.int32),
                            lengths)
         assert fa.counters()["decode_fallback_dtype"] == 1
+        # ISSUE 12 satellite: Tq>1 no longer collapses into the shape
+        # slug — a query-bank reference route gets its own decision, so
+        # the speculative verify's fused/fallback mix stays separable
         q4 = jnp.concatenate([q, q], axis=2)    # Tq=2: reference path
         fa.decode_dispatch(q4, k, v, lengths)
-        assert fa.counters()["decode_fallback_shape"] == 2
+        assert fa.counters()["decode_fallback_shape"] == 1
+        assert fa.counters()["decode_fallback_multiquery"] == 1
     finally:
         fa.set_mode(old)
 

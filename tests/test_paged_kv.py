@@ -1,0 +1,607 @@
+"""Paged KV cache, copy-on-write prefix sharing, speculative decoding
+(ISSUE 12).
+
+The acceptance suite for the paged serving memory model, all on CPU (the
+fused kernels run through the Pallas interpreter under mode "force"):
+
+- ops-level paged gather/scatter roundtrip, write gating, clamp safety;
+- the Tq=k window-causal verify kernel == the quadratic reference ==
+  k sequential single-query decodes (argmax), with its own dispatch
+  decisions (``decode_multiquery`` / ``decode_multiquery_fallback``);
+- THE property test: random join/leave/grow/fork sequences over the
+  paged pool are bit-identical to the contiguous-cache oracle (greedy
+  tokens AND raw logits), f32 and int8 KV, including a fully-shared-
+  then-forked prefix;
+- prefix sharing through the batcher (prefilled once, mapped many,
+  forked on first write), pool eviction under pressure, and the
+  ``serving.page_pool`` fault site;
+- speculative decoding: draft/verify emits the target's exact greedy
+  stream for a perfect AND a garbage draft, accept-rate reported, zero
+  post-warmup compiles, fused Tq=k path taken under force mode;
+- GET /stats + ServingStatsListener expose the page-pool / prefix /
+  accept-rate fields; the SameDiff paged rewrite == the cached rewrite.
+"""
+
+import json
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deeplearning4j_tpu.ops as ops
+from deeplearning4j_tpu.nn.config import InputType, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.layers.attention import (
+    LearnedSelfAttentionLayer, SelfAttentionLayer)
+from deeplearning4j_tpu.nn.layers.core import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.model import MultiLayerNetwork
+from deeplearning4j_tpu.ops import autotune as at
+from deeplearning4j_tpu.ops import flash_attention as fa
+from deeplearning4j_tpu.runtime import faults
+from deeplearning4j_tpu.runtime import telemetry as tel
+from deeplearning4j_tpu.serving import (ContinuousBatcher, GenerativeEngine,
+                                        JsonModelServer, PagedGenerativeEngine,
+                                        PagedKVPool, PoolExhausted)
+
+RNG = np.random.default_rng(21)
+V = 16
+
+
+@pytest.fixture
+def force_mode():
+    old = fa.set_mode("force")
+    fa.reset_counters()
+    yield
+    fa.set_mode(old)
+
+
+def _lm(seed=0, heads=2):
+    conf = (NeuralNetConfiguration.builder().seed(seed)
+            .input_type(InputType.recurrent(V, 8))
+            .list(SelfAttentionLayer(n_out=V, n_heads=heads),
+                  DenseLayer(n_out=24, activation="relu"),
+                  OutputLayer(n_out=V, activation="softmax"))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _feat(tok):
+    return np.eye(V, dtype=np.float32)[int(tok)]
+
+
+# ---------------------------------------------------------------------------
+# ops: paged gather/scatter + the Tq=k verify kernel
+# ---------------------------------------------------------------------------
+
+def test_paged_gather_insert_roundtrip(rng):
+    """Scatter through the page table and gather back == the contiguous
+    layout; write gating and out-of-table clamps are no-ops."""
+    H, d, P = 2, 4, 8
+    pool = jnp.zeros((5 * P, H, d), jnp.float32)
+    pt = jnp.asarray([[1, 2], [3, 4]], jnp.int32)
+    new = jnp.asarray(rng.normal(size=(2, H, 3, d)).astype(np.float32))
+    lengths = jnp.asarray([0, 5])
+    pool2 = fa.paged_insert(pool, new, lengths, pt, P)
+    g = np.asarray(fa.paged_gather(pool2, pt, P))
+    assert g.shape == (2, H, 2 * P, d)
+    np.testing.assert_array_equal(g[0][:, 0:3], np.asarray(new)[0])
+    np.testing.assert_array_equal(g[1][:, 5:8], np.asarray(new)[1])
+    # untouched rows stay zero; the zero page stays zero
+    assert np.all(g[0][:, 3:] == 0) and np.all(g[1][:, :5] == 0)
+    assert np.all(np.asarray(pool2)[:P] == 0)
+    # write gating: gated rows (and their stale out-of-range lengths)
+    # leave the pool bit-identical
+    pool3 = fa.paged_insert(pool2, new, jnp.asarray([1, 99]), pt, P,
+                            write=jnp.asarray([0, 0]))
+    np.testing.assert_array_equal(np.asarray(pool3), np.asarray(pool2))
+
+
+def test_multiquery_kernel_matches_reference(rng, force_mode):
+    """The fused Tq=k window-causal kernel == the quadratic reference ==
+    k sequential single-query decodes, and counts its decision."""
+    B, H, C, d, k = 2, 2, 32, 8, 4
+    q = jnp.asarray(rng.normal(size=(B, H, k, d)).astype(np.float32))
+    kc = jnp.asarray(rng.normal(size=(B, H, C, d)).astype(np.float32))
+    vc = jnp.asarray(rng.normal(size=(B, H, C, d)).astype(np.float32))
+    ln = jnp.asarray([5, 20])
+    y = fa.decode_multiquery_dispatch(q, kc, vc, ln)
+    assert fa.counters()["decode_multiquery"] == 1
+    ref = fa.reference_decode_multiquery(q, kc, vc, ln)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(ref), atol=1e-5)
+    # query i == a single-query decode seeing lengths + 1 + i entries
+    for i in range(k):
+        yi = fa.reference_decode_attention(q[:, :, i:i + 1], kc, vc,
+                                           ln + 1 + i)
+        np.testing.assert_allclose(np.asarray(y)[:, :, i:i + 1],
+                                   np.asarray(yi), atol=1e-5)
+    # tokens past a query's window must not influence it
+    kc2 = kc.at[0, :, 8:].set(999.0)
+    vc2 = vc.at[0, :, 8:].set(-999.0)
+    y2 = fa.decode_multiquery_dispatch(q, kc2, vc2, jnp.asarray([5, 3]))
+    y3 = fa.reference_decode_multiquery(q, kc2, vc2, jnp.asarray([5, 3]))
+    np.testing.assert_allclose(np.asarray(y2), np.asarray(y3), atol=1e-5)
+
+
+def test_multiquery_dispatch_counters(rng):
+    """Verify losing its fused path is ONE visible number (the ISSUE 12
+    satellite): mode off, CPU auto, and bad dtype all count
+    decode_multiquery_fallback — never a silent reference route."""
+    B, H, C, d, k = 1, 1, 16, 8, 3
+    q = jnp.asarray(rng.normal(size=(B, H, k, d)).astype(np.float32))
+    kc = jnp.asarray(rng.normal(size=(B, H, C, d)).astype(np.float32))
+    ln = jnp.asarray([4])
+    fa.reset_counters()
+    old = fa.mode()
+    try:
+        fa.set_mode("auto")   # CPU: platform fallback
+        fa.decode_multiquery_dispatch(q, kc, kc, ln)
+        assert fa.counters()["decode_multiquery_fallback"] == 1
+        fa.set_mode("off")
+        fa.decode_multiquery_dispatch(q, kc, kc, ln)
+        assert fa.counters()["decode_multiquery_fallback"] == 2
+        fa.set_mode("force")
+        qi = q.astype(jnp.int32)
+        fa.decode_multiquery_dispatch(qi, kc.astype(jnp.int32),
+                                      kc.astype(jnp.int32), ln)
+        assert fa.counters()["decode_multiquery_fallback"] == 3
+        assert fa.counters()["decode_multiquery"] == 0
+    finally:
+        fa.set_mode(old)
+
+
+def test_autotune_page_keys(tmp_path):
+    """Page size is part of the decode tuning key and survives disk
+    persistence; multi-query decode keys pin block_q to the window."""
+    at.reset()
+    key = at.cache_key(4, 64, 16, np.float32, True, decode=True, page=8)
+    assert key[-2:] == ("decode", "page8")
+    b = at.get_blocks(4, 64, 16, np.float32, True, decode=True, page=8)
+    assert b is not None and b[0] == 4 and 64 % b[1] == 0
+    # contiguous (page0) and paged keys do not collide
+    b2 = at.get_blocks(4, 64, 16, np.float32, True, decode=True)
+    assert at.lookup(4, 64, 16, np.float32, True, decode=True, page=8) \
+        is not None
+    assert at.lookup(4, 64, 16, np.float32, True, decode=True) is not None
+    assert b2 is not None
+    p = str(tmp_path / "tune.json")
+    at.save(p)
+    at.reset()
+    assert at.load(p) >= 2
+    assert at.lookup(4, 64, 16, np.float32, True, decode=True, page=8) \
+        is not None
+    at.reset()
+
+
+# ---------------------------------------------------------------------------
+# THE property test: paged pool == contiguous oracle, bit-identical
+# ---------------------------------------------------------------------------
+
+def _drive_paged_vs_contiguous(net, op_seq, kv_cache=None, slots=3,
+                               page_size=8, max_cache=16):
+    """Run one random join/leave/grow/fork sequence on a paged engine and
+    the contiguous oracle in lockstep, asserting raw logits bit-equality
+    at every prefill and decode step. Returns (paged engine, per-slot
+    greedy token logs from both paths)."""
+    P = page_size
+    ce = GenerativeEngine(net, slots=slots, kv_cache=kv_cache)
+    pe = PagedGenerativeEngine(net, slots=slots,
+                               pages=1 + slots * (max_cache // P) + 2,
+                               page_size=P, max_cache_len=max_cache,
+                               kv_cache=kv_cache)
+    buckets = [b for b in (8, 16, 32) if b <= max_cache]
+    ce.warmup(buckets, [8])
+    pe.warmup(buckets, [8])
+    cs = ce.new_state(8)
+    ps = pe.new_state(8)
+    prompts = [np.eye(V, dtype=np.float32)[RNG.integers(0, V, n)]
+               for n in (3, 5, 6)]
+    pending = [None] * slots          # next input token per live slot
+    lengths = np.zeros(slots, np.int64)
+    live = [False] * slots
+    toks_c = [[] for _ in range(slots)]
+    toks_p = [[] for _ in range(slots)]
+    for op in op_seq:
+        if op[0] == "admit":
+            free = [i for i in range(slots) if not live[i]]
+            if not free:
+                continue
+            slot, pi = free[0], op[1] % len(prompts)
+            prompt, plen = prompts[pi], len(prompts[pi])
+            cs, cl = ce.prefill(cs, prompt, plen, slot)
+            key = f"prompt-{pi}"
+            hit = pe.pool.lookup_prefix(key)
+            if hit is not None:
+                pe.map_pages(ps, slot, hit.pages)
+                ps.lengths[slot] = plen
+                pl = hit.logits.copy()
+            else:
+                pages = pe.pool.alloc(-(-plen // P))
+                pe.map_pages(ps, slot, pages)
+                ps, pl = pe.prefill(ps, prompt, plen, slot)
+                pe.pool.register_prefix(key, pages, plen, pl)
+            np.testing.assert_array_equal(cl, pl)
+            live[slot] = True
+            lengths[slot] = plen
+            pending[slot] = int(np.argmax(pl))
+            toks_c[slot] = [int(np.argmax(cl))]
+            toks_p[slot] = [int(np.argmax(pl))]
+        elif op[0] == "leave":
+            slot = op[1] % slots
+            if live[slot]:
+                live[slot] = False
+                pending[slot] = None
+                lengths[slot] = 0
+                pe.pool.release(pe.release_slot(ps, slot))
+        elif op[0] == "step":
+            cur = [i for i in range(slots) if live[i]]
+            if not cur:
+                continue
+            need = int(lengths[cur].max()) + 1
+            if need > cs.cache_len:
+                cs = ce.grow(cs, cs.cache_len + 1)
+                ps = pe.grow(ps, ps.cache_len + 1)
+            assert cs.cache_len == ps.cache_len
+            active = np.array([1 if live[i] else 0 for i in range(slots)],
+                              np.int32)
+            x = np.zeros((slots, 1, V), np.float32)
+            for i in cur:
+                x[i, 0] = _feat(pending[i])
+            cs, cl = ce.decode(cs, x, active)
+            pairs = []
+            for i in cur:
+                pairs += pe.prepare_write(ps, i, 1)
+            ps = pe.fork(ps, pairs)
+            ps, pl = pe.decode(ps, x, active)
+            cl = np.asarray(cl)
+            for i in cur:
+                np.testing.assert_array_equal(cl[i], pl[i])
+                lengths[i] += 1
+                pending[i] = int(np.argmax(pl[i]))
+                toks_c[i].append(int(np.argmax(cl[i])))
+                toks_p[i].append(int(np.argmax(pl[i])))
+    assert toks_c == toks_p
+    return pe
+
+
+@pytest.mark.parametrize("kv_cache", [None, "int8"])
+def test_paged_pool_property_vs_contiguous_oracle(kv_cache):
+    """Random join/leave/grow/fork sequences over the paged pool are
+    bit-identical to the contiguous-cache oracle — greedy tokens AND raw
+    logits — f32 and int8 KV, with a fully-shared-then-forked prefix
+    (every 'admit 0' after the first maps prompt 0's registered pages
+    and forks its partial page on first write)."""
+    net = _lm()
+    r = np.random.default_rng(4)
+    op_seq = [("admit", 0), ("step",), ("admit", 0), ("step",), ("step",)]
+    for _ in range(14):
+        roll = r.random()
+        if roll < 0.3:
+            op_seq.append(("admit", int(r.integers(0, 3))))
+        elif roll < 0.45:
+            op_seq.append(("leave", int(r.integers(0, 3))))
+        else:
+            op_seq.append(("step",))
+    pe = _drive_paged_vs_contiguous(net, op_seq, kv_cache=kv_cache,
+                                    max_cache=32)
+    st = pe.pool.stats()
+    # the fully-shared-then-forked prefix actually happened
+    assert st["prefix_hits"] >= 1
+    assert st["forks"] >= 1
+    assert int(tel.registry.get(
+        "serving.page_pool.forks").total()) >= st["forks"]
+
+
+# ---------------------------------------------------------------------------
+# allocator: eviction under pressure, exhaustion, fault site
+# ---------------------------------------------------------------------------
+
+def test_pool_eviction_under_pressure():
+    """A full free list evicts prefix-registry entries LRU-first (the
+    degradation path — counted); only live-pinned pages raise
+    PoolExhausted."""
+    pool = PagedKVPool(5, 8, engine_id="evict-test")
+    a = pool.alloc(2)
+    pool.register_prefix("p0", a, 10, np.zeros(4))
+    pool.release(a)               # now only the registry pins them
+    b = pool.alloc(2)             # the other two pages
+    assert pool.pages_free() == 0
+    got = pool.alloc(2)           # pressure: evicts the registered prefix
+    assert sorted(got) == sorted(a)
+    assert pool.stats()["evictions"] == 1
+    assert pool.lookup_prefix("p0") is None   # gone (counted as a miss)
+    with pytest.raises(PoolExhausted):
+        pool.alloc(1)             # everything pinned by live refs
+    pool.release(b)
+    assert pool.pages_free() == 2
+
+
+def test_page_pool_fault_site():
+    """The serving.page_pool fault site makes allocation failure
+    deterministic: admission fails the request (counted), the batcher
+    recovers for subsequent traffic."""
+    net = _lm()
+    faults.reset()
+    cb = ContinuousBatcher(net, slots=2, max_cache_len=16, min_cache_len=16,
+                           max_new_tokens=3, paged=True, page_size=8)
+    try:
+        faults.inject("serving.page_pool", error="crash", times=1)
+        h = cb.submit(tokens=[1, 2], max_new_tokens=3)
+        with pytest.raises(faults.InjectedCrash):
+            h.result(timeout=120)
+        assert faults.counters()["serving.page_pool"]["fired"] == 1
+        faults.reset()
+        res = cb.submit(tokens=[1, 2], max_new_tokens=3).result(timeout=120)
+        assert len(res["tokens"]) == 3
+        assert cb.stats()["failures"] >= 1
+        # the failed admission leaked nothing: one live stream's pages
+        # at most were in use, and they were reclaimed on finish
+        assert cb.stats()["page_pool"]["pages_in_use"] <= 1
+    finally:
+        faults.reset()
+        cb.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# batcher: prefix sharing, COW, zero post-warmup compiles
+# ---------------------------------------------------------------------------
+
+def test_batcher_prefix_sharing_and_cow():
+    """An identical prompt is prefilled once and mapped into later
+    streams; a shared (partial) page forks only on first write; output
+    stays bit-equal to the contiguous batcher."""
+    net = _lm()
+    toks = list(RNG.integers(0, V, 5))
+    cb0 = ContinuousBatcher(net, slots=2, max_cache_len=32,
+                            min_cache_len=32, max_new_tokens=5)
+    ref = cb0.submit(tokens=toks, max_new_tokens=5).result(
+        timeout=120)["tokens"]
+    cb0.shutdown()
+    cb = ContinuousBatcher(net, slots=2, max_cache_len=32, min_cache_len=32,
+                           max_new_tokens=5, paged=True, page_size=8)
+    prefills0 = cb.engine._h_prefill.values_list()
+    a = cb.submit(tokens=toks, max_new_tokens=5).result(
+        timeout=120)["tokens"]
+    n_prefills = len(cb.engine._h_prefill.values_list())
+    b = cb.submit(tokens=toks, max_new_tokens=5).result(
+        timeout=120)["tokens"]
+    assert a == ref and b == ref
+    st = cb.stats()["page_pool"]
+    assert st["prefix_hits"] == 1 and st["prefix_misses"] == 1
+    # the hit stream skipped prefill entirely (prefilled once, fleet-wide)
+    assert len(cb.engine._h_prefill.values_list()) == n_prefills
+    assert len(prefills0) < n_prefills
+    # 5 tokens from plen 5 write positions 5..9: the shared partial page
+    # (tokens 0..7) forks once per stream, page 2 is allocated fresh
+    assert st["forks"] >= 2
+    # both streams done: only the registered prefix pages stay resident
+    assert st["pages_in_use"] == 1
+    assert st["prefix_entries"] == 1
+    cb.shutdown()
+
+
+def test_paged_zero_postwarmup_compiles():
+    """Steady state: ragged prompts, join/leave churn, growth across a
+    page-table bucket, prefix hits and COW forks — zero compile events
+    after warmup (grow() is a host page-table append)."""
+    net = _lm()
+    cb = ContinuousBatcher(net, slots=2, max_cache_len=32, min_cache_len=8,
+                           max_new_tokens=6, paged=True, page_size=8)
+    warm = cb.engine.compiles
+    ev0 = int(tel.registry.get("compile.events").total())
+    hs = [cb.submit(tokens=list(RNG.integers(0, V, 2 + (i % 3))),
+                    max_new_tokens=4 + (i % 3)) for i in range(5)]
+    hs.append(cb.submit(tokens=[3, 1, 2], max_new_tokens=6))  # crosses 8
+    for h in hs:
+        assert len(h.result(timeout=120)["tokens"]) >= 4
+    assert cb.engine.compiles == warm
+    assert int(tel.registry.get("compile.events").total()) == ev0
+    cb.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# speculative decoding
+# ---------------------------------------------------------------------------
+
+def test_speculative_equals_greedy_perfect_and_garbage_draft():
+    """Draft/verify emits the target's exact greedy stream regardless of
+    draft quality: a perfect draft (the target itself) accepts ~all and
+    amortizes verify steps; a garbage draft accepts ~none but stays
+    CORRECT (the first mismatch emits the target's own argmax)."""
+    net = _lm()
+    toks = list(RNG.integers(0, V, 4))
+    cb0 = ContinuousBatcher(net, slots=2, max_cache_len=32,
+                            min_cache_len=32, max_new_tokens=6)
+    ref = cb0.submit(tokens=toks, max_new_tokens=6).result(
+        timeout=120)["tokens"]
+    cb0.shutdown()
+
+    cb1 = ContinuousBatcher(net, slots=2, max_cache_len=32, min_cache_len=32,
+                            max_new_tokens=6, paged=True, page_size=8,
+                            draft_model=net, speculate_k=3)
+    warm = cb1.engine.compiles
+    ev0 = int(tel.registry.get("compile.events").total())
+    got = cb1.submit(tokens=toks, max_new_tokens=6).result(
+        timeout=120)["tokens"]
+    assert got == ref
+    sp = cb1.stats()["speculative"]
+    assert sp["k"] == 3 and sp["proposed"] > 0
+    assert sp["accept_rate"] == 1.0      # the draft IS the target
+    # one verify step advances up to k tokens: 6 tokens in ~2 windows
+    assert sp["proposed"] <= 9
+    assert cb1.engine.compiles == warm   # zero post-warmup compiles
+    assert int(tel.registry.get("compile.events").total()) == ev0
+    assert cb1.engine._h_decode.values_list()
+    assert tel.registry.get(
+        "serving.speculative.accept_rate").values_list(pi=cb1._id)
+    cb1.shutdown()
+
+    draft = _lm(seed=99)
+    cb2 = ContinuousBatcher(net, slots=2, max_cache_len=32, min_cache_len=32,
+                            max_new_tokens=6, paged=True, page_size=8,
+                            draft_model=draft, speculate_k=3)
+    got2 = cb2.submit(tokens=toks, max_new_tokens=6).result(
+        timeout=120)["tokens"]
+    assert got2 == ref
+    assert cb2.stats()["speculative"]["accept_rate"] < 1.0
+    cb2.shutdown()
+
+
+def test_speculative_verify_takes_fused_path(force_mode):
+    """Under force mode the verify executable traces through the fused
+    Tq=k kernel — the decision counter proves the speculative path is
+    not silently on the reference route."""
+    net = _lm()
+    cb = ContinuousBatcher(net, slots=2, max_cache_len=16, min_cache_len=16,
+                           max_new_tokens=4, paged=True, page_size=8,
+                           draft_model=net, speculate_k=3)
+    try:
+        assert fa.counters()["decode_multiquery"] >= 1, fa.counters()
+        assert fa.counters()["decode_multiquery_fallback"] == 0
+        res = cb.submit(tokens=[1, 2], max_new_tokens=4).result(timeout=240)
+        assert len(res["tokens"]) == 4
+    finally:
+        cb.shutdown()
+
+
+def test_speculative_config_validation():
+    net = _lm()
+    with pytest.raises(ValueError, match="paged"):
+        ContinuousBatcher(net, slots=1, max_new_tokens=2, draft_model=net,
+                          warmup=False)
+    with pytest.raises(ValueError, match="sample_fn"):
+        ContinuousBatcher(net, slots=1, max_new_tokens=2, paged=True,
+                          draft_model=net, warmup=False,
+                          sample_fn=lambda lg: 0)
+    with pytest.raises(ValueError, match="speculate_k"):
+        ContinuousBatcher(net, slots=1, max_new_tokens=2, paged=True,
+                          draft_model=net, speculate_k=1, warmup=False)
+
+
+def test_explicit_engine_cache_len_mismatch_rejected():
+    """An explicitly built paged engine caps the page table; a batcher
+    admission bound wider than the engine's would overflow map_pages and
+    leak pages — the config is rejected loudly (review finding)."""
+    net = _lm()
+    eng = PagedGenerativeEngine(net, slots=1, pages=4, page_size=8,
+                                max_cache_len=16)
+    with pytest.raises(ValueError, match="max_cache_len"):
+        ContinuousBatcher(net, max_cache_len=64, engine=eng, warmup=False)
+    cb = ContinuousBatcher(net, max_cache_len=16, min_cache_len=16,
+                           max_new_tokens=2, engine=eng, warmup=False)
+    cb.shutdown()
+
+
+def test_learned_attention_refuses_multiquery_verify():
+    lyr = LearnedSelfAttentionLayer(n_out=8, n_heads=2, n_queries=2)
+    params, state, _ = lyr.initialize(jax.random.PRNGKey(0), (8, V),
+                                      jnp.float32)
+    spec = lyr.decode_cache_spec(params, 2, 16, jnp.float32)
+    cache = jax.tree.map(lambda a: jnp.zeros(a.shape, a.dtype), spec)
+    with pytest.raises(ValueError, match="multi-token"):
+        lyr.decode_step(params, jnp.zeros((2, 3, V)), state, cache=cache,
+                        lengths=jnp.asarray([1, 1]))
+
+
+# ---------------------------------------------------------------------------
+# observability + SameDiff paged rewrite
+# ---------------------------------------------------------------------------
+
+def test_stats_endpoint_and_listener_expose_paged_fields():
+    """GET /stats carries the generator's page-pool occupancy / prefix
+    hits / accept-rate; ServingStatsListener snapshots the same dict
+    (ISSUE 12 satellite)."""
+    from deeplearning4j_tpu.ui.stats import ServingStatsListener
+    net = _lm()
+    srv = JsonModelServer(net, generate=dict(
+        slots=2, max_cache_len=16, min_cache_len=16, max_new_tokens=3,
+        paged=True, page_size=8, draft_model=net, speculate_k=2))
+    port = srv.start()
+    try:
+        body = json.dumps({"tokens": [1, 2], "max_new_tokens": 3}).encode()
+        r = urllib.request.urlopen(urllib.request.Request(
+            f"http://127.0.0.1:{port}/generate", data=body), timeout=120)
+        assert len(json.loads(r.read())["tokens"]) == 3
+        st = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/stats", timeout=60).read())
+        gen = st["generator"]
+        assert gen["page_pool"]["pages_total"] > 0
+        assert "pages_free" in gen["page_pool"]
+        assert gen["page_pool"]["prefix_misses"] >= 1
+        assert gen["speculative"]["accept_rate"] is not None
+        assert gen["engine"]["paged"]["page_size"] == 8
+        # per-engine registry labels (anti-blending): the pool gauges
+        # carry this engine's id
+        eid = srv.generator.engine._id
+        assert int(tel.registry.get("serving.page_pool.pages_total")
+                   .value(engine=eid)) > 0
+        rec = ServingStatsListener(srv.generator).report()
+        assert rec["page_pool"]["pages_total"] > 0
+        assert rec["speculative"]["proposed"] > 0
+    finally:
+        srv.stop()
+
+
+def test_samediff_paged_rewrite_parity(rng):
+    """rewrite_for_decode(paged=True) swaps fused sites for
+    attention.paged_sdpa; the paged replay == the cached replay
+    bit-for-bit (same values through the page-table gather)."""
+    from deeplearning4j_tpu.autodiff import SameDiff, fuse_attention
+    from deeplearning4j_tpu.autodiff.decode import (PAGE_TABLE,
+                                                    rewrite_for_decode)
+
+    NEG = np.float32(np.finfo(np.float32).min)
+    d = 8
+
+    def mk(weights):
+        sd = SameDiff()
+        x = sd.placeholder("x")
+        mask = sd.placeholder("mask")
+        wq, wk, wv, wo = (sd.var(nm, weights[nm])
+                          for nm in ("Wq", "Wk", "Wv", "Wo"))
+        q = sd.call("linalg.mmul", x, wq, name="q")
+        k = sd.call("linalg.mmul", x, wk, name="k")
+        v = sd.call("linalg.mmul", x, wv, name="v")
+        dk = sd.constant("dk", np.float32(np.sqrt(d)))
+        scores = sd.call("linalg.mmul", q, k, name="scores",
+                         attrs={"transpose_b": True})
+        scaled = sd.call("math.div", scores, dk, name="scaled")
+        masked = sd.call("math.add", scaled, mask, name="masked")
+        probs = sd.call("act.softmax", masked, name="probs")
+        ctx = sd.call("linalg.mmul", probs, v, name="ctx")
+        sd.call("linalg.mmul", ctx, wo, name="out")
+        return sd
+
+    weights = {n: rng.normal(size=(d, d)).astype(np.float32) * 0.3
+               for n in ("Wq", "Wk", "Wv", "Wo")}
+    B, H, Tp, C, P = 2, 2, 4, 16, 8
+    sd1 = mk(weights)
+    fuse_attention(sd1)
+    dgc = rewrite_for_decode(sd1, output="out")
+    sd2 = mk(weights)
+    fuse_attention(sd2)
+    dgp = rewrite_for_decode(sd2, output="out", paged=True, page_size=P)
+    assert dgp.paged and dgp.site_names() == ["ctx"]
+    ops.mark_fwd_tested("attention.paged_sdpa")
+
+    plens = np.array([3, 4])
+    xp = rng.normal(size=(B, H, Tp, d)).astype(np.float32) * 0.5
+    kb = np.where(np.arange(Tp)[None, None, None, :] <
+                  plens[:, None, None, None], 0.0, NEG).astype(np.float32)
+    y1, c1 = dgc.prefill({"x": xp, "mask": kb}, plens, C)
+    y2, c2 = dgp.prefill({"x": xp, "mask": kb}, plens, C)
+    np.testing.assert_array_equal(np.asarray(y1), np.asarray(y2))
+    assert c2[PAGE_TABLE].shape == (B, C // P)
+    lengths = plens.copy()
+    for _ in range(3):
+        x_t = rng.normal(size=(B, H, 1, d)).astype(np.float32) * 0.5
+        m1 = np.zeros((B, 1, 1, 1), np.float32)
+        o1, c1 = dgc.decode_step({"x": x_t, "mask": m1}, c1, lengths)
+        o2, c2 = dgp.decode_step({"x": x_t, "mask": m1}, c2, lengths)
+        lengths = lengths + 1
+        np.testing.assert_array_equal(np.asarray(o1), np.asarray(o2))
+    # the overflow guard knows the paged geometry
+    with pytest.raises(ValueError, match="cache full"):
+        dgp.decode_step({"x": xp[:, :, :1],
+                         "mask": np.zeros((B, 1, 1, 1), np.float32)},
+                        c2, np.array([C, C]))

@@ -29,7 +29,8 @@ GRAD_FLOOR = 0.95
 # fast numpy oracles in test_ops_math.py).
 _MARKING_FILES = {"test_conv3d_capsules.py", "test_flash_attention.py",
                   "test_m17_breadth.py", "test_ops.py", "test_ops_math.py",
-                  "test_ops_grad_r5.py", "test_quantized_serving.py"}
+                  "test_ops_grad_r5.py", "test_quantized_serving.py",
+                  "test_paged_kv.py"}
 
 
 def test_workspace_policy_coverage_floor(request):
@@ -62,9 +63,11 @@ def test_fault_site_coverage_floor(request):
     # telemetry floor's `needed` pattern): resilience fires the train/
     # checkpoint/data/one-shot-serving sites, generative decode fires
     # serving.decode, quantized serving fires serving.quantize, the pod
-    # suite fires parallel.host_loss (ISSUE 10)
+    # suite fires parallel.host_loss (ISSUE 10), the paged-KV suite
+    # fires serving.page_pool (ISSUE 12)
     needed = {"test_resilience.py", "test_generative_decode.py",
-              "test_quantized_serving.py", "test_multihost_pod.py"}
+              "test_quantized_serving.py", "test_multihost_pod.py",
+              "test_paged_kv.py"}
     missing = needed - collected
     if missing:
         pytest.skip(f"chunked run (fault-firing files not collected: "
@@ -103,7 +106,11 @@ def test_telemetry_metric_floor(request):
               "test_quantized_serving.py",
               # pod-scale multi-host (ISSUE 10): the only writer of
               # resilience.host_loss_recoveries
-              "test_multihost_pod.py"}
+              "test_multihost_pod.py",
+              # paged KV + speculative decoding (ISSUE 12): the
+              # serving.page_pool.* gauges/counters and the
+              # serving.speculative.* accept-rate family
+              "test_paged_kv.py"}
     missing = needed - collected
     if missing:
         pytest.skip(f"chunked run (telemetry-ledger-marking files not "
