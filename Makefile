@@ -3,7 +3,8 @@
 
 PY ?= python
 
-.PHONY: test test-fast multihost-sim multihost-smoke bench bench-generative
+.PHONY: test test-fast multihost-sim multihost-smoke bench bench-generative \
+	trace-demo
 
 # fast (tier-1) suite — what CI gates on
 test-fast:
@@ -39,3 +40,10 @@ bench:
 bench-generative:
 	env JAX_PLATFORMS=cpu $(PY) -c "import json, bench; \
 print(json.dumps(bench.bench_generative_serving(), indent=1))"
+
+# ISSUE 13: tiny serve-and-trace loop — boots a JsonModelServer, POSTs a
+# few /predict requests with the JSONL event log on, resolves one
+# request at GET /trace/<id>, validates the JSONL schema, and
+# pretty-prints the stitched timeline. Doubles as a schema smoke test.
+trace-demo:
+	env JAX_PLATFORMS=cpu $(PY) -m deeplearning4j_tpu.runtime.trace_demo

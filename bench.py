@@ -196,6 +196,18 @@ def bench_resnet():
     mfu_med = (3 * fwd_flops * (batch / step_time_median) / peak) \
         if peak else None
 
+    # ISSUE 13: MFU attribution of the SAME measured step — cost_analysis
+    # flops/bytes vs the min-chain step time, decomposed into compute/
+    # memory/host/other fractions (sums to 1.0; "other" is the
+    # contention+inefficiency residue the schedule tuner hunts). Keyed in
+    # the process-wide report cache; embedded here so the artifact
+    # carries the decomposition next to the headline number.
+    try:
+        attribution = net.attribution_report(batch,
+                                             measured_s=step_time)
+    except Exception as e:  # never take the headline down
+        attribution = {"error": f"{type(e).__name__}: {e}"[:300]}
+
     return {
         "metric": "resnet50_train_mfu_pct",
         "value": round(mfu * 100, 2) if mfu is not None else None,
@@ -215,6 +227,7 @@ def bench_resnet():
         "mfu_median_pct": round(mfu_med * 100, 2) if mfu_med else None,
         "chains": chains,
         "final_loss": round(final_loss, 3),
+        "attribution": attribution,
         "fwd_gflops_per_example": round(fwd_flops / 1e9, 2),
         "peak_tflops_bf16": round(peak / 1e12, 1) if peak else None,
         "params": net.num_params(),
@@ -536,11 +549,24 @@ def bench_bert():
             return time.perf_counter() - t0, fl
 
         chain(1)  # settle
-        return chain, state
+        # avals of the fit-step call, captured NOW (the chains donate and
+        # delete the live arrays): the ISSUE 13 attribution lowers the
+        # same jitted step on these for cost_analysis — nothing executes
+        try:
+            step_avals = jax.tree.map(
+                lambda a: jax.ShapeDtypeStruct(
+                    np.shape(a), getattr(a, "dtype",
+                                         np.asarray(a).dtype)),
+                (train_vals, opt_state, other_vals,
+                 jnp.asarray(0, jnp.int32), feeds[0]))
+            step_info = (step, step_avals)
+        except Exception:
+            step_info = None
+        return chain, state, step_info
 
-    chain_f32, _ = make_runner("FLOAT")
-    chain_f32h, _ = make_runner("FLOAT", f32_precision="highest")
-    chain_b16, st16 = make_runner("BFLOAT16")
+    chain_f32, _, _ = make_runner("FLOAT")
+    chain_f32h, _, _ = make_runner("FLOAT", f32_precision="highest")
+    chain_b16, st16, step16 = make_runner("BFLOAT16")
 
     runs32, runs32h, runs16 = [], [], []
     for _ in range(6):  # interleaved: contention hits all configs alike
@@ -622,6 +648,20 @@ def bench_bert():
     mfu16 = 3 * fwd_flops * (batch / dt) / peak if peak else None
     mfu32 = 3 * fwd_flops * (batch / dt32) / peak if peak else None
 
+    # ISSUE 13: cost-analysis attribution of the bf16 fit step against
+    # the measured min-chain step time (fractions sum to 1.0; the
+    # compute fraction is XLA-counted MFU vs the analytic mfu_pct above)
+    try:
+        from deeplearning4j_tpu.runtime import attribution as _attr
+        if step16 is None:
+            raise ValueError("fit-step avals were not capturable")
+        step_fn, step_avals = step16
+        attribution = _attr.attribute_jitted(
+            step_fn, step_avals, measured_s=dt,
+            key=f"samediff.fit_step:bert-base:b{batch}xT{seqlen}:bf16")
+    except Exception as e:  # never take the metric down
+        attribution = {"error": f"{type(e).__name__}: {e}"[:300]}
+
     return {
         "metric": "bert_base_finetune_examples_per_sec",
         "value": round(batch / dt, 1),
@@ -664,6 +704,7 @@ def bench_bert():
                               "HIGHEST (genuine f32 accumulation passes)",
         "bf16_speedup_vs_true_f32": round(dt32h / dt, 3),
         "memory": memory,
+        "attribution": attribution,
         "autotuned_batch": autotuned_batch,
         "autotuned_examples_per_sec": autotuned_eps,
         "fwd_gflops_per_example": round(fwd_flops / 1e9, 2),

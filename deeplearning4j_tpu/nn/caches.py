@@ -186,6 +186,22 @@ class CompiledCacheMixin(SentinelCounterMixin):
         from . import memory as _memory
         return _memory.max_batch(self, bytes_limit, **kwargs)
 
+    def attribution_report(self, batch_size: int, steps: int = 3,
+                           accum_steps: int = 1, seq_len=None,
+                           peaks=None, measured_s=None) -> dict:
+        """``memory_report``'s roofline sibling (ISSUE 13): decompose
+        this model's train-step time at ``batch_size`` into compute-
+        bound / memory-bound / host-bound / unattributed seconds with an
+        ``mfu_gap`` breakdown, from the AOT executable's
+        ``cost_analysis()`` + a synced measurement (or a caller-supplied
+        ``measured_s``). Reports are keyed and cached process-wide so a
+        schedule tuner can rank remat/overlap/batch configs without
+        re-measuring. See ``runtime.attribution.attribution_report``."""
+        from ..runtime import attribution as _attr
+        return _attr.attribution_report(
+            self, batch_size, steps=steps, accum_steps=accum_steps,
+            seq_len=seq_len, peaks=peaks, measured_s=measured_s)
+
     def inference_engine(self, **kwargs):
         """The model's serving engine (``serving.engine.InferenceEngine``),
         created lazily; ``output()`` routes through it. Pass kwargs (e.g.

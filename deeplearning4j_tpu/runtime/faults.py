@@ -234,6 +234,12 @@ def trip(site: str) -> Optional[Injection]:
         return None
     log.warning("fault injection fired at %r (%d/%s)", site, inj.fired,
                 inj.times)
+    # black box (ISSUE 13): every fired trip lands in the flight-recorder
+    # ring AND triggers a dump — the spans/compiles/traces leading up to
+    # the fault are on disk before any recovery path runs
+    _tel.flight.record({"type": "fault", "site": site,
+                        "error": inj.error, "fired": inj.fired})
+    _tel.flight.auto_dump(f"fault:{site}")
     if inj.delay:
         time.sleep(inj.delay)
     if inj.error is not None:

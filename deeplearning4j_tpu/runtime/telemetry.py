@@ -63,7 +63,7 @@ import os
 import re
 import threading
 import time
-from collections import deque
+from collections import OrderedDict, deque
 from typing import Dict, Iterator, List, Optional, Tuple
 
 __all__ = [
@@ -72,6 +72,11 @@ __all__ = [
     "emit_event", "record_compile", "compile_events",
     "reset_compile_events", "step_annotation", "prometheus_text",
     "snapshot", "coverage_report",
+    # per-request distributed tracing (ISSUE 13)
+    "RequestTrace", "start_request_trace", "get_trace", "recent_traces",
+    "phase_sink", "sink_phases", "stitch_event_logs", "format_timeline",
+    # SLO + flight recorder (ISSUE 13)
+    "SLO", "FlightRecorder", "flight",
 ]
 
 COUNTER = "counter"
@@ -651,10 +656,15 @@ class _SpanCtx:
         if registry._enabled:
             registry.histogram(sp.name).observe(sp.duration_s,
                                                 **(sp.labels or {}))
-            emit_event({"type": "span", "name": sp.name,
-                        "trace": sp.trace_id, "span": sp.span_id,
-                        "parent": sp.parent_id, "duration_s": sp.duration_s,
-                        **(sp.labels or {}), **sp.attrs})
+            ev = {"type": "span", "name": sp.name,
+                  "trace": sp.trace_id, "span": sp.span_id,
+                  "parent": sp.parent_id, "duration_s": sp.duration_s,
+                  **(sp.labels or {}), **sp.attrs}
+            if exc and exc[0] is not None:
+                ev["status"] = "error"
+                ev["error"] = getattr(exc[0], "__name__", str(exc[0]))
+            emit_event(ev)
+            flight.record(ev)
         return False
 
 
@@ -769,11 +779,16 @@ def close_event_log():
 
 def emit_event(event: dict) -> None:
     """Append one event to the JSONL sink (no-op without a sink). Adds a
-    wall-clock ``t`` so offline consumers can align multiple processes."""
+    wall-clock ``t`` so offline consumers can align multiple processes,
+    and — on a multi-host run — the pod ``host`` coordinate, so
+    :func:`stitch_event_logs` can merge per-host files without blending
+    who emitted what (ISSUE 13 cross-host stitching)."""
     sink = _event_sink
     if sink is None:
         return
     rec = {"t": time.time(), **event}
+    if _host["count"] > 1 and "host" not in rec:
+        rec["host"] = _host["index"]
     line = json.dumps(rec, default=str)
     with _event_lock:
         if _event_sink is not sink:  # closed/re-pointed while we serialized
@@ -797,9 +812,22 @@ _host = {"index": 0, "count": 1}
 
 def set_host(index: int, count: int) -> None:
     """Declare this process's pod coordinates (process_index, process
-    count). ``count <= 1`` returns labeling to the single-process mode."""
+    count). ``count <= 1`` returns labeling to the single-process mode.
+
+    Pod tracing hook (ISSUE 13): with ``DL4J_TPU_EVENT_LOG=<base>`` set,
+    a multi-host process re-points its JSONL event sink to
+    ``<base>.host<index>.jsonl`` the moment its pod coordinates are known
+    (the launcher calls this right after ``jax.distributed`` comes up) —
+    each host writes its own file, and :func:`stitch_event_logs` merges
+    them into one pod-level trace."""
     _host["index"] = int(index)
     _host["count"] = int(count)
+    base = os.environ.get("DL4J_TPU_EVENT_LOG")
+    if base and int(count) > 1:
+        try:
+            event_log(f"{base}.host{int(index)}.jsonl")
+        except OSError:
+            pass  # an unwritable trace dir must not take the pod down
 
 
 def host_labels() -> dict:
@@ -842,6 +870,7 @@ def record_compile(site: str, cause: str, **detail) -> None:
     with _compiles_lock:
         _compile_log.append(ev)
     emit_event(ev)
+    flight.record(ev)
 
 
 def compile_events(site: Optional[str] = None) -> List[dict]:
@@ -858,3 +887,510 @@ def compile_events(site: Optional[str] = None) -> List[dict]:
 def reset_compile_events() -> None:
     with _compiles_lock:
         _compile_log.clear()
+
+
+# ---------------------------------------------------- per-request tracing
+#: Contextvars die at the dispatcher's queue boundary (the submit thread's
+#: context never reaches the dispatcher/decode worker), so request tracing
+#: is EXPLICIT (ISSUE 13): ``start_request_trace`` returns a
+#: :class:`RequestTrace` the serving fronts thread through their queues on
+#: the request object itself. Each trace accumulates a stitched timeline —
+#: one-shot: queue→coalesce→pad→execute→unpad→resolve; generative:
+#: queue→prefill→per-decode-iteration — whose phase durations sum to the
+#: request's measured latency (tier-1-asserted to within 10%). Finished
+#: traces land in a bounded in-memory store (``GET /trace/<id>``), in the
+#: JSONL event log (one ``type="trace"`` line per request), and in the
+#: flight recorder.
+
+TRACE_STORE_LIMIT = 256    #: finished+live traces kept for GET /trace/<id>
+TRACE_EVENT_LIMIT = 512    #: timeline events per trace (then counted, dropped)
+
+_trace_lock = threading.Lock()
+_trace_seq = itertools.count(1)
+_trace_store: "OrderedDict[str, RequestTrace]" = OrderedDict()
+
+
+class _NullTrace:
+    """No-op trace handed out when telemetry is disabled — the serving
+    hot paths call ``.phase()``/``.finish()`` unconditionally."""
+
+    __slots__ = ()
+    trace_id = None
+
+    def phase(self, *a, **k):
+        return None
+
+    def finish(self, *a, **k):
+        return None
+
+
+NULL_TRACE = _NullTrace()
+
+
+class RequestTrace:
+    """One request's stitched timeline. Append-only: the submitting thread
+    writes the enqueue mark, the dispatcher/decode worker appends phases,
+    and exactly one ``finish()`` stamps status + total duration (list
+    append is GIL-atomic; phases are single-writer per lifecycle stage by
+    construction). Phase durations are SECONDS; ``shared=True`` marks a
+    phase whose wall time was shared with the other members of a
+    coalesced batch (pad/execute/unpad)."""
+
+    __slots__ = ("trace_id", "kind", "attrs", "t_start", "t_wall",
+                 "events", "status", "error", "duration_s", "dropped",
+                 "_done")
+
+    def __init__(self, kind: str, attrs: dict):
+        # host- and process-qualified so pod-merged logs can never
+        # collide two hosts' traces (the span-int ids need host
+        # qualification at stitch time; these are born unique)
+        self.trace_id = f"{_host['index']}-{os.getpid():x}-" \
+                        f"{next(_trace_seq):x}"
+        self.kind = kind
+        self.attrs = dict(attrs)
+        self.t_start = time.perf_counter()
+        self.t_wall = time.time()
+        self.events: List[dict] = []
+        self.status: Optional[str] = None
+        self.error: Optional[str] = None
+        self.duration_s: Optional[float] = None
+        self.dropped = 0
+        self._done = False
+
+    def phase(self, name: str, duration_s: float, **attrs) -> None:
+        """Append one timeline phase (bounded: past TRACE_EVENT_LIMIT the
+        event is counted into ``dropped_events`` instead — a 10k-token
+        generation must not grow its trace without bound)."""
+        if len(self.events) >= TRACE_EVENT_LIMIT:
+            self.dropped += 1
+            return
+        ev = {"phase": name, "duration_s": float(duration_s)}
+        if attrs:
+            ev.update(attrs)
+        self.events.append(ev)
+
+    def finish(self, status: str = "ok", error: Optional[str] = None,
+               **attrs) -> None:
+        """Stamp the terminal status exactly once (shed / deadline /
+        shutdown / failure paths all resolve their span — satellite
+        requirement: no request ends without a terminal trace record).
+        The once-only guard is locked: a shutdown() racing a resolving
+        dispatch calls finish from two threads, and emitting both an
+        "ok" and an "error" record for one trace would double-count in
+        every consumer."""
+        with _trace_lock:
+            if self._done:
+                return
+            self._done = True
+        self.status = status
+        self.error = error
+        self.duration_s = time.perf_counter() - self.t_start
+        if attrs:
+            self.attrs.update(attrs)
+        rec = self.timeline()
+        emit_event({"type": "trace", **rec})
+        flight.record({"type": "trace", **rec})
+
+    def timeline(self) -> dict:
+        """JSON-safe stitched timeline (the ``GET /trace/<id>`` body and
+        the JSONL ``type="trace"`` record)."""
+        rec = {"trace": self.trace_id, "kind": self.kind,
+               "t": self.t_wall, "status": self.status,
+               "duration_s": self.duration_s,
+               "phases": list(self.events),
+               "dropped_events": self.dropped}
+        if self.error is not None:
+            rec["error"] = self.error
+        if _host["count"] > 1:
+            rec["host"] = _host["index"]
+        rec.update(self.attrs)
+        return rec
+
+
+def start_request_trace(kind: str, **attrs):
+    """New :class:`RequestTrace` registered in the bounded store (oldest
+    evicted). Returns :data:`NULL_TRACE` when telemetry is disabled — the
+    fenced ``telemetry_overhead`` contract covers tracing too."""
+    if not registry._enabled:
+        return NULL_TRACE
+    tr = RequestTrace(kind, attrs)
+    with _trace_lock:
+        _trace_store[tr.trace_id] = tr
+        while len(_trace_store) > TRACE_STORE_LIMIT:
+            _trace_store.popitem(last=False)
+    return tr
+
+
+def get_trace(trace_id: str) -> Optional[dict]:
+    """Stitched timeline of one (possibly still-running) request, or None
+    when unknown/evicted."""
+    with _trace_lock:
+        tr = _trace_store.get(trace_id)
+    return tr.timeline() if tr is not None else None
+
+
+def recent_traces(n: int = 32) -> List[dict]:
+    """Newest-first ``{trace, kind, status, duration_s}`` summaries of the
+    trace store (the ``GET /traces`` listing)."""
+    with _trace_lock:
+        trs = list(_trace_store.values())[-int(n):]
+    return [{"trace": t.trace_id, "kind": t.kind, "status": t.status,
+             "duration_s": t.duration_s} for t in reversed(trs)]
+
+
+# the dispatcher thread installs a collector around the engine call so the
+# engine's internal pad/execute/unpad clocks reach every member request's
+# trace without the engine knowing about batching (contextvar: the engine
+# call runs IN the dispatcher thread, so the context flows)
+_phase_sink: contextvars.ContextVar = \
+    contextvars.ContextVar("dl4j_tpu_phase_sink", default=None)
+
+
+def phase_sink():
+    """The active per-call phase collector (``callable(name, seconds)``),
+    or None. Engines report their request-lifecycle phase durations here
+    IN ADDITION to the phase histograms."""
+    return _phase_sink.get()
+
+
+class _PhaseSinkCtx:
+    __slots__ = ("_collector", "_token")
+
+    def __init__(self, collector):
+        self._collector = collector
+        self._token = None
+
+    def __enter__(self):
+        self._token = _phase_sink.set(self._collector)
+        return self._collector
+
+    def __exit__(self, *exc):
+        _phase_sink.reset(self._token)
+        return False
+
+
+def sink_phases(collector) -> "_PhaseSinkCtx":
+    """``with telemetry.sink_phases(lambda name, s: ...):`` — collect the
+    engine-internal phase durations of every engine call in the body."""
+    return _PhaseSinkCtx(collector)
+
+
+def stitch_event_logs(paths) -> dict:
+    """Merge JSONL event logs (one per host on a pod — see
+    :func:`set_host`) into one pod-level view: all events wall-clock
+    sorted, grouped by host-qualified trace id. Request traces are born
+    host-qualified; bare integer span trace ids get an explicit
+    ``<host>:<id>`` prefix here so two hosts' span counters can never
+    blend. Unparseable lines are skipped (a torn final line from a killed
+    host must not poison the stitch)."""
+    events: List[dict] = []
+    for p in paths:
+        try:
+            fh = open(p, "r", encoding="utf-8")
+        except OSError:
+            continue
+        with fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    ev = json.loads(line)
+                except ValueError:
+                    continue
+                if isinstance(ev, dict):
+                    events.append(ev)
+    events.sort(key=lambda e: e.get("t", 0.0))
+    traces: Dict[str, List[dict]] = {}
+    for ev in events:
+        tid = ev.get("trace")
+        if tid is None:
+            continue
+        key = tid if isinstance(tid, str) else \
+            f"{ev.get('host', 0)}:{tid}"
+        traces.setdefault(key, []).append(ev)
+    return {"events": events, "traces": traces,
+            "hosts": sorted({e.get("host", 0) for e in events})}
+
+
+def format_timeline(timeline: dict) -> str:
+    """Human-readable rendering of one stitched timeline (the
+    ``make trace-demo`` output). Consecutive same-name phases (decode
+    iterations) collapse into one ``xN`` line."""
+    if not timeline:
+        return "(no trace)"
+    hdr = (f"trace {timeline.get('trace')} kind={timeline.get('kind')} "
+           f"status={timeline.get('status')}")
+    dur = timeline.get("duration_s")
+    if dur is not None:
+        hdr += f" duration={dur * 1e3:.2f}ms"
+    if timeline.get("error"):
+        hdr += f" error={timeline['error']}"
+    lines = [hdr]
+    groups: List[List[dict]] = []
+    for ev in timeline.get("phases", ()):
+        if groups and groups[-1][0].get("phase") == ev.get("phase"):
+            groups[-1].append(ev)
+        else:
+            groups.append([ev])
+    for g in groups:
+        name = g[0].get("phase")
+        total = sum(e.get("duration_s", 0.0) for e in g)
+        line = f"  {name:<12} {total * 1e3:9.3f}ms"
+        if len(g) > 1:
+            line += f"  x{len(g)}"
+        extras = {k: v for k, v in g[0].items()
+                  if k not in ("phase", "duration_s")}
+        if extras:
+            line += "  " + " ".join(f"{k}={v}" for k, v in
+                                    sorted(extras.items()))
+        lines.append(line)
+    if timeline.get("dropped_events"):
+        lines.append(f"  (+{timeline['dropped_events']} dropped events)")
+    total = sum(e.get("duration_s", 0.0)
+                for e in timeline.get("phases", ()))
+    lines.append(f"  {'= phases':<12} {total * 1e3:9.3f}ms")
+    return "\n".join(lines)
+
+
+# ------------------------------------------------------------------- SLO
+_G_BURN = gauge(
+    "slo.burn_rate",
+    "error-budget burn rate per SLO objective and window (1.0 = burning "
+    "exactly the budget; multi-window alarms page on sustained high burn)")
+_C_SLO_ALARMS = counter(
+    "slo.alarms", "multi-window burn-rate alarm activations per SLO")
+
+
+class SLO:
+    """Windowed SLO objective over request outcomes (ISSUE 13): a target
+    p99 latency and/or error-rate budget, evaluated as **multi-window
+    burn rates** (the SRE-workbook alerting shape) over its own
+    timestamped sample reservoir.
+
+    A request is *bad* when it failed, or when ``target_p99_ms`` is set
+    and its latency exceeded the target. The budget is the allowed bad
+    fraction (``target_error_rate``, else ``error_budget``); the burn
+    rate of a window is ``bad_fraction / budget``. :meth:`alarm` returns
+
+    - ``"fast_burn"`` — both the fast and slow windows burn at
+      >= ``fast_burn`` (the page: budget exhausts in hours);
+    - ``"slow_burn"`` — the slow window burns at >= ``slow_burn`` (the
+      ticket: sustained budget bleed);
+    - ``None`` — healthy (or not enough recent samples to judge).
+
+    The serving fronts consult this inside their HEALTHY / DEGRADED /
+    SHEDDING state machine: a firing alarm reports DEGRADED even when no
+    individual request failed hard. Burn rates export through the
+    ``slo.burn_rate{slo=,window=}`` gauge on every evaluation."""
+
+    def __init__(self, name: str, target_p99_ms: Optional[float] = None,
+                 target_error_rate: Optional[float] = None,
+                 error_budget: float = 0.01,
+                 fast_window_s: float = 60.0, slow_window_s: float = 600.0,
+                 fast_burn: float = 14.4, slow_burn: float = 6.0,
+                 min_samples: int = 8, reservoir: int = 8192):
+        if target_p99_ms is None and target_error_rate is None:
+            raise ValueError("an SLO needs target_p99_ms and/or "
+                             "target_error_rate")
+        self.name = str(name)
+        self.target_p99_ms = target_p99_ms
+        self.target_error_rate = target_error_rate
+        self.budget = float(target_error_rate
+                            if target_error_rate is not None
+                            else error_budget)
+        if self.budget <= 0:
+            raise ValueError("the error budget must be positive")
+        self.fast_window_s = float(fast_window_s)
+        self.slow_window_s = float(slow_window_s)
+        self.fast_burn = float(fast_burn)
+        self.slow_burn = float(slow_burn)
+        self.min_samples = int(min_samples)
+        self._samples: deque = deque(maxlen=int(reservoir))
+        self._lock = threading.Lock()
+        self._alarmed: Optional[str] = None
+
+    def record(self, latency_s: float, ok: bool = True) -> None:
+        with self._lock:
+            self._samples.append(
+                (time.monotonic(), float(latency_s), bool(ok)))
+
+    def _window(self, window_s: float, now: float):
+        with self._lock:
+            sel = [(l, ok) for t, l, ok in self._samples
+                   if t >= now - window_s]
+        if len(sel) < self.min_samples:
+            return None, len(sel)
+        bad = sum(1 for l, ok in sel
+                  if not ok or (self.target_p99_ms is not None
+                                and l * 1e3 > self.target_p99_ms))
+        return bad / len(sel), len(sel)
+
+    def burn_rate(self, window_s: float) -> Optional[float]:
+        """``bad_fraction / budget`` over the last ``window_s`` seconds
+        (None below ``min_samples`` — a cold SLO must not flap alarms on
+        two requests)."""
+        frac, _n = self._window(window_s, time.monotonic())
+        return None if frac is None else frac / self.budget
+
+    def alarm(self) -> Optional[str]:
+        fast = self.burn_rate(self.fast_window_s)
+        slow = self.burn_rate(self.slow_window_s)
+        _G_BURN.set(fast, slo=self.name, window="fast")
+        _G_BURN.set(slow, slo=self.name, window="slow")
+        state = None
+        if fast is not None and slow is not None and \
+                fast >= self.fast_burn and slow >= self.fast_burn:
+            state = "fast_burn"
+        elif slow is not None and slow >= self.slow_burn:
+            state = "slow_burn"
+        if state is not None and state != self._alarmed:
+            _C_SLO_ALARMS.inc(slo=self.name, kind=state)
+            flight.record({"type": "slo_alarm", "slo": self.name,
+                           "kind": state, "fast_burn_rate": fast,
+                           "slow_burn_rate": slow})
+        self._alarmed = state
+        return state
+
+    def snapshot(self) -> dict:
+        fast = self.burn_rate(self.fast_window_s)
+        slow = self.burn_rate(self.slow_window_s)
+        return {"name": self.name, "target_p99_ms": self.target_p99_ms,
+                "target_error_rate": self.target_error_rate,
+                "budget": self.budget,
+                "burn_rate_fast": fast, "burn_rate_slow": slow,
+                "alarm": self._alarmed}
+
+
+# -------------------------------------------------------- flight recorder
+_C_DUMPS = counter(
+    "flight.dumps",
+    "flight-recorder JSONL dumps by trigger kind (fault trip, serving "
+    "failure, explicit)")
+
+
+class FlightRecorder:
+    """Bounded in-memory black box (ISSUE 13): the last N structured
+    events — spans, compile events, fault trips, finished request traces,
+    SLO alarms — ring-buffered as they happen, dumped to JSONL when
+    something goes wrong. Triggers: any fault-site trip that FIRES
+    (``runtime/faults.py``), an unhandled serving dispatch/decode
+    failure, or an explicit :meth:`dump`.
+
+    ``configure(dir=...)`` (or ``DL4J_TPU_FLIGHT_DIR``) points dumps at a
+    directory (``flight_<n>_<reason>.jsonl``, header line first); without
+    one, auto-dumps still capture to :attr:`last_dump` in memory. The
+    dump header snapshots the fault counters and the ``sentinel.*`` /
+    ``resilience.*`` registry cells, so the r10 resilience machinery's
+    state at failure time rides along with the event ring."""
+
+    def __init__(self, capacity: int = 2048,
+                 min_interval_s: float = 1.0):
+        self._ring: deque = deque(maxlen=int(capacity))
+        self._lock = threading.Lock()
+        self._dir = os.environ.get("DL4J_TPU_FLIGHT_DIR") or None
+        self._seq = itertools.count(1)
+        #: auto-dump rate limit, per reason: a hot path tripping the same
+        #: fault (or shedding the same way) thousands of times must not
+        #: rewrite the whole ring to a new file per event
+        self.min_interval_s = float(min_interval_s)
+        self._last_auto: Dict[str, float] = {}
+        self.last_dump: Optional[dict] = None
+
+    def configure(self, dir=_MISSING, capacity: Optional[int] = None,
+                  min_interval_s: Optional[float] = None
+                  ) -> "FlightRecorder":
+        """``dir=None`` explicitly disables file dumps; OMITTING ``dir``
+        keeps the current directory (so a capacity-only reconfigure
+        cannot silently drop the ``DL4J_TPU_FLIGHT_DIR`` target)."""
+        with self._lock:
+            if dir is not _MISSING:
+                self._dir = dir
+            if capacity is not None:
+                self._ring = deque(self._ring, maxlen=int(capacity))
+            if min_interval_s is not None:
+                self.min_interval_s = float(min_interval_s)
+        return self
+
+    def record(self, ev: dict) -> None:
+        """Ring-append one event (cheap: the deque bounds itself; hot
+        callers pass the dict they already built for the event log)."""
+        if "t" not in ev:
+            ev = {"t": time.time(), **ev}
+        self._ring.append(ev)
+
+    def events(self) -> List[dict]:
+        return list(self._ring)
+
+    def _state_header(self, reason: str, n_events: int) -> dict:
+        header = {"type": "flight_dump", "reason": reason,
+                  "t": time.time(), "events": n_events,
+                  "host": _host["index"]}
+        try:
+            from . import faults as _faults
+            header["fault_counters"] = _faults.counters()
+        except Exception:
+            pass
+        counters = {}
+        for name in registry.names():
+            if name.startswith(("sentinel.", "resilience.", "faults.")):
+                m = registry.get(name)
+                if m is not None and m.kind != HISTOGRAM:
+                    counters[name] = m.total() if m.kind == COUNTER \
+                        else {json.dumps(dict(k)): v
+                              for k, v in m.series().items()}
+        header["counters"] = counters
+        return header
+
+    def dump(self, reason: str = "explicit",
+             path: Optional[str] = None) -> dict:
+        """Write the ring as JSONL (header line first). Returns the dump
+        dict (``path`` is None when no directory/path is configured —
+        the in-memory :attr:`last_dump` still captures everything)."""
+        evs = list(self._ring)
+        header = self._state_header(reason, len(evs))
+        target = path
+        if target is None and self._dir is not None:
+            tag = re.sub(r"[^a-zA-Z0-9_.-]", "_", reason)
+            target = os.path.join(
+                self._dir, f"flight_{next(self._seq):04d}_{tag}.jsonl")
+        if target is not None:
+            os.makedirs(os.path.dirname(target) or ".", exist_ok=True)
+            with open(target, "w", encoding="utf-8") as f:
+                f.write(json.dumps(header, default=str) + "\n")
+                for ev in evs:
+                    f.write(json.dumps(ev, default=str) + "\n")
+        out = {"reason": reason, "path": target, "header": header,
+               "events": evs}
+        self.last_dump = out
+        _C_DUMPS.inc(kind=reason.split(":", 1)[0])
+        return out
+
+    def auto_dump(self, reason: str) -> Optional[dict]:
+        """Dump, rate-limited per reason (``min_interval_s``), and never
+        let recorder trouble compound the original failure (disk full
+        during an incident is exactly when this fires). Returns None
+        when suppressed by the rate limit."""
+        now = time.monotonic()
+        with self._lock:
+            last = self._last_auto.get(reason)
+            if last is not None and now - last < self.min_interval_s:
+                return None
+            self._last_auto[reason] = now
+        try:
+            return self.dump(reason)
+        except Exception as e:
+            try:
+                import logging
+                logging.getLogger("deeplearning4j_tpu").warning(
+                    "flight-recorder dump failed (%s: %s)",
+                    type(e).__name__, e)
+            except Exception:
+                pass
+            return None
+
+
+#: THE process-wide flight recorder (spans/compiles/traces record into it
+#: unconditionally-when-enabled; faults.trip() and the serving failure
+#: paths trigger auto-dumps).
+flight = FlightRecorder()

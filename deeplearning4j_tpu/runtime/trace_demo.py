@@ -1,0 +1,116 @@
+"""``make trace-demo`` (ISSUE 13 satellite): a tiny serve-and-trace loop.
+
+End to end, on CPU, in seconds: build a small MLP, front it with
+``JsonModelServer`` (batched ``ParallelInference``), point the JSONL
+event log at a temp dir, POST a few ``/predict`` requests, resolve one
+request's ``trace_id`` at ``GET /trace/<id>``, validate the JSONL event
+schema, and pretty-print the stitched timeline. Doubles as a smoke test
+of the trace JSONL schema — :func:`main` raises on any violation and is
+called by the tier-1 suite.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+import urllib.request
+
+import numpy as np
+
+from . import telemetry
+
+#: minimum keys per JSONL event type — the schema the stitcher and any
+#: offline consumer rely on (validated on every demo run)
+_SCHEMA = {
+    "trace": {"trace", "kind", "status", "duration_s", "phases"},
+    "span": {"name", "trace", "span", "duration_s"},
+    "compile": {"site", "cause"},
+}
+
+
+def _build_server():
+    from ..nn.config import InputType, NeuralNetConfiguration
+    from ..nn.layers.core import DenseLayer, OutputLayer
+    from ..nn.model import MultiLayerNetwork
+    from ..nn.updaters import Sgd
+    from ..serving.server import JsonModelServer
+
+    conf = (NeuralNetConfiguration.builder().seed(0)
+            .updater(Sgd(learning_rate=0.05))
+            .input_type(InputType.feed_forward(8))
+            .list(DenseLayer(n_out=16, activation="tanh"),
+                  OutputLayer(n_out=4, activation="softmax",
+                              loss="mcxent"))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    return JsonModelServer(net, max_batch_size=8, max_wait_ms=2,
+                           warmup=True)
+
+
+def validate_events(path: str) -> dict:
+    """Parse a JSONL event log and assert the per-type key schema.
+    Returns counts per event type; raises ``ValueError`` on a violation
+    (the trace-demo's smoke-test value)."""
+    counts: dict = {}
+    with open(path, "r", encoding="utf-8") as f:
+        for i, line in enumerate(f):
+            line = line.strip()
+            if not line:
+                continue
+            ev = json.loads(line)
+            if "t" not in ev or "type" not in ev:
+                raise ValueError(f"line {i}: event missing t/type: {ev}")
+            kind = ev["type"]
+            missing = _SCHEMA.get(kind, set()) - set(ev)
+            if missing:
+                raise ValueError(
+                    f"line {i}: {kind} event missing {sorted(missing)}")
+            counts[kind] = counts.get(kind, 0) + 1
+    return counts
+
+
+def main(out_dir: str = None, requests: int = 4,
+         printer=print) -> dict:
+    """Run the serve-and-trace loop; returns a summary dict (the tier-1
+    smoke test asserts on it). ``printer`` receives the human-readable
+    timeline."""
+    out_dir = out_dir or tempfile.mkdtemp(prefix="dl4j_trace_demo_")
+    log_path = os.path.join(out_dir, "events.jsonl")
+    rng = np.random.default_rng(0)
+    with telemetry.event_log(log_path):
+        with _build_server() as srv:
+            trace_id = None
+            for _ in range(max(1, int(requests))):
+                body = json.dumps(
+                    {"data": rng.normal(size=(2, 8)).tolist()}).encode()
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{srv.port}/predict",
+                        data=body) as resp:
+                    payload = json.loads(resp.read())
+                trace_id = payload.get("trace_id", trace_id)
+            if trace_id is None:
+                raise ValueError("/predict returned no trace_id "
+                                 "(telemetry disabled?)")
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{srv.port}/trace/{trace_id}") as r:
+                timeline = json.loads(r.read())
+    counts = validate_events(log_path)
+    if counts.get("trace", 0) < requests:
+        raise ValueError(f"expected >= {requests} trace events in the "
+                         f"JSONL log, found {counts}")
+    rendered = telemetry.format_timeline(timeline)
+    printer(rendered)
+    printer(f"event log: {log_path}  ({counts})")
+    phase_sum = sum(p.get("duration_s", 0.0)
+                    for p in timeline.get("phases", ()))
+    return {"trace_id": trace_id, "timeline": timeline,
+            "event_counts": counts, "event_log": log_path,
+            "phase_sum_s": phase_sum,
+            "duration_s": timeline.get("duration_s")}
+
+
+if __name__ == "__main__":
+    summary = main()
+    print(json.dumps({k: v for k, v in summary.items()
+                      if k != "timeline"}, indent=1, default=str))

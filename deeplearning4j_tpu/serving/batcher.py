@@ -94,6 +94,16 @@ _H_ACCEPT = _tel.histogram(
     "serving.speculative.accept_rate",
     "accepted/k per verify window per active slot — THE draft-quality "
     "signal (emitted tokens per target step = accepted + 1)")
+# generative latency decomposition (ISSUE 13): time-to-first-token
+# (submit -> first emitted token, queue+prefill included) and
+# time-per-output-token (steady-state inter-token interval), per request
+_H_TTFT = _tel.histogram(
+    "serving.ttft_s",
+    "time to first token per generative request (submit -> first emit)")
+_H_TPOT = _tel.histogram(
+    "serving.tpot_s",
+    "time per output token per generative request "
+    "((resolve - first emit) / (tokens - 1))")
 _pi_ids = itertools.count()
 
 
@@ -110,15 +120,21 @@ class HealthState:
 
 class _Request:
     __slots__ = ("x", "length", "future", "t_enqueue", "t_dequeue",
-                 "deadline")
+                 "deadline", "trace")
 
-    def __init__(self, x, length, deadline=None):
+    def __init__(self, x, length, deadline=None, trace=None):
         self.x = x
         self.length = length          # true seq length (seq models)
         self.future: Future = Future()
         self.t_enqueue = time.perf_counter()
         self.t_dequeue = None         # stamped by the dispatcher's get()
         self.deadline = deadline      # absolute perf_counter time or None
+        # explicit trace context (ISSUE 13): contextvars die at the queue
+        # boundary, so the trace rides the request object itself — which
+        # is also what keeps a carried-over coalesce request on its
+        # ORIGINAL trace
+        self.trace = trace if trace is not None else _tel.NULL_TRACE
+        self.future.trace_id = self.trace.trace_id
 
     def expired(self, now=None) -> bool:
         return self.deadline is not None and \
@@ -152,7 +168,8 @@ class ParallelInference:
                  retry_transient: bool = True,
                  health_window_s: float = 5.0,
                  degraded_p99_ms: Optional[float] = None,
-                 quantize: Optional[str] = None):
+                 quantize: Optional[str] = None,
+                 slo: Optional[_tel.SLO] = None):
         if mode not in (InferenceMode.SEQUENTIAL, InferenceMode.BATCHED):
             raise ValueError(f"unknown inference mode {mode!r}")
         if batch_limit is not None:  # deprecated alias
@@ -175,6 +192,10 @@ class ParallelInference:
         # the health window above this threshold reports DEGRADED even
         # with no hard failures (None = latency never degrades health)
         self.degraded_p99_ms = degraded_p99_ms
+        # ISSUE 13: a windowed SLO objective (target p99 / error-rate with
+        # multi-window burn-rate alarms); every resolved request records
+        # into it, and a firing alarm reports DEGRADED through health()
+        self.slo = slo
         if engine is not None and quantize is not None:
             # a silently-dropped quantize kwarg would serve f32 while
             # the deploy config believes it is int8 — fail loudly
@@ -247,8 +268,14 @@ class ParallelInference:
         dl = self.deadline_ms if deadline_ms is None else deadline_ms
         deadline = None if dl is None else time.perf_counter() + dl / 1e3
         self._m_requests.inc()
+        # explicit trace context (ISSUE 13): born here, carried through
+        # the queue on the request; every terminal path — resolve, shed,
+        # deadline, shutdown, failure — finishes it with a status
+        trace = _tel.start_request_trace("serving.request", pi=self._id,
+                                         mode=str(self.mode))
         if self.mode == InferenceMode.SEQUENTIAL:
-            req = self._make_request(x, deadline)
+            req = self._make_request(x, deadline, trace)
+            phases: List = []
             try:
                 if req.expired():
                     raise DeadlineExceeded(
@@ -259,24 +286,41 @@ class ParallelInference:
                     if req.expired():
                         raise DeadlineExceeded(
                             "request deadline expired before dispatch")
+                    t_d = time.perf_counter()
+                    trace.phase("queue", t_d - req.t_enqueue)
                     with _tel.span("serving.dispatch",
                                    labels={"pi": self._id,
                                            "mode": str(self.mode)},
-                                   rows=int(x.shape[0])):
-                        out = self._call_engine(x)
+                                   rows=int(x.shape[0]),
+                                   links=[trace.trace_id]):
+                        with _tel.sink_phases(
+                                lambda n, d: phases.append((n, d))):
+                            out = self._call_engine(x)
                 self._m_batches.inc()
                 self._h_rows.observe(x.shape[0])
                 req.future.set_result(
                     [np.asarray(o) for o in out] if isinstance(out, list)
                     else np.asarray(out))
+                done_t = time.perf_counter()
+                for name, d in phases:
+                    trace.phase(name, d)
+                trace.phase("resolve", max(
+                    0.0, done_t - t_d - sum(d for _, d in phases)))
+                trace.finish("ok", rows=int(x.shape[0]))
+                self._record_slo(done_t - req.t_enqueue, True)
             except DeadlineExceeded as e:
                 self._m_deadline.inc()
                 self._note("deadline")
                 req.future.set_exception(e)
+                trace.finish("error", f"{type(e).__name__}: {e}")
+                self._record_slo(time.perf_counter() - req.t_enqueue, False)
             except Exception as e:
                 self._m_failures.inc()
                 self._note("failure")
                 req.future.set_exception(e)
+                trace.finish("error", f"{type(e).__name__}: {e}")
+                self._record_slo(time.perf_counter() - req.t_enqueue, False)
+                _tel.flight.auto_dump("serving.dispatch")
             finally:
                 self._record_latency(req)
             return req.future
@@ -288,16 +332,23 @@ class ParallelInference:
             # traffic) cannot evade the overload protection.
             self._m_shed.inc()
             self._note("shed")
+            trace.finish("error", "QueueFull: shed at queue depth "
+                         f"{self._q.qsize()}")
+            self._record_slo(0.0, False)
             raise QueueFull(
                 f"serving queue depth {self._q.qsize()} at/above shedding "
                 f"threshold {self.shed_queue_depth}")
         if x.shape[0] > self.max_batch_size:
-            return self._submit_chunked(x, deadline)
-        return self._enqueue(self._make_request(x, deadline))
+            return self._submit_chunked(x, deadline, trace)
+        return self._enqueue(self._make_request(x, deadline, trace))
 
-    def _make_request(self, x, deadline=None) -> _Request:
+    def _make_request(self, x, deadline=None, trace=None) -> _Request:
         return _Request(x, x.shape[1] if self._seq and x.ndim >= 2 else None,
-                        deadline)
+                        deadline, trace)
+
+    def _record_slo(self, latency_s: float, ok: bool) -> None:
+        if self.slo is not None:
+            self.slo.record(latency_s, ok)
 
     def _enqueue(self, req: _Request) -> Future:
         self._q.put(req)
@@ -307,16 +358,27 @@ class ParallelInference:
         if self._shutdown.is_set() and not req.future.done():
             req.future.set_exception(ShutdownError(
                 "ParallelInference shut down before the request was served"))
+            req.trace.finish("error", "ShutdownError: shut down before "
+                             "the request was served")
         return req.future
 
-    def _submit_chunked(self, x, deadline=None) -> Future:
+    def _submit_chunked(self, x, deadline=None, trace=None) -> Future:
         """Split an oversized request into <= max_batch_size chunks (each
         pads onto a warmed bucket — no compile under traffic) and resolve
-        one parent future with the rejoined rows."""
+        one parent future with the rejoined rows. Each chunk gets its own
+        child trace (``parent=`` the submitting request's trace id); the
+        parent trace finishes when the rejoined future resolves."""
         m = self.max_batch_size
-        subs = [self._make_request(x[i:i + m], deadline)
-                for i in range(0, x.shape[0], m)]
+        trace = trace if trace is not None else _tel.NULL_TRACE
+        subs = []
+        for i in range(0, x.shape[0], m):
+            sub_tr = _tel.NULL_TRACE if trace.trace_id is None else \
+                _tel.start_request_trace("serving.request", pi=self._id,
+                                         mode=str(self.mode),
+                                         parent=trace.trace_id)
+            subs.append(self._make_request(x[i:i + m], deadline, sub_tr))
         parent: Future = Future()
+        parent.trace_id = trace.trace_id
         state = {"left": len(subs)}
         plock = threading.Lock()
 
@@ -327,6 +389,7 @@ class ParallelInference:
                 err = f.exception()
                 if err is not None:
                     parent.set_exception(err)
+                    trace.finish("error", f"{type(err).__name__}: {err}")
                     return
                 state["left"] -= 1
                 if state["left"]:
@@ -338,6 +401,16 @@ class ParallelInference:
                         for k in range(len(results[0]))])
                 else:
                     parent.set_result(np.concatenate(results))
+                # one covering phase so the parent timeline keeps the
+                # phases-sum-to-latency contract (the per-phase detail
+                # lives in the linked child traces); NULL_TRACE has no
+                # clock — skip when telemetry is off
+                if trace.trace_id is not None:
+                    trace.phase("chunked",
+                                time.perf_counter() - trace.t_start,
+                                chunks=len(subs))
+                trace.finish("ok", chunks=len(subs),
+                             children=[s.trace.trace_id for s in subs])
 
         for s in subs:
             s.future.add_done_callback(on_done)
@@ -348,7 +421,12 @@ class ParallelInference:
     def output(self, x, deadline_ms: Optional[float] = None) -> np.ndarray:
         """Blocking convenience over :meth:`submit`; re-checks shutdown so
         a racing ``shutdown()`` cannot strand the caller."""
-        fut = self.submit(x, deadline_ms=deadline_ms)
+        return self._wait(self.submit(x, deadline_ms=deadline_ms))
+
+    def _wait(self, fut: Future):
+        """Block on one submitted future, re-checking shutdown (shared by
+        :meth:`output` and ``JsonModelServer``, which needs the future —
+        and its ``trace_id`` — rather than just the rows)."""
         while True:
             try:
                 return fut.result(timeout=0.2)
@@ -382,6 +460,11 @@ class ParallelInference:
         reservoir are both read over ``health_window_s``, so a latency
         spike an hour ago cannot pin the state (ISSUE 6 satellite —
         the pre-registry percentiles were lifetime-of-process)."""
+        # ISSUE 13: evaluate the SLO FIRST, unconditionally — alarm() is
+        # what exports the burn-rate gauges and counts transitions, and
+        # an incident (shedding/degraded below) is exactly when those
+        # must keep moving
+        slo_alarm = self.slo.alarm() if self.slo is not None else None
         now = time.perf_counter()
         recent = {k for t, k in list(self._events)
                   if now - t <= self.health_window}
@@ -395,6 +478,10 @@ class ParallelInference:
             p99 = self._h_latency.percentile(99, window=self.health_window)
             if p99 is not None and p99 * 1e3 > self.degraded_p99_ms:
                 return HealthState.DEGRADED
+        # a burning SLO (sustained multi-window budget burn) degrades
+        # health even when no individual request failed hard
+        if slo_alarm is not None:
+            return HealthState.DEGRADED
         return HealthState.HEALTHY
 
     # legacy counter attributes — views over the registry cells
@@ -454,6 +541,8 @@ class ParallelInference:
             "batch_rows_max": None if rows["max"] is None
             else int(rows["max"]),
         }
+        if self.slo is not None:
+            out["slo"] = self.slo.snapshot()
         out["engine"] = self.engine.stats()
         return out
 
@@ -473,6 +562,8 @@ class ParallelInference:
                 req.future.set_exception(ShutdownError(
                     "ParallelInference shut down before the request "
                     "was served"))
+            req.trace.finish("error", "ShutdownError: shut down before "
+                             "the request was served")
 
     def __enter__(self):
         return self
@@ -512,6 +603,9 @@ class ParallelInference:
         if not req.future.done():
             req.future.set_exception(DeadlineExceeded(
                 "request deadline expired before dispatch"))
+        req.trace.finish("error", "DeadlineExceeded: request deadline "
+                         "expired before dispatch")
+        self._record_slo(time.perf_counter() - req.t_enqueue, False)
         self._record_latency(req)
         return True
 
@@ -585,18 +679,42 @@ class ParallelInference:
         if pending is not None:  # don't strand a carried request
             pending.future.set_exception(ShutdownError(
                 "ParallelInference shut down before the request was served"))
+            pending.trace.finish("error", "ShutdownError: shut down "
+                                 "before the request was served")
         # queued-request drain happens in shutdown() (this thread exits first)
 
     def _run(self, batch: List[_Request], total: int):
+        # per-request timeline stitching (ISSUE 13): queue = enqueue ->
+        # own dequeue, coalesce = own dequeue -> dispatch start; the
+        # engine-internal pad/execute/unpad phases arrive through the
+        # phase sink and are SHARED batch wall-time; resolve absorbs the
+        # remaining dispatch wall (concat, fault hooks, scatter) so the
+        # per-request phase durations sum to the measured latency
+        t_d = time.perf_counter()
+        tel = _tel.enabled()
+        phases: List = []
+        for r in batch:
+            r.trace.phase("queue", r.t_dequeue - r.t_enqueue)
+            r.trace.phase("coalesce", t_d - r.t_dequeue)
         try:
+            # the coalesced span LINKS every member request's trace — the
+            # fan-in edge a queue-crossing contextvar could never record
             with _tel.span("serving.dispatch",
                            labels={"pi": self._id,
                                    "mode": str(self.mode)},
-                           rows=int(total), requests=len(batch)):
-                out = self._run_engine(batch)
+                           rows=int(total), requests=len(batch),
+                           links=[r.trace.trace_id for r in batch
+                                  if r.trace.trace_id is not None]):
+                if tel:
+                    with _tel.sink_phases(
+                            lambda n, d: phases.append((n, d))):
+                        out = self._run_engine(batch)
+                else:
+                    out = self._run_engine(batch)
             outs = out if isinstance(out, list) else [out]
             i = 0
             done_t = time.perf_counter()
+            shared = sum(d for _, d in phases)
             for r in batch:
                 n = r.x.shape[0]
                 rows = [o[i:i + n] for o in outs]
@@ -606,6 +724,11 @@ class ParallelInference:
                 i += n
                 if not r.future.done():  # a shutdown race may have failed it
                     r.future.set_result(rows if len(rows) > 1 else rows[0])
+                for name, d in phases:
+                    r.trace.phase(name, d, shared=True)
+                r.trace.phase("resolve", max(0.0, done_t - t_d - shared))
+                r.trace.finish("ok", rows=int(n), batch_rows=int(total))
+                self._record_slo(done_t - r.t_enqueue, True)
             self._m_batches.inc()
             self._h_rows.observe(total)
             self._h_latency.observe_many(
@@ -619,6 +742,12 @@ class ParallelInference:
             for r in batch:
                 if not r.future.done():
                     r.future.set_exception(e)
+                r.trace.finish("error", f"{type(e).__name__}: {e}")
+                self._record_slo(done_t - r.t_enqueue, False)
+            # black box: the failed batch's span chains + the preceding
+            # compile/fault events are already in the ring — dump AFTER
+            # the traces finish so the dump contains them
+            _tel.flight.auto_dump("serving.dispatch")
 
     def _run_engine(self, batch: List[_Request]):
         """Coalesce one batch's arrays and dispatch the engine call."""
@@ -681,9 +810,10 @@ class GenerationHandle:
 
 class _GenRequest:
     __slots__ = ("x", "plen", "max_new", "eos_id", "handle", "t_enqueue",
-                 "deadline", "t_admitted", "tokens", "emitted")
+                 "deadline", "t_admitted", "tokens", "emitted", "trace",
+                 "t_first_token", "t_anchor")
 
-    def __init__(self, x, plen, max_new, eos_id, deadline):
+    def __init__(self, x, plen, max_new, eos_id, deadline, trace=None):
         self.x = x                    # [T, F] prompt features (host)
         self.plen = int(plen)
         self.max_new = int(max_new)
@@ -694,6 +824,14 @@ class _GenRequest:
         self.t_admitted = None
         self.tokens: List[int] = []
         self.emitted = 0
+        # explicit trace context through the queue (ISSUE 13); t_anchor
+        # is the end of the last timeline phase, so per-iteration decode
+        # phases tile the admitted lifetime exactly (timeline sums to the
+        # measured latency)
+        self.trace = trace if trace is not None else _tel.NULL_TRACE
+        self.handle.trace_id = self.trace.trace_id
+        self.t_first_token = None
+        self.t_anchor = None
 
     def expired(self, now=None) -> bool:
         return self.deadline is not None and \
@@ -748,7 +886,8 @@ class ContinuousBatcher:
                  pages: Optional[int] = None,
                  prefix_cache: bool = True,
                  draft_model=None,
-                 speculate_k: int = 4):
+                 speculate_k: int = 4,
+                 slo: Optional[_tel.SLO] = None):
         from .engine import GenerativeEngine, PagedGenerativeEngine
         self.model = model
         # ISSUE 9: quantize="int8" (weights) / kv_cache="int8" (per-row
@@ -860,6 +999,12 @@ class ContinuousBatcher:
         self._m_retries = _M_RETRIES.labeled(pi=self._id)
         self._m_tokens = _M_TOKENS.labeled(pi=self._id)
         self._h_latency = _H_LATENCY.labeled(pi=self._id)
+        # ISSUE 13 satellite: per-request TTFT/TPOT as first-class
+        # registry reservoirs (previously TPOT existed only as a bench
+        # artifact number) — stats()/GET /stats report their p50/p99
+        self._h_ttft = _H_TTFT.labeled(pi=self._id)
+        self._h_tpot = _H_TPOT.labeled(pi=self._id)
+        self.slo = slo
         self._g_slots = _G_SLOTS.labeled(pi=self._id)
         self._g_slots.set(0)
         self._m_proposed = _M_PROPOSED.labeled(pi=self._id)
@@ -879,6 +1024,9 @@ class ContinuousBatcher:
     def health(self) -> str:
         """HEALTHY / DEGRADED / SHEDDING over the recent event window —
         the r10 serving state machine applied to the generative front."""
+        # SLO first, unconditionally: alarm() exports the burn gauges
+        # and counts transitions; they must keep moving during incidents
+        slo_alarm = self.slo.alarm() if self.slo is not None else None
         now = time.perf_counter()
         recent = {k for t, k in list(self._events)
                   if now - t <= self.health_window}
@@ -888,7 +1036,13 @@ class ContinuousBatcher:
             return HealthState.SHEDDING
         if recent & {"failure", "retry", "deadline"}:
             return HealthState.DEGRADED
+        if slo_alarm is not None:
+            return HealthState.DEGRADED
         return HealthState.HEALTHY
+
+    def _record_slo(self, latency_s: float, ok: bool) -> None:
+        if self.slo is not None:
+            self.slo.record(latency_s, ok)
 
     # ---- public ------------------------------------------------------------
     def _one_hot(self, token: int) -> np.ndarray:
@@ -926,10 +1080,15 @@ class ContinuousBatcher:
                 f"prompt ({plen}) + max_new_tokens ({max_new})"
                 + (f" + speculative slack ({slack})" if slack else "")
                 + f" exceeds max_cache_len {self.max_cache_len}")
+        trace = _tel.start_request_trace("serving.generate", pi=self._id,
+                                         plen=plen, max_new=max_new)
         if self.shed_queue_depth is not None and \
                 self._q.qsize() >= self.shed_queue_depth:
             self._m_shed.inc()
             self._note("shed")
+            trace.finish("error", "QueueFull: shed at queue depth "
+                         f"{self._q.qsize()}")
+            self._record_slo(0.0, False)
             raise QueueFull(
                 f"generation queue depth {self._q.qsize()} at/above "
                 f"shedding threshold {self.shed_queue_depth}")
@@ -937,13 +1096,15 @@ class ContinuousBatcher:
         deadline = None if dl is None else time.perf_counter() + dl / 1e3
         req = _GenRequest(prompt, plen, max_new,
                           self.eos_id if eos_id is None else eos_id,
-                          deadline)
+                          deadline, trace)
         self._m_requests.inc()
         self._q.put(req)
         if self._shutdown.is_set() and not req.handle.future.done():
             req.handle.future.set_exception(ShutdownError(
                 "ContinuousBatcher shut down before the request was served"))
             req.handle._finish()
+            req.trace.finish("error", "ShutdownError: shut down before "
+                             "the request was served")
         return req.handle
 
     def generate(self, prompt=None, tokens=None, **kw) -> dict:
@@ -957,6 +1118,8 @@ class ContinuousBatcher:
         return self._q.qsize()
 
     def stats(self) -> dict:
+        ttft = self._h_ttft.hist_snapshot()
+        tpot = self._h_tpot.hist_snapshot()
         out = {
             "slots": self.slots,
             "health": self.health(),
@@ -969,8 +1132,20 @@ class ContinuousBatcher:
             "deadline_expired": int(self._m_deadline.value()),
             "retries": int(self._m_retries.value()),
             "cache_len": self._state.cache_len,
+            # ISSUE 13 satellite: per-request TTFT/TPOT percentiles (ms)
+            # — previously TPOT was a bench-artifact-only number
+            "ttft_ms_p50": None if ttft["p50"] is None
+            else ttft["p50"] * 1e3,
+            "ttft_ms_p99": None if ttft["p99"] is None
+            else ttft["p99"] * 1e3,
+            "tpot_ms_p50": None if tpot["p50"] is None
+            else tpot["p50"] * 1e3,
+            "tpot_ms_p99": None if tpot["p99"] is None
+            else tpot["p99"] * 1e3,
             "engine": self.engine.stats(),
         }
+        if self.slo is not None:
+            out["slo"] = self.slo.snapshot()
         if self.paged:
             # page-pool occupancy/free + prefix hit counters, per engine
             # (labeled engine= in the registry; surfaced here for
@@ -1001,10 +1176,13 @@ class ContinuousBatcher:
             if not req.handle.future.done():
                 req.handle.future.set_exception(err)
             req.handle._stream.put(None)
+            req.trace.finish("error", f"ShutdownError: {err}")
         for i, req in enumerate(self._slot_req):
             if req is not None and not req.handle.future.done():
                 req.handle.future.set_exception(err)
                 req.handle._stream.put(None)
+            if req is not None:
+                req.trace.finish("error", f"ShutdownError: {err}")
             self._slot_req[i] = None
 
     def __enter__(self):
@@ -1048,7 +1226,14 @@ class ContinuousBatcher:
             if not req.handle.future.done():
                 req.handle.future.set_exception(e)
             req.handle._stream.put(None)
+            req.trace.finish("error", f"{type(e).__name__}: {e}",
+                             tokens=req.emitted)
+            self._record_slo(time.perf_counter() - req.t_enqueue, False)
             self._slot_req[i] = None
+        # black box (ISSUE 13): decode-thread failure is the generative
+        # front's "unhandled engine failure" — dump after the in-flight
+        # traces resolve so their span chains are in the ring
+        _tel.flight.auto_dump("serving.decode")
         self._lengths[:] = 0
         self._x_t[:] = 0.0
         if self.paged:
@@ -1090,6 +1275,10 @@ class ContinuousBatcher:
                 req.handle.future.set_exception(DeadlineExceeded(
                     "generation request expired before admission"))
                 req.handle._stream.put(None)
+                req.trace.finish("error", "DeadlineExceeded: generation "
+                                 "request expired before admission")
+                self._record_slo(time.perf_counter() - req.t_enqueue,
+                                 False)
                 continue
             try:
                 self._prefill(req, slot)
@@ -1100,6 +1289,9 @@ class ContinuousBatcher:
                 if not req.handle.future.done():
                     req.handle.future.set_exception(e)
                 req.handle._stream.put(None)
+                req.trace.finish("error", f"{type(e).__name__}: {e}")
+                self._record_slo(time.perf_counter() - req.t_enqueue,
+                                 False)
                 # a mid-admission failure (page-pool exhaustion, a
                 # raising sample_fn in _emit_token, ...) must not leave
                 # a zombie slot decoding a dead request — or leak the
@@ -1115,6 +1307,10 @@ class ContinuousBatcher:
         if need_c > self._state.cache_len:
             self._state = self.engine.grow(self._state, need_c)
         req.t_admitted = time.perf_counter()
+        # timeline (ISSUE 13): queue = enqueue -> admission; the deadline
+        # clock restarts here (decided r13 semantics), the trace keeps
+        # the whole submit->resolve wall
+        req.trace.phase("queue", req.t_admitted - req.t_enqueue)
         if self.paged:
             logits = self._paged_admit(req, slot)
         else:
@@ -1128,6 +1324,9 @@ class ContinuousBatcher:
             self._dstate, _ = self.draft.prefill(
                 self._dstate, req.x, req.plen, slot)
             self._dlengths[slot] = req.plen
+        now = time.perf_counter()
+        req.trace.phase("prefill", now - req.t_admitted, slot=slot)
+        req.t_anchor = now
         self._slot_req[slot] = req
         self._lengths[slot] = req.plen
         self._emit_token(slot, logits)
@@ -1204,16 +1403,31 @@ class ContinuousBatcher:
         req.emitted += 1
         self._m_tokens.inc()
         req.handle._emit(req.emitted - 1, tok)
+        now = time.perf_counter()
+        if req.t_first_token is None:
+            # first-class TTFT (ISSUE 13): submit -> first emitted token,
+            # queue wait and prefill included — the user-visible stall
+            req.t_first_token = now
+            self._h_ttft.observe(now - req.t_enqueue)
         done = req.emitted >= req.max_new or \
             (req.eos_id is not None and tok == req.eos_id)
         if done:
             # submit->resolve, the family's documented unit (the one-shot
             # front observes at resolution too — dashboards can compare)
-            self._h_latency.observe(time.perf_counter() - req.t_enqueue)
+            latency = now - req.t_enqueue
+            self._h_latency.observe(latency)
+            ttft = req.t_first_token - req.t_enqueue
+            tpot = None
+            if req.emitted > 1:
+                tpot = (now - req.t_first_token) / (req.emitted - 1)
+                self._h_tpot.observe(tpot)
+            self._record_slo(latency, True)
             if not req.handle.future.done():
                 req.handle.future.set_result(
                     {"tokens": list(req.tokens), "logits": logits})
             req.handle._stream.put(None)
+            req.trace.finish("ok", tokens=req.emitted, ttft_s=ttft,
+                             tpot_s=tpot)
             self._slot_req[slot] = None
             self._reset_slot(slot)
         else:
@@ -1267,6 +1481,14 @@ class ContinuousBatcher:
         self._state = state
         self._lengths[live] += 1
         for i in live:
+            # per-iteration timeline phase BEFORE the emit (emit may
+            # finish the request): anchor -> now tiles the request's
+            # admitted lifetime with no gaps, so the stitched phases sum
+            # to the measured latency
+            req = self._slot_req[i]
+            now = time.perf_counter()
+            req.trace.phase("decode", now - req.t_anchor)
+            req.t_anchor = now
             self._emit_token(i, logits[i])
         self._g_slots.set(self.active_slots())
 
@@ -1329,6 +1551,14 @@ class ContinuousBatcher:
                 accepted += 1
             self._m_accepted.inc(accepted)
             self._h_accept.observe(accepted / k)
+            # ISSUE 13 satellite: accept/reject shows up in the stitched
+            # timeline — one speculative window phase per verify step
+            req = self._slot_req[s]
+            now = time.perf_counter()
+            req.trace.phase("decode", now - req.t_anchor,
+                            speculative=True, proposed=k,
+                            accepted=accepted)
+            req.t_anchor = now
             l0 = int(self._lengths[s])
             done = False
             for j, tok in enumerate(emitted):

@@ -669,11 +669,18 @@ class InferenceEngine(_QuantizedParamsMixin):
         xs_avals = [jax.ShapeDtypeStruct(x.shape, x.dtype) for x in xs_p]
         masks_avals = [None if m is None else
                        jax.ShapeDtypeStruct(m.shape, m.dtype) for m in masks]
+        # per-request tracing (ISSUE 13): when a dispatcher installed a
+        # phase sink for this call, the same pad/execute/unpad durations
+        # fan out into every member request's stitched timeline
+        sink = _tel.phase_sink() if tel else None
         if tel:  # request-lifecycle phases: pad -> execute -> unpad.
             # pad ends BEFORE the executable lookup: a cold-bucket AOT
             # compile must read as a compile event, not as seconds of
             # "host padding" in this histogram
-            self._h_pad.observe(time.perf_counter() - t0)
+            d_pad = time.perf_counter() - t0
+            self._h_pad.observe(d_pad)
+            if sink is not None:
+                sink("pad", d_pad)
         exe = self._get_compiled(xs_avals, masks_avals)
         if tel:
             t1 = time.perf_counter()
@@ -689,9 +696,14 @@ class InferenceEngine(_QuantizedParamsMixin):
             # np.asarray below syncs anyway; the execute phase measures
             # placement + dispatch (the transfer sync lands in unpad)
             self._h_exec.observe(t2 - t1)
+            if sink is not None:
+                sink("execute", t2 - t1)
         res = [self._unpad(np.asarray(o), n, seq_lens) for o in outs]
         if tel:
-            self._h_unpad.observe(time.perf_counter() - t2)
+            d_unpad = time.perf_counter() - t2
+            self._h_unpad.observe(d_unpad)
+            if sink is not None:
+                sink("unpad", d_unpad)
         return res if self._is_graph and len(res) > 1 else res[0]
 
     def _unpad(self, out, n, seq_lens):
@@ -813,6 +825,71 @@ class InferenceEngine(_QuantizedParamsMixin):
         if cm:
             report.update(cm)
         return report
+
+    def attribution_report(self, bucket: int, seq_buckets=None,
+                           measured_s: Optional[float] = None,
+                           peaks=None) -> dict:
+        """MFU attribution of ONE serving bucket program (ISSUE 13 —
+        ``memory_report``'s roofline sibling): the AOT executable's
+        ``cost_analysis()`` flops/bytes against this engine's measured
+        per-call window — pad+execute+unpad p50s, with pad+unpad as the
+        host seconds of that window. Serve (or warm and measure) traffic
+        first, or pass ``measured_s`` explicitly — attribution without a
+        measurement is a roofline estimate, flagged as such."""
+        from ..runtime import attribution as _attr
+        b = next_bucket(int(bucket), self.min_bucket)
+        t = self._warmup_seq_lens(seq_buckets)[0]
+        xs_avals, masks_avals = self._bucket_avals(b, t)
+        fp = self._params_placement()[0]
+        cache_key = self._key_of(xs_avals, masks_avals, fp)
+        with self._lock:
+            # reuse the warmed executable when the bucket is already
+            # compiled; a cold bucket pays ONE probe compile and the
+            # result is cached (it is byte-identical to the serving
+            # executable, so this also pre-warms the bucket — the tuner
+            # calls this repeatedly across configs)
+            compiled = self._compiled.get(cache_key)
+            if compiled is None:
+                compiled = self._lower_bucket(xs_avals,
+                                              masks_avals).compile()
+                _tel.record_compile("serving.engine", "probe",
+                                    engine=self._id, bucket=f"[{b}]")
+                self._compiled[cache_key] = compiled
+                self._known_avals.add(cache_key[:2])
+            buckets_served = {k[0] for k in self._compiled}
+        measurement_note = None
+        host_s = None
+        if measured_s is None:
+            if len(buckets_served) > 1:
+                # the phase histograms are labeled engine= only — with
+                # several compiled bucket shapes their p50 BLENDS
+                # buckets, and attributing bucket-b flops against a
+                # mixed-bucket measurement would cache garbage for the
+                # tuner. Degrade to a flagged roofline estimate instead.
+                measurement_note = (
+                    f"phase histograms blend {len(buckets_served)} "
+                    "compiled bucket shapes; pass measured_s for this "
+                    "bucket explicitly")
+            else:
+                # the measured window is the WHOLE engine call (pad +
+                # execute + unpad), so the host phases are a subset of
+                # it — carving host_s out of an execute-only window
+                # would mis-attribute device time as host time
+                ex = self._h_exec.percentile(50)
+                pad = self._h_pad.percentile(50)
+                unpad = self._h_unpad.percentile(50)
+                if ex is not None:
+                    host_s = (pad or 0.0) + (unpad or 0.0)
+                    measured_s = ex + host_s
+        rep = _attr.attribute_compiled(
+            compiled, measured_s=measured_s, host_s=host_s, peaks=peaks,
+            key=f"serving.engine:{type(self.model).__name__}:"
+                f"b{b}xt{t}:{self.quantize or 'f32'}")
+        if measurement_note is not None:
+            rep["measurement_note"] = measurement_note
+        rep.update({"kind": "serving_bucket", "bucket": b, "seq_len": t,
+                    "quantize": self.quantize or "off"})
+        return rep
 
     def stats(self) -> dict:
         with self._lock:
@@ -1171,6 +1248,40 @@ class GenerativeEngine(_QuantizedParamsMixin):
                "kv_cache": self.kv_cache if self._kv_quant else "off"}
         out.update(self._quantize_stats())
         return out
+
+    def attribution_report(self, cache_len: int,
+                           measured_s: Optional[float] = None,
+                           peaks=None) -> dict:
+        """MFU attribution of the decode-step program at one cache bucket
+        (ISSUE 13): ``cost_analysis()`` of the full-slot-batch decode
+        executable vs the measured ``serving.phase.decode_step_s`` p50
+        for this engine. Warm/serve first or pass ``measured_s``."""
+        from ..runtime import attribution as _attr
+        c = next_bucket(int(cache_len))
+        exe = self._decode_exe(c, _warmup=True)
+        measurement_note = None
+        if measured_s is None:
+            with self._lock:
+                decode_buckets = {k for k in self._compiled
+                                  if k[0] == "decode"}
+            if len(decode_buckets) > 1:
+                # same anti-blending rule as the one-shot engine: the
+                # decode histogram is per-engine, not per-cache-bucket
+                measurement_note = (
+                    f"decode histogram blends {len(decode_buckets)} "
+                    "cache buckets; pass measured_s for this bucket "
+                    "explicitly")
+            else:
+                measured_s = self._h_decode.percentile(50)
+        rep = _attr.attribute_compiled(
+            exe, measured_s=measured_s, peaks=peaks,
+            key=f"serving.decode:{type(self.model).__name__}:"
+                f"s{self.slots}xc{c}:{self.quantize or 'f32'}")
+        if measurement_note is not None:
+            rep["measurement_note"] = measurement_note
+        rep.update({"kind": "decode_step", "cache_len": c,
+                    "slots": self.slots})
+        return rep
 
 
 class PagedDecodeState:

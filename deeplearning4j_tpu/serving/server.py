@@ -104,6 +104,21 @@ class JsonModelServer:
                     self.send_header("Content-Length", str(len(body)))
                     self.end_headers()
                     self.wfile.write(body)
+                elif self.path.startswith("/trace/"):
+                    # per-request stitched timeline (ISSUE 13): /predict
+                    # and /generate return a trace_id; this resolves it
+                    from ..runtime import telemetry as _telemetry
+                    tl = _telemetry.get_trace(
+                        self.path[len("/trace/"):])
+                    if tl is None:
+                        self._send(404, {"error": "unknown or evicted "
+                                         "trace id"})
+                    else:
+                        self._send(200, tl)
+                elif self.path == "/traces":
+                    from ..runtime import telemetry as _telemetry
+                    self._send(200,
+                               {"traces": _telemetry.recent_traces()})
                 else:
                     self._send(404, {"error": "unknown path"})
 
@@ -123,11 +138,17 @@ class JsonModelServer:
                         ds = DataSet(x, None)
                         server.pre_processor.transform(ds)
                         x = ds.features
-                    out = server.inference.output(x)
-                    self._send(200, {"output":
-                                     [np.asarray(o).tolist() for o in out]
-                                     if isinstance(out, list)
-                                     else np.asarray(out).tolist()})
+                    fut = server.inference.submit(x)
+                    out = server.inference._wait(fut)
+                    payload = {"output":
+                               [np.asarray(o).tolist() for o in out]
+                               if isinstance(out, list)
+                               else np.asarray(out).tolist()}
+                    # stitched-timeline handle (ISSUE 13): resolve it at
+                    # GET /trace/<id> (absent when telemetry is off)
+                    if getattr(fut, "trace_id", None) is not None:
+                        payload["trace_id"] = fut.trace_id
+                    self._send(200, payload)
                 except QueueFull as e:
                     self._send(429, {"error": f"{type(e).__name__}: {e}"})
                 except DeadlineExceeded as e:
@@ -166,7 +187,10 @@ class JsonModelServer:
                             **kw)
                     if not req.get("stream"):
                         res = handle.result()
-                        self._send(200, {"tokens": res["tokens"]})
+                        payload = {"tokens": res["tokens"]}
+                        if getattr(handle, "trace_id", None) is not None:
+                            payload["trace_id"] = handle.trace_id
+                        self._send(200, payload)
                         return
                     # stream NDJSON per token; HTTP/1.0 close-delimited
                     self.send_response(200)
@@ -182,9 +206,11 @@ class JsonModelServer:
                             self.wfile.flush()
                             i += 1
                         res = handle.result()
-                        self.wfile.write(json.dumps(
-                            {"done": True, "tokens": res["tokens"]}
-                        ).encode() + b"\n")
+                        final = {"done": True, "tokens": res["tokens"]}
+                        if getattr(handle, "trace_id", None) is not None:
+                            final["trace_id"] = handle.trace_id
+                        self.wfile.write(json.dumps(final).encode()
+                                         + b"\n")
                     except Exception as e:
                         self.wfile.write(json.dumps(
                             {"error": f"{type(e).__name__}: {e}"}

@@ -1,0 +1,191 @@
+"""ISSUE 13: the MFU-attribution profiler (``runtime/attribution.py``).
+
+Acceptance: ``attribution_report`` decomposes step time into
+compute/memory/host fractions with ``mfu_gap`` accounted — fractions sum
+to ~1.0 — for the train step (``model.attribution_report``, both the
+self-measured and externally-measured paths) and the serving engines'
+bucket/decode programs, keyed for the schedule tuner's cache.
+"""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nn.config import InputType, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.layers.attention import SelfAttentionLayer
+from deeplearning4j_tpu.nn.layers.core import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.model import MultiLayerNetwork
+from deeplearning4j_tpu.nn.updaters import Sgd
+from deeplearning4j_tpu.runtime import attribution as attr
+from deeplearning4j_tpu.runtime import telemetry as tel
+
+
+def _net(seed=0):
+    conf = (NeuralNetConfiguration.builder().seed(seed)
+            .updater(Sgd(learning_rate=0.05))
+            .input_type(InputType.feed_forward(32))
+            .list(DenseLayer(n_out=64, activation="tanh"),
+                  OutputLayer(n_out=8, activation="softmax",
+                              loss="mcxent"))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+PEAKS = {"flops_per_s": 1e12, "bytes_per_s": 1e11, "source": "test"}
+
+
+def _assert_partition(rep):
+    fr = rep["fractions"]
+    assert fr is not None
+    assert abs(sum(fr.values()) - 1.0) < 1e-9
+    assert all(0.0 <= v <= 1.0 for v in fr.values())
+    assert rep["mfu"] == fr["compute"]
+    gap = rep["mfu_gap"]
+    assert abs(gap["total"] - (1.0 - fr["compute"])) < 1e-9
+    assert abs(gap["memory"] + gap["host"] + gap["other"]
+               - gap["total"]) < 1e-9
+
+
+# ------------------------------------------------------------- pure math
+def test_attribute_partition_exact_values():
+    # 1e9 flops @ 1e12 flops/s = 1ms compute; 1e9 bytes @ 1e11 B/s =
+    # 10ms memory -> 9ms memory-bound excess; 2ms host; rest "other"
+    rep = attr.attribute(1e9, 1e9, measured_s=0.020, host_s=0.002,
+                         peaks=PEAKS)
+    assert abs(rep["compute_s"] - 0.001) < 1e-12
+    assert abs(rep["memory_s"] - 0.009) < 1e-12
+    assert abs(rep["host_s"] - 0.002) < 1e-12
+    assert abs(rep["other_s"] - 0.008) < 1e-12
+    assert rep["roofline_bound"] == "memory"
+    assert abs(rep["arithmetic_intensity"] - 1.0) < 1e-12
+    _assert_partition(rep)
+
+
+def test_attribute_clamps_keep_partition():
+    # measured FASTER than the roofline compute bound: compute fraction
+    # clamps to 1.0, nothing goes negative
+    rep = attr.attribute(1e9, 0.0, measured_s=1e-5, peaks=PEAKS)
+    _assert_partition(rep)
+    assert rep["mfu"] == 1.0
+    # host_s larger than the remaining time clamps too
+    rep2 = attr.attribute(1e9, 0.0, measured_s=0.002, host_s=1.0,
+                          peaks=PEAKS)
+    _assert_partition(rep2)
+    assert rep2["other_s"] == 0.0
+
+
+def test_attribute_unmeasured_is_flagged():
+    rep = attr.attribute(1e9, 1e9, measured_s=None, peaks=PEAKS)
+    assert rep["measured"] is False
+    assert rep["fractions"] is None and rep["mfu"] is None
+    assert rep["roofline_compute_s"] > 0
+
+
+# -------------------------------------------------------------- device peaks
+def test_device_peaks_env_override(monkeypatch):
+    monkeypatch.setenv("DL4J_TPU_PEAK_FLOPS", "2e12")
+    monkeypatch.setenv("DL4J_TPU_PEAK_BW", "3e11")
+    pk = attr.device_peaks()
+    assert pk["flops_per_s"] == 2e12
+    assert pk["bytes_per_s"] == 3e11
+    assert pk["source"] == "table"
+
+
+def test_device_peaks_calibrates_on_unknown_devices(monkeypatch):
+    monkeypatch.delenv("DL4J_TPU_PEAK_FLOPS", raising=False)
+    monkeypatch.delenv("DL4J_TPU_PEAK_BW", raising=False)
+    pk = attr.device_peaks()     # CPU CI: no table row -> calibration
+    assert pk["flops_per_s"] > 0 and pk["bytes_per_s"] > 0
+
+
+# ---------------------------------------------------------- train step
+def test_model_attribution_report_partitions_and_caches():
+    net = _net()
+    rep = net.attribution_report(8, steps=2)
+    assert rep["kind"] == "train_step" and rep["batch_size"] == 8
+    assert rep["cost_available"] is True
+    assert rep["measured_s"] > 0
+    _assert_partition(rep)
+    # keyed + cached so a schedule tuner can rank without re-measuring
+    assert rep["key"].startswith("train.step:MultiLayerNetwork:b8")
+    assert attr.cached_report(rep["key"])["measured_s"] == \
+        rep["measured_s"]
+    assert rep["key"] in attr.report_keys()
+    # the probe lands in the retrace tracker, not as a mystery compile
+    assert any(e["cause"] == "probe"
+               for e in tel.compile_events("train.step"))
+
+
+def test_model_attribution_external_measurement():
+    """The bench path: attribute against an externally measured step time
+    (no self-measurement runs)."""
+    net = _net(seed=1)
+    rep = net.attribution_report(4, measured_s=0.05, peaks=PEAKS)
+    assert rep["measured_s"] == 0.05
+    _assert_partition(rep)
+
+
+def test_cost_analysis_unavailable_degrades(monkeypatch):
+    net = _net(seed=2)
+    monkeypatch.setattr(attr, "cost_analysis", lambda c: None)
+    rep = net.attribution_report(4, measured_s=0.01)
+    assert rep["cost_available"] is False
+    assert rep["fractions"] is None and rep["mfu"] is None
+
+
+# ------------------------------------------------------------- serving
+def test_engine_attribution_after_traffic():
+    from deeplearning4j_tpu.serving.engine import InferenceEngine
+
+    net = _net(seed=3)
+    eng = InferenceEngine(net)
+    eng.warmup([8])
+    x = np.zeros((8, 32), np.float32)
+    for _ in range(3):
+        eng.output(x)
+    compiles = eng.compiles
+    ev0 = int(tel.registry.get("compile.events").total())
+    rep = eng.attribution_report(8)
+    # the warmed bucket's executable is REUSED: no probe compile, no
+    # serving-counter movement (the tuner calls this repeatedly)
+    assert eng.compiles == compiles
+    assert int(tel.registry.get("compile.events").total()) == ev0
+    assert rep["kind"] == "serving_bucket" and rep["bucket"] == 8
+    _assert_partition(rep)
+    # the measured window is the WHOLE call: execute p50 + the host
+    # pad+unpad p50s (host time is a subset of the window, not carved
+    # out of device time)
+    ex = eng._h_exec.percentile(50)
+    pad = eng._h_pad.percentile(50) or 0.0
+    unpad = eng._h_unpad.percentile(50) or 0.0
+    assert abs(rep["measured_s"] - (ex + pad + unpad)) <= 1e-9
+    assert 0 <= rep["host_s"] <= pad + unpad + 1e-12
+
+
+def test_generative_decode_attribution_explicit_measurement():
+    from deeplearning4j_tpu.serving.engine import GenerativeEngine
+
+    V = 16
+    conf = (NeuralNetConfiguration.builder().seed(0)
+            .input_type(InputType.recurrent(V, 8))
+            .list(SelfAttentionLayer(n_out=V, n_heads=2),
+                  OutputLayer(n_out=V, activation="softmax"))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    eng = GenerativeEngine(net, slots=2)
+    rep = eng.attribution_report(16, measured_s=0.005, peaks=PEAKS)
+    assert rep["kind"] == "decode_step" and rep["cache_len"] == 16
+    _assert_partition(rep)
+
+
+def test_attribute_jitted_lowers_on_avals():
+    import jax
+    import jax.numpy as jnp
+
+    fn = jax.jit(lambda a, b: a @ b)
+    aval = jax.ShapeDtypeStruct((64, 64), jnp.float32)
+    rep = attr.attribute_jitted(fn, (aval, aval), measured_s=0.001,
+                                peaks=PEAKS, key="t.jitted:mm64")
+    _assert_partition(rep)
+    # 2*64^3 flops at 1e12 flops/s
+    assert abs(rep["roofline_compute_s"] - 2 * 64 ** 3 / 1e12) < 1e-9
+    assert attr.cached_report("t.jitted:mm64") is not None

@@ -1,0 +1,501 @@
+"""ISSUE 13: per-request distributed tracing, SLO burn-rate alarms, and
+the flight recorder.
+
+Acceptance coverage:
+- a traced one-shot AND a traced generative request each yield ONE
+  stitched timeline whose phase durations sum to within 10% of the
+  measured request latency;
+- trace propagation edge cases: carried-over coalesce requests keep
+  their ORIGINAL trace; shed / deadline-expired / shutdown requests
+  still resolve their span with an error status; speculative-decode
+  accept/reject iterations appear in the timeline;
+- an injected ``serving.dispatch`` fault produces a flight-recorder dump
+  containing the failing request's span chain and the preceding
+  compile/fault events;
+- ``pi.stats()``/``GET /stats`` expose per-request TTFT/TPOT p50/p99;
+- SLO multi-window burn-rate alarms wire into the HEALTHY/DEGRADED
+  state machine;
+- cross-host stitching merges per-host JSONL logs into one pod trace;
+- ``prometheus_text()`` summaries carry ``_sum``/``_count`` children
+  (burn-rate math needs rates, not just quantiles).
+"""
+
+import json
+import os
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nn.config import InputType, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.layers.attention import SelfAttentionLayer
+from deeplearning4j_tpu.nn.layers.core import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.model import MultiLayerNetwork
+from deeplearning4j_tpu.nn.updaters import Sgd
+from deeplearning4j_tpu.runtime import faults
+from deeplearning4j_tpu.runtime import telemetry as tel
+from deeplearning4j_tpu.runtime.faults import DeadlineExceeded, QueueFull
+from deeplearning4j_tpu.serving.batcher import (ContinuousBatcher,
+                                                HealthState, InferenceMode,
+                                                ParallelInference)
+
+V = 16
+
+
+def _net(seed=0, n_in=6, n_out=3):
+    conf = (NeuralNetConfiguration.builder().seed(seed)
+            .updater(Sgd(learning_rate=0.05))
+            .input_type(InputType.feed_forward(n_in))
+            .list(DenseLayer(n_out=8, activation="tanh"),
+                  OutputLayer(n_out=n_out, activation="softmax",
+                              loss="mcxent"))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _lm(seed=0):
+    conf = (NeuralNetConfiguration.builder().seed(seed)
+            .input_type(InputType.recurrent(V, 8))
+            .list(SelfAttentionLayer(n_out=V, n_heads=2),
+                  OutputLayer(n_out=V, activation="softmax"))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _x(n=2, n_in=6, seed=0):
+    return np.random.default_rng(seed).normal(
+        size=(n, n_in)).astype(np.float32)
+
+
+def _phases(tl):
+    return [p["phase"] for p in tl["phases"]]
+
+
+def _phase_sum(tl):
+    return sum(p["duration_s"] for p in tl["phases"])
+
+
+# ------------------------------------------------------ stitched timelines
+def test_oneshot_trace_timeline_sums_to_latency():
+    """Acceptance: one stitched timeline per one-shot request —
+    queue→coalesce→pad→execute→unpad→resolve — summing to within 10% of
+    the measured latency."""
+    net = _net()
+    pi = ParallelInference(net, mode=InferenceMode.BATCHED,
+                           max_batch_size=8, max_wait_ms=2, warmup=True)
+    try:
+        fut = pi.submit(_x())
+        fut.result(timeout=30)
+        assert fut.trace_id is not None
+        tl = tel.get_trace(fut.trace_id)
+        assert tl["status"] == "ok" and tl["kind"] == "serving.request"
+        names = _phases(tl)
+        assert names[:2] == ["queue", "coalesce"]
+        assert {"pad", "execute", "unpad"} <= set(names)
+        assert names[-1] == "resolve"
+        # engine phases are marked as shared batch wall time
+        assert all(p.get("shared") for p in tl["phases"]
+                   if p["phase"] in ("pad", "execute", "unpad"))
+        assert abs(_phase_sum(tl) - tl["duration_s"]) \
+            <= 0.10 * tl["duration_s"]
+    finally:
+        pi.shutdown()
+
+
+def test_sequential_trace_timeline_sums_to_latency():
+    net = _net()
+    pi = ParallelInference(net, mode=InferenceMode.SEQUENTIAL)
+    try:
+        fut = pi.submit(_x())
+        fut.result(timeout=30)
+        tl = tel.get_trace(fut.trace_id)
+        assert tl["status"] == "ok"
+        assert {"queue", "execute", "resolve"} <= set(_phases(tl))
+        assert abs(_phase_sum(tl) - tl["duration_s"]) \
+            <= 0.10 * tl["duration_s"]
+    finally:
+        pi.shutdown()
+
+
+def test_generative_trace_timeline_ttft_tpot():
+    """Acceptance: the generative timeline — queue→prefill→per-decode-
+    iteration — sums to within 10% of the measured latency, with
+    first-class TTFT/TPOT on the trace AND p50/p99 in stats()."""
+    net = _lm()
+    cb = ContinuousBatcher(net, slots=2, max_cache_len=32,
+                           min_cache_len=16, max_new_tokens=4)
+    try:
+        x = np.eye(V, dtype=np.float32)[
+            np.random.default_rng(0).integers(0, V, 3)]
+        h = cb.submit(prompt=x, max_new_tokens=4)
+        res = h.result(timeout=120)
+        assert len(res["tokens"]) == 4
+        tl = tel.get_trace(h.trace_id)
+        assert tl["status"] == "ok" and tl["kind"] == "serving.generate"
+        names = _phases(tl)
+        assert names[0] == "queue" and names[1] == "prefill"
+        assert names.count("decode") == 3    # tokens 2..4
+        assert abs(_phase_sum(tl) - tl["duration_s"]) \
+            <= 0.10 * tl["duration_s"]
+        assert tl["ttft_s"] > 0 and tl["tpot_s"] > 0
+        st = cb.stats()
+        for k in ("ttft_ms_p50", "ttft_ms_p99", "tpot_ms_p50",
+                  "tpot_ms_p99"):
+            assert st[k] is not None and st[k] > 0, k
+    finally:
+        cb.shutdown()
+
+
+def test_chunked_request_parent_trace_links_children():
+    net = _net()
+    pi = ParallelInference(net, mode=InferenceMode.BATCHED,
+                           max_batch_size=2, max_wait_ms=1, warmup=True)
+    try:
+        fut = pi.submit(_x(n=5))
+        fut.result(timeout=30)
+        tl = tel.get_trace(fut.trace_id)
+        assert tl["status"] == "ok" and tl["chunks"] == 3
+        assert len(tl["children"]) == 3
+        # the parent keeps the phases-sum contract via one covering
+        # "chunked" phase; per-phase detail lives in the children
+        assert _phases(tl) == ["chunked"]
+        assert abs(_phase_sum(tl) - tl["duration_s"]) \
+            <= 0.10 * tl["duration_s"]
+        for cid in tl["children"]:
+            child = tel.get_trace(cid)
+            assert child["parent"] == fut.trace_id
+            assert child["status"] == "ok"
+    finally:
+        pi.shutdown()
+
+
+# ------------------------------------------------- propagation edge cases
+def test_carried_over_coalesce_request_keeps_original_trace():
+    """A request the dispatcher dequeues but carries into the NEXT batch
+    (would overshoot max_batch_size) keeps its original trace: exactly
+    one queue phase, measured from the original enqueue."""
+    net = _net()
+    pi = ParallelInference(net, mode=InferenceMode.BATCHED,
+                           max_batch_size=3, max_wait_ms=150, warmup=True)
+    try:
+        f1 = pi.submit(_x(n=2, seed=1))
+        f2 = pi.submit(_x(n=2, seed=2))   # 2+2 > 3: carried over
+        f1.result(timeout=30)
+        f2.result(timeout=30)
+        tl = tel.get_trace(f2.trace_id)
+        assert tl["status"] == "ok"
+        names = _phases(tl)
+        assert names.count("queue") == 1 and names.count("coalesce") == 1
+        assert names.count("resolve") == 1
+        assert abs(_phase_sum(tl) - tl["duration_s"]) \
+            <= 0.10 * tl["duration_s"]
+        # the carried request waited through the first batch's linger +
+        # dispatch; its timeline covers that wall time (no trace restart)
+        t1 = tel.get_trace(f1.trace_id)
+        assert tl["duration_s"] >= t1["duration_s"] * 0.5
+    finally:
+        pi.shutdown()
+
+
+def test_shed_deadline_shutdown_requests_resolve_their_trace():
+    net = _net()
+    # shed: depth-0 threshold rejects in the caller's thread
+    pi = ParallelInference(net, mode=InferenceMode.BATCHED,
+                           max_batch_size=4, shed_queue_depth=0)
+    try:
+        with pytest.raises(QueueFull):
+            pi.submit(_x())
+        shed_tl = tel.recent_traces(1)[0]
+        shed_tl = tel.get_trace(shed_tl["trace"])
+        assert shed_tl["status"] == "error"
+        assert "QueueFull" in shed_tl["error"]
+    finally:
+        pi.shutdown()
+
+    # deadline: expired before dispatch (sequential = deterministic)
+    pi2 = ParallelInference(net, mode=InferenceMode.SEQUENTIAL)
+    try:
+        fut = pi2.submit(_x(), deadline_ms=0)
+        with pytest.raises(DeadlineExceeded):
+            fut.result(timeout=10)
+        tl = tel.get_trace(fut.trace_id)
+        assert tl["status"] == "error"
+        assert "DeadlineExceeded" in tl["error"]
+    finally:
+        pi2.shutdown()
+
+    # shutdown: a queued request drained by shutdown() resolves its span
+    net2 = _net(seed=3)
+    pi3 = ParallelInference(net2, mode=InferenceMode.BATCHED,
+                            max_batch_size=2, max_wait_ms=1, warmup=True)
+    faults.inject("serving.slow", delay=0.4, times=1)
+    try:
+        f_slow = pi3.submit(_x(seed=4))      # holds the dispatcher 0.4s
+        time.sleep(0.05)
+        f_q = pi3.submit(_x(seed=5))         # still queued at shutdown
+        pi3.shutdown()
+        with pytest.raises(Exception):
+            f_q.result(timeout=10)
+        tl = tel.get_trace(f_q.trace_id)
+        assert tl["status"] == "error"
+        assert "Shutdown" in tl["error"]
+        assert f_slow.done()
+    finally:
+        faults.reset()
+        pi3.shutdown()
+
+
+def test_speculative_iterations_appear_in_timeline():
+    """Satellite: speculative-decode verify windows land in the stitched
+    timeline with their proposed/accepted counts."""
+    net = _lm()
+    toks = list(np.random.default_rng(5).integers(0, V, 4))
+    cb = ContinuousBatcher(net, slots=2, max_cache_len=32,
+                           min_cache_len=32, max_new_tokens=6,
+                           paged=True, page_size=8,
+                           draft_model=net, speculate_k=3)
+    try:
+        h = cb.submit(tokens=toks, max_new_tokens=6)
+        res = h.result(timeout=180)
+        assert len(res["tokens"]) == 6
+        tl = tel.get_trace(h.trace_id)
+        spec = [p for p in tl["phases"] if p.get("speculative")]
+        assert spec, _phases(tl)
+        for p in spec:
+            assert p["proposed"] == 3
+            assert 0 <= p["accepted"] <= 3
+        # the draft IS the target: everything accepted
+        assert all(p["accepted"] == 3 for p in spec)
+        assert abs(_phase_sum(tl) - tl["duration_s"]) \
+            <= 0.10 * tl["duration_s"]
+    finally:
+        cb.shutdown()
+
+
+# ------------------------------------------------------------ HTTP surface
+def test_server_trace_endpoint_and_stats():
+    from deeplearning4j_tpu.serving.server import JsonModelServer
+
+    net = _net()
+    with JsonModelServer(net, max_batch_size=8, max_wait_ms=2,
+                         warmup=True) as srv:
+        body = json.dumps({"data": _x().tolist()}).encode()
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/predict", data=body) as r:
+            payload = json.loads(r.read())
+        assert "trace_id" in payload
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/trace/"
+                f"{payload['trace_id']}") as r:
+            tl = json.loads(r.read())
+        assert tl["status"] == "ok" and tl["phases"]
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/traces") as r:
+            listing = json.loads(r.read())
+        assert any(t["trace"] == payload["trace_id"]
+                   for t in listing["traces"])
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/trace/bogus")
+        assert exc.value.code == 404
+
+
+def test_trace_demo_smoke(tmp_path):
+    """``make trace-demo``'s entry point runs end to end and validates
+    the JSONL schema (the satellite's smoke-test role)."""
+    from deeplearning4j_tpu.runtime import trace_demo
+
+    out = trace_demo.main(out_dir=str(tmp_path), requests=2,
+                          printer=lambda s: None)
+    assert out["event_counts"]["trace"] >= 2
+    assert out["event_counts"]["span"] >= 1
+    assert out["duration_s"] is not None
+    assert abs(out["phase_sum_s"] - out["duration_s"]) \
+        <= 0.10 * out["duration_s"]
+
+
+# ------------------------------------------------------------------- SLO
+def test_slo_burn_rate_multiwindow_alarm():
+    slo = tel.SLO("t_unit", target_p99_ms=1.0, fast_window_s=5.0,
+                  slow_window_s=10.0, min_samples=4)
+    # below min_samples: no judgement, no alarm flapping
+    slo.record(1e-5, ok=True)
+    assert slo.burn_rate(5.0) is None and slo.alarm() is None
+    for _ in range(8):
+        slo.record(1e-5, ok=True)          # fast, ok: not burning
+    assert slo.alarm() is None
+    alarms0 = tel.registry.get("slo.alarms").total()
+    for _ in range(16):
+        slo.record(0.5, ok=False)          # slow AND failed
+    assert slo.alarm() == "fast_burn"
+    assert tel.registry.get("slo.alarms").total() == alarms0 + 1
+    assert slo.alarm() == "fast_burn"      # steady: no re-count
+    assert tel.registry.get("slo.alarms").total() == alarms0 + 1
+    snap = slo.snapshot()
+    assert snap["burn_rate_fast"] > slo.fast_burn
+    assert tel.registry.get("slo.burn_rate").value(
+        default=None, slo="t_unit", window="fast") is not None
+
+
+def test_slo_wired_into_health_state_machine():
+    net = _net()
+    slo = tel.SLO("t_front", target_p99_ms=1e-4, fast_window_s=5.0,
+                  slow_window_s=10.0, min_samples=4)
+    pi = ParallelInference(net, mode=InferenceMode.SEQUENTIAL, slo=slo)
+    try:
+        for s in range(6):
+            pi.output(_x(seed=s))          # any real request misses 0.1us
+        assert pi.health() == HealthState.DEGRADED
+        st = pi.stats()
+        assert st["health"] == HealthState.DEGRADED
+        assert st["slo"]["alarm"] is not None
+        assert st["slo"]["burn_rate_fast"] > 1.0
+        # the burn gauges keep exporting even when ANOTHER rule already
+        # degrades health (alarm() runs first, not behind early returns)
+        tel.registry.get("slo.burn_rate").zero(slo="t_front",
+                                               window="fast")
+        pi._note("failure")              # event-window rule -> DEGRADED
+        assert pi.health() == HealthState.DEGRADED
+        assert tel.registry.get("slo.burn_rate").value(
+            default=None, slo="t_front", window="fast") is not None
+    finally:
+        pi.shutdown()
+
+
+def test_slo_requires_a_target():
+    with pytest.raises(ValueError):
+        tel.SLO("t_empty")
+
+
+# -------------------------------------------------------- flight recorder
+def test_injected_dispatch_fault_produces_flight_dump(tmp_path):
+    """Acceptance: an injected ``serving.dispatch`` fault produces a
+    flight-recorder dump containing the failing request's span chain and
+    the preceding compile/fault events."""
+    net = _net(seed=7)
+    tel.flight.configure(dir=str(tmp_path))
+    try:
+        pi = ParallelInference(net, mode=InferenceMode.BATCHED,
+                               max_batch_size=4, max_wait_ms=1,
+                               warmup=True)
+        # times=2 beats the one transient retry -> the batch fails
+        faults.inject("serving.dispatch", error="crash", times=2)
+        try:
+            fut = pi.submit(_x(seed=8))
+            with pytest.raises(faults.InjectedCrash):
+                fut.result(timeout=30)
+        finally:
+            faults.reset()
+            pi.shutdown()
+        dumps = sorted(os.listdir(tmp_path))
+        assert dumps, "no flight dump written"
+        # the last dump is the serving-failure one (after the traces
+        # resolved) — it must contain the whole story
+        last = tmp_path / dumps[-1]
+        recs = [json.loads(line) for line in open(last)]
+        assert recs[0]["type"] == "flight_dump"
+        assert recs[0]["reason"].startswith("serving.dispatch")
+        assert "fault_counters" in recs[0]
+        body = recs[1:]
+        failed = [r for r in body if r.get("type") == "trace"
+                  and r.get("trace") == fut.trace_id]
+        assert failed and failed[0]["status"] == "error"
+        assert "InjectedCrash" in failed[0]["error"]
+        assert any(r.get("type") == "fault"
+                   and r.get("site") == "serving.dispatch" for r in body)
+        assert any(r.get("type") == "compile"
+                   and r.get("site") == "serving.engine" for r in body)
+        assert any(r.get("type") == "span"
+                   and r.get("name") == "serving.dispatch" for r in body)
+    finally:
+        tel.flight.configure(dir=None)
+
+
+def test_flight_configure_capacity_keeps_dump_dir(tmp_path):
+    """A capacity-only reconfigure must not silently drop the dump
+    directory (DL4J_TPU_FLIGHT_DIR would be discarded exactly when the
+    black box is needed); dir=None explicitly disables files."""
+    rec = tel.FlightRecorder(capacity=4)
+    rec.configure(dir=str(tmp_path))
+    rec.configure(capacity=16)              # dir omitted: preserved
+    rec.record({"type": "probe"})
+    dump = rec.dump("explicit")
+    assert dump["path"] is not None and os.path.exists(dump["path"])
+    rec.configure(dir=None)                 # explicit disable
+    assert rec.dump("explicit")["path"] is None
+    # auto-dumps are rate-limited PER REASON (a hot path tripping the
+    # same fault thousands of times must not rewrite the ring per event)
+    rec2 = tel.FlightRecorder(capacity=4, min_interval_s=60.0)
+    assert rec2.auto_dump("fault:x") is not None
+    assert rec2.auto_dump("fault:x") is None       # suppressed
+    assert rec2.auto_dump("fault:y") is not None   # different reason
+    rec2.configure(min_interval_s=0.0)
+    assert rec2.auto_dump("fault:x") is not None   # limit lifted
+
+
+def test_flight_explicit_dump_counts_and_captures(tmp_path):
+    tel.flight.record({"type": "probe", "marker": "t_flight"})
+    before = tel.registry.get("flight.dumps").total()
+    dump = tel.flight.dump("explicit", path=str(tmp_path / "d.jsonl"))
+    assert tel.registry.get("flight.dumps").total() == before + 1
+    assert any(e.get("marker") == "t_flight" for e in dump["events"])
+    assert tel.flight.last_dump is dump
+    recs = [json.loads(line) for line in open(dump["path"])]
+    assert recs[0]["type"] == "flight_dump"
+
+
+# ------------------------------------------------------ cross-host stitch
+def test_stitch_event_logs_merges_hosts(tmp_path, monkeypatch):
+    """Pod path: DL4J_TPU_EVENT_LOG + set_host() gives each host its own
+    JSONL file; stitch_event_logs merges them into ONE pod-level trace
+    view with host-qualified ids (the 2-proc multihost_sim contract,
+    simulated in-process)."""
+    base = str(tmp_path / "pod_events")
+    monkeypatch.setenv("DL4J_TPU_EVENT_LOG", base)
+    try:
+        for host in (0, 1):
+            tel.set_host(host, 2)          # re-points the event sink
+            with tel.span("train.pod_step", step=host):
+                pass
+            tr = tel.start_request_trace("serving.request", pi="pod")
+            tr.phase("execute", 0.001)
+            tr.finish("ok")
+    finally:
+        tel.close_event_log()
+        tel.set_host(0, 1)
+    paths = [f"{base}.host0.jsonl", f"{base}.host1.jsonl"]
+    assert all(os.path.exists(p) for p in paths)
+    merged = tel.stitch_event_logs(paths)
+    assert merged["hosts"] == [0, 1]
+    assert all("host" in e for e in merged["events"])
+    # spans: int trace ids get host-qualified; request traces are born
+    # host-qualified — no cross-host blending either way
+    span_keys = {k for k, evs in merged["traces"].items()
+                 if any(e.get("type") == "span" for e in evs)}
+    assert {k.split(":")[0] for k in span_keys if ":" in k} <= {"0", "1"}
+    req = [k for k, evs in merged["traces"].items()
+           if any(e.get("type") == "trace" for e in evs)]
+    assert len(req) == 2
+    assert any(k.startswith("0-") for k in req)
+    assert any(k.startswith("1-") for k in req)
+    # wall-clock ordering held after the merge
+    ts = [e["t"] for e in merged["events"]]
+    assert ts == sorted(ts)
+
+
+# ----------------------------------------------------- prometheus children
+def test_prometheus_summaries_emit_sum_and_count_children():
+    """Satellite: burn-rate math over a scrape needs rates — summaries
+    must export ``_sum``/``_count`` children, not just quantiles."""
+    h = tel.histogram("t.promsum")
+    for v in (0.1, 0.2, 0.3):
+        h.observe(v, inst="a")
+    text = tel.prometheus_text()
+    assert 'dl4j_t_promsum_count{inst="a"} 3' in text
+    assert 'dl4j_t_promsum_sum{inst="a"}' in text
+    assert 'quantile="0.99"' in text
+    # and the serving latency family the SLO dashboards consume
+    assert "dl4j_serving_request_latency_s_count" in text
+    assert "dl4j_serving_request_latency_s_sum" in text
+    h.zero()

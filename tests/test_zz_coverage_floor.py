@@ -110,7 +110,10 @@ def test_telemetry_metric_floor(request):
               # paged KV + speculative decoding (ISSUE 12): the
               # serving.page_pool.* gauges/counters and the
               # serving.speculative.* accept-rate family
-              "test_paged_kv.py"}
+              "test_paged_kv.py",
+              # tracing/SLO/flight recorder + attribution (ISSUE 13):
+              # serving.ttft_s/tpot_s, slo.burn_rate/alarms, flight.dumps
+              "test_tracing_slo.py", "test_attribution.py"}
     missing = needed - collected
     if missing:
         pytest.skip(f"chunked run (telemetry-ledger-marking files not "
@@ -124,6 +127,41 @@ def test_telemetry_metric_floor(request):
         f"registered metrics never written by any test: "
         f"{rep['untouched']} — wire a test through the owning subsystem "
         "(or drop the dead metric)")
+
+
+def test_source_metric_names_are_registered(request):
+    """ISSUE 13 satellite (grep-the-AST): every registry metric name
+    written as a literal in PRODUCT SOURCE must be registered by the end
+    of the suite — closing the coverage floor's blind spot (the untouched
+    floor above only sees metrics that got DECLARED; a name in source
+    whose declaration site no test ever reaches was invisible to it).
+    Declaring modules are imported here first, so module-level
+    declarations count even if their subsystem's tests were skipped."""
+    import importlib
+
+    collected = {item.fspath.basename for item in request.session.items}
+    # call-time declarations (train.phase.*, checkpoint gates) need their
+    # subsystems' tests to have run — same guard set as the floor above
+    needed = {"test_telemetry.py", "test_resilience.py",
+              "test_serving_engine.py", "test_autotune_overlap.py",
+              "test_checkpoint.py", "test_quantized_serving.py"}
+    missing_files = needed - collected
+    if missing_files:
+        pytest.skip(f"chunked run (declaring-subsystem files not "
+                    f"collected: {sorted(missing_files)})")
+    from test_static_telemetry import collect_metric_names
+    from deeplearning4j_tpu.runtime import telemetry
+    per_file = collect_metric_names()
+    for rel in per_file:
+        mod = rel[:-3].replace("/", ".").replace("\\", ".")
+        importlib.import_module(mod)
+    registered = set(telemetry.registry.names())
+    missing = {name: rel for rel, names in per_file.items()
+               for name in names if name not in registered}
+    assert not missing, (
+        f"metric names written in source but never registered by any "
+        f"tier-1 path: {missing} — declare them at import time or wire "
+        "a test through the declaring code path")
 
 
 def test_coverage_floor(request):
