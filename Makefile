@@ -4,7 +4,7 @@
 PY ?= python
 
 .PHONY: test test-fast multihost-sim multihost-smoke bench bench-generative \
-	trace-demo
+	trace-demo tune
 
 # fast (tier-1) suite — what CI gates on
 test-fast:
@@ -40,6 +40,15 @@ bench:
 bench-generative:
 	env JAX_PLATFORMS=cpu $(PY) -c "import json, bench; \
 print(json.dumps(bench.bench_generative_serving(), indent=1))"
+
+# ISSUE 14: joint schedule tuner dry-run on CPU with a toy model —
+# seeds a default cache entry (CPU never sweeps), asserts the JSON
+# cache file was written and re-loads into a hit. Exits non-zero on any
+# failed invariant.
+tune:
+	env JAX_PLATFORMS=cpu \
+		DL4J_TPU_SCHEDULE_CACHE=/tmp/dl4j_tpu_schedule_cache.json \
+		$(PY) -m deeplearning4j_tpu.runtime.schedule
 
 # ISSUE 13: tiny serve-and-trace loop — boots a JsonModelServer, POSTs a
 # few /predict requests with the JSONL event log on, resolves one

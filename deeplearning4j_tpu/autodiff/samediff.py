@@ -636,6 +636,25 @@ class SameDiff(_SentinelCounterMixin):
             train, other, {k: jnp.asarray(v) for k, v in feeds.items()})
         return {k: np.asarray(v) for k, v in g.items()}
 
+    def _cast_other_vals(self, other_vals):
+        """bf16 audit fix (ISSUE 14 satellite, the r12 cast hoist's
+        sibling): under a 16-bit dtype policy, cast the NON-trainable
+        values (imported CONSTs, frozen weights) to the compute dtype
+        ONCE, host-call-side, instead of re-casting them inside every
+        compiled fit step — they never change between steps, so the
+        per-step ``cast_floating`` over them was pure wasted bandwidth
+        (for a frozen-encoder fine-tune, the entire encoder re-cast
+        every step). The step's in-graph ``cast_floating`` stays as a
+        safety net and is an IDENTITY (zero jaxpr eqns) for pre-cast
+        leaves, so a caller handing raw f32 values still computes
+        correctly — just without the hoist. Bit-equal to the un-hoisted
+        program: the same cast, done once (tested, jaxpr-regressed).
+        Identity under a non-mixed policy."""
+        from .. import dtypes as _dt
+        if not _dt.is_mixed(self.dtype):
+            return other_vals
+        return _dt.cast_floating(other_vals, _dt.resolve(self.dtype))
+
     def _fit_loss_fn(self):
         """The pure training loss ``(train_vals, other_vals, feeds) ->
         scalar`` the fit step differentiates — factored out so
@@ -785,8 +804,12 @@ class SameDiff(_SentinelCounterMixin):
         updater = self.updater
         step = self._fit_step_cached()
         train_vals = {n: self._values[n] for n in train_names}
-        other_vals = {n: v for n, v in self._values.items()
-                      if n not in train_names}
+        # cast hoist (ISSUE 14 satellite): constants/frozen values go to
+        # the compute dtype ONCE here, not once per compiled step —
+        # self._values keeps the f32 originals (masters discipline)
+        other_vals = self._cast_other_vals(
+            {n: v for n, v in self._values.items()
+             if n not in train_names})
         opt_state = updater.init_state(train_vals)
         cbs = list(self._listeners) + list(listeners or [])
         history = History()
@@ -866,7 +889,10 @@ class SameDiff(_SentinelCounterMixin):
         step = self._fit_step_cached()
         train_names = [n for n, v in self._vars.items() if v.kind == VARIABLE]
         tv = {n: self._values[n] for n in train_names}
-        ov = {n: v for n, v in self._values.items() if n not in tv}
+        # mirror fit()'s cast hoist so the lowered program IS the one the
+        # fit loop runs (pre-cast other_vals avals)
+        ov = self._cast_other_vals(
+            {n: v for n, v in self._values.items() if n not in tv})
         tv_avals = jax.eval_shape(lambda: tv)
         ov_avals = jax.eval_shape(lambda: ov)
         opt_avals = jax.eval_shape(lambda: self.updater.init_state(tv))

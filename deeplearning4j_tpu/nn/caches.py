@@ -202,6 +202,25 @@ class CompiledCacheMixin(SentinelCounterMixin):
             self, batch_size, steps=steps, accum_steps=accum_steps,
             seq_len=seq_len, peaks=peaks, measured_s=measured_s)
 
+    def tune_schedule(self, batch_size: int, apply: bool = True,
+                      force: bool = False, **kwargs) -> dict:
+        """Joint schedule search over THIS model's real train step
+        (ISSUE 14, ``runtime/schedule.py``): workspace-mode remat policy
+        x accum_steps x batch size, pruned by the AOT
+        ``memory_report``/``max_batch`` oracle (never OOM-probes), seeded
+        from cached ``attribution_report`` fractions, timed as real
+        compiled steps (TPU only — CPU seeds a default entry unless
+        ``force=True``), winner cached per (model-fingerprint, topology,
+        dtype-policy) with JSON disk persistence
+        (``DL4J_TPU_SCHEDULE_CACHE``). ``apply=True`` applies the winning
+        ``workspace_mode`` through :meth:`set_workspace_mode` — one
+        attributed retrace at the next build, zero steady-state compiles
+        after; the winning batch size is a recommendation in the returned
+        entry. ``DL4J_TPU_SCHEDULE_TUNE=off`` pins to cache/defaults."""
+        from ..runtime import schedule as _sched
+        return _sched.tune_schedule(self, batch_size, apply=apply,
+                                    force=force, **kwargs)
+
     def inference_engine(self, **kwargs):
         """The model's serving engine (``serving.engine.InferenceEngine``),
         created lazily; ``output()`` routes through it. Pass kwargs (e.g.

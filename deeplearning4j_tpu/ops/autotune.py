@@ -158,6 +158,18 @@ def _default_blocks(tq: int, tk: int,
 
 
 # ---------------------------------------------------------------- cache
+def atomic_json_save(path: str, snap: dict) -> str:
+    """Persist a JSON-able cache snapshot via tmp+rename — a torn write
+    must never corrupt the next process's load. Shared persistence
+    discipline for the sweep-and-cache tuners (this module's flash-block
+    cache and ``runtime/schedule.py``'s joint schedule cache)."""
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(snap, f, indent=1)
+    os.replace(tmp, path)
+    return path
+
+
 def _cache_path() -> Optional[str]:
     p = os.environ.get("DL4J_TPU_AUTOTUNE_CACHE", "")
     return p or None
@@ -315,12 +327,7 @@ def save(path: Optional[str] = None) -> Optional[str]:
     path = path or _cache_path()
     if not path:
         return None
-    snap = cache_snapshot()
-    tmp = f"{path}.tmp.{os.getpid()}"
-    with open(tmp, "w") as f:
-        json.dump(snap, f, indent=1)
-    os.replace(tmp, path)
-    return path
+    return atomic_json_save(path, cache_snapshot())
 
 
 def load(path: Optional[str] = None, merge: bool = True) -> int:

@@ -199,6 +199,41 @@ class ParallelWrapper:
             self._pending_step_cause = "overlap"
         return self
 
+    def set_accum_steps(self, k: int) -> "ParallelWrapper":
+        """Change the gradient micro-accumulation factor in place (the
+        ISSUE 14 schedule-tuner apply seam). The microbatch split is
+        baked into the compiled step, so a change drops the cached step;
+        the rebuild is attributed ``cause="config_change"`` (or whatever
+        the tuner arms). Note accum_steps changes the summation ORDER of
+        the gradient (weighted-mean recombination, ``nn/microbatch.py``):
+        equal to accum_steps=1 to float tolerance, not bit-for-bit."""
+        k = int(k)
+        if k < 1:
+            raise ValueError(f"accum_steps must be >= 1, got {k}")
+        if k != self.accum_steps:
+            self.accum_steps = k
+            if self._step is not None:
+                self._step = None
+                self._pending_step_cause = \
+                    self._pending_step_cause or "config_change"
+        return self
+
+    def tune_schedule(self, batch_size: int, apply: bool = True,
+                      force: bool = False, **kwargs) -> dict:
+        """Joint schedule search over THIS wrapper's sharded train step
+        (ISSUE 14, ``runtime/schedule.py``): workspace-mode x accum_steps
+        x GLOBAL batch size x ``overlap_bucket_mb`` (when the ZeRO-1
+        overlap is on), oracle-pruned via AOT ``memory_analysis`` of the
+        GSPMD program, attribution-seeded, timed as real sharded steps.
+        ``apply=True`` routes the winner through the existing seams —
+        ``model.set_workspace_mode`` / :meth:`set_overlap` /
+        :meth:`set_accum_steps` — one attributed retrace each, zero
+        steady-state compiles after. Batch size is a recommendation in
+        the returned entry (the iterator owns the real batch)."""
+        from ..runtime import schedule as _sched
+        return _sched.tune_schedule(self, batch_size, apply=apply,
+                                    force=force, **kwargs)
+
     def _dense_keys(self) -> set:
         """Top-level param keys (layer index / vertex name) whose layer is
         in the dense family — the only layers TP shards. Matching on the
@@ -431,20 +466,21 @@ class ParallelWrapper:
 
         return step_fn, shard_args
 
-    def memory_report(self, batch_size: int, seq_len=None) -> dict:
-        """Compiled-HBM accounting of THIS wrapper's sharded train step
-        (GSPMD program — the per-device memory_analysis view) at the
-        GLOBAL ``batch_size``, via AOT lower+compile (nothing executes).
-        Same fields as ``model.memory_report`` (``nn/memory.py``); the
-        conf's ``workspace_mode`` remat policy and ``shard_update``/
-        ``accum_steps`` are all baked into the measured program."""
+    def _lower_step(self, batch_size: int, seq_len=None, step_fn=None):
+        """AOT lower+compile of a sharded train step at the GLOBAL
+        ``batch_size`` (nothing executes). ``step_fn=None`` uses (and
+        caches) THIS wrapper's step; an explicit ``step_fn`` (the
+        schedule tuner's candidate builds) is lowered without touching
+        the wrapper's cache."""
         from ..nn import memory as _memory
+        from ..runtime import sentinel as _sent
         m = self.model
         if not m.params:
             m.init()
-        if self._step is None:
-            self._step = self._build()
-        step_fn, _ = self._step
+        if step_fn is None:
+            if self._step is None:
+                self._step = self._build()
+            step_fn, _ = self._step
         repl, data, p_sh, _, opt_sh, bn_sh, _ = self._sharding_trees()
 
         def sds(aval, sh):
@@ -455,6 +491,26 @@ class ParallelWrapper:
         y = jax.tree.map(lambda a: sds(a, data), y)
         fm = (None,) * len(x) if isinstance(x, tuple) else None
         lm = (None,) * len(y) if isinstance(y, tuple) else None
+        return step_fn.lower(
+            jax.tree.map(sds, jax.eval_shape(lambda: m.params), p_sh),
+            jax.tree.map(sds, jax.eval_shape(lambda: m.updater_state),
+                         opt_sh),
+            jax.tree.map(sds, jax.eval_shape(lambda: m.state), bn_sh),
+            jax.ShapeDtypeStruct((), jnp.int32, sharding=repl),
+            sds(jax.eval_shape(lambda: jax.random.PRNGKey(0)), repl),
+            x, y, fm, lm,
+            jax.tree.map(lambda a: sds(a, repl),
+                         _sent.counter_avals())).compile()
+
+    def memory_report(self, batch_size: int, seq_len=None) -> dict:
+        """Compiled-HBM accounting of THIS wrapper's sharded train step
+        (GSPMD program — the per-device memory_analysis view) at the
+        GLOBAL ``batch_size``, via AOT lower+compile (nothing executes).
+        Same fields as ``model.memory_report`` (``nn/memory.py``); the
+        conf's ``workspace_mode`` remat policy and ``shard_update``/
+        ``accum_steps`` are all baked into the measured program."""
+        from ..nn import memory as _memory
+        m = self.model
         report = {
             "workspace_mode": str(getattr(m.conf, "workspace_mode", "none")),
             "batch_size": int(batch_size),
@@ -466,21 +522,96 @@ class ParallelWrapper:
             "peak_bytes": None,
             "device": _memory.device_memory_stats(),
         }
-        from ..runtime import sentinel as _sent
-        compiled = step_fn.lower(
-            jax.tree.map(sds, jax.eval_shape(lambda: m.params), p_sh),
-            jax.tree.map(sds, jax.eval_shape(lambda: m.updater_state),
-                         opt_sh),
-            jax.tree.map(sds, jax.eval_shape(lambda: m.state), bn_sh),
-            jax.ShapeDtypeStruct((), jnp.int32, sharding=repl),
-            sds(jax.eval_shape(lambda: jax.random.PRNGKey(0)), repl),
-            x, y, fm, lm,
-            jax.tree.map(lambda a: sds(a, repl),
-                         _sent.counter_avals())).compile()
-        cm = _memory.compiled_memory(compiled)
+        cm = _memory.compiled_memory(self._lower_step(batch_size, seq_len))
         if cm:
             report.update(cm)
         return report
+
+    def _host_share(self, batch_args, batch_size: int):
+        """Slice synthetic FULL-GLOBAL-size batch arrays down to THIS
+        host's share before ``shard_args``: the multi-host contract of
+        ``shard_batch`` is local-value-IS-the-shard
+        (``make_array_from_process_local_data``), so feeding every host
+        the full global batch would silently reassemble — and measure —
+        a ``batch_size x process_count`` program while the cost model
+        and cache key describe ``batch_size`` (the attribution/tuner
+        measurement paths). Identity on a single process."""
+        n = jax.process_count()
+        if n <= 1:
+            return batch_args
+        if batch_size % n:
+            raise ValueError(
+                f"global batch {batch_size} does not divide over "
+                f"{n} hosts — pass a host-divisible batch_size")
+        share = batch_size // n
+        return jax.tree.map(lambda a: a[:share], batch_args)
+
+    def _schedule_key_suffix(self) -> dict:
+        """The wrapper-schedule fields every cached attribution report
+        must be keyed on (ISSUE 14 satellite bugfix): a report measured
+        with overlap ON describes a differently-scheduled program than
+        one with overlap OFF, and the tuner seeding from the cache must
+        never read across that boundary."""
+        return {"su": int(self.shard_update),
+                "ov": int(self.overlap_grads),
+                "mb": self.overlap_bucket_bytes / (1 << 20),
+                "mesh": "x".join(str(s) for s in self.mesh.devices.shape)}
+
+    def attribution_report(self, batch_size: int, steps: int = 3,
+                           seq_len=None, peaks=None,
+                           measured_s=None) -> dict:
+        """MFU attribution of THIS wrapper's sharded step at the GLOBAL
+        ``batch_size`` (``runtime/attribution.py``): AOT
+        ``cost_analysis`` + a synced self-measurement of ``steps`` real
+        sharded executions on zero batches (or a caller-supplied
+        ``measured_s``). The report key carries the full schedule —
+        workspace_mode, accum_steps, shard_update, overlap on/off and
+        bucket size, mesh shape — so the ISSUE 14 tuner can seed from
+        cached fractions without ever reading a differently-scheduled
+        program's numbers."""
+        import time as _time
+
+        from ..runtime import attribution as _attr
+        from ..runtime import telemetry as _tel
+        m = self.model
+        if not m.params:
+            m.init()
+        if self._step is None:
+            self._step = self._build()
+        step_fn, shard_args = self._step
+        compiled = self._lower_step(batch_size, seq_len)
+        _tel.record_compile("parallel.step", "probe",
+                            model=type(m).__name__, batch=batch_size)
+        if measured_s is None:
+            durs = []
+            for i in range(max(1, int(steps)) + 1):
+                (params, opt, state, stepi, key, xs, ys, fm, lm,
+                 sent) = _attr._train_step_args(
+                    m, batch_size, self.accum_steps, seq_len, i)
+                xs, ys = self._host_share((xs, ys), batch_size)
+                args = shard_args(params, opt, state, sent, stepi, key,
+                                  xs, ys, fm, lm)
+                t0 = _time.perf_counter()
+                out = step_fn(*args)
+                jax.block_until_ready(out)
+                durs.append(_time.perf_counter() - t0)
+            measured_s = min(durs[1:]) if len(durs) > 1 else durs[0]
+        key = _attr.train_step_key(m, batch_size, self.accum_steps,
+                                   seq_len,
+                                   schedule=self._schedule_key_suffix())
+        rep = _attr.attribute_compiled(compiled, measured_s, peaks=peaks,
+                                       key=key)
+        rep.update({"kind": "parallel_step",
+                    "batch_size": int(batch_size),
+                    "accum_steps": self.accum_steps,
+                    "shard_update": self.shard_update,
+                    "overlap": self.overlap_grads,
+                    "overlap_bucket_mb":
+                        self.overlap_bucket_bytes / (1 << 20),
+                    "devices": int(self.mesh.devices.size),
+                    "workspace_mode":
+                        str(getattr(m.conf, "workspace_mode", "none"))})
+        return rep
 
     def on_host_loss(self) -> None:
         """Post-``launcher.reinitialize()`` repair (ISSUE 10): the old

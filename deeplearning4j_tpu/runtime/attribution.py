@@ -48,7 +48,8 @@ from . import telemetry as _tel
 
 __all__ = ["device_peaks", "cost_analysis", "attribute",
            "attribute_compiled", "attribute_jitted", "attribution_report",
-           "cached_report", "report_keys"]
+           "cached_report", "report_keys", "model_fingerprint",
+           "train_step_key"]
 
 #: HBM bandwidth table (bytes/s) by device-kind substring — the roofline
 #: denominator ``_detect_peak_flops`` (optimize/listeners.py) does not
@@ -212,6 +213,46 @@ def attribute(flops: float, bytes_accessed: float,
     return out
 
 
+def model_fingerprint(model) -> str:
+    """Short stable digest of a model's parameter TREE (class + every leaf
+    path/shape/dtype). Part of every cached report/schedule key: two
+    models of the same class at the same batch are different programs
+    when their topologies differ, and a report keyed only on the class
+    name would serve one model's cached fractions to the other (the
+    ISSUE 14 stale-seed bug class)."""
+    import hashlib
+    from jax.tree_util import keystr, tree_flatten_with_path
+    flat, _ = tree_flatten_with_path(model.params)
+    leaves = sorted(
+        (keystr(path), tuple(getattr(a, "shape", ())),
+         str(getattr(a, "dtype", "?")))
+        for path, a in flat)
+    raw = repr((type(model).__name__, leaves)).encode()
+    return hashlib.sha256(raw).hexdigest()[:12]
+
+
+def train_step_key(model, batch_size: int, accum_steps: int = 1,
+                   seq_len: Optional[int] = None,
+                   schedule: Optional[dict] = None) -> str:
+    """Cache key for a train-step attribution report. Carries EVERYTHING
+    that changes the compiled program the fractions describe: the model
+    fingerprint, batch/accum, the dtype policy, the workspace/remat
+    policy, and — via ``schedule`` (the ParallelWrapper path) — the
+    sharding/overlap settings. A tuner reading cached fractions keyed
+    without any of these would seed its search from a differently-
+    scheduled program's numbers (ISSUE 14 satellite bugfix; regression:
+    tests/test_attribution.py mutate-policy test)."""
+    dtype = str(getattr(model.conf, "dtype", "FLOAT"))
+    mode = str(getattr(model.conf, "workspace_mode", "none") or "none")
+    key = (f"train.step:{type(model).__name__}:{model_fingerprint(model)}"
+           f":b{batch_size}:acc{accum_steps}:{dtype}:{mode}")
+    if seq_len:
+        key += f":T{seq_len}"
+    if schedule:
+        key += "".join(f":{k}={schedule[k]}" for k in sorted(schedule))
+    return key
+
+
 #: process-wide report cache, keyed so ROADMAP item 4's schedule tuner
 #: can rank configurations without re-measuring
 _REPORTS: Dict[str, dict] = {}
@@ -340,9 +381,7 @@ def attribution_report(model, batch_size: int, steps: int = 3,
                 .percentile(50, model=lbl, **_tel.host_labels())
     dtype = str(getattr(model.conf, "dtype", "FLOAT"))
     mode = str(getattr(model.conf, "workspace_mode", "none"))
-    key = (f"train.step:{type(model).__name__}:b{batch_size}"
-           f":acc{accum_steps}:{dtype}:{mode}"
-           + (f":T{seq_len}" if seq_len else ""))
+    key = train_step_key(model, batch_size, accum_steps, seq_len)
     rep = attribute_compiled(compiled, measured_s, host_s=host_s,
                              peaks=peaks, key=key)
     rep.update({"kind": "train_step", "batch_size": int(batch_size),

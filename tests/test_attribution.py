@@ -106,7 +106,10 @@ def test_model_attribution_report_partitions_and_caches():
     assert rep["measured_s"] > 0
     _assert_partition(rep)
     # keyed + cached so a schedule tuner can rank without re-measuring
-    assert rep["key"].startswith("train.step:MultiLayerNetwork:b8")
+    # (r18: a model fingerprint sits between the class and the batch so
+    # same-class different-topology models never share a report)
+    assert rep["key"].startswith(
+        f"train.step:MultiLayerNetwork:{attr.model_fingerprint(net)}:b8")
     assert attr.cached_report(rep["key"])["measured_s"] == \
         rep["measured_s"]
     assert rep["key"] in attr.report_keys()
@@ -189,3 +192,73 @@ def test_attribute_jitted_lowers_on_avals():
     # 2*64^3 flops at 1e12 flops/s
     assert abs(rep["roofline_compute_s"] - 2 * 64 ** 3 / 1e12) < 1e-9
     assert attr.cached_report("t.jitted:mm64") is not None
+
+
+# --------------------------------------------------- ISSUE 14 key bugfix
+def test_report_key_tracks_workspace_mode_mutation():
+    """ISSUE 14 satellite bugfix regression: the cached report's key must
+    include the workspace/remat policy — a tuner reading cached fractions
+    after a policy mutation would otherwise seed its search from the
+    OLD program's numbers. Mutate the policy -> fresh key, fresh report;
+    the old report stays cached under its own key."""
+    net = _net(seed=11)
+    rep1 = net.attribution_report(4, measured_s=1e-3, peaks=PEAKS)
+    assert ":none" in rep1["key"]
+    net.set_workspace_mode("dots_saveable")
+    rep2 = net.attribution_report(4, measured_s=2e-3, peaks=PEAKS)
+    assert rep2["key"] != rep1["key"]
+    assert ":dots_saveable" in rep2["key"]
+    assert rep2["workspace_mode"] == "dots_saveable"
+    old = attr.cached_report(rep1["key"])
+    assert old is not None and old["measured_s"] == 1e-3
+    assert attr.cached_report(rep2["key"])["measured_s"] == 2e-3
+
+
+def test_report_key_tracks_model_fingerprint():
+    """Two models of the same class but different topologies must never
+    share a cached report (the fingerprint half of the key)."""
+    a = _net(seed=0)
+    conf = (NeuralNetConfiguration.builder().seed(0)
+            .updater(Sgd(learning_rate=0.05))
+            .input_type(InputType.feed_forward(32))
+            .list(DenseLayer(n_out=128, activation="tanh"),
+                  OutputLayer(n_out=8, activation="softmax",
+                              loss="mcxent"))
+            .build())
+    b = MultiLayerNetwork(conf).init()
+    ra = a.attribution_report(4, measured_s=1e-3, peaks=PEAKS)
+    rb = b.attribution_report(4, measured_s=1e-3, peaks=PEAKS)
+    assert ra["key"] != rb["key"]
+    assert attr.model_fingerprint(a) != attr.model_fingerprint(b)
+    assert attr.model_fingerprint(a) == attr.model_fingerprint(_net(seed=0))
+
+
+def test_wrapper_report_key_tracks_overlap_settings():
+    """ParallelWrapper.attribution_report keys on the overlap/sharding
+    schedule: overlap on vs off (and different bucket sizes) are
+    differently-scheduled programs and must cache separately."""
+    from deeplearning4j_tpu.parallel.data_parallel import ParallelWrapper
+    net = _net(seed=4)
+    pw = ParallelWrapper(net, shard_update=True)
+    r_off = pw.attribution_report(8, measured_s=1e-3, peaks=PEAKS)
+    pw.set_overlap(True, bucket_mb=2)
+    r_on = pw.attribution_report(8, measured_s=1e-3, peaks=PEAKS)
+    assert r_off["key"] != r_on["key"]
+    assert "ov=0" in r_off["key"] and "ov=1" in r_on["key"]
+    assert "mb=2" in r_on["key"]
+    assert r_on["kind"] == "parallel_step" and r_on["overlap"] is True
+    _assert_partition(r_on)
+    # both survive in the cache under their own keys
+    assert attr.cached_report(r_off["key"]) is not None
+    assert attr.cached_report(r_on["key"]) is not None
+
+
+def test_wrapper_report_self_measures_real_sharded_steps():
+    from deeplearning4j_tpu.parallel.data_parallel import ParallelWrapper
+    net = _net(seed=6)
+    pw = ParallelWrapper(net)
+    rep = pw.attribution_report(8, steps=2, peaks=PEAKS)
+    assert rep["measured"] and rep["measured_s"] > 0
+    _assert_partition(rep)
+    # the measurement must not have perturbed the model (donated copies)
+    assert net.params["0"]["W"].shape == (32, 64)

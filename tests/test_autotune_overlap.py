@@ -676,3 +676,108 @@ def test_mixed_accum_graph_engine_hoist(rng, monkeypatch):
 
     walk(jaxpr.jaxpr, False)
     assert bad == []
+
+
+# ---------------------------------------------------------------------------
+# bf16 audit fix (ISSUE 14 satellite): SameDiff other-vals cast hoist —
+# the r12 scan hoist's sibling. Non-trainable values (imported CONSTs,
+# frozen weights) are cast to the compute dtype ONCE at fit entry
+# instead of inside every compiled step.
+# ---------------------------------------------------------------------------
+
+def _frozen_const_sd(seed=0):
+    """A SameDiff graph with a NON-trainable float tensor (a frozen
+    weight, the transfer-learning shape) feeding the trainable head."""
+    from deeplearning4j_tpu.autodiff import SameDiff
+    from deeplearning4j_tpu.nn.updaters import Adam
+
+    rng = np.random.default_rng(seed)
+    sd = SameDiff()
+    x = sd.placeholder("x")
+    wf = sd.constant("w_frozen",
+                     rng.normal(size=(16, 16)).astype(np.float32))
+    h = sd.call("linalg.mmul", x, wf, name="h0")
+    h = sd.call("act.relu", h, name="h0r")
+    w = sd.var("w", rng.normal(size=(16, 4)).astype(np.float32))
+    logits = sd.call("linalg.mmul", h, w, name="logits")
+    labels = sd.placeholder("labels")
+    sd.set_loss(sd.call("loss.softmax_ce_logits", labels, logits))
+    sd.set_updater(Adam(learning_rate=1e-3))
+    sd.set_dtype("BFLOAT16")
+    return sd
+
+
+def _const_shaped_bf16_converts(sd, ov):
+    """convert_element_type f32->bf16 eqns anywhere in the fit step whose
+    shape matches a non-trainable tensor — the per-step cast the hoist
+    removes."""
+    from deeplearning4j_tpu.autodiff.samediff import VARIABLE
+    tv = {n: sd._values[n] for n, v in sd._vars.items()
+          if v.kind == VARIABLE}
+    feeds = {"x": jnp.zeros((4, 16), jnp.float32),
+             "labels": jnp.zeros((4, 4), jnp.float32)}
+    _spec, step = sd._make_fit_step()
+    opt = sd.updater.init_state(tv)
+    jaxpr = jax.make_jaxpr(step.__wrapped__)(
+        tv, opt, ov, jnp.int32(0), feeds)
+    const_shapes = {(16, 16)}  # w_frozen; disjoint from every tv shape
+    found = []
+
+    def walk(jx):
+        for eqn in jx.eqns:
+            if eqn.primitive.name == "convert_element_type" and \
+                    str(eqn.outvars[0].aval.dtype) == "bfloat16" and \
+                    str(eqn.invars[0].aval.dtype) == "float32" and \
+                    tuple(eqn.outvars[0].aval.shape) in const_shapes:
+                found.append(tuple(eqn.outvars[0].aval.shape))
+            for v in eqn.params.values():
+                if getattr(v, "jaxpr", None) is not None:
+                    walk(v.jaxpr)
+    walk(jaxpr.jaxpr)
+    return found
+
+
+def test_samediff_other_vals_cast_hoisted_out_of_step(rng):
+    """With the hoist (pre-cast other_vals, the fit() path) the compiled
+    step contains ZERO const-shaped f32->bf16 converts; handing raw f32
+    other_vals still computes correctly through the in-step safety cast
+    (exactly one convert) — the backward-compat contract."""
+    from deeplearning4j_tpu.autodiff.samediff import VARIABLE
+    sd = _frozen_const_sd()
+    tv_names = {n for n, v in sd._vars.items() if v.kind == VARIABLE}
+    ov_raw = {n: v for n, v in sd._values.items() if n not in tv_names}
+    ov_cast = sd._cast_other_vals(ov_raw)
+    assert str(ov_cast["w_frozen"].dtype) == "bfloat16"
+    assert str(sd._values["w_frozen"].dtype) == "float32"  # master intact
+    assert _const_shaped_bf16_converts(sd, ov_cast) == []
+    assert len(_const_shaped_bf16_converts(sd, ov_raw)) >= 1
+
+
+def test_samediff_other_vals_hoist_bit_equal(rng, monkeypatch):
+    """fit() with the hoist is BIT-equal in every trained value to the
+    pre-fix per-step-cast program (forced by disabling the hoist): the
+    cast moved, the math did not."""
+    from deeplearning4j_tpu.autodiff.samediff import SameDiff
+
+    feeds = {"x": rng.normal(size=(4, 16)).astype(np.float32),
+             "labels": np.eye(4, dtype=np.float32)[
+                 np.random.default_rng(1).integers(0, 4, 4)]}
+    h = _frozen_const_sd(seed=3)
+    h.fit(dict(feeds), epochs=3)
+    u = _frozen_const_sd(seed=3)
+    monkeypatch.setattr(SameDiff, "_cast_other_vals",
+                        lambda self, ov: ov)  # the pre-fix program
+    u.fit(dict(feeds), epochs=3)
+    monkeypatch.undo()
+    assert h.variables() == u.variables()
+    for n in h.variables():
+        np.testing.assert_array_equal(np.asarray(h._values[n]),
+                                      np.asarray(u._values[n]))
+
+
+def test_samediff_cast_hoist_identity_for_f32_policy():
+    sd = _frozen_const_sd()
+    sd.set_dtype("FLOAT")
+    ov = {"w_frozen": sd._values["w_frozen"]}
+    out = sd._cast_other_vals(ov)
+    assert out["w_frozen"] is ov["w_frozen"]  # no copy, no cast

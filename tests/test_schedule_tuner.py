@@ -1,0 +1,396 @@
+"""ISSUE 14: the joint schedule tuner (``runtime/schedule.py``).
+
+Acceptance surface:
+- oracle-pruned candidates are NEVER timed (no OOM probing — the AOT
+  byte oracle gates every execution);
+- tuned-vs-default BIT-equivalence of params AND updater state (the
+  tuner must not change math);
+- cache JSON round-trip, corrupt-file tolerance, and the
+  upgrade-never-pin merge rules (swept beats default, never the reverse);
+- zero post-warmup compile events after ``tune_schedule()`` (delta of
+  the ``compile.events`` counter);
+- CPU-never-sweeps guard + the ``DL4J_TPU_SCHEDULE_TUNE=off`` env pin,
+  mirroring the flash tuner's contract;
+- attribution-seeded candidate ordering (memory-bound -> coarser remat
+  first, host-bound -> bigger batch first);
+- cache keys separate different model topologies (fingerprint) and the
+  apply seams route through set_workspace_mode/set_overlap/
+  set_accum_steps.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax
+
+from deeplearning4j_tpu.nn import memory as memmod
+from deeplearning4j_tpu.nn.config import InputType, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.layers.core import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.model import MultiLayerNetwork
+from deeplearning4j_tpu.nn.updaters import Adam, Sgd
+from deeplearning4j_tpu.runtime import attribution as attr
+from deeplearning4j_tpu.runtime import schedule as sched
+from deeplearning4j_tpu.runtime import telemetry as tel
+
+
+@pytest.fixture(autouse=True)
+def clean_schedule(monkeypatch):
+    """Empty schedule cache + zeroed counters per test; env cache path
+    cleared so a developer's DL4J_TPU_SCHEDULE_CACHE can't leak in."""
+    monkeypatch.delenv("DL4J_TPU_SCHEDULE_CACHE", raising=False)
+    monkeypatch.delenv("DL4J_TPU_SCHEDULE_TUNE", raising=False)
+    sched.reset()
+    sched.reset_counters()
+    old = sched.set_mode(None)
+    yield
+    sched.set_mode(old)
+    sched.reset()
+
+
+def _net(seed=0, feat=8, hidden=16, updater=None):
+    conf = (NeuralNetConfiguration.builder().seed(seed)
+            .updater(updater or Adam(learning_rate=1e-3))
+            .input_type(InputType.feed_forward(feat))
+            .list(DenseLayer(n_out=hidden, activation="relu"),
+                  OutputLayer(n_out=4))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+SMALL = dict(policies=("none", "dots_saveable"), accum_candidates=(1,),
+             batch_candidates=(4,), repeats=1)
+
+
+# ---------------------------------------------------------------- oracle
+def test_oracle_prunes_over_limit_without_timing(monkeypatch):
+    """Candidates whose AOT peak exceeds the bytes limit are pruned
+    BEFORE any execution: the timed set and the pruned set are disjoint,
+    every pruned entry names a peak above the limit, and the tuner's
+    runner is never even constructed for a pruned config — the
+    'never OOM-probe' contract."""
+    if not memmod.memory_analysis_supported():
+        pytest.skip("PJRT build exposes no memory_analysis")
+    net = _net()
+    base_peak = net.memory_report(4)["peak_bytes"]
+    timed = []
+    orig = sched.ScheduleTuner._runner
+
+    def spy(self, cfg):
+        timed.append(json.dumps(cfg, sort_keys=True))
+        return orig(self, cfg)
+    monkeypatch.setattr(sched.ScheduleTuner, "_runner", spy)
+    entry = sched.tune_schedule(
+        net, 4, apply=False, force=True,
+        bytes_limit=int(base_peak * 1.2),
+        policies=("none",), accum_candidates=(1,),
+        batch_candidates=(4, 512), repeats=1)
+    assert entry["source"] == "sweep"
+    pruned = entry["pruned"]
+    assert pruned, "the 512-batch candidate should exceed 1.2x base peak"
+    for p in pruned:
+        assert p["peak_bytes"] is None or \
+            p["peak_bytes"] > entry["bytes_limit"]
+        assert json.dumps(p["config"], sort_keys=True) not in timed
+    timed_cfgs = {json.dumps(t["config"], sort_keys=True)
+                  for t in entry["candidates"]}
+    pruned_cfgs = {json.dumps(p["config"], sort_keys=True) for p in pruned}
+    assert not (timed_cfgs & pruned_cfgs)
+    assert sched.counters()["pruned"] == len(pruned)
+
+
+def test_incumbent_is_always_timed_and_ratio_le_one():
+    """The incumbent config is always a candidate, so the winner's
+    tuned-vs-default ratio is <= 1.0 by construction."""
+    net = _net()
+    entry = sched.tune_schedule(net, 4, apply=False, force=True, **SMALL)
+    assert entry["source"] == "sweep"
+    tags = [json.dumps(c["config"], sort_keys=True)
+            for c in entry["candidates"]]
+    assert json.dumps(entry["default_config"], sort_keys=True) in tags
+    assert entry["ratio_vs_default"] <= 1.0
+    assert entry["us"] <= entry["default_us"]
+
+
+# --------------------------------------------------------- bit equality
+def test_tuned_vs_default_bit_equivalence():
+    """Training after tune_schedule() (applied remat knob) is BIT-equal
+    in params AND updater state to the default schedule on the same
+    batches — the tuner must not change math."""
+    from deeplearning4j_tpu.data.dataset import DataSet
+    rng = np.random.default_rng(5)
+    x = rng.normal(size=(8, 8)).astype(np.float32)
+    y = np.eye(4, dtype=np.float32)[rng.integers(0, 4, 8)]
+
+    tuned = _net(seed=3)
+    entry = tuned.tune_schedule(4, force=True, **SMALL)
+    default = _net(seed=3)
+    assert np.array_equal(np.asarray(tuned.params["0"]["W"]),
+                          np.asarray(default.params["0"]["W"]))
+    tuned.fit(DataSet(x, y), epochs=3)
+    default.fit(DataSet(x, y), epochs=3)
+    for a, b in zip(jax.tree.leaves(tuned.params),
+                    jax.tree.leaves(default.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    for a, b in zip(jax.tree.leaves(tuned.updater_state),
+                    jax.tree.leaves(default.updater_state)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # and the tuned model really carries the winner's policy
+    assert str(getattr(tuned.conf, "workspace_mode", "none")) == \
+        entry["config"]["workspace_mode"]
+
+
+# ---------------------------------------------------------------- cache
+def test_cache_round_trip_and_hit(tmp_path, monkeypatch):
+    path = str(tmp_path / "sched.json")
+    monkeypatch.setenv("DL4J_TPU_SCHEDULE_CACHE", path)
+    net = _net()
+    e1 = sched.tune_schedule(net, 4, apply=False, force=True, **SMALL)
+    assert os.path.exists(path), "auto-save after sweep"
+    sched.reset()
+    assert sched.load(path) >= 1
+    e2 = sched.tune_schedule(net, 4, apply=False, force=True)
+    assert sched.counters()["hit"] == 1
+    assert e2["config"] == e1["config"]
+    assert e2["source"] == "sweep"  # swept entries are terminal
+
+
+def test_cache_corrupt_file_never_blocks(tmp_path, monkeypatch):
+    path = str(tmp_path / "sched.json")
+    with open(path, "w") as f:
+        f.write("{not json")
+    monkeypatch.setenv("DL4J_TPU_SCHEDULE_CACHE", path)
+    sched.reset()
+    sched._env_cache_loaded = False  # force the lazy env-load path
+    net = _net()
+    entry = sched.tune_schedule(net, 4, apply=False)  # must not raise
+    assert entry["source"] in ("default", "sweep")
+    # garbage ENTRIES (parseable json, invalid config) are dropped too —
+    # incl. non-dict entries and a config missing batch_size, which
+    # apply_entry would KeyError on (review-round regressions)
+    with open(path, "w") as f:
+        json.dump({"version": 1, "entries": [
+            {"key": ["a", "b", "c"],
+             "config": {"workspace_mode": "not_a_policy",
+                        "accum_steps": 1, "batch_size": 4},
+             "source": "sweep"},
+            {"key": ["a", "b"], "config": {}, "source": "sweep"},
+            "not_even_a_dict",
+            {"key": ["a", "b", "c"],
+             "config": {"workspace_mode": "dots_saveable",
+                        "accum_steps": 1},  # no batch_size
+             "source": "sweep"},
+        ]}, f)
+    assert sched.load(path) == 0
+    # and the lazy env-load path survives the same file (must not raise
+    # out of tune_schedule)
+    sched.reset()
+    sched._env_cache_loaded = False
+    entry = sched.tune_schedule(net, 4, apply=True)
+    assert entry["source"] in ("default", "sweep")
+
+
+def test_cache_merge_rules_upgrade_never_pin(tmp_path):
+    """A swept disk entry beats an in-process default; a disk default
+    never demotes an in-process sweep — the flash cache's rules."""
+    net = _net()
+    key = sched.cache_key(net)
+    cfg = sched.incumbent_config(net, 4)
+    swept = {"key": list(key),
+             "config": dict(cfg, workspace_mode="dots_saveable"),
+             "source": "sweep", "us": 10.0}
+    default = {"key": list(key), "config": dict(cfg), "source": "default"}
+    p_swept = str(tmp_path / "swept.json")
+    p_default = str(tmp_path / "default.json")
+    with open(p_swept, "w") as f:
+        json.dump({"version": 1, "entries": [swept]}, f)
+    with open(p_default, "w") as f:
+        json.dump({"version": 1, "entries": [default]}, f)
+
+    # in-process default, disk sweep -> upgraded
+    sched.tune_schedule(net, 4, apply=False)  # seeds default (CPU)
+    assert sched.load(p_swept) == 1
+    assert sched.lookup(net)["source"] == "sweep"
+    # in-process sweep, disk default -> NOT demoted
+    assert sched.load(p_default) == 0
+    assert sched.lookup(net)["source"] == "sweep"
+    # a swept cache hit is terminal even under force
+    entry = sched.tune_schedule(net, 4, apply=False, force=True)
+    assert entry["config"]["workspace_mode"] == "dots_saveable"
+    assert sched.counters()["sweep"] == 0  # never re-swept
+
+
+def test_cache_key_separates_topologies():
+    """Two models of the same class with different parameter trees get
+    different keys (the fingerprint half of (fingerprint, topology,
+    dtype))."""
+    a, b = _net(hidden=16), _net(hidden=32)
+    assert sched.cache_key(a) != sched.cache_key(b)
+    assert sched.cache_key(a) == sched.cache_key(_net(hidden=16))
+
+
+# ------------------------------------------------------ compile accounting
+def test_zero_post_warmup_compiles_after_tune():
+    """After tune_schedule() applies the winner: ONE attributed retrace
+    at the next build, then zero steady-state compile events (counter
+    delta — the bounded event log can saturate)."""
+    from deeplearning4j_tpu.data.dataset import DataSet
+    rng = np.random.default_rng(2)
+    x = rng.normal(size=(8, 8)).astype(np.float32)
+    y = np.eye(4, dtype=np.float32)[rng.integers(0, 4, 8)]
+    net = _net(seed=1)
+    net.fit(DataSet(x, y), epochs=1)  # steady state before tuning
+    # apply a config that CHANGES the policy, deterministically
+    sched.apply_entry(net, {"config": dict(
+        sched.incumbent_config(net, 4), workspace_mode="dots_saveable")})
+    c = tel.registry.get("compile.events")
+    ev0 = int(c.total())
+    net.fit(DataSet(x, y), epochs=1)  # the ONE attributed retrace
+    assert int(c.total()) - ev0 == 1
+    events = [e for e in tel.compile_events()
+              if e.get("cause") == "workspace_mode"]
+    assert events, "the apply retrace must be attributed"
+    ev1 = int(c.total())
+    net.fit(DataSet(x, y), epochs=2)  # steady state: zero compiles
+    assert int(c.total()) - ev1 == 0
+
+
+def test_sweep_probes_are_attributed_schedule_tune():
+    net = _net()
+    before = [e for e in tel.compile_events()
+              if e.get("cause") == "schedule_tune"]
+    sched.tune_schedule(net, 4, apply=False, force=True, **SMALL)
+    after = [e for e in tel.compile_events()
+             if e.get("cause") == "schedule_tune"]
+    assert len(after) > len(before), \
+        "every oracle/timing probe must record cause=schedule_tune"
+
+
+# ------------------------------------------------------------ guard rails
+def test_cpu_never_sweeps_without_force():
+    """mode auto on CPU: tune_schedule seeds a default entry with ZERO
+    probe compiles and zero timed candidates — the tier-1 guard."""
+    net = _net()
+    c = tel.registry.get("compile.events")
+    ev0 = int(c.total())
+    entry = sched.tune_schedule(net, 4, apply=False)
+    assert jax.default_backend() != "tpu"
+    assert entry["source"] == "default"
+    assert entry["config"] == sched.incumbent_config(net, 4)
+    assert sched.counters()["sweep"] == 0
+    assert sched.counters()["candidate"] == 0
+    assert int(c.total()) - ev0 == 0  # not even an oracle lower
+
+
+def test_env_off_pin_beats_force(monkeypatch):
+    """DL4J_TPU_SCHEDULE_TUNE=off: cache hits and default seeds only —
+    zero probe compiles even under force=True (the operator kill
+    switch, read per call so no restart is needed)."""
+    monkeypatch.setenv("DL4J_TPU_SCHEDULE_TUNE", "off")
+    assert sched.mode() == "off"
+    net = _net()
+    c = tel.registry.get("compile.events")
+    ev0 = int(c.total())
+    entry = sched.tune_schedule(net, 4, apply=False, force=True)
+    assert entry["source"] == "default"
+    assert sched.counters()["sweep"] == 0
+    assert int(c.total()) - ev0 == 0
+    monkeypatch.delenv("DL4J_TPU_SCHEDULE_TUNE")
+    assert sched.mode() == "auto"
+    with pytest.raises(ValueError):
+        sched.set_mode("sometimes")
+
+
+# ------------------------------------------------------------ seeding
+def _seed_report(net, batch, fractions):
+    key = attr.train_step_key(net, batch, 1, None)
+    attr._remember(key, {"fractions": fractions, "measured": True})
+
+
+def test_attribution_seed_memory_bound_orders_coarser_remat_first():
+    net = _net()
+    _seed_report(net, 4, {"compute": 0.1, "memory": 0.7, "host": 0.1,
+                          "other": 0.1})
+    t = sched.ScheduleTuner(net, 4, policies=("none", "dots_saveable",
+                                              "every_2"),
+                            accum_candidates=(1,), batch_candidates=(4,))
+    ordered = t.ordered_candidates()
+    assert t.seed_order == "memory"
+    assert ordered[0] == t.incumbent  # the ratio denominator stays first
+    # "none" IS the incumbent (deduped to the front); the rest runs
+    # coarsest-remat-first
+    rest_policies = [c["workspace_mode"] for c in ordered[1:]]
+    assert rest_policies == ["every_2", "dots_saveable"]
+
+
+def test_attribution_seed_host_bound_orders_bigger_batch_first():
+    net = _net()
+    _seed_report(net, 4, {"compute": 0.2, "memory": 0.1, "host": 0.6,
+                          "other": 0.1})
+    t = sched.ScheduleTuner(net, 4, policies=("none",),
+                            accum_candidates=(1,),
+                            batch_candidates=(4, 8, 16))
+    ordered = t.ordered_candidates()
+    assert t.seed_order == "host"
+    assert [c["batch_size"] for c in ordered[1:]][0] == 16
+
+
+def test_max_candidates_budget_truncates_but_keeps_incumbent():
+    net = _net()
+    t = sched.ScheduleTuner(net, 4, policies=("none", "dots_saveable",
+                                              "every_2"),
+                            accum_candidates=(1, 2),
+                            batch_candidates=(4, 8), max_candidates=3)
+    ordered = t.ordered_candidates()
+    assert len(ordered) == 3
+    assert ordered[0] == t.incumbent
+
+
+# ------------------------------------------------------------- apply seams
+def test_apply_entry_routes_through_wrapper_seams():
+    from deeplearning4j_tpu.parallel.data_parallel import ParallelWrapper
+    net = _net(updater=Sgd(learning_rate=0.1))
+    pw = ParallelWrapper(net, shard_update=True, overlap_grads=True,
+                         overlap_bucket_mb=4)
+    entry = {"config": {"workspace_mode": "dots_saveable",
+                        "accum_steps": 2, "batch_size": 16,
+                        "overlap": True, "overlap_bucket_mb": 2.0}}
+    changed = sched.apply_entry(pw, entry)
+    assert set(changed) == {"workspace_mode", "accum_steps", "overlap"}
+    assert pw.accum_steps == 2
+    assert pw.overlap_bucket_bytes == 2 * (1 << 20)
+    assert str(net.conf.workspace_mode) == "dots_saveable"
+    # idempotent: re-applying the same entry changes nothing
+    assert sched.apply_entry(pw, entry) == []
+    with pytest.raises(ValueError):
+        pw.set_accum_steps(0)
+
+
+def test_wrapper_sweep_times_bucket_candidates():
+    from deeplearning4j_tpu.parallel.data_parallel import ParallelWrapper
+    net = _net(updater=Sgd(learning_rate=0.1))
+    pw = ParallelWrapper(net, shard_update=True, overlap_grads=True)
+    entry = pw.tune_schedule(8, force=True,
+                             policies=("none",), accum_candidates=(1,),
+                             batch_candidates=(8,),
+                             bucket_candidates=(2.0, 8.0), repeats=1)
+    assert entry["source"] == "sweep"
+    buckets = {c["config"]["overlap_bucket_mb"]
+               for c in entry["candidates"]}
+    assert {2.0, 8.0} <= buckets
+    assert entry["ratio_vs_default"] <= 1.0
+    # the tuned_ratio gauge was written by the sweep
+    assert tel.registry.get("schedule.tuned_ratio").value() <= 1.0
+
+
+def test_dry_run_machinery(tmp_path, monkeypatch):
+    """The Makefile `tune` target's dry-run: cache file written on a CPU
+    default-seed pass and re-loaded into a hit."""
+    path = str(tmp_path / "dry.json")
+    monkeypatch.setenv("DL4J_TPU_SCHEDULE_CACHE", path)
+    out = sched._dry_run()
+    assert out["cache_path"] == path
+    assert out["entries"] >= 1
+    assert out["counters"]["hit"] >= 1
