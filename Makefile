@@ -3,11 +3,21 @@
 
 PY ?= python
 
-.PHONY: test test-fast multihost-sim multihost-smoke bench bench-generative \
-	trace-demo tune
+.PHONY: test test-fast lint multihost-sim multihost-smoke bench \
+	bench-generative trace-demo tune
 
-# fast (tier-1) suite — what CI gates on
-test-fast:
+# ISSUE 15: JAX-aware static analysis (runtime/staticcheck.py) — the
+# repo's hand-enforced invariants as machine-checked rules. Exits
+# non-zero on any finding that is neither suppressed inline (with a
+# reason) nor grandfathered in staticcheck_baseline.json (with a
+# reason). `--format json` for the full schema; `--list-rules` to see
+# the active rule set.
+lint:
+	env JAX_PLATFORMS=cpu $(PY) -m deeplearning4j_tpu.runtime.staticcheck
+
+# fast (tier-1) suite — what CI gates on (lint runs first: a lint
+# finding fails the build before the slower pytest pass starts)
+test-fast: lint
 	env JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow' \
 		-p no:cacheprovider
 

@@ -92,6 +92,22 @@ def _emit(lines):
     try:
         from deeplearning4j_tpu.ops import autotune as _autotune
         from deeplearning4j_tpu.runtime import telemetry as _telemetry
+        # ISSUE 15 satellite: run the lint and embed its state — a bench
+        # artifact records whether the tree it measured was clean, and
+        # the staticcheck.findings{rule=,state=} counter lands in the
+        # registry snapshot below. Import INSIDE the inner try: a broken
+        # staticcheck must degrade this block alone, never the registry/
+        # autotune snapshots that predate it
+        try:
+            from deeplearning4j_tpu.runtime import staticcheck as \
+                _staticcheck
+            _screp = _staticcheck.run()
+            _sc_block = {"open": [f.as_dict() for f in _screp.findings],
+                         "baselined": len(_screp.baselined),
+                         "rules": _screp.rules,
+                         "counter": _staticcheck.findings_snapshot()}
+        except Exception as e:
+            _sc_block = {"error": str(e)}
         artifact = order + [{
             "metric": "telemetry_registry_snapshot",
             "snapshot": _telemetry.snapshot(compact=True),
@@ -100,6 +116,7 @@ def _emit(lines):
             # metric is part of the record — a speedup claim without the
             # blocks that produced it is not reproducible
             "autotune_cache": _autotune.cache_snapshot(),
+            "staticcheck": _sc_block,
         }]
     except Exception:
         artifact = order

@@ -914,7 +914,10 @@ class SameDiff(_SentinelCounterMixin):
             "device": _memory.device_memory_stats(),
         }
         from ..runtime import sentinel as _sent
-        # sentinel counters included: accounts the REAL step fit() runs
+        from ..runtime import telemetry as _tel
+        # sentinel counters included: accounts the REAL step fit() runs;
+        # the accounting compile is attributed like every other probe
+        _tel.record_compile("samediff.fit_step", "probe", batch=batch)
         compiled = step.lower(tv_avals, opt_avals, ov_avals,
                               jax.ShapeDtypeStruct((), jnp.int32),
                               feeds_avals, _sent.counter_avals()).compile()

@@ -221,6 +221,22 @@ class CompiledCacheMixin(SentinelCounterMixin):
         return _sched.tune_schedule(self, batch_size, apply=apply,
                                     force=force, **kwargs)
 
+    def audit_compiled(self, batch_size: int, accum_steps: int = 1,
+                       seq_len=None, rules=None):
+        """Tier B compiled-program audit (ISSUE 15,
+        ``runtime/staticcheck.py``): trace/lower THIS model's REAL fused
+        train step at ``batch_size`` (nothing executes) and check the
+        program-shape invariants the r12/r18 reviews enforced by hand —
+        no param-shaped 16-bit cast inside scan bodies, no host
+        callbacks, donation actually applied in the lowered program, and
+        no f32 matmuls under a 16-bit compute policy. Returns a list of
+        ``staticcheck.Finding`` — empty means the compiled program is
+        clean; tests and the bench assert ``audit_compiled(...) == []``
+        instead of copy-pasting jaxpr greps."""
+        from ..runtime import staticcheck as _sc
+        return _sc.audit_model(self, batch_size, accum_steps=accum_steps,
+                               seq_len=seq_len, rules=rules)
+
     def inference_engine(self, **kwargs):
         """The model's serving engine (``serving.engine.InferenceEngine``),
         created lazily; ``output()`` routes through it. Pass kwargs (e.g.

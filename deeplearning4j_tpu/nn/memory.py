@@ -58,6 +58,8 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 import jax
 import numpy as np
 
+from ..runtime import telemetry as _tel
+
 # ---------------------------------------------------------------- policies
 
 
@@ -177,6 +179,9 @@ def memory_analysis_supported() -> bool:
     if _MA_SUPPORTED is None:
         try:
             import jax.numpy as jnp
+            # once-per-process trivial compile; attributed so even the
+            # capability probe is visible to the retrace tracker
+            _tel.record_compile("memory.probe", "probe")
             c = jax.jit(lambda x: x + 1).lower(
                 jax.ShapeDtypeStruct((8,), jnp.float32)).compile()
             ma = c.memory_analysis()
@@ -288,9 +293,13 @@ def _batch_avals(model, batch_size: int, seq_len: Optional[int] = None):
 
 
 def _lower_train_step(model, batch_size: int, accum_steps: int = 1,
-                      seq_len: Optional[int] = None):
+                      seq_len: Optional[int] = None,
+                      cause: Optional[str] = "probe"):
     """AOT lower+compile of the engine's REAL fused train step at the
-    given batch size (nothing executes, nothing is allocated on device)."""
+    given batch size (nothing executes, nothing is allocated on device).
+    The compile is reported to the retrace tracker as ``cause`` (default
+    ``probe``); a caller that records its own attributed event (the
+    schedule tuner's ``schedule_tune``) passes ``cause=None``."""
     x, y = _batch_avals(model, batch_size, seq_len)
     params_avals = jax.eval_shape(lambda: model.params)
     state_avals = jax.eval_shape(lambda: model.state)
@@ -301,6 +310,9 @@ def _lower_train_step(model, batch_size: int, accum_steps: int = 1,
     lm = (None,) * len(y) if isinstance(y, tuple) else None
     step = model._build_train_step(accum_steps)
     from ..runtime import sentinel as _sent
+    if cause is not None:
+        _tel.record_compile("train.step", cause,
+                            model=type(model).__name__, batch=batch_size)
     # sentinel counters included: this accounts the REAL fused step the
     # fit loop runs (divergence sentinel and all)
     return step.lower(params_avals, opt_avals, state_avals,

@@ -466,15 +466,22 @@ class ParallelWrapper:
 
         return step_fn, shard_args
 
-    def _lower_step(self, batch_size: int, seq_len=None, step_fn=None):
+    def _lower_step(self, batch_size: int, seq_len=None, step_fn=None,
+                    cause="probe"):
         """AOT lower+compile of a sharded train step at the GLOBAL
         ``batch_size`` (nothing executes). ``step_fn=None`` uses (and
         caches) THIS wrapper's step; an explicit ``step_fn`` (the
         schedule tuner's candidate builds) is lowered without touching
-        the wrapper's cache."""
+        the wrapper's cache. The compile is reported to the retrace
+        tracker as ``cause`` (``None`` = the caller already attributed
+        it, e.g. the tuner's ``schedule_tune``)."""
         from ..nn import memory as _memory
         from ..runtime import sentinel as _sent
+        from ..runtime import telemetry as _tel
         m = self.model
+        if cause is not None:
+            _tel.record_compile("parallel.step", cause,
+                                model=type(m).__name__, batch=batch_size)
         if not m.params:
             m.init()
         if step_fn is None:
@@ -579,9 +586,9 @@ class ParallelWrapper:
         if self._step is None:
             self._step = self._build()
         step_fn, shard_args = self._step
+        # _lower_step records the probe compile itself (parallel.step/
+        # probe) — attributing here too would double-count the event
         compiled = self._lower_step(batch_size, seq_len)
-        _tel.record_compile("parallel.step", "probe",
-                            model=type(m).__name__, batch=batch_size)
         if measured_s is None:
             durs = []
             for i in range(max(1, int(steps)) + 1):

@@ -303,6 +303,7 @@ def attribute_jitted(fn, args, measured_s: float,
     (bench glue for steps measured elsewhere, e.g. the SameDiff BERT fit
     step): AOT lower+compile for ``cost_analysis`` only — nothing
     executes."""
+    _tel.record_compile("attribution.jitted", "probe")
     lowered = fn.lower(*args)
     return attribute_compiled(lowered.compile(), measured_s,
                               host_s=host_s, peaks=peaks, key=key)
@@ -354,10 +355,10 @@ def attribution_report(model, batch_size: int, steps: int = 3,
     from ..nn import memory as _memory
     if not model.params and not model.state:
         model.init()
+    # _lower_train_step records the probe compile itself (train.step/
+    # probe) — attributing here too would double-count the event
     compiled = _memory._lower_train_step(model, batch_size, accum_steps,
                                          seq_len)
-    _tel.record_compile("train.step", "probe",
-                        model=type(model).__name__, batch=batch_size)
     host_s = None
     if measured_s is None:
         durs = []

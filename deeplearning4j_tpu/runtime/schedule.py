@@ -494,11 +494,14 @@ class ScheduleTuner:
             if _is_wrapper(self.target):
                 step_fn, _ = self.target._build()
                 compiled = self.target._lower_step(
-                    cfg["batch_size"], self.seq_len, step_fn=step_fn)
+                    cfg["batch_size"], self.seq_len, step_fn=step_fn,
+                    cause=None)  # already attributed schedule_tune above
             else:
+                # cause=None: the oracle already attributed this compile
+                # as schedule_tune above — don't double-count it as probe
                 compiled = _memory._lower_train_step(
                     self.model, cfg["batch_size"], cfg["accum_steps"],
-                    self.seq_len)
+                    self.seq_len, cause=None)
         cm = _memory.compiled_memory(compiled)
         return (cm.get("peak_bytes") if cm else None), compiled
 

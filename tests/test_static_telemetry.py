@@ -1,111 +1,22 @@
-"""ISSUE 13 satellite: grep-the-AST static checks over the telemetry
-surface.
+"""ISSUE 13 satellite, migrated into the staticcheck framework (ISSUE
+15): the grep-the-AST collectors now live in
+``deeplearning4j_tpu/runtime/staticcheck.py`` (where the
+``compile-cause-registered`` rule enforces the same invariant as a lint
+gate), and this file keeps its public surface as thin wrappers so the zz
+coverage floor's imports keep working unchanged.
 
-Two invariants that grep can hold but runtime tests cannot:
-
-- every ``record_compile(site, cause)`` call in the tree whose cause is a
-  string LITERAL uses a cause registered in ``COMPILE_CAUSES`` — a typo'd
-  cause would silently fragment the retrace dashboards;
-- every registry metric name written as a literal in product source is
-  collectable (the zz coverage floor cross-checks the collected set
-  against the registry at end-of-suite — a metric named in source that no
-  test ever declares/writes is the floor's blind spot).
-
-The collectors live here so ``tests/test_zz_coverage_floor.py`` can
-import them (same pattern as ``golden_harness``).
+The collectors run over staticcheck's mtime-cached module index, so the
+lint gate (tests/test_staticcheck.py), these wrappers and the zz floor's
+metric-name cross-check share ONE AST walk per suite run.
 """
 
-import ast
-import os
-
-import deeplearning4j_tpu
+from deeplearning4j_tpu.runtime import staticcheck
+from deeplearning4j_tpu.runtime.staticcheck import (   # noqa: F401 — the
+    collect_invalidate_causes,                         # zz floor imports
+    collect_metric_names,                              # these names from
+    collect_record_compile_causes,                     # this module
+)
 from deeplearning4j_tpu.runtime.telemetry import COMPILE_CAUSES
-
-PKG_DIR = os.path.dirname(deeplearning4j_tpu.__file__)
-
-
-def _package_files():
-    for root, _dirs, files in os.walk(PKG_DIR):
-        for fn in files:
-            if fn.endswith(".py"):
-                yield os.path.join(root, fn)
-
-
-def _call_name(node: ast.Call):
-    if isinstance(node.func, ast.Attribute):
-        return node.func.attr
-    if isinstance(node.func, ast.Name):
-        return node.func.id
-    return None
-
-
-def collect_metric_names():
-    """{relative_path: sorted([literal metric names])} for every literal
-    first argument of a ``counter``/``gauge``/``histogram`` call in the
-    package. Dotted names only — the registry's ``subsystem.name``
-    convention — so locals/test helpers don't false-positive."""
-    out = {}
-    for path in _package_files():
-        with open(path, "r", encoding="utf-8") as f:
-            tree = ast.parse(f.read(), path)
-        names = set()
-        for node in ast.walk(tree):
-            if not isinstance(node, ast.Call):
-                continue
-            if _call_name(node) not in ("counter", "gauge", "histogram"):
-                continue
-            if node.args and isinstance(node.args[0], ast.Constant) \
-                    and isinstance(node.args[0].value, str) \
-                    and "." in node.args[0].value:
-                names.add(node.args[0].value)
-        if names:
-            out[os.path.relpath(path, os.path.dirname(PKG_DIR))] = \
-                sorted(names)
-    return out
-
-
-def collect_record_compile_causes():
-    """[(relative_path, lineno, cause_literal_or_None)] for every
-    ``record_compile(...)`` call site in the package (None = the cause is
-    computed, e.g. the caches' ``_consume_retrace_cause`` path)."""
-    sites = []
-    for path in _package_files():
-        with open(path, "r", encoding="utf-8") as f:
-            tree = ast.parse(f.read(), path)
-        rel = os.path.relpath(path, os.path.dirname(PKG_DIR))
-        for node in ast.walk(tree):
-            if not isinstance(node, ast.Call) or \
-                    _call_name(node) != "record_compile":
-                continue
-            cause = None
-            if len(node.args) >= 2 and isinstance(node.args[1],
-                                                  ast.Constant):
-                cause = node.args[1].value
-            else:
-                for kw in node.keywords:
-                    if kw.arg == "cause" and \
-                            isinstance(kw.value, ast.Constant):
-                        cause = kw.value.value
-            sites.append((rel, node.lineno, cause))
-    return sites
-
-
-def collect_invalidate_causes():
-    """Literal ``cause=`` kwargs on ``invalidate``/``_invalidate_compiled``
-    calls — these flow verbatim into record_compile events later."""
-    out = []
-    for path in _package_files():
-        with open(path, "r", encoding="utf-8") as f:
-            tree = ast.parse(f.read(), path)
-        rel = os.path.relpath(path, os.path.dirname(PKG_DIR))
-        for node in ast.walk(tree):
-            if not isinstance(node, ast.Call) or _call_name(node) not in \
-                    ("invalidate", "_invalidate_compiled"):
-                continue
-            for kw in node.keywords:
-                if kw.arg == "cause" and isinstance(kw.value, ast.Constant):
-                    out.append((rel, node.lineno, kw.value.value))
-    return out
 
 
 def test_record_compile_cause_literals_are_registered():
@@ -142,5 +53,15 @@ def test_metric_name_collector_finds_known_subsystems():
     for expected in ("serving.requests", "serving.engine.calls",
                      "serving.ttft_s", "compile.events", "faults.calls",
                      "flash_attention.dispatch", "slo.burn_rate",
-                     "flight.dumps", "train.phase.step_s"):
+                     "flight.dumps", "train.phase.step_s",
+                     "staticcheck.findings"):
         assert expected in all_names, (expected, sorted(all_names))
+
+
+def test_cause_collectors_back_the_lint_rule():
+    """The migrated collectors and the ``compile-cause-registered`` lint
+    rule must agree: a tree where the collectors find no unregistered
+    literal is a tree where the rule yields no finding (they walk the
+    same cached index — a drift here means one of them regressed)."""
+    rep = staticcheck.run(rules=["compile-cause-registered"])
+    assert rep.findings == [], [str(f) for f in rep.findings]

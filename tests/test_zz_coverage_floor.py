@@ -116,7 +116,10 @@ def test_telemetry_metric_floor(request):
               "test_tracing_slo.py", "test_attribution.py",
               # joint schedule tuner (ISSUE 14): the only writer of the
               # schedule.events counter and schedule.tuned_ratio gauge
-              "test_schedule_tuner.py"}
+              "test_schedule_tuner.py",
+              # staticcheck analyzer (ISSUE 15): the only writer of
+              # staticcheck.findings / staticcheck.runs
+              "test_staticcheck.py"}
     missing = needed - collected
     if missing:
         pytest.skip(f"chunked run (telemetry-ledger-marking files not "
@@ -139,7 +142,12 @@ def test_source_metric_names_are_registered(request):
     floor above only sees metrics that got DECLARED; a name in source
     whose declaration site no test ever reaches was invisible to it).
     Declaring modules are imported here first, so module-level
-    declarations count even if their subsystem's tests were skipped."""
+    declarations count even if their subsystem's tests were skipped.
+
+    ISSUE 15 satellite: the collector is the staticcheck framework's —
+    it reads the analyzer's mtime-cached module index, so this
+    cross-check shares the lint gate's single AST walk instead of
+    re-walking the package a second time per suite run."""
     import importlib
 
     collected = {item.fspath.basename for item in request.session.items}
@@ -152,7 +160,7 @@ def test_source_metric_names_are_registered(request):
     if missing_files:
         pytest.skip(f"chunked run (declaring-subsystem files not "
                     f"collected: {sorted(missing_files)})")
-    from test_static_telemetry import collect_metric_names
+    from deeplearning4j_tpu.runtime.staticcheck import collect_metric_names
     from deeplearning4j_tpu.runtime import telemetry
     per_file = collect_metric_names()
     for rel in per_file:
