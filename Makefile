@@ -4,7 +4,7 @@
 PY ?= python
 
 .PHONY: test test-fast lint multihost-sim multihost-smoke bench \
-	bench-generative trace-demo tune
+	bench-generative bench-kernels trace-demo tune
 
 # ISSUE 15: JAX-aware static analysis (runtime/staticcheck.py) — the
 # repo's hand-enforced invariants as machine-checked rules. Exits
@@ -50,6 +50,16 @@ bench:
 bench-generative:
 	env JAX_PLATFORMS=cpu $(PY) -c "import json, bench; \
 print(json.dumps(bench.bench_generative_serving(), indent=1))"
+
+# ISSUE 16: the fused-epilogue kernel-library metric standalone — the
+# fused master-cast+updater step vs the unfused updater-then-cast-sweep
+# sequence (interleaved A/B, median of per-round ratios, bit-parity
+# asserted in-bench, zero post-warmup compiles). CPU-capable; the
+# BN/LN/GeLU epilogue kernels themselves are TPU-only wins and are
+# covered by interpret-mode parity tests instead.
+bench-kernels:
+	env JAX_PLATFORMS=cpu $(PY) -c "import json, bench; \
+print(json.dumps(bench.bench_fused_epilogues(), indent=1))"
 
 # ISSUE 14: joint schedule tuner dry-run on CPU with a toy model —
 # seeds a default cache entry (CPU never sweeps), asserts the JSON

@@ -655,13 +655,22 @@ class SameDiff(_SentinelCounterMixin):
             return other_vals
         return _dt.cast_floating(other_vals, _dt.resolve(self.dtype))
 
-    def _fit_loss_fn(self):
+    def _fit_loss_fn(self, split_penalty: bool = False):
         """The pure training loss ``(train_vals, other_vals, feeds) ->
         scalar`` the fit step differentiates — factored out so
         :meth:`memory_report` can account its forward→backward residuals.
         Applies the ``workspace_mode`` remat policy: the op-list replay is
         segmented at attention anchors and each segment rematerializes in
-        the backward pass (``autodiff/remat.py``)."""
+        the backward pass (``autodiff/remat.py``).
+
+        ``split_penalty=True`` returns the four-arg form ``(tv_penalty,
+        tv_forward, other_vals, feeds)`` the fused master-cast updater
+        step (ISSUE 16) differentiates: the forward reads the
+        compute-dtype copies carried across steps (``cast_floating`` on
+        them is an identity, so the traced forward is bit-equal to the
+        unfused one) while l1/l2 penalties keep reading the f32 MASTERS
+        — exactly the split the unfused program has. The default form is
+        the split one applied to the same tree twice."""
         loss_name = self.loss_name
         tc = dict(self.train_config)
         from .. import dtypes as _dt
@@ -670,11 +679,12 @@ class SameDiff(_SentinelCounterMixin):
         cdt = _dt.resolve(self.dtype)
         policy = _memory.resolve_policy(getattr(self, "workspace_mode", None))
 
-        def loss_fn(tv, other_vals, feeds):
+        def loss_split(tv_pen, tv, other_vals, feeds):
             vals, fd = {**other_vals, **tv}, feeds
             if mixed:
                 # fp32 masters -> compute-dtype working copies; grads
-                # flow back through the cast into fp32 (engine parity)
+                # flow back through the cast into fp32 (engine parity).
+                # Identity (zero eqns) for pre-cast fused-carry leaves.
                 vals = _dt.cast_floating(vals, cdt)
                 fd = _dt.cast_floating(fd, cdt)
             if policy.remat:
@@ -688,11 +698,17 @@ class SameDiff(_SentinelCounterMixin):
                 total = jnp.asarray(total, jnp.float32)
             if tc.get("l1"):
                 total = total + tc["l1"] * sum(
-                    jnp.sum(jnp.abs(v)) for v in tv.values())
+                    jnp.sum(jnp.abs(v)) for v in tv_pen.values())
             if tc.get("l2"):
                 total = total + 0.5 * tc["l2"] * sum(
-                    jnp.sum(jnp.square(v)) for v in tv.values())
+                    jnp.sum(jnp.square(v)) for v in tv_pen.values())
             return total
+
+        if split_penalty:
+            return loss_split
+
+        def loss_fn(tv, other_vals, feeds):
+            return loss_split(tv, tv, other_vals, feeds)
 
         return loss_fn
 
@@ -707,14 +723,16 @@ class SameDiff(_SentinelCounterMixin):
         train_names = [n for n, v in self._vars.items() if v.kind == VARIABLE]
         updater = self.updater
         tc = dict(self.train_config)
-        loss_fn = self._fit_loss_fn()
+        fused_cast = self.fused_updater_active()
+        loss_fn = self._fit_loss_fn(split_penalty=fused_cast)
+        penalty = bool(tc.get("l1")) or bool(tc.get("l2"))
+        from .. import dtypes as _dt
+        cdt = _dt.resolve(self.dtype)
 
         from ..runtime import sentinel as _sent
+        from ..nn import updaters as _updaters
 
-        def step(train_vals, opt_state, other_vals, step_i, feeds,
-                 sentinel=None):
-            loss, grads = jax.value_and_grad(
-                lambda tv: loss_fn(tv, other_vals, feeds))(train_vals)
+        def _clip_and_ok(loss, grads):
             from ..nn import gradnorm as _gn
             # the shared engine clip pipeline; per-VARIABLE grouping means
             # each leaf is wrapped as its own "layer" for the mode step
@@ -724,25 +742,78 @@ class SameDiff(_SentinelCounterMixin):
                 tc.get("grad_norm"), tc.get("grad_norm_threshold", 1.0),
                 tc.get("clip_value"), tc.get("clip_l2"), wrapped)
             grads = {k: v["g"] for k, v in wrapped.items()}
-
             # DIVERGENCE SENTINEL — engine-parity contract (see
             # MultiLayerNetwork._build_train_step): non-finite loss or
             # global grad norm skips the weight update inside lax.cond and
             # bumps the on-device counters; zero host syncs/retraces.
             ok = _sent.finite_ok(loss, grads)
+            return grads, ok, clip_events
 
-            def _apply(train_vals, opt_state):
-                delta, new_opt = updater.apply(grads, opt_state, train_vals,
-                                               step_i)
-                return (jax.tree.map(lambda p, d: p - d, train_vals, delta),
-                        new_opt)
+        if fused_cast:
+            # FUSED MASTER-CAST UPDATER STEP (ISSUE 16): the first arg is
+            # the ``(masters, compute_copies)`` carry from _fit_carry().
+            # The forward reads the pre-cast compute copies (cast_floating
+            # on them is identity -> bit-equal forward); cotangents come
+            # back 16-bit and are upcast EXACTLY like the unfused cast's
+            # transpose (f32<-16-bit convert is value-exact); the updater
+            # emits the fresh compute copy in the same fusion that writes
+            # the f32 master (apply_leafwise_cast), so the standalone
+            # per-step master-cast sweep disappears from the program.
+            def step(carry, opt_state, other_vals, step_i, feeds,
+                     sentinel=None):
+                tv, tv_c = carry
+                if penalty:
+                    # penalties read the f32 masters (argnum 0), the
+                    # forward reads the compute copies (argnum 1) — the
+                    # exact split the unfused program differentiates; the
+                    # two cotangent paths sum commutatively (bit-equal)
+                    loss, (g_m, g_c) = jax.value_and_grad(
+                        lambda a, b: loss_fn(a, b, other_vals, feeds),
+                        argnums=(0, 1))(tv, tv_c)
+                    grads = jax.tree.map(
+                        lambda p, gm, gc: gm + gc.astype(p.dtype),
+                        tv, g_m, g_c)
+                else:
+                    loss, g_c = jax.value_and_grad(
+                        lambda b: loss_fn(tv, b, other_vals, feeds))(tv_c)
+                    grads = jax.tree.map(lambda p, gc: gc.astype(p.dtype),
+                                         tv, g_c)
+                grads, ok, clip_events = _clip_and_ok(loss, grads)
 
-            new_vals, new_opt = _sent.guarded_apply(
-                ok, _apply, train_vals, opt_state)
-            if sentinel is None:  # pre-sentinel call signature
-                return new_vals, new_opt, loss
-            return (new_vals, new_opt,
-                    _sent.update_counters(sentinel, ok, clip_events), loss)
+                def _apply(pair, opt_state):
+                    p, _ = pair
+                    new_p, new_pc, new_opt = _updaters.apply_leafwise_cast(
+                        updater, grads, opt_state, p, step_i, cdt)
+                    return (new_p, new_pc), new_opt
+
+                new_carry, new_opt = _sent.guarded_apply(
+                    ok, _apply, (tv, tv_c), opt_state)
+                if sentinel is None:  # pre-sentinel call signature
+                    return new_carry, new_opt, loss
+                return (new_carry, new_opt,
+                        _sent.update_counters(sentinel, ok, clip_events),
+                        loss)
+        else:
+            def step(train_vals, opt_state, other_vals, step_i, feeds,
+                     sentinel=None):
+                loss, grads = jax.value_and_grad(
+                    lambda tv: loss_fn(tv, other_vals, feeds))(train_vals)
+                grads, ok, clip_events = _clip_and_ok(loss, grads)
+
+                def _apply(train_vals, opt_state):
+                    delta, new_opt = updater.apply(grads, opt_state,
+                                                   train_vals, step_i)
+                    return (jax.tree.map(lambda p, d: p - d, train_vals,
+                                         delta),
+                            new_opt)
+
+                new_vals, new_opt = _sent.guarded_apply(
+                    ok, _apply, train_vals, opt_state)
+                if sentinel is None:  # pre-sentinel call signature
+                    return new_vals, new_opt, loss
+                return (new_vals, new_opt,
+                        _sent.update_counters(sentinel, ok, clip_events),
+                        loss)
 
         import json as _json
         from .. import environment as _envmod
@@ -752,12 +823,45 @@ class SameDiff(_SentinelCounterMixin):
                 str(self.dtype),
                 str(getattr(self, "workspace_mode", "none")),
                 str(_envmod.Environment.instance().f32_matmul_precision),
-                tuple(train_names))
+                tuple(train_names),
+                "fused_cast" if fused_cast else "plain")
         return spec, jax.jit(step, donate_argnums=(0, 1))
+
+    # ------------------------------------------- fused master-cast carry
+    def fused_updater_active(self) -> bool:
+        """Does the compiled fit step use the fused master-cast updater
+        (ISSUE 16)? True under a 16-bit dtype policy with the fused-
+        epilogue library enabled (``DL4J_TPU_FUSED_EPILOGUES`` != off).
+        When True the step's first argument is the ``(masters,
+        compute_copies)`` tuple from :meth:`_fit_carry`, not the bare
+        master dict — external drivers (bench) go through the carry
+        helpers instead of assuming the plain signature."""
+        from ..ops import fused_epilogues as _fe
+        # the SameDiff step differentiates penalties against the masters
+        # explicitly (split_penalty), so l1/l2 never forces a fallback
+        return _fe.route_updater(self.dtype) is None
+
+    def _fit_carry(self, train_vals):
+        """The compiled step's first argument for ``train_vals``: the
+        ``(masters, compute_copies)`` pair when the fused updater is
+        active (the ONE remaining host-side cast — every subsequent step
+        re-emits the copies from inside the updater), else the bare
+        master dict."""
+        if not self.fused_updater_active():
+            return train_vals
+        from .. import dtypes as _dt
+        return (train_vals,
+                _dt.cast_floating(train_vals, _dt.resolve(self.dtype)))
+
+    @staticmethod
+    def _carry_masters(carry):
+        """The f32 masters view of a step carry (either signature)."""
+        return carry[0] if isinstance(carry, tuple) else carry
 
     #: spec tuple positions -> retrace-tracker cause (see _make_fit_step
     #: for the tuple layout); anything else is a generic config change
-    _SPEC_CAUSES = {4: "dtype_policy", 5: "workspace_mode", 6: "precision"}
+    _SPEC_CAUSES = {4: "dtype_policy", 5: "workspace_mode", 6: "precision",
+                    8: "fused_updater"}
 
     def _fit_step_cached(self):
         """The cached compiled fit step (built if absent/stale). ONE step
@@ -785,6 +889,11 @@ class SameDiff(_SentinelCounterMixin):
                           if i in self._SPEC_CAUSES), "config_change")
         _tel.record_compile("samediff.fit_step", cause,
                             loss=str(spec[1]))
+        # dispatch accounting rides the cache miss: ONE decision count per
+        # compiled step, not one per fit() call (mirrors the kernel-side
+        # fused_epilogues.dispatch discipline: zero silent fallbacks)
+        from ..ops import fused_epilogues as _fe
+        _fe.dispatch_updater(self.dtype)
         self._fn_cache["__fit_step__"] = (spec, step)
         self._last_fit_spec = spec
         return step
@@ -803,7 +912,11 @@ class SameDiff(_SentinelCounterMixin):
         train_names = [n for n, v in self._vars.items() if v.kind == VARIABLE]
         updater = self.updater
         step = self._fit_step_cached()
-        train_vals = {n: self._values[n] for n in train_names}
+        # fused master-cast carry (ISSUE 16): under a 16-bit policy the
+        # step carries (masters, compute_copies) — built ONCE here, then
+        # the fused updater re-emits the copies every step on-device
+        carry = self._fit_carry({n: self._values[n] for n in train_names})
+        train_vals = self._carry_masters(carry)
         # cast hoist (ISSUE 14 satellite): constants/frozen values go to
         # the compute dtype ONCE here, not once per compiled step —
         # self._values keeps the f32 originals (masters discipline)
@@ -829,10 +942,11 @@ class SameDiff(_SentinelCounterMixin):
                         feeds = {k: jnp.full_like(v, jnp.nan)
                                  if jnp.issubdtype(v.dtype, jnp.floating)
                                  else v for k, v in feeds.items()}
-                train_vals, opt_state, self._sentinel, loss = step(
-                    train_vals, opt_state, other_vals,
+                carry, opt_state, self._sentinel, loss = step(
+                    carry, opt_state, other_vals,
                     jnp.asarray(i, jnp.int32), feeds,
                     self._ensure_sentinel())
+                train_vals = self._carry_masters(carry)
                 loss = float(loss)
                 history.losses.append(loss)
                 epoch_losses.append(loss)
@@ -894,6 +1008,9 @@ class SameDiff(_SentinelCounterMixin):
         ov = self._cast_other_vals(
             {n: v for n, v in self._values.items() if n not in tv})
         tv_avals = jax.eval_shape(lambda: tv)
+        # the step's first arg is the fused (masters, copies) carry when
+        # the fused updater is active — lower the REAL signature
+        carry_avals = jax.eval_shape(lambda: self._fit_carry(tv))
         ov_avals = jax.eval_shape(lambda: ov)
         opt_avals = jax.eval_shape(lambda: self.updater.init_state(tv))
         feeds_avals = {
@@ -918,7 +1035,7 @@ class SameDiff(_SentinelCounterMixin):
         # sentinel counters included: accounts the REAL step fit() runs;
         # the accounting compile is attributed like every other probe
         _tel.record_compile("samediff.fit_step", "probe", batch=batch)
-        compiled = step.lower(tv_avals, opt_avals, ov_avals,
+        compiled = step.lower(carry_avals, opt_avals, ov_avals,
                               jax.ShapeDtypeStruct((), jnp.int32),
                               feeds_avals, _sent.counter_avals()).compile()
         cm = _memory.compiled_memory(compiled)

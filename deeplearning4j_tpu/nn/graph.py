@@ -329,7 +329,7 @@ class ComputationGraph(_caches.CompiledCacheMixin):
     # --------------------------------------------------------------- forward
     def _forward(self, params, inputs: Dict[str, jax.Array], state, *,
                  train, rng, masks: Optional[Dict[str, Any]] = None,
-                 remat_policy=None):
+                 remat_policy=None, fold_epilogues=True):
         """Pure topo walk. Returns ({vertex: activation}, new_state,
         {vertex: mask}) for output vertices.
 
@@ -356,21 +356,68 @@ class ComputationGraph(_caches.CompiledCacheMixin):
         acts: Dict[str, jax.Array] = dict(inputs)
         mks: Dict[str, Any] = dict(masks or {})
         new_state = dict(state)
+        fold, skip = self._epilogue_fold_plan() if fold_epilogues \
+            else ({}, frozenset())
         for name in self._topo:
             v, ins = self._vertex_map[name]
             if rng is not None and v.stochastic:
                 rng, sub = jax.random.split(rng)
             else:
                 sub = None
+            if name in skip:  # folded act vertex: value passes through
+                acts[name] = acts[ins[0]]
+                mks[name] = mks.get(ins[0])
+                continue
+            kw = {"fold_act": fold[name]} if name in fold else {}
             y, s_new, m = v.apply(
                 params.get(name, {}), [acts[i] for i in ins],
                 state.get(name, {}), train=train, rng=sub,
-                masks=[mks.get(i) for i in ins])
+                masks=[mks.get(i) for i in ins], **kw)
             acts[name] = y
             mks[name] = m
             if s_new:
                 new_state[name] = s_new
         return acts, new_state, mks
+
+    def _epilogue_fold_plan(self):
+        """Static BN+activation fold plan over the vertex graph
+        (ISSUE 16): a LayerVertex(BatchNormalization) whose output is
+        consumed ONLY by a LayerVertex(ActivationLayer) with a kernel-
+        foldable activation (and is not itself a network output — a
+        residual branch reading the pre-activation BN output blocks the
+        fold) gets the act folded into its ``bn_act`` epilogue; the act
+        vertex becomes a value pass-through. The dispatcher's fallback is
+        bit-identical, so the fold never changes numerics."""
+        cached = getattr(self, "_epilogue_fold", None)
+        if cached is not None:
+            return cached
+        from ..ops import fused_epilogues as _fe
+        from .layers.conv import BatchNormalization
+        from .layers.core import ActivationLayer
+        consumers: Dict[str, list] = {}
+        for name in self._topo:
+            _, ins = self._vertex_map[name]
+            for i in ins:
+                consumers.setdefault(i, []).append(name)
+        outputs = set(self.conf.outputs)
+        fold, skip = {}, set()
+        for name in self._topo:
+            v, _ = self._vertex_map[name]
+            if not (isinstance(v, LayerVertex)
+                    and isinstance(v.layer, BatchNormalization)):
+                continue
+            if name in outputs or len(consumers.get(name, [])) != 1:
+                continue
+            nxt = consumers[name][0]
+            nv, _ = self._vertex_map[nxt]
+            if (isinstance(nv, LayerVertex)
+                    and type(nv.layer) is ActivationLayer
+                    and _fe.foldable_act(nv.layer.activation,
+                                         getattr(nv.layer, "alpha", None))):
+                fold[name] = nv.layer.activation
+                skip.add(nxt)
+        self._epilogue_fold = (fold, frozenset(skip))
+        return self._epilogue_fold
 
     def _forward_remat(self, params, inputs, state, *, train, rng, masks,
                        policy):
@@ -412,16 +459,22 @@ class ComputationGraph(_caches.CompiledCacheMixin):
                 a = dict(carry_acts)
                 m = dict(carry_mks)
                 ns = {}
+                fold, skip = self._epilogue_fold_plan()
                 for name in _names:
                     v, ins = self._vertex_map[name]
                     if rng is not None and v.stochastic:
                         rng, sub = jax.random.split(rng)
                     else:
                         sub = None
+                    if name in skip:  # folded act vertex: pass-through
+                        a[name] = a[ins[0]]
+                        m[name] = m.get(ins[0])
+                        continue
+                    kw = {"fold_act": fold[name]} if name in fold else {}
                     y, s_new, mk = v.apply(
                         seg_params.get(name, {}), [a[i] for i in ins],
                         seg_state.get(name, {}), train=train, rng=sub,
-                        masks=[m.get(i) for i in ins])
+                        masks=[m.get(i) for i in ins], **kw)
                     a[name] = y
                     m[name] = mk
                     if s_new:
@@ -530,8 +583,17 @@ class ComputationGraph(_caches.CompiledCacheMixin):
 
         return loss_fn
 
+    def fused_updater_active(self) -> bool:
+        """Fused master-cast updater gate (ISSUE 16) — see
+        ``MultiLayerNetwork.fused_updater_active``."""
+        from ..ops import fused_epilogues as _fe
+        return _fe.route_updater(
+            self.conf.dtype,
+            has_penalty=self._uses_regularization()) is None
+
     def _build_train_step(self, accum_steps: int = 1,
-                          sentinel_guard: bool = True, grad_transform=None):
+                          sentinel_guard: bool = True, grad_transform=None,
+                          fused_cast: bool = False):
         """Fused pure train step; ``accum_steps=k`` scans the gradient over
         k microbatches before the single updater application (same contract
         as ``MultiLayerNetwork._build_train_step`` — see
@@ -543,7 +605,12 @@ class ComputationGraph(_caches.CompiledCacheMixin):
         (see its docstring): the transform is value-identity scheduling
         structure applied BEFORE clip/sentinel; the hoist casts fp32
         masters to the compute dtype once per step instead of once per
-        microbatch (bit-equivalent, gated on no l1/l2)."""
+        microbatch (bit-equivalent, gated on no l1/l2). ``fused_cast=True``
+        (ISSUE 16, gated on :meth:`fused_updater_active`) compiles the
+        fused master-cast variant — ``params_c`` compute copy in the
+        signature, cast folded into the updater write; see
+        ``MultiLayerNetwork._build_train_step`` for the exactness
+        argument."""
         updater = self.conf.updater
         from .layers.wrappers import FrozenLayer
         from .vertices import LayerVertex
@@ -557,6 +624,54 @@ class ComputationGraph(_caches.CompiledCacheMixin):
         cdt = _dt.resolve(self.conf.dtype)
         pdt = _dt.param_dtype(self.conf.dtype)
         from ..runtime import sentinel as _sent
+
+        if fused_cast:
+            if accum_steps != 1:
+                raise ValueError("fused_cast requires accum_steps == 1 "
+                                 "(the microbatch scan has its own hoist)")
+
+            def fused_step_fn(params, params_c, opt_state, bn_state, step,
+                              key, xs, ys, fms, lms, sentinel=None):
+                (loss, new_bn), grads = vg_fn(
+                    params_c, bn_state, key, xs, ys, fms, lms)
+                # exact upcast — the unfused cast's transpose, bitwise
+                grads = _dt.cast_floating(grads, pdt)
+                if grad_transform is not None:
+                    grads = grad_transform(grads)
+                grads, clip_events = self._clip(grads)
+
+                def _apply(pair, opt_state):
+                    p, _ = pair
+                    new_p, new_pc, new_opt = _upd.apply_leafwise_cast(
+                        updater, grads, opt_state, p, step, cdt)
+                    if self.conf.constraints:
+                        new_p = _constraints.apply_constraints(
+                            self.conf.constraints, new_p, skip=frozen_keys)
+                        new_pc = _dt.cast_floating(new_p, cdt)
+                    return (new_p, new_pc), new_opt
+
+                if not sentinel_guard:  # A/B baseline
+                    (new_p, new_pc), new_opt = _apply(
+                        (params, params_c), opt_state)
+                    if sentinel is None:
+                        return new_p, new_pc, new_opt, new_bn, loss
+                    return (new_p, new_pc, new_opt, new_bn,
+                            _sent.update_counters(sentinel, jnp.bool_(True),
+                                                  clip_events), loss)
+                ok = _sent.finite_ok(loss, grads)
+                (new_p, new_pc), new_opt = _sent.guarded_apply(
+                    ok, _apply, (params, params_c), opt_state)
+                out_bn = jax.tree.map(
+                    lambda new, old: jnp.where(ok, new, old),
+                    new_bn, bn_state) if bn_state else new_bn
+                if sentinel is None:
+                    return new_p, new_pc, new_opt, out_bn, loss
+                return (new_p, new_pc, new_opt, out_bn,
+                        _sent.update_counters(sentinel, ok, clip_events),
+                        loss)
+
+            return jax.jit(fused_step_fn, donate_argnums=(0, 1, 2, 3),
+                           compiler_options=_env.engine_compiler_options())
 
         def step_fn(params, opt_state, bn_state, step, key, xs, ys, fms, lms,
                     sentinel=None):
@@ -628,7 +743,38 @@ class ComputationGraph(_caches.CompiledCacheMixin):
         which for a ~45 ms ResNet-50 step is a ~10% tax. Scanning on device
         removes it entirely and is how XLA-era trainers are meant to run
         epochs whose data fits in HBM.
+
+        Under the fused master-cast updater (ISSUE 16) the scan carries
+        the ``params_c`` compute copy — one cast per epoch launch, the
+        rest emitted by the fused updater write; external signature
+        unchanged (masters in, masters out).
         """
+        if self.fused_updater_active():
+            step = self._build_train_step(fused_cast=True).__wrapped__
+            cdt = _dt.resolve(self.conf.dtype)
+
+            def epoch_fn(params, opt_state, bn_state, sentinel, start_step,
+                         key, xs, ys):
+                params_c = _dt.cast_floating(params, cdt)  # once per epoch
+                def body(carry, xy):
+                    params, params_c, opt_state, bn_state, sentinel, i = carry
+                    bx, by = xy
+                    k = jax.random.fold_in(key, i)
+                    (params, params_c, opt_state, bn_state, sentinel,
+                     loss) = step(params, params_c, opt_state, bn_state, i,
+                                  k, bx, by, (None,) * len(bx),
+                                  (None,) * len(by), sentinel)
+                    return (params, params_c, opt_state, bn_state, sentinel,
+                            i + 1), loss
+                (params, _, opt_state, bn_state, sentinel, _), losses = \
+                    jax.lax.scan(
+                        body, (params, params_c, opt_state, bn_state,
+                               sentinel, start_step), (xs, ys))
+                return params, opt_state, bn_state, sentinel, losses
+
+            return jax.jit(epoch_fn, donate_argnums=(0, 1, 2, 3),
+                           compiler_options=_env.engine_compiler_options())
+
         step = self._build_train_step().__wrapped__
 
         def epoch_fn(params, opt_state, bn_state, sentinel, start_step, key,
@@ -732,8 +878,18 @@ class ComputationGraph(_caches.CompiledCacheMixin):
         if not self.params and not self.state:
             self.init()
         if self._train_step is None:
-            self._train_step = self._build_train_step()
+            self._train_step_fused = self.fused_updater_active()
+            self._train_step = self._build_train_step(
+                fused_cast=self._train_step_fused)
+            from ..ops import fused_epilogues as _fe
+            _fe.dispatch_updater(self.conf.dtype,
+                                 has_penalty=self._uses_regularization())
             self._record_build("train.step", cache_attr="_train_step")
+        fused = getattr(self, "_train_step_fused", False)
+        # fused master-cast carry (ISSUE 16): one host-side cast per fit()
+        # call — see MultiLayerNetwork.fit
+        params_c = _dt.cast_floating(
+            self.params, _dt.resolve(self.conf.dtype)) if fused else None
         from ..runtime import faults as _faults
         it = _as_multi_iterator(data, labels)
         # step-phase tracing (ISSUE 6): shared scaffold on
@@ -763,11 +919,20 @@ class ComputationGraph(_caches.CompiledCacheMixin):
                 step = jnp.asarray(self.iteration, dtype=jnp.int32)
                 self._last_batch = xs  # StatsListener activation sampling
                 with self._timed_dispatch(tel, _h_step):
-                    (self.params, self.updater_state, self.state,
-                     self._sentinel, loss) = \
-                        self._train_step(self.params, self.updater_state,
-                                         self.state, step, sub, xs, ys, fms,
-                                         lms, self._ensure_sentinel())
+                    if fused:
+                        (self.params, params_c, self.updater_state,
+                         self.state, self._sentinel, loss) = \
+                            self._train_step(self.params, params_c,
+                                             self.updater_state, self.state,
+                                             step, sub, xs, ys, fms, lms,
+                                             self._ensure_sentinel())
+                    else:
+                        (self.params, self.updater_state, self.state,
+                         self._sentinel, loss) = \
+                            self._train_step(self.params, self.updater_state,
+                                             self.state, step, sub, xs, ys,
+                                             fms, lms,
+                                             self._ensure_sentinel())
                 self._score = loss
                 self.iteration += 1
                 for cb in self._listeners:
@@ -789,8 +954,11 @@ class ComputationGraph(_caches.CompiledCacheMixin):
                 f"feed_forward takes {len(self.conf.inputs)} inputs "
                 f"({self.conf.inputs}), got {len(inputs)}")
         ins = dict(zip(self.conf.inputs, inputs))
+        # no epilogue fold here: feedForward exposes every vertex's true
+        # activation (the fold would show the BN vertex post-activation)
         acts, _, _ = self._forward(self.params, ins, self.state,
-                                   train=train, rng=rng)
+                                   train=train, rng=rng,
+                                   fold_epilogues=False)
         return acts
 
     def output(self, *inputs, train: bool = False):

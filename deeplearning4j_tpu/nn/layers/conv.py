@@ -96,9 +96,20 @@ class ConvolutionLayer(Layer):
                              padding=self.padding, dilation=self.dilation,
                              mode=self.mode, data_format=self.data_format)
         else:
-            y = nnops.conv2d(x, w, params.get("b"), stride=self.stride,
+            # post-conv epilogue (ISSUE 16): the conv itself stays with XLA
+            # (a hand-written conv kernel measured ~50% SLOWER than XLA's —
+            # ops/pallas_kernels.py negative result); only the bias+act
+            # tail routes through the fused epilogue library. The
+            # dispatcher's fallback reproduces conv2d's internal reshape-
+            # add plus the catalog activation bit-for-bit.
+            from ...ops import fused_epilogues as _fe
+            y = nnops.conv2d(x, w, None, stride=self.stride,
                              padding=self.padding, dilation=self.dilation,
                              mode=self.mode, data_format=self.data_format)
+            caxis = 1 if self.data_format == "NCHW" else -1
+            return (_fe.bias_act(y, params.get("b"), act=self.activation,
+                                 axis=caxis),
+                    state, mask)
         return _act.get(self.activation)(y), state, mask
 
 
@@ -170,7 +181,13 @@ class BatchNormalization(Layer):
         state = {"mean": jnp.zeros((n,), dtype), "var": jnp.ones((n,), dtype)}
         return params, state, tuple(input_shape)
 
-    def apply(self, params, x, state, *, train=False, rng=None, mask=None):
+    def apply(self, params, x, state, *, train=False, rng=None, mask=None,
+              fold_act=None):
+        # ``fold_act`` (ISSUE 16): activation folded into the BN epilogue
+        # by the engines' fold plan (a following ActivationLayer becomes a
+        # pass-through). Routed through ops.fused_epilogues.bn_act, whose
+        # fallback is nnops.batch_norm + the catalog activation —
+        # bit-identical to the unfused pair.
         axis = self._caxis(x.ndim)
         reduce_axes = tuple(i for i in range(x.ndim) if i != (axis % x.ndim))
         gamma = params.get("gamma")
@@ -215,13 +232,16 @@ class BatchNormalization(Layer):
                                   + (1 - d) * mean).astype(state["mean"].dtype),
                          "var": (d * state["var"]
                                  + (1 - d) * var).astype(state["var"].dtype)}
-            y = nnops.batch_norm(x, gamma, beta, mean.astype(x.dtype),
-                                 var.astype(x.dtype), self.eps, axis)
+            from ...ops import fused_epilogues as _fe
+            y = _fe.bn_act(x, gamma, beta, mean.astype(x.dtype),
+                           var.astype(x.dtype), self.eps, axis,
+                           act=fold_act or "identity")
             return y, new_state, mask
-        y = nnops.batch_norm(x, gamma, beta,
-                             state["mean"].astype(x.dtype),
-                             state["var"].astype(x.dtype),
-                             self.eps, axis)
+        from ...ops import fused_epilogues as _fe
+        y = _fe.bn_act(x, gamma, beta,
+                       state["mean"].astype(x.dtype),
+                       state["var"].astype(x.dtype),
+                       self.eps, axis, act=fold_act or "identity")
         return y, state, mask
 
 

@@ -33,6 +33,7 @@ from typing import Any, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ...ops import activations as _act
 from ...ops import nnops
@@ -145,7 +146,8 @@ class LSTM(_RecurrentLayer):
         cell = self._cell(grad_path)
         if cell is not nnops.lstm_cell:
             from ...ops import pallas_kernels as pk
-            if not pk.fits_vmem(x.shape[0], w.shape[0], rw.shape[0]):
+            if not pk.fits_vmem(x.shape[0], w.shape[0], rw.shape[0],
+                                np.dtype(x.dtype).itemsize):
                 cell = nnops.lstm_cell
 
         def step(carry, inp):

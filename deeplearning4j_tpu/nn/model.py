@@ -162,6 +162,10 @@ class MultiLayerNetwork(_caches.CompiledCacheMixin):
                                        policy=remat_policy)
         new_state = dict(state)
         acts = [x]
+        # BN+act epilogue fold (ISSUE 16): feedForward (collect=True) keeps
+        # the true per-layer activations; the training/inference walk folds
+        fold, skip = ({}, frozenset()) if collect \
+            else self._epilogue_fold_plan()
         for i, layer in enumerate(self.layers):
             si = str(i)
             p = params.get(si, {})
@@ -170,12 +174,48 @@ class MultiLayerNetwork(_caches.CompiledCacheMixin):
                 rng, sub = jax.random.split(rng)
             else:
                 sub = None
-            x, s_new, mask = layer.apply(p, x, s, train=train, rng=sub, mask=mask)
+            if i in skip:
+                continue  # activation folded into the previous BN; its
+                # rng split above still ran, so the stream is unchanged
+            if i in fold:
+                x, s_new, mask = layer.apply(p, x, s, train=train, rng=sub,
+                                             mask=mask, fold_act=fold[i])
+            else:
+                x, s_new, mask = layer.apply(p, x, s, train=train, rng=sub,
+                                             mask=mask)
             if collect:
                 acts.append(x)
             if s_new:
                 new_state[si] = s_new
         return (acts if collect else x), new_state, mask
+
+    def _epilogue_fold_plan(self):
+        """Static BN+activation fold plan (ISSUE 16): every
+        BatchNormalization immediately followed by a parameter-free
+        ActivationLayer with a kernel-foldable activation gets the act
+        folded into its ``fused_epilogues.bn_act`` epilogue
+        (``fold -> {bn_index: act}``) and the ActivationLayer becomes a
+        pass-through (``skip``). Purely structural — cached per model;
+        the dispatcher still decides fuse-vs-fallback per shape/dtype at
+        trace time (fallback is bit-identical, so the fold itself never
+        changes numerics)."""
+        cached = getattr(self, "_epilogue_fold", None)
+        if cached is not None:
+            return cached
+        from ..ops import fused_epilogues as _fe
+        from .layers.conv import BatchNormalization
+        from .layers.core import ActivationLayer
+        fold, skip = {}, set()
+        for i, layer in enumerate(self.layers[:-1]):
+            nxt = self.layers[i + 1]
+            if (isinstance(layer, BatchNormalization)
+                    and type(nxt) is ActivationLayer
+                    and _fe.foldable_act(nxt.activation,
+                                         getattr(nxt, "alpha", None))):
+                fold[i] = nxt.activation
+                skip.add(i + 1)
+        self._epilogue_fold = (fold, frozenset(skip))
+        return self._epilogue_fold
 
     def _forward_remat(self, params, x, state, *, train, rng, mask, policy):
         """The same layer walk, segmented into ``policy.every``-layer
@@ -189,6 +229,7 @@ class MultiLayerNetwork(_caches.CompiledCacheMixin):
         here)."""
         from . import memory as _memory
         new_state = dict(state)
+        fold, skip = self._epilogue_fold_plan()
         for s, e in _memory.segment_ranges(len(self.layers), policy.every):
             seg = list(range(s, e))
 
@@ -201,9 +242,12 @@ class MultiLayerNetwork(_caches.CompiledCacheMixin):
                         rng, sub = jax.random.split(rng)
                     else:
                         sub = None
+                    if i in skip:  # folded act: split consumed, apply no-op
+                        continue
+                    kw = {"fold_act": fold[i]} if i in fold else {}
                     x, s_new, mask = layer.apply(
                         seg_params.get(si, {}), x, seg_state.get(si, {}),
-                        train=train, rng=sub, mask=mask)
+                        train=train, rng=sub, mask=mask, **kw)
                     if s_new:
                         ns[si] = s_new
                 return x, ns, mask, rng
@@ -304,8 +348,22 @@ class MultiLayerNetwork(_caches.CompiledCacheMixin):
         return any((getattr(l, "l1", 0.0) or getattr(l, "l2", 0.0))
                    for l in self.layers)
 
+    def fused_updater_active(self) -> bool:
+        """Does the train step fold the per-step f32->compute master cast
+        into the updater write (ISSUE 16)? True under a 16-bit policy with
+        no l1/l2 term (the regularization reads the params the loss fn is
+        handed, so it must see f32 masters) and the fused-epilogue library
+        enabled. When True the step carries a ``params_c`` compute copy
+        alongside the masters and the standalone per-step cast sweep
+        disappears from the compiled program."""
+        from ..ops import fused_epilogues as _fe
+        return _fe.route_updater(
+            self.conf.dtype,
+            has_penalty=self._uses_regularization()) is None
+
     def _build_train_step(self, accum_steps: int = 1,
-                          sentinel_guard: bool = True, grad_transform=None):
+                          sentinel_guard: bool = True, grad_transform=None,
+                          fused_cast: bool = False):
         """Fused pure train step. ``accum_steps=k`` splits the batch into k
         microbatches and accumulates the mean gradient via ``lax.scan``
         before the SINGLE updater application (see ``nn/microbatch.py`` for
@@ -335,7 +393,19 @@ class MultiLayerNetwork(_caches.CompiledCacheMixin):
         parameter k times per step. Gradients come back in the compute
         dtype and promote exactly into the f32 scan accumulator (the same
         values the per-microbatch cast-backward produced), then cast to the
-        master dtype before clipping — bit-equivalent (tested)."""
+        master dtype before clipping — bit-equivalent (tested).
+
+        ``fused_cast=True`` (ISSUE 16, caller gates on
+        :meth:`fused_updater_active`) compiles the FUSED MASTER-CAST
+        variant: the signature gains a ``params_c`` compute-dtype copy
+        after ``params``, the forward differentiates the copy
+        (``_forward``'s ``cast_floating`` is identity on pre-cast leaves
+        -> bit-equal forward), cotangents upcast exactly like the unfused
+        cast's transpose, and ``apply_leafwise_cast`` emits next step's
+        compute copy inside the same fusion that writes the f32 master —
+        the standalone per-step cast sweep is gone from the program.
+        Bit-parity of params AND updater state vs the unfused step is
+        asserted in tests."""
         updater = self.conf.updater
         from .layers.wrappers import FrozenLayer
         from . import microbatch as _micro
@@ -347,6 +417,58 @@ class MultiLayerNetwork(_caches.CompiledCacheMixin):
                       and not self._uses_regularization())
         cdt = _dt.resolve(self.conf.dtype)
         pdt = _dt.param_dtype(self.conf.dtype)
+
+        if fused_cast:
+            if accum_steps != 1:
+                raise ValueError("fused_cast requires accum_steps == 1 "
+                                 "(the microbatch scan has its own hoist)")
+
+            def fused_step_fn(params, params_c, opt_state, bn_state, step,
+                              key, x, y, fmask, lmask, sentinel=None):
+                (loss, new_bn), grads = vg_fn(
+                    params_c, bn_state, key, x, y, fmask, lmask)
+                # exact upcast: the transpose of convert f32->16-bit is
+                # convert 16-bit->f32, value-exact — same bits as the
+                # unfused step's through-the-cast cotangents
+                grads = _dt.cast_floating(grads, pdt)
+                if grad_transform is not None:
+                    grads = grad_transform(grads)
+                grads, clip_events = self._clip(grads)
+
+                def _apply(pair, opt_state):
+                    p, _ = pair
+                    new_p, new_pc, new_opt = _updaters.apply_leafwise_cast(
+                        updater, grads, opt_state, p, step, cdt)
+                    if self.conf.constraints:
+                        # constraints rewrite the masters post-update, so
+                        # the fused copy must be re-derived from them
+                        new_p = _constraints.apply_constraints(
+                            self.conf.constraints, new_p, skip=frozen_keys)
+                        new_pc = _dt.cast_floating(new_p, cdt)
+                    return (new_p, new_pc), new_opt
+
+                if not sentinel_guard:  # A/B baseline
+                    (new_p, new_pc), new_opt = _apply(
+                        (params, params_c), opt_state)
+                    if sentinel is None:
+                        return new_p, new_pc, new_opt, new_bn, loss
+                    return (new_p, new_pc, new_opt, new_bn,
+                            _sent.update_counters(sentinel, jnp.bool_(True),
+                                                  clip_events), loss)
+                ok = _sent.finite_ok(loss, grads)
+                (new_p, new_pc), new_opt = _sent.guarded_apply(
+                    ok, _apply, (params, params_c), opt_state)
+                out_bn = jax.tree.map(
+                    lambda new, old: jnp.where(ok, new, old),
+                    new_bn, bn_state) if bn_state else new_bn
+                if sentinel is None:
+                    return new_p, new_pc, new_opt, out_bn, loss
+                return (new_p, new_pc, new_opt, out_bn,
+                        _sent.update_counters(sentinel, ok, clip_events),
+                        loss)
+
+            return jax.jit(fused_step_fn, donate_argnums=(0, 1, 2, 3),
+                           compiler_options=_env.engine_compiler_options())
 
         def step_fn(params, opt_state, bn_state, step, key, x, y, fmask,
                     lmask, sentinel=None):
@@ -409,7 +531,37 @@ class MultiLayerNetwork(_caches.CompiledCacheMixin):
         """lax.scan of the fused train step over a device-resident batch
         stack — one XLA launch per epoch (see ComputationGraph.
         _build_epoch_fn for the rationale; same contract, singular
-        batch arity)."""
+        batch arity). When the fused master-cast updater is active
+        (ISSUE 16) the scan body carries the compute-dtype ``params_c``
+        copy: the masters are cast ONCE per epoch launch and every
+        subsequent copy is emitted by the fused updater write — the
+        per-scan-step cast sweep is gone. External signature unchanged
+        (masters in, masters out)."""
+        if self.fused_updater_active():
+            step = self._build_train_step(fused_cast=True).__wrapped__
+            cdt = _dt.resolve(self.conf.dtype)
+
+            def epoch_fn(params, opt_state, bn_state, sentinel, start_step,
+                         key, xs, ys):
+                params_c = _dt.cast_floating(params, cdt)  # once per epoch
+                def body(carry, xy):
+                    params, params_c, opt_state, bn_state, sentinel, i = carry
+                    bx, by = xy
+                    k = jax.random.fold_in(key, i)
+                    (params, params_c, opt_state, bn_state, sentinel,
+                     loss) = step(params, params_c, opt_state, bn_state, i,
+                                  k, bx, by, None, None, sentinel)
+                    return (params, params_c, opt_state, bn_state, sentinel,
+                            i + 1), loss
+                (params, _, opt_state, bn_state, sentinel, _), losses = \
+                    jax.lax.scan(
+                        body, (params, params_c, opt_state, bn_state,
+                               sentinel, start_step), (xs, ys))
+                return params, opt_state, bn_state, sentinel, losses
+
+            return jax.jit(epoch_fn, donate_argnums=(0, 1, 2, 3),
+                           compiler_options=_env.engine_compiler_options())
+
         step = self._build_train_step().__wrapped__
 
         def epoch_fn(params, opt_state, bn_state, sentinel, start_step, key,
@@ -510,8 +662,23 @@ class MultiLayerNetwork(_caches.CompiledCacheMixin):
         from ..runtime import faults as _faults
         it = _as_iterator(data, labels)
         if self._train_step is None:
-            self._train_step = self._build_train_step()
+            self._train_step_fused = self.fused_updater_active()
+            self._train_step = self._build_train_step(
+                fused_cast=self._train_step_fused)
+            # one dispatch decision per compiled step (zero silent
+            # fallbacks — fused_epilogues.dispatch{decision=} discipline)
+            from ..ops import fused_epilogues as _fe
+            _fe.dispatch_updater(self.conf.dtype,
+                                 has_penalty=self._uses_regularization())
             self._record_build("train.step", cache_attr="_train_step")
+        fused = getattr(self, "_train_step_fused", False)
+        # fused master-cast carry (ISSUE 16): ONE host-side cast per fit()
+        # call; every later compute copy is emitted by the fused updater
+        # write on-device (listener-side mutation of self.params mid-fit
+        # is not supported under the fused step — same contract as
+        # fit_on_device where the whole epoch is device-resident)
+        params_c = _dt.cast_floating(
+            self.params, _dt.resolve(self.conf.dtype)) if fused else None
         # step-phase tracing (ISSUE 6): shared scaffold on
         # CompiledCacheMixin — see caches.py _phase_clocks/_timed_batches
         _h_wait, _h_step = self._phase_clocks()
@@ -533,11 +700,20 @@ class MultiLayerNetwork(_caches.CompiledCacheMixin):
                 step = jnp.asarray(self.iteration, dtype=jnp.int32)  # traced, no retrace per step
                 self._last_batch = x  # StatsListener activation sampling
                 with self._timed_dispatch(tel, _h_step):
-                    (self.params, self.updater_state, self.state,
-                     self._sentinel, loss) = \
-                        self._train_step(self.params, self.updater_state,
-                                         self.state, step, sub, x, y, fm, lm,
-                                         self._ensure_sentinel())
+                    if fused:
+                        (self.params, params_c, self.updater_state,
+                         self.state, self._sentinel, loss) = \
+                            self._train_step(self.params, params_c,
+                                             self.updater_state, self.state,
+                                             step, sub, x, y, fm, lm,
+                                             self._ensure_sentinel())
+                    else:
+                        (self.params, self.updater_state, self.state,
+                         self._sentinel, loss) = \
+                            self._train_step(self.params, self.updater_state,
+                                             self.state, step, sub, x, y,
+                                             fm, lm,
+                                             self._ensure_sentinel())
                 # keep the loss on device: score() syncs lazily, so the train
                 # loop never blocks on the host (async dispatch back-to-back)
                 self._score = loss

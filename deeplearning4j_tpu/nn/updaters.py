@@ -305,6 +305,48 @@ def apply_leafwise(updater, grads, state, params, step):
     return _tmap(lambda p, d: p - d, params, delta), new_state
 
 
+def _cast_leaf(p, compute_dtype):
+    """Per-leaf rendition of ``dtypes.cast_floating``: floating leaves to
+    the compute dtype, everything else (ints/bools, quantized tensors)
+    untouched — the fused-cast outputs must be EXACTLY what a standalone
+    ``cast_floating`` sweep over the fresh params would produce."""
+    if getattr(p, "__quantized_tensor__", False):
+        return p
+    if hasattr(p, "dtype") and jnp.issubdtype(p.dtype, jnp.floating):
+        return p.astype(compute_dtype)
+    return p
+
+
+def apply_leaf_cast(updater, grad, slots, param, step, compute_dtype):
+    """:func:`apply_leaf` with the mixed-precision master cast folded into
+    the parameter write: returns ``(new_param, new_param_compute,
+    new_slots)`` where ``new_param_compute = new_param.astype(compute)``
+    emitted by the SAME fusion that writes the f32 master (ISSUE 16 — the
+    fused master-cast+updater step). The unfused program pays a separate
+    full-params HBM sweep for this cast at the top of every forward
+    (``master_cast_ms`` in the r18 BERT phase audit); here the cast rides
+    the updater's write while ``new_param`` is still in registers.
+
+    The f32 master arithmetic is untouched — ``new_param`` is
+    bit-identical to :func:`apply_leaf`'s, and the compute copy is
+    bit-identical to casting after the fact (f32->bf16 rounding of the
+    same value) — so fused and unfused training trajectories match
+    exactly (asserted in tests). Elementwise like :func:`apply_leaf`:
+    the ZeRO-1 shard contract carries over to both outputs."""
+    new_param, new_slots = apply_leaf(updater, grad, slots, param, step)
+    return new_param, _cast_leaf(new_param, compute_dtype), new_slots
+
+
+def apply_leafwise_cast(updater, grads, state, params, step, compute_dtype):
+    """Tree-level :func:`apply_leaf_cast`: the form the engines' fused
+    train steps use. Returns ``(new_params, new_params_compute,
+    new_state)``."""
+    new_params, new_state = apply_leafwise(updater, grads, state, params,
+                                           step)
+    new_params_c = _tmap(lambda p: _cast_leaf(p, compute_dtype), new_params)
+    return new_params, new_params_c, new_state
+
+
 def apply_fused(updater, grads, state, params, step):
     """Flat-buffer updater application — the TPU rendition of DL4J's
     flat-param contract (SURVEY.md §7.3.5: one contiguous param/grad

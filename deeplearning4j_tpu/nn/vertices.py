@@ -135,11 +135,15 @@ class LayerVertex(GraphVertex):
             shape = (flat,)
         return self.layer.initialize(key, shape, dtype)
 
-    def apply(self, params, xs, state, *, train=False, rng=None, masks=None):
+    def apply(self, params, xs, state, *, train=False, rng=None, masks=None,
+              fold_act=None):
         x = xs[0]
         if self._flatten:
             x = x.reshape(x.shape[0], -1)
         mask = _first_mask(masks)
+        if fold_act is not None:  # BN+act epilogue fold (ISSUE 16)
+            return self.layer.apply(params, x, state, train=train, rng=rng,
+                                    mask=mask, fold_act=fold_act)
         return self.layer.apply(params, x, state, train=train, rng=rng,
                                 mask=mask)
 

@@ -61,6 +61,10 @@ AXIS_CANDIDATES = 4
 _EVENTS = _tel.counter(
     "flash_attention.autotune",
     "block-shape autotuner events (hit / default / sweep / sweep_candidate)")
+_EP_EVENTS = _tel.counter(
+    "fused_epilogues.autotune",
+    "epilogue row-block autotuner events (hit / default / sweep / "
+    "sweep_candidate)")
 
 _lock = threading.RLock()
 _cache: Dict[tuple, dict] = {}
@@ -92,6 +96,17 @@ def counters() -> dict:
 
 def reset_counters() -> None:
     _EVENTS.zero()
+
+
+def epilogue_counters() -> dict:
+    """Epilogue-tuner outcome counts — a view over the registry's
+    ``fused_epilogues.autotune{event=}`` counter (ISSUE 16)."""
+    return {k: int(_EP_EVENTS.value(event=k))
+            for k in ("hit", "default", "sweep", "sweep_candidate")}
+
+
+def reset_epilogue_counters() -> None:
+    _EP_EVENTS.zero()
 
 
 # ----------------------------------------------------------------- keys
@@ -264,6 +279,160 @@ def get_blocks(tq, tk, d, dtype, has_bias, *, concrete: bool = False,
     return default
 
 
+# ------------------------------------------------- fused-epilogue keys
+# The epilogue kernels (ops/fused_epilogues.py) expose one schedule knob:
+# the row-block size of the (rows // block,) grid. Same sweep-and-cache
+# discipline as the attention keys, same disk file, distinct key prefix
+# ("epilogue", kind, rows, cols, dtype) and a distinct registry counter so
+# the two kernel families' tuner health is separable on /metrics.
+
+def epilogue_cache_key(kind: str, rows: int, cols: int, dtype) -> tuple:
+    return ("epilogue", str(kind), int(rows), int(cols),
+            str(np.dtype(dtype)))
+
+
+def epilogue_candidates(kind: str, rows: int, cols: int,
+                        dtype) -> List[int]:
+    """Feasible row blocks for one epilogue key (descending): the largest
+    few sublane-multiple divisors of ``rows`` that fit the kernel's VMEM
+    budget — every candidate is a shape the dispatcher would accept."""
+    from . import fused_epilogues as _fe
+    mult = _fe._row_mult(dtype)
+    itemsize = np.dtype(dtype).itemsize
+    out: List[int] = []
+    b = min(MAX_BLOCK, int(rows))
+    b -= b % mult
+    while b >= mult and len(out) < AXIS_CANDIDATES:
+        if rows % b == 0 and _fe.fits_vmem_epilogue(b, cols, itemsize, kind):
+            out.append(b)
+        b -= mult
+    return out
+
+
+def _valid_epilogue_blocks(blocks, kind, rows, cols, dtype) -> bool:
+    from . import fused_epilogues as _fe
+    try:
+        br = int(blocks[0])
+    except (TypeError, ValueError, IndexError):
+        return False
+    mult = _fe._row_mult(dtype)
+    return (br >= mult and br % mult == 0 and rows % br == 0
+            and _fe.fits_vmem_epilogue(br, cols,
+                                       np.dtype(dtype).itemsize, kind))
+
+
+def epilogue_blocks(kind: str, rows: int, cols: int, dtype, *,
+                    concrete: bool = False) -> Optional[int]:
+    """Row block for one epilogue key — the :func:`get_blocks` contract
+    (swept hit > inline sweep when concrete on TPU > seeded default),
+    scalar-valued since the epilogue grid has one axis. Returns None when
+    nothing tiles (the dispatcher already guarded, so only for degenerate
+    keys)."""
+    from . import fused_epilogues as _fe
+    key = epilogue_cache_key(kind, rows, cols, dtype)
+    can_sweep = (concrete and _state["mode"] == "auto"
+                 and jax.default_backend() == "tpu")
+    with _lock:
+        _ensure_loaded()
+        e = _cache.get(key)
+        if e is not None and not _valid_epilogue_blocks(
+                e.get("blocks"), kind, rows, cols, dtype):
+            del _cache[key]
+            e = None
+        if e is not None and not (can_sweep and e.get("source") != "sweep"):
+            _EP_EVENTS.inc(event="hit")
+            return int(e["blocks"][0])
+    if can_sweep:
+        e = epilogue_sweep(kind, rows, cols, dtype)
+        return int(e["blocks"][0]) if e else None
+    default = _fe.row_block(rows, _fe._row_mult(dtype))
+    if default is None:
+        return None
+    with _lock:
+        _cache.setdefault(key, {"blocks": [int(default)],
+                                "source": "default"})
+    _EP_EVENTS.inc(event="default")
+    return default
+
+
+def _time_epilogue_candidate(kind, rows, cols, dtype, br, interpret,
+                             repeats: int) -> float:
+    """Seconds (min over repeats) for one fwd+bwd through the epilogue
+    kernel at row block ``br`` on synthetic operands."""
+    from . import fused_epilogues as _fe
+    rng = np.random.default_rng(0)
+    x2 = jnp.asarray(rng.normal(size=(rows, cols)) * 0.5, dtype)
+    v1 = jnp.asarray(rng.normal(size=(1, cols)) * 0.5,
+                     jnp.float32 if kind == "affine" else dtype)
+    v2 = jnp.asarray(rng.normal(size=(1, cols)) * 0.5, v1.dtype)
+
+    if kind == "ln":
+        def loss(x_, g_, b_):
+            y = _fe._ln_act(x_, g_, b_, 1e-6, "gelu", br, interpret)
+            return jnp.sum(y.astype(jnp.float32))
+    else:
+        def loss(x_, g_, b_):
+            y = _fe._affine_act(x_, g_, b_, "relu", br, interpret)
+            return jnp.sum(y.astype(jnp.float32))
+
+    fn = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+    _tel.record_compile("fused_epilogues.autotune", "autotune",
+                        blocks=[int(br)], kind=str(kind),
+                        rows=int(rows), cols=int(cols))
+    _EP_EVENTS.inc(event="sweep_candidate")
+
+    def run():
+        gs = fn(x2, v1, v2)
+        return float(jnp.sum(gs[0].astype(jnp.float32)))  # force readback
+
+    run()  # compile + settle
+    best = float("inf")
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        run()
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def epilogue_sweep(kind: str, rows: int, cols: int, dtype, *,
+                   interpret: bool = False,
+                   repeats: int = 3) -> Optional[dict]:
+    """Measure every candidate row block for one epilogue key and cache
+    the winner — the :func:`sweep` contract (TPU-only unless
+    ``interpret=True``; interpreter entries tagged for re-sweep)."""
+    if not interpret and jax.default_backend() != "tpu":
+        raise RuntimeError(
+            "autotune.epilogue_sweep() timings are only meaningful on TPU; "
+            "CPU runs use pre-seeded defaults (pass interpret=True to "
+            "exercise the sweep machinery in tests)")
+    cands = epilogue_candidates(kind, rows, cols, dtype)
+    if not cands:
+        return None
+    timings = []
+    for br in cands:
+        dt = _time_epilogue_candidate(kind, rows, cols, dtype, br,
+                                      interpret, repeats)
+        timings.append({"blocks": [int(br)], "us": round(dt * 1e6, 2)})
+    best = min(timings, key=lambda t: t["us"])
+    entry = {
+        "blocks": best["blocks"],
+        "source": "sweep_interpret" if interpret else "sweep",
+        "us": best["us"],
+        "candidates": timings,
+        "backend": jax.default_backend(),
+    }
+    key = epilogue_cache_key(kind, rows, cols, dtype)
+    with _lock:
+        _cache[key] = entry
+    _EP_EVENTS.inc(event="sweep")
+    if _cache_path():
+        try:
+            save()
+        except OSError:
+            pass  # persistence is best-effort; the process cache holds
+    return dict(entry)
+
+
 def _norm_shape(shape) -> tuple:
     """Normalize a warmup/seed shape spec: 5-tuples are one-shot keys,
     6-tuples carry a trailing decode flag."""
@@ -345,6 +514,20 @@ def load(path: Optional[str] = None, merge: bool = True) -> int:
             _cache.clear()
         for ent in snap.get("entries", []):
             raw = ent["key"]
+            if str(raw[0]) == "epilogue":
+                kind, rows, cols = str(raw[1]), int(raw[2]), int(raw[3])
+                dt = str(raw[4])
+                key = epilogue_cache_key(kind, rows, cols, dt)
+                if not _valid_epilogue_blocks(ent.get("blocks"), kind,
+                                              rows, cols, dt):
+                    continue  # stale/hand-edited entry: never serve it
+                cur = _cache.get(key)
+                if cur is not None and cur.get("source") != "default" \
+                        and ent.get("source") == "default":
+                    continue
+                _cache[key] = {k: v for k, v in ent.items() if k != "key"}
+                n += 1
+                continue
             tail = [str(x) for x in raw[5:]]
             decode = "decode" in tail
             page = next((int(t[4:]) for t in tail

@@ -981,6 +981,13 @@ def check_source(source: str, rel: str = "<fixture>",
 JAXPR_RULES = ("no-param-cast-in-scan", "no-host-callback",
                "no-f32-leak-under-bf16-policy", "donation-applied")
 
+# Opt-in rules (ISSUE 16): only checked when the caller declares the
+# program SHOULD be fused (``expect_fusion=True`` / the CLI fusion
+# probe). A dispatcher that silently falls back leaves the program
+# numerically right but slow — exactly the failure mode runtime tests
+# can't see, so the lint gate traces the real step and inspects it.
+FUSION_RULES = ("fusion-applied-epilogue", "fusion-applied-updater")
+
 _CALLBACK_PRIMS = ("pure_callback", "io_callback", "debug_callback",
                    "outside_call", "host_callback", "callback")
 _LOOP_PRIMS = ("scan", "while")
@@ -1003,6 +1010,7 @@ def jaxpr_audit(fn, args=(), rules: Optional[Sequence[str]] = None, *,
                 param_shapes: Sequence[Tuple[int, ...]] = (),
                 policy: Optional[str] = None,
                 expect_donation: bool = False,
+                expect_fusion: bool = False,
                 lowered_text: Optional[str] = None,
                 label: str = "<fn>") -> List[Finding]:
     """Audit a compiled program's jaxpr against the Tier B rules — the
@@ -1022,9 +1030,20 @@ def jaxpr_audit(fn, args=(), rules: Optional[Sequence[str]] = None, *,
     - ``donation-applied``: the lowered program carries input/output
       aliasing (``expect_donation=True`` + ``lowered_text``) — donation
       silently not applying doubles peak HBM.
+    - ``fusion-applied-epilogue`` (``expect_fusion=True`` only): the
+      program contains at least one ``pallas_call`` — a build that
+      claims epilogue fusion but lowered zero kernels silently fell
+      back to the standalone BN-then-activation chain.
+    - ``fusion-applied-updater`` (``expect_fusion=True`` only): no
+      top-level f32->16-bit ``convert_element_type`` reads a program
+      INPUT with a ndim>=2 ``param_shapes`` shape — that is the
+      standalone master cast-sweep at the head of the step; the fused
+      updater casts only the freshly-updated masters (intermediates).
     """
     import jax
     rules = tuple(rules or JAXPR_RULES)
+    if expect_fusion:
+        rules = rules + tuple(r for r in FUSION_RULES if r not in rules)
     findings: List[Finding] = []
     target = getattr(fn, "__wrapped__", fn)
     closed = jax.make_jaxpr(target)(*args)
@@ -1038,8 +1057,28 @@ def jaxpr_audit(fn, args=(), rules: Optional[Sequence[str]] = None, *,
             mixed16 = str(policy).lower() in ("bfloat16", "float16",
                                               "bf16", "f16", "half")
 
+    top_invars = set(id(v) for v in closed.jaxpr.invars)
+    pallas_calls = [0]
+
     def visit(eqn, inside_loop):
         name = eqn.primitive.name
+        if "pallas_call" in name:
+            pallas_calls[0] += 1
+        if "fusion-applied-updater" in rules and \
+                name == "convert_element_type" and pshapes:
+            iv, ov = eqn.invars[0], eqn.outvars[0]
+            if (id(iv) in top_invars
+                    and str(getattr(iv, "aval", ov.aval).dtype) == "float32"
+                    and str(ov.aval.dtype) in _16BIT
+                    and len(ov.aval.shape) >= 2
+                    and tuple(ov.aval.shape) in pshapes):
+                findings.append(Finding(
+                    "fusion-applied-updater", label, 0,
+                    f"param-shaped f32->{ov.aval.dtype} cast "
+                    f"{tuple(ov.aval.shape)} reads a program input — the "
+                    "standalone master cast-sweep still heads the step; "
+                    "the fused updater was expected to fold it into the "
+                    "updater write (silent fallback?)"))
         if "no-host-callback" in rules and any(
                 c in name for c in _CALLBACK_PRIMS):
             findings.append(Finding(
@@ -1068,6 +1107,12 @@ def jaxpr_audit(fn, args=(), rules: Optional[Sequence[str]] = None, *,
                     "MXU runs at half rate"))
 
     _walk_jaxpr(closed.jaxpr, visit)
+    if "fusion-applied-epilogue" in rules and pallas_calls[0] == 0:
+        findings.append(Finding(
+            "fusion-applied-epilogue", label, 0,
+            "expect_fusion but the compiled program contains zero "
+            "pallas_call kernels — the epilogue dispatcher silently fell "
+            "back to the standalone normalization/activation chain"))
     if "donation-applied" in rules and expect_donation:
         if lowered_text is None and hasattr(fn, "lower"):
             try:
@@ -1120,6 +1165,65 @@ def audit_model(model, batch_size: int, accum_steps: int = 1,
         expect_donation=True, lowered_text=lowered_text, label=label)
 
 
+def fusion_probe() -> List[Finding]:
+    """Trace a tiny bf16 conv->BN->relu model's FUSED train step under
+    ``DL4J_TPU_FUSED_EPILOGUES=force`` and assert the fusion actually
+    lowered (ISSUE 16): at least one ``pallas_call`` in the program and
+    no standalone master cast-sweep reading the step's inputs. Runs from
+    the CLI so ``make lint`` fails on a silent dispatcher fallback —
+    the one regression runtime parity tests cannot catch, because the
+    fallback is bit-identical and only slow. Nothing executes (aval
+    trace only); force mode is restored afterwards."""
+    import jax
+    import numpy as np
+    from .. import dtypes as _dt
+    from ..nn.config import InputType, NeuralNetConfiguration
+    from ..nn.layers.conv import BatchNormalization, ConvolutionLayer
+    from ..nn.layers.core import ActivationLayer, OutputLayer
+    from ..nn.model import MultiLayerNetwork
+    from ..nn.updaters import Sgd
+    from ..ops import fused_epilogues as _fe
+
+    conf = (NeuralNetConfiguration.builder().seed(7)
+            .updater(Sgd(learning_rate=0.05))
+            .data_type("BFLOAT16")
+            .input_type(InputType.convolutional(3, 8, 8,
+                                                data_format="NHWC"))
+            .list(ConvolutionLayer(n_out=8, kernel=(3, 3), mode="same",
+                                   activation="identity",
+                                   data_format="NHWC"),
+                  BatchNormalization(data_format="NHWC"),
+                  ActivationLayer(activation="relu"),
+                  OutputLayer(n_out=3)).build())
+    model = MultiLayerNetwork(conf).init()
+    label = "<fusion_probe bf16 conv/BN/relu batch=4>"
+    prev = _fe.set_mode("force")
+    try:
+        if not model.fused_updater_active():
+            return [Finding(
+                "fusion-applied-updater", label, 0,
+                "fused master-cast updater inactive for a plain bf16 "
+                "Sgd model — route_updater rejected the canonical case")]
+        step = model._build_train_step(fused_cast=True)
+        cdt = _dt.resolve(conf.dtype)
+        pa = jax.eval_shape(lambda: model.params)
+        pca = jax.eval_shape(lambda: _dt.cast_floating(model.params, cdt))
+        oa = jax.eval_shape(lambda: model.updater_state)
+        sa = jax.eval_shape(lambda: model.state)
+        step_aval = jax.ShapeDtypeStruct((), np.int32)
+        key_aval = jax.eval_shape(lambda: jax.random.PRNGKey(0))
+        x = jax.ShapeDtypeStruct((4, 8, 8, 3), np.float32)
+        y = jax.ShapeDtypeStruct((4, 3), np.float32)
+        return jaxpr_audit(
+            step, (pa, pca, oa, sa, step_aval, key_aval, x, y, None, None),
+            rules=(), expect_fusion=True,
+            param_shapes=[tuple(l.shape)
+                          for l in jax.tree.leaves(model.params)],
+            policy=str(conf.dtype), label=label)
+    finally:
+        _fe.set_mode(prev)
+
+
 # ------------------------------------------------------------------- CLI
 
 
@@ -1148,6 +1252,9 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     p.add_argument("--rules", default=None,
                    help="comma-separated rule subset (default: all)")
     p.add_argument("--list-rules", action="store_true")
+    p.add_argument("--no-fusion-probe", action="store_true",
+                   help="skip the Tier B fused-train-step trace (ISSUE "
+                        "16); the AST rules still run")
     p.add_argument("--emit-baseline", action="store_true",
                    help="print baseline-entry skeletons for the open "
                         "findings (add a reason to each before checking "
@@ -1170,6 +1277,10 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     except ValueError as e:  # malformed baseline
         print(f"staticcheck: {e}", file=sys.stderr)
         return 2
+    if not args.no_fusion_probe and rules is None:
+        # Tier B gate: a silent epilogue/updater fallback is invisible to
+        # parity tests (bit-identical, just slow) — fail the lint build.
+        rep.findings.extend(fusion_probe())
     if args.emit_baseline:
         print(json.dumps({"entries": [
             {"rule": f.rule, "path": f.path,

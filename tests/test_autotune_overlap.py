@@ -718,8 +718,10 @@ def _const_shaped_bf16_converts(sd, ov):
              "labels": jnp.zeros((4, 4), jnp.float32)}
     _spec, step = sd._make_fit_step()
     opt = sd.updater.init_state(tv)
+    # carry helper, not the bare dict: under the bf16 policy the fused
+    # master-cast updater step (ISSUE 16) takes (masters, compute_copies)
     jaxpr = jax.make_jaxpr(step.__wrapped__)(
-        tv, opt, ov, jnp.int32(0), feeds)
+        sd._fit_carry(tv), opt, ov, jnp.int32(0), feeds)
     const_shapes = {(16, 16)}  # w_frozen; disjoint from every tv shape
     found = []
 

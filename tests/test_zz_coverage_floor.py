@@ -30,7 +30,7 @@ GRAD_FLOOR = 0.95
 _MARKING_FILES = {"test_conv3d_capsules.py", "test_flash_attention.py",
                   "test_m17_breadth.py", "test_ops.py", "test_ops_math.py",
                   "test_ops_grad_r5.py", "test_quantized_serving.py",
-                  "test_paged_kv.py"}
+                  "test_paged_kv.py", "test_fused_epilogues.py"}
 
 
 def test_workspace_policy_coverage_floor(request):
@@ -119,7 +119,11 @@ def test_telemetry_metric_floor(request):
               "test_schedule_tuner.py",
               # staticcheck analyzer (ISSUE 15): the only writer of
               # staticcheck.findings / staticcheck.runs
-              "test_staticcheck.py"}
+              "test_staticcheck.py",
+              # fused-epilogue kernel library (ISSUE 16): the guaranteed
+              # writer of fused_epilogues.dispatch{decision=} and
+              # fused_epilogues.autotune{event=}
+              "test_fused_epilogues.py"}
     missing = needed - collected
     if missing:
         pytest.skip(f"chunked run (telemetry-ledger-marking files not "
