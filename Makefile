@@ -4,7 +4,7 @@
 PY ?= python
 
 .PHONY: test test-fast lint multihost-sim multihost-smoke bench \
-	bench-generative bench-kernels trace-demo tune
+	bench-generative bench-kernels bench-pod-serving trace-demo tune
 
 # ISSUE 15: JAX-aware static analysis (runtime/staticcheck.py) — the
 # repo's hand-enforced invariants as machine-checked rules. Exits
@@ -50,6 +50,16 @@ bench:
 bench-generative:
 	env JAX_PLATFORMS=cpu $(PY) -c "import json, bench; \
 print(json.dumps(bench.bench_generative_serving(), indent=1))"
+
+# ISSUE 17: the tensor-parallel pod-serving metric standalone — TP-vs-
+# single-device interleaved A/B on a 4-virtual-device CPU mesh, with
+# greedy bit-parity, per-device pool-bytes == full/k, zero post-warmup
+# compiles, and the shard_map dispatch mix all hard-asserted in-bench.
+bench-pod-serving:
+	env JAX_PLATFORMS=cpu \
+		XLA_FLAGS=--xla_force_host_platform_device_count=4 \
+		$(PY) -c "import json, bench; \
+print(json.dumps(bench.bench_pod_serving(), indent=1))"
 
 # ISSUE 16: the fused-epilogue kernel-library metric standalone — the
 # fused master-cast+updater step vs the unfused updater-then-cast-sweep

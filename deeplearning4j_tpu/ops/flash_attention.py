@@ -56,6 +56,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.sharding import PartitionSpec as P
 
 from . import register
 from ..environment import precision_for
@@ -789,7 +790,16 @@ _COUNTER_KEYS = ("fused", "fallback_mode", "fallback_platform",
                  # (decode_multiquery) or silently losing it
                  # (decode_multiquery_fallback)
                  "decode_fallback_multiquery", "decode_multiquery",
-                 "decode_multiquery_fallback")
+                 "decode_multiquery_fallback",
+                 # ISSUE 17: tensor-parallel serving decisions. Armed by
+                 # tp_shard_context during engine lowering: heads divide
+                 # the model axis -> per-shard dispatch under shard_map;
+                 # otherwise the GSPMD-partitioned einsum path. Both
+                 # counted — zero silent fallbacks extends to TP.
+                 "decode_tp_shard_map", "decode_fallback_tp_gspmd",
+                 "decode_multiquery_tp_shard_map",
+                 "decode_multiquery_fallback_tp_gspmd",
+                 "fallback_tp_gspmd")
 # dispatch decisions live in the process-wide MetricsRegistry (ISSUE 6):
 # one counter, labeled by decision, so `GET /metrics` exposes the
 # fused-vs-fallback mix; counters()/reset_counters() below are the
@@ -799,8 +809,46 @@ from ..runtime import telemetry as _tel  # noqa: E402  (stdlib-only import)
 _DISPATCH = _tel.counter(
     "flash_attention.dispatch",
     "attention dispatch decisions at trace time (fused vs fallback_*)")
-_state = {"mode": os.environ.get("DL4J_TPU_FLASH_ATTENTION", "auto")}
+_state = {"mode": os.environ.get("DL4J_TPU_FLASH_ATTENTION", "auto"),
+          "tp_mesh": None, "tp_axis": None}
 _FUSABLE_DTYPES = (jnp.float32, jnp.bfloat16, jnp.float16)
+
+
+class tp_shard_context:
+    """Arm tensor-parallel dispatch for the duration of a trace (ISSUE
+    17). The serving engines enter this around ``jit(...).lower(...)``
+    when params/KV are model-axis sharded; while armed,
+    :func:`decode_dispatch` / :func:`decode_multiquery_dispatch` route
+    per-shard under ``shard_map`` when the head axis divides the model
+    axis, and :func:`attention` + indivisible decode shapes take the
+    GSPMD-partitioned einsum path — every decision counted. Consulted at
+    TRACE time only (same contract as :func:`set_mode`): warmed
+    executables keep whichever path was traced into them. Re-entrant;
+    not thread-safe (lowering happens under the engine lock)."""
+
+    def __init__(self, mesh, axis: str):
+        self.mesh = mesh
+        self.axis = axis
+        self._prev = (None, None)
+
+    def __enter__(self):
+        self._prev = (_state["tp_mesh"], _state["tp_axis"])
+        _state["tp_mesh"] = self.mesh
+        _state["tp_axis"] = self.axis
+        return self
+
+    def __exit__(self, *exc):
+        _state["tp_mesh"], _state["tp_axis"] = self._prev
+        return False
+
+
+def _tp_armed():
+    """(mesh, axis, k) while a tp_shard_context is live, else None."""
+    mesh, axis = _state["tp_mesh"], _state["tp_axis"]
+    if mesh is None or axis is None or axis not in mesh.shape:
+        return None
+    k = int(mesh.shape[axis])
+    return (mesh, axis, k) if k > 1 else None
 
 
 def mode() -> str:
@@ -865,7 +913,15 @@ def _route(q, k, v, bias) -> Optional[str]:
 def attention(q, k, v, bias=None, scale: Optional[float] = None):
     """Guarded attention dispatch: the flash kernel when the route is clear,
     the f32-softmax reference path otherwise. Layers and the SameDiff
-    ``attention.fused_sdpa`` op both enter here."""
+    ``attention.fused_sdpa`` op both enter here.
+
+    Under an armed :class:`tp_shard_context` (TP prefill lowering) the
+    reference einsum path is taken unconditionally: GSPMD partitions the
+    head-sharded contractions itself and the decision is counted under
+    ``fallback_tp_gspmd`` (not silent)."""
+    if _tp_armed() is not None:
+        _DISPATCH.inc(decision="fallback_tp_gspmd")
+        return reference_attention(q, k, v, bias, scale)
     reason = _route(q, k, v, bias)
     if reason is None:
         _DISPATCH.inc(decision="fused")
@@ -897,15 +953,12 @@ def _route_decode(q, k, v) -> Optional[str]:
     return None
 
 
-def decode_dispatch(q, k, v, lengths, scale=None, page: int = 0):
-    """Guarded decode dispatch: the single-query flash kernel when the
-    route is clear, the f32-softmax reference otherwise. The KV-cache
-    layers and the SameDiff ``attention.cached_sdpa`` op both enter here.
-    ``q`` with Tq > 1 (e.g. LearnedSelfAttention's query bank — uniform
-    visibility over the valid cache, NOT the speculative verify's causal
-    window) takes the reference path, counted under its own
-    ``decode_fallback_multiquery`` slug (ISSUE 12 satellite) so it never
-    blends with genuine shape failures or the verify path's decisions."""
+def _decode_dispatch_local(q, k, v, lengths, scale=None, page: int = 0):
+    """The per-device decode dispatch body: single-query flash kernel
+    when the route is clear, f32-softmax reference otherwise. Called
+    directly (bypassing TP routing) from inside the shard_map inner —
+    the TP context is still armed during that trace and re-entering
+    :func:`decode_dispatch` would recurse."""
     if q.ndim == 4 and q.shape[2] == 1:
         reason = _route_decode(q, k, v)
     elif q.ndim == 4 and q.shape[2] > 1:
@@ -920,6 +973,50 @@ def decode_dispatch(q, k, v, lengths, scale=None, page: int = 0):
     C = k.shape[2]
     bias = length_bias(lengths, C)[:, None, None, :]
     return reference_attention(q, k, v, bias=bias, scale=scale)
+
+
+def _tp_head_shard(local_fn, armed, q, k, v, lengths, scale, page):
+    """Run a per-device dispatch body under shard_map with heads (axis 1
+    of the [B, H, *, d] operands) split over the model axis. ``lengths``
+    stays replicated; softmax is per-head so no cross-shard collective
+    is needed (check_rep=False: the head axis is genuinely sharded)."""
+    from jax.experimental.shard_map import shard_map
+    mesh, axis, _ = armed
+    spec4 = P(None, axis, None, None)
+
+    def inner(q_, k_, v_, lengths_):
+        return local_fn(q_, k_, v_, lengths_, scale=scale, page=page)
+
+    return shard_map(inner, mesh=mesh,
+                     in_specs=(spec4, spec4, spec4, P()),
+                     out_specs=spec4, check_rep=False)(q, k, v, lengths)
+
+
+def decode_dispatch(q, k, v, lengths, scale=None, page: int = 0):
+    """Guarded decode dispatch: the single-query flash kernel when the
+    route is clear, the f32-softmax reference otherwise. The KV-cache
+    layers and the SameDiff ``attention.cached_sdpa`` op both enter here.
+    ``q`` with Tq > 1 (e.g. LearnedSelfAttention's query bank — uniform
+    visibility over the valid cache, NOT the speculative verify's causal
+    window) takes the reference path, counted under its own
+    ``decode_fallback_multiquery`` slug (ISSUE 12 satellite) so it never
+    blends with genuine shape failures or the verify path's decisions.
+
+    Under an armed :class:`tp_shard_context` (ISSUE 17): heads divisible
+    by the model-axis size run the per-shard body under ``shard_map``
+    (``decode_tp_shard_map``); otherwise the GSPMD-partitioned reference
+    einsum (``decode_fallback_tp_gspmd``). Both counted."""
+    armed = _tp_armed()
+    if armed is not None and q.ndim == 4:
+        if q.shape[1] % armed[2] == 0:
+            _DISPATCH.inc(decision="decode_tp_shard_map")
+            return _tp_head_shard(_decode_dispatch_local, armed,
+                                  q, k, v, lengths, scale, page)
+        _DISPATCH.inc(decision="decode_fallback_tp_gspmd")
+        C = k.shape[2]
+        bias = length_bias(lengths, C)[:, None, None, :]
+        return reference_attention(q, k, v, bias=bias, scale=scale)
+    return _decode_dispatch_local(q, k, v, lengths, scale=scale, page=page)
 
 
 def _route_multiquery(q, k, v) -> Optional[str]:
@@ -945,14 +1042,10 @@ def _route_multiquery(q, k, v) -> Optional[str]:
     return None
 
 
-def decode_multiquery_dispatch(q, k, v, lengths, scale=None, page: int = 0):
-    """Guarded multi-query decode dispatch (speculative verify, ISSUE
-    12): the window-causal Tq=k kernel when the route is clear, the
-    reference path with an explicit per-query bias otherwise. ``lengths``
-    [B] counts valid cache entries BEFORE the k-token window. Every
-    decision is counted (``decode_multiquery`` vs
-    ``decode_multiquery_fallback``) — the tier-1 dispatch asserts and
-    ``/metrics`` both see a verify that lost its fused path."""
+def _decode_multiquery_local(q, k, v, lengths, scale=None, page: int = 0):
+    """Per-device multi-query verify dispatch body (see
+    :func:`_decode_dispatch_local` for why the TP wrapper calls this
+    directly)."""
     reason = _route_multiquery(q, k, v)
     if reason is None:
         _DISPATCH.inc(decision="decode_multiquery")
@@ -961,6 +1054,30 @@ def decode_multiquery_dispatch(q, k, v, lengths, scale=None, page: int = 0):
                                            interpret=not _tpu_available())
     _DISPATCH.inc(decision=reason)
     return reference_decode_multiquery(q, k, v, lengths, scale=scale)
+
+
+def decode_multiquery_dispatch(q, k, v, lengths, scale=None, page: int = 0):
+    """Guarded multi-query decode dispatch (speculative verify, ISSUE
+    12): the window-causal Tq=k kernel when the route is clear, the
+    reference path with an explicit per-query bias otherwise. ``lengths``
+    [B] counts valid cache entries BEFORE the k-token window. Every
+    decision is counted (``decode_multiquery`` vs
+    ``decode_multiquery_fallback``) — the tier-1 dispatch asserts and
+    ``/metrics`` both see a verify that lost its fused path.
+
+    TP routing under an armed :class:`tp_shard_context` mirrors
+    :func:`decode_dispatch` (``decode_multiquery_tp_shard_map`` /
+    ``decode_multiquery_fallback_tp_gspmd``)."""
+    armed = _tp_armed()
+    if armed is not None and q.ndim == 4:
+        if q.shape[1] % armed[2] == 0:
+            _DISPATCH.inc(decision="decode_multiquery_tp_shard_map")
+            return _tp_head_shard(_decode_multiquery_local, armed,
+                                  q, k, v, lengths, scale, page)
+        _DISPATCH.inc(decision="decode_multiquery_fallback_tp_gspmd")
+        return reference_decode_multiquery(q, k, v, lengths, scale=scale)
+    return _decode_multiquery_local(q, k, v, lengths, scale=scale,
+                                    page=page)
 
 
 @register("attention.fused_sdpa", category="attention")

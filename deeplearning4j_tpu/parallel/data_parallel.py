@@ -238,35 +238,24 @@ class ParallelWrapper:
         """Top-level param keys (layer index / vertex name) whose layer is
         in the dense family — the only layers TP shards. Matching on the
         leaf name 'W' alone would also catch embedding tables and LSTM/GRU
-        input kernels, whose per-step collectives hurt the TP path."""
-        from ..nn.layers.core import (DenseLayer, LossLayer, OutputLayer)
-        dense = (DenseLayer, OutputLayer, LossLayer)
-        keys = set()
-        if self._is_graph:
-            from ..nn.vertices import LayerVertex
-            for name, v, _ in self.model.conf.vertices:
-                if isinstance(v, LayerVertex) and isinstance(v.layer, dense):
-                    keys.add(str(name))
-        else:
-            for i, lyr in enumerate(self.model.layers):
-                if isinstance(lyr, dense):
-                    keys.add(str(i))
-        return keys
+        input kernels, whose per-step collectives hurt the TP path.
+        Shared with the serving placement layer (ISSUE 17)."""
+        from . import placement as _pl
+        return _pl.dense_tp_keys(self.model)
 
     def _param_spec(self, path: tuple, arr) -> P:
-        """PartitionSpec for one parameter leaf under tensor parallelism."""
+        """PartitionSpec for one parameter leaf under tensor parallelism —
+        the training contract: dense family only (``attn_heads=None``;
+        serving extends the same derivation with the attention family
+        through ``ParamsPlacement``)."""
+        from . import placement as _pl
         if self.model_axis is None:
             return P()
         if self._dense_key_cache is None:
             self._dense_key_cache = self._dense_keys()
-        if not path or str(path[0]) not in self._dense_key_cache:
-            return P()
-        name = path[-1]
-        if name == "W" and getattr(arr, "ndim", 0) == 2:
-            return P(None, self.model_axis)     # dense kernel: shard out-dim
-        if name == "b" and getattr(arr, "ndim", 0) == 1:
-            return P(self.model_axis)
-        return P()
+        return _pl.tp_param_spec(
+            tuple(str(p) for p in path), arr, self.model_axis,
+            int(self.mesh.shape[self.model_axis]), self._dense_key_cache)
 
     def _update_spec(self, path: tuple, arr) -> P:
         """PartitionSpec for one UPDATER-STATE leaf under the sharded weight
@@ -402,25 +391,12 @@ class ParallelWrapper:
 
         multi_host = jax.process_count() > 1
 
-        def put(t, sharding):
-            """Place one FULL-VALUE array (params / opt state / BN state /
-            sentinel — every host holds the entire logical value) onto
-            ``sharding``. Multi-host: each host materializes only its
-            addressable shards via ``make_array_from_callback`` slicing
-            the full local value. NOT ``make_array_from_process_local_
-            data`` — that API's contract is "local value = this host's
-            shard", which for a ZeRO-1 opt-state leaf sharded over the
-            pod-wide data axis would concatenate the hosts' (identical)
-            full copies into a double-width global (observed: a (6,16)
-            Adam slot became (6,32)). Arrays already carrying the target
-            sharding (step outputs fed back in) pass through."""
-            if isinstance(t, jax.Array) and t.sharding == sharding:
-                return t
-            if multi_host:
-                arr = np.asarray(t)
-                return jax.make_array_from_callback(
-                    arr.shape, sharding, lambda idx: arr[idx])
-            return jax.device_put(t, sharding)
+        # FULL-VALUE placement (params / opt state / BN state / sentinel —
+        # every host holds the entire logical value): the shared placement
+        # layer's put (ISSUE 17); see placement.put_full for the
+        # full-value vs host-shard contract (the (6,16)->(6,32) Adam-slot
+        # incident lives in its docstring now).
+        from .placement import put_full as put
 
         def shard_batch(t):
             """Batch-sharded placement for one array, a tuple of arrays
@@ -559,10 +535,11 @@ class ParallelWrapper:
         with overlap ON describes a differently-scheduled program than
         one with overlap OFF, and the tuner seeding from the cache must
         never read across that boundary."""
+        from . import placement as _pl
         return {"su": int(self.shard_update),
                 "ov": int(self.overlap_grads),
                 "mb": self.overlap_bucket_bytes / (1 << 20),
-                "mesh": "x".join(str(s) for s in self.mesh.devices.shape)}
+                "mesh": _pl.mesh_key(self.mesh)}
 
     def attribution_report(self, batch_size: int, steps: int = 3,
                            seq_len=None, peaks=None,
@@ -662,6 +639,7 @@ class ParallelWrapper:
         if "data" not in self.mesh.axis_names:
             raise ValueError("serving_engine needs a 'data' mesh axis; "
                              f"mesh has {self.mesh.axis_names}")
+        kwargs.setdefault("model_axis", self.model_axis or "model")
         return InferenceEngine(self.model, mesh=self.mesh, **kwargs)
 
     def fit(self, data, epochs: int = 1, resilience=None):

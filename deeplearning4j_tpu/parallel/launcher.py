@@ -285,7 +285,7 @@ def _group_by_host(devices, hosts: Optional[int] = None):
 
 
 def pod_mesh(model: int = 1, devices: Optional[Sequence] = None,
-             hosts: Optional[int] = None):
+             hosts: Optional[int] = None, model_span: str = "host"):
     """2-D DCN-aware ``('data', 'model')`` multi-host mesh (ISSUE 10).
 
     Placement rule: the **model** (tensor-parallel) axis is laid over
@@ -308,10 +308,22 @@ def pod_mesh(model: int = 1, devices: Optional[Sequence] = None,
     membership decides). Works unchanged through ``ParallelWrapper``:
     batch shards over ``'data'``, ``model_axis="model"`` composes, and
     ``shard_update``/``overlap_grads`` ride the data axis.
+
+    ``model_span="pod"`` (ISSUE 17) lifts the one-host restriction: the
+    model axis is laid host-major over the whole pod, so a model whose
+    shards cannot fit one host's HBM still serves as a SINGLE sharded
+    replica. The per-layer TP collectives then ride DCN — the documented
+    tradeoff for pod serving, where "exists at all" beats "ICI-fast" and
+    decode steps are latency-tolerant relative to a training step.
+    ``model`` must divide the total device count; requires
+    ``model_span`` in ``("host", "pod")``.
     """
     import jax
     from jax.sharding import Mesh
 
+    if model_span not in ("host", "pod"):
+        raise ValueError(
+            f"model_span={model_span!r} not in ('host', 'pod')")
     devs = list(devices) if devices is not None else jax.devices()
     groups = _group_by_host(devs, hosts)
     locals_ = {len(g) for g in groups}
@@ -320,12 +332,27 @@ def pod_mesh(model: int = 1, devices: Optional[Sequence] = None,
             f"ragged pod: per-host device counts differ "
             f"({sorted(len(g) for g in groups)}); a mesh needs equal hosts")
     local = locals_.pop()
+    if model_span == "pod":
+        total = len(groups) * local
+        if model < 1 or total % model:
+            raise ValueError(
+                f"model={model} must divide the pod device count {total} "
+                "when model_span='pod'")
+        flat = [d for g in groups for d in g]
+        data = total // model
+        arr = np.empty((data, model), dtype=object)
+        for row in range(data):
+            arr[row, :] = flat[row * model:(row + 1) * model]
+        if model == 1:
+            return Mesh(arr[:, 0], ("data",))
+        return Mesh(arr, ("data", "model"))
     if model < 1 or local % model:
         raise ValueError(
             f"model={model} must divide the per-host device count {local}: "
             "the model axis must stay inside one host (ICI-adjacent) — "
             "tensor-parallel collectives on the DCN hop would dominate the "
-            "step")
+            "step (serve a too-big-for-one-host model with "
+            "model_span='pod')")
     data = len(groups) * (local // model)
     arr = np.empty((data, model), dtype=object)
     row = 0

@@ -1,0 +1,539 @@
+"""Mesh-aware params/KV placement layer (ISSUE 17 tentpole, layer 1).
+
+Training already knows how to lay a params tree over a pod mesh
+(``ParallelWrapper``'s GSPMD specs, r7/r15); the serving engines each
+re-derived a private slice of that machinery — identity-cached
+``device_put`` walks, a placement fingerprint keyed into the bucket
+cache, and the quantized-params source. This module is the shared
+substrate both sides ride:
+
+- **TP spec derivation** (:func:`tp_param_spec`): the dense-family rule
+  extracted from ``ParallelWrapper._param_spec`` (``W [in, out]`` shards
+  its out-dim over the model axis, ``b [out]`` follows), extended for
+  serving with the attention family — ``Wq/Wk/Wv [f, H*hs]`` column-
+  shard so each device owns whole heads (no cross-shard reduction in the
+  projection), ``Wo [H*hs, out]`` row-shards (one psum per layer),
+  biases follow their sharded dim. Attention params shard only when the
+  layer's head count divides the model-axis size; everything else
+  replicates — replication is always correct, sharding is the
+  optimization.
+- **QuantizedTensor awareness**: a pytree-registered int8 leaf places as
+  one unit — ``q`` gets the weight spec; the f32 ``scale [channels]``
+  (always the out-channel axis, the r14 cast rule keeps it f32) shards
+  with the model axis exactly when the weight spec put the model axis on
+  the quantized axis, else replicates.
+- **KV head sharding** (:func:`cache_sharding_tree`): contiguous decode
+  caches ``[S, H, C, d]`` and paged pool payloads ``[n_pages*P, H, d]``
+  split their head axis ``H/k`` per device. The page-row axis must NOT
+  shard over data — the host-side int32 page table indexes arbitrary
+  rows, so every device needs every row of its head slice. int8 KV
+  scale leaves (``[.., H, .., 1]``) carry the same head axis and shard
+  identically.
+- **The multi-host put contract** (:func:`put_full`): host full values
+  become global arrays via ``jax.make_array_from_callback`` (every host
+  holds the full value and donates the shards it owns — the same
+  contract as ``ParallelWrapper._build``'s ``put``, where confusing
+  full-value with host-shard placement once doubled an Adam slot).
+- **Identity-cached placement + fingerprint**
+  (:class:`ParamsPlacement`): the engines' per-placement compiled-key
+  machinery, extracted — place once per params identity, fingerprint the
+  leaf shardings so AOT executables are keyed to the placement they were
+  lowered for.
+
+``QuantizedParamsMixin`` (the serving engines' quantized-params source,
+previously private to ``serving/engine.py``) lives here too so the
+placement walk and the quantize walk stay one layer.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Callable, Dict, Optional, Set, Tuple
+
+import numpy as np
+
+import jax
+from jax.sharding import NamedSharding
+from jax.sharding import PartitionSpec as P
+from jax.tree_util import tree_map_with_path
+
+from ..ops import quantize as _q
+from ..runtime import faults as _faults
+from ..runtime import telemetry as _tel
+
+log = logging.getLogger("deeplearning4j_tpu")
+
+# int8 post-training quantization (ISSUE 9) telemetry, declared beside
+# the quantized-params source below; every cell binds engine= (the
+# per-instance anti-blending rule) and dies with its engine through
+# :func:`release_cells`
+_G_Q_SITES = _tel.gauge("serving.quantize.sites",
+                        "weights quantized to int8 in the serving params")
+_G_Q_WBYTES = _tel.gauge("serving.quantize.weight_bytes",
+                         "serving params bytes after quantization")
+_G_Q_SAVED = _tel.gauge("serving.quantize.bytes_saved",
+                        "params bytes saved by int8 quantization")
+_M_Q_REQUANT = _tel.counter(
+    "serving.quantize.requantizations",
+    "weight requantizations after a params update (no recompile: the "
+    "quantized avals are identical)")
+_M_Q_FALLBACK = _tel.counter(
+    "serving.quantize.fallbacks",
+    "quantize requests served f32 instead (env pin or quantization "
+    "failure — the engine degrades, it does not die)")
+
+#: leaf names of the attention projection family and the axis the model
+#: axis lands on when the layer's heads divide it: column-sharded
+#: in-projections (each device computes its own heads' q/k/v — no
+#: collective), row-sharded out-projection (one psum closes the layer)
+_ATTN_COL = ("Wq", "Wk", "Wv")
+_ATTN_COL_B = ("bq", "bk", "bv")
+_ATTN_ROW = ("Wo",)
+
+
+def path_names(path) -> Tuple[str, ...]:
+    """Stringified pytree path (DictKey/SequenceKey/FlattenedIndexKey all
+    carry ``.key``) — the name tuple every spec function matches on."""
+    return tuple(str(getattr(k, "key", k)) for k in path)
+
+
+def dense_tp_keys(model) -> Set[str]:
+    """Top-level param keys (layer index / vertex name) whose layer is in
+    the dense family — extracted from ``ParallelWrapper._dense_keys``.
+    Matching on the leaf name 'W' alone would also catch embedding tables
+    and LSTM/GRU input kernels, whose per-step collectives hurt TP."""
+    from ..nn.layers.core import DenseLayer, LossLayer, OutputLayer
+    dense = (DenseLayer, OutputLayer, LossLayer)
+    keys: Set[str] = set()
+    for key, lyr in _iter_layers(model):
+        if isinstance(lyr, dense):
+            keys.add(key)
+    return keys
+
+
+def attention_tp_heads(model) -> Dict[str, int]:
+    """Top-level param key -> ``n_heads`` for every attention layer — the
+    serving-side extension of the dense family. Per-layer head counts
+    decide per-layer shardability (``n_heads % k == 0``), and the KV
+    cache for a layer shards its head axis exactly when the layer's
+    projections do, so activations and cache stay aligned."""
+    heads: Dict[str, int] = {}
+    for key, lyr in _iter_layers(model):
+        n = getattr(lyr, "n_heads", None)
+        if isinstance(n, int) and n >= 1 and hasattr(lyr, "decode_cache_spec"):
+            heads[key] = n
+    return heads
+
+
+def _iter_layers(model):
+    """(top-level param key, layer) pairs for MLN and graph models."""
+    from ..nn.vertices import LayerVertex
+    if getattr(model, "_is_graph", None) or hasattr(model.conf, "vertices"):
+        verts = getattr(model.conf, "vertices", None)
+        if verts is not None:
+            for name, v, _ in verts:
+                if isinstance(v, LayerVertex):
+                    yield str(name), v.layer
+            return
+    for i, lyr in enumerate(model.layers):
+        yield str(i), lyr
+
+
+def tp_param_spec(names: Tuple[str, ...], leaf, model_axis: Optional[str],
+                  tp: int, dense_keys: Set[str],
+                  attn_heads: Optional[Dict[str, int]] = None) -> P:
+    """PartitionSpec for one parameter leaf under tensor parallelism.
+
+    ``attn_heads=None`` reproduces ``ParallelWrapper._param_spec``
+    exactly (dense family only — the training contract); a head map adds
+    the serving-side attention rules. A ``QuantizedTensor`` leaf is
+    specced by its int8 payload's geometry (see
+    :func:`quantized_shardings` for the scale rule)."""
+    if model_axis is None or tp <= 1:
+        return P()
+    if isinstance(leaf, _q.QuantizedTensor):
+        leaf = leaf.q
+    if not names:
+        return P()
+    top, name = str(names[0]), str(names[-1])
+    ndim = getattr(leaf, "ndim", 0)
+    if top in dense_keys:
+        if name == "W" and ndim == 2:
+            return P(None, model_axis)      # dense kernel: shard out-dim
+        if name == "b" and ndim == 1:
+            return P(model_axis)
+        return P()
+    if attn_heads and top in attn_heads and attn_heads[top] % tp == 0:
+        if name in _ATTN_COL and ndim == 2:
+            return P(None, model_axis)      # each device owns whole heads
+        if name in _ATTN_COL_B and ndim == 1:
+            return P(model_axis)
+        if name in _ATTN_ROW and ndim == 2:
+            return P(model_axis, None)      # out-proj row shard: one psum
+    return P()
+
+
+def quantized_shardings(qt, wspec: P, mesh, model_axis: Optional[str]):
+    """(q, scale) NamedShardings for one ``QuantizedTensor`` leaf. The
+    scale vector ``[channels]`` runs along the quantized axis (always the
+    OUT channel axis, ``ndim - 1``); it shards over the model axis iff
+    the weight spec put the model axis there, else replicates (e.g. a
+    row-sharded ``Wo`` is quantized along its replicated out-dim)."""
+    ndim = getattr(qt.q, "ndim", 0)
+    wtuple = tuple(wspec) + (None,) * (ndim - len(tuple(wspec)))
+    on_q_axis = ndim and qt.axis == ndim - 1 and \
+        wtuple[qt.axis] == model_axis and model_axis is not None
+    sspec = P(model_axis) if on_q_axis else P()
+    return (NamedSharding(mesh, wspec), NamedSharding(mesh, sspec))
+
+
+def sharding_tree(mesh, tree, spec_fn: Callable[[Tuple[str, ...], object], P]):
+    """NamedSharding tree matching ``tree`` (QuantizedTensor leaves place
+    as one unit: a QT of shardings, same pytree structure)."""
+    def leaf(path, a):
+        names = path_names(path)
+        spec = spec_fn(names, a)
+        if isinstance(a, _q.QuantizedTensor):
+            qsh, ssh = quantized_shardings(
+                a, spec, mesh, _spec_axis(spec))
+            return _q.QuantizedTensor(qsh, ssh, a.axis)
+        return NamedSharding(mesh, spec)
+    return tree_map_with_path(
+        leaf, tree, is_leaf=lambda x: isinstance(x, _q.QuantizedTensor))
+
+
+def _spec_axis(spec: P) -> Optional[str]:
+    for ax in tuple(spec):
+        if ax is not None:
+            return ax if isinstance(ax, str) else ax[0]
+    return None
+
+
+def cache_sharding_tree(mesh, tree, model_axis: str, tp: int,
+                        head_axis: int = 1):
+    """NamedSharding tree for a KV-cache aval/spec tree: the head axis
+    (axis 1 for both contiguous ``[S, H, C, d]`` buckets and paged
+    ``[n_pages*P, H, d]`` pool payloads, scales included) splits ``H/k``
+    per device when divisible, else that leaf replicates. Axis 0 (slot
+    row / page row) stays unsharded: the host page table indexes
+    arbitrary page rows, so a data-axis split would orphan rows."""
+    def leaf(a):
+        shp = getattr(a, "shape", ())
+        if len(shp) > head_axis and tp > 1 and shp[head_axis] % tp == 0:
+            spec = [None] * len(shp)
+            spec[head_axis] = model_axis
+            return NamedSharding(mesh, P(*spec))
+        return NamedSharding(mesh, P())
+    return jax.tree.map(leaf, tree)
+
+
+def put_full(value, sharding):
+    """Place one host FULL VALUE (or an already-global array) under
+    ``sharding``. Multi-host, a host value must go through
+    ``make_array_from_callback`` — every process holds the full value and
+    contributes the shards it owns (the full-value contract from
+    ``ParallelWrapper._build``; the host-shard variant
+    ``make_array_from_process_local_data`` is for batches, and confusing
+    the two once turned a (6,16) Adam slot into (6,32)). Arrays already
+    carrying the target sharding pass through untouched."""
+    if isinstance(value, jax.Array):
+        if value.sharding == sharding:
+            return value
+        if not value.is_fully_addressable and not value.is_fully_replicated:
+            # cross-placement reshard of a distributed array: let the
+            # runtime route it (jax>=0.4.35 device_put reshards)
+            return jax.device_put(value, sharding)
+        value = np.asarray(value)
+    if jax.process_count() > 1:
+        arr = np.asarray(value)
+        return jax.make_array_from_callback(
+            arr.shape, sharding, lambda idx: arr[idx])
+    return jax.device_put(value, sharding)
+
+
+def put_tree(tree, shardings, keep_on_mesh: bool = False, mesh=None):
+    """Per-leaf :func:`put_full` over matching pytrees. With
+    ``keep_on_mesh``, leaves already carrying a NamedSharding on ``mesh``
+    keep their placement (the pre-TP serving semantic: a tensor-parallel
+    leaf left behind by training must not be gathered — that can OOM the
+    exact models TP exists for)."""
+    def leaf(t, sh):
+        if keep_on_mesh and isinstance(t, jax.Array) and \
+                isinstance(getattr(t, "sharding", None), NamedSharding) and \
+                t.sharding.mesh == mesh:
+            return t
+        return put_full(t, sh)
+    return jax.tree.map(leaf, tree, shardings)
+
+
+def placement_fingerprint(*trees) -> str:
+    """Order-insensitive digest of every leaf's sharding — the engines'
+    compiled-key component that keys AOT executables to the placement
+    they were lowered for. ``"host"`` when any leaf is undevice'd."""
+    shs = []
+    for t in trees:
+        shs += [getattr(x, "sharding", None) for x in jax.tree.leaves(t)]
+    if any(s is None for s in shs):
+        return "host"
+    return "|".join(sorted(set(str(s) for s in shs)))
+
+
+def mesh_key(mesh) -> str:
+    """The r18 schedule-key mesh component: device-grid shape as
+    ``"2x4"`` — a report measured on one topology never seeds another."""
+    return "x".join(str(s) for s in mesh.devices.shape)
+
+
+def mesh_suffix(mesh, model_axis: Optional[str] = None) -> str:
+    """Attribution-cache key suffix for a mesh-placed serving program:
+    mesh shape + model-axis (TP) size, so a TP decode step's cached cost
+    fractions never blend with single-device ones (r18 rule)."""
+    tp = int(mesh.shape[model_axis]) \
+        if model_axis and model_axis in mesh.axis_names else 1
+    return f"mesh={mesh_key(mesh)}:tp{tp}"
+
+
+def release_cells(engine_id: str) -> int:
+    """Drop every telemetry cell bound to one engine id (engines register
+    this through ``weakref.finalize`` so per-engine cells die with the
+    engine)."""
+    return _tel.registry.discard_cells(engine=engine_id)
+
+
+def tree_bytes_per_device(tree, shardings) -> int:
+    """PER-DEVICE bytes of a placed (or to-be-placed) tree: each leaf's
+    bytes divided by the product of the mesh-axis sizes its spec shards
+    over. This is the number ``memory_report`` / ``max_batch`` must
+    account under TP — the full-tree bytes over-report a sharded model's
+    per-device footprint by the TP factor (the satellite bugfix)."""
+    total = 0
+    for leaf, sh in zip(jax.tree.leaves(tree), jax.tree.leaves(shardings)):
+        nbytes = int(np.prod(getattr(leaf, "shape", ()) or (1,))) * \
+            np.dtype(leaf.dtype).itemsize
+        denom = 1
+        if isinstance(sh, NamedSharding):
+            for ax in tuple(sh.spec):
+                if ax is None:
+                    continue
+                for a in (ax if isinstance(ax, tuple) else (ax,)):
+                    denom *= int(sh.mesh.shape[a])
+        total += -(-nbytes // denom)
+    return total
+
+
+def load_checkpoint(model, directory: str):
+    """Restore ``model`` in place from a pod ``TrainingCheckpointer``
+    directory (gather-on-save makes the layout topology-independent, so
+    a serving host restores host-side full values regardless of the
+    training topology). The engines' ``warmup(checkpoint=...)`` rides
+    this: restore, then the placement walk loads each host's addressable
+    shards onto the serving mesh. Returns the restored step (None on an
+    empty directory — the model keeps its initialized params)."""
+    from .checkpoint import TrainingCheckpointer
+    ck = TrainingCheckpointer(directory)
+    try:
+        return ck.restore(model)
+    finally:
+        ck.close()
+
+
+class QuantizedParamsMixin:
+    """Quantize-on-warmup params source shared by the serving engines
+    (ISSUE 9; extracted here with the placement machinery — ISSUE 17).
+    ``quantize="int8"`` makes :meth:`_serving_params` hand the
+    executables a per-channel int8 params tree instead of the model's
+    f32 one — quantized ONCE per params identity (warmup pays it; a
+    ``fit()`` rebinding the params requantizes host-side with identical
+    avals, so zero post-warmup compiles survive the transform). The
+    ``DL4J_TPU_QUANT=off`` env pin and any quantization failure (fault
+    site ``serving.quantize``) degrade to f32 serving, sticky + counted
+    — a quantizer bug must not flap executable shapes or kill serving."""
+
+    def _init_quantize(self, quantize: Optional[str]):
+        if quantize not in (None, "int8"):
+            raise ValueError(f"unknown quantize mode {quantize!r} "
+                             "(expected None or 'int8')")
+        self.quantize = quantize
+        self._qparams = None
+        self._qparams_src = None
+        self._q_report = None
+        self._q_disabled: Optional[str] = None   # sticky fallback reason
+
+    def _quantize_active(self) -> bool:
+        return self.quantize is not None and self._q_disabled is None
+
+    def _serving_params(self):
+        """The params tree the executables are compiled over and fed:
+        the model's own tree, or its quantized twin (identity-cached on
+        ``model.params`` — ``fit()`` rebinds the dict, so the cache
+        tracks updates exactly like ``_place_params``)."""
+        if self.quantize is None or self._q_disabled is not None:
+            return self.model.params
+        src = self.model.params
+        if self._qparams_src is src:
+            return self._qparams
+        if _q.mode() == "off" and self._qparams is None:
+            # CI kill switch, evaluated BEFORE anything compiled: serve
+            # f32, counted, sticky (a pin is a process constant — no
+            # shape flapping). Once an engine HAS warmed quantized, the
+            # executables' avals are int8+scale, so a later mode flip
+            # does not stop requantization — handing them f32 params
+            # would be a signature mismatch, and serving stale weights
+            # after a fit() would be silently wrong; use
+            # set_quantize(None) + re-warm to actually leave int8.
+            self._q_disabled = "env_off"
+            self._m_q_fallback.inc()
+            log.warning("DL4J_TPU_QUANT=off: engine quantize=%r request "
+                        "serves f32", self.quantize)
+            return self.model.params
+        try:
+            if _faults.enabled():
+                _faults.trip("serving.quantize")
+            qparams, report = _q.quantize_model_params(self.model)
+        except Exception as e:
+            self._m_q_fallback.inc()
+            if self._qparams is not None:
+                # a REquantization failed after warmup: keep serving the
+                # previous quantized tree (stale scales beat feeding f32
+                # avals to executables compiled for int8). The failed
+                # source is cached so a persistent failure does not
+                # re-walk + re-warn on EVERY request — the next params
+                # rebind (a new identity) retries
+                log.warning("weight requantization failed (%s: %s); "
+                            "serving the previous quantized params",
+                            type(e).__name__, e)
+                self._qparams_src = src
+                return self._qparams
+            # degrade, don't die: f32 serving with the failure counted;
+            # sticky so the executable avals never flap mid-traffic
+            self._q_disabled = "error"
+            log.warning("weight quantization failed (%s: %s); serving "
+                        "f32", type(e).__name__, e)
+            return self.model.params
+        if self._qparams_src is not None:
+            self._m_q_requant.inc()   # params updated -> fresh scales
+        self._qparams = qparams
+        self._qparams_src = src
+        self._q_report = report
+        self._g_q_sites.set(report.sites)
+        total, _qb = _q.quantized_bytes(qparams)
+        self._g_q_wbytes.set(total)
+        self._g_q_saved.set(report.bytes_saved)
+        return qparams
+
+    def _bind_quantize_cells(self):
+        self._m_q_requant = _M_Q_REQUANT.labeled(engine=self._id)
+        self._m_q_fallback = _M_Q_FALLBACK.labeled(engine=self._id)
+        self._g_q_sites = _G_Q_SITES.labeled(engine=self._id)
+        self._g_q_wbytes = _G_Q_WBYTES.labeled(engine=self._id)
+        self._g_q_saved = _G_Q_SAVED.labeled(engine=self._id)
+
+    def set_quantize(self, quantize: Optional[str]):
+        """Flip the engine's quantization mode. Every warmed executable
+        compiled the other params dtype, so the bucket cache is
+        invalidated with cause ``quantize`` — the retrace tracker
+        attributes the rebuilds instead of showing mystery
+        ``new_bucket`` events. Re-warm before traffic."""
+        if quantize not in (None, "int8"):
+            raise ValueError(f"unknown quantize mode {quantize!r} "
+                             "(expected None or 'int8')")
+        self.quantize = quantize
+        self._qparams = None
+        self._qparams_src = None
+        self._q_report = None
+        self._q_disabled = None
+        self.invalidate(cause="quantize")
+        return self
+
+    def _quantize_stats(self) -> dict:
+        out = {"quantize": self.quantize or "off"}
+        if self._q_disabled is not None:
+            out["quantize_fallback"] = self._q_disabled
+        if self._q_report is not None:
+            out["quantized_sites"] = self._q_report.sites
+            out["quantized_bytes_saved"] = self._q_report.bytes_saved
+        return out
+
+
+class ParamsPlacement:
+    """One engine's (or wrapper's) placement policy over one mesh:
+    derives the TP spec trees, places identity-cached params/state, and
+    fingerprints placements for the compiled-key cache.
+
+    ``model_axis`` activates tensor parallelism only when the mesh
+    actually carries that axis with size > 1 — a data-only mesh degrades
+    to the replicated placement the pre-TP engines used, bit-for-bit.
+    """
+
+    def __init__(self, mesh, model=None, model_axis: Optional[str] = "model",
+                 data_axis: str = "data"):
+        self.mesh = mesh
+        self.data_axis = data_axis
+        active = (mesh is not None and model_axis is not None
+                  and model_axis in mesh.axis_names
+                  and int(mesh.shape[model_axis]) > 1)
+        self.model_axis = model_axis if active else None
+        self.tp = int(mesh.shape[model_axis]) if active else 1
+        self._dense = dense_tp_keys(model) if (active and model is not None) \
+            else set()
+        self._attn = attention_tp_heads(model) \
+            if (active and model is not None) else {}
+        self._placed_src: Optional[tuple] = None
+        self._placed: Optional[tuple] = None
+
+    # ------------------------------------------------------------- specs
+    def param_spec(self, names: Tuple[str, ...], leaf) -> P:
+        return tp_param_spec(names, leaf, self.model_axis, self.tp,
+                             self._dense, self._attn)
+
+    def param_shardings(self, params):
+        return sharding_tree(self.mesh, params, self.param_spec)
+
+    def state_shardings(self, state):
+        repl = self.replicated()
+        return jax.tree.map(lambda _: repl, state)
+
+    def cache_shardings(self, cache_tree):
+        """Head-sharded NamedSharding tree for a decode-cache or paged
+        pool aval/spec tree (replicated when TP is inactive)."""
+        if self.model_axis is None:
+            repl = self.replicated()
+            return jax.tree.map(lambda _: repl, cache_tree)
+        return cache_sharding_tree(self.mesh, cache_tree,
+                                   self.model_axis, self.tp)
+
+    def replicated(self) -> NamedSharding:
+        return NamedSharding(self.mesh, P())
+
+    # --------------------------------------------------------- placement
+    def place(self, params, state, src: Optional[tuple] = None,
+              keep_on_mesh: bool = False):
+        """(placed params, placed state), identity-cached on ``src``
+        (default: the trees themselves). TP active forces the derived
+        spec (AOT executables pin these exact in_shardings);
+        ``keep_on_mesh`` preserves the pre-TP keep-what's-on-the-mesh
+        semantic for replicated placements."""
+        key = src if src is not None else (params, state)
+        if self._placed_src is not None \
+                and self._placed_src[0] is key[0] \
+                and self._placed_src[1] is key[1]:
+            return self._placed
+        keep = keep_on_mesh and self.model_axis is None
+        placed = (
+            put_tree(params, self.param_shardings(params),
+                     keep_on_mesh=keep, mesh=self.mesh),
+            put_tree(state, self.state_shardings(state),
+                     keep_on_mesh=keep, mesh=self.mesh),
+        )
+        self._placed_src, self._placed = key, placed
+        return placed
+
+    def invalidate(self):
+        """Forget the cached placement (quantize toggles, new params)."""
+        self._placed_src = self._placed = None
+
+    def fingerprint(self, *trees) -> str:
+        return placement_fingerprint(*trees)
+
+    def suffix(self) -> str:
+        return mesh_suffix(self.mesh, self.model_axis)

@@ -540,6 +540,50 @@ def _check_metric_labels(idx: ModuleIndex):
     yield from _check_metric_labels_in(idx, indexes)
 
 
+# ------------------------------------------- rule: mesh-scoped-metric-label
+
+#: metric-name families whose cells describe a PLACEMENT, not just an
+#: instance (ISSUE 17): the same engine id serving on two different
+#: meshes is two different programs, so the binding must carry
+#: ``mesh=<shape>`` next to its instance label or the cells blend
+#: across topologies the same way unlabeled cells blend across engines.
+MESH_SCOPED_FAMILIES = ("serving.engine.tp",)
+
+
+@rule("mesh-scoped-metric-label",
+      "topology-dependent serving cells must bind mesh=<shape> next to "
+      "their instance label")
+def _check_mesh_labels(idx: ModuleIndex):
+    try:
+        indexes = package_index() if os.path.exists(idx.path) else [idx]
+    except Exception:
+        indexes = [idx]
+    if idx not in indexes:
+        indexes = [idx] + list(indexes)
+    for call, name, assigned, chained in _metric_decls(idx):
+        if not name.startswith(MESH_SCOPED_FAMILIES):
+            continue
+        sites = []
+        if chained is not None:
+            attr, chain_call = chained
+            if attr in _READ_METHODS:
+                continue   # read-side lookup, creates no cell
+            if attr in _WRITE_METHODS:
+                sites = [chain_call]
+        elif assigned is not None:
+            sites = [s for _i, s in
+                     _instance_binding_sites(indexes, assigned)]
+        ok = [s for s in sites if _has_instance_kw(s)
+              and any(kw.arg == "mesh" for kw in s.keywords)]
+        if not ok:
+            yield Finding(
+                "mesh-scoped-metric-label", idx.rel, call.lineno,
+                f"mesh-scoped metric {name!r} must be bound with BOTH an "
+                f"instance label ({'/'.join(INSTANCE_LABEL_KEYS)}) and a "
+                "mesh= label — a TP engine's cells otherwise blend across "
+                "topologies")
+
+
 # -------------------------------------------- rule: registry-lock-discipline
 
 

@@ -1467,10 +1467,14 @@ class ContinuousBatcher:
                 return
             if self.paged:
                 # copy-on-write: every active slot's write position must
-                # land on an exclusively-owned page BEFORE dispatch
+                # land on an exclusively-owned page BEFORE dispatch.
+                # ONE locked refcount snapshot per round (ISSUE 17
+                # satellite) — not one pool-lock round-trip per page.
+                snap = self.engine.pool.ref_snapshot()
                 pairs = []
                 for s in live:
-                    pairs += self.engine.prepare_write(self._state, s, 1)
+                    pairs += self.engine.prepare_write(
+                        self._state, s, 1, ref_snapshot=snap)
                 if pairs:
                     self._state = self.engine.fork(self._state, pairs)
             state, logits = self.engine.decode(
@@ -1532,9 +1536,11 @@ class ContinuousBatcher:
         for s in live:
             for i in range(1, k):
                 x_seq[s, i] = self.token_to_features(int(props[s, i - 1]))
+        snap = self.engine.pool.ref_snapshot()
         pairs = []
         for s in live:
-            pairs += self.engine.prepare_write(self._state, s, k)
+            pairs += self.engine.prepare_write(
+                self._state, s, k, ref_snapshot=snap)
         if pairs:
             self._state = self.engine.fork(self._state, pairs)
         self._state, vlg = self.engine.verify(self._state, x_seq, active)

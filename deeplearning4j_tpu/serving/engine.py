@@ -29,6 +29,7 @@ Works for both engines: ``MultiLayerNetwork`` (single input) and
 
 from __future__ import annotations
 
+import contextlib
 import itertools
 import logging
 import threading
@@ -42,8 +43,10 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from .. import dtypes as _dt
+from ..ops import flash_attention as _fa
 from ..ops import quantize as _q
-from ..runtime import faults as _faults
+from ..parallel import placement as _pl
+from ..parallel.placement import QuantizedParamsMixin as _QuantizedParamsMixin
 from ..runtime import telemetry as _tel
 
 log = logging.getLogger("deeplearning4j_tpu")
@@ -72,24 +75,17 @@ _H_PREFILL = _tel.histogram("serving.phase.prefill_s",
                             "prompt prefill time per admitted request")
 _H_DECODE = _tel.histogram("serving.phase.decode_step_s",
                            "one decode iteration over the slot batch")
-# int8 post-training quantization (ISSUE 9): calibration/dequant telemetry,
-# labeled engine= like every per-instance serving cell (anti-blending rule)
-_G_Q_SITES = _tel.gauge("serving.quantize.sites",
-                        "weights quantized to int8 in the serving params")
-_G_Q_WBYTES = _tel.gauge("serving.quantize.weight_bytes",
-                         "serving params bytes after quantization")
-_G_Q_SAVED = _tel.gauge("serving.quantize.bytes_saved",
-                        "params bytes saved by int8 quantization")
-_M_Q_REQUANT = _tel.counter(
-    "serving.quantize.requantizations",
-    "weight requantizations after a params update (no recompile: the "
-    "quantized avals are identical)")
-_M_Q_FALLBACK = _tel.counter(
-    "serving.quantize.fallbacks",
-    "quantize requests served f32 instead (env pin or quantization "
-    "failure — the engine degrades, it does not die)")
+# int8 post-training quantization (ISSUE 9): the calibration/dequant
+# telemetry and the quantized-params source moved to
+# parallel/placement.py with the rest of the placement machinery
+# (ISSUE 17); the KV gauge stays here (generative engines only)
 _G_Q_KV = _tel.gauge("serving.quantize.kv_bytes",
                      "decode KV-cache bytes at the current bucket")
+# tensor-parallel serving (ISSUE 17): per-engine shard count, labeled
+# engine= AND mesh= — the staticcheck mesh-label rule keys on both
+_G_TP_SHARDS = _tel.gauge(
+    "serving.engine.tp_shards",
+    "model-axis shards serving this engine's params/KV (1 = unsharded)")
 _engine_ids = itertools.count()
 
 
@@ -108,123 +104,6 @@ def default_buckets(max_batch: int = 64, minimum: int = 1) -> List[int]:
         out.append(b)
         b <<= 1
     return out
-
-
-class _QuantizedParamsMixin:
-    """Quantize-on-warmup params source shared by both serving engines
-    (ISSUE 9). ``quantize="int8"`` makes :meth:`_serving_params` hand the
-    executables a per-channel int8 params tree instead of the model's
-    f32 one — quantized ONCE per params identity (warmup pays it; a
-    ``fit()`` rebinding the params requantizes host-side with identical
-    avals, so zero post-warmup compiles survive the transform). The
-    ``DL4J_TPU_QUANT=off`` env pin and any quantization failure (fault
-    site ``serving.quantize``) degrade to f32 serving, sticky + counted
-    — a quantizer bug must not flap executable shapes or kill serving."""
-
-    def _init_quantize(self, quantize: Optional[str]):
-        if quantize not in (None, "int8"):
-            raise ValueError(f"unknown quantize mode {quantize!r} "
-                             "(expected None or 'int8')")
-        self.quantize = quantize
-        self._qparams = None
-        self._qparams_src = None
-        self._q_report = None
-        self._q_disabled: Optional[str] = None   # sticky fallback reason
-
-    def _quantize_active(self) -> bool:
-        return self.quantize is not None and self._q_disabled is None
-
-    def _serving_params(self):
-        """The params tree the executables are compiled over and fed:
-        the model's own tree, or its quantized twin (identity-cached on
-        ``model.params`` — ``fit()`` rebinds the dict, so the cache
-        tracks updates exactly like ``_place_params``)."""
-        if self.quantize is None or self._q_disabled is not None:
-            return self.model.params
-        src = self.model.params
-        if self._qparams_src is src:
-            return self._qparams
-        if _q.mode() == "off" and self._qparams is None:
-            # CI kill switch, evaluated BEFORE anything compiled: serve
-            # f32, counted, sticky (a pin is a process constant — no
-            # shape flapping). Once an engine HAS warmed quantized, the
-            # executables' avals are int8+scale, so a later mode flip
-            # does not stop requantization — handing them f32 params
-            # would be a signature mismatch, and serving stale weights
-            # after a fit() would be silently wrong; use
-            # set_quantize(None) + re-warm to actually leave int8.
-            self._q_disabled = "env_off"
-            self._m_q_fallback.inc()
-            log.warning("DL4J_TPU_QUANT=off: engine quantize=%r request "
-                        "serves f32", self.quantize)
-            return self.model.params
-        try:
-            if _faults.enabled():
-                _faults.trip("serving.quantize")
-            qparams, report = _q.quantize_model_params(self.model)
-        except Exception as e:
-            self._m_q_fallback.inc()
-            if self._qparams is not None:
-                # a REquantization failed after warmup: keep serving the
-                # previous quantized tree (stale scales beat feeding f32
-                # avals to executables compiled for int8). The failed
-                # source is cached so a persistent failure does not
-                # re-walk + re-warn on EVERY request — the next params
-                # rebind (a new identity) retries
-                log.warning("weight requantization failed (%s: %s); "
-                            "serving the previous quantized params",
-                            type(e).__name__, e)
-                self._qparams_src = src
-                return self._qparams
-            # degrade, don't die: f32 serving with the failure counted;
-            # sticky so the executable avals never flap mid-traffic
-            self._q_disabled = "error"
-            log.warning("weight quantization failed (%s: %s); serving "
-                        "f32", type(e).__name__, e)
-            return self.model.params
-        if self._qparams_src is not None:
-            self._m_q_requant.inc()   # params updated -> fresh scales
-        self._qparams = qparams
-        self._qparams_src = src
-        self._q_report = report
-        self._g_q_sites.set(report.sites)
-        total, _qb = _q.quantized_bytes(qparams)
-        self._g_q_wbytes.set(total)
-        self._g_q_saved.set(report.bytes_saved)
-        return qparams
-
-    def _bind_quantize_cells(self):
-        self._m_q_requant = _M_Q_REQUANT.labeled(engine=self._id)
-        self._m_q_fallback = _M_Q_FALLBACK.labeled(engine=self._id)
-        self._g_q_sites = _G_Q_SITES.labeled(engine=self._id)
-        self._g_q_wbytes = _G_Q_WBYTES.labeled(engine=self._id)
-        self._g_q_saved = _G_Q_SAVED.labeled(engine=self._id)
-
-    def set_quantize(self, quantize: Optional[str]):
-        """Flip the engine's quantization mode. Every warmed executable
-        compiled the other params dtype, so the bucket cache is
-        invalidated with cause ``quantize`` — the retrace tracker
-        attributes the rebuilds instead of showing mystery
-        ``new_bucket`` events. Re-warm before traffic."""
-        if quantize not in (None, "int8"):
-            raise ValueError(f"unknown quantize mode {quantize!r} "
-                             "(expected None or 'int8')")
-        self.quantize = quantize
-        self._qparams = None
-        self._qparams_src = None
-        self._q_report = None
-        self._q_disabled = None
-        self.invalidate(cause="quantize")
-        return self
-
-    def _quantize_stats(self) -> dict:
-        out = {"quantize": self.quantize or "off"}
-        if self._q_disabled is not None:
-            out["quantize_fallback"] = self._q_disabled
-        if self._q_report is not None:
-            out["quantized_sites"] = self._q_report.sites
-            out["quantized_bytes_saved"] = self._q_report.bytes_saved
-        return out
 
 
 class InferenceEngine(_QuantizedParamsMixin):
@@ -251,15 +130,23 @@ class InferenceEngine(_QuantizedParamsMixin):
     """
 
     def __init__(self, model, mesh=None, data_axis: str = "data",
-                 min_bucket: int = 1, quantize: Optional[str] = None):
+                 min_bucket: int = 1, quantize: Optional[str] = None,
+                 model_axis: Optional[str] = "model"):
         self.model = model
         self.mesh = mesh
         self.data_axis = data_axis
+        self._placement_layer = None
         if mesh is not None:
             if data_axis not in mesh.axis_names:
                 raise ValueError(f"mesh has no {data_axis!r} axis "
                                  f"(axes: {mesh.axis_names})")
             min_bucket = max(min_bucket, int(mesh.shape[data_axis]))
+            # ISSUE 17: a mesh carrying a model axis (launcher.pod_mesh
+            # (model=k)) serves tensor-parallel — params shard by the
+            # placement layer's TP specs instead of replicating
+            self._placement_layer = _pl.ParamsPlacement(
+                mesh, model=model, model_axis=model_axis,
+                data_axis=data_axis)
         self.min_bucket = max(1, int(min_bucket))
         self._is_graph = hasattr(model.conf, "inputs")
         self._input_shapes = self._model_input_shapes()
@@ -298,6 +185,10 @@ class InferenceEngine(_QuantizedParamsMixin):
         self._h_pad = _H_PAD.labeled(engine=self._id)
         self._h_exec = _H_EXEC.labeled(engine=self._id)
         self._h_unpad = _H_UNPAD.labeled(engine=self._id)
+        if self._placement_layer is not None:
+            _G_TP_SHARDS.labeled(
+                engine=self._id, mesh=_pl.mesh_key(mesh)
+            ).set(self._placement_layer.tp)
         # retrace tracker: why the next compile is happening (armed by
         # invalidate(cause=...), consumed by _get_compiled) + the aval
         # keys ever compiled, so a re-compile of a known bucket shape
@@ -428,8 +319,19 @@ class InferenceEngine(_QuantizedParamsMixin):
         fn = self._forward_fn()
         jitted = jax.jit(fn) if in_sh is None else \
             jax.jit(fn, in_shardings=in_sh)
-        return jitted.lower(params_avals, state_avals,
-                            tuple(xs_avals), tuple(masks_avals))
+        with self._tp_trace():
+            return jitted.lower(params_avals, state_avals,
+                                tuple(xs_avals), tuple(masks_avals))
+
+    def _tp_trace(self):
+        """Arm ``flash_attention``'s tensor-parallel dispatch for the
+        duration of one trace/lower: attention sites route per-shard
+        ``shard_map`` (decode) or the counted GSPMD-partitioned einsum
+        path instead of tracing a Pallas kernel over sharded operands."""
+        pl = self._placement_layer
+        if pl is not None and pl.model_axis is not None:
+            return _fa.tp_shard_context(pl.mesh, pl.model_axis)
+        return contextlib.nullcontext()
 
     @staticmethod
     def _bucket_label(key: Tuple) -> str:
@@ -501,7 +403,8 @@ class InferenceEngine(_QuantizedParamsMixin):
 
     def warmup(self, buckets: Optional[Sequence[int]] = None,
                seq_buckets: Optional[Sequence[int]] = None,
-               bytes_limit: Optional[int] = None) -> "InferenceEngine":
+               bytes_limit: Optional[int] = None,
+               checkpoint: Optional[str] = None) -> "InferenceEngine":
         """Compile every (batch bucket x seq bucket) executable now, via
         the AOT path — after this, requests whose padded shape lands on a
         warmed bucket never trigger a compile. ``seq_buckets`` applies to
@@ -512,7 +415,14 @@ class InferenceEngine(_QuantizedParamsMixin):
         bucket whose serving program FITS the device ``bytes_limit``
         (:meth:`max_batch` — AOT memory accounting, no OOM probing);
         ``bytes_limit`` overrides the device's own limit (required on
-        backends without ``memory_stats``)."""
+        backends without ``memory_stats``).
+
+        ``checkpoint=<dir>`` (ISSUE 17): restore the model from a pod
+        ``TrainingCheckpointer`` directory first, so multi-host warmup is
+        one call — restore host-side, place each host's addressable
+        shards onto the serving mesh, AOT-compile every bucket."""
+        if checkpoint is not None:
+            _pl.load_checkpoint(self.model, checkpoint)
         if self._input_shapes is None:
             raise ValueError("model config has no input shapes "
                              "(input_type(...)); warmup cannot derive "
@@ -721,29 +631,20 @@ class InferenceEngine(_QuantizedParamsMixin):
         return out
 
     def _place_params(self):
-        """Params/state ready for the executables. With a mesh: leaves
-        already living on THIS mesh keep their sharding (a tensor-parallel
-        leaf stays sharded — replicating it would defeat TP and can OOM);
-        everything else replicates onto it. Re-placed once per params
-        identity (fit() rebinds the dict, so identity tracks updates)."""
+        """Params/state ready for the executables — the placement layer's
+        walk (ISSUE 17). Without a model axis, leaves already living on
+        THIS mesh keep their sharding (a tensor-parallel leaf left behind
+        by training stays sharded — replicating it would defeat TP and
+        can OOM) and everything else replicates; with a TP mesh the
+        layer's derived specs are forced (the AOT executables pin them as
+        in_shardings). Re-placed once per params identity (fit() rebinds
+        the dict, so identity tracks updates)."""
         model = self.model
         if self.mesh is None:
             return self._serving_params(), model.state
-        src = self._placed_params_src  # strong refs; id() could be reused
-        if src is None or src[0] is not model.params or \
-                src[1] is not model.state:
-            repl = NamedSharding(self.mesh, P())
-
-            def place(leaf):
-                sh = getattr(leaf, "sharding", None)
-                if isinstance(sh, NamedSharding) and sh.mesh == self.mesh:
-                    return leaf
-                return jax.device_put(leaf, repl)
-
-            self._placed = (jax.tree.map(place, self._serving_params()),
-                            jax.tree.map(place, model.state))
-            self._placed_params_src = (model.params, model.state)
-        return self._placed
+        return self._placement_layer.place(
+            self._serving_params(), model.state,
+            src=(model.params, model.state), keep_on_mesh=True)
 
     # ---------------------------------------------------------------- admin
     def invalidate(self, cause: str = "invalidate"):
@@ -760,6 +661,8 @@ class InferenceEngine(_QuantizedParamsMixin):
             self._placed_params_src = None
             self._placement = None
             self._placement_src = None
+            if self._placement_layer is not None:
+                self._placement_layer.invalidate()
             self._invalidate_cause = cause
             # refresh EVERY pending stale entry too: a bucket invalidated
             # twice before its rebuild is attributed to the most recent
@@ -818,9 +721,21 @@ class InferenceEngine(_QuantizedParamsMixin):
         report = {"bucket": b, "seq_len": t,
                   "quantize": self.quantize or "off",
                   "params_bytes": total,
+                  "params_bytes_per_device": total,
                   "quantized_weight_bytes": qbytes,
                   "temp_bytes": None, "argument_bytes": None,
                   "output_bytes": None, "peak_bytes": None}
+        pl = self._placement_layer
+        if pl is not None:
+            # ISSUE 17 satellite bugfix: under TP the per-device params
+            # footprint is the SHARDED bytes, not the full tree — the
+            # AOT memory_analysis above already accounts per-device
+            # (the lowering pins the sharded in_shardings), and this
+            # field makes the params split explicit
+            report["params_bytes_per_device"] = _pl.tree_bytes_per_device(
+                params, pl.param_shardings(params))
+            report["tp_shards"] = pl.tp
+            report["mesh"] = _pl.mesh_key(pl.mesh)
         cm = _memory.compiled_memory(compiled)
         if cm:
             report.update(cm)
@@ -881,10 +796,16 @@ class InferenceEngine(_QuantizedParamsMixin):
                 if ex is not None:
                     host_s = (pad or 0.0) + (unpad or 0.0)
                     measured_s = ex + host_s
+        # mesh-placed programs key their mesh shape + TP size into the
+        # attribution cache (the r18 fingerprint-key rule): a TP decode
+        # fraction must never seed — or be seeded by — a single-device one
+        key = (f"serving.engine:{type(self.model).__name__}:"
+               f"b{b}xt{t}:{self.quantize or 'f32'}")
+        if self._placement_layer is not None:
+            key += f":{self._placement_layer.suffix()}"
         rep = _attr.attribute_compiled(
             compiled, measured_s=measured_s, host_s=host_s, peaks=peaks,
-            key=f"serving.engine:{type(self.model).__name__}:"
-                f"b{b}xt{t}:{self.quantize or 'f32'}")
+            key=key)
         if measurement_note is not None:
             rep["measurement_note"] = measurement_note
         rep.update({"kind": "serving_bucket", "bucket": b, "seq_len": t,
@@ -956,13 +877,25 @@ class GenerativeEngine(_QuantizedParamsMixin):
 
     def __init__(self, model, slots: int = 8,
                  quantize: Optional[str] = None,
-                 kv_cache: Optional[str] = None):
+                 kv_cache: Optional[str] = None,
+                 mesh=None, data_axis: str = "data",
+                 model_axis: Optional[str] = "model"):
         self.model = model
         self.slots = int(slots)
         if kv_cache not in (None, "int8"):
             raise ValueError(f"unknown kv_cache mode {kv_cache!r} "
                              "(expected None or 'int8')")
         self.kv_cache = kv_cache
+        # ISSUE 17: tensor-parallel decode over a pod mesh — params
+        # shard by the placement layer's TP specs, the KV caches shard
+        # their head axis, the slot batch replicates (per-slot rows are
+        # the continuous batcher's join/leave unit, not a data shard)
+        self.mesh = mesh
+        self._placement_layer = None
+        if mesh is not None:
+            self._placement_layer = _pl.ParamsPlacement(
+                mesh, model=model, model_axis=model_axis,
+                data_axis=data_axis)
         self._compiled: Dict[Tuple, Any] = {}
         self._lock = threading.Lock()
         self._invalidate_cause: Optional[str] = None
@@ -977,6 +910,10 @@ class GenerativeEngine(_QuantizedParamsMixin):
         self._m_compiles = _M_COMPILES.labeled(engine=self._id)
         self._h_prefill = _H_PREFILL.labeled(engine=self._id)
         self._h_decode = _H_DECODE.labeled(engine=self._id)
+        if self._placement_layer is not None:
+            _G_TP_SHARDS.labeled(
+                engine=self._id, mesh=_pl.mesh_key(mesh)
+            ).set(self._placement_layer.tp)
         try:
             if not hasattr(model, "_serving_engines"):
                 model._serving_engines = weakref.WeakSet()
@@ -997,14 +934,19 @@ class GenerativeEngine(_QuantizedParamsMixin):
         model.decode_cache_spec(1, 8, kv_quant=self._kv_quant)
 
     # ---------------------------------------------------------- state blobs
-    def cache_bytes(self, cache_len: int) -> int:
+    def cache_bytes(self, cache_len: int, per_device: bool = False) -> int:
         """Decode-cache bytes at one bucket for the full slot batch —
         the quantity ``kv_cache="int8"`` halves (the measured basis of
         the "~2x decode slot capacity" claim; surfaced per state via the
-        ``serving.quantize.kv_bytes`` gauge)."""
+        ``serving.quantize.kv_bytes`` gauge). ``per_device=True`` under a
+        TP mesh divides head-sharded leaves by the model-axis size —
+        each device holds H/k heads' rows (ISSUE 17)."""
         c = next_bucket(cache_len)
         spec = self.model.decode_cache_spec(self.slots, c,
                                             kv_quant=self._kv_quant)
+        if per_device and self._placement_layer is not None:
+            return _pl.tree_bytes_per_device(
+                spec, self._placement_layer.cache_shardings(spec))
         return sum(int(np.prod(a.shape)) * np.dtype(a.dtype).itemsize
                    for a in jax.tree.leaves(spec))
 
@@ -1013,8 +955,14 @@ class GenerativeEngine(_QuantizedParamsMixin):
         c = next_bucket(cache_len)
         caches = self.model.init_decode_cache(self.slots, c,
                                               kv_quant=self._kv_quant)
+        lengths = jnp.zeros((self.slots,), jnp.int32)
+        if self.mesh is not None:
+            pl = self._placement_layer
+            caches = _pl.put_tree(caches, pl.cache_shardings(caches))
+            lengths = _pl.put_full(np.zeros((self.slots,), np.int32),
+                                   pl.replicated())
         self._g_q_kv.set(self.cache_bytes(c))
-        return DecodeState(caches, jnp.zeros((self.slots,), jnp.int32), c)
+        return DecodeState(caches, lengths, c)
 
     def grow(self, state: DecodeState, cache_len: int) -> DecodeState:
         """Re-bucket the caches to a larger power-of-two length by
@@ -1029,9 +977,21 @@ class GenerativeEngine(_QuantizedParamsMixin):
         def grow_leaf(a):
             # every cache leaf is [S, H, C, d] with C on axis 2 — the
             # int8 value buckets AND their [S, H, C, 1] scale buckets
+            if isinstance(a, jax.Array) and not a.is_fully_addressable:
+                raise RuntimeError(
+                    "contiguous-cache grow() cannot host-gather a "
+                    "multi-host sharded cache; warm a fixed cache bucket "
+                    "(min == max) or serve through PagedGenerativeEngine "
+                    "(its grow is a host page-table bump)")
+            sh = a.sharding if isinstance(a, jax.Array) and \
+                self.mesh is not None else None
             h = np.asarray(a)
-            return jax.device_put(
-                np.pad(h, [(0, 0), (0, 0), (0, pad), (0, 0)]))
+            padded = np.pad(h, [(0, 0), (0, 0), (0, pad), (0, 0)])
+            if sh is not None:
+                # pad axis 2 is replicated in the cache spec, so the
+                # original head sharding carries over unchanged
+                return _pl.put_full(padded, sh)
+            return jax.device_put(padded)
 
         self._g_q_kv.set(self.cache_bytes(c2))
         return DecodeState(jax.tree.map(grow_leaf, state.caches),
@@ -1046,6 +1006,42 @@ class GenerativeEngine(_QuantizedParamsMixin):
         serving_params = self._serving_params()
         return (jax.eval_shape(lambda: serving_params),
                 jax.eval_shape(lambda: self.model.state))
+
+    def _place_params(self):
+        """Params/state ready for the executables (the placement layer's
+        identity-cached TP walk when a mesh is configured — ISSUE 17)."""
+        if self.mesh is None:
+            return self._serving_params(), self.model.state
+        return self._placement_layer.place(
+            self._serving_params(), self.model.state,
+            src=(self.model.params, self.model.state))
+
+    def _tp_trace(self):
+        """Arm ``flash_attention``'s tensor-parallel dispatch while one
+        decode-family executable traces (per-shard ``shard_map`` or the
+        counted GSPMD einsum fallback — zero silent fallbacks)."""
+        pl = self._placement_layer
+        if pl is not None and pl.model_axis is not None:
+            return _fa.tp_shard_context(pl.mesh, pl.model_axis)
+        return contextlib.nullcontext()
+
+    def _tp_shardings(self, cache_avals):
+        """(params, state, caches, replicated) sharding trees for one
+        executable's in/out pinning: params by TP spec, KV caches
+        head-sharded H/k per device, everything small replicated."""
+        pl = self._placement_layer
+        return (pl.param_shardings(self._serving_params()),
+                pl.state_shardings(self.model.state),
+                pl.cache_shardings(cache_avals),
+                pl.replicated())
+
+    def _put_arg(self, a):
+        """Per-call small arguments (token windows, lengths, page
+        tables): replicated onto the mesh — explicit, because multi-host
+        AOT executables cannot place host numpy themselves."""
+        if self.mesh is None:
+            return a
+        return _pl.put_full(np.asarray(a), self._placement_layer.replicated())
 
     def _feature_dim(self) -> int:
         shapes = self.model.conf.input_shape
@@ -1102,12 +1098,19 @@ class GenerativeEngine(_QuantizedParamsMixin):
         def build():
             p_avals, s_avals = self._params_avals()
             cache_avals = model.decode_cache_spec(S, c, kv_quant=kv_quant)
-            return jax.jit(fn).lower(
-                p_avals, s_avals, cache_avals,
-                jax.ShapeDtypeStruct((S,), jnp.int32),
-                jax.ShapeDtypeStruct((1, tp, f), dt),
-                jax.ShapeDtypeStruct((), jnp.int32),
-                jax.ShapeDtypeStruct((), jnp.int32))
+            jkw = {}
+            if self.mesh is not None:
+                p_sh, s_sh, c_sh, repl = self._tp_shardings(cache_avals)
+                jkw["in_shardings"] = (p_sh, s_sh, c_sh, repl, repl,
+                                       repl, repl)
+                jkw["out_shardings"] = (c_sh, repl, repl)
+            with self._tp_trace():
+                return jax.jit(fn, **jkw).lower(
+                    p_avals, s_avals, cache_avals,
+                    jax.ShapeDtypeStruct((S,), jnp.int32),
+                    jax.ShapeDtypeStruct((1, tp, f), dt),
+                    jax.ShapeDtypeStruct((), jnp.int32),
+                    jax.ShapeDtypeStruct((), jnp.int32))
 
         return self._get_compiled(("prefill", tp, c), build, _warmup)
 
@@ -1137,20 +1140,34 @@ class GenerativeEngine(_QuantizedParamsMixin):
             # (~40% of CPU decode-step time at C=128). The caller must
             # treat the passed DecodeState as consumed — the batcher
             # rebuilds fresh state if a decode dispatch ever throws.
-            return jax.jit(fn, donate_argnums=(2,)).lower(
-                p_avals, s_avals, cache_avals,
-                jax.ShapeDtypeStruct((S,), jnp.int32),
-                jax.ShapeDtypeStruct((S, 1, f), dt),
-                jax.ShapeDtypeStruct((S,), jnp.int32))
+            jkw = {"donate_argnums": (2,)}
+            if self.mesh is not None:
+                p_sh, s_sh, c_sh, repl = self._tp_shardings(cache_avals)
+                jkw["in_shardings"] = (p_sh, s_sh, c_sh, repl, repl, repl)
+                # caches keep their head sharding so donation aliases
+                # the sharded buffers in place
+                jkw["out_shardings"] = (c_sh, repl, repl)
+            with self._tp_trace():
+                return jax.jit(fn, **jkw).lower(
+                    p_avals, s_avals, cache_avals,
+                    jax.ShapeDtypeStruct((S,), jnp.int32),
+                    jax.ShapeDtypeStruct((S, 1, f), dt),
+                    jax.ShapeDtypeStruct((S,), jnp.int32))
 
         return self._get_compiled(("decode", c), build, _warmup)
 
     def warmup(self, cache_buckets: Sequence[int],
-               prompt_buckets: Sequence[int]) -> "GenerativeEngine":
+               prompt_buckets: Sequence[int],
+               checkpoint: Optional[str] = None) -> "GenerativeEngine":
         """Compile every (prompt bucket x cache bucket) prefill and every
         cache-bucket decode executable outside traffic. After this, a
         generation whose prompt and total length stay within the warmed
-        ladders never compiles (asserted by the bench/tier-1 suite)."""
+        ladders never compiles (asserted by the bench/tier-1 suite).
+        ``checkpoint=<dir>`` restores the model from a pod
+        ``TrainingCheckpointer`` directory first (multi-host AOT warmup
+        in one call — ISSUE 17)."""
+        if checkpoint is not None:
+            _pl.load_checkpoint(self.model, checkpoint)
         cs = sorted(set(next_bucket(c) for c in cache_buckets))
         tps = sorted(set(next_bucket(t) for t in prompt_buckets))
         for c in cs:
@@ -1191,11 +1208,13 @@ class GenerativeEngine(_QuantizedParamsMixin):
                              f"{state.cache_len}; grow() first")
         self._m_calls.inc()
         exe = self._prefill_exe(tp, state.cache_len)
+        params, mstate = self._place_params()
         tel = _tel.enabled()
         t0 = time.perf_counter() if tel else 0.0
         caches, lengths, logits = exe(
-            self._serving_params(), self.model.state, state.caches,
-            state.lengths, x, np.int32(plen), np.int32(slot))
+            params, mstate, state.caches, state.lengths,
+            self._put_arg(x), self._put_arg(np.int32(plen)),
+            self._put_arg(np.int32(slot)))
         logits = np.asarray(logits)
         if tel:
             self._h_prefill.observe(time.perf_counter() - t0)
@@ -1211,11 +1230,13 @@ class GenerativeEngine(_QuantizedParamsMixin):
             x_t = x_t.astype(dt)
         self._m_calls.inc()
         exe = self._decode_exe(state.cache_len)
+        params, mstate = self._place_params()
         tel = _tel.enabled()
         t0 = time.perf_counter() if tel else 0.0
         caches, lengths, logits = exe(
-            self._serving_params(), self.model.state, state.caches,
-            state.lengths, x_t, np.asarray(active, np.int32))
+            params, mstate, state.caches, state.lengths,
+            self._put_arg(x_t),
+            self._put_arg(np.asarray(active, np.int32)))
         logits = np.asarray(logits)
         if tel:
             self._h_decode.observe(time.perf_counter() - t0)
@@ -1225,6 +1246,8 @@ class GenerativeEngine(_QuantizedParamsMixin):
     def invalidate(self, cause: str = "invalidate"):
         with self._lock:
             self._compiled.clear()
+            if self._placement_layer is not None:
+                self._placement_layer.invalidate()
             self._invalidate_cause = cause
 
     @property
@@ -1246,6 +1269,9 @@ class GenerativeEngine(_QuantizedParamsMixin):
                "compiles": self.compiles, "compiled_buckets": buckets,
                "slots": self.slots,
                "kv_cache": self.kv_cache if self._kv_quant else "off"}
+        if self._placement_layer is not None:
+            out["mesh"] = _pl.mesh_key(self.mesh)
+            out["tp_shards"] = self._placement_layer.tp
         out.update(self._quantize_stats())
         return out
 
@@ -1273,10 +1299,14 @@ class GenerativeEngine(_QuantizedParamsMixin):
                     "explicitly")
             else:
                 measured_s = self._h_decode.percentile(50)
+        # r18 fingerprint-key rule (ISSUE 17 satellite): a TP decode
+        # step's cached fractions never blend with single-device ones
+        key = (f"serving.decode:{type(self.model).__name__}:"
+               f"s{self.slots}xc{c}:{self.quantize or 'f32'}")
+        if self._placement_layer is not None:
+            key += f":{self._placement_layer.suffix()}"
         rep = _attr.attribute_compiled(
-            exe, measured_s=measured_s, peaks=peaks,
-            key=f"serving.decode:{type(self.model).__name__}:"
-                f"s{self.slots}xc{c}:{self.quantize or 'f32'}")
+            exe, measured_s=measured_s, peaks=peaks, key=key)
         if measurement_note is not None:
             rep["measurement_note"] = measurement_note
         rep.update({"kind": "decode_step", "cache_len": c,
@@ -1338,10 +1368,13 @@ class PagedGenerativeEngine(GenerativeEngine):
     def __init__(self, model, slots: int = 8, pages: int = 64,
                  page_size: int = 16, max_cache_len: int = 256,
                  quantize: Optional[str] = None,
-                 kv_cache: Optional[str] = None):
+                 kv_cache: Optional[str] = None,
+                 mesh=None, data_axis: str = "data",
+                 model_axis: Optional[str] = "model"):
         from .kv_pool import PagedKVPool
         super().__init__(model, slots=slots, quantize=quantize,
-                         kv_cache=kv_cache)
+                         kv_cache=kv_cache, mesh=mesh, data_axis=data_axis,
+                         model_axis=model_axis)
         self.page_size = next_bucket(page_size)
         self.max_cache_len = next_bucket(max_cache_len)
         if self.max_cache_len < self.page_size:
@@ -1356,13 +1389,18 @@ class PagedGenerativeEngine(GenerativeEngine):
         return self.model.paged_cache_spec(self.pages, self.page_size,
                                            kv_quant=self._kv_quant)
 
-    def pool_bytes(self) -> int:
+    def pool_bytes(self, per_device: bool = False) -> int:
         """Total device bytes of the paged KV pool — the FIXED number the
         concurrent-streams-per-GB accounting divides into (contiguous
         slots each cost their full bucket; paged streams cost only their
-        allocated pages)."""
+        allocated pages). ``per_device=True`` accounts the head-sharded
+        pool: each device holds H/k of every page payload (ISSUE 17)."""
+        spec = self._pool_spec()
+        if per_device and self._placement_layer is not None:
+            return _pl.tree_bytes_per_device(
+                spec, self._placement_layer.cache_shardings(spec))
         return sum(int(np.prod(a.shape)) * np.dtype(a.dtype).itemsize
-                   for a in jax.tree.leaves(self._pool_spec()))
+                   for a in jax.tree.leaves(spec))
 
     def bytes_per_token(self) -> int:
         return self.pool_bytes() // (self.pages * self.page_size)
@@ -1372,6 +1410,9 @@ class PagedGenerativeEngine(GenerativeEngine):
         initial page-table width bucket (defaults to one page)."""
         caches = jax.tree.map(lambda a: jnp.zeros(a.shape, a.dtype),
                               self._pool_spec())
+        if self.mesh is not None:
+            pl = self._placement_layer
+            caches = _pl.put_tree(caches, pl.cache_shardings(caches))
         mp = self._mp_bucket(cache_len)
         self._g_q_kv.set(self.pool_bytes())
         return PagedDecodeState(
@@ -1417,14 +1458,23 @@ class PagedGenerativeEngine(GenerativeEngine):
         return pages
 
     def prepare_write(self, state: PagedDecodeState, slot: int,
-                      n_tokens: int) -> list:
+                      n_tokens: int, ref_snapshot=None) -> list:
         """Make positions ``[lengths[slot], +n_tokens)`` exclusively
         writable: allocate missing pages, and mark shared pages for a
         copy-on-write fork (refcount > 1 — the prefix registry or a
         sibling stream still reads them). Returns ``(src, dst)`` page
         pairs for ONE batched :meth:`fork` call. Raises host-side on
         cache overflow (the clamped-scatter alternative would silently
-        overwrite the last page)."""
+        overwrite the last page).
+
+        ``ref_snapshot`` (ISSUE 17 satellite): a ``pool.ref_snapshot()``
+        refcount copy taken ONCE per admission round by the batcher so
+        the per-page shared-ness probe stops taking the pool lock per
+        candidate walk. Safe because only the calling decode worker can
+        RAISE a page's refcount (lookup_prefix/retain are same-thread),
+        so a stale snapshot can at worst over-fork — never lose a CoW
+        fork. The snapshot is updated in place so repeated calls within
+        one round stay consistent."""
         l = int(state.lengths[slot])
         P = self.page_size
         j_last = (l + int(n_tokens) - 1) // P
@@ -1432,17 +1482,38 @@ class PagedGenerativeEngine(GenerativeEngine):
             raise ValueError(
                 f"slot {slot} write of {n_tokens} at length {l} exceeds "
                 f"max_cache_len {self.max_cache_len}")
-        forks = []
+        snap = ref_snapshot
+        # Pass 1: plan — which logical rows need a fresh page, which
+        # shared pages fork. No pool calls yet, so allocation is
+        # all-or-nothing (one batched alloc below).
+        plan = []         # (j, old_page_or_0)
         for j in range(l // P, j_last + 1):
             page = int(state.page_table[slot, j])
             if page == 0:
-                state.page_table[slot, j] = self.pool.alloc(1)[0]
-            elif self.pool.shared(page):
-                fresh = self.pool.alloc(1)[0]
-                forks.append((page, fresh))
-                state.page_table[slot, j] = fresh
-                self.pool.release([page])
-                self.pool.note_fork()
+                plan.append((j, 0))
+            else:
+                shared = (int(snap[page]) > 1 if snap is not None
+                          else self.pool.shared(page))
+                if shared:
+                    plan.append((j, page))
+        if not plan:
+            return []
+        fresh_pages = self.pool.alloc(len(plan))
+        forks = []
+        released = []
+        for (j, old), fresh in zip(plan, fresh_pages):
+            state.page_table[slot, j] = fresh
+            if snap is not None:
+                snap[fresh] = 1
+            if old:
+                forks.append((old, fresh))
+                released.append(old)
+                if snap is not None:
+                    snap[old] -= 1
+        if released:
+            self.pool.release(released)
+        if forks:
+            self.pool.note_fork(len(forks))
         return forks
 
     # ----------------------------------------------------------- compilation
@@ -1477,11 +1548,18 @@ class PagedGenerativeEngine(GenerativeEngine):
         def build():
             p_avals, s_avals = self._params_avals()
             pool_avals = self._pool_spec()
-            return jax.jit(fn, donate_argnums=(2,)).lower(
-                p_avals, s_avals, pool_avals,
-                jax.ShapeDtypeStruct((1, tp, f), dt),
-                jax.ShapeDtypeStruct((), jnp.int32),
-                jax.ShapeDtypeStruct((tp,), jnp.int32))
+            jkw = {"donate_argnums": (2,)}
+            if self.mesh is not None:
+                p_sh, s_sh, pool_sh, repl = self._tp_shardings(pool_avals)
+                jkw["in_shardings"] = (p_sh, s_sh, pool_sh, repl, repl,
+                                       repl)
+                jkw["out_shardings"] = (pool_sh, repl)
+            with self._tp_trace():
+                return jax.jit(fn, **jkw).lower(
+                    p_avals, s_avals, pool_avals,
+                    jax.ShapeDtypeStruct((1, tp, f), dt),
+                    jax.ShapeDtypeStruct((), jnp.int32),
+                    jax.ShapeDtypeStruct((tp,), jnp.int32))
 
         return self._get_compiled(("pprefill", tp), build, _warmup)
 
@@ -1501,12 +1579,19 @@ class PagedGenerativeEngine(GenerativeEngine):
         def build():
             p_avals, s_avals = self._params_avals()
             pool_avals = self._pool_spec()
-            return jax.jit(fn, donate_argnums=(2,)).lower(
-                p_avals, s_avals, pool_avals,
-                jax.ShapeDtypeStruct((S, mp), jnp.int32),
-                jax.ShapeDtypeStruct((S,), jnp.int32),
-                jax.ShapeDtypeStruct((S, kq, f), dt),
-                jax.ShapeDtypeStruct((S,), jnp.int32))
+            jkw = {"donate_argnums": (2,)}
+            if self.mesh is not None:
+                p_sh, s_sh, pool_sh, repl = self._tp_shardings(pool_avals)
+                jkw["in_shardings"] = (p_sh, s_sh, pool_sh, repl, repl,
+                                       repl, repl)
+                jkw["out_shardings"] = (pool_sh, repl)
+            with self._tp_trace():
+                return jax.jit(fn, **jkw).lower(
+                    p_avals, s_avals, pool_avals,
+                    jax.ShapeDtypeStruct((S, mp), jnp.int32),
+                    jax.ShapeDtypeStruct((S,), jnp.int32),
+                    jax.ShapeDtypeStruct((S, kq, f), dt),
+                    jax.ShapeDtypeStruct((S,), jnp.int32))
 
         return self._get_compiled(("pdecode", kq, mp), build, _warmup)
 
@@ -1523,7 +1608,14 @@ class PagedGenerativeEngine(GenerativeEngine):
 
         def build():
             pool_avals = self._pool_spec()
-            return jax.jit(fn, donate_argnums=(0,)).lower(
+            jkw = {"donate_argnums": (0,)}
+            if self.mesh is not None:
+                pl = self._placement_layer
+                pool_sh = pl.cache_shardings(pool_avals)
+                jkw["in_shardings"] = (pool_sh, pl.replicated(),
+                                       pl.replicated())
+                jkw["out_shardings"] = pool_sh
+            return jax.jit(fn, **jkw).lower(
                 pool_avals,
                 jax.ShapeDtypeStruct((S,), jnp.int32),
                 jax.ShapeDtypeStruct((S,), jnp.int32))
@@ -1532,10 +1624,17 @@ class PagedGenerativeEngine(GenerativeEngine):
 
     def warmup(self, cache_buckets: Sequence[int],
                prompt_buckets: Sequence[int],
-               speculate: Sequence[int] = ()) -> "PagedGenerativeEngine":
+               speculate: Sequence[int] = (),
+               checkpoint: Optional[str] = None) -> "PagedGenerativeEngine":
         """Compile every (table-width bucket) decode executable — plus a
         Tq=k verify per ``speculate`` window — every prompt-bucket
-        prefill, and the page-fork copy, outside traffic."""
+        prefill, and the page-fork copy, outside traffic.
+
+        ``checkpoint``: pod AOT warmup (ISSUE 17) — restore params from
+        a ``TrainingCheckpointer`` directory first, so every host loads
+        only its addressable shards before bucket compilation."""
+        if checkpoint is not None:
+            _pl.load_checkpoint(self.model, checkpoint)
         mps = sorted({self._mp_bucket(c) for c in cache_buckets})
         tps = sorted({next_bucket(t) for t in prompt_buckets})
         for mp in mps:
@@ -1577,8 +1676,11 @@ class PagedGenerativeEngine(GenerativeEngine):
         rows = np.where(pages > 0, pages * P + pos % P, 0).astype(np.int32)
         tel = _tel.enabled()
         t0 = time.perf_counter() if tel else 0.0
-        caches, logits = exe(self._serving_params(), self.model.state,
-                             state.caches, x, np.int32(plen), rows)
+        params, mstate = self._place_params()
+        caches, logits = exe(params, mstate, state.caches,
+                             self._put_arg(x),
+                             self._put_arg(np.int32(plen)),
+                             self._put_arg(rows))
         logits = np.asarray(logits)
         if tel:
             self._h_prefill.observe(time.perf_counter() - t0)
@@ -1597,10 +1699,12 @@ class PagedGenerativeEngine(GenerativeEngine):
                                   dtype=np.int32)
         tel = _tel.enabled()
         t0 = time.perf_counter() if tel else 0.0
-        caches, y = exe(self._serving_params(), self.model.state,
-                        state.caches, pt,
-                        state.lengths.astype(np.int32), x,
-                        np.asarray(active, np.int32))
+        params, mstate = self._place_params()
+        caches, y = exe(params, mstate, state.caches,
+                        self._put_arg(pt),
+                        self._put_arg(state.lengths.astype(np.int32)),
+                        self._put_arg(x),
+                        self._put_arg(np.asarray(active, np.int32)))
         y = np.asarray(y)
         if tel:
             self._h_decode.observe(time.perf_counter() - t0)
@@ -1639,7 +1743,7 @@ class PagedGenerativeEngine(GenerativeEngine):
             dst = np.zeros((S,), np.int32)
             for j, (s_pg, d_pg) in enumerate(chunk):
                 src[j], dst[j] = s_pg, d_pg
-            caches = exe(caches, src, dst)
+            caches = exe(caches, self._put_arg(src), self._put_arg(dst))
         return PagedDecodeState(caches, state.lengths, state.page_table,
                                 state.mp, state.page_size)
 
@@ -1648,4 +1752,6 @@ class PagedGenerativeEngine(GenerativeEngine):
         out = super().stats()
         out["paged"] = self.pool.stats()
         out["pool_bytes"] = self.pool_bytes()
+        if self._placement_layer is not None:
+            out["pool_bytes_per_device"] = self.pool_bytes(per_device=True)
         return out

@@ -168,8 +168,19 @@ class PagedKVPool:
         with self._lock:
             return bool(page) and self._ref[page] > 1
 
-    def note_fork(self) -> None:
-        self._m_forks.inc()
+    def ref_snapshot(self) -> "np.ndarray":
+        """One locked copy of the refcount table (ISSUE 17 satellite):
+        the batcher takes this ONCE per admission round and probes
+        shared-ness against it instead of calling :meth:`shared` (one
+        lock round-trip) per candidate page. Safe for CoW because only
+        the calling decode worker can raise a refcount — a stale entry
+        can at worst trigger a spurious fork, never lose one."""
+        with self._lock:
+            return self._ref.copy()
+
+    def note_fork(self, n: int = 1) -> None:
+        if n:
+            self._m_forks.inc(n)
 
     # ------------------------------------------------------ prefix registry
     def lookup_prefix(self, key: str) -> Optional[_PrefixEntry]:
