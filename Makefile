@@ -4,7 +4,8 @@
 PY ?= python
 
 .PHONY: test test-fast lint multihost-sim multihost-smoke bench \
-	bench-generative bench-kernels bench-pod-serving trace-demo tune
+	bench-generative bench-kernels bench-pod-serving bench-disagg \
+	disagg-sim trace-demo tune
 
 # ISSUE 15: JAX-aware static analysis (runtime/staticcheck.py) — the
 # repo's hand-enforced invariants as machine-checked rules. Exits
@@ -60,6 +61,24 @@ bench-pod-serving:
 		XLA_FLAGS=--xla_force_host_platform_device_count=4 \
 		$(PY) -c "import json, bench; \
 print(json.dumps(bench.bench_pod_serving(), indent=1))"
+
+# ISSUE 18: the disaggregated-serving metric standalone — colocated vs
+# prefill/decode-split mixed-load A/B (interleaved rounds, median of
+# per-round interactive-stream TTFT-p99 ratios, decode-TPOT ramp
+# ratios under the prefill burst, stitched-timeline check, zero
+# post-warmup compiles). CPU-capable.
+bench-disagg:
+	env JAX_PLATFORMS=cpu $(PY) -c "import json, bench; \
+print(json.dumps(bench.bench_disaggregated_serving(), indent=1))"
+
+# the REAL two-process topology behind it: a prefill process ships KV
+# pages over a socket, a decode process adopts and serves them — greedy
+# bit-parity vs the colocated oracle, migrated-prefix reuse, stitched
+# cross-process timelines, zero post-warmup compiles (also the tier-1
+# gate via tests/test_disagg.py::test_disagg_two_process_sim)
+disagg-sim:
+	$(PY) -m deeplearning4j_tpu.parallel.multihost_sim --disagg \
+		--outdir /tmp/dl4j_tpu_disagg_sim
 
 # ISSUE 16: the fused-epilogue kernel-library metric standalone — the
 # fused master-cast+updater step vs the unfused updater-then-cast-sweep

@@ -2186,6 +2186,266 @@ def bench_pod_serving():
     }
 
 
+def bench_disaggregated_serving(rounds=3):
+    """Disaggregated serving metric (ISSUE 18, CPU-capable): mixed-load
+    TTFT tail for (a) a COLOCATED paged ``ContinuousBatcher`` — long
+    prefills and steady decode share one worker loop, so every prefill
+    admitted mid-stream stalls the decode iterations queued behind it —
+    versus (b) the SPLIT topology: a ``PrefillReplica`` prefills long
+    prompts off the decode worker's thread (standing in for the prefill
+    pool's process; the two-process version is the ``multihost_sim
+    --disagg`` tier-1 gate) and ships pages via ``submit_prefilled``,
+    so the decode pool only ever pays a bucketed page adoption.
+
+    Each round runs, interleaved colocated/split so both sides see the
+    same CPU weather: a LOW window (steady short-prompt decode only —
+    the per-side TPOT baseline) and a HIGH window (the same steady
+    decode + a burst of long-prefill requests, arrivals interleaved).
+    Headline = median over rounds of colocated/split INTERACTIVE-stream
+    TTFT p99 under the mixed load (> 1.0 = split wins): a long request
+    pays its own prefill on either topology, so the tail disaggregation
+    removes is the one it put in front of everyone ELSE's first token.
+    The flatness acceptance rides the TPOT ramp ratios: ramping prefill
+    must inflate the split decode MEDIAN strictly less than the
+    colocated one — enforced only on hosts with enough cores to seat
+    the pools separately (a 1-2 core box time-slices both pools, so the
+    ramps there are scheduler noise, reported but not gated).
+    A pre-window probe migration checks the stitched-timeline contract
+    (phases sum to the measured origin->resolution latency within 10%);
+    the timed windows pay ZERO compiles (hard field)."""
+    import os
+    import tempfile
+    import threading
+
+    from deeplearning4j_tpu.nn.config import (InputType,
+                                              NeuralNetConfiguration)
+    from deeplearning4j_tpu.nn.layers.attention import SelfAttentionLayer
+    from deeplearning4j_tpu.nn.layers.core import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.model import MultiLayerNetwork
+    from deeplearning4j_tpu.runtime import telemetry as _tel
+    from deeplearning4j_tpu.serving import ContinuousBatcher, PrefillReplica
+
+    # prefill must DOMINATE the migration overhead for the split to pay
+    # off (on TPUs the page export/import is DMA-cheap next to a long
+    # prefill's compute; a toy prompt would invert that): 112-token
+    # prompts on a 2-attention-layer net put ~T^2 attention work behind
+    # every colocated admission, while the decode pool's adoption stays
+    # one bucketed 14-page scatter
+    V, PAGE, CACHE = 64, 8, 128
+    N_SHORT, N_LONG = 6, 6
+    PLEN_LONG, GEN_SHORT, GEN_LONG = 112, 16, 2
+    conf = (NeuralNetConfiguration.builder().seed(0)
+            .input_type(InputType.recurrent(V, 16))
+            .list(SelfAttentionLayer(n_out=V, n_heads=4),
+                  DenseLayer(n_out=96, activation="relu"),
+                  SelfAttentionLayer(n_out=V, n_heads=4),
+                  OutputLayer(n_out=V, activation="softmax"))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    rng = np.random.default_rng(7)
+    eye = np.eye(V, dtype=np.float32)
+
+    def fresh_prompt(plen):
+        # unique per request: a prefix-registry hit would turn the
+        # prefill under test into a free lookup on EITHER side
+        return eye[rng.integers(0, V, int(plen))]
+
+    # slots cover the full mixed burst: TTFT then measures admission
+    # interference (the thing disaggregation removes), not slot wait
+    colo = ContinuousBatcher(net, slots=N_SHORT + N_LONG,
+                             max_cache_len=CACHE, paged=True,
+                             page_size=PAGE, max_new_tokens=GEN_SHORT,
+                             pool_label="colocated")
+    pre = PrefillReplica(net, pages=257, page_size=PAGE,
+                         max_cache_len=CACHE, prompt_buckets=[16, CACHE])
+    dec = ContinuousBatcher(net, slots=N_SHORT + N_LONG,
+                            max_cache_len=CACHE, paged=True,
+                            page_size=PAGE, max_new_tokens=GEN_SHORT,
+                            pool_label="decode",
+                            migrate_buckets=[-(-PLEN_LONG // PAGE)])
+
+    def colo_short(i):
+        return colo.submit(prompt=fresh_prompt(8))
+
+    def colo_long(i):
+        return colo.submit(prompt=fresh_prompt(PLEN_LONG),
+                           max_new_tokens=GEN_LONG)
+
+    def split_short(i):
+        # steady decode residency lives on the HBM-rich pool directly
+        return dec.submit(prompt=fresh_prompt(8))
+
+    def split_long(i):
+        ship = pre.prefill(fresh_prompt(PLEN_LONG))
+        return dec.submit_prefilled(ship, max_new_tokens=GEN_LONG)
+
+    def drive(submit_short, submit_long, with_longs):
+        """One window: N_SHORT steady interactive streams (+ N_LONG
+        long-prefill bursts when ramping), arrivals interleaved;
+        per-request TTFT measured at the driver (submit -> first
+        streamed token), collected separately per class — the split's
+        claim is about the INTERACTIVE tail (a long request pays its
+        own prefill on either topology; what disaggregation removes is
+        that prefill landing in front of everyone else's first token)."""
+        shorts, longs = [], []
+        lock = threading.Lock()
+
+        def one(submit, i, sink):
+            t0 = time.perf_counter()
+            h = submit(i)
+            next(h.tokens(timeout=600))
+            dt = time.perf_counter() - t0
+            h.result(timeout=600)
+            with lock:
+                sink.append(dt)
+
+        threads = []
+        for i in range(max(N_SHORT, N_LONG)):
+            if i < N_LONG and with_longs:
+                threads.append(threading.Thread(
+                    target=one, args=(submit_long, i, longs)))
+            if i < N_SHORT:
+                threads.append(threading.Thread(
+                    target=one, args=(submit_short, i, shorts)))
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return shorts, longs
+
+    def tpot_window(cb, fn):
+        """Run ``fn`` and return the decode pool's per-token TPOT
+        samples observed DURING it (values-list delta on the bound
+        serving.tpot_s cell)."""
+        n0 = len(cb._h_tpot.values_list())
+        out = fn()
+        return out, cb._h_tpot.values_list()[n0:]
+
+    # ---- stitched-timeline probe (the cross-pool trace contract) ----
+    with tempfile.TemporaryDirectory() as td:
+        log = os.path.join(td, "events.jsonl")
+        _tel.event_log(log)
+        try:
+            t_origin = time.perf_counter()
+            ship = pre.prefill(fresh_prompt(PLEN_LONG), t_origin=t_origin)
+            t_sub = time.perf_counter()
+            h = dec.submit_prefilled(ship, max_new_tokens=GEN_LONG)
+            h.result(timeout=600)
+            latency = ship.elapsed_s + (time.perf_counter() - t_sub)
+        finally:
+            _tel.close_event_log()
+        stitched = _tel.stitch_event_logs([log])
+        recs = [r for r in stitched["traces"].get(ship.trace_id, [])
+                if r.get("type") == "trace"]
+        merged = _tel.merge_trace_records(recs)
+        phase_sum = sum(p.get("duration_s", 0.0)
+                        for p in merged.get("phases", []))
+        stitch_ok = abs(phase_sum - latency) <= 0.10 * latency
+
+    ev0 = int(_tel.registry.get("compile.events").total())
+
+    # ---- interleaved rounds: LOW (baseline TPOT) then HIGH (ramp) ----
+    ttft_ratios = []
+    colo_low_tpot, colo_high_tpot = [], []
+    split_low_tpot, split_high_tpot = [], []
+    colo_high_ttft, split_high_ttft = [], []
+    colo_long_ttft, split_long_ttft = [], []
+    for _ in range(rounds):
+        _, tp = tpot_window(colo, lambda: drive(colo_short, colo_long,
+                                                False))
+        colo_low_tpot += tp
+        _, tp = tpot_window(dec, lambda: drive(split_short, split_long,
+                                               False))
+        split_low_tpot += tp
+        (tt_c, tl_c), tp = tpot_window(
+            colo, lambda: drive(colo_short, colo_long, True))
+        colo_high_tpot += tp
+        colo_high_ttft += tt_c
+        colo_long_ttft += tl_c
+        (tt_s, tl_s), tp = tpot_window(
+            dec, lambda: drive(split_short, split_long, True))
+        split_high_tpot += tp
+        split_high_ttft += tt_s
+        split_long_ttft += tl_s
+        _, c99 = _percentiles(tt_c)
+        _, s99 = _percentiles(tt_s)
+        ttft_ratios.append(c99 / s99)
+    ev1 = int(_tel.registry.get("compile.events").total())
+
+    ttft_ratios.sort()
+    ratio = ttft_ratios[len(ttft_ratios) // 2]
+    c_lo50, c_lo99 = _percentiles(colo_low_tpot)
+    c_hi50, c_hi99 = _percentiles(colo_high_tpot)
+    s_lo50, s_lo99 = _percentiles(split_low_tpot)
+    s_hi50, s_hi99 = _percentiles(split_high_tpot)
+    _, c_tt99 = _percentiles(colo_high_ttft)
+    _, s_tt99 = _percentiles(split_high_ttft)
+    _, c_lg99 = _percentiles(colo_long_ttft)
+    _, s_lg99 = _percentiles(split_long_ttft)
+    split_flat = s_hi99 / s_lo99
+    colo_flat = c_hi99 / c_lo99
+    split_flat50 = s_hi50 / s_lo50
+    colo_flat50 = c_hi50 / c_lo50
+    # flatness is only falsifiable when the host can actually give the
+    # pools separate cores: on a 1-2 core box every concurrent prefill
+    # steals decode cycles by time-slicing REGARDLESS of topology, so
+    # the ramp ratios are pure scheduler noise — report them, gate on
+    # them only with >= 4 cores (the TTFT ratio gates everywhere: it
+    # measures admission ORDERING, which survives time-slicing)
+    cores = os.cpu_count() or 1
+    flat_ok = (split_flat50 < colo_flat50) if cores >= 4 else True
+    dec_stats = dec.stats()
+    pre_stats = pre.stats()
+    colo.shutdown()
+    dec.shutdown()
+
+    return {
+        "metric": "disaggregated_serving",
+        "value": round(ratio, 2),
+        "unit": "x_mixed_load_interactive_ttft_p99_colocated_vs_split",
+        "pair_ratios": [round(r, 2) for r in ttft_ratios],
+        "workload": f"{N_SHORT} steady 8-token-prompt/{GEN_SHORT}-token "
+                    f"interactive streams + {N_LONG} interleaved "
+                    f"{PLEN_LONG}-token prefill bursts, {rounds} "
+                    f"interleaved rounds",
+        # the headline class: interactive streams' first token under the
+        # prefill ramp (the long bursts pay their own prefill on either
+        # topology and are reported below for context)
+        "ttft_p99_ms_colocated": round(c_tt99 * 1e3, 2),
+        "ttft_p99_ms_split": round(s_tt99 * 1e3, 2),
+        "ttft_p99_ms_colocated_long": round(c_lg99 * 1e3, 2),
+        "ttft_p99_ms_split_long": round(s_lg99 * 1e3, 2),
+        # decode TPOT p99, LOW -> HIGH prefill load, per side: the
+        # flatness acceptance (split stays put; colocated inflates
+        # because prefills share its decode worker loop)
+        "tpot_p99_ms_colocated_low": round(c_lo99 * 1e3, 2),
+        "tpot_p99_ms_colocated_high": round(c_hi99 * 1e3, 2),
+        "tpot_p99_ms_split_low": round(s_lo99 * 1e3, 2),
+        "tpot_p99_ms_split_high": round(s_hi99 * 1e3, 2),
+        # the relative-flatness acceptance — ramping prefill must
+        # inflate the split decode median strictly less than the
+        # colocated one — enforced only where the host can seat the
+        # pools on separate cores (see tpot_ramp_gate)
+        "tpot_p50_ramp_ratio_colocated": round(colo_flat50, 2),
+        "tpot_p50_ramp_ratio_split": round(split_flat50, 2),
+        "tpot_p99_ramp_ratio_colocated": round(colo_flat, 2),
+        "tpot_p99_ramp_ratio_split": round(split_flat, 2),
+        "tpot_ramp_gate": ("enforced" if cores >= 4 else
+                           f"reported-only ({cores}-core host time-"
+                           "slices both pools)"),
+        # the cross-pool trace contract, measured on a live migration
+        "stitched_phase_sum_within_10pct": bool(stitch_ok),
+        "migrations": dec_stats["engine"]["paged"]["adoptions"],
+        "prefill_pool": {"prefix_entries":
+                         pre_stats["engine"]["paged"]["prefix_entries"],
+                         "health": pre_stats["health"]},
+        # acceptance: the timed windows pay ZERO compiles
+        "post_warmup_compile_events": int(ev1 - ev0),
+        "pass": bool(ratio > 1.0 and flat_ok and stitch_ok
+                     and (ev1 - ev0) == 0),
+    }
+
+
 def bench_multihost_scaling():
     """Pod-scale multi-host training (ISSUE 10): the 2-process CPU pod
     simulation — real subprocesses joined by ``jax.distributed`` (gloo
@@ -2503,6 +2763,14 @@ if __name__ == "__main__":
         lines.append({
             "metric": "pod_serving", "value": None,
             "unit": "x_tokens_per_sec_tp2_vs_single_device",
+            "error": f"{type(e).__name__}: {e}"[:300]})
+    _emit(lines)
+    try:
+        lines.append(bench_disaggregated_serving())
+    except Exception as e:
+        lines.append({
+            "metric": "disaggregated_serving", "value": None,
+            "unit": "x_mixed_load_interactive_ttft_p99_colocated_vs_split",
             "error": f"{type(e).__name__}: {e}"[:300]})
     _emit(lines)
     try:

@@ -142,4 +142,8 @@ def quantization_gate(model, features, labels=None, max_delta: float = 0.01,
     return accuracy_delta_gate(base.output, quant.output, batches,
                                labels=labels, max_delta=max_delta,
                                raise_on_fail=raise_on_fail,
-                               cell_labels={"engine": quant._id})
+                               cell_labels={
+                                   "engine": quant._id,
+                                   "pool": getattr(quant, "_pool_label",
+                                                   "default"),
+                               })

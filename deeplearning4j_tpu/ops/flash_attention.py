@@ -708,6 +708,38 @@ def paged_insert(pool, new, lengths, page_table, page_size: int, write=None):
     return pool.at[rows].set(upd)
 
 
+def page_rows(pages, page_size: int):
+    """Token rows covering WHOLE pages: ``pages`` [n] page ids ->
+    [n * page_size] int32 rows — the index form shared by
+    :func:`page_export` / :func:`page_import` (ISSUE 18). Page id 0
+    (padding in a fixed-size migration bucket) resolves to the reserved
+    zero page; importers gate those rows off."""
+    P = int(page_size)
+    pages = jnp.asarray(pages).astype(jnp.int32)
+    return (pages[:, None] * P
+            + jnp.arange(P, dtype=jnp.int32)[None, :]).reshape(-1)
+
+
+def page_export(pool, rows):
+    """Gather whole pages out of one layer's pool in ONE device call:
+    ``pool`` [NP*P, H, d], ``rows`` [n*P] -> [n*P, H, d] payload block
+    (ISSUE 18 KV-page migration — never a device round-trip per page)."""
+    return pool[rows]
+
+
+def page_import(pool, rows, payload, gate):
+    """Scatter whole pages into one layer's pool in ONE device call.
+    ``gate`` [n*P] bool follows the write-gate contract of
+    :func:`paged_insert`: gated-off rows (bucket padding pointing at the
+    zero page) scatter back the value they gathered — a no-op — so an
+    import can never corrupt the zero page or a page another stream
+    holds."""
+    upd = jnp.asarray(payload).astype(pool.dtype)
+    upd = jnp.where(jnp.asarray(gate).astype(bool)[:, None, None],
+                    upd, pool[rows])
+    return pool.at[rows].set(upd)
+
+
 # --------------------------------------------------------------------------
 # multi-query decode: verify k speculated tokens in ONE step (ISSUE 12)
 # --------------------------------------------------------------------------

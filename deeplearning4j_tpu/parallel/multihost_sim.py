@@ -185,6 +185,198 @@ def _serving_phase(args, result) -> None:
         }
 
 
+def _disagg_worker(args) -> None:
+    """ISSUE 18 acceptance phase: TWO processes NOT joined by
+    ``jax.distributed`` — pid 0 is a PREFILL-pool server (a
+    :class:`~..serving.disagg.PrefillReplica` per KV variant behind a
+    loopback TCP shipment channel), pid 1 is the DECODE-pool driver (a
+    paged ``ContinuousBatcher`` per variant that adopts the shipped
+    pages, plus a colocated single-pool oracle). The driver asserts, for
+    f32 AND int8 KV:
+
+    - migrated-stream greedy tokens BIT-equal to the un-migrated
+      single-pool oracle;
+    - the second identical prompt hits the DECODE pool's prefix registry
+      (fleet-wide: migrated pages re-served with no second migration);
+    - zero post-warmup compile events in both processes;
+    - the stitched cross-process timeline (both pools' ``type="trace"``
+      records under ONE trace id) has phases summing to the measured
+      request latency within 10% across the handoff.
+    """
+    import numpy as np
+
+    from ..runtime import telemetry as _tel
+    from ..serving.batcher import ContinuousBatcher
+    from ..serving.disagg import (KVShipment, PrefillReplica, read_msg,
+                                  write_msg)
+    from ..serving.kv_pool import prompt_key
+
+    V, PAGE, CACHE, MAX_NEW = 16, 8, 32, 8
+    pid = args.pid
+    evpath = os.path.join(args.outdir, f"events_disagg_{pid}.jsonl")
+    _tel.event_log(evpath)
+    net = _build_attn_net(V)
+    eye = np.eye(V, dtype=np.float32)
+    rng = np.random.default_rng(11)
+    prompts = [rng.integers(0, V, 6), rng.integers(0, V, 9)]
+    variants = (("f32", None), ("int8", "int8"))
+    result = {"phase": "disagg", "pid": pid, "variants": {}}
+
+    if pid == 0:
+        # ---------------------------------------------- prefill server
+        replicas = {
+            name: PrefillReplica(net, pages=32, page_size=PAGE,
+                                 max_cache_len=CACHE, prompt_buckets=[16],
+                                 kv_cache=kvc, pool_label="prefill")
+            for name, kvc in variants}
+        c0 = _compile_total()
+        srv = socket.socket()
+        srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        srv.bind(("127.0.0.1", args.port))
+        srv.listen(1)
+        conn, _addr = srv.accept()
+        try:
+            while True:
+                msg = json.loads(read_msg(conn).decode("utf-8"))
+                if msg.get("cmd") == "quit":
+                    break
+                pre = replicas[msg["variant"]]
+                ship = pre.prefill(eye[np.asarray(msg["tokens"], int)])
+                write_msg(conn, ship.to_bytes())
+        finally:
+            conn.close()
+            srv.close()
+        result["post_warmup_compile_events"] = _compile_total() - c0
+        assert result["post_warmup_compile_events"] == 0, \
+            (f"{result['post_warmup_compile_events']} post-warmup "
+             "compiles in the prefill pool")
+        for name, pre in replicas.items():
+            result["variants"][name] = {"prefill_pool": pre.stats()}
+    else:
+        # ----------------------------------------------- decode driver
+        fronts = {}
+        for name, kvc in variants:
+            fronts[name] = {
+                "decode": ContinuousBatcher(
+                    net, slots=2, max_cache_len=CACHE, paged=True,
+                    pages=32, page_size=PAGE, max_new_tokens=MAX_NEW,
+                    kv_cache=kvc, pool_label="decode",
+                    migrate_buckets=[2]),
+                "oracle": ContinuousBatcher(
+                    net, slots=2, max_cache_len=CACHE, paged=True,
+                    pages=32, page_size=PAGE, max_new_tokens=MAX_NEW,
+                    kv_cache=kvc, pool_label="colocated"),
+            }
+        c0 = _compile_total()
+        conn = socket.socket()
+        deadline = time.time() + 60
+        while True:
+            try:
+                conn.connect(("127.0.0.1", args.port))
+                break
+            except OSError:
+                if time.time() > deadline:
+                    raise
+                time.sleep(0.2)
+
+        def migrate(variant: str, toks):
+            # measured request latency = ORIGIN (prefill-pool arrival) ->
+            # resolution, the span the stitched phases tile: the
+            # shipment's origin-side elapsed plus the decode-side
+            # submit->result wall. The request-leg RPC transport happens
+            # BEFORE the request exists origin-side; it rides t_wall
+            # (reported, sub-ms on loopback), not the timeline.
+            t0 = time.perf_counter()
+            write_msg(conn, json.dumps(
+                {"variant": variant, "tokens": [int(t) for t in toks]}
+            ).encode("utf-8"))
+            ship = KVShipment.from_bytes(read_msg(conn))
+            t_sub = time.perf_counter()
+            h = fronts[variant]["decode"].submit_prefilled(
+                ship, max_new_tokens=MAX_NEW)
+            out = h.result(timeout=120)
+            now = time.perf_counter()
+            return ship, out, ship.elapsed_s + (now - t_sub), now - t0
+
+        try:
+            for name, _kvc in variants:
+                cb = fronts[name]["decode"]
+                oracle = fronts[name]["oracle"]
+                vres = {}
+                ship0, out0, lat0, wall0 = migrate(name, prompts[0])
+                _ship1, out1, _l1, _w1 = migrate(name, prompts[1])
+                for toks, out in ((prompts[0], out0), (prompts[1], out1)):
+                    ref = oracle.submit(
+                        eye[toks], max_new_tokens=MAX_NEW).result(
+                            timeout=120)
+                    assert out["tokens"] == ref["tokens"], \
+                        (f"{name}: migrated tokens {out['tokens']} != "
+                         f"single-pool oracle {ref['tokens']}")
+                # fleet-wide prefix reuse: the repeat prompt is resident
+                # in the DECODE pool (adopted pages) — served locally,
+                # no second migration
+                key = prompt_key(eye[prompts[0]], len(prompts[0]))
+                assert cb.engine.pool.peek_prefix(key), \
+                    f"{name}: migrated prefix not registered decode-side"
+                adoptions_before = cb.engine.pool.stats()["adoptions"]
+                rep = cb.submit(eye[prompts[0]],
+                                max_new_tokens=MAX_NEW).result(timeout=120)
+                assert rep["tokens"] == out0["tokens"], \
+                    f"{name}: prefix-hit tokens diverge from migrated run"
+                pstats = cb.engine.pool.stats()
+                assert pstats["prefix_hits"] >= 1, \
+                    f"{name}: repeat prompt missed the migrated prefix"
+                assert pstats["adoptions"] == adoptions_before, \
+                    f"{name}: repeat prompt migrated again"
+                # ONE stitched timeline across the process boundary:
+                # phases must tile the measured latency (±10%)
+                rec0 = [json.loads(ln) for ln in open(
+                    os.path.join(args.outdir, "events_disagg_0.jsonl"))
+                    if ln.strip()]
+                rec1 = [json.loads(ln) for ln in open(evpath)
+                        if ln.strip()]
+                recs = [r for r in rec0 + rec1
+                        if r.get("type") == "trace"
+                        and r.get("trace") == ship0.trace_id]
+                assert len(recs) == 2, \
+                    (f"{name}: expected prefill+decode trace records for "
+                     f"{ship0.trace_id}, got {len(recs)}")
+                merged = _tel.merge_trace_records(recs)
+                assert merged["pools"] == ["prefill", "decode"], merged
+                phase_sum = sum(p.get("duration_s", 0.0)
+                                for p in merged["phases"])
+                assert abs(phase_sum - lat0) <= 0.10 * lat0, \
+                    (f"{name}: stitched phases sum {phase_sum * 1e3:.2f}ms"
+                     f" vs measured {lat0 * 1e3:.2f}ms (>10% apart)")
+                names = [p.get("phase") for p in merged["phases"]]
+                assert "handoff" in names and "adopt" in names, names
+                vres.update({
+                    "tokens": [int(t) for t in out0["tokens"]],
+                    "latency_ms": round(lat0 * 1e3, 3),
+                    "wall_with_transport_ms": round(wall0 * 1e3, 3),
+                    "stitched_phase_sum_ms": round(phase_sum * 1e3, 3),
+                    "phases": names,
+                    "decode_pool": pstats,
+                })
+                result["variants"][name] = vres
+            result["post_warmup_compile_events"] = _compile_total() - c0
+            assert result["post_warmup_compile_events"] == 0, \
+                (f"{result['post_warmup_compile_events']} post-warmup "
+                 "compiles in the decode pool")
+        finally:
+            write_msg(conn, json.dumps({"cmd": "quit"}).encode("utf-8"))
+            conn.close()
+            for name in fronts:
+                fronts[name]["decode"].shutdown()
+                fronts[name]["oracle"].shutdown()
+
+    _tel.close_event_log()
+    with open(os.path.join(args.outdir,
+                           f"result_disagg_{pid}.json"), "w") as f:
+        json.dump(result, f)
+    print(f"phase disagg pid {pid}: ok", flush=True)
+
+
 def _make_stream(global_batch: int, steps: int, in_dim: int):
     """The SAME deterministic global batch stream on every host — the
     HostShardedIterator takes each host's slice (TensorFlow's contract:
@@ -221,6 +413,13 @@ def _worker(args) -> None:
 
     in_dim = 64
     phase, pid, nprocs = args.phase, args.pid, args.nprocs
+    if phase == "disagg":
+        # ISSUE 18: the disaggregated pair is NOT a jax.distributed pod —
+        # two independent single-process runtimes joined only by the
+        # KV-shipment channel (the --port the pod phases would have used
+        # for the coordinator is the prefill server's listen port here)
+        _disagg_worker(args)
+        return
     from . import launcher
     if nprocs > 1:
         launcher.initialize(
@@ -472,6 +671,43 @@ def run_serving(outdir: str, timeout: float = 420.0,
     return artifact
 
 
+def run_disagg(outdir: str, timeout: float = 300.0,
+               artifact_path: Optional[str] = None) -> dict:
+    """ISSUE 18 acceptance: a PREFILL-pool process ships KV pages over a
+    loopback channel to a DECODE-pool process that adopts and serves
+    them. The workers assert the contract (bit-equal migrated streams
+    for f32 and int8 KV, fleet-wide prefix reuse with no re-migration,
+    zero post-warmup compiles in BOTH pools, stitched cross-process
+    timelines whose phases sum to the measured latency ±10%); the
+    orchestrator folds their result files into the artifact. Fast enough
+    for tier-1 (small model, one prompt pair per variant)."""
+    os.makedirs(outdir, exist_ok=True)
+    one_dev = {"XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+               "DL4J_TPU_SIM_DEVICES_PER_HOST": "1"}
+    res = _spawn("disagg", 2, outdir, 1, 1, 1, timeout,
+                 extra_env=one_dev)
+    server, driver = res[0], res[1]
+    for r in res:
+        assert int(r["post_warmup_compile_events"]) == 0, r
+    artifact = {
+        "metric": "disagg_serving_sim",
+        "value": 1.0,
+        "unit": "bool_all_assertions",
+        "pools": {"prefill": 1, "decode": 1},
+        "variants": driver["variants"],
+        "prefill_pool": {name: v["prefill_pool"]
+                         for name, v in server["variants"].items()},
+        "post_warmup_compile_events": 0,
+        "note": "CPU loopback pools: bit-parity/prefix-reuse/compile/"
+                "timeline proofs are the artifact; split-vs-colocated "
+                "latency comes from bench.py disaggregated_serving",
+    }
+    if artifact_path:
+        with open(artifact_path, "w") as f:
+            json.dump(artifact, f, indent=1)
+    return artifact
+
+
 def run_simulation(outdir: str, steps: int = 4, epochs: int = 2,
                    global_batch_per_host: int = 16,
                    artifact_path: Optional[str] = None,
@@ -574,12 +810,21 @@ def main(argv=None) -> None:
     ap.add_argument("--serving", action="store_true",
                     help="orchestrator mode: run the ISSUE 17 pod-serving "
                          "acceptance phase instead of the training matrix")
+    ap.add_argument("--disagg", action="store_true",
+                    help="orchestrator mode: run the ISSUE 18 "
+                         "disaggregated prefill/decode acceptance phase "
+                         "(two processes joined by the KV-shipment "
+                         "channel, not jax.distributed)")
     args = ap.parse_args(argv)
     if args.worker:
         _worker(args)
         return
     if args.serving:
         art = run_serving(args.outdir, artifact_path=args.artifact)
+        print(json.dumps(art, indent=1))
+        return
+    if args.disagg:
+        art = run_disagg(args.outdir, artifact_path=args.artifact)
         print(json.dumps(art, indent=1))
         return
     art = run_simulation(args.outdir, steps=args.steps, epochs=args.epochs,

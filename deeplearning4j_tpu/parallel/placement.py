@@ -422,11 +422,15 @@ class QuantizedParamsMixin:
         return qparams
 
     def _bind_quantize_cells(self):
-        self._m_q_requant = _M_Q_REQUANT.labeled(engine=self._id)
-        self._m_q_fallback = _M_Q_FALLBACK.labeled(engine=self._id)
-        self._g_q_sites = _G_Q_SITES.labeled(engine=self._id)
-        self._g_q_wbytes = _G_Q_WBYTES.labeled(engine=self._id)
-        self._g_q_saved = _G_Q_SAVED.labeled(engine=self._id)
+        # pool= beside engine= (ISSUE 18): engines set _pool_label before
+        # binding; non-serving hosts of the mixin fall back to "default"
+        eid = self._id
+        pool = getattr(self, "_pool_label", "default")
+        self._m_q_requant = _M_Q_REQUANT.labeled(engine=eid, pool=pool)
+        self._m_q_fallback = _M_Q_FALLBACK.labeled(engine=eid, pool=pool)
+        self._g_q_sites = _G_Q_SITES.labeled(engine=eid, pool=pool)
+        self._g_q_wbytes = _G_Q_WBYTES.labeled(engine=eid, pool=pool)
+        self._g_q_saved = _G_Q_SAVED.labeled(engine=eid, pool=pool)
 
     def set_quantize(self, quantize: Optional[str]):
         """Flip the engine's quantization mode. Every warmed executable

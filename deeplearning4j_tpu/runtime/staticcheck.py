@@ -31,6 +31,10 @@ cross-check share a single walk per suite run). Rules:
   somewhere in the package, and a module binding instance cells must have
   a ``discard_cells`` finalizer site (or inherit the
   ``telemetry_label`` finalizer) so instance churn cannot grow /metrics.
+- ``pool-scoped-metric-label`` — ``serving.*`` cells must additionally
+  bind ``pool=<role>`` beside the instance label (ISSUE 18): one scrape
+  collects a disaggregated prefill/decode process pair, and an
+  unlabeled-pool cell blends both roles' telemetry.
 - ``registry-lock-discipline`` — a read-modify-write of a registry cell
   (``.set(... .value() ...)``, ``.zero()``-then-``.inc()``, cross-kind
   shims) must sit inside a ``registry.locked()``/``_lock`` context.
@@ -582,6 +586,50 @@ def _check_mesh_labels(idx: ModuleIndex):
                 f"instance label ({'/'.join(INSTANCE_LABEL_KEYS)}) and a "
                 "mesh= label — a TP engine's cells otherwise blend across "
                 "topologies")
+
+
+# ------------------------------------------- rule: pool-scoped-metric-label
+
+#: metric-name families whose cells describe a ROLE in a disaggregated
+#: serving topology (ISSUE 18): a prefill replica and a decode replica
+#: run the same engine/batcher code, and one scrape collects both
+#: processes — a ``serving.*`` cell bound without ``pool=`` blends the
+#: prefill pool's page churn into the decode pool's residency numbers,
+#: which is exactly the signal the disagg router routes on.
+POOL_SCOPED_FAMILIES = ("serving.",)
+
+
+@rule("pool-scoped-metric-label",
+      "serving cells must bind pool=<role> next to their instance label")
+def _check_pool_labels(idx: ModuleIndex):
+    try:
+        indexes = package_index() if os.path.exists(idx.path) else [idx]
+    except Exception:
+        indexes = [idx]
+    if idx not in indexes:
+        indexes = [idx] + list(indexes)
+    for call, name, assigned, chained in _metric_decls(idx):
+        if not name.startswith(POOL_SCOPED_FAMILIES):
+            continue
+        sites = []
+        if chained is not None:
+            attr, chain_call = chained
+            if attr in _READ_METHODS:
+                continue   # read-side lookup, creates no cell
+            if attr in _WRITE_METHODS:
+                sites = [chain_call]
+        elif assigned is not None:
+            sites = [s for _i, s in
+                     _instance_binding_sites(indexes, assigned)]
+        ok = [s for s in sites if _has_instance_kw(s)
+              and any(kw.arg == "pool" for kw in s.keywords)]
+        if not ok:
+            yield Finding(
+                "pool-scoped-metric-label", idx.rel, call.lineno,
+                f"pool-scoped metric {name!r} must be bound with BOTH an "
+                f"instance label ({'/'.join(INSTANCE_LABEL_KEYS)}) and a "
+                "pool= label — a disaggregated prefill/decode pair "
+                "otherwise blends both roles into one cell")
 
 
 # -------------------------------------------- rule: registry-lock-discipline

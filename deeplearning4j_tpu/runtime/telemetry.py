@@ -1007,13 +1007,23 @@ class RequestTrace:
         return rec
 
 
-def start_request_trace(kind: str, **attrs):
+def start_request_trace(kind: str, trace_id: Optional[str] = None,
+                        **attrs):
     """New :class:`RequestTrace` registered in the bounded store (oldest
     evicted). Returns :data:`NULL_TRACE` when telemetry is disabled — the
-    fenced ``telemetry_overhead`` contract covers tracing too."""
+    fenced ``telemetry_overhead`` contract covers tracing too.
+
+    ``trace_id`` (ISSUE 18): CONTINUE an existing request's timeline
+    under its origin id instead of minting a fresh one — the decode pool
+    adopts the prefill pool's trace id so one disaggregated request
+    still yields ONE stitched timeline across both processes
+    (:func:`stitch_event_logs` groups by id; :func:`merge_trace_records`
+    folds the per-pool records)."""
     if not registry._enabled:
         return NULL_TRACE
     tr = RequestTrace(kind, attrs)
+    if trace_id:
+        tr.trace_id = str(trace_id)
     with _trace_lock:
         _trace_store[tr.trace_id] = tr
         while len(_trace_store) > TRACE_STORE_LIMIT:
@@ -1111,6 +1121,34 @@ def stitch_event_logs(paths) -> dict:
         traces.setdefault(key, []).append(ev)
     return {"events": events, "traces": traces,
             "hosts": sorted({e.get("host", 0) for e in events})}
+
+
+def merge_trace_records(records) -> dict:
+    """One request, ONE timeline (ISSUE 18): fold the per-pool
+    ``type="trace"`` records a disaggregated request emits — the prefill
+    pool finishes its half at handoff, the decode pool finishes the
+    request under the SAME trace id — into a single timeline dict.
+    Phases concatenate in record wall-clock order; ``duration_s`` sums
+    the per-pool spans (inter-pool transport rides the decode side's
+    ``handoff`` phase, so phases still sum to the request's measured
+    latency within tolerance); status/error come from the LAST record
+    (the pool that resolved the request)."""
+    recs = sorted((dict(r) for r in records), key=lambda r: r.get("t", 0.0))
+    if not recs:
+        return {}
+    out = dict(recs[0])
+    out["phases"] = [p for r in recs for p in r.get("phases", ())]
+    out["dropped_events"] = sum(int(r.get("dropped_events", 0))
+                                for r in recs)
+    out["duration_s"] = sum(float(r.get("duration_s") or 0.0)
+                            for r in recs)
+    out["status"] = recs[-1].get("status")
+    if recs[-1].get("error") is not None:
+        out["error"] = recs[-1]["error"]
+    elif "error" in out:
+        del out["error"]
+    out["pools"] = [r.get("pool") for r in recs if r.get("pool")]
+    return out
 
 
 def format_timeline(timeline: dict) -> str:

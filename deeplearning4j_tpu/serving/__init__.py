@@ -4,14 +4,19 @@ dynamic micro-batching dispatcher; ISSUE 8 adds the generative decode
 hot path — KV-cache prefill/decode executables and token-boundary
 continuous batching with streaming; ISSUE 12 adds the paged KV pool —
 fixed-size HBM pages + host page tables, copy-on-write prefix sharing,
-and draft/verify speculative decoding)."""
+and draft/verify speculative decoding; ISSUE 18 disaggregates the
+generative path — prefill and decode pools joined by KV-page migration,
+with a router owning admission)."""
 
 from ..runtime.faults import (DeadlineExceeded, QueueFull,  # noqa: F401
                               ShutdownError)
 from .engine import (DecodeState, GenerativeEngine,  # noqa: F401
                      InferenceEngine, PagedDecodeState,
                      PagedGenerativeEngine, default_buckets, next_bucket)
-from .kv_pool import PagedKVPool, PoolExhausted  # noqa: F401
+from .kv_pool import (PagedKVPool, PoolExhausted,  # noqa: F401
+                      prompt_key)
 from .batcher import (ContinuousBatcher, GenerationHandle,  # noqa: F401
                       HealthState, InferenceMode, ParallelInference)
+from .disagg import (DisaggRouter, KVShipment,  # noqa: F401
+                     PrefillReplica, RouterHandle)
 from .server import JsonModelServer  # noqa: F401

@@ -40,7 +40,6 @@ deadline_expired / retries — zero silent fallbacks) and injectable via
 
 from __future__ import annotations
 
-import hashlib
 import itertools
 import queue
 import threading
@@ -169,9 +168,11 @@ class ParallelInference:
                  health_window_s: float = 5.0,
                  degraded_p99_ms: Optional[float] = None,
                  quantize: Optional[str] = None,
-                 slo: Optional[_tel.SLO] = None):
+                 slo: Optional[_tel.SLO] = None,
+                 pool_label: str = "default"):
         if mode not in (InferenceMode.SEQUENTIAL, InferenceMode.BATCHED):
             raise ValueError(f"unknown inference mode {mode!r}")
+        self._pool_label = str(pool_label)
         if batch_limit is not None:  # deprecated alias
             max_batch_size = batch_limit
         self.model = model
@@ -207,7 +208,8 @@ class ParallelInference:
             # batcher hit the same warmed bucket cache; a mesh or a
             # quantize request needs its own engine (its executables are
             # compiled over different params avals/shardings)
-            engine = (InferenceEngine(model, mesh=mesh, quantize=quantize)
+            engine = (InferenceEngine(model, mesh=mesh, quantize=quantize,
+                                      pool_label=self._pool_label)
                       if mesh is not None or quantize is not None
                       else model.inference_engine())
         self.engine = engine
@@ -230,16 +232,20 @@ class ParallelInference:
         # drops the cells when this front is collected (bounded registry) --
         self._id = str(next(_pi_ids))
         weakref.finalize(self, _tel.registry.discard_cells, pi=self._id)
-        self._m_requests = _M_REQUESTS.labeled(pi=self._id)
-        self._m_batches = _M_BATCHES.labeled(pi=self._id)
-        self._m_failures = _M_FAILURES.labeled(pi=self._id)
-        self._m_shed = _M_SHED.labeled(pi=self._id)
-        self._m_deadline = _M_DEADLINE.labeled(pi=self._id)
-        self._m_retries = _M_RETRIES.labeled(pi=self._id)
-        self._h_latency = _H_LATENCY.labeled(pi=self._id)
-        self._h_rows = _H_ROWS.labeled(pi=self._id)
-        self._h_queue = _H_QUEUE.labeled(pi=self._id)
-        self._h_coalesce = _H_COALESCE.labeled(pi=self._id)
+        # explicit pi=/pool= kwargs at every .labeled() site — the
+        # staticcheck label rules read them from the AST (a **splat
+        # would be invisible to metric-label-blending / pool-scoped)
+        _pi, _pool = self._id, self._pool_label
+        self._m_requests = _M_REQUESTS.labeled(pi=_pi, pool=_pool)
+        self._m_batches = _M_BATCHES.labeled(pi=_pi, pool=_pool)
+        self._m_failures = _M_FAILURES.labeled(pi=_pi, pool=_pool)
+        self._m_shed = _M_SHED.labeled(pi=_pi, pool=_pool)
+        self._m_deadline = _M_DEADLINE.labeled(pi=_pi, pool=_pool)
+        self._m_retries = _M_RETRIES.labeled(pi=_pi, pool=_pool)
+        self._h_latency = _H_LATENCY.labeled(pi=_pi, pool=_pool)
+        self._h_rows = _H_ROWS.labeled(pi=_pi, pool=_pool)
+        self._h_queue = _H_QUEUE.labeled(pi=_pi, pool=_pool)
+        self._h_coalesce = _H_COALESCE.labeled(pi=_pi, pool=_pool)
         # degradation events: the recent-event window behind health()
         self._events = deque(maxlen=1024)      # (t, kind) kind in
         #                                        {shed, failure, retry,
@@ -290,6 +296,7 @@ class ParallelInference:
                     trace.phase("queue", t_d - req.t_enqueue)
                     with _tel.span("serving.dispatch",
                                    labels={"pi": self._id,
+                                           "pool": self._pool_label,
                                            "mode": str(self.mode)},
                                    rows=int(x.shape[0]),
                                    links=[trace.trace_id]):
@@ -701,6 +708,7 @@ class ParallelInference:
             # fan-in edge a queue-crossing contextvar could never record
             with _tel.span("serving.dispatch",
                            labels={"pi": self._id,
+                                   "pool": self._pool_label,
                                    "mode": str(self.mode)},
                            rows=int(total), requests=len(batch),
                            links=[r.trace.trace_id for r in batch
@@ -811,9 +819,10 @@ class GenerationHandle:
 class _GenRequest:
     __slots__ = ("x", "plen", "max_new", "eos_id", "handle", "t_enqueue",
                  "deadline", "t_admitted", "tokens", "emitted", "trace",
-                 "t_first_token", "t_anchor")
+                 "t_first_token", "t_anchor", "shipment")
 
-    def __init__(self, x, plen, max_new, eos_id, deadline, trace=None):
+    def __init__(self, x, plen, max_new, eos_id, deadline, trace=None,
+                 shipment=None):
         self.x = x                    # [T, F] prompt features (host)
         self.plen = int(plen)
         self.max_new = int(max_new)
@@ -824,6 +833,13 @@ class _GenRequest:
         self.t_admitted = None
         self.tokens: List[int] = []
         self.emitted = 0
+        # ISSUE 18: a migrated-KV handoff (serving.disagg.KVShipment) —
+        # admission ADOPTS its pages instead of prefilling. The deadline
+        # above was RE-ARMED at submit_prefilled time (r13 semantics
+        # extended: a slow handoff never expires paid-for prefill work);
+        # t_enqueue is back-dated by the shipment's origin elapsed so
+        # latency/TTFT span the whole request across pools.
+        self.shipment = shipment
         # explicit trace context through the queue (ISSUE 13); t_anchor
         # is the end of the last timeline phase, so per-iteration decode
         # phases tile the admitted lifetime exactly (timeline sums to the
@@ -887,9 +903,15 @@ class ContinuousBatcher:
                  prefix_cache: bool = True,
                  draft_model=None,
                  speculate_k: int = 4,
-                 slo: Optional[_tel.SLO] = None):
+                 slo: Optional[_tel.SLO] = None,
+                 pool_label: str = "default",
+                 migrate_buckets: Sequence[int] = ()):
         from .engine import GenerativeEngine, PagedGenerativeEngine
         self.model = model
+        # ISSUE 18: pool role of this front (prefill / decode /
+        # colocated) — every serving.* cell carries pool= beside pi=
+        self._pool_label = str(pool_label)
+        self._migrate_buckets = tuple(int(n) for n in migrate_buckets)
         # ISSUE 9: quantize="int8" (weights) / kv_cache="int8" (per-row
         # quantized KV buckets — half the cache HBM per slot) flow to the
         # engine; with an explicit engine= the caller configures it there
@@ -916,11 +938,12 @@ class ContinuousBatcher:
                 engine = PagedGenerativeEngine(
                     model, slots=slots, pages=n_pages, page_size=psz,
                     max_cache_len=self.max_cache_len, quantize=quantize,
-                    kv_cache=kv_cache)
+                    kv_cache=kv_cache, pool_label=self._pool_label)
             else:
                 engine = GenerativeEngine(model, slots=slots,
                                           quantize=quantize,
-                                          kv_cache=kv_cache)
+                                          kv_cache=kv_cache,
+                                          pool_label=self._pool_label)
         self.engine = engine
         self.paged = isinstance(engine, PagedGenerativeEngine)
         if self.paged and self.max_cache_len > engine.max_cache_len:
@@ -958,7 +981,8 @@ class ContinuousBatcher:
             if self.speculate_k < 2:
                 raise ValueError("speculate_k must be >= 2 (k=1 is plain "
                                  "decode)")
-            self.draft = GenerativeEngine(draft_model, slots=self.slots)
+            self.draft = GenerativeEngine(draft_model, slots=self.slots,
+                                          pool_label=self._pool_label)
             if self.draft._feature_dim() != self._f:
                 raise ValueError(
                     f"draft model feature dim {self.draft._feature_dim()} "
@@ -973,7 +997,8 @@ class ContinuousBatcher:
             if self.paged:
                 self.engine.warmup(
                     cb, pb, speculate=(self.speculate_k,)
-                    if self.draft is not None else ())
+                    if self.draft is not None else (),
+                    migrate_buckets=self._migrate_buckets)
             else:
                 self.engine.warmup(cb, pb)
             if self.draft is not None:
@@ -992,24 +1017,25 @@ class ContinuousBatcher:
         # its own pi= instance id, plus the slot-occupancy gauge
         self._id = str(next(_pi_ids))
         weakref.finalize(self, _tel.registry.discard_cells, pi=self._id)
-        self._m_requests = _M_REQUESTS.labeled(pi=self._id)
-        self._m_failures = _M_FAILURES.labeled(pi=self._id)
-        self._m_shed = _M_SHED.labeled(pi=self._id)
-        self._m_deadline = _M_DEADLINE.labeled(pi=self._id)
-        self._m_retries = _M_RETRIES.labeled(pi=self._id)
-        self._m_tokens = _M_TOKENS.labeled(pi=self._id)
-        self._h_latency = _H_LATENCY.labeled(pi=self._id)
+        _pi, _pool = self._id, self._pool_label
+        self._m_requests = _M_REQUESTS.labeled(pi=_pi, pool=_pool)
+        self._m_failures = _M_FAILURES.labeled(pi=_pi, pool=_pool)
+        self._m_shed = _M_SHED.labeled(pi=_pi, pool=_pool)
+        self._m_deadline = _M_DEADLINE.labeled(pi=_pi, pool=_pool)
+        self._m_retries = _M_RETRIES.labeled(pi=_pi, pool=_pool)
+        self._m_tokens = _M_TOKENS.labeled(pi=_pi, pool=_pool)
+        self._h_latency = _H_LATENCY.labeled(pi=_pi, pool=_pool)
         # ISSUE 13 satellite: per-request TTFT/TPOT as first-class
         # registry reservoirs (previously TPOT existed only as a bench
         # artifact number) — stats()/GET /stats report their p50/p99
-        self._h_ttft = _H_TTFT.labeled(pi=self._id)
-        self._h_tpot = _H_TPOT.labeled(pi=self._id)
+        self._h_ttft = _H_TTFT.labeled(pi=_pi, pool=_pool)
+        self._h_tpot = _H_TPOT.labeled(pi=_pi, pool=_pool)
         self.slo = slo
-        self._g_slots = _G_SLOTS.labeled(pi=self._id)
+        self._g_slots = _G_SLOTS.labeled(pi=_pi, pool=_pool)
         self._g_slots.set(0)
-        self._m_proposed = _M_PROPOSED.labeled(pi=self._id)
-        self._m_accepted = _M_ACCEPTED.labeled(pi=self._id)
-        self._h_accept = _H_ACCEPT.labeled(pi=self._id)
+        self._m_proposed = _M_PROPOSED.labeled(pi=_pi, pool=_pool)
+        self._m_accepted = _M_ACCEPTED.labeled(pi=_pi, pool=_pool)
+        self._h_accept = _H_ACCEPT.labeled(pi=_pi, pool=_pool)
         # r10 degradation state machine, same recent-event window as the
         # one-shot front
         self.health_window = 5.0
@@ -1081,6 +1107,7 @@ class ContinuousBatcher:
                 + (f" + speculative slack ({slack})" if slack else "")
                 + f" exceeds max_cache_len {self.max_cache_len}")
         trace = _tel.start_request_trace("serving.generate", pi=self._id,
+                                         pool=self._pool_label,
                                          plen=plen, max_new=max_new)
         if self.shed_queue_depth is not None and \
                 self._q.qsize() >= self.shed_queue_depth:
@@ -1111,6 +1138,77 @@ class ContinuousBatcher:
         """Blocking convenience over :meth:`submit`."""
         return self.submit(prompt=prompt, tokens=tokens, **kw).result()
 
+    def submit_prefilled(self, shipment,
+                         max_new_tokens: Optional[int] = None,
+                         deadline_ms: Optional[float] = None,
+                         eos_id: Optional[int] = None) -> GenerationHandle:
+        """Enqueue a generation whose prompt was prefilled in ANOTHER
+        pool (ISSUE 18 disaggregated serving): the request joins the
+        decode queue carrying a :class:`~.disagg.KVShipment`; admission
+        ADOPTS its migrated pages into this engine's pool instead of
+        prefilling, and the first token comes from the shipped prefill
+        logits.
+
+        **Deadline semantics (the r13 contract extended):**
+        ``deadline_ms`` RE-ARMS here — it bounds decode-pool enqueue ->
+        admission from THIS call, never from the origin submit, so a
+        slow handoff can never expire prefill work the other pool
+        already paid for (and an admitted generation is still never
+        killed mid-flight). Latency/TTFT still span the WHOLE request:
+        ``t_enqueue`` is back-dated by the shipment's origin-side
+        elapsed time."""
+        if self._shutdown.is_set():
+            raise ShutdownError("ContinuousBatcher is shut down")
+        if not self.paged:
+            raise ValueError("submit_prefilled needs a paged engine — KV "
+                             "pages migrate; contiguous buckets do not")
+        if self.draft is not None:
+            raise ValueError("speculative decoding cannot adopt a "
+                             "migrated prompt: the draft engine has no "
+                             "KV for it (route speculative traffic to a "
+                             "colocated replica)")
+        shipment.validate_for(self.engine)
+        plen = int(shipment.plen)
+        max_new = int(max_new_tokens) if max_new_tokens is not None \
+            else self.max_new_tokens
+        if next_bucket(plen + max_new) > self.max_cache_len:
+            raise ValueError(
+                f"prompt ({plen}) + max_new_tokens ({max_new}) exceeds "
+                f"max_cache_len {self.max_cache_len}")
+        trace = _tel.start_request_trace(
+            "serving.generate", trace_id=shipment.trace_id,
+            pi=self._id, pool=self._pool_label, plen=plen,
+            max_new=max_new, migrated=True)
+        if self.shed_queue_depth is not None and \
+                self._q.qsize() >= self.shed_queue_depth:
+            self._m_shed.inc()
+            self._note("shed")
+            trace.finish("error", "QueueFull: shed at queue depth "
+                         f"{self._q.qsize()}")
+            self._record_slo(0.0, False)
+            raise QueueFull(
+                f"generation queue depth {self._q.qsize()} at/above "
+                f"shedding threshold {self.shed_queue_depth}")
+        dl = self.deadline_ms if deadline_ms is None else deadline_ms
+        # RE-ARMED: absolute deadline from NOW (decode-pool submit), not
+        # from the back-dated origin enqueue below
+        deadline = None if dl is None else time.perf_counter() + dl / 1e3
+        x = shipment.x if shipment.x is not None \
+            else np.zeros((plen, self._f), np.float32)
+        req = _GenRequest(x, plen, max_new,
+                          self.eos_id if eos_id is None else eos_id,
+                          deadline, trace, shipment=shipment)
+        req.t_enqueue = time.perf_counter() - float(shipment.elapsed_s)
+        self._m_requests.inc()
+        self._q.put(req)
+        if self._shutdown.is_set() and not req.handle.future.done():
+            req.handle.future.set_exception(ShutdownError(
+                "ContinuousBatcher shut down before the request was served"))
+            req.handle._finish()
+            req.trace.finish("error", "ShutdownError: shut down before "
+                             "the request was served")
+        return req.handle
+
     def active_slots(self) -> int:
         return sum(1 for r in self._slot_req if r is not None)
 
@@ -1122,6 +1220,7 @@ class ContinuousBatcher:
         tpot = self._h_tpot.hist_snapshot()
         out = {
             "slots": self.slots,
+            "pool": self._pool_label,
             "health": self.health(),
             "slots_active": int(self._g_slots.value()),
             "queue_depth": self._q.qsize(),
@@ -1307,29 +1406,74 @@ class ContinuousBatcher:
         if need_c > self._state.cache_len:
             self._state = self.engine.grow(self._state, need_c)
         req.t_admitted = time.perf_counter()
-        # timeline (ISSUE 13): queue = enqueue -> admission; the deadline
-        # clock restarts here (decided r13 semantics), the trace keeps
-        # the whole submit->resolve wall
-        req.trace.phase("queue", req.t_admitted - req.t_enqueue)
-        if self.paged:
-            logits = self._paged_admit(req, slot)
+        if req.shipment is not None:
+            # ISSUE 18 handoff: everything since the ORIGIN submit that
+            # the shipped phases don't already cover (serialization, the
+            # channel, the decode queue wait) is the handoff phase — so
+            # the stitched cross-pool timeline still tiles the measured
+            # latency exactly
+            req.trace.phase("handoff", max(
+                0.0, (req.t_admitted - req.t_enqueue)
+                - float(req.shipment.phase_total_s)))
+            logits = self._adopt_admit(req, slot)
+            now = time.perf_counter()
+            req.trace.phase("adopt", now - req.t_admitted, slot=slot)
         else:
-            self._state, logits = self.engine.prefill(
-                self._state, req.x, req.plen, slot)
-        if self.draft is not None:
-            # the draft's (small, contiguous) caches always prefill —
-            # they are private per slot, never shared
-            if need_c > self._dstate.cache_len:
-                self._dstate = self.draft.grow(self._dstate, need_c)
-            self._dstate, _ = self.draft.prefill(
-                self._dstate, req.x, req.plen, slot)
-            self._dlengths[slot] = req.plen
-        now = time.perf_counter()
-        req.trace.phase("prefill", now - req.t_admitted, slot=slot)
+            # timeline (ISSUE 13): queue = enqueue -> admission; the
+            # deadline clock restarts here (decided r13 semantics), the
+            # trace keeps the whole submit->resolve wall
+            req.trace.phase("queue", req.t_admitted - req.t_enqueue)
+            if self.paged:
+                logits = self._paged_admit(req, slot)
+            else:
+                self._state, logits = self.engine.prefill(
+                    self._state, req.x, req.plen, slot)
+            if self.draft is not None:
+                # the draft's (small, contiguous) caches always prefill
+                # — they are private per slot, never shared
+                if need_c > self._dstate.cache_len:
+                    self._dstate = self.draft.grow(self._dstate, need_c)
+                self._dstate, _ = self.draft.prefill(
+                    self._dstate, req.x, req.plen, slot)
+                self._dlengths[slot] = req.plen
+            now = time.perf_counter()
+            req.trace.phase("prefill", now - req.t_admitted, slot=slot)
         req.t_anchor = now
         self._slot_req[slot] = req
         self._lengths[slot] = req.plen
         self._emit_token(slot, logits)
+
+    def _adopt_admit(self, req: _GenRequest, slot: int) -> np.ndarray:
+        """Admission for a migrated request (ISSUE 18): a prefix-registry
+        hit on the shipped key maps ALREADY-adopted pages (the fleet-wide
+        hit — an identical prompt migrated here before, or was prefilled
+        locally); a miss adopts fresh pages, scatters the shipped payload
+        blocks in bucketed device calls, and registers the prefix so the
+        NEXT identical prompt on this pool hits without re-migrating —
+        the shared system prompt is prefilled once per POOL."""
+        sh = req.shipment
+        eng = self.engine
+        if self.prefix_cache and sh.prefix_key is not None:
+            hit = eng.pool.lookup_prefix(sh.prefix_key)
+            if hit is not None:
+                eng.map_pages(self._state, slot, hit.pages)
+                self._state.lengths[slot] = req.plen
+                return hit.logits.copy()
+        pages = eng.pool.adopt(len(sh.pages))
+        try:
+            self._state = eng.import_pages(self._state, pages, sh.payload)
+            eng.map_pages(self._state, slot, pages)
+            self._state.lengths[slot] = req.plen
+        except BaseException:
+            # same once-only reclaim as _paged_admit: clear the row
+            # before releasing so _reset_slot cannot double-release
+            self._state.page_table[slot, :] = 0
+            eng.pool.release(pages)
+            raise
+        if self.prefix_cache and sh.prefix_key is not None:
+            eng.pool.register_prefix(sh.prefix_key, pages, req.plen,
+                                     sh.logits)
+        return np.asarray(sh.logits).copy()
 
     def _paged_admit(self, req: _GenRequest, slot: int) -> np.ndarray:
         """Paged admission with prefix sharing (ISSUE 12): hash the full
@@ -1348,11 +1492,10 @@ class ContinuousBatcher:
         n_pages = -(-req.plen // P)
         key = None
         if self.prefix_cache:
-            h = hashlib.blake2b(digest_size=16)
-            h.update(np.int64(req.plen).tobytes())
-            h.update(np.ascontiguousarray(req.x[:req.plen],
-                                          dtype=np.float32).tobytes())
-            key = h.hexdigest()
+            # shared with the ISSUE 18 router: both sides must agree on
+            # the key for repeat prompts to hit migrated pages
+            from .kv_pool import prompt_key
+            key = prompt_key(req.x, req.plen)
             hit = self.engine.pool.lookup_prefix(key)
             if hit is not None:
                 self.engine.map_pages(self._state, slot, hit.pages)

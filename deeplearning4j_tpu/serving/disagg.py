@@ -1,0 +1,708 @@
+"""Disaggregated generative serving (ISSUE 18 tentpole): a PREFILL pool
+and a DECODE pool connected by KV-page migration, with a router that owns
+admission.
+
+The r17 per-iteration timelines show the interference this removes:
+prefill (compute-bound, bursty, long) and decode (memory-bound, steady,
+short) share chips in a colocated batcher, so one long prefill stalls
+every decode iteration admitted behind it — TPOT p99 degrades exactly
+when prefill load ramps. Here each phase runs on the resource it is
+bound on (the TensorFlow dynamic-placement thesis, PAPERS.md
+1605.08695), and the placement decision reads MEASURED attribution
+fractions, not guesses:
+
+- :class:`PrefillReplica` runs ``PagedGenerativeEngine`` prefill ONLY
+  and ships the resulting KV pages as a :class:`KVShipment` — payload
+  blocks of ``[page_size, H, d]`` token rows per layer (plus the d=1
+  int8 scale rows when ``kv_cache="int8"``), a host-side page-table
+  handoff, the prefill logits, and the prefix-registry key.
+- The decode pool's ``ContinuousBatcher.submit_prefilled`` ADOPTS the
+  shipment: its ``kv_pool`` allocator hands out fresh table slots
+  (``adopt`` — refcounted exactly like local pages), the payload
+  scatters in bucketed device calls, and the prefix registers under the
+  SHIPPED key — so a fleet-wide system prompt is prefilled once per
+  POOL, not per process, and the second identical prompt on a DIFFERENT
+  replica reuses the migrated pages.
+- :class:`DisaggRouter` owns admission: prefill requests route to
+  compute-rich replicas and decode residency to HBM-rich ones, using
+  each replica's cached ``attribution_report`` fractions plus live
+  pages-free/queue-depth telemetry. One ``ref_snapshot()`` per ROUTING
+  ROUND (the r21 pattern) supplies every candidate's pages-free count —
+  the router never takes a pool lock per candidate request.
+- Deadline semantics (the r13 contract extended): the router's
+  ``deadline_ms`` bounds submit -> PREFILL admission; at the decode pool
+  the clock RE-ARMS (``submit_prefilled``), so a slow handoff can never
+  expire prefill work the other pool already paid for.
+- One request, ONE timeline: the decode pool continues the prefill
+  pool's trace id, so ``stitch_event_logs`` + ``merge_trace_records``
+  yield a single timeline whose phases (queue, prefill, export, handoff,
+  adopt, decode xN) sum to the measured latency across the process
+  boundary.
+
+Serialization is pickle-free: a JSON header + raw ``tobytes()`` buffers
+(:meth:`KVShipment.to_bytes` / :meth:`KVShipment.from_bytes`), framed
+for a stream socket by :func:`write_msg` / :func:`read_msg` — the same
+loopback process channels ``parallel/multihost_sim.py`` exercises.
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+import threading
+import time
+import weakref
+from collections import deque
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import numpy as np
+
+from ..runtime import telemetry as _tel
+from ..runtime.faults import DeadlineExceeded, QueueFull, ShutdownError
+from .batcher import HealthState, _pi_ids
+from .engine import PagedGenerativeEngine, next_bucket
+from .kv_pool import prompt_key
+
+_M_MIGRATIONS = _tel.counter(
+    "serving.disagg.migrations",
+    "KV shipments adopted across the prefill->decode pool boundary")
+_M_ROUTED_PREFILL = _tel.counter(
+    "serving.disagg.routed_prefill",
+    "router admissions that paid a prefill-pool prefill")
+_M_ROUTED_HIT = _tel.counter(
+    "serving.disagg.routed_prefix_hit",
+    "router admissions served from a decode pool's resident prefix "
+    "(no prefill, no migration)")
+_H_ROUTE = _tel.histogram(
+    "serving.phase.route_s",
+    "router admission decision time per request (snapshot + scoring)")
+
+
+# --------------------------------------------------------------------- wire
+
+def write_msg(sock, data: bytes) -> None:
+    """Length-prefixed frame on a stream socket (the shipment channel)."""
+    sock.sendall(struct.pack("<Q", len(data)) + data)
+
+
+def read_msg(sock) -> bytes:
+    """Read one :func:`write_msg` frame; raises ConnectionError on EOF
+    mid-frame (a torn shipment must fail loudly, never truncate)."""
+    buf = b""
+    while len(buf) < 8:
+        chunk = sock.recv(8 - len(buf))
+        if not chunk:
+            raise ConnectionError("channel closed reading frame header")
+        buf += chunk
+    (n,) = struct.unpack("<Q", buf)
+    parts: List[bytes] = []
+    got = 0
+    while got < n:
+        chunk = sock.recv(min(1 << 20, n - got))
+        if not chunk:
+            raise ConnectionError(
+                f"channel closed mid-frame ({got}/{n} bytes)")
+        parts.append(chunk)
+        got += len(chunk)
+    return b"".join(parts)
+
+
+class KVShipment:
+    """One migrated prompt's KV pages + handoff metadata (ISSUE 18).
+
+    ``payload`` mirrors the engine's ``paged_cache_spec`` tree
+    (``{layer: {name: [n_pages*page_size, H, d] rows}}``) in the PAGE
+    ORDER of ``pages``; int8 pools carry their per-row f32 scale leaves
+    in the same tree. ``elapsed_s`` is the origin-side wall from the
+    ORIGIN submit to shipment construction and back-dates the decode
+    pool's ``t_enqueue``; ``phase_total_s`` is the sum of trace phases
+    the origin emitted, so the decode pool's ``handoff`` phase can tile
+    the un-phased remainder exactly."""
+
+    __slots__ = ("page_size", "plen", "pages", "payload", "logits",
+                 "prefix_key", "x", "kv_quant", "trace_id", "elapsed_s",
+                 "phase_total_s")
+
+    def __init__(self, page_size: int, plen: int, pages: Sequence[int],
+                 payload, logits, prefix_key: Optional[str] = None,
+                 x=None, kv_quant: bool = False,
+                 trace_id: Optional[str] = None,
+                 elapsed_s: float = 0.0, phase_total_s: float = 0.0):
+        self.page_size = int(page_size)
+        self.plen = int(plen)
+        self.pages = [int(p) for p in pages]
+        self.payload = payload
+        self.logits = np.asarray(logits)
+        self.prefix_key = prefix_key
+        self.x = None if x is None else np.asarray(x, np.float32)
+        self.kv_quant = bool(kv_quant)
+        self.trace_id = trace_id
+        self.elapsed_s = float(elapsed_s)
+        self.phase_total_s = float(phase_total_s)
+
+    # ------------------------------------------------------------ validation
+    def validate_for(self, engine: PagedGenerativeEngine) -> None:
+        """Loud structural rejection BEFORE the request queues (ISSUE 18
+        satellite): page-size, quantization-mode, layer-tree, and
+        head-count/dtype mismatches between pools raise here, not deep
+        inside a device scatter."""
+        if self.page_size != int(engine.page_size):
+            raise ValueError(
+                f"page-size mismatch: shipment pages are "
+                f"{self.page_size} tokens, receiving pool uses "
+                f"{engine.page_size}")
+        if self.kv_quant != bool(engine._kv_quant):
+            raise ValueError(
+                "kv_cache quantization modes disagree across pools: "
+                f"shipment int8={self.kv_quant}, receiving engine "
+                f"int8={bool(engine._kv_quant)}")
+        spec = engine._pool_spec()
+        spec_leaves, spec_def = jax.tree.flatten(spec)
+        pay_leaves, pay_def = jax.tree.flatten(self.payload)
+        if pay_def != spec_def:
+            raise ValueError(
+                "migrated payload layer tree does not match the "
+                f"receiving pool's cache layout: {pay_def} vs {spec_def}")
+        rows = len(self.pages) * self.page_size
+        for sl, pl in zip(spec_leaves, pay_leaves):
+            pl = np.asarray(pl)
+            want = (rows,) + tuple(sl.shape[1:])
+            if tuple(pl.shape) != want:
+                raise ValueError(
+                    f"migrated payload block {tuple(pl.shape)} != {want} "
+                    "(head-count/head-dim mismatch between pools)")
+            if np.dtype(pl.dtype) != np.dtype(sl.dtype):
+                raise ValueError(
+                    f"migrated payload dtype {pl.dtype} != pool dtype "
+                    f"{sl.dtype}")
+        if -(-self.plen // self.page_size) != len(self.pages):
+            raise ValueError(
+                f"shipment carries {len(self.pages)} pages for plen "
+                f"{self.plen} (page_size {self.page_size})")
+
+    # --------------------------------------------------------- serialization
+    def _leaf_iter(self):
+        # layer keys stay exactly as the pool spec spells them (string
+        # layer indices) — coercing them would change the tree_def and
+        # fail validate_for on a byte-identical payload
+        for layer in sorted(self.payload, key=str):
+            for name in sorted(self.payload[layer]):
+                yield layer, name, np.asarray(self.payload[layer][name])
+
+    def to_bytes(self) -> bytes:
+        """Pickle-free wire form: one JSON header + concatenated raw
+        ``tobytes()`` buffers (logits, optional prompt features, then
+        every payload leaf in sorted (layer, name) order)."""
+        leaves = []
+        bufs = [np.ascontiguousarray(self.logits).tobytes()]
+        if self.x is not None:
+            bufs.append(np.ascontiguousarray(self.x).tobytes())
+        for layer, name, arr in self._leaf_iter():
+            leaves.append({"layer": layer, "name": name,
+                           "shape": list(arr.shape),
+                           "dtype": np.dtype(arr.dtype).name})
+            bufs.append(np.ascontiguousarray(arr).tobytes())
+        header = {
+            "v": 1,
+            "page_size": self.page_size,
+            "plen": self.plen,
+            "pages": self.pages,
+            "kv_quant": self.kv_quant,
+            "prefix_key": self.prefix_key,
+            "trace_id": self.trace_id,
+            "elapsed_s": self.elapsed_s,
+            "phase_total_s": self.phase_total_s,
+            "logits": {"shape": list(self.logits.shape),
+                       "dtype": np.dtype(self.logits.dtype).name},
+            "x": None if self.x is None else
+                 {"shape": list(self.x.shape), "dtype": "float32"},
+            "leaves": leaves,
+        }
+        hj = json.dumps(header).encode("utf-8")
+        return struct.pack("<Q", len(hj)) + hj + b"".join(bufs)
+
+    @classmethod
+    def from_bytes(cls, data: bytes) -> "KVShipment":
+        (hn,) = struct.unpack("<Q", data[:8])
+        header = json.loads(data[8:8 + hn].decode("utf-8"))
+        if header.get("v") != 1:
+            raise ValueError(f"unknown KVShipment wire version "
+                             f"{header.get('v')!r}")
+        off = 8 + hn
+
+        def take(shape, dtype):
+            nonlocal off
+            n = int(np.prod(shape or [1])) * np.dtype(dtype).itemsize
+            arr = np.frombuffer(data[off:off + n], dtype=dtype) \
+                .reshape(shape).copy()
+            off += n
+            return arr
+
+        logits = take(header["logits"]["shape"], header["logits"]["dtype"])
+        x = None
+        if header["x"] is not None:
+            x = take(header["x"]["shape"], header["x"]["dtype"])
+        payload: Dict[str, Dict[str, np.ndarray]] = {}
+        for leaf in header["leaves"]:
+            payload.setdefault(leaf["layer"], {})[leaf["name"]] = \
+                take(leaf["shape"], leaf["dtype"])
+        return cls(header["page_size"], header["plen"], header["pages"],
+                   payload, logits, prefix_key=header["prefix_key"],
+                   x=x, kv_quant=header["kv_quant"],
+                   trace_id=header["trace_id"],
+                   elapsed_s=header["elapsed_s"],
+                   phase_total_s=header["phase_total_s"])
+
+
+class PrefillReplica:
+    """A compute-pool replica: runs ``PagedGenerativeEngine`` prefill
+    ONLY and ships the resulting pages (ISSUE 18). Its own pool's prefix
+    registry makes repeat prompts free on THIS side too — a registered
+    prompt exports its resident pages without re-prefilling.
+
+    ``prompt_buckets`` drive both the prefill executables and the
+    migration (page-count) buckets, so a warmed replica ships at zero
+    post-warmup compiles."""
+
+    def __init__(self, model, pages: int = 64, page_size: int = 16,
+                 max_cache_len: int = 256,
+                 prompt_buckets: Optional[Sequence[int]] = None,
+                 quantize: Optional[str] = None,
+                 kv_cache: Optional[str] = None,
+                 prefix_cache: bool = True,
+                 ship_features: bool = False,
+                 pool_label: str = "prefill"):
+        self.engine = PagedGenerativeEngine(
+            model, slots=1, pages=pages, page_size=page_size,
+            max_cache_len=max_cache_len, quantize=quantize,
+            kv_cache=kv_cache, pool_label=pool_label)
+        self.pool_label = str(pool_label)
+        self.prefix_cache = bool(prefix_cache)
+        self.ship_features = bool(ship_features)
+        P = self.engine.page_size
+        pb = sorted({next_bucket(int(t)) for t in
+                     (prompt_buckets or [max_cache_len])})
+        self.engine.warmup(
+            [max_cache_len], pb,
+            migrate_buckets=sorted({-(-t // P) for t in pb}))
+        self._state = self.engine.new_state(max_cache_len)
+        self._lock = threading.Lock()
+        self._inflight = 0
+        self._events: deque = deque(maxlen=1024)
+
+    # same r10 recent-event window as the serving fronts, per POOL
+    def note(self, kind: str) -> None:
+        self._events.append((time.perf_counter(), kind))
+
+    def health(self, window_s: float = 5.0) -> str:
+        now = time.perf_counter()
+        recent = {k for t, k in list(self._events) if now - t <= window_s}
+        if "shed" in recent:
+            return HealthState.SHEDDING
+        if recent & {"failure", "retry", "deadline"}:
+            return HealthState.DEGRADED
+        return HealthState.HEALTHY
+
+    def queue_depth(self) -> int:
+        return self._inflight
+
+    def prefill(self, prompt, plen: Optional[int] = None,
+                t_origin: Optional[float] = None) -> KVShipment:
+        """Prefill one prompt (or hit this replica's own registry) and
+        export its pages as a :class:`KVShipment`. Synchronous — the
+        router serializes prefills per replica; ``t_origin`` is the
+        origin submit's ``perf_counter`` so the shipment's elapsed time
+        (and the stitched timeline's ``queue`` phase) spans any router
+        queue wait."""
+        prompt = np.asarray(prompt, np.float32)
+        if prompt.ndim == 3 and prompt.shape[0] == 1:
+            prompt = prompt[0]
+        plen = int(plen) if plen is not None else int(prompt.shape[0])
+        eng = self.engine
+        P = eng.page_size
+        n_pages = -(-plen // P)
+        t0 = time.perf_counter()
+        origin = t_origin if t_origin is not None else t0
+        trace = _tel.start_request_trace(
+            "serving.generate", pool=self.pool_label, plen=plen,
+            migrated=True)
+        phases: List[float] = []
+
+        def phase(name, dur, **attrs):
+            trace.phase(name, dur, **attrs)
+            phases.append(float(dur))
+
+        phase("queue", t0 - origin)
+        key = prompt_key(prompt, plen) if self.prefix_cache else None
+        self._inflight += 1
+        try:
+            with self._lock:
+                hit = eng.pool.lookup_prefix(key) \
+                    if key is not None else None
+                if hit is not None:
+                    pages = list(hit.pages)
+                    logits = hit.logits.copy()
+                else:
+                    t1 = time.perf_counter()
+                    pages = eng.pool.alloc(n_pages)
+                    try:
+                        eng.map_pages(self._state, 0, pages)
+                        self._state, logits = eng.prefill(
+                            self._state, prompt, plen, 0)
+                    except BaseException:
+                        self._state.page_table[0, :] = 0
+                        eng.pool.release(pages)
+                        self.note("failure")
+                        raise
+                    if key is not None:
+                        eng.pool.register_prefix(key, pages, plen, logits)
+                    phase("prefill", time.perf_counter() - t1)
+                t2 = time.perf_counter()
+                payload = eng.export_pages(self._state, pages)
+                phase("export", time.perf_counter() - t2,
+                      pages=len(pages))
+                if hit is not None:
+                    # lookup_prefix bumped a stream ref for us
+                    eng.pool.release(pages)
+                else:
+                    # the registry's own ref keeps the pages resident;
+                    # release the stream ref + clear the slot row (or
+                    # drop an unregistered prompt's pages entirely)
+                    eng.release_slot(self._state, 0)
+                    eng.pool.release(pages)
+        finally:
+            self._inflight -= 1
+        now = time.perf_counter()
+        ship = KVShipment(
+            P, plen, pages, payload, logits, prefix_key=key,
+            x=prompt if self.ship_features else None,
+            kv_quant=bool(eng._kv_quant), trace_id=trace.trace_id,
+            elapsed_s=now - origin, phase_total_s=sum(phases))
+        # the prefill pool's half of the ONE timeline ends at handoff;
+        # the decode pool continues under the same trace id
+        trace.finish("handoff", pages=len(pages))
+        return ship
+
+    def stats(self) -> dict:
+        return {"pool": self.pool_label, "health": self.health(),
+                "inflight": self._inflight,
+                "engine": self.engine.stats()}
+
+
+class RouterHandle:
+    """The router's answer to :class:`GenerationHandle`: resolves to the
+    decode-pool handle once routing lands; ``result()``/``tokens()``
+    delegate. Routing failures (shed, deadline, structural rejection)
+    surface through :meth:`result` exactly like batcher failures."""
+
+    def __init__(self):
+        from concurrent.futures import Future
+        self._inner: "Future" = Future()
+        self.trace_id: Optional[str] = None
+
+    def _bind(self, handle) -> None:
+        self.trace_id = handle.trace_id
+        self._inner.set_result(handle)
+
+    def _fail(self, err: BaseException) -> None:
+        if not self._inner.done():
+            self._inner.set_exception(err)
+
+    def result(self, timeout: Optional[float] = None) -> dict:
+        return self._inner.result(timeout=timeout).result(timeout=timeout)
+
+    def tokens(self, timeout: Optional[float] = None):
+        handle = self._inner.result(timeout=timeout)
+        return handle.tokens(timeout=timeout)
+
+
+class _RouteRequest:
+    __slots__ = ("prompt", "plen", "max_new", "deadline_ms", "eos_id",
+                 "handle", "t_enqueue", "deadline")
+
+    def __init__(self, prompt, plen, max_new, deadline_ms, eos_id):
+        self.prompt = prompt
+        self.plen = int(plen)
+        self.max_new = max_new
+        self.deadline_ms = deadline_ms
+        self.eos_id = eos_id
+        self.handle = RouterHandle()
+        self.t_enqueue = time.perf_counter()
+        # the ROUTER deadline bounds submit -> prefill admission; the
+        # decode pool re-arms its own clock at submit_prefilled (r13
+        # semantics extended — see ContinuousBatcher.submit_prefilled)
+        self.deadline = None if deadline_ms is None \
+            else self.t_enqueue + deadline_ms / 1e3
+
+
+class DisaggRouter:
+    """Admission owner for a disaggregated serving topology (ISSUE 18):
+    N prefill replicas (compute pool) + M decode replicas
+    (``ContinuousBatcher`` fronts over HBM-rich pools).
+
+    Routing, per request:
+
+    1. Probe every decode replica's prefix registry (non-mutating
+       ``peek_prefix``) — a resident prompt routes straight to that
+       replica's ordinary ``submit`` (its admission maps the resident
+       pages; no prefill, no migration).
+    2. Otherwise prefill on the most COMPUTE-RICH prefill replica —
+       ranked by cached ``attribution_report`` compute-fraction headroom
+       (lower measured compute fraction = more headroom), queue depth
+       breaking ties — then adopt on the most HBM-RICH decode replica:
+       pages-free (read from this round's ``ref_snapshot``, see below)
+       minus a queue-depth penalty, SHEDDING replicas excluded.
+
+    One ``ref_snapshot()`` per ROUTING ROUND (ISSUE 18 satellite, the
+    r21 pattern): the admission loop drains a batch of queued requests
+    per round and takes ONE refcount snapshot per decode pool for the
+    whole batch — scoring candidates never takes a pool lock per
+    request. A stale snapshot can at worst mis-rank a replica by a few
+    pages; it can never corrupt admission (the batcher re-checks
+    capacity under its own lock).
+
+    Health is per-POOL (the r10/r17 state machine extended): ``health()``
+    reports prefill-pool, decode-pool, and router states; the pool SLOs
+    (burn-rate alarms) ride the member fronts' existing machinery."""
+
+    def __init__(self, prefills: Sequence[PrefillReplica],
+                 decodes: Sequence, max_new_tokens: int = 32,
+                 deadline_ms: Optional[float] = None,
+                 shed_queue_depth: Optional[int] = None,
+                 queue_limit: int = 256,
+                 round_limit: int = 8,
+                 health_window_s: float = 5.0):
+        import queue as _queue
+        if not prefills or not decodes:
+            raise ValueError("DisaggRouter needs >= 1 prefill and >= 1 "
+                             "decode replica")
+        self.prefills = list(prefills)
+        self.decodes = list(decodes)
+        for cb in self.decodes:
+            if not getattr(cb, "paged", False):
+                raise ValueError("decode replicas must serve paged "
+                                 "engines (KV pages migrate)")
+        self.max_new_tokens = int(max_new_tokens)
+        self.deadline_ms = deadline_ms
+        self.shed_queue_depth = None if shed_queue_depth is None \
+            else int(shed_queue_depth)
+        self.round_limit = max(1, int(round_limit))
+        self.health_window = float(health_window_s)
+        self._q: "_queue.Queue[_RouteRequest]" = \
+            _queue.Queue(maxsize=queue_limit)
+        self._shutdown = threading.Event()
+        self._events: deque = deque(maxlen=1024)
+        self._reports: Dict[tuple, Optional[dict]] = {}
+        self._id = str(next(_pi_ids))
+        weakref.finalize(self, _tel.registry.discard_cells, pi=self._id)
+        _pi = self._id
+        self._m_migrations = _M_MIGRATIONS.labeled(pi=_pi, pool="router")
+        self._m_routed_prefill = _M_ROUTED_PREFILL.labeled(
+            pi=_pi, pool="router")
+        self._m_routed_hit = _M_ROUTED_HIT.labeled(pi=_pi, pool="router")
+        self._h_route = _H_ROUTE.labeled(pi=_pi, pool="router")
+        self._worker = threading.Thread(target=self._loop, daemon=True,
+                                        name="DisaggRouter-admission")
+        self._worker.start()
+
+    # ------------------------------------------------------------- admission
+    def submit(self, prompt=None, tokens=None, plen: Optional[int] = None,
+               max_new_tokens: Optional[int] = None,
+               deadline_ms: Optional[float] = None,
+               eos_id: Optional[int] = None) -> RouterHandle:
+        """Enqueue one generation into the topology. Sheds in the
+        caller's thread (``QueueFull``) above ``shed_queue_depth``, like
+        the member fronts."""
+        if self._shutdown.is_set():
+            raise ShutdownError("DisaggRouter is shut down")
+        if tokens is not None:
+            t2f = self.decodes[0].token_to_features
+            prompt = np.stack([t2f(t) for t in tokens])
+        prompt = np.asarray(prompt, np.float32)
+        if prompt.ndim == 3 and prompt.shape[0] == 1:
+            prompt = prompt[0]
+        plen = int(plen) if plen is not None else int(prompt.shape[0])
+        if self.shed_queue_depth is not None and \
+                self._q.qsize() >= self.shed_queue_depth:
+            self._note("shed")
+            raise QueueFull(
+                f"router queue depth {self._q.qsize()} at/above shedding "
+                f"threshold {self.shed_queue_depth}")
+        dl = self.deadline_ms if deadline_ms is None else deadline_ms
+        req = _RouteRequest(prompt, plen,
+                            max_new_tokens if max_new_tokens is not None
+                            else self.max_new_tokens, dl, eos_id)
+        self._q.put(req)
+        return req.handle
+
+    def generate(self, prompt=None, tokens=None, **kw) -> dict:
+        return self.submit(prompt=prompt, tokens=tokens, **kw).result()
+
+    def _note(self, kind: str) -> None:
+        self._events.append((time.perf_counter(), kind))
+
+    def _loop(self):
+        import queue as _queue
+        while not self._shutdown.is_set():
+            try:
+                first = self._q.get(timeout=0.05)
+            except _queue.Empty:
+                continue
+            batch = [first]
+            while len(batch) < self.round_limit:
+                try:
+                    batch.append(self._q.get_nowait())
+                except _queue.Empty:
+                    break
+            # ONE snapshot per routing round (r21 pattern): refcounts ->
+            # pages-free for every decode candidate, no per-request lock
+            snaps = [cb.engine.pool.ref_snapshot() for cb in self.decodes]
+            free = [int(np.count_nonzero(s[1:] == 0)) for s in snaps]
+            for req in batch:
+                try:
+                    self._route_one(req, free)
+                except BaseException as e:
+                    self._note("failure")
+                    req.handle._fail(e)
+
+    def _route_one(self, req: _RouteRequest, pages_free: List[int]):
+        t0 = time.perf_counter()
+        # router deadline: bounds submit -> prefill admission only
+        if req.deadline is not None and t0 > req.deadline:
+            self._note("deadline")
+            req.handle._fail(DeadlineExceeded(
+                "request expired in the router queue before prefill "
+                "admission"))
+            return
+        key = prompt_key(req.prompt, req.plen)
+        # 1) resident prompt? route to its decode replica, no migration
+        for i, cb in enumerate(self.decodes):
+            if cb.prefix_cache and cb.engine.pool.peek_prefix(key):
+                self._m_routed_hit.inc()
+                self._h_route.observe(time.perf_counter() - t0)
+                req.handle._bind(cb.submit(
+                    prompt=req.prompt, plen=req.plen,
+                    max_new_tokens=req.max_new,
+                    deadline_ms=req.deadline_ms, eos_id=req.eos_id))
+                return
+        # 2) prefill on the compute-rich replica, adopt on the HBM-rich
+        pre = self.prefills[self._pick_prefill()]
+        self._h_route.observe(time.perf_counter() - t0)
+        ship = pre.prefill(req.prompt, plen=req.plen,
+                           t_origin=req.t_enqueue)
+        self._m_routed_prefill.inc()
+        di = self._pick_decode(pages_free, len(ship.pages))
+        cb = self.decodes[di]
+        pages_free[di] -= len(ship.pages)   # keep the round's view honest
+        self._m_migrations.inc()
+        # deadline RE-ARMS at the decode pool (r13 extended): the full
+        # original budget guards decode-queue wait, never the handoff
+        req.handle._bind(cb.submit_prefilled(
+            ship, max_new_tokens=req.max_new,
+            deadline_ms=req.deadline_ms, eos_id=req.eos_id))
+
+    # --------------------------------------------------------------- scoring
+    def _report_fractions(self, idx, engine, cache_len: int):
+        """Cached attribution fractions per replica engine (the ISSUE 13
+        machinery as a routing signal). None when the program cannot be
+        attributed (no cost model, no measurement) — scoring then falls
+        back to queue depth / pages-free alone."""
+        if idx not in self._reports:
+            try:
+                rep = engine.attribution_report(cache_len)
+                self._reports[idx] = rep.get("fractions") \
+                    if rep.get("cost_available") else None
+            except Exception:
+                self._reports[idx] = None
+        return self._reports[idx]
+
+    def _pick_prefill(self) -> int:
+        best, best_score = 0, None
+        for i, pre in enumerate(self.prefills):
+            if pre.health(self.health_window) == HealthState.SHEDDING:
+                continue
+            fr = self._report_fractions(
+                ("p", i), pre.engine, pre.engine.max_cache_len)
+            headroom = 1.0 - float(fr["compute"]) if fr else 0.5
+            score = headroom - 0.25 * pre.queue_depth()
+            if best_score is None or score > best_score:
+                best, best_score = i, score
+        return best
+
+    def _pick_decode(self, pages_free: List[int], need: int) -> int:
+        best, best_score = 0, None
+        for i, cb in enumerate(self.decodes):
+            if cb.health() == HealthState.SHEDDING:
+                continue
+            fr = self._report_fractions(
+                ("d", i), cb.engine, cb.max_cache_len)
+            # HBM-rich: free pages normalized by pool size, discounted
+            # by measured memory-boundedness and queue depth
+            total = max(1, cb.engine.pages - 1)
+            score = pages_free[i] / total \
+                - 0.1 * (float(fr["memory"]) if fr else 0.5) \
+                - 0.05 * cb.queue_depth()
+            if pages_free[i] < need:
+                score -= 1.0    # would force eviction on arrival
+            if best_score is None or score > best_score:
+                best, best_score = i, score
+        return best
+
+    # ---------------------------------------------------------------- health
+    def health(self) -> dict:
+        """Per-POOL health (r10/r17 extended): worst member state per
+        pool plus the router's own shed/deadline window."""
+        def worst(states):
+            order = [HealthState.HEALTHY, HealthState.DEGRADED,
+                     HealthState.SHEDDING]
+            return max(states, key=order.index) if states else \
+                HealthState.HEALTHY
+        now = time.perf_counter()
+        recent = {k for t, k in list(self._events)
+                  if now - t <= self.health_window}
+        if "shed" in recent or (
+                self.shed_queue_depth is not None
+                and self._q.qsize() >= self.shed_queue_depth):
+            router = HealthState.SHEDDING
+        elif recent & {"failure", "deadline"}:
+            router = HealthState.DEGRADED
+        else:
+            router = HealthState.HEALTHY
+        return {
+            "router": router,
+            "prefill": worst([p.health(self.health_window)
+                              for p in self.prefills]),
+            "decode": worst([cb.health() for cb in self.decodes]),
+        }
+
+    def stats(self) -> dict:
+        return {
+            "health": self.health(),
+            "queue_depth": self._q.qsize(),
+            "migrations": int(self._m_migrations.value()),
+            "routed_prefill": int(self._m_routed_prefill.value()),
+            "routed_prefix_hit": int(self._m_routed_hit.value()),
+            "prefill": [p.stats() for p in self.prefills],
+            "decode": [cb.stats() for cb in self.decodes],
+        }
+
+    def shutdown(self):
+        self._shutdown.set()
+        if self._worker:
+            self._worker.join(timeout=10)
+        err = ShutdownError("DisaggRouter shut down before the request "
+                            "was routed")
+        import queue as _queue
+        while True:
+            try:
+                req = self._q.get_nowait()
+            except _queue.Empty:
+                break
+            req.handle._fail(err)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.shutdown()

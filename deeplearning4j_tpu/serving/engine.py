@@ -75,6 +75,14 @@ _H_PREFILL = _tel.histogram("serving.phase.prefill_s",
                             "prompt prefill time per admitted request")
 _H_DECODE = _tel.histogram("serving.phase.decode_step_s",
                            "one decode iteration over the slot batch")
+# disaggregated serving (ISSUE 18): KV-page migration — whole pages
+# gathered to host / scattered from host in ONE device call per bucket
+_H_KV_EXPORT = _tel.histogram(
+    "serving.phase.kv_export_s",
+    "KV-page export (device gather + host copy) per migrated request")
+_H_KV_IMPORT = _tel.histogram(
+    "serving.phase.kv_import_s",
+    "KV-page import (host upload + device scatter) per adopted request")
 # int8 post-training quantization (ISSUE 9): the calibration/dequant
 # telemetry and the quantized-params source moved to
 # parallel/placement.py with the rest of the placement machinery
@@ -131,8 +139,14 @@ class InferenceEngine(_QuantizedParamsMixin):
 
     def __init__(self, model, mesh=None, data_axis: str = "data",
                  min_bucket: int = 1, quantize: Optional[str] = None,
-                 model_axis: Optional[str] = "model"):
+                 model_axis: Optional[str] = "model",
+                 pool_label: str = "default"):
         self.model = model
+        # ISSUE 18: disaggregated topologies run several engines per
+        # PROCESS ROLE (prefill pool vs decode pool); every serving.*
+        # cell carries pool= beside engine= so pool-level dashboards
+        # never blend phases across roles (staticcheck enforces it)
+        self._pool_label = str(pool_label)
         self.mesh = mesh
         self.data_axis = data_axis
         self._placement_layer = None
@@ -174,20 +188,22 @@ class InferenceEngine(_QuantizedParamsMixin):
         weakref.finalize(self, _tel.registry.discard_cells, engine=self._id)
         self._init_quantize(quantize)
         self._bind_quantize_cells()
-        self._m_calls = _M_CALLS.labeled(engine=self._id)
-        self._m_hits = _M_HITS.labeled(engine=self._id)
-        self._m_compiles = _M_COMPILES.labeled(engine=self._id)
-        self._m_padded = _M_PADDED.labeled(engine=self._id)
+        _pool = self._pool_label
+        self._m_calls = _M_CALLS.labeled(engine=self._id, pool=_pool)
+        self._m_hits = _M_HITS.labeled(engine=self._id, pool=_pool)
+        self._m_compiles = _M_COMPILES.labeled(engine=self._id, pool=_pool)
+        self._m_padded = _M_PADDED.labeled(engine=self._id, pool=_pool)
         # phase histograms carry engine= too: in a multi-engine process
         # (lazy default engine + ParallelWrapper.serving_engine(), or a
         # multi-model service) unlabeled cells would blend every engine's
         # pad/execute/unpad distribution into one unusable p99
-        self._h_pad = _H_PAD.labeled(engine=self._id)
-        self._h_exec = _H_EXEC.labeled(engine=self._id)
-        self._h_unpad = _H_UNPAD.labeled(engine=self._id)
+        self._h_pad = _H_PAD.labeled(engine=self._id, pool=_pool)
+        self._h_exec = _H_EXEC.labeled(engine=self._id, pool=_pool)
+        self._h_unpad = _H_UNPAD.labeled(engine=self._id, pool=_pool)
         if self._placement_layer is not None:
             _G_TP_SHARDS.labeled(
-                engine=self._id, mesh=_pl.mesh_key(mesh)
+                engine=self._id, mesh=_pl.mesh_key(mesh),
+                pool=_pool,
             ).set(self._placement_layer.tp)
         # retrace tracker: why the next compile is happening (armed by
         # invalidate(cause=...), consumed by _get_compiled) + the aval
@@ -344,7 +360,8 @@ class InferenceEngine(_QuantizedParamsMixin):
         cell = self._hit_cells.get(key)
         if cell is None:
             cell = self._hit_cells[key] = _M_BUCKET_HITS.labeled(
-                engine=self._id, bucket=self._bucket_label(key))
+                engine=self._id, pool=self._pool_label,
+                bucket=self._bucket_label(key))
         return cell
 
     def _get_compiled(self, xs_avals, masks_avals, _warmup=False):
@@ -879,9 +896,11 @@ class GenerativeEngine(_QuantizedParamsMixin):
                  quantize: Optional[str] = None,
                  kv_cache: Optional[str] = None,
                  mesh=None, data_axis: str = "data",
-                 model_axis: Optional[str] = "model"):
+                 model_axis: Optional[str] = "model",
+                 pool_label: str = "default"):
         self.model = model
         self.slots = int(slots)
+        self._pool_label = str(pool_label)
         if kv_cache not in (None, "int8"):
             raise ValueError(f"unknown kv_cache mode {kv_cache!r} "
                              "(expected None or 'int8')")
@@ -904,15 +923,21 @@ class GenerativeEngine(_QuantizedParamsMixin):
         weakref.finalize(self, _tel.registry.discard_cells, engine=self._id)
         self._init_quantize(quantize)
         self._bind_quantize_cells()
-        self._g_q_kv = _G_Q_KV.labeled(engine=self._id)
-        self._m_calls = _M_CALLS.labeled(engine=self._id)
-        self._m_hits = _M_HITS.labeled(engine=self._id)
-        self._m_compiles = _M_COMPILES.labeled(engine=self._id)
-        self._h_prefill = _H_PREFILL.labeled(engine=self._id)
-        self._h_decode = _H_DECODE.labeled(engine=self._id)
+        _pool = self._pool_label
+        self._g_q_kv = _G_Q_KV.labeled(engine=self._id, pool=_pool)
+        self._m_calls = _M_CALLS.labeled(engine=self._id, pool=_pool)
+        self._m_hits = _M_HITS.labeled(engine=self._id, pool=_pool)
+        self._m_compiles = _M_COMPILES.labeled(engine=self._id, pool=_pool)
+        self._h_prefill = _H_PREFILL.labeled(engine=self._id, pool=_pool)
+        self._h_decode = _H_DECODE.labeled(engine=self._id, pool=_pool)
+        self._h_kv_export = _H_KV_EXPORT.labeled(engine=self._id,
+                                                 pool=_pool)
+        self._h_kv_import = _H_KV_IMPORT.labeled(engine=self._id,
+                                                 pool=_pool)
         if self._placement_layer is not None:
             _G_TP_SHARDS.labeled(
-                engine=self._id, mesh=_pl.mesh_key(mesh)
+                engine=self._id, mesh=_pl.mesh_key(mesh),
+                pool=_pool,
             ).set(self._placement_layer.tp)
         try:
             if not hasattr(model, "_serving_engines"):
@@ -1370,11 +1395,12 @@ class PagedGenerativeEngine(GenerativeEngine):
                  quantize: Optional[str] = None,
                  kv_cache: Optional[str] = None,
                  mesh=None, data_axis: str = "data",
-                 model_axis: Optional[str] = "model"):
+                 model_axis: Optional[str] = "model",
+                 pool_label: str = "default"):
         from .kv_pool import PagedKVPool
         super().__init__(model, slots=slots, quantize=quantize,
                          kv_cache=kv_cache, mesh=mesh, data_axis=data_axis,
-                         model_axis=model_axis)
+                         model_axis=model_axis, pool_label=pool_label)
         self.page_size = next_bucket(page_size)
         self.max_cache_len = next_bucket(max_cache_len)
         if self.max_cache_len < self.page_size:
@@ -1382,7 +1408,8 @@ class PagedGenerativeEngine(GenerativeEngine):
         self.max_pages_per_slot = self.max_cache_len // self.page_size
         self.pages = int(pages)
         self.pool = PagedKVPool(self.pages, self.page_size,
-                                engine_id=self._id)
+                                engine_id=self._id,
+                                pool_label=self._pool_label)
 
     # ---------------------------------------------------------- state blobs
     def _pool_spec(self):
@@ -1622,17 +1649,195 @@ class PagedGenerativeEngine(GenerativeEngine):
 
         return self._get_compiled(("pfork",), build, _warmup)
 
+    # -------------------------------------------- KV-page migration (ISSUE 18)
+    def _pexport_exe(self, npg: int, _warmup=False):
+        """Gather ``npg`` whole pages out of every layer pool in ONE
+        device call: pages [npg] -> payload tree of [npg*P, H, d] blocks
+        (plus the d=1 int8 scale rows when ``kv_cache="int8"``). NOT
+        donated — the exporting pool keeps serving its pages (the prefix
+        registry may still map them)."""
+        P = self.page_size
+
+        def fn(pool, pages):
+            rows = _fa.page_rows(pages, P)
+            return jax.tree.map(lambda leaf: _fa.page_export(leaf, rows),
+                                pool)
+
+        def build():
+            pool_avals = self._pool_spec()
+            jkw = {}
+            if self.mesh is not None:
+                pl = self._placement_layer
+                jkw["in_shardings"] = (pl.cache_shardings(pool_avals),
+                                       pl.replicated())
+                # payload blocks leave the mesh: replicate so the host
+                # copy below is one addressable read per leaf
+                jkw["out_shardings"] = pl.replicated()
+            return jax.jit(fn, **jkw).lower(
+                pool_avals, jax.ShapeDtypeStruct((npg,), jnp.int32))
+
+        return self._get_compiled(("pexport", npg), build, _warmup)
+
+    def _pimport_exe(self, npg: int, _warmup=False):
+        """Scatter ``npg`` whole migrated pages into every layer pool in
+        ONE device call. Rows of padding entries (page id 0) are
+        write-gated — they scatter back the value they gathered, so a
+        short chunk can never corrupt the zero page. Donates the pool."""
+        P = self.page_size
+
+        def fn(pool, pages, payload):
+            rows = _fa.page_rows(pages, P)
+            gate = jnp.repeat(pages > 0, P)
+            return jax.tree.map(
+                lambda leaf, pay: _fa.page_import(leaf, rows, pay, gate),
+                pool, payload)
+
+        def build():
+            pool_avals = self._pool_spec()
+            payload_avals = jax.tree.map(
+                lambda a: jax.ShapeDtypeStruct(
+                    (npg * P,) + tuple(a.shape[1:]), a.dtype), pool_avals)
+            jkw = {"donate_argnums": (0,)}
+            if self.mesh is not None:
+                pl = self._placement_layer
+                pool_sh = pl.cache_shardings(pool_avals)
+                jkw["in_shardings"] = (pool_sh, pl.replicated(),
+                                       pl.replicated())
+                jkw["out_shardings"] = pool_sh
+            return jax.jit(fn, **jkw).lower(
+                pool_avals, jax.ShapeDtypeStruct((npg,), jnp.int32),
+                payload_avals)
+
+        return self._get_compiled(("pimport", npg), build, _warmup)
+
+    def _migrate_chunks(self, kind: str, n: int):
+        """Chunk an ``n``-page migration over the warmed page-count
+        buckets for executable family ``kind``: yields ``(bucket, take)``
+        pairs — one device call each, never a call per page. Falls back
+        to one ``next_bucket(n)`` compile (counted ``new_bucket``) when
+        nothing is warmed."""
+        with self._lock:
+            warmed = sorted(k[1] for k in self._compiled if k[0] == kind)
+        i = 0
+        while i < n:
+            rem = n - i
+            if warmed:
+                fits = [b for b in warmed if b >= rem]
+                bucket = fits[0] if fits else warmed[-1]
+            else:
+                bucket = next_bucket(rem)
+            take = min(bucket, rem)
+            yield bucket, take
+            i += take
+
+    def export_pages(self, state: PagedDecodeState, pages: Sequence[int]):
+        """Materialize whole pages as HOST numpy payload blocks (ISSUE 18
+        migration, sender side): the tree mirrors ``paged_cache_spec``
+        but each leaf is ``[len(pages)*page_size, H, d]`` rows in page
+        order. One device gather per warmed chunk; one host copy per
+        leaf."""
+        pages = [int(p) for p in pages]
+        if not pages:
+            raise ValueError("export_pages needs at least one page")
+        if any(p <= 0 or p >= self.pages for p in pages):
+            raise ValueError(f"page ids out of range: {pages}")
+        P = self.page_size
+        tel = _tel.enabled()
+        t0 = time.perf_counter() if tel else 0.0
+        chunks = []
+        i = 0
+        for bucket, take in self._migrate_chunks("pexport", len(pages)):
+            ids = np.zeros((bucket,), np.int32)
+            ids[:take] = pages[i:i + take]
+            exe = self._pexport_exe(bucket)
+            self._m_calls.inc()
+            payload = exe(state.caches, self._put_arg(ids))
+            chunks.append(jax.tree.map(
+                lambda a: np.asarray(a)[:take * P].copy(), payload))
+            i += take
+        if len(chunks) == 1:
+            out = chunks[0]
+        else:
+            out = jax.tree.map(
+                lambda *xs: np.concatenate(xs, axis=0), *chunks)
+        if tel:
+            self._h_kv_export.observe(time.perf_counter() - t0)
+        return out
+
+    def import_pages(self, state: PagedDecodeState, pages: Sequence[int],
+                     payload) -> PagedDecodeState:
+        """Install migrated payload blocks into freshly allocated page
+        ids (ISSUE 18 migration, receiver side). ``payload`` must
+        structurally match this engine's ``paged_cache_spec`` leaves
+        (same layer tree, same [.., H, d] trailing dims, same dtypes) —
+        mismatches raise before any device work."""
+        pages = [int(p) for p in pages]
+        if not pages:
+            raise ValueError("import_pages needs at least one page")
+        P = self.page_size
+        spec = self._pool_spec()
+        spec_leaves, spec_def = jax.tree.flatten(spec)
+        pay_leaves, pay_def = jax.tree.flatten(payload)
+        if pay_def != spec_def:
+            raise ValueError(
+                f"migrated payload tree does not match this engine's "
+                f"paged cache layout: {pay_def} vs {spec_def}")
+        want_rows = len(pages) * P
+        for sl, pl_ in zip(spec_leaves, pay_leaves):
+            pl_ = np.asarray(pl_)
+            if tuple(pl_.shape) != (want_rows,) + tuple(sl.shape[1:]):
+                raise ValueError(
+                    f"migrated payload block {pl_.shape} does not match "
+                    f"{(want_rows,) + tuple(sl.shape[1:])} (page_size/"
+                    f"head-count/d mismatch between pools)")
+            if np.dtype(pl_.dtype) != np.dtype(sl.dtype):
+                raise ValueError(
+                    f"migrated payload dtype {pl_.dtype} != pool dtype "
+                    f"{sl.dtype} (kv_cache modes disagree across pools)")
+        tel = _tel.enabled()
+        t0 = time.perf_counter() if tel else 0.0
+        caches = state.caches
+        i = 0
+        for bucket, take in self._migrate_chunks("pimport", len(pages)):
+            ids = np.zeros((bucket,), np.int32)
+            ids[:take] = pages[i:i + take]
+
+            def slice_pad(a):
+                a = np.asarray(a)[i * P:(i + take) * P]
+                if bucket > take:
+                    pad = np.zeros(((bucket - take) * P,) + a.shape[1:],
+                                   a.dtype)
+                    a = np.concatenate([a, pad], axis=0)
+                return a
+
+            exe = self._pimport_exe(bucket)
+            self._m_calls.inc()
+            caches = exe(caches, self._put_arg(ids),
+                         jax.tree.map(lambda a: self._put_arg(slice_pad(a)),
+                                      payload))
+            i += take
+        if tel:
+            self._h_kv_import.observe(time.perf_counter() - t0)
+        return PagedDecodeState(caches, state.lengths, state.page_table,
+                                state.mp, state.page_size)
+
     def warmup(self, cache_buckets: Sequence[int],
                prompt_buckets: Sequence[int],
                speculate: Sequence[int] = (),
-               checkpoint: Optional[str] = None) -> "PagedGenerativeEngine":
+               checkpoint: Optional[str] = None,
+               migrate_buckets: Sequence[int] = ()) -> "PagedGenerativeEngine":
         """Compile every (table-width bucket) decode executable — plus a
         Tq=k verify per ``speculate`` window — every prompt-bucket
         prefill, and the page-fork copy, outside traffic.
 
         ``checkpoint``: pod AOT warmup (ISSUE 17) — restore params from
         a ``TrainingCheckpointer`` directory first, so every host loads
-        only its addressable shards before bucket compilation."""
+        only its addressable shards before bucket compilation.
+
+        ``migrate_buckets`` (ISSUE 18): page-count buckets for the
+        KV-page export/import executables — disaggregated replicas pass
+        the page counts their prompt buckets imply so migrations stay at
+        zero post-warmup compiles; colocated engines skip the cost."""
         if checkpoint is not None:
             _pl.load_checkpoint(self.model, checkpoint)
         mps = sorted({self._mp_bucket(c) for c in cache_buckets})
@@ -1645,6 +1850,10 @@ class PagedGenerativeEngine(GenerativeEngine):
         for tp in tps:
             self._pprefill_exe(tp, _warmup=True)
         self._pfork_exe(_warmup=True)
+        for npg in sorted({next_bucket(max(1, int(n)))
+                           for n in migrate_buckets}):
+            self._pexport_exe(npg, _warmup=True)
+            self._pimport_exe(npg, _warmup=True)
         return self
 
     # -------------------------------------------------------------- dispatch

@@ -36,6 +36,7 @@ be read from any thread — all state mutates under one lock.
 
 from __future__ import annotations
 
+import hashlib
 import threading
 from collections import OrderedDict
 from typing import Dict, List, Optional, Sequence
@@ -61,6 +62,24 @@ _M_EVICTIONS = _tel.counter(
 _M_FORKS = _tel.counter(
     "serving.page_pool.forks",
     "copy-on-write page forks (first write to a shared page)")
+_M_ADOPTIONS = _tel.counter(
+    "serving.page_pool.adoptions",
+    "pages adopted from a migrating prefill pool (ISSUE 18 handoff)")
+
+
+def prompt_key(x, plen: int) -> str:
+    """Content key of one FULL prompt (the prefix-registry admission
+    key): length + f32 feature bytes through blake2b. Full-prompt only —
+    the stack's prefix-LM prompts attend bidirectionally over
+    themselves, so per-chunk sharing would blend suffix-dependent k/v
+    (see the engine/PARITY notes). Shared by the batcher's paged
+    admission and the ISSUE 18 disaggregated router, which must agree on
+    the key to route repeat prompts to their migrated pages."""
+    h = hashlib.blake2b(digest_size=16)
+    h.update(np.int64(int(plen)).tobytes())
+    h.update(np.ascontiguousarray(np.asarray(x)[:int(plen)],
+                                  dtype=np.float32).tobytes())
+    return h.hexdigest()
 
 
 class PoolExhausted(RuntimeError):
@@ -88,23 +107,29 @@ class PagedKVPool:
     """
 
     def __init__(self, n_pages: int, page_size: int,
-                 engine_id: str = "0"):
+                 engine_id: str = "0", pool_label: str = "default"):
         if n_pages < 2:
             raise ValueError("paged pool needs >= 2 pages (page 0 is the "
                              "reserved zero page)")
         self.n_pages = int(n_pages)
         self.page_size = int(page_size)
+        self.pool_label = str(pool_label)
         self._lock = threading.RLock()
         self._free: List[int] = list(range(self.n_pages - 1, 0, -1))
         self._ref = np.zeros(self.n_pages, np.int64)
         self._prefix: "OrderedDict[str, _PrefixEntry]" = OrderedDict()
         self.pages_peak = 0
-        self._g_total = _G_TOTAL.labeled(engine=engine_id)
-        self._g_free = _G_FREE.labeled(engine=engine_id)
-        self._m_hits = _M_PREFIX_HITS.labeled(engine=engine_id)
-        self._m_misses = _M_PREFIX_MISSES.labeled(engine=engine_id)
-        self._m_evict = _M_EVICTIONS.labeled(engine=engine_id)
-        self._m_forks = _M_FORKS.labeled(engine=engine_id)
+        # pool= beside engine= (ISSUE 18): a disaggregated process pair
+        # scrapes both roles into one dashboard — unlabeled cells would
+        # blend the prefill pool's churn with decode-pool residency
+        eid, pool = engine_id, self.pool_label
+        self._g_total = _G_TOTAL.labeled(engine=eid, pool=pool)
+        self._g_free = _G_FREE.labeled(engine=eid, pool=pool)
+        self._m_hits = _M_PREFIX_HITS.labeled(engine=eid, pool=pool)
+        self._m_misses = _M_PREFIX_MISSES.labeled(engine=eid, pool=pool)
+        self._m_evict = _M_EVICTIONS.labeled(engine=eid, pool=pool)
+        self._m_forks = _M_FORKS.labeled(engine=eid, pool=pool)
+        self._m_adopt = _M_ADOPTIONS.labeled(engine=eid, pool=pool)
         self._g_total.set(self.n_pages - 1)
         self._g_free.set(len(self._free))
 
@@ -182,6 +207,16 @@ class PagedKVPool:
         if n:
             self._m_forks.inc(n)
 
+    def adopt(self, n: int = 1) -> List[int]:
+        """Fresh table slots for MIGRATED pages (ISSUE 18): allocation-
+        wise identical to :meth:`alloc` (refcount 1 per page — the
+        adopting stream's reference; the caller re-registers a migrated
+        prefix for the registry's own ref), counted separately so pool
+        telemetry splits locally prefilled pages from adopted ones."""
+        out = self.alloc(n)
+        self._m_adopt.inc(len(out))
+        return out
+
     # ------------------------------------------------------ prefix registry
     def lookup_prefix(self, key: str) -> Optional[_PrefixEntry]:
         """Map a registered prompt: bumps every page's refcount for the
@@ -197,6 +232,15 @@ class PagedKVPool:
                 self._ref[p] += 1
             self._m_hits.inc()
             return e
+
+    def peek_prefix(self, key: str) -> bool:
+        """Non-mutating registry probe (ISSUE 18 router): True when the
+        key is registered HERE. Bumps no refcount/LRU and counts no
+        hit/miss — the router probes every decode replica per candidate
+        prompt, and a counted miss per probe would poison the hit-rate
+        signal the pool exports."""
+        with self._lock:
+            return key in self._prefix
 
     def register_prefix(self, key: str, pages: Sequence[int], plen: int,
                         logits) -> None:
@@ -252,4 +296,5 @@ class PagedKVPool:
                 "prefix_misses": int(self._m_misses.value()),
                 "evictions": int(self._m_evict.value()),
                 "forks": int(self._m_forks.value()),
+                "adoptions": int(self._m_adopt.value()),
             }

@@ -77,13 +77,20 @@ class JsonModelServer:
                 elif self.path == "/healthz":
                     pi = server.inference
                     h = pi.health()
+                    body = {"status": h,
+                            "queue_depth": pi.queue_depth(),
+                            "shed": pi.shed,
+                            "deadline_expired": pi.deadline_expired,
+                            "retries": pi.retries,
+                            "failures": pi.failures}
+                    if server.generator is not None:
+                        # disaggregated topologies (ISSUE 18): the pool
+                        # role rides readiness so a router/load balancer
+                        # can tell a prefill replica from a decode pool
+                        # without a second round-trip to /stats
+                        body["pool"] = server.generator._pool_label
                     self._send(503 if h == HealthState.SHEDDING else 200,
-                               {"status": h,
-                                "queue_depth": pi.queue_depth(),
-                                "shed": pi.shed,
-                                "deadline_expired": pi.deadline_expired,
-                                "retries": pi.retries,
-                                "failures": pi.failures})
+                               body)
                 elif self.path == "/stats":
                     # serving observability: request latency percentiles,
                     # queue depth, bucket hits / compiles; with a

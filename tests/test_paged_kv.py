@@ -433,7 +433,8 @@ def test_speculative_equals_greedy_perfect_and_garbage_draft():
     assert int(tel.registry.get("compile.events").total()) == ev0
     assert cb1.engine._h_decode.values_list()
     assert tel.registry.get(
-        "serving.speculative.accept_rate").values_list(pi=cb1._id)
+        "serving.speculative.accept_rate").values_list(pi=cb1._id,
+                                                       pool="default")
     cb1.shutdown()
 
     draft = _lm(seed=99)
@@ -531,10 +532,10 @@ def test_stats_endpoint_and_listener_expose_paged_fields():
         assert gen["speculative"]["accept_rate"] is not None
         assert gen["engine"]["paged"]["page_size"] == 8
         # per-engine registry labels (anti-blending): the pool gauges
-        # carry this engine's id
+        # carry this engine's id + pool role
         eid = srv.generator.engine._id
         assert int(tel.registry.get("serving.page_pool.pages_total")
-                   .value(engine=eid)) > 0
+                   .value(engine=eid, pool="default")) > 0
         rec = ServingStatsListener(srv.generator).report()
         assert rec["page_pool"]["pages_total"] > 0
         assert rec["speculative"]["proposed"] > 0

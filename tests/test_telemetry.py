@@ -189,11 +189,12 @@ def test_registry_discard_cells_bounds_instance_churn():
     eng = InferenceEngine(net)
     eng.output(_data(n=2).features)
     eid = eng._id
-    assert telemetry.counter("serving.engine.calls").value(engine=eid) == 1
+    assert telemetry.counter("serving.engine.calls") \
+        .value(engine=eid, pool="default") == 1
     del eng
     gc.collect()
     assert telemetry.counter("serving.engine.calls") \
-        .value(default=None, engine=eid) is None  # cells gone
+        .value(default=None, engine=eid, pool="default") is None  # gone
 
 
 # ------------------------------------------------------------------ spans
@@ -412,18 +413,20 @@ def test_serving_phases_and_dispatch_span_recorded():
             f.result(timeout=10)
     finally:
         pi.shutdown()
-    # engine-side phases are labeled engine=<id> and the dispatch span
-    # pi=<id>,mode= (multi-front processes must not blend distributions)
+    # engine-side phases are labeled engine=<id>,pool=<role> and the
+    # dispatch span pi=<id>,pool=,mode= (multi-front processes and
+    # disaggregated pools must not blend distributions)
     eid = pi.engine._id
     for name in ("serving.phase.pad_s", "serving.phase.execute_s",
                  "serving.phase.unpad_s"):
         assert telemetry.histogram(name) \
-            .hist_snapshot(engine=eid)["count"] >= 1, name
+            .hist_snapshot(engine=eid, pool="default")["count"] >= 1, name
     assert telemetry.histogram("serving.dispatch") \
-        .hist_snapshot(pi=pi._id, mode="batched")["count"] >= 1
+        .hist_snapshot(pi=pi._id, pool="default",
+                       mode="batched")["count"] >= 1
     # queue/coalesce phases are per-instance labeled
     q = telemetry.histogram("serving.phase.queue_s") \
-        .hist_snapshot(pi=pi._id)
+        .hist_snapshot(pi=pi._id, pool="default")
     assert q["count"] >= 4
 
 
@@ -486,7 +489,7 @@ def test_preexisting_surfaces_are_registry_views():
     assert eng.calls == 1
     assert eng.stats()["padded_rows"] == 1  # 3 -> 4 bucket
     assert telemetry.counter("serving.engine.calls") \
-        .value(engine=eng._id) == 1
+        .value(engine=eng._id, pool="default") == 1
 
     # sentinel counters mirror into gauges at the sync point, labeled
     # model=<id> so concurrent models can't overwrite each other's cell

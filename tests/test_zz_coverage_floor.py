@@ -123,7 +123,11 @@ def test_telemetry_metric_floor(request):
               # fused-epilogue kernel library (ISSUE 16): the guaranteed
               # writer of fused_epilogues.dispatch{decision=} and
               # fused_epilogues.autotune{event=}
-              "test_fused_epilogues.py"}
+              "test_fused_epilogues.py",
+              # disaggregated serving (ISSUE 18): the only writer of the
+              # serving.disagg.* router counters, serving.phase.route_s,
+              # and the kv_export_s/kv_import_s migration histograms
+              "test_disagg.py"}
     missing = needed - collected
     if missing:
         pytest.skip(f"chunked run (telemetry-ledger-marking files not "
