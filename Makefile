@@ -5,7 +5,7 @@ PY ?= python
 
 .PHONY: test test-fast lint multihost-sim multihost-smoke bench \
 	bench-generative bench-kernels bench-pod-serving bench-disagg \
-	disagg-sim trace-demo tune
+	bench-decode disagg-sim trace-demo tune
 
 # ISSUE 15: JAX-aware static analysis (runtime/staticcheck.py) — the
 # repo's hand-enforced invariants as machine-checked rules. Exits
@@ -61,6 +61,16 @@ bench-pod-serving:
 		XLA_FLAGS=--xla_force_host_platform_device_count=4 \
 		$(PY) -c "import json, bench; \
 print(json.dumps(bench.bench_pod_serving(), indent=1))"
+
+# ISSUE 19: the host-free decode metric standalone — adaptive
+# multi-token horizons + double-buffering vs the horizon-1 interleaved
+# loop (interleaved pairs, median of tokens/sec ratios), with greedy
+# bit-parity, zero post-warmup compiles in both windows, the horizon
+# histogram / dispatch-decision mix, and per-arm attribution reports
+# showing the host fraction shrink — all hard-asserted in-bench.
+bench-decode:
+	env JAX_PLATFORMS=cpu $(PY) -c "import json, bench; \
+print(json.dumps(bench.bench_decode_loop(), indent=1))"
 
 # ISSUE 18: the disaggregated-serving metric standalone — colocated vs
 # prefill/decode-split mixed-load A/B (interleaved rounds, median of

@@ -1878,6 +1878,162 @@ def bench_generative_serving():
     }
 
 
+def bench_decode_loop(rounds=3):
+    """ISSUE 19 metric (CPU-capable): the host-free decode runtime —
+    adaptive multi-token horizons + double-buffering (``max_horizon=8``)
+    vs the horizon-1 interleaved loop (one on-device k=1 dispatch and
+    one host readback per token — the pre-ISSUE-19 steady state). Both
+    arms sample greedily ON DEVICE; the A/B isolates exactly what the
+    horizon runtime eliminates: per-token host dispatch/readback and the
+    host<->device ping-pong between decode iterations.
+
+    Hard-asserted in-bench: bit-identical greedy streams, adaptive
+    tokens/sec ratio > 1.0 (interleaved pairs, median of ratios), and
+    ZERO post-warmup compile events in both timed windows. The artifact
+    embeds per-arm ``attribution_report``s (host fraction of a decode
+    step, fed with the measured decode_host_s split) so the host share
+    visibly shrinks, plus the horizon histogram and the
+    dispatch-decision mix (every decision counted, nothing silent)."""
+    from deeplearning4j_tpu.nn.config import (InputType,
+                                              NeuralNetConfiguration)
+    from deeplearning4j_tpu.nn.layers.attention import SelfAttentionLayer
+    from deeplearning4j_tpu.nn.layers.core import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.model import MultiLayerNetwork
+    from deeplearning4j_tpu.runtime import telemetry as _tel
+    from deeplearning4j_tpu.serving import ContinuousBatcher
+
+    V, B, gen_tokens, max_cache = 32, 4, 32, 64
+    conf = (NeuralNetConfiguration.builder().seed(0)
+            .input_type(InputType.recurrent(V, 8))
+            .list(SelfAttentionLayer(n_out=V, n_heads=2),
+                  DenseLayer(n_out=48, activation="relu"),
+                  SelfAttentionLayer(n_out=48, n_heads=2),
+                  OutputLayer(n_out=V, activation="softmax"))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    rng = np.random.default_rng(0)
+    prompts = [list(rng.integers(0, V, int(rng.integers(4, 9))))
+               for _ in range(B)]
+    tokens_per_run = B * gen_tokens
+
+    def make(max_horizon):
+        ev0 = int(_tel.registry.get("compile.events").total())
+        cb = ContinuousBatcher(net, slots=B, max_cache_len=max_cache,
+                               min_cache_len=max_cache,
+                               max_new_tokens=gen_tokens,
+                               max_horizon=max_horizon)
+        warm_ev = int(_tel.registry.get("compile.events").total()) - ev0
+        return cb, cb.engine.compiles, \
+            int(_tel.registry.get("compile.events").total()), warm_ev
+
+    def run(cb):
+        t0 = time.perf_counter()
+        handles = [cb.submit(tokens=p) for p in prompts]
+        streams = [h.result(timeout=600)["tokens"] for h in handles]
+        return time.perf_counter() - t0, streams
+
+    cb1, warm1, ev1, warm_ev1 = make(1)
+    cb8, warm8, ev8, warm_ev8 = make(8)
+    pairs, streams1 = [], None
+    for _ in range(rounds):
+        w1, s1 = run(cb1)
+        w8, s8 = run(cb8)
+        # acceptance: the horizon loop + on-device EOS freeze is
+        # bit-exact vs the per-token oracle, every round
+        assert s8 == s1, "adaptive-horizon stream diverged from the " \
+                         "horizon-1 oracle"
+        streams1 = s1
+        pairs.append((w1, w8))
+    ratios = sorted(w1 / w8 for w1, w8 in pairs)
+    ratio = ratios[len(ratios) // 2]
+    assert ratio > 1.0, (
+        f"adaptive horizons must beat the horizon-1 loop (got {ratio})")
+    # acceptance: both timed windows paid ZERO compiles
+    assert cb1.engine.compiles == warm1 and cb8.engine.compiles == warm8
+    ev_now = int(_tel.registry.get("compile.events").total())
+    assert ev_now == ev8, "post-warmup compile events in a timed window"
+
+    def arm(cb):
+        pi = dict(pi=cb._id, pool="default")
+        dev = _tel.registry.get(
+            "serving.phase.decode_device_s").values_list(**pi)
+        host = _tel.registry.get(
+            "serving.phase.decode_host_s").values_list(**pi)
+        tpot = _tel.registry.get("serving.tpot_s").values_list(**pi)
+        p50, p99 = _percentiles(tpot)
+        dev_med = sorted(dev)[len(dev) // 2] if dev else None
+        host_med = sorted(host)[len(host) // 2] if host else 0.0
+        st = cb.stats()
+        return {
+            "tpot_p50_ms": None if p50 is None else round(p50 * 1e3, 3),
+            "tpot_p99_ms": None if p99 is None else round(p99 * 1e3, 3),
+            "dispatch_decisions": st["dispatch_decisions"],
+            "tokens_per_s_window": round(st["tokens_per_s"], 1),
+            "host_s_per_dispatch_p50": None if host_med is None
+            else round(host_med, 6),
+            "device_s_per_dispatch_p50": None if dev_med is None
+            else round(dev_med, 6),
+        }, dev_med, host_med
+
+    a1, dev1, host1 = arm(cb1)
+    a8, dev8, host8 = arm(cb8)
+    hz = _tel.registry.get("serving.decode.horizon").hist_snapshot(
+        pi=cb8._id, pool="default")
+    w1_best = min(w for w, _ in pairs)
+    w8_best = min(w for _, w in pairs)
+    # MFU attribution of the actual programs each arm runs, fed with the
+    # measured split — the headline "host fraction shrinks" evidence
+    # lives in the artifact, not a narrative. Per-token accounting: the
+    # device work per token is the same program either way (one decode
+    # step, scanned or not), so the k=1 fetch wait — dispatch is
+    # immediately followed by the blocking readback, no overlap to hide
+    # it — measures device busy per token; EVERYTHING else in the wall
+    # (python loop, dispatch prep, per-token readback sync, emission) is
+    # the host share the horizon runtime amortizes over k tokens
+    m1 = w1_best / tokens_per_run            # wall per token, horizon 1
+    m8 = w8_best / tokens_per_run            # wall per token, adaptive
+    d = dev1 or 0.0                          # device busy per token
+    attr1 = cb1.engine.attribution_report(
+        max_cache, measured_s=m1, horizon=1, host_s=max(0.0, m1 - d))
+    # XLA's cost_analysis counts the compiled loop body ONCE, so the
+    # horizon executable's roofline is already per-token — keep the
+    # measured side per-token too
+    attr8 = cb8.engine.attribution_report(
+        max_cache, measured_s=m8, horizon=8, host_s=max(0.0, m8 - d))
+    assert attr8["fractions"]["host"] < attr1["fractions"]["host"], (
+        "horizon runtime must shrink the host fraction per token")
+    cb1.shutdown()
+    cb8.shutdown()
+    return {
+        "metric": "decode_loop",
+        "value": round(ratio, 2),
+        "unit": "x_tokens_per_sec_adaptive_horizon_vs_horizon1",
+        "pair_ratios": [round(r, 2) for r in ratios],
+        "model": f"2x self-attention({V}) + MLP, vocab {V}, "
+                 f"slots {B}, {gen_tokens} tokens/request, "
+                 f"cache bucket {max_cache}",
+        "tokens_per_run": tokens_per_run,
+        "horizon1_tokens_per_sec": round(tokens_per_run / w1_best, 1),
+        "adaptive_tokens_per_sec": round(tokens_per_run / w8_best, 1),
+        "greedy_bit_parity": True,
+        "streams_sample": streams1[0][:8],
+        "horizon_histogram": hz,
+        "warmup_compile_events": {"horizon1": warm_ev1,
+                                  "adaptive": warm_ev8},
+        "post_warmup_compile_events": 0,
+        "horizon1": a1,
+        "adaptive": a8,
+        # host fraction of one decode dispatch, measured split: the
+        # horizon program amortizes ONE host readback over k tokens
+        "attribution_horizon1": {
+            k: attr1[k] for k in ("fractions", "host_s", "measured_s",
+                                  "horizon") if k in attr1},
+        "attribution_adaptive": {
+            k: attr8[k] for k in ("fractions", "host_s", "measured_s",
+                                  "horizon") if k in attr8},
+    }
+
+
 def bench_quantized_serving():
     """ISSUE 9 metric (CPU-capable): int8 post-training quantized serving
     vs the bf16 engine at MATCHED buckets. Three measured claims, none
@@ -2747,6 +2903,14 @@ if __name__ == "__main__":
         lines.append({
             "metric": "generative_serving", "value": None,
             "unit": "x_tokens_per_sec_kv_cache_vs_full_recompute",
+            "error": f"{type(e).__name__}: {e}"[:300]})
+    _emit(lines)
+    try:
+        lines.append(bench_decode_loop())
+    except Exception as e:
+        lines.append({
+            "metric": "decode_loop", "value": None,
+            "unit": "x_tokens_per_sec_adaptive_horizon_vs_horizon1",
             "error": f"{type(e).__name__}: {e}"[:300]})
     _emit(lines)
     try:

@@ -942,6 +942,21 @@ class MultiLayerNetwork(_caches.CompiledCacheMixin):
                             self.paged_cache_spec(n_pages, page_size,
                                                   kv_quant=kv_quant))
 
+    def decode_token_features(self, tokens, dtype=None):
+        """On-device twin of the serving host featurizer: int32 token ids
+        [B] -> next-step decode input [B, 1, F]. Must stay bit-identical
+        to ``ContinuousBatcher._one_hot`` (``f[token % F] = 1.0``) so the
+        fused multi-token decode loop matches the host oracle exactly."""
+        shape = self.conf.input_shape
+        if not (isinstance(shape, (tuple, list)) and len(shape) == 2):
+            raise ValueError(
+                "decode_token_features needs a recurrent [T, F] input "
+                f"type; model input_shape is {shape!r}")
+        f = int(shape[1])
+        dt = _dt.resolve(self.conf.dtype) if dtype is None else dtype
+        toks = jnp.asarray(tokens, jnp.int32) % f
+        return jax.nn.one_hot(toks, f, dtype=dt)[:, None, :]
+
     def _decode_cast(self, params, x):
         dt = _dt.resolve(self.conf.dtype)
         if jnp.issubdtype(dt, jnp.floating) and \

@@ -93,3 +93,4 @@ from . import reduce  # noqa: E402,F401
 from . import flash_attention  # noqa: E402,F401  (attention.fused_sdpa)
 from . import fused_epilogues  # noqa: E402,F401  (epilogue.* fused kernels)
 from . import quantize  # noqa: E402,F401  (quantize.int8_mmul)
+from . import sampling  # noqa: E402,F401  (sampling.* decode-loop primitives)

@@ -1,0 +1,117 @@
+"""On-device sampling primitives for the generative decode loop.
+
+The host decode loop samples with ``int(np.argmax(logits))`` — one
+device->host readback per generated token.  These primitives run the
+same reductions *inside* the compiled step so a multi-token horizon
+(`lax.scan` over k decode iterations) never touches the host:
+
+* :func:`greedy` — argmax over the vocab axis (bit-exact with the host
+  oracle, and lowers to a single ``argmax`` primitive the staticcheck
+  decode probe counts).
+* :func:`categorical` — temperature softmax sampling via the Gumbel
+  trick with a threaded PRNG key.
+* :func:`top_k` — top-k filtered temperature sampling.
+* :func:`eos_hit` — on-device EOS detection feeding the existing
+  write-gating masks so finished slots freeze bit-exactly.
+
+A :class:`SamplingSpec` pins the *static* part of the configuration
+(method, k) into the engine compile key while temperature stays a
+runtime scalar — changing temperature never recompiles.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from . import register
+
+__all__ = [
+    "greedy", "categorical", "top_k", "eos_hit",
+    "SamplingSpec", "GREEDY",
+]
+
+_TEMP_FLOOR = 1e-6
+
+
+@register("sampling.greedy", "sampling", differentiable=False)
+def greedy(logits):
+    """Greedy token selection: argmax over the last axis -> int32."""
+    return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+
+@register("sampling.categorical", "sampling", differentiable=False)
+def categorical(logits, key, temperature=1.0):
+    """Temperature softmax sampling via the Gumbel-max trick.
+
+    ``argmax(logits/T + Gumbel)`` draws exactly from
+    ``softmax(logits/T)`` without normalising on device.
+    """
+    t = jnp.maximum(jnp.asarray(temperature, logits.dtype), _TEMP_FLOOR)
+    g = jax.random.gumbel(key, logits.shape, logits.dtype)
+    return jnp.argmax(logits / t + g, axis=-1).astype(jnp.int32)
+
+
+@register("sampling.top_k", "sampling", differentiable=False)
+def top_k(logits, key, k, temperature=1.0):
+    """Top-k filtered temperature sampling (k is static)."""
+    k = int(k)
+    if k <= 0:
+        raise ValueError("top_k requires k >= 1")
+    kth = jax.lax.top_k(logits, k)[0][..., -1:]
+    neg = jnp.asarray(jnp.finfo(logits.dtype).min, logits.dtype)
+    masked = jnp.where(logits < kth, neg, logits)
+    return categorical(masked, key, temperature)
+
+
+def eos_hit(tokens, eos_ids):
+    """Per-slot EOS detection mask.
+
+    ``eos_ids`` holds one int32 id per slot with ``-1`` meaning "no EOS
+    for this slot"; returns int32 1 where the freshly sampled token
+    terminates the stream.  Feed the complement into the write gate so
+    finished slots freeze bit-exactly.
+    """
+    return ((eos_ids >= 0) & (tokens == eos_ids)).astype(jnp.int32)
+
+
+@dataclasses.dataclass(frozen=True)
+class SamplingSpec:
+    """Static sampling configuration threaded into engine compile keys.
+
+    ``method`` is one of ``greedy`` / ``categorical`` / ``top_k``; only
+    ``method`` and ``k`` participate in the compile key — temperature is
+    a runtime scalar argument of the compiled step.
+    """
+
+    method: str = "greedy"
+    temperature: float = 1.0
+    k: int = 0
+
+    def __post_init__(self):
+        if self.method not in ("greedy", "categorical", "top_k"):
+            raise ValueError(f"unknown sampling method {self.method!r}")
+        if self.method == "top_k" and self.k <= 0:
+            raise ValueError("top_k sampling requires k >= 1")
+
+    @property
+    def stochastic(self):
+        return self.method != "greedy"
+
+    def static_key(self):
+        return (self.method, int(self.k))
+
+    def build(self):
+        """Return ``fn(logits, key, temperature) -> int32 tokens``."""
+        if self.method == "greedy":
+            return lambda logits, key, temperature: greedy(logits)
+        if self.method == "categorical":
+            return categorical
+        k = int(self.k)
+        return lambda logits, key, temperature: top_k(
+            logits, key, k, temperature)
+
+
+GREEDY = SamplingSpec()

@@ -706,12 +706,21 @@ HOT_PATHS = (
     ("serving/batcher.py", "_dispatcher"),
     ("serving/batcher.py", "_run"),
     ("serving/batcher.py", "_run_engine"),
+    # ISSUE 19: the continuous-batching decode loop — a host sync on a
+    # decode dispatch's outputs here re-serializes the double-buffered
+    # horizon pipeline (HorizonResult.fetch() is the ONE sanctioned
+    # readback and is deliberately not a step callable)
+    ("serving/batcher.py", "_decode_iter"),
+    ("serving/batcher.py", "_emit_token"),
+    ("serving/batcher.py", "_dispatch_horizon"),
+    ("serving/batcher.py", "_consume_horizon"),
 )
 
 #: callables whose results are compiled-step outputs (device arrays the
 #: hot loop must not sync on)
 STEP_CALLABLES = ("_train_step", "step_fn", "_epoch_fn", "_run_engine",
-                  "_call_engine")
+                  "_call_engine", "decode", "decode_multi",
+                  "pdecode_multi")
 
 _SYNC_CALLS = ("float", "int")
 _SYNC_NP = ("asarray", "array")
@@ -1073,6 +1082,14 @@ def check_source(source: str, rel: str = "<fixture>",
 JAXPR_RULES = ("no-param-cast-in-scan", "no-host-callback",
                "no-f32-leak-under-bf16-policy", "donation-applied")
 
+# Opt-in rule (ISSUE 19): only checked when the caller declares the
+# program IS a multi-token decode horizon (``expect_decode_loop=True``
+# / the CLI decode probe). A horizon that silently degrades — a host
+# callback smuggled into the scan body, or the scan not lowering at
+# all — is numerically right but pays the per-token host round-trip
+# the horizon exists to eliminate.
+DECODE_RULES = ("no-host-callback-in-decode",)
+
 # Opt-in rules (ISSUE 16): only checked when the caller declares the
 # program SHOULD be fused (``expect_fusion=True`` / the CLI fusion
 # probe). A dispatcher that silently falls back leaves the program
@@ -1103,6 +1120,7 @@ def jaxpr_audit(fn, args=(), rules: Optional[Sequence[str]] = None, *,
                 policy: Optional[str] = None,
                 expect_donation: bool = False,
                 expect_fusion: bool = False,
+                expect_decode_loop: bool = False,
                 lowered_text: Optional[str] = None,
                 label: str = "<fn>") -> List[Finding]:
     """Audit a compiled program's jaxpr against the Tier B rules — the
@@ -1131,11 +1149,19 @@ def jaxpr_audit(fn, args=(), rules: Optional[Sequence[str]] = None, *,
       INPUT with a ndim>=2 ``param_shapes`` shape — that is the
       standalone master cast-sweep at the head of the step; the fused
       updater casts only the freshly-updated masters (intermediates).
+    - ``no-host-callback-in-decode`` (``expect_decode_loop=True``
+      only, ISSUE 19): the multi-token decode horizon contains zero
+      host-callback primitives, lowers an actual ``scan``/``while``
+      loop, and performs exactly ONE logits->token ``argmax`` reduction
+      per scan iteration — a silently-degraded horizon fails the lint
+      build instead of quietly paying per-token host round-trips.
     """
     import jax
     rules = tuple(rules or JAXPR_RULES)
     if expect_fusion:
         rules = rules + tuple(r for r in FUSION_RULES if r not in rules)
+    if expect_decode_loop:
+        rules = rules + tuple(r for r in DECODE_RULES if r not in rules)
     findings: List[Finding] = []
     target = getattr(fn, "__wrapped__", fn)
     closed = jax.make_jaxpr(target)(*args)
@@ -1151,11 +1177,24 @@ def jaxpr_audit(fn, args=(), rules: Optional[Sequence[str]] = None, *,
 
     top_invars = set(id(v) for v in closed.jaxpr.invars)
     pallas_calls = [0]
+    loops = [0]
+    argmax_in_loop = [0]
 
     def visit(eqn, inside_loop):
         name = eqn.primitive.name
         if "pallas_call" in name:
             pallas_calls[0] += 1
+        if name in _LOOP_PRIMS:
+            loops[0] += 1
+        if name == "argmax" and inside_loop:
+            argmax_in_loop[0] += 1
+        if "no-host-callback-in-decode" in rules and any(
+                c in name for c in _CALLBACK_PRIMS):
+            findings.append(Finding(
+                "no-host-callback-in-decode", label, 0,
+                f"host callback primitive {name!r} inside the compiled "
+                "decode horizon — the k-token loop round-trips to the "
+                "host it exists to bypass"))
         if "fusion-applied-updater" in rules and \
                 name == "convert_element_type" and pshapes:
             iv, ov = eqn.invars[0], eqn.outvars[0]
@@ -1199,6 +1238,21 @@ def jaxpr_audit(fn, args=(), rules: Optional[Sequence[str]] = None, *,
                     "MXU runs at half rate"))
 
     _walk_jaxpr(closed.jaxpr, visit)
+    if "no-host-callback-in-decode" in rules:
+        if loops[0] == 0:
+            findings.append(Finding(
+                "no-host-callback-in-decode", label, 0,
+                "no scan/while loop in the multi-token decode program — "
+                "the horizon silently degraded to straight-line code "
+                "(unrolled or collapsed); the per-(cache x horizon) "
+                "bucket compile strategy assumes ONE compiled loop"))
+        elif argmax_in_loop[0] != 1:
+            findings.append(Finding(
+                "no-host-callback-in-decode", label, 0,
+                f"{argmax_in_loop[0]} logits->token argmax reductions "
+                "inside the decode scan body (expected exactly 1 per "
+                "iteration) — sampling is duplicated or was hoisted out "
+                "of the compiled loop"))
     if "fusion-applied-epilogue" in rules and pallas_calls[0] == 0:
         findings.append(Finding(
             "fusion-applied-epilogue", label, 0,
@@ -1316,6 +1370,35 @@ def fusion_probe() -> List[Finding]:
         _fe.set_mode(prev)
 
 
+def decode_probe() -> List[Finding]:
+    """Trace a tiny generative engine's k-token decode horizon program
+    and audit it with ``no-host-callback-in-decode`` (ISSUE 19): zero
+    host callbacks, a real compiled loop, exactly one logits->token
+    reduction per scan iteration. Runs from the CLI so ``make lint``
+    fails on a silently-degraded horizon — like the fusion probe, this
+    is the one regression parity tests cannot catch, because a
+    degraded horizon is bit-identical and only slow. Nothing executes
+    (aval trace only)."""
+    from ..nn.config import InputType, NeuralNetConfiguration
+    from ..nn.layers.attention import SelfAttentionLayer
+    from ..nn.layers.core import DenseLayer, OutputLayer
+    from ..nn.model import MultiLayerNetwork
+    from ..serving.engine import GenerativeEngine
+
+    V = 8
+    conf = (NeuralNetConfiguration.builder().seed(3)
+            .input_type(InputType.recurrent(V, 4))
+            .list(SelfAttentionLayer(n_out=V, n_heads=2),
+                  DenseLayer(n_out=16, activation="relu"),
+                  OutputLayer(n_out=V, activation="softmax"))
+            .build())
+    model = MultiLayerNetwork(conf).init()
+    eng = GenerativeEngine(model, slots=2)
+    fn, avals = eng.decode_multi_traceable(16, 4)
+    return jaxpr_audit(fn, avals, rules=(), expect_decode_loop=True,
+                       label="<decode_probe greedy horizon k=4>")
+
+
 # ------------------------------------------------------------------- CLI
 
 
@@ -1373,6 +1456,9 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         # Tier B gate: a silent epilogue/updater fallback is invisible to
         # parity tests (bit-identical, just slow) — fail the lint build.
         rep.findings.extend(fusion_probe())
+        # same failure mode for the decode horizon (ISSUE 19): a
+        # degraded k-token loop is bit-identical and only slow
+        rep.findings.extend(decode_probe())
     if args.emit_baseline:
         print(json.dumps({"entries": [
             {"rule": f.rule, "path": f.path,

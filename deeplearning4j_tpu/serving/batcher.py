@@ -41,6 +41,7 @@ deadline_expired / retries — zero silent fallbacks) and injectable via
 from __future__ import annotations
 
 import itertools
+import os
 import queue
 import threading
 import time
@@ -49,9 +50,11 @@ from collections import deque
 from concurrent.futures import Future, TimeoutError as _FutTimeout
 from typing import List, Optional, Sequence
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..ops import sampling as _smp
 from ..runtime import faults as _faults
 from ..runtime import telemetry as _tel
 from ..runtime.faults import DeadlineExceeded, QueueFull, ShutdownError
@@ -103,6 +106,32 @@ _H_TPOT = _tel.histogram(
     "serving.tpot_s",
     "time per output token per generative request "
     "((resolve - first emit) / (tokens - 1))")
+# host-free decode horizons (ISSUE 19): decode_step_s decomposes into a
+# device fraction (the blocking readback of an in-flight multi-token
+# horizon) and a host fraction (emission, deadline checks, trace
+# stitching) — with double-buffering the host fraction overlaps the
+# NEXT in-flight horizon instead of stalling the device
+_H_DECODE_DEV = _tel.histogram(
+    "serving.phase.decode_device_s",
+    "per-dispatch device wait: the one blocking readback of an "
+    "in-flight decode horizon (host-loop decode: the dispatch+sync)")
+_H_DECODE_HOST = _tel.histogram(
+    "serving.phase.decode_host_s",
+    "per-dispatch host-side share of the decode phase (sampling/"
+    "emission/featurization/trace stitching); overlapped with the "
+    "next in-flight horizon when double-buffering engages")
+_H_HORIZON = _tel.histogram(
+    "serving.decode.horizon",
+    "tokens per decode dispatch (the adaptive horizon k)")
+_M_DISPATCH = _tel.counter(
+    "serving.decode.dispatch",
+    "decode dispatch decisions by kind (decision= on_device / "
+    "host_loop / speculative) — host-loop fallbacks for custom "
+    "sample_fn/token_to_features are counted, never silent")
+_G_TPS = _tel.gauge(
+    "serving.tokens_per_s",
+    "windowed generative throughput over the batcher health window — "
+    "lets SLO burn-rate alarms gate on throughput, not just TPOT")
 _pi_ids = itertools.count()
 
 
@@ -854,6 +883,23 @@ class _GenRequest:
             (now if now is not None else time.perf_counter()) > self.deadline
 
 
+class _Horizon:
+    """One in-flight multi-token decode dispatch (ISSUE 19): the
+    engine's non-blocking ``HorizonResult`` plus the host bookkeeping
+    needed to consume it — which slots were live at dispatch and how
+    much token budget each has left AFTER this horizon lands (the
+    chain gate: only slots with budget remaining may ride the next
+    chained dispatch)."""
+
+    __slots__ = ("res", "live", "k", "budget_after")
+
+    def __init__(self, res, live, k, budget_after):
+        self.res = res
+        self.live = list(live)
+        self.k = int(k)
+        self.budget_after = dict(budget_after)
+
+
 class ContinuousBatcher:
     """Token-boundary continuous batching over a
     :class:`~..serving.engine.GenerativeEngine` slot set.
@@ -905,7 +951,10 @@ class ContinuousBatcher:
                  speculate_k: int = 4,
                  slo: Optional[_tel.SLO] = None,
                  pool_label: str = "default",
-                 migrate_buckets: Sequence[int] = ()):
+                 migrate_buckets: Sequence[int] = (),
+                 max_horizon: Optional[int] = None,
+                 sampling: Optional["_smp.SamplingSpec"] = None,
+                 seed: int = 0):
         from .engine import GenerativeEngine, PagedGenerativeEngine
         self.model = model
         # ISSUE 18: pool role of this front (prefill / decode /
@@ -963,6 +1012,37 @@ class ContinuousBatcher:
         self.prefill_per_iter = max(1, int(prefill_per_iter))
         self.eos_id = eos_id
         self._f = self.engine._feature_dim()
+        # ISSUE 19: a custom sample_fn / token_to_features cannot run
+        # inside the compiled horizon — those callers keep the per-token
+        # host loop, counted as decision="host_loop" dispatches (never a
+        # silent degradation)
+        self._custom_host_loop = (sample_fn is not None
+                                  or token_to_features is not None)
+        if sampling is not None and sample_fn is not None:
+            raise ValueError("sampling= configures the ON-DEVICE "
+                             "sampler; a host sample_fn bypasses it — "
+                             "pass one of the two")
+        self._sampling = sampling if sampling is not None else _smp.GREEDY
+        if self._sampling.stochastic and draft_model is not None:
+            raise ValueError("speculative decoding verifies GREEDY "
+                             "tokens; stochastic on-device sampling "
+                             "cannot be teacher-forced")
+        if max_horizon is None:
+            env = os.environ.get("DL4J_TPU_DECODE_HORIZON")
+            max_horizon = int(env) if env else 8
+        self.max_horizon = max(1, int(max_horizon))
+        # horizon ramp ladder: powers of two up to max_horizon (plus
+        # max_horizon itself) — paces how fast the adaptive scheduler
+        # grows k in steady state. Purely a scheduling schedule: k is a
+        # RUNTIME scalar of the ONE warmed kmax=max_horizon program per
+        # cache bucket, so any budget-capped k <= max_horizon dispatches
+        # without a post-warmup compile
+        ladder, h = [], 1
+        while h < self.max_horizon:
+            ladder.append(h)
+            h <<= 1
+        ladder.append(self.max_horizon)
+        self._ladder = tuple(ladder)
         self.token_to_features = token_to_features or self._one_hot
         self.sample_fn = sample_fn or (lambda logits: int(np.argmax(logits)))
         # speculative decoding (ISSUE 12): a small draft engine proposes
@@ -994,13 +1074,21 @@ class ContinuousBatcher:
                 cb.append(b)
                 b <<= 1
             pb = list(prompt_buckets) if prompt_buckets else cb
+            # ONE kmax=max_horizon program per cache bucket serves every
+            # runtime k the scheduler picks (k is a scalar argument of
+            # the compiled loop); host-loop/speculative fronts skip it
+            horizons = () if (self._custom_host_loop
+                              or self.draft is not None) \
+                else (self.max_horizon,)
             if self.paged:
                 self.engine.warmup(
                     cb, pb, speculate=(self.speculate_k,)
                     if self.draft is not None else (),
-                    migrate_buckets=self._migrate_buckets)
+                    migrate_buckets=self._migrate_buckets,
+                    horizons=horizons, sampling=self._sampling)
             else:
-                self.engine.warmup(cb, pb)
+                self.engine.warmup(cb, pb, horizons=horizons,
+                                   sampling=self._sampling)
             if self.draft is not None:
                 self.draft.warmup(cb, pb)
         # live decode state + host mirrors (worker-thread-only)
@@ -1011,6 +1099,14 @@ class ContinuousBatcher:
         if self.draft is not None:
             self._dstate = self.draft.new_state(self.min_cache_len)
             self._dlengths = np.zeros((self.slots,), np.int64)
+        # ISSUE 19 runtime state: the in-flight horizon (double-
+        # buffering holds at most ONE), the adaptive-horizon streak, the
+        # threaded PRNG key (device-carried across chained dispatches),
+        # and a token-timestamp ring for the windowed throughput gauge
+        self._inflight: Optional[_Horizon] = None
+        self._h_streak = 0
+        self._key = np.asarray(jax.random.PRNGKey(int(seed)), np.uint32)
+        self._token_times: deque = deque(maxlen=4096)
         self._q: "queue.Queue[_GenRequest]" = queue.Queue(maxsize=queue_limit)
         self._shutdown = threading.Event()
         # observability: same registry families as the one-shot front,
@@ -1036,6 +1132,19 @@ class ContinuousBatcher:
         self._m_proposed = _M_PROPOSED.labeled(pi=_pi, pool=_pool)
         self._m_accepted = _M_ACCEPTED.labeled(pi=_pi, pool=_pool)
         self._h_accept = _H_ACCEPT.labeled(pi=_pi, pool=_pool)
+        # ISSUE 19: device/host decode split, horizon histogram,
+        # dispatch-decision mix, windowed throughput
+        self._h_dec_dev = _H_DECODE_DEV.labeled(pi=_pi, pool=_pool)
+        self._h_dec_host = _H_DECODE_HOST.labeled(pi=_pi, pool=_pool)
+        self._h_horizon = _H_HORIZON.labeled(pi=_pi, pool=_pool)
+        self._m_disp_dev = _M_DISPATCH.labeled(pi=_pi, pool=_pool,
+                                               decision="on_device")
+        self._m_disp_host = _M_DISPATCH.labeled(pi=_pi, pool=_pool,
+                                                decision="host_loop")
+        self._m_disp_spec = _M_DISPATCH.labeled(pi=_pi, pool=_pool,
+                                                decision="speculative")
+        self._g_tps = _G_TPS.labeled(pi=_pi, pool=_pool)
+        self._g_tps.set(0.0)
         # r10 degradation state machine, same recent-event window as the
         # one-shot front
         self.health_window = 5.0
@@ -1218,6 +1327,14 @@ class ContinuousBatcher:
     def stats(self) -> dict:
         ttft = self._h_ttft.hist_snapshot()
         tpot = self._h_tpot.hist_snapshot()
+        # windowed throughput (ISSUE 19 satellite): tokens emitted in
+        # the trailing health window — the gauge SLO burn-rate alarms
+        # can gate on (TPOT percentiles alone miss idle-front decay)
+        now = time.perf_counter()
+        w = self.health_window
+        tps = sum(1 for t in list(self._token_times)
+                  if now - t <= w) / w
+        self._g_tps.set(tps)
         out = {
             "slots": self.slots,
             "pool": self._pool_label,
@@ -1241,6 +1358,13 @@ class ContinuousBatcher:
             else tpot["p50"] * 1e3,
             "tpot_ms_p99": None if tpot["p99"] is None
             else tpot["p99"] * 1e3,
+            "tokens_per_s": tps,
+            "max_horizon": self.max_horizon,
+            "dispatch_decisions": {
+                "on_device": int(self._m_disp_dev.value()),
+                "host_loop": int(self._m_disp_host.value()),
+                "speculative": int(self._m_disp_spec.value()),
+            },
             "engine": self.engine.stats(),
         }
         if self.slo is not None:
@@ -1300,9 +1424,18 @@ class ContinuousBatcher:
     def _loop(self):
         while not self._shutdown.is_set():
             try:
+                if self._inflight is not None:
+                    # double-buffering (ISSUE 19): a horizon is in
+                    # flight — chain its successor, then land it (one
+                    # readback) and run the host-side emission work
+                    self._consume_horizon()
+                    continue
                 admitted = self._admit()
                 if any(r is not None for r in self._slot_req):
-                    self._decode_iter()
+                    if self.draft is not None or self._custom_host_loop:
+                        self._decode_iter()
+                    else:
+                        self._dispatch_horizon()
                 elif not admitted:
                     time.sleep(0.002)  # idle: no queue, no active slots
             except Exception as e:
@@ -1317,6 +1450,8 @@ class ContinuousBatcher:
         buffers, so after a failed dispatch they may be consumed — with
         every slot freed, fresh zeros are the correct state), and keep
         the worker alive for subsequent traffic."""
+        self._inflight = None
+        self._h_streak = 0
         live = [i for i, r in enumerate(self._slot_req) if r is not None]
         self._m_failures.inc(max(1, len(live)))
         self._note("failure")
@@ -1470,10 +1605,16 @@ class ContinuousBatcher:
             self._state.page_table[slot, :] = 0
             eng.pool.release(pages)
             raise
+        # materialize ONCE (the host-sync-in-hot-path staticcheck rule
+        # flagged the former asarray(...).copy() double-copy here); the
+        # registry keeps the materialized array, so only the registered
+        # path pays a defensive copy for the caller-visible buffer
+        logits = np.asarray(sh.logits)
         if self.prefix_cache and sh.prefix_key is not None:
             eng.pool.register_prefix(sh.prefix_key, pages, req.plen,
-                                     sh.logits)
-        return np.asarray(sh.logits).copy()
+                                     logits)
+            return logits.copy()
+        return logits
 
     def _paged_admit(self, req: _GenRequest, slot: int) -> np.ndarray:
         """Paged admission with prefix sharing (ISSUE 12): hash the full
@@ -1516,6 +1657,10 @@ class ContinuousBatcher:
             raise
         if key is not None:
             self.engine.pool.register_prefix(key, pages, req.plen, logits)
+            # the registry keeps `logits`; hand the caller its own copy
+            # so a user mutating the result dict cannot corrupt the
+            # recorded prefix logits future hits replay
+            return logits.copy()
         return logits
 
     def _reset_slot(self, slot: int):
@@ -1547,6 +1692,7 @@ class ContinuousBatcher:
         self._m_tokens.inc()
         req.handle._emit(req.emitted - 1, tok)
         now = time.perf_counter()
+        self._token_times.append(now)
         if req.t_first_token is None:
             # first-class TTFT (ISSUE 13): submit -> first emitted token,
             # queue wait and prefill included — the user-visible stall
@@ -1577,6 +1723,204 @@ class ContinuousBatcher:
             self._x_t[slot, 0] = self.token_to_features(tok)
         return done
 
+    def _trip_decode_fault(self):
+        """Deterministic fault site for the decode dispatch path with
+        the documented ONE-transient-retry semantics. Only covers
+        PRE-dispatch failures: once a dispatch lands, the donated
+        buffers are consumed and re-dispatch is impossible — executor
+        failures route to _fail_active's fresh-state recovery."""
+        attempt = 0
+        while _faults.enabled():
+            try:
+                _faults.trip("serving.decode")
+                break
+            except Exception as e:
+                if attempt == 0 and _faults.is_transient(e):
+                    attempt = 1
+                    self._m_retries.inc()
+                    self._note("retry")
+                    continue
+                raise
+
+    # ---- host-free decode horizons (ISSUE 19) ------------------------------
+    def _pick_horizon(self, live) -> int:
+        """Adaptive horizon: k=1 while the admission queue is non-empty
+        (joins/leaves stay at token boundaries), doubling up the ramp
+        ladder toward max_horizon in steady state, always capped by the
+        smallest remaining token budget over the live slots — no slot
+        can ever decode past its max_new inside a horizon, so the host
+        and device length mirrors never diverge. The cap is EXACT (k is
+        a runtime scalar of the warmed kmax=max_horizon program, so an
+        off-ladder k never compiles)."""
+        if self.max_horizon <= 1 or not self._q.empty():
+            self._h_streak = 0
+            k = 1
+        else:
+            k = self._ladder[min(self._h_streak, len(self._ladder) - 1)]
+            self._h_streak += 1
+        budget = min(self._slot_req[s].max_new - self._slot_req[s].emitted
+                     for s in live)
+        return max(1, min(k, budget))
+
+    def _eos_vec(self, live) -> np.ndarray:
+        """Per-slot EOS ids for on-device EOS detection (-1 = none)."""
+        eos = np.full((self.slots,), -1, np.int32)
+        for s in live:
+            e = self._slot_req[s].eos_id
+            if e is not None:
+                eos[s] = int(e)
+        return eos
+
+    def _dispatch_horizon(self):
+        """Dispatch ONE multi-token decode horizon without blocking on
+        its result (ISSUE 19 tentpole): sampling, featurization, EOS
+        freezing, and length advance all run on-device inside a single
+        compiled loop; the host reads tokens back once per horizon
+        in _consume_horizon, overlapped with the NEXT chained dispatch
+        when the chain gate allows."""
+        active = np.array([1 if r is not None else 0
+                           for r in self._slot_req], np.int32)
+        live = [i for i in range(self.slots) if active[i]]
+        k = self._pick_horizon(live)
+        need = int(self._lengths[live].max()) + k
+        if need > self._state.cache_len:
+            self._state = self.engine.grow(self._state, need)
+        eos = self._eos_vec(live)
+        try:
+            self._trip_decode_fault()
+            if self.paged:
+                # copy-on-write over the WHOLE horizon: every position
+                # the k steps will write must land on exclusively-owned
+                # pages BEFORE dispatch (one refcount snapshot)
+                snap = self.engine.pool.ref_snapshot()
+                pairs = []
+                for s in live:
+                    pairs += self.engine.prepare_write(
+                        self._state, s, k, ref_snapshot=snap)
+                if pairs:
+                    self._state = self.engine.fork(self._state, pairs)
+                self._state, res = self.engine.pdecode_multi(
+                    self._state, self._x_t, active, k, eos_ids=eos,
+                    sampling=self._sampling, key=self._key)
+            else:
+                self._state, res = self.engine.decode_multi(
+                    self._state, self._x_t, active, k, eos_ids=eos,
+                    sampling=self._sampling, key=self._key)
+        except Exception as e:
+            self._fail_active(e)
+            return
+        self._key = res.chain.key
+        self._m_disp_dev.inc()
+        self._h_horizon.observe(float(k))
+        self._inflight = _Horizon(res, live, k, {
+            s: self._slot_req[s].max_new - self._slot_req[s].emitted - k
+            for s in live})
+
+    def _maybe_chain(self, h: "_Horizon"):
+        """Double-buffering: dispatch horizon i+1 from horizon i's
+        device-carried chain (x_t/active/lengths/key never touch the
+        host) BEFORE consuming horizon i, so emission and trace work
+        overlap device compute. Chaining yields to admission (a queued
+        request that could take a free slot) and to contiguous growth
+        (a host-side cache gather would block on the in-flight
+        horizon)."""
+        if self.max_horizon <= 1:
+            return
+        if not self._q.empty() and self._free_slot() is not None:
+            return
+        # a slot that hit EOS in an EARLIER horizon was reset during
+        # that consume (req gone, device side already frozen) — its
+        # dispatch-time budget is stale, so require a live request too
+        cont = [s for s in h.live if h.budget_after.get(s, 0) > 0
+                and self._slot_req[s] is not None]
+        if not cont:
+            return
+        budget = min(h.budget_after[s] for s in cont)
+        k2 = self._ladder[min(self._h_streak, len(self._ladder) - 1)]
+        k2 = max(1, min(k2, budget))
+        # lengths after horizon i land at AT MOST mirror + h.k (EOS
+        # freezes advance less — writes past a frozen slot's length are
+        # gated off, so sizing for the maximum is safe)
+        assumed_max = max(int(self._lengths[s]) + h.k for s in cont)
+        need = assumed_max + k2
+        if not self.paged and need > self._state.cache_len:
+            return  # contiguous growth host-gathers: consume first
+        cap = np.zeros((self.slots,), np.int32)
+        cap[cont] = 1
+        eos = self._eos_vec(cont)
+        self._trip_decode_fault()
+        if self.paged:
+            if need > self._state.cache_len:
+                self._state = self.engine.grow(self._state, need)
+            # CoW for the chained horizon is planned against ASSUMED
+            # post-horizon lengths (mirror + h.k); restore the mirror
+            # right after — _consume_horizon advances it by the ACTUAL
+            # emitted counts
+            saved = self._state.lengths.copy()
+            try:
+                for s in cont:
+                    self._state.lengths[s] = saved[s] + h.k
+                snap = self.engine.pool.ref_snapshot()
+                pairs = []
+                for s in cont:
+                    pairs += self.engine.prepare_write(
+                        self._state, s, k2, ref_snapshot=snap)
+            finally:
+                self._state.lengths[:] = saved
+            if pairs:
+                self._state = self.engine.fork(self._state, pairs)
+            self._state, res = self.engine.pdecode_multi(
+                self._state, None, None, k2, eos_ids=eos,
+                active_cap=cap, sampling=self._sampling,
+                chain=h.res.chain)
+        else:
+            self._state, res = self.engine.decode_multi(
+                self._state, None, None, k2, eos_ids=eos,
+                active_cap=cap, sampling=self._sampling,
+                chain=h.res.chain)
+        self._key = res.chain.key
+        self._h_streak += 1
+        self._m_disp_dev.inc()
+        self._h_horizon.observe(float(k2))
+        self._inflight = _Horizon(
+            res, cont, k2, {s: h.budget_after[s] - k2 for s in cont})
+
+    def _consume_horizon(self):
+        """Land the in-flight horizon: chain the successor FIRST (the
+        device keeps working), then ONE blocking readback, then the
+        host-side per-token work — emission, featurization-free trace
+        stitching, slot reclaim — exactly the work the device no
+        longer waits on."""
+        h = self._inflight
+        self._inflight = None
+        self._maybe_chain(h)
+        t0 = time.perf_counter()
+        toks, logits, emitted = h.res.fetch()
+        t_fetch = time.perf_counter()
+        self._h_dec_dev.observe(t_fetch - t0)
+        for s in h.live:
+            req = self._slot_req[s]
+            if req is None:
+                continue  # freed by a failure path while in flight
+            m = int(emitted[:, s].sum())
+            if m <= 0:
+                continue
+            # per-TOKEN decode phases tiling the horizon wall exactly:
+            # the stitched timeline keeps its one-phase-per-token shape
+            # and its sums-to-latency contract under any horizon k
+            now = time.perf_counter()
+            dt = (now - req.t_anchor) / m
+            req.t_anchor = now
+            for j in range(m):
+                req.trace.phase("decode", dt, horizon=h.k)
+                self._lengths[s] += 1
+                if self.paged:
+                    self._state.lengths[s] += 1
+                if self._emit_known(s, int(toks[j, s]), logits[j, s]):
+                    break
+        self._g_slots.set(self.active_slots())
+        self._h_dec_host.observe(time.perf_counter() - t_fetch)
+
     def _decode_iter(self):
         active = np.array([1 if r is not None else 0
                            for r in self._slot_req], np.int32)
@@ -1587,27 +1931,13 @@ class ContinuousBatcher:
             self._state = self.engine.grow(
                 self._state, self._state.cache_len + 1)
         try:
-            # the transient retry only covers PRE-dispatch failures (the
-            # fault-injection trip): once engine.decode dispatches, the
-            # donated cache buffers are consumed and a re-dispatch with
-            # the same state is impossible — executor failures fall
-            # through to _fail_active's fresh-state recovery instead
-            attempt = 0
-            while _faults.enabled():
-                try:
-                    _faults.trip("serving.decode")
-                    break
-                except Exception as e:
-                    if attempt == 0 and _faults.is_transient(e):
-                        attempt = 1
-                        self._m_retries.inc()
-                        self._note("retry")
-                        continue
-                    raise
+            self._trip_decode_fault()
             if self.draft is not None:
+                self._m_disp_spec.inc()
                 self._speculative_iter(active, live)
                 self._g_slots.set(self.active_slots())
                 return
+            self._m_disp_host.inc()
             if self.paged:
                 # copy-on-write: every active slot's write position must
                 # land on an exclusively-owned page BEFORE dispatch.
@@ -1620,8 +1950,11 @@ class ContinuousBatcher:
                         self._state, s, 1, ref_snapshot=snap)
                 if pairs:
                     self._state = self.engine.fork(self._state, pairs)
+            t_d0 = time.perf_counter()
             state, logits = self.engine.decode(
                 self._state, self._x_t, active)
+            t_d1 = time.perf_counter()
+            self._h_dec_dev.observe(t_d1 - t_d0)
         except Exception as e:
             self._fail_active(e)
             return
@@ -1638,6 +1971,8 @@ class ContinuousBatcher:
             req.t_anchor = now
             self._emit_token(i, logits[i])
         self._g_slots.set(self.active_slots())
+        self._h_horizon.observe(1.0)
+        self._h_dec_host.observe(time.perf_counter() - t_d1)
 
     def _speculative_iter(self, active, live):
         """Draft-propose / target-verify (ISSUE 12): the draft engine

@@ -45,6 +45,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from .. import dtypes as _dt
 from ..ops import flash_attention as _fa
 from ..ops import quantize as _q
+from ..ops import sampling as _smp
 from ..parallel import placement as _pl
 from ..parallel.placement import QuantizedParamsMixin as _QuantizedParamsMixin
 from ..runtime import telemetry as _tel
@@ -858,6 +859,65 @@ class DecodeState:
         self.cache_len = int(cache_len)
 
 
+class HorizonChain:
+    """Device-carried loop state between chained decode horizons
+    (ISSUE 19): the next-step features, the live mask, the advanced
+    lengths, and the threaded PRNG key — everything horizon i+1 needs to
+    dispatch WITHOUT the host reading horizon i back first. All four are
+    device arrays straight out of the previous executable call."""
+
+    __slots__ = ("x_t", "active", "lengths", "key")
+
+    def __init__(self, x_t, active, lengths, key):
+        self.x_t = x_t
+        self.active = active
+        self.lengths = lengths
+        self.key = key
+
+
+class HorizonResult:
+    """One in-flight multi-token decode horizon (ISSUE 19).
+
+    ``toks``/``logits``/``actives`` are DEVICE arrays of shape
+    ``[kmax, slots]`` / ``[kmax, slots, V]`` / ``[kmax, slots]`` where
+    ``kmax >= k`` is the serving executable's capacity (rows ``>= k``
+    are zero) — JAX's async dispatch means the executable call returned
+    before the device finished, so the batcher can dispatch horizon i+1
+    (via ``chain``) and run its host-side emission of horizon i-1 while
+    this one computes. :meth:`fetch` is the single blocking device->host
+    readback per horizon — one sync per k tokens instead of one per
+    token. ``actives[j, s] == 1`` iff slot ``s`` really emitted token j
+    (EOS mid-horizon or ``j >= k`` freezes the tail — per-slot emission
+    is always a prefix; tail tokens/logits are garbage by the same
+    contract as inactive decode rows)."""
+
+    __slots__ = ("k", "chain", "_toks", "_logits", "_actives", "_eng",
+                 "_t0", "_cached")
+
+    def __init__(self, toks, logits, actives, chain, k, eng, t0):
+        self._toks = toks
+        self._logits = logits
+        self._actives = actives
+        self.chain = chain
+        self.k = int(k)
+        self._eng = eng
+        self._t0 = t0
+        self._cached = None
+
+    def fetch(self):
+        """Block until the horizon's device work completes and return
+        host ``(toks [k, S], logits [k, S, V], actives [k, S])`` numpy.
+        Observes ``serving.phase.decode_step_s`` once per horizon
+        (dispatch -> readback-complete) on first call; idempotent."""
+        if self._cached is None:
+            out = (np.asarray(self._toks), np.asarray(self._logits),
+                   np.asarray(self._actives))
+            if self._t0 is not None and self._eng is not None:
+                self._eng._h_decode.observe(time.perf_counter() - self._t0)
+            self._cached = out
+        return self._cached
+
+
 class GenerativeEngine(_QuantizedParamsMixin):
     """Bucketed AOT-compiled autoregressive decode for one model
     (ISSUE 8 tentpole, layer 2): the generative sibling of
@@ -1181,22 +1241,152 @@ class GenerativeEngine(_QuantizedParamsMixin):
 
         return self._get_compiled(("decode", c), build, _warmup)
 
+    def _decode_multi_parts(self, c: int, kmax: int,
+                            spec: _smp.SamplingSpec):
+        """(fn, avals, cache_avals) for one multi-token horizon program
+        (ISSUE 19 tentpole): a ``lax.fori_loop`` over ``k <= kmax``
+        decode iterations — ``k`` is a RUNTIME scalar argument, so ONE
+        compiled program per cache bucket serves EVERY horizon the
+        scheduler picks (exact budget caps, k=1 under queue pressure)
+        at zero post-warmup compiles. Samples on-device, featurizes the
+        token through the model's embedding path on-device, and
+        write-gates EOS-frozen slots — the logits never touch the host
+        inside the horizon. The token/logits/emitted outputs are fixed
+        ``[kmax, ...]`` buffers; rows ``>= k`` stay zero, so ``emitted``
+        is a per-slot prefix mask whatever k ran. Shared by
+        :meth:`_decode_multi_exe` and the staticcheck decode probe so
+        ``make lint`` audits EXACTLY what serving runs."""
+        model = self.model
+        S = self.slots
+        f = self._feature_dim()
+        dt = _dt.resolve(model.conf.dtype)
+        kv_quant = self._kv_quant
+        sample = spec.build()
+        stochastic = spec.stochastic
+
+        p_avals, s_avals = self._params_avals()
+        cache_avals = model.decode_cache_spec(S, c, kv_quant=kv_quant)
+        len_aval = jax.ShapeDtypeStruct((S,), jnp.int32)
+        x_aval = jax.ShapeDtypeStruct((S, 1, f), dt)
+        i32_aval = jax.ShapeDtypeStruct((S,), jnp.int32)
+        # the loop carry must be shape-stable, so the output buffers are
+        # allocated [kmax, ...] up front — which needs the logits dim
+        # before tracing the body
+        y_aval = jax.eval_shape(
+            lambda p, m, cc, ll, xx, aa: model._decode_step(
+                p, xx, m, cc, ll, write=aa)[0],
+            p_avals, s_avals, cache_avals, len_aval, x_aval, i32_aval)
+        V, ldt = int(y_aval.shape[-1]), y_aval.dtype
+
+        def fn(params, mstate, caches, lengths, x_t, active, cap,
+               eos_ids, temp, key, k):
+            # cap: host-known budget exhaustion (max_new) the device
+            # cannot detect — ANDed once so chained horizons stop
+            # writing rows whose request already hit its token budget
+            active = active * cap
+
+            def body(i, carry):
+                caches, lengths, x_t, active, key, toks, lgs, ems = carry
+                if stochastic:
+                    key, sub = jax.random.split(key)
+                else:
+                    sub = key
+                y, caches = model._decode_step(params, x_t, mstate,
+                                               caches, lengths,
+                                               write=active)
+                logits = y[:, 0]
+                tok = sample(logits, sub, temp)
+                emitted = active
+                lengths = lengths + active.astype(lengths.dtype)
+                # EOS freezes the slot for the REST of the horizon: the
+                # EOS token itself is still emitted (emitted = pre-step
+                # active), subsequent iterations write-gate the row so
+                # its cache stays bit-identical to the host oracle's
+                active = active * (1 - _smp.eos_hit(tok, eos_ids))
+                x_t = model.decode_token_features(tok, dtype=dt)
+                toks = jax.lax.dynamic_update_index_in_dim(
+                    toks, tok.astype(jnp.int32), i, 0)
+                lgs = jax.lax.dynamic_update_index_in_dim(
+                    lgs, logits.astype(ldt), i, 0)
+                ems = jax.lax.dynamic_update_index_in_dim(
+                    ems, emitted, i, 0)
+                return (caches, lengths, x_t, active, key,
+                        toks, lgs, ems)
+
+            init = (caches, lengths, x_t, active, key,
+                    jnp.zeros((kmax, S), jnp.int32),
+                    jnp.zeros((kmax, S, V), ldt),
+                    jnp.zeros((kmax, S), jnp.int32))
+            (caches, lengths, x_t, active, key,
+             toks, logits, emitted) = jax.lax.fori_loop(0, k, body, init)
+            return (caches, lengths, x_t, active, key,
+                    toks, logits, emitted)
+
+        avals = (p_avals, s_avals, cache_avals,
+                 len_aval, x_aval, i32_aval, i32_aval, i32_aval,
+                 jax.ShapeDtypeStruct((), jnp.float32),
+                 jax.ShapeDtypeStruct((2,), jnp.uint32),
+                 jax.ShapeDtypeStruct((), jnp.int32))
+        return fn, avals, cache_avals
+
+    def decode_multi_traceable(self, cache_len: int, k: int,
+                               sampling: _smp.SamplingSpec = _smp.GREEDY):
+        """(fn, avals) of the horizon program (``k`` = its kmax) — the
+        staticcheck ``no-host-callback-in-decode`` jaxpr audit traces
+        this."""
+        c = next_bucket(int(cache_len))
+        fn, avals, _ = self._decode_multi_parts(c, int(k), sampling)
+        return fn, avals
+
+    def _decode_multi_exe(self, c: int, kmax: int,
+                          spec: _smp.SamplingSpec, _warmup=False):
+        def build():
+            fn, avals, cache_avals = self._decode_multi_parts(
+                c, kmax, spec)
+            # caches donated exactly like the single-step path — the
+            # loop's carry updates the HBM cache in place per iteration
+            jkw = {"donate_argnums": (2,)}
+            if self.mesh is not None:
+                p_sh, s_sh, c_sh, repl = self._tp_shardings(cache_avals)
+                jkw["in_shardings"] = (p_sh, s_sh, c_sh) + (repl,) * 8
+                jkw["out_shardings"] = (c_sh,) + (repl,) * 7
+            with self._tp_trace():
+                return jax.jit(fn, **jkw).lower(*avals)
+
+        return self._get_compiled(
+            ("decode_multi", c, kmax) + spec.static_key(), build, _warmup)
+
     def warmup(self, cache_buckets: Sequence[int],
                prompt_buckets: Sequence[int],
-               checkpoint: Optional[str] = None) -> "GenerativeEngine":
+               checkpoint: Optional[str] = None,
+               horizons: Sequence[int] = (),
+               sampling: _smp.SamplingSpec = _smp.GREEDY
+               ) -> "GenerativeEngine":
         """Compile every (prompt bucket x cache bucket) prefill and every
         cache-bucket decode executable outside traffic. After this, a
         generation whose prompt and total length stay within the warmed
         ladders never compiles (asserted by the bench/tier-1 suite).
         ``checkpoint=<dir>`` restores the model from a pod
         ``TrainingCheckpointer`` directory first (multi-host AOT warmup
-        in one call — ISSUE 17)."""
+        in one call — ISSUE 17). ``horizons`` (ISSUE 19): additionally
+        compile the fused multi-token decode program per (cache bucket
+        x horizon CAPACITY) under ``sampling`` — k is a runtime scalar,
+        so warming just ``(max_horizon,)`` covers every adaptive k the
+        scheduler can pick at zero post-warmup compiles."""
         if checkpoint is not None:
             _pl.load_checkpoint(self.model, checkpoint)
         cs = sorted(set(next_bucket(c) for c in cache_buckets))
         tps = sorted(set(next_bucket(t) for t in prompt_buckets))
+        hs = sorted({int(h) for h in horizons if int(h) >= 1})
         for c in cs:
-            self._decode_exe(c, _warmup=True)
+            if not hs:
+                # a horizon front NEVER dispatches the single-step
+                # program (k=1 rides the same kmax executable), so its
+                # compile would be pure warmup wall-time; host-loop /
+                # speculative fronts (horizons=()) still warm it
+                self._decode_exe(c, _warmup=True)
+            for h in hs:
+                self._decode_multi_exe(c, h, sampling, _warmup=True)
             for tp in tps:
                 if tp <= c:
                     self._prefill_exe(tp, c, _warmup=True)
@@ -1267,6 +1457,80 @@ class GenerativeEngine(_QuantizedParamsMixin):
             self._h_decode.observe(time.perf_counter() - t0)
         return DecodeState(caches, lengths, state.cache_len), logits
 
+    def _horizon_args(self, k, active_cap, eos_ids, sampling, key):
+        S = self.slots
+        cap = np.ones((S,), np.int32) if active_cap is None \
+            else np.asarray(active_cap, np.int32)
+        eos = np.full((S,), -1, np.int32) if eos_ids is None \
+            else np.asarray(eos_ids, np.int32)
+        temp = np.float32(sampling.temperature)
+        if key is None:
+            key = np.zeros((2,), np.uint32) if not sampling.stochastic \
+                else np.asarray(jax.random.PRNGKey(0), np.uint32)
+        if isinstance(key, jax.Array):
+            # a chained device key: hand it straight to the executable —
+            # np.asarray here would block on the in-flight horizon.
+            key_arg = key
+        else:
+            key_arg = self._put_arg(np.asarray(key, np.uint32))
+        return (self._put_arg(cap), self._put_arg(eos),
+                self._put_arg(temp), key_arg)
+
+    def _cast_x(self, x_t):
+        x_t = np.asarray(x_t)
+        dt = _dt.resolve(self.model.conf.dtype)
+        if np.issubdtype(x_t.dtype, np.floating) and x_t.dtype != dt:
+            x_t = x_t.astype(dt)
+        return x_t
+
+    def decode_multi(self, state: DecodeState, x_t, active, k: int, *,
+                     eos_ids=None, active_cap=None,
+                     sampling: _smp.SamplingSpec = _smp.GREEDY,
+                     key=None, chain: Optional[HorizonChain] = None):
+        """k tokens for every slot in ONE dispatch (ISSUE 19 tentpole):
+        sample/featurize/EOS-freeze on-device; returns
+        ``(state', HorizonResult)`` WITHOUT blocking — the caller reads
+        tokens back via ``result.fetch()`` (one sync per horizon) and
+        may dispatch the next horizon first from ``result.chain``
+        (double-buffering). ``eos_ids`` [S] int32 per-slot EOS (-1 =
+        none); ``active_cap`` [S] 0/1 host-known budget gate ANDed into
+        the live mask; ``chain`` reuses the previous horizon's
+        device-carried x_t/active/key so chained dispatch never touches
+        the host. The passed state is CONSUMED (caches donated).
+
+        k is a RUNTIME scalar of the compiled program: any warmed
+        executable whose capacity kmax >= k serves the dispatch (the
+        smallest such, mirroring prefill's warmed-bucket pick), so an
+        exact budget-capped k never compiles post-warmup; only a k
+        beyond every warmed capacity compiles a new kmax=k program
+        (counted by ``compiles`` like any cold bucket)."""
+        k = int(k)
+        with self._lock:
+            warmed = sorted(
+                kk[2] for kk in self._compiled
+                if kk[0] == "decode_multi" and kk[1] == state.cache_len
+                and kk[2] >= k and tuple(kk[3:]) == sampling.static_key())
+        kmax = warmed[0] if warmed else k
+        exe = self._decode_multi_exe(state.cache_len, kmax, sampling)
+        self._m_calls.inc()
+        params, mstate = self._place_params()
+        cap, eos, temp, key_arg = self._horizon_args(
+            k, active_cap, eos_ids, sampling, key)
+        if chain is not None:
+            x_arg, a_arg, key_arg = chain.x_t, chain.active, chain.key
+        else:
+            x_arg = self._put_arg(self._cast_x(x_t))
+            a_arg = self._put_arg(np.asarray(active, np.int32))
+        tel = _tel.enabled()
+        t0 = time.perf_counter() if tel else None
+        caches, lengths, x2, a2, k2, toks, logits, emitted = exe(
+            params, mstate, state.caches, state.lengths, x_arg, a_arg,
+            cap, eos, temp, key_arg, self._put_arg(np.int32(k)))
+        state2 = DecodeState(caches, lengths, state.cache_len)
+        ch = HorizonChain(x2, a2, lengths, k2)
+        return state2, HorizonResult(toks, logits, emitted, ch, k,
+                                     self, t0)
+
     # ---------------------------------------------------------------- admin
     def invalidate(self, cause: str = "invalidate"):
         with self._lock:
@@ -1302,14 +1566,23 @@ class GenerativeEngine(_QuantizedParamsMixin):
 
     def attribution_report(self, cache_len: int,
                            measured_s: Optional[float] = None,
-                           peaks=None) -> dict:
+                           peaks=None, horizon: Optional[int] = None,
+                           host_s: Optional[float] = None) -> dict:
         """MFU attribution of the decode-step program at one cache bucket
         (ISSUE 13): ``cost_analysis()`` of the full-slot-batch decode
         executable vs the measured ``serving.phase.decode_step_s`` p50
-        for this engine. Warm/serve first or pass ``measured_s``."""
+        for this engine. Warm/serve first or pass ``measured_s``.
+        ``horizon=k`` (ISSUE 19) attributes the fused k-token greedy
+        horizon program instead; ``host_s`` feeds the measured host-side
+        share of each step so the report's host fraction tracks what the
+        horizon runtime actually eliminated."""
         from ..runtime import attribution as _attr
         c = next_bucket(int(cache_len))
-        exe = self._decode_exe(c, _warmup=True)
+        if horizon:
+            exe = self._decode_multi_exe(c, int(horizon), _smp.GREEDY,
+                                         _warmup=True)
+        else:
+            exe = self._decode_exe(c, _warmup=True)
         measurement_note = None
         if measured_s is None:
             with self._lock:
@@ -1328,14 +1601,19 @@ class GenerativeEngine(_QuantizedParamsMixin):
         # step's cached fractions never blend with single-device ones
         key = (f"serving.decode:{type(self.model).__name__}:"
                f"s{self.slots}xc{c}:{self.quantize or 'f32'}")
+        if horizon:
+            key += f":h{int(horizon)}"
         if self._placement_layer is not None:
             key += f":{self._placement_layer.suffix()}"
         rep = _attr.attribute_compiled(
-            exe, measured_s=measured_s, peaks=peaks, key=key)
+            exe, measured_s=measured_s, host_s=host_s, peaks=peaks,
+            key=key)
         if measurement_note is not None:
             rep["measurement_note"] = measurement_note
         rep.update({"kind": "decode_step", "cache_len": c,
                     "slots": self.slots})
+        if horizon:
+            rep["horizon"] = int(horizon)
         return rep
 
 
@@ -1622,6 +1900,102 @@ class PagedGenerativeEngine(GenerativeEngine):
 
         return self._get_compiled(("pdecode", kq, mp), build, _warmup)
 
+    def _pdecode_multi_parts(self, kmax: int, mp: int,
+                             spec: _smp.SamplingSpec):
+        """Paged twin of :meth:`_decode_multi_parts`: the page table is
+        a loop-invariant argument (pages for the whole horizon are
+        prepared by the batcher's CoW pass before dispatch), lengths
+        advance in the carry so each iteration scatters into the right
+        page rows. Like the contiguous twin, k is a RUNTIME scalar
+        bounded by the program's ``kmax`` output capacity."""
+        model = self.model
+        S = self.slots
+        f = self._feature_dim()
+        dt = _dt.resolve(model.conf.dtype)
+        P = self.page_size
+        sample = spec.build()
+        stochastic = spec.stochastic
+
+        p_avals, s_avals = self._params_avals()
+        pool_avals = self._pool_spec()
+        pt_aval = jax.ShapeDtypeStruct((S, mp), jnp.int32)
+        len_aval = jax.ShapeDtypeStruct((S,), jnp.int32)
+        x_aval = jax.ShapeDtypeStruct((S, 1, f), dt)
+        i32_aval = jax.ShapeDtypeStruct((S,), jnp.int32)
+        y_aval = jax.eval_shape(
+            lambda p, m, po, tb, ll, xx, aa: model._decode_step(
+                p, xx, m, po, ll, write=aa, page_table=tb,
+                page_size=P)[0],
+            p_avals, s_avals, pool_avals, pt_aval, len_aval, x_aval,
+            i32_aval)
+        V, ldt = int(y_aval.shape[-1]), y_aval.dtype
+
+        def fn(params, mstate, pool, pt, lengths, x_t, active, cap,
+               eos_ids, temp, key, k):
+            active = active * cap
+
+            def body(i, carry):
+                pool, lengths, x_t, active, key, toks, lgs, ems = carry
+                if stochastic:
+                    key, sub = jax.random.split(key)
+                else:
+                    sub = key
+                y, pool = model._decode_step(params, x_t, mstate, pool,
+                                             lengths, write=active,
+                                             page_table=pt, page_size=P)
+                logits = y[:, 0]
+                tok = sample(logits, sub, temp)
+                emitted = active
+                lengths = lengths + active.astype(lengths.dtype)
+                active = active * (1 - _smp.eos_hit(tok, eos_ids))
+                x_t = model.decode_token_features(tok, dtype=dt)
+                toks = jax.lax.dynamic_update_index_in_dim(
+                    toks, tok.astype(jnp.int32), i, 0)
+                lgs = jax.lax.dynamic_update_index_in_dim(
+                    lgs, logits.astype(ldt), i, 0)
+                ems = jax.lax.dynamic_update_index_in_dim(
+                    ems, emitted, i, 0)
+                return (pool, lengths, x_t, active, key,
+                        toks, lgs, ems)
+
+            init = (pool, lengths, x_t, active, key,
+                    jnp.zeros((kmax, S), jnp.int32),
+                    jnp.zeros((kmax, S, V), ldt),
+                    jnp.zeros((kmax, S), jnp.int32))
+            (pool, lengths, x_t, active, key,
+             toks, logits, emitted) = jax.lax.fori_loop(0, k, body, init)
+            return pool, lengths, x_t, active, key, toks, logits, emitted
+
+        avals = (p_avals, s_avals, pool_avals, pt_aval,
+                 len_aval, x_aval, i32_aval, i32_aval, i32_aval,
+                 jax.ShapeDtypeStruct((), jnp.float32),
+                 jax.ShapeDtypeStruct((2,), jnp.uint32),
+                 jax.ShapeDtypeStruct((), jnp.int32))
+        return fn, avals, pool_avals
+
+    def decode_multi_traceable(self, cache_len: int, k: int,
+                               sampling: _smp.SamplingSpec = _smp.GREEDY):
+        mp = self._mp_bucket(int(cache_len))
+        fn, avals, _ = self._pdecode_multi_parts(int(k), mp, sampling)
+        return fn, avals
+
+    def _pdecode_multi_exe(self, kmax: int, mp: int,
+                           spec: _smp.SamplingSpec, _warmup=False):
+        def build():
+            fn, avals, pool_avals = self._pdecode_multi_parts(
+                kmax, mp, spec)
+            jkw = {"donate_argnums": (2,)}
+            if self.mesh is not None:
+                p_sh, s_sh, pool_sh, repl = self._tp_shardings(pool_avals)
+                jkw["in_shardings"] = (p_sh, s_sh, pool_sh) + (repl,) * 9
+                jkw["out_shardings"] = (pool_sh,) + (repl,) * 7
+            with self._tp_trace():
+                return jax.jit(fn, **jkw).lower(*avals)
+
+        return self._get_compiled(
+            ("pdecode_multi", kmax, mp) + spec.static_key(), build,
+            _warmup)
+
     def _pfork_exe(self, _warmup=False):
         S = self.slots
         P = self.page_size
@@ -1825,7 +2199,10 @@ class PagedGenerativeEngine(GenerativeEngine):
                prompt_buckets: Sequence[int],
                speculate: Sequence[int] = (),
                checkpoint: Optional[str] = None,
-               migrate_buckets: Sequence[int] = ()) -> "PagedGenerativeEngine":
+               migrate_buckets: Sequence[int] = (),
+               horizons: Sequence[int] = (),
+               sampling: _smp.SamplingSpec = _smp.GREEDY
+               ) -> "PagedGenerativeEngine":
         """Compile every (table-width bucket) decode executable — plus a
         Tq=k verify per ``speculate`` window — every prompt-bucket
         prefill, and the page-fork copy, outside traffic.
@@ -1842,8 +2219,14 @@ class PagedGenerativeEngine(GenerativeEngine):
             _pl.load_checkpoint(self.model, checkpoint)
         mps = sorted({self._mp_bucket(c) for c in cache_buckets})
         tps = sorted({next_bucket(t) for t in prompt_buckets})
+        hs = sorted({int(h) for h in horizons if int(h) >= 1})
         for mp in mps:
-            self._pdecode_exe(1, mp, _warmup=True)
+            if not hs:
+                # same rule as the contiguous engine: a horizon front
+                # never dispatches the single-token window
+                self._pdecode_exe(1, mp, _warmup=True)
+            for h in hs:
+                self._pdecode_multi_exe(h, mp, sampling, _warmup=True)
             for kq in speculate:
                 if int(kq) > 1:
                     self._pdecode_exe(int(kq), mp, _warmup=True)
@@ -1936,6 +2319,53 @@ class PagedGenerativeEngine(GenerativeEngine):
         ``(state', logits [S, k, V])``."""
         return self._dispatch_window(state, x_seq, active,
                                      int(np.asarray(x_seq).shape[1]))
+
+    def pdecode_multi(self, state: PagedDecodeState, x_t, active, k: int,
+                      *, eos_ids=None, active_cap=None,
+                      sampling: _smp.SamplingSpec = _smp.GREEDY,
+                      key=None, chain: Optional[HorizonChain] = None):
+        """Paged k-token horizon (ISSUE 19): same contract as
+        :meth:`GenerativeEngine.decode_multi`. Host ``lengths`` are NOT
+        advanced here — the batcher syncs them from the fetched per-slot
+        emit counts (mirroring the speculative rollback discipline); the
+        device-carried lengths ride ``result.chain`` so a chained
+        dispatch needs no host mirror. The caller must
+        ``prepare_write(..., k)`` + ``fork`` BEFORE dispatch so every
+        page the horizon can touch is exclusively writable. k is a
+        runtime scalar: the smallest warmed capacity kmax >= k serves
+        the dispatch, exactly like the contiguous path."""
+        k = int(k)
+        with self._lock:
+            warmed = sorted(
+                kk[1] for kk in self._compiled
+                if kk[0] == "pdecode_multi" and kk[2] == state.mp
+                and kk[1] >= k and tuple(kk[3:]) == sampling.static_key())
+        kmax = warmed[0] if warmed else k
+        exe = self._pdecode_multi_exe(kmax, state.mp, sampling)
+        self._m_calls.inc()
+        pt = np.ascontiguousarray(state.page_table[:, :state.mp],
+                                  dtype=np.int32)
+        params, mstate = self._place_params()
+        cap, eos, temp, key_arg = self._horizon_args(
+            k, active_cap, eos_ids, sampling, key)
+        if chain is not None:
+            x_arg, a_arg, key_arg = chain.x_t, chain.active, chain.key
+            l_arg = chain.lengths
+        else:
+            x_arg = self._put_arg(self._cast_x(x_t))
+            a_arg = self._put_arg(np.asarray(active, np.int32))
+            l_arg = self._put_arg(state.lengths.astype(np.int32))
+        tel = _tel.enabled()
+        t0 = time.perf_counter() if tel else None
+        pool, lengths, x2, a2, k2, toks, logits, emitted = exe(
+            params, mstate, state.caches, self._put_arg(pt), l_arg,
+            x_arg, a_arg, cap, eos, temp, key_arg,
+            self._put_arg(np.int32(k)))
+        state2 = PagedDecodeState(pool, state.lengths, state.page_table,
+                                  state.mp, state.page_size)
+        ch = HorizonChain(x2, a2, lengths, k2)
+        return state2, HorizonResult(toks, logits, emitted, ch, k,
+                                     self, t0)
 
     def fork(self, state: PagedDecodeState, pairs) -> PagedDecodeState:
         """Copy-on-write page copies: one batched executable call per

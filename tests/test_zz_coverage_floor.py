@@ -30,7 +30,10 @@ GRAD_FLOOR = 0.95
 _MARKING_FILES = {"test_conv3d_capsules.py", "test_flash_attention.py",
                   "test_m17_breadth.py", "test_ops.py", "test_ops_math.py",
                   "test_ops_grad_r5.py", "test_quantized_serving.py",
-                  "test_paged_kv.py", "test_fused_epilogues.py"}
+                  "test_paged_kv.py", "test_fused_epilogues.py",
+                  # host-free decode (ISSUE 19): sampling.greedy /
+                  # categorical / top_k forward marks
+                  "test_decode_horizon.py"}
 
 
 def test_workspace_policy_coverage_floor(request):
@@ -127,7 +130,12 @@ def test_telemetry_metric_floor(request):
               # disaggregated serving (ISSUE 18): the only writer of the
               # serving.disagg.* router counters, serving.phase.route_s,
               # and the kv_export_s/kv_import_s migration histograms
-              "test_disagg.py"}
+              "test_disagg.py",
+              # host-free decode horizons (ISSUE 19): the only writer of
+              # serving.decode.horizon, serving.decode.dispatch{decision=},
+              # serving.phase.decode_device_s/decode_host_s, and the
+              # windowed serving.tokens_per_s gauge
+              "test_decode_horizon.py"}
     missing = needed - collected
     if missing:
         pytest.skip(f"chunked run (telemetry-ledger-marking files not "
