@@ -5,7 +5,7 @@ PY ?= python
 
 .PHONY: test test-fast lint multihost-sim multihost-smoke bench \
 	bench-generative bench-kernels bench-pod-serving bench-disagg \
-	bench-decode disagg-sim trace-demo tune
+	bench-decode bench-fleet disagg-sim trace-demo tune
 
 # ISSUE 15: JAX-aware static analysis (runtime/staticcheck.py) — the
 # repo's hand-enforced invariants as machine-checked rules. Exits
@@ -71,6 +71,16 @@ print(json.dumps(bench.bench_pod_serving(), indent=1))"
 bench-decode:
 	env JAX_PLATFORMS=cpu $(PY) -c "import json, bench; \
 print(json.dumps(bench.bench_decode_loop(), indent=1))"
+
+# ISSUE 20: the model-fleet hot-swap metric standalone — open-loop
+# load across interleaved (steady, during-swap) window pairs; hard-
+# asserts in-bench that the median during/steady p99 ratio is <= 1.1,
+# zero requests dropped, zero post-warmup compiles on any incumbent,
+# and that the forced canary-rollback drill produced its flight dump
+# (swap/rollback counters ride the artifact). CPU-capable.
+bench-fleet:
+	env JAX_PLATFORMS=cpu $(PY) -c "import json, bench; \
+print(json.dumps(bench.bench_fleet_swap(), indent=1))"
 
 # ISSUE 18: the disaggregated-serving metric standalone — colocated vs
 # prefill/decode-split mixed-load A/B (interleaved rounds, median of

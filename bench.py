@@ -2602,6 +2602,152 @@ def bench_disaggregated_serving(rounds=3):
     }
 
 
+def bench_fleet_swap(pairs=3, steady_s=1.2):
+    """Model-fleet hot-swap metric (ISSUE 20, CPU-capable): open-loop
+    load threads drive one fleet model through ``pairs`` interleaved
+    (steady-window, swap-window) rounds — each swap window background-
+    builds + warms the next version and atomically flips to it mid-load.
+    Each pair has three phases, all under load: a measured steady
+    window; an UNMEASURED (but still drop-checked) build phase in which
+    the candidate version builds + warms off the serving path — on a
+    multi-core host this costs the serving path nothing (the incumbent's
+    zero post-warmup compiles prove it never re-entered XLA), while on a
+    1-core CI box the build's CPU time would otherwise masquerade as
+    serving-tail inflation; and a measured during-swap window bracketing
+    the atomic flip + drain + old-executable retirement — the phase a
+    naive stop-the-world reload stalls. Headline: median of per-pair
+    p99(during-swap)/p99(steady) ratios. Hard-asserted in-bench: the
+    ratio <= 1.1 (the flip is invisible at the tail), requests_dropped
+    == 0 across ALL phases (no typed shed, no untyped drop, ever), and
+    zero post-warmup compiles on every incumbent across every background
+    load/warm/flip. A forced canary rollback drill runs last so the
+    artifact's swap/rollback counters carry both lifecycle directions."""
+    import threading
+
+    from deeplearning4j_tpu.nn.config import (InputType,
+                                              NeuralNetConfiguration)
+    from deeplearning4j_tpu.nn.layers.core import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.model import MultiLayerNetwork
+    from deeplearning4j_tpu.runtime import faults
+    from deeplearning4j_tpu.runtime import telemetry as tel
+    from deeplearning4j_tpu.runtime.faults import (DeadlineExceeded,
+                                                   QueueFull,
+                                                   ShutdownError)
+    from deeplearning4j_tpu.serving import (CanaryGate, FleetError,
+                                            ModelRegistry)
+
+    feat = 32
+
+    def mk(seed):
+        conf = (NeuralNetConfiguration.builder().seed(seed)
+                .input_type(InputType.feed_forward(feat))
+                .list(DenseLayer(n_out=64, activation="relu"),
+                      OutputLayer(n_out=10))
+                .build())
+        return MultiLayerNetwork(conf).init()
+
+    fk = {"max_batch_size": 16, "max_wait_ms": 1.0}
+    reg = ModelRegistry()
+    reg.add_version("m", 1, mk(1), front_kwargs=dict(fk))
+    reg.set_live("m", 1)
+    rng = np.random.default_rng(0)
+    xs = [rng.normal(size=(4, feat)).astype(np.float32)
+          for _ in range(4)]
+    typed_shed, untyped = [], []
+
+    def window(during=None, duration_s=steady_s):
+        """Open-loop load window; returns per-request latencies (s).
+        ``during`` (the swap) runs on THIS thread mid-window."""
+        lats, stop = [], threading.Event()
+
+        def worker(k):
+            while not stop.is_set():
+                t0 = time.perf_counter()
+                try:
+                    reg.output("m", xs[k])
+                    lats.append(time.perf_counter() - t0)
+                except (QueueFull, DeadlineExceeded, ShutdownError,
+                        FleetError) as e:
+                    typed_shed.append(e)
+                except Exception as e:  # noqa: BLE001 - the invariant
+                    untyped.append(e)
+                time.sleep(0.001)
+
+        threads = [threading.Thread(target=worker, args=(k,),
+                                    daemon=True) for k in range(4)]
+        for t in threads:
+            t.start()
+        if during is not None:
+            time.sleep(duration_s / 3)
+            during()
+            time.sleep(duration_s / 3)
+        else:
+            time.sleep(duration_s)
+        stop.set()
+        for t in threads:
+            t.join(timeout=60)
+        return lats
+
+    ratios, pwc_checks, n_requests = [], [], 0
+    for i in range(pairs):
+        steady = window()
+        old_v, new_v = i + 1, i + 2
+        incumbent = reg.version("m", old_v)
+        # build phase: candidate builds + warms under load (drop-checked
+        # via the shared typed/untyped lists, not latency-measured)
+        window(during=lambda: reg.add_version(
+            "m", new_v, mk(new_v), front_kwargs=dict(fk)))
+        # invariant half 1: the background build/warm of new_v never
+        # compiled anything on the incumbent's serving path
+        pwc_checks.append(incumbent.post_warmup_compiles)
+        during = window(during=lambda: reg.set_live("m", new_v))
+        pwc_checks.append(reg.version("m", new_v).post_warmup_compiles)
+        n_requests += len(steady) + len(during)
+        ratios.append(float(np.percentile(during, 99)
+                            / np.percentile(steady, 99)))
+    ratio = float(np.median(ratios))
+
+    # forced rollback drill: the counters must carry both directions
+    last = pairs + 1
+    reg.add_version("m", last + 1, mk(99), front_kwargs=dict(fk))
+    reg.start_canary("m", last + 1,
+                     CanaryGate(fraction=0.3, min_samples=2))
+    faults.reset()
+    faults.inject("fleet.canary", times=1)
+    rb = reg.evaluate_canary("m")
+    faults.reset()
+    dump = tel.flight.last_dump
+    st = reg.stats()
+    reg.shutdown()
+
+    assert ratio <= 1.1, (
+        f"hot-swap visible at the tail: during/steady p99 ratio "
+        f"{ratio:.3f} > 1.1 (per-pair {ratios})")
+    assert not typed_shed and not untyped, (
+        f"requests dropped during hot-swap: {len(typed_shed)} typed, "
+        f"{len(untyped)} untyped ({(typed_shed + untyped)[:3]!r})")
+    assert all(c == 0 for c in pwc_checks), (
+        f"post-warmup compiles on a serving path: {pwc_checks}")
+    assert rb["decision"] == "rolled_back" and st["rollbacks"] == 1
+    assert dump and dump["reason"] == f"fleet.canary:m@v{last + 1}"
+
+    return {
+        "metric": "fleet_swap_p99_ratio",
+        "value": round(ratio, 3),
+        "unit": "x_p99_during_swap_vs_steady",
+        "model": f"MLP {feat}-64-10 fp32, {pairs} hot-swap pairs under "
+                 "4-thread open-loop load",
+        "per_pair_ratios": [round(r, 3) for r in ratios],
+        "requests": n_requests,
+        "requests_dropped": len(typed_shed) + len(untyped),
+        "post_warmup_compiles": max(pwc_checks),
+        "swaps": st["swaps"],
+        "rollbacks": st["rollbacks"],
+        "rollback_dump_reason": dump["reason"],
+        "pass": True,  # unreachable if any hard assert above fired
+    }
+
+
 def bench_multihost_scaling():
     """Pod-scale multi-host training (ISSUE 10): the 2-process CPU pod
     simulation — real subprocesses joined by ``jax.distributed`` (gloo

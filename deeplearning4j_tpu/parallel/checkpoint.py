@@ -421,6 +421,29 @@ class TrainingCheckpointer:
         return [s for s in sorted(self._mngr.all_steps(), reverse=True)
                 if self.verify(s) is True]
 
+    def scan_steps(self) -> dict:
+        """One watch-loop scan (the fleet's hot-swap seam, ISSUE 20):
+        classify every on-disk step as ``verified`` (manifest checks
+        out), ``torn`` (manifest mismatch — an interrupted writer; the
+        fleet watch loop skips these loudly) or ``unverified`` (no
+        manifest — pre-manifest checkpoint). Each list is newest first.
+        Forces a directory re-read where orbax supports it, so a watcher
+        polling a directory another PROCESS writes sees new steps."""
+        try:
+            steps = self._mngr.reload() or self._mngr.all_steps()
+        except (AttributeError, TypeError):  # older orbax: no reload()
+            try:
+                steps = self._mngr.all_steps(read=True)
+            except TypeError:
+                steps = self._mngr.all_steps()
+        out = {"verified": [], "torn": [], "unverified": []}
+        for s in sorted(steps, reverse=True):
+            v = self.verify(s)
+            key = "verified" if v is True else (
+                "torn" if v is False else "unverified")
+            out[key].append(s)
+        return out
+
     # -- restore ------------------------------------------------------------
     def latest_step(self) -> Optional[int]:
         return self._mngr.latest_step()

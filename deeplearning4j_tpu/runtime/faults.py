@@ -138,6 +138,17 @@ SITES = frozenset({
     "serving.quantize",   # weight quantization failure -> f32 fallback
     "serving.page_pool",  # paged-KV page allocation failure / pressure
     "parallel.host_loss",  # whole host drops out of the pod (reinit+restore)
+    # model-fleet hot-swap sites (ISSUE 20). Taxonomy mapping:
+    "fleet.load",         # background checkpoint load/warm failure —
+                          # TRANSIENT class: the watcher retries with
+                          # backoff, exhaustion fails the step loudly and
+                          # the incumbent keeps serving
+    "fleet.swap",         # failure at the atomic flip point — rollback
+                          # class: candidate marked FAILED, old version
+                          # keeps serving, flight-recorder dump
+    "fleet.canary",       # forced canary-gate trip — NOT an error:
+                          # rollback is the designed outcome, nothing
+                          # propagates to callers
 })
 
 

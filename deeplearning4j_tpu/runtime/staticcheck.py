@@ -35,6 +35,12 @@ cross-check share a single walk per suite run). Rules:
   bind ``pool=<role>`` beside the instance label (ISSUE 18): one scrape
   collects a disaggregated prefill/decode process pair, and an
   unlabeled-pool cell blends both roles' telemetry.
+- ``fleet-version-label`` — fleet-managed serving cells (the
+  ``serving.fleet.*`` family, plus any ``serving.*`` declaration inside
+  ``serving/fleet.py``) must bind ``version=<v>`` beside their instance/
+  pool labels (ISSUE 20): the fleet runs N versions of one model
+  concurrently, and an unversioned cell blends the incumbent's p99 with
+  the canary's — the exact signal promotion/rollback decides on.
 - ``registry-lock-discipline`` — a read-modify-write of a registry cell
   (``.set(... .value() ...)``, ``.zero()``-then-``.inc()``, cross-kind
   shims) must sit inside a ``registry.locked()``/``_lock`` context.
@@ -630,6 +636,58 @@ def _check_pool_labels(idx: ModuleIndex):
                 f"instance label ({'/'.join(INSTANCE_LABEL_KEYS)}) and a "
                 "pool= label — a disaggregated prefill/decode pair "
                 "otherwise blends both roles into one cell")
+
+
+# ---------------------------------------------- rule: fleet-version-label
+
+#: metric-name families whose cells describe one VERSION of a servable
+#: (ISSUE 20): the fleet runs N versions of one model concurrently
+#: (incumbent + canary, or mid-swap overlap), and a cell bound without
+#: ``version=`` blends two versions' latency into one p99 — which is
+#: exactly the signal the canary gate promotes/rolls back on.
+VERSION_SCOPED_FAMILIES = ("serving.fleet.",)
+#: fleet-managed modules: ANY ``serving.*`` cell recorded here describes
+#: a versioned servable, whatever its family, so the version= obligation
+#: extends to the whole serving namespace inside them.
+FLEET_MODULES = ("serving/fleet.py",)
+
+
+@rule("fleet-version-label",
+      "fleet-managed serving cells must bind version=<v> next to their "
+      "engine=/pi=/model=/pool= labels")
+def _check_fleet_version_labels(idx: ModuleIndex):
+    try:
+        indexes = package_index() if os.path.exists(idx.path) else [idx]
+    except Exception:
+        indexes = [idx]
+    if idx not in indexes:
+        indexes = [idx] + list(indexes)
+    fleet_module = idx.rel in FLEET_MODULES
+    for call, name, assigned, chained in _metric_decls(idx):
+        if not (name.startswith(VERSION_SCOPED_FAMILIES)
+                or (fleet_module and name.startswith("serving."))):
+            continue
+        sites = []
+        if chained is not None:
+            attr, chain_call = chained
+            if attr in _READ_METHODS:
+                continue   # read-side lookup, creates no cell
+            if attr in _WRITE_METHODS:
+                sites = [chain_call]
+        elif assigned is not None:
+            sites = [s for _i, s in
+                     _instance_binding_sites(indexes, assigned)]
+        bad = [s for s in sites
+               if not any(kw.arg == "version" for kw in s.keywords)]
+        if bad or not sites:
+            yield Finding(
+                "fleet-version-label", idx.rel,
+                (bad[0].lineno if bad else call.lineno),
+                f"fleet-managed metric {name!r} must be bound with a "
+                "version= label beside its instance/pool labels — two "
+                "versions of one model otherwise blend into one cell, "
+                "corrupting the very p99/error deltas the canary gate "
+                "decides on")
 
 
 # -------------------------------------------- rule: registry-lock-discipline

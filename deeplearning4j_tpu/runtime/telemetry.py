@@ -846,7 +846,7 @@ COMPILE_CAUSES = ("first_build", "warmup", "new_bucket", "dtype_policy",
                   "workspace_mode", "params_placement", "init",
                   "invalidate", "config_change", "precision", "probe",
                   "lr_backoff", "autotune", "overlap", "quantize",
-                  "host_loss", "schedule_tune")
+                  "host_loss", "schedule_tune", "fleet_retire")
 
 _compile_counter = counter(
     "compile.events",

@@ -6,7 +6,9 @@ continuous batching with streaming; ISSUE 12 adds the paged KV pool —
 fixed-size HBM pages + host page tables, copy-on-write prefix sharing,
 and draft/verify speculative decoding; ISSUE 18 disaggregates the
 generative path — prefill and decode pools joined by KV-page migration,
-with a router owning admission)."""
+with a router owning admission; ISSUE 20 adds the model fleet — a
+versioned registry behind one front with checkpoint-watch hot-swap,
+SLO-gated canarying and automatic rollback)."""
 
 from ..runtime.faults import (DeadlineExceeded, QueueFull,  # noqa: F401
                               ShutdownError)
@@ -19,4 +21,6 @@ from .batcher import (ContinuousBatcher, GenerationHandle,  # noqa: F401
                       HealthState, InferenceMode, ParallelInference)
 from .disagg import (DisaggRouter, KVShipment,  # noqa: F401
                      PrefillReplica, RouterHandle)
+from .fleet import (CanaryGate, CheckpointWatcher,  # noqa: F401
+                    FleetError, ModelRegistry, ModelVersion)
 from .server import JsonModelServer  # noqa: F401

@@ -480,6 +480,14 @@ class ParallelInference:
         is atomic under the GIL; readers snapshot)."""
         self._events.append((time.perf_counter(), kind))
 
+    def note_shed(self):
+        """Count an EXTERNAL load-shed against this front's health state
+        machine (ISSUE 20: the fleet's per-model quota rejects before
+        submit() — the rejection must still flip health to SHEDDING
+        exactly as a queue-depth shed would)."""
+        self._m_shed.inc()
+        self._note("shed")
+
     def health(self) -> str:
         """The serving health state machine:
 
@@ -1155,6 +1163,12 @@ class ContinuousBatcher:
 
     def _note(self, kind: str):
         self._events.append((time.perf_counter(), kind))
+
+    def note_shed(self):
+        """Count an EXTERNAL load-shed against this front's health state
+        machine (ISSUE 20 fleet quota — see ParallelInference.note_shed)."""
+        self._m_shed.inc()
+        self._note("shed")
 
     def health(self) -> str:
         """HEALTHY / DEGRADED / SHEDDING over the recent event window —

@@ -1,0 +1,939 @@
+"""Zero-downtime model fleet (ISSUE 20 tentpole): versioned registry,
+checkpoint-watch hot-swap, SLO-gated canary, automatic rollback.
+
+Every serving engine in the zoo — one-shot :class:`InferenceEngine`,
+generative :class:`GenerativeEngine`, paged, quantized, tensor-parallel —
+lives one-model-per-process with no safe way to change the model under
+traffic. This module composes the existing parts into the TF-Serving
+production layer (PAPERS.md 1605.08695 §serving: versioned servables
+behind one front, background load/warmup, atomic flip, rollback on
+regression):
+
+- :class:`ModelVersion` — one versioned servable: a model wrapped in a
+  warmed serving front (``ParallelInference`` for one-shot engines,
+  ``ContinuousBatcher`` for generative/paged/quantized flavors), its
+  warmed bucket set, and per-version telemetry cells labeled
+  ``model=<name>, version=<v>, pool=`` so two versions of one model can
+  never blend into one p99 (the ``fleet-version-label`` lint rule keeps
+  it that way).
+- :class:`ModelRegistry` — N models x N versions behind one routing
+  front. ``submit()`` routes by (model, pinned version | canary split |
+  live), enforces the per-model quota (an exceeded quota raises
+  ``QueueFull`` AND feeds the live front's shed/health state machine via
+  ``note_shed()``), and observes per-version request latency.
+- **Hot-swap** rides :class:`CheckpointWatcher`: a background loop over
+  a ``TrainingCheckpointer`` directory in which only
+  ``verified_steps()`` manifests are eligible — torn/corrupt writes are
+  skipped LOUDLY (``swap_events{event=skipped_torn}`` + a warning), the
+  new version loads and warms its buckets entirely off the serving path
+  (zero post-warmup compile events on the live version, recorded in the
+  ``post_warmup_compiles`` gauge and asserted by the chaos drills), then
+  an atomic flip retires the old version's executables. A failure at ANY
+  stage — injected via the ``fleet.load`` / ``fleet.swap`` /
+  ``fleet.canary`` fault sites — leaves the old version serving: there
+  is never a window with no servable model. ``fleet.load`` failures are
+  retried with backoff while transient (the taxonomy's retry class);
+  ``fleet.swap`` failures roll back; a ``fleet.canary`` trip is NOT an
+  error — it is the rollback path working as designed.
+- **Canarying is SLO-gated** (:class:`CanaryGate`): a configurable
+  traffic fraction routes to the candidate; promotion requires every
+  gate green — windowed accuracy delta (probe), error-rate delta, p99
+  ratio, and TTFT/TPOT ratios for generative fronts — evaluated the r17
+  burn-rate way (windowed reservoirs, minimum sample counts, consecutive
+  green windows). Any trip triggers automatic rollback with a
+  flight-recorder dump whose events carry the candidate version and its
+  recent trace ids, so the regression is attributable to the flip.
+"""
+
+from __future__ import annotations
+
+import itertools
+import logging
+import random
+import threading
+import time
+import weakref
+from collections import deque
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from ..runtime import faults as _faults
+from ..runtime import telemetry as _tel
+from ..runtime.faults import QueueFull
+from .batcher import (ContinuousBatcher, HealthState, InferenceMode,
+                      ParallelInference)
+
+log = logging.getLogger("deeplearning4j_tpu")
+
+# Per-version fleet cells. EVERY binding carries model= (instance),
+# version= (the fleet-version-label rule: two versions of one model must
+# never blend into one cell) and pool= (one scrape may collect several
+# fleet processes/roles).
+_M_ROUTED = _tel.counter(
+    "serving.fleet.routed",
+    "requests routed per model/version by arm= (live/canary/pinned)")
+_H_LAT = _tel.histogram(
+    "serving.fleet.request_latency_s",
+    "per-version submit->resolve latency (timestamped reservoir: the "
+    "canary gate reads windowed p99s per arm from these cells)")
+_G_PWC = _tel.gauge(
+    "serving.fleet.post_warmup_compiles",
+    "compile events on a version's engine since its warmup finished — "
+    "nonzero on a LIVE version means the serving path recompiled under "
+    "traffic (the zero-downtime invariant the chaos drills assert)")
+_M_SWAP = _tel.counter(
+    "serving.fleet.swap_events",
+    "hot-swap lifecycle events per model/version by event= (loaded / "
+    "load_retry / load_failed / flipped / retired / swap_failed / "
+    "skipped_torn)")
+_M_CANARY = _tel.counter(
+    "serving.fleet.canary_events",
+    "canary lifecycle events per model/version by event= (started / "
+    "green / promoted / rolled_back)")
+_M_QUOTA = _tel.counter(
+    "serving.fleet.quota_shed",
+    "requests rejected by the per-model quota (also fed into the live "
+    "front's shed/health state machine)")
+
+_HEALTH_ORDER = {HealthState.HEALTHY: 0, HealthState.DEGRADED: 1,
+                 HealthState.SHEDDING: 2}
+
+
+def worst_health(states) -> str:
+    """Worst-of health aggregation for the fleet ``/healthz`` top-level
+    code (per-model breakdown rides in the body)."""
+    worst = HealthState.HEALTHY
+    for s in states:
+        if _HEALTH_ORDER.get(s, 0) > _HEALTH_ORDER[worst]:
+            worst = s
+    return worst
+
+
+class FleetError(RuntimeError):
+    """A fleet control-plane operation failed (unknown model/version,
+    flip on an unwarmed candidate, ...). Request-path failures keep
+    their typed serving errors (QueueFull/DeadlineExceeded/...)."""
+
+
+class ModelVersion:
+    """One versioned servable: model + warmed serving front + telemetry.
+
+    ``kind="one-shot"`` wraps the model in a :class:`ParallelInference`
+    front (any ``InferenceEngine`` flavor: pass ``engine=`` prebuilt, or
+    ``quantize=``/``mesh=`` through ``front_kwargs``); ``kind=
+    "generative"`` wraps a :class:`ContinuousBatcher` (``GenerativeEngine``
+    / ``PagedGenerativeEngine`` via ``paged=True`` / quantized via
+    ``quantize=``/``kv_cache=`` in ``front_kwargs``). The front warms its
+    full bucket set at construction — a version is only routable once
+    warm, and :attr:`post_warmup_compiles` must stay 0 while it serves.
+    """
+
+    # lifecycle states
+    WARMING = "WARMING"
+    READY = "READY"          # warmed, not routed
+    LIVE = "LIVE"
+    CANARY = "CANARY"
+    RETIRED = "RETIRED"
+    FAILED = "FAILED"
+    ROLLED_BACK = "ROLLED_BACK"
+
+    def __init__(self, name: str, version: int, model,
+                 kind: str = "one-shot",
+                 front_kwargs: Optional[dict] = None,
+                 checkpoint_step: Optional[int] = None,
+                 pool_label: str = "fleet"):
+        if kind not in ("one-shot", "generative"):
+            raise ValueError(f"unknown servable kind {kind!r}")
+        self.name = str(name)
+        self.version = int(version)
+        self.model = model
+        self.kind = kind
+        self.checkpoint_step = checkpoint_step
+        self.state = self.WARMING
+        self._pool_label = str(pool_label)
+        kw = dict(front_kwargs or {})
+        kw.setdefault("pool_label", self._pool_label)
+        t0 = time.perf_counter()
+        if kind == "generative":
+            kw.setdefault("warmup", True)
+            self.front = ContinuousBatcher(model, **kw)
+        else:
+            kw.setdefault("warmup", True)
+            kw.setdefault("mode", InferenceMode.BATCHED)
+            self.front = ParallelInference(model, **kw)
+        self.warmup_s = time.perf_counter() - t0
+        # the compile floor: everything after this count is a
+        # post-warmup compile on this version's serving path
+        self._warm_compiles = int(self.front.engine.stats()["compiles"])
+        # explicit model=/version=/pool= at every binding site — the
+        # lint rules (metric-label-blending, pool-scoped-metric-label,
+        # fleet-version-label) verify the kwargs statically
+        self._h_latency = _H_LAT.labeled(model=self.name,
+                                         version=str(self.version),
+                                         pool=self._pool_label)
+        self._g_pwc = _G_PWC.labeled(model=self.name,
+                                     version=str(self.version),
+                                     pool=self._pool_label)
+        self._g_pwc.set(0)
+        self.routed = 0
+        weakref.finalize(self, _tel.registry.discard_cells,
+                         model=self.name, version=str(self.version))
+        self.state = self.READY
+
+    def note_routed(self, arm: str):
+        """Count one request routed to this version (``arm=`` live /
+        canary / pinned — the traffic-split audit trail)."""
+        self.routed += 1
+        _M_ROUTED.inc(model=self.name, version=str(self.version),
+                      pool=self._pool_label, arm=arm)
+
+    @property
+    def post_warmup_compiles(self) -> int:
+        """Compile events on this version's engine since warmup — the
+        zero-impact invariant: a LIVE version must report 0 across any
+        background load/warmup/flip of another version."""
+        n = int(self.front.engine.stats()["compiles"]) - self._warm_compiles
+        self._g_pwc.set(n)
+        return n
+
+    def health(self) -> str:
+        return self.front.health()
+
+    def latency_p99(self, window_s: Optional[float] = None
+                    ) -> Optional[float]:
+        """Windowed p99 of THIS version's fleet-routed requests (the
+        canary gate's latency input; seconds, None below sample floor)."""
+        return self._h_latency.percentile(99, window=window_s)
+
+    def ttft_p99(self, window_s: Optional[float] = None) -> Optional[float]:
+        h = getattr(self.front, "_h_ttft", None)
+        return None if h is None else h.percentile(99, window=window_s)
+
+    def tpot_p99(self, window_s: Optional[float] = None) -> Optional[float]:
+        h = getattr(self.front, "_h_tpot", None)
+        return None if h is None else h.percentile(99, window=window_s)
+
+    def output(self, x, deadline_ms: Optional[float] = None):
+        """Blocking single-version convenience (probe path — bypasses
+        routing/quota so an accuracy probe never perturbs the split)."""
+        if self.kind != "one-shot":
+            raise FleetError("output() probes the one-shot front; use "
+                             "submit_generate for generative versions")
+        return self.front.output(x, deadline_ms=deadline_ms)
+
+    def retire(self, drain_s: float = 2.0):
+        """Stop serving: drain the queue (bounded), shut the front down,
+        and drop the compiled executables (the atomic flip's 'retire old
+        executables' half). Safe to call twice."""
+        if self.state == self.RETIRED:
+            return
+        deadline = time.monotonic() + max(0.0, drain_s)
+        while self.front.queue_depth() > 0 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        self.front.shutdown()
+        # registered cause: a compile attributed to fleet_retire after
+        # this point means something rebuilt a RETIRED version's
+        # executables — a bug the retrace dashboard should name
+        self.front.engine.invalidate(cause="fleet_retire")
+        if self.state not in (self.ROLLED_BACK, self.FAILED):
+            # keep the forensic terminal states — a rolled-back canary
+            # stays attributably ROLLED_BACK even after its executables
+            # are dropped
+            self.state = self.RETIRED
+
+    def stats(self) -> dict:
+        return {"version": self.version, "kind": self.kind,
+                "state": self.state, "health": self.health(),
+                "checkpoint_step": self.checkpoint_step,
+                "warmup_s": self.warmup_s,
+                "post_warmup_compiles": self.post_warmup_compiles,
+                "routed": self.routed,
+                "queue_depth": self.front.queue_depth()}
+
+
+class CanaryGate:
+    """Promotion gates for one canary evaluation window. ALL gates must
+    be green to count a window green; ``promote_after`` consecutive green
+    windows promote. Any red gate triggers automatic rollback.
+
+    Gates (each skipped when its inputs are absent/below sample floor —
+    a gate that cannot be evaluated is *pending*, never green):
+
+    - ``max_error_delta`` — candidate windowed error rate may exceed the
+      incumbent's by at most this much (absolute fraction).
+    - ``max_p99_ratio`` — candidate windowed latency p99 / incumbent p99.
+    - ``max_accuracy_drop`` — with a ``probe`` (called per arm with the
+      :class:`ModelVersion`; returns accuracy in [0,1]), the incumbent-
+      minus-candidate accuracy delta allowed.
+    - ``max_ttft_ratio`` / ``max_tpot_ratio`` — generative fronts only.
+    """
+
+    def __init__(self, fraction: float = 0.2, window_s: float = 5.0,
+                 min_samples: int = 8, promote_after: int = 1,
+                 max_error_delta: float = 0.02,
+                 max_p99_ratio: float = 1.25,
+                 max_accuracy_drop: float = 0.02,
+                 max_ttft_ratio: float = 1.25,
+                 max_tpot_ratio: float = 1.25,
+                 probe: Optional[Callable[[ModelVersion], float]] = None):
+        if not 0.0 < fraction < 1.0:
+            raise ValueError("canary fraction must be in (0, 1)")
+        self.fraction = float(fraction)
+        self.window_s = float(window_s)
+        self.min_samples = int(min_samples)
+        self.promote_after = max(1, int(promote_after))
+        self.max_error_delta = float(max_error_delta)
+        self.max_p99_ratio = float(max_p99_ratio)
+        self.max_accuracy_drop = float(max_accuracy_drop)
+        self.max_ttft_ratio = float(max_ttft_ratio)
+        self.max_tpot_ratio = float(max_tpot_ratio)
+        self.probe = probe
+
+
+class _ModelEntry:
+    """Registry-internal per-model state (guarded by the registry lock
+    for control-plane mutation; the request path reads the live/canary
+    references without holding it — flips are single-reference writes)."""
+
+    def __init__(self, name: str, quota: Optional[int]):
+        self.name = name
+        self.quota = None if quota is None else int(quota)
+        self.versions: Dict[int, ModelVersion] = {}
+        self.live: Optional[ModelVersion] = None
+        self.canary: Optional[ModelVersion] = None
+        self.gate: Optional[CanaryGate] = None
+        self.green_streak = 0
+        self.inflight = 0
+        self.inflight_lock = threading.Lock()
+        # windowed per-arm outcomes for the canary error-delta gate, and
+        # the candidate's recent trace ids for rollback attribution
+        self.outcomes: deque = deque(maxlen=4096)   # (t, version, ok)
+        self.canary_traces: deque = deque(maxlen=64)
+        self.failed_loads: set = set()               # checkpoint steps
+        self.skipped_torn: set = set()
+
+
+class ModelRegistry:
+    """N models x N versions behind one routing front (the TF-Serving
+    ServableManager shape). See the module docstring for the contract.
+
+    Usage::
+
+        reg = ModelRegistry()
+        reg.add_version("mnist", 1, net_v1)          # builds + warms
+        reg.set_live("mnist", 1)                     # atomic flip
+        fut = reg.submit("mnist", x)                 # routed request
+        reg.add_version("mnist", 2, net_v2)
+        reg.start_canary("mnist", 2, CanaryGate(fraction=0.25))
+        ...traffic...
+        reg.evaluate_canary("mnist")  # -> promoted / rolled_back / ...
+    """
+
+    def __init__(self, pool_label: str = "fleet", seed: int = 0):
+        self._pool_label = str(pool_label)
+        self._models: Dict[str, _ModelEntry] = {}
+        self._lock = threading.RLock()
+        # seeded: the traffic split is deterministic under test
+        self._rng = random.Random(seed)
+        self.swaps = 0
+        self.rollbacks = 0
+
+    # ---- control plane ----------------------------------------------------
+    def add_model(self, name: str, quota: Optional[int] = None
+                  ) -> "_ModelEntry":
+        with self._lock:
+            entry = self._models.get(name)
+            if entry is None:
+                entry = self._models[name] = _ModelEntry(name, quota)
+            elif quota is not None:
+                entry.quota = int(quota)
+            return entry
+
+    def add_version(self, name: str, version: int, model,
+                    kind: str = "one-shot",
+                    front_kwargs: Optional[dict] = None,
+                    checkpoint_step: Optional[int] = None,
+                    quota: Optional[int] = None) -> ModelVersion:
+        """Build + warm one servable version. Warmup happens HERE, on the
+        caller's thread (the watcher's background thread for hot-swaps) —
+        never on the serving path. The version is READY but unrouted
+        until :meth:`set_live` / :meth:`start_canary`."""
+        entry = self.add_model(name, quota)
+        with self._lock:
+            if version in entry.versions:
+                raise FleetError(f"{name} version {version} already "
+                                 "registered")
+        mv = ModelVersion(name, version, model, kind=kind,
+                          front_kwargs=front_kwargs,
+                          checkpoint_step=checkpoint_step,
+                          pool_label=self._pool_label)
+        with self._lock:
+            entry.versions[version] = mv
+        _M_SWAP.inc(model=name, version=str(version),
+                    pool=self._pool_label, event="loaded")
+        return mv
+
+    def _entry(self, name: str) -> _ModelEntry:
+        entry = self._models.get(name)
+        if entry is None:
+            raise FleetError(f"unknown model {name!r}; registered: "
+                             f"{sorted(self._models)}")
+        return entry
+
+    def version(self, name: str, version: int) -> ModelVersion:
+        entry = self._entry(name)
+        mv = entry.versions.get(int(version))
+        if mv is None:
+            raise FleetError(f"unknown version {version} of {name!r}; "
+                             f"registered: {sorted(entry.versions)}")
+        return mv
+
+    def live_version(self, name: str) -> Optional[ModelVersion]:
+        return self._entry(name).live
+
+    def set_live(self, name: str, version: int,
+                 retire_old: bool = True, drain_s: float = 2.0
+                 ) -> ModelVersion:
+        """ATOMIC FLIP. The candidate must be warmed (READY/CANARY); the
+        ``fleet.swap`` fault site sits at the flip point — an injected
+        (or real) failure there leaves the OLD version serving and marks
+        the candidate FAILED, with a flight-recorder dump. On success the
+        old version's executables retire (drain + shutdown + invalidate)
+        off the request path."""
+        entry = self._entry(name)
+        with self._lock:
+            mv = self.version(name, version)
+            if mv.state not in (ModelVersion.READY, ModelVersion.CANARY):
+                raise FleetError(
+                    f"cannot flip {name} to version {version} in state "
+                    f"{mv.state} (must be warmed READY/CANARY)")
+            old = entry.live
+            try:
+                if _faults.enabled():
+                    _faults.trip("fleet.swap")
+            except Exception as e:
+                mv.state = ModelVersion.FAILED
+                if entry.canary is mv:
+                    entry.canary = None
+                    entry.gate = None
+                _M_SWAP.inc(model=name, version=str(version),
+                            pool=self._pool_label, event="swap_failed")
+                _tel.flight.record({
+                    "type": "fleet_swap_failed", "model": name,
+                    "candidate_version": version,
+                    "live_version": None if old is None else old.version,
+                    "error": f"{type(e).__name__}: {e}"})
+                _tel.flight.auto_dump(f"fleet.swap:{name}@v{version}")
+                log.warning("fleet swap of %s to v%d failed (%s: %s); "
+                            "version %s keeps serving", name, version,
+                            type(e).__name__, e,
+                            "none" if old is None else old.version)
+                raise
+            # the flip: one reference write — a request routed a
+            # microsecond earlier still resolves on the old front (it
+            # drains before retirement), a request routed after lands on
+            # the new warmed front. Never a window with no servable.
+            entry.live = mv
+            mv.state = ModelVersion.LIVE
+            if entry.canary is mv:
+                entry.canary = None
+                entry.gate = None
+            self.swaps += 1
+        _M_SWAP.inc(model=name, version=str(version),
+                    pool=self._pool_label, event="flipped")
+        _tel.flight.record({"type": "fleet_flip", "model": name,
+                            "version": version,
+                            "from": None if old is None else old.version})
+        if old is not None and old is not mv and retire_old:
+            old.retire(drain_s=drain_s)
+            _M_SWAP.inc(model=name, version=str(old.version),
+                        pool=self._pool_label, event="retired")
+        return mv
+
+    # ---- canary -----------------------------------------------------------
+    def start_canary(self, name: str, version: int, gate: CanaryGate
+                     ) -> ModelVersion:
+        entry = self._entry(name)
+        with self._lock:
+            if entry.live is None:
+                raise FleetError(f"{name} has no live version to canary "
+                                 "against; set_live first")
+            mv = self.version(name, version)
+            if mv.state != ModelVersion.READY:
+                raise FleetError(f"canary candidate must be READY; "
+                                 f"{name} v{version} is {mv.state}")
+            entry.canary = mv
+            entry.gate = gate
+            entry.green_streak = 0
+            entry.canary_traces.clear()
+            mv.state = ModelVersion.CANARY
+        _M_CANARY.inc(model=name, version=str(version),
+                      pool=self._pool_label, event="started")
+        return mv
+
+    def _arm_window(self, entry: _ModelEntry, version: int,
+                    window_s: float):
+        now = time.monotonic()
+        sel = [ok for t, v, ok in list(entry.outcomes)
+               if v == version and now - t <= window_s]
+        return sel
+
+    def evaluate_canary(self, name: str) -> dict:
+        """One canary evaluation window. Returns ``{"decision": ...,
+        "gates": {...}}`` where decision is ``no_canary`` / ``pending``
+        (a gate lacks samples) / ``green`` (streak advanced) /
+        ``promoted`` / ``rolled_back``. The ``fleet.canary`` fault site
+        fires HERE: an injected trip forces the rollback path — by the
+        taxonomy it is not an error (rollback is the designed outcome),
+        so nothing raises."""
+        entry = self._entry(name)
+        with self._lock:
+            cand, live, gate = entry.canary, entry.live, entry.gate
+        if cand is None or gate is None or live is None:
+            return {"decision": "no_canary", "gates": {}}
+        gates: Dict[str, Optional[bool]] = {}
+        forced = None
+        if _faults.enabled():
+            try:
+                inj = _faults.trip("fleet.canary")
+            except Exception as e:
+                # an error-kind injection at the canary site is ALSO a
+                # trip, not a crash: the gate fails closed into rollback
+                inj, forced = True, f"{type(e).__name__}: {e}"
+            if inj is not None:
+                gates["injected"] = False
+                forced = forced or "fault-injected canary trip"
+        W = gate.window_s
+        if not gates.get("injected") is False:
+            live_out = self._arm_window(entry, live.version, W)
+            cand_out = self._arm_window(entry, cand.version, W)
+            if len(cand_out) >= gate.min_samples and \
+                    len(live_out) >= gate.min_samples:
+                live_err = 1.0 - sum(live_out) / len(live_out)
+                cand_err = 1.0 - sum(cand_out) / len(cand_out)
+                gates["error_delta"] = (cand_err - live_err
+                                        <= gate.max_error_delta)
+            else:
+                gates["error_delta"] = None
+            lp, cp = live.latency_p99(W), cand.latency_p99(W)
+            gates["p99_ratio"] = None if lp is None or cp is None or lp <= 0 \
+                else cp / lp <= gate.max_p99_ratio
+            if gate.probe is not None:
+                try:
+                    acc_live = float(gate.probe(live))
+                    acc_cand = float(gate.probe(cand))
+                    gates["accuracy_delta"] = (acc_live - acc_cand
+                                               <= gate.max_accuracy_drop)
+                except Exception as e:
+                    log.warning("canary accuracy probe failed (%s: %s); "
+                                "gate fails closed", type(e).__name__, e)
+                    gates["accuracy_delta"] = False
+            if cand.kind == "generative":
+                lt, ct = live.ttft_p99(W), cand.ttft_p99(W)
+                gates["ttft_ratio"] = None if lt is None or ct is None \
+                    or lt <= 0 else ct / lt <= gate.max_ttft_ratio
+                lt, ct = live.tpot_p99(W), cand.tpot_p99(W)
+                gates["tpot_ratio"] = None if lt is None or ct is None \
+                    or lt <= 0 else ct / lt <= gate.max_tpot_ratio
+        if any(v is False for v in gates.values()):
+            self._rollback_canary(name, entry, cand, live, gates, forced)
+            return {"decision": "rolled_back", "gates": gates}
+        if any(v is None for v in gates.values()) or not gates:
+            return {"decision": "pending", "gates": gates}
+        with self._lock:
+            entry.green_streak += 1
+            streak = entry.green_streak
+        _M_CANARY.inc(model=name, version=str(cand.version),
+                      pool=self._pool_label, event="green")
+        if streak >= gate.promote_after:
+            self.set_live(name, cand.version)
+            _M_CANARY.inc(model=name, version=str(cand.version),
+                          pool=self._pool_label, event="promoted")
+            return {"decision": "promoted", "gates": gates}
+        return {"decision": "green", "gates": gates}
+
+    def _rollback_canary(self, name: str, entry: _ModelEntry,
+                         cand: ModelVersion, live: ModelVersion,
+                         gates: dict, forced: Optional[str]):
+        """Automatic rollback: the candidate leaves the traffic split
+        (the incumbent was never demoted — rollback is one reference
+        clear), and the flight recorder dumps with the candidate version
+        and its recent trace ids so the regression is attributable."""
+        with self._lock:
+            entry.canary = None
+            entry.gate = None
+            entry.green_streak = 0
+            cand.state = ModelVersion.ROLLED_BACK
+            traces = list(entry.canary_traces)
+            self.rollbacks += 1
+        _M_CANARY.inc(model=name, version=str(cand.version),
+                      pool=self._pool_label, event="rolled_back")
+        _tel.flight.record({
+            "type": "canary_rollback", "model": name,
+            "candidate_version": cand.version,
+            "incumbent_version": live.version,
+            "gates": {k: v for k, v in gates.items()},
+            "forced": forced,
+            "candidate_traces": traces})
+        _tel.flight.auto_dump(f"fleet.canary:{name}@v{cand.version}")
+        log.warning("canary %s v%d rolled back (gates=%s%s); incumbent "
+                    "v%d keeps serving", name, cand.version, gates,
+                    f", {forced}" if forced else "", live.version)
+        cand.retire()
+
+    # ---- request path -----------------------------------------------------
+    def _route(self, entry: _ModelEntry, version: Optional[int]):
+        if version is not None:
+            mv = entry.versions.get(int(version))
+            if mv is None or mv.state in (ModelVersion.RETIRED,
+                                          ModelVersion.FAILED,
+                                          ModelVersion.ROLLED_BACK):
+                raise FleetError(
+                    f"version {version} of {entry.name!r} is not "
+                    "servable")
+            return mv, "pinned"
+        cand = entry.canary
+        if cand is not None and entry.gate is not None and \
+                self._rng.random() < entry.gate.fraction:
+            return cand, "canary"
+        live = entry.live
+        if live is None:
+            raise FleetError(f"model {entry.name!r} has no live version")
+        return live, "live"
+
+    def _admit(self, entry: _ModelEntry, mv: ModelVersion):
+        """Per-model quota: a cap on in-flight fleet requests for this
+        model (all versions). Exceeding it is a counted, typed rejection
+        that ALSO feeds the live front's shed/health state machine —
+        ``/healthz`` flips the model to SHEDDING exactly as a queue-depth
+        shed would."""
+        if entry.quota is None:
+            return
+        with entry.inflight_lock:
+            over = entry.inflight >= entry.quota
+            if not over:
+                return
+        _M_QUOTA.inc(model=entry.name, version=str(mv.version),
+                     pool=self._pool_label)
+        shed_on = entry.live if entry.live is not None else mv
+        shed_on.front.note_shed()
+        raise QueueFull(
+            f"model {entry.name!r} at quota ({entry.quota} in-flight)")
+
+    def submit(self, name: str, x, version: Optional[int] = None,
+               deadline_ms: Optional[float] = None):
+        """Route one one-shot request; returns the front's Future (its
+        ``trace_id`` rides along). Typed failures only: FleetError for
+        routing errors, QueueFull for quota/shed, and the front's own
+        DeadlineExceeded/ShutdownError through the future."""
+        entry = self._entry(name)
+        mv, arm = self._route(entry, version)
+        if mv.kind != "one-shot":
+            raise FleetError(f"{name} v{mv.version} is generative; use "
+                             "submit_generate()")
+        self._admit(entry, mv)
+        with entry.inflight_lock:
+            entry.inflight += 1
+        t0 = time.perf_counter()
+        try:
+            fut = mv.front.submit(x, deadline_ms=deadline_ms)
+        except BaseException:
+            with entry.inflight_lock:
+                entry.inflight -= 1
+            raise
+        mv.note_routed(arm)
+        if arm == "canary" and getattr(fut, "trace_id", None) is not None:
+            entry.canary_traces.append(fut.trace_id)
+
+        def _done(f, _mv=mv, _entry=entry, _t0=t0):
+            with _entry.inflight_lock:
+                _entry.inflight -= 1
+            ok = f.cancelled() is False and f.exception() is None
+            _mv._h_latency.observe(time.perf_counter() - _t0)
+            _entry.outcomes.append((time.monotonic(), _mv.version, ok))
+
+        fut.fleet_front = mv.front  # for wait(): shutdown-aware blocking
+        fut.fleet_version = mv.version
+        fut.add_done_callback(_done)
+        return fut
+
+    def wait(self, fut):
+        """Block on a fleet-submitted future, shutdown-aware (rides the
+        serving front the request was actually routed to — pinned/canary
+        arms included)."""
+        front = getattr(fut, "fleet_front", None)
+        if isinstance(front, ParallelInference):
+            return front._wait(fut)
+        return fut.result()
+
+    def output(self, name: str, x, version: Optional[int] = None,
+               deadline_ms: Optional[float] = None):
+        """Blocking convenience over :meth:`submit`."""
+        return self.wait(self.submit(name, x, version=version,
+                                     deadline_ms=deadline_ms))
+
+    def submit_generate(self, name: str, version: Optional[int] = None,
+                        **kw):
+        """Route one generative request (``prompt=``/``tokens=``/
+        ``max_new_tokens=``/``deadline_ms=`` as the batcher takes them);
+        returns the :class:`GenerationHandle`."""
+        entry = self._entry(name)
+        mv, arm = self._route(entry, version)
+        if mv.kind != "generative":
+            raise FleetError(f"{name} v{mv.version} is one-shot; use "
+                             "submit()")
+        self._admit(entry, mv)
+        with entry.inflight_lock:
+            entry.inflight += 1
+        t0 = time.perf_counter()
+        try:
+            handle = mv.front.submit(**kw)
+        except BaseException:
+            with entry.inflight_lock:
+                entry.inflight -= 1
+            raise
+        mv.note_routed(arm)
+        if arm == "canary" and getattr(handle, "trace_id", None) is not None:
+            entry.canary_traces.append(handle.trace_id)
+
+        def _done(f, _mv=mv, _entry=entry, _t0=t0):
+            with _entry.inflight_lock:
+                _entry.inflight -= 1
+            ok = f.cancelled() is False and f.exception() is None
+            _mv._h_latency.observe(time.perf_counter() - _t0)
+            _entry.outcomes.append((time.monotonic(), _mv.version, ok))
+
+        handle.future.add_done_callback(_done)
+        return handle
+
+    # ---- observability ----------------------------------------------------
+    def models(self) -> List[str]:
+        with self._lock:
+            return sorted(self._models)
+
+    def single_model_name(self) -> str:
+        names = self.models()
+        if len(names) != 1:
+            raise FleetError(
+                f"request names no model and the fleet serves "
+                f"{len(names)} ({names}); send the X-Model header")
+        return names[0]
+
+    def healthz(self) -> dict:
+        """Per-model readiness: top-level ``status`` is worst-of the
+        LIVE versions only — a SHEDDING canary cannot mark the whole
+        front 503 while its incumbent is HEALTHY; canary health rides in
+        the per-model breakdown instead (the ISSUE 20 healthz bugfix)."""
+        models = {}
+        with self._lock:
+            entries = list(self._models.items())
+        for name, entry in entries:
+            live, cand = entry.live, entry.canary
+            m = {"live_version": None if live is None else live.version,
+                 "health": HealthState.SHEDDING if live is None
+                 else live.health(),
+                 "queue_depth": 0 if live is None
+                 else live.front.queue_depth(),
+                 "quota": entry.quota, "inflight": entry.inflight}
+            if cand is not None:
+                m["canary"] = {"version": cand.version,
+                               "health": cand.health()}
+            models[name] = m
+        status = worst_health(m["health"] for m in models.values())
+        return {"status": status, "models": models}
+
+    def stats(self) -> dict:
+        out = {"swaps": self.swaps, "rollbacks": self.rollbacks,
+               "models": {}}
+        with self._lock:
+            entries = list(self._models.items())
+        for name, entry in entries:
+            out["models"][name] = {
+                "live_version": None if entry.live is None
+                else entry.live.version,
+                "canary_version": None if entry.canary is None
+                else entry.canary.version,
+                "quota": entry.quota, "inflight": entry.inflight,
+                "versions": {v: mv.stats()
+                             for v, mv in sorted(entry.versions.items())}}
+        return out
+
+    def shutdown(self):
+        with self._lock:
+            entries = list(self._models.values())
+        for entry in entries:
+            for mv in entry.versions.values():
+                if mv.state != ModelVersion.RETIRED:
+                    mv.front.shutdown()
+
+
+# ===========================================================================
+# Checkpoint-watch hot-swap loop
+# ===========================================================================
+
+class CheckpointWatcher:
+    """Background watch loop over a ``TrainingCheckpointer`` directory:
+    deploy every NEW manifest-verified step as a hot-swap (or a canary
+    when ``gate`` is set), never touching the serving path.
+
+    - Only ``verified_steps()`` manifests are eligible. Torn writes
+      (manifest mismatch) are skipped LOUDLY — once per step: a warning
+      plus ``swap_events{event=skipped_torn}``.
+    - The load stage (build via ``model_factory()`` + restore + warm)
+      runs on this thread with the ``fleet.load`` fault site armed:
+      transient failures retry with exponential backoff up to
+      ``load_retries`` (counted ``load_retry``); exhaustion marks the
+      step failed (``load_failed`` + flight dump) and the incumbent
+      keeps serving.
+    - The flip stage routes through ``ModelRegistry.set_live`` (the
+      ``fleet.swap`` site) or ``start_canary`` + ``evaluate_canary``
+      (the ``fleet.canary`` site) when a :class:`CanaryGate` is given.
+
+    ``poll()`` runs one synchronous iteration (what the tests drive);
+    ``start()`` spawns the daemon loop at ``interval_s``.
+    """
+
+    def __init__(self, registry: ModelRegistry, name: str, checkpointer,
+                 model_factory: Callable[[], object],
+                 kind: str = "one-shot",
+                 front_kwargs: Optional[dict] = None,
+                 gate: Optional[CanaryGate] = None,
+                 interval_s: float = 0.5,
+                 load_retries: int = 3, backoff_s: float = 0.02,
+                 drain_s: float = 2.0):
+        self.registry = registry
+        self.name = str(name)
+        self.ckpt = checkpointer
+        self.model_factory = model_factory
+        self.kind = kind
+        self.front_kwargs = dict(front_kwargs or {})
+        self.gate = gate
+        self.interval_s = float(interval_s)
+        self.load_retries = int(load_retries)
+        self.backoff_s = float(backoff_s)
+        self.drain_s = float(drain_s)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.deployed_step: Optional[int] = None
+        registry.add_model(self.name)
+        # start numbering above any pre-existing versions so a watcher
+        # attached to a manually-deployed model doesn't collide on v1
+        entry = registry._entry(self.name)
+        self._versions = itertools.count(
+            1 + max(entry.versions, default=0))
+
+    # -- one iteration ------------------------------------------------------
+    def poll(self) -> Optional[dict]:
+        """One watch iteration: scan, skip torn loudly, deploy the newest
+        verified step not yet deployed/failed. Returns a deployment
+        report dict, or None when nothing new."""
+        entry = self.registry._entry(self.name)
+        scan = self.ckpt.scan_steps()
+        for s in scan["torn"]:
+            if s not in entry.skipped_torn:
+                entry.skipped_torn.add(s)
+                _M_SWAP.inc(model=self.name, version=str(s),
+                            pool=self.registry._pool_label,
+                            event="skipped_torn")
+                log.warning(
+                    "checkpoint step %d in %s failed manifest "
+                    "verification (torn write) — skipped by the fleet "
+                    "watch loop; the live version keeps serving",
+                    s, self.ckpt.directory)
+        candidates = [s for s in scan["verified"]
+                      if (self.deployed_step is None
+                          or s > self.deployed_step)
+                      and s not in entry.failed_loads]
+        if not candidates:
+            # an armed canary still needs its evaluation heartbeat
+            if entry.canary is not None:
+                res = self.registry.evaluate_canary(self.name)
+                if res["decision"] in ("promoted", "rolled_back"):
+                    return {"step": self.deployed_step, **res}
+            return None
+        step = candidates[0]  # newest-first from scan_steps()
+        try:
+            mv = self._load(step)
+        except Exception as e:
+            entry.failed_loads.add(step)
+            _M_SWAP.inc(model=self.name, version=str(step),
+                        pool=self.registry._pool_label,
+                        event="load_failed")
+            _tel.flight.record({
+                "type": "fleet_load_failed", "model": self.name,
+                "checkpoint_step": step,
+                "error": f"{type(e).__name__}: {e}"})
+            _tel.flight.auto_dump(f"fleet.load:{self.name}@step{step}")
+            log.warning("fleet load of %s step %d failed after retries "
+                        "(%s: %s); the live version keeps serving",
+                        self.name, step, type(e).__name__, e)
+            return {"step": step, "decision": "load_failed"}
+        self.deployed_step = step
+        if self.gate is not None and entry.live is not None:
+            self.registry.start_canary(self.name, mv.version, self.gate)
+            return {"step": step, "decision": "canary_started",
+                    "version": mv.version}
+        try:
+            self.registry.set_live(self.name, mv.version,
+                                   drain_s=self.drain_s)
+        except Exception:
+            return {"step": step, "decision": "swap_failed",
+                    "version": mv.version}
+        return {"step": step, "decision": "flipped",
+                "version": mv.version}
+
+    def _load(self, step: int) -> ModelVersion:
+        """Load stage with the transient-retry contract: ``fleet.load``
+        trips before the expensive work; transient failures back off and
+        retry (the taxonomy's retry class), non-transient ones raise."""
+        attempt = 0
+        while True:
+            try:
+                if _faults.enabled():
+                    _faults.trip("fleet.load")
+                model = self.model_factory()
+                self.ckpt.restore(model, step=step)
+                return self.registry.add_version(
+                    self.name, next(self._versions), model,
+                    kind=self.kind, front_kwargs=dict(self.front_kwargs),
+                    checkpoint_step=step)
+            except Exception as e:
+                if attempt < self.load_retries and _faults.is_transient(e):
+                    attempt += 1
+                    _M_SWAP.inc(model=self.name, version=str(step),
+                                pool=self.registry._pool_label,
+                                event="load_retry")
+                    time.sleep(self.backoff_s * (2 ** (attempt - 1)))
+                    continue
+                raise
+
+    # -- daemon loop --------------------------------------------------------
+    def _loop(self):
+        while not self._stop.is_set():
+            try:
+                self.poll()
+            except Exception as e:  # the watch loop must never die
+                log.warning("fleet watch iteration failed (%s: %s)",
+                            type(e).__name__, e)
+            self._stop.wait(self.interval_s)
+
+    def start(self) -> "CheckpointWatcher":
+        if self._thread is None or not self._thread.is_alive():
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._loop, daemon=True,
+                name=f"CheckpointWatcher-{self.name}")
+            self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()

@@ -34,19 +34,34 @@ class JsonModelServer:
     GET /metrics -> the process-wide MetricsRegistry in Prometheus text
     exposition (ISSUE 6): serving counters/latency summaries, engine
     bucket/compile counters, flash-attention dispatch, resilience
-    telemetry, retrace-tracker events — one scrape endpoint for the lot."""
+    telemetry, retrace-tracker events — one scrape endpoint for the lot.
 
-    def __init__(self, model, port: int = 0, host: str = "127.0.0.1",
+    Fleet mode (ISSUE 20): pass ``fleet=ModelRegistry(...)`` instead of a
+    model and ONE server front-ends N models x N versions. Requests route
+    by the ``X-Model`` header (optional when the fleet serves exactly one
+    model) and optional ``X-Model-Version`` pin; unknown names/versions
+    are 404s. ``/healthz`` becomes per-model: the top-level status code
+    is worst-of the LIVE versions only (a SHEDDING canary cannot 503 the
+    whole front while its incumbent is HEALTHY), with the per-model —
+    and per-canary — breakdown in the body. The registry's lifecycle
+    (hot-swap watch loops, canary evaluation) belongs to the caller;
+    ``stop()`` does not shut the fleet down."""
+
+    def __init__(self, model=None, port: int = 0, host: str = "127.0.0.1",
                  mode: str = InferenceMode.BATCHED,
-                 pre_processor=None, generate=None, **inference_kwargs):
-        self.inference = ParallelInference(model, mode=mode,
-                                           **inference_kwargs)
+                 pre_processor=None, generate=None, fleet=None,
+                 **inference_kwargs):
+        if (model is None) == (fleet is None):
+            raise ValueError("pass exactly one of model= or fleet=")
+        self.fleet = fleet
+        self.inference = None if fleet is not None else ParallelInference(
+            model, mode=mode, **inference_kwargs)
         # ISSUE 8: generative serving front. ``generate`` is a kwargs dict
         # for ContinuousBatcher (slots/max_cache_len/...); when set, POST
         # /generate streams per-token partial results (NDJSON lines, one
         # per decode iteration) or returns the full token list
         self.generator = None
-        if generate is not None:
+        if generate is not None and fleet is None:
             from .batcher import ContinuousBatcher
             self.generator = ContinuousBatcher(model, **dict(generate))
         self.pre_processor = pre_processor
@@ -75,6 +90,16 @@ class JsonModelServer:
                 if self.path == "/health":
                     self._send(200, {"status": "ok"})
                 elif self.path == "/healthz":
+                    if server.fleet is not None:
+                        # ISSUE 20 bugfix: per-model readiness. The top-
+                        # level code aggregates worst-of the LIVE versions
+                        # only — a SHEDDING canary must not 503 the whole
+                        # front while its incumbent is HEALTHY; its health
+                        # rides in the per-model breakdown instead
+                        body = server.fleet.healthz()
+                        self._send(503 if body["status"] ==
+                                   HealthState.SHEDDING else 200, body)
+                        return
                     pi = server.inference
                     h = pi.health()
                     body = {"status": h,
@@ -96,6 +121,9 @@ class JsonModelServer:
                     # queue depth, bucket hits / compiles; with a
                     # generative front, the page-pool occupancy / prefix
                     # hits / speculative accept-rate ride along (ISSUE 12)
+                    if server.fleet is not None:
+                        self._send(200, server.fleet.stats())
+                        return
                     st = dict(server.inference.stats())
                     if server.generator is not None:
                         st["generator"] = server.generator.stats()
@@ -129,6 +157,24 @@ class JsonModelServer:
                 else:
                     self._send(404, {"error": "unknown path"})
 
+            def _fleet_target(self):
+                """Resolve (name, version) from the routing headers.
+                ``X-Model`` may be omitted when the fleet serves exactly
+                one model; ``X-Model-Version`` pins a version."""
+                from .fleet import FleetError
+                name = self.headers.get("X-Model")
+                if name is None:
+                    name = server.fleet.single_model_name()
+                ver = self.headers.get("X-Model-Version")
+                if ver is not None:
+                    try:
+                        ver = int(ver)
+                    except ValueError:
+                        raise FleetError(
+                            f"X-Model-Version must be an integer; got "
+                            f"{ver!r}")
+                return name, ver
+
             def do_POST(self):
                 if self.path == "/generate":
                     self._generate()
@@ -136,6 +182,7 @@ class JsonModelServer:
                 if self.path != "/predict":
                     self._send(404, {"error": "unknown path"})
                     return
+                from .fleet import FleetError
                 try:
                     n = int(self.headers.get("Content-Length", 0))
                     req = json.loads(self.rfile.read(n) or b"{}")
@@ -145,8 +192,13 @@ class JsonModelServer:
                         ds = DataSet(x, None)
                         server.pre_processor.transform(ds)
                         x = ds.features
-                    fut = server.inference.submit(x)
-                    out = server.inference._wait(fut)
+                    if server.fleet is not None:
+                        name, ver = self._fleet_target()
+                        fut = server.fleet.submit(name, x, version=ver)
+                        out = server.fleet.wait(fut)
+                    else:
+                        fut = server.inference.submit(x)
+                        out = server.inference._wait(fut)
                     payload = {"output":
                                [np.asarray(o).tolist() for o in out]
                                if isinstance(out, list)
@@ -155,7 +207,13 @@ class JsonModelServer:
                     # GET /trace/<id> (absent when telemetry is off)
                     if getattr(fut, "trace_id", None) is not None:
                         payload["trace_id"] = fut.trace_id
+                    if server.fleet is not None:
+                        # which version actually served the request (the
+                        # canary split means the caller cannot know)
+                        payload["version"] = fut.fleet_version
                     self._send(200, payload)
+                except FleetError as e:
+                    self._send(404, {"error": f"{type(e).__name__}: {e}"})
                 except QueueFull as e:
                     self._send(429, {"error": f"{type(e).__name__}: {e}"})
                 except DeadlineExceeded as e:
@@ -173,7 +231,8 @@ class JsonModelServer:
                 (partial results at token boundaries), then a final
                 ``{"done": true, "tokens": [...]}`` line; non-streaming
                 returns one JSON body."""
-                if server.generator is None:
+                from .fleet import FleetError
+                if server.generator is None and server.fleet is None:
                     self._send(404, {"error": "server was built without "
                                      "generate= support"})
                     return
@@ -186,12 +245,16 @@ class JsonModelServer:
                     if req.get("deadline_ms") is not None:
                         kw["deadline_ms"] = float(req["deadline_ms"])
                     if "tokens" in req:
-                        handle = server.generator.submit(
-                            tokens=[int(t) for t in req["tokens"]], **kw)
+                        kw["tokens"] = [int(t) for t in req["tokens"]]
                     else:
-                        handle = server.generator.submit(
-                            prompt=np.asarray(req["prompt"], np.float32),
-                            **kw)
+                        kw["prompt"] = np.asarray(req["prompt"],
+                                                  np.float32)
+                    if server.fleet is not None:
+                        name, ver = self._fleet_target()
+                        handle = server.fleet.submit_generate(
+                            name, version=ver, **kw)
+                    else:
+                        handle = server.generator.submit(**kw)
                     if not req.get("stream"):
                         res = handle.result()
                         payload = {"tokens": res["tokens"]}
@@ -222,6 +285,8 @@ class JsonModelServer:
                         self.wfile.write(json.dumps(
                             {"error": f"{type(e).__name__}: {e}"}
                         ).encode() + b"\n")
+                except FleetError as e:
+                    self._send(404, {"error": f"{type(e).__name__}: {e}"})
                 except QueueFull as e:
                     self._send(429, {"error": f"{type(e).__name__}: {e}"})
                 except DeadlineExceeded as e:
@@ -242,7 +307,10 @@ class JsonModelServer:
         if self._httpd:
             self._httpd.shutdown()
             self._httpd.server_close()
-        self.inference.shutdown()
+        # a fleet's lifecycle (watch loops, canaries) belongs to whoever
+        # built the registry — the HTTP front never tears it down
+        if self.inference is not None:
+            self.inference.shutdown()
         if self.generator is not None:
             self.generator.shutdown()
 

@@ -243,6 +243,67 @@ def test_fault_site_registration_positive_negative():
     assert sc.check_source(dynamic, rules=["fault-site-registration"]) == []
 
 
+def test_fleet_version_label_positive_negative():
+    """ISSUE 20 satellite: serving cells recorded from fleet-managed code
+    must carry version= at EVERY binding site — two versions of one model
+    must never blend into one p99 during a canary."""
+    bad = ('_H = histogram("serving.fleet.request_latency_s", "lat")\n'
+           "\n"
+           "class V:\n"
+           "    def __init__(self):\n"
+           "        self._h = _H.labeled(model=self.name, pool='fleet')\n")
+    good = ('_H = histogram("serving.fleet.request_latency_s", "lat")\n'
+            "\n"
+            "class V:\n"
+            "    def __init__(self):\n"
+            "        self._h = _H.labeled(model=self.name,\n"
+            "                             version=str(self.version),\n"
+            "                             pool='fleet')\n")
+    assert rules_of(sc.check_source(bad, rules=["fleet-version-label"])) \
+        == ["fleet-version-label"]
+    assert sc.check_source(good, rules=["fleet-version-label"]) == []
+    # chained writes: the version obligation holds for direct inc() too
+    chain_bad = ('counter("serving.fleet.swap_events", "e")'
+                 '.inc(model="m", event="loaded")\n')
+    chain_good = ('counter("serving.fleet.swap_events", "e")'
+                  '.inc(model="m", version="1", event="loaded")\n')
+    assert rules_of(sc.check_source(chain_bad,
+                                    rules=["fleet-version-label"])) \
+        == ["fleet-version-label"]
+    assert sc.check_source(chain_good, rules=["fleet-version-label"]) == []
+    # reads never create cells; a declaration with NO binding site at all
+    # is itself a finding (an unbindable fleet cell cannot carry version=)
+    read_only = ('p = histogram("serving.fleet.request_latency_s", "l")'
+                 ".percentile(99)\n")
+    assert sc.check_source(read_only, rules=["fleet-version-label"]) == []
+    unbound = '_M = counter("serving.fleet.routed", "r")\n'
+    assert rules_of(sc.check_source(unbound,
+                                    rules=["fleet-version-label"])) \
+        == ["fleet-version-label"]
+    # outside fleet modules, non-fleet serving families are exempt ...
+    other = ('_M = counter("serving.engine.calls", "c")\n'
+             "\n"
+             "class E:\n"
+             "    def __init__(self):\n"
+             "        self._m = _M.labeled(engine=self._id)\n")
+    assert sc.check_source(other, rules=["fleet-version-label"]) == []
+    # ... but INSIDE serving/fleet.py every serving.* cell is versioned
+    assert rules_of(sc.check_source(other, rel="serving/fleet.py",
+                                    rules=["fleet-version-label"])) \
+        == ["fleet-version-label"]
+
+
+def test_fleet_version_label_suppression():
+    src = ('_H = histogram("serving.fleet.request_latency_s", "lat")\n'
+           "\n"
+           "class V:\n"
+           "    def __init__(self):\n"
+           "        # staticcheck: disable=fleet-version-label -- "
+           "aggregate-only cell, no per-version split\n"
+           "        self._h = _H.labeled(model=self.name, pool='fleet')\n")
+    assert sc.check_source(src, rules=["fleet-version-label"]) == []
+
+
 # ------------------------------------------------- suppressions + baseline
 
 
@@ -467,4 +528,5 @@ def test_zz_gate_zero_open_findings_on_shipped_tree():
     for f, e in rep.baselined:
         assert str(e["reason"]).strip(), f
     assert rep.stale_baseline == [], rep.stale_baseline
-    assert len(rep.rules) >= 6
+    # ratchet: ISSUE 20 landed fleet-version-label as the 10th rule
+    assert len(rep.rules) >= 10

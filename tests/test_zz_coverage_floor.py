@@ -70,7 +70,10 @@ def test_fault_site_coverage_floor(request):
     # fires serving.page_pool (ISSUE 12)
     needed = {"test_resilience.py", "test_generative_decode.py",
               "test_quantized_serving.py", "test_multihost_pod.py",
-              "test_paged_kv.py"}
+              "test_paged_kv.py",
+              # model fleet (ISSUE 20): the only firer of the fleet.load /
+              # fleet.swap / fleet.canary sites (chaos drills)
+              "test_fleet.py"}
     missing = needed - collected
     if missing:
         pytest.skip(f"chunked run (fault-firing files not collected: "
@@ -135,7 +138,12 @@ def test_telemetry_metric_floor(request):
               # serving.decode.horizon, serving.decode.dispatch{decision=},
               # serving.phase.decode_device_s/decode_host_s, and the
               # windowed serving.tokens_per_s gauge
-              "test_decode_horizon.py"}
+              "test_decode_horizon.py",
+              # model fleet (ISSUE 20): the only writer of the
+              # serving.fleet.* family (routed, request_latency_s,
+              # post_warmup_compiles, swap_events, canary_events,
+              # quota_shed)
+              "test_fleet.py"}
     missing = needed - collected
     if missing:
         pytest.skip(f"chunked run (telemetry-ledger-marking files not "
